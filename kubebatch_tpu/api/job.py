@@ -1,0 +1,238 @@
+"""TaskInfo / JobInfo — per-pod and per-PodGroup aggregates.
+
+ref: pkg/scheduler/api/job_info.go, pod_info.go.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..objects import (Pod, PodGroup, PodPhase, is_backfill_pod)
+from .resource import Resource
+from .types import (JobReadiness, TaskStatus, allocated_status,
+                    allocated_statuses, validate_status_update)
+
+
+def pod_key(pod: Pod) -> str:
+    """'namespace/name' task key (ref: api/helpers.go:27-33)."""
+    return f"{pod.namespace}/{pod.name}"
+
+
+def get_task_status(pod: Pod) -> TaskStatus:
+    """Pod phase -> TaskStatus (ref: api/helpers.go:35-61)."""
+    if pod.phase == PodPhase.RUNNING:
+        return (TaskStatus.RELEASING if pod.deletion_timestamp is not None
+                else TaskStatus.RUNNING)
+    if pod.phase == PodPhase.PENDING:
+        if pod.deletion_timestamp is not None:
+            return TaskStatus.RELEASING
+        return TaskStatus.PENDING if not pod.node_name else TaskStatus.BOUND
+    if pod.phase == PodPhase.SUCCEEDED:
+        return TaskStatus.SUCCEEDED
+    if pod.phase == PodPhase.FAILED:
+        return TaskStatus.FAILED
+    return TaskStatus.UNKNOWN
+
+
+def get_pod_resource_without_init_containers(pod: Pod) -> Resource:
+    """Sum of app-container requests (ref: api/pod_info.go:71-80)."""
+    result = Resource.empty()
+    for c in pod.containers:
+        result.add(Resource.from_resource_list(c.requests))
+    return result
+
+
+def get_pod_resource_request(pod: Pod) -> Resource:
+    """max(sum of containers, each init container) per dimension — init
+    containers run sequentially (ref: api/pod_info.go:33-69)."""
+    result = get_pod_resource_without_init_containers(pod)
+    for c in pod.init_containers:
+        result.set_max(Resource.from_resource_list(c.requests))
+    return result
+
+
+def get_job_id(pod: Pod) -> str:
+    """'namespace/group-name' from the group annotation, else ''
+    (ref: job_info.go:60-70)."""
+    gn = pod.group_name
+    return f"{pod.namespace}/{gn}" if gn else ""
+
+
+class TaskInfo:
+    """Scheduling view of one pod (ref: job_info.go:36-131)."""
+
+    __slots__ = ("uid", "job", "name", "namespace", "resreq", "init_resreq",
+                 "node_name", "status", "priority", "volume_ready", "pod",
+                 "is_backfill")
+
+    def __init__(self, pod: Pod):
+        self.uid: str = pod.uid
+        self.job: str = get_job_id(pod)
+        self.name: str = pod.name
+        self.namespace: str = pod.namespace
+        #: steady-state request (app containers only)
+        self.resreq: Resource = get_pod_resource_without_init_containers(pod)
+        #: launch-time request (max with init containers) — what predicates use
+        self.init_resreq: Resource = get_pod_resource_request(pod)
+        self.node_name: str = pod.node_name
+        self.status: TaskStatus = get_task_status(pod)
+        self.priority: int = pod.priority if pod.priority is not None else 1
+        self.volume_ready: bool = False
+        self.pod: Pod = pod
+        self.is_backfill: bool = is_backfill_pod(pod)
+
+    def clone(self) -> "TaskInfo":
+        t = object.__new__(TaskInfo)
+        t.uid = self.uid
+        t.job = self.job
+        t.name = self.name
+        t.namespace = self.namespace
+        t.resreq = self.resreq.clone()
+        t.init_resreq = self.init_resreq.clone()
+        t.node_name = self.node_name
+        t.status = self.status
+        t.priority = self.priority
+        t.volume_ready = self.volume_ready
+        t.pod = self.pod
+        t.is_backfill = self.is_backfill
+        return t
+
+    @property
+    def key(self) -> str:
+        return pod_key(self.pod)
+
+    def __repr__(self) -> str:
+        return (f"Task({self.namespace}/{self.name}: job={self.job}, "
+                f"status={self.status}, pri={self.priority}, "
+                f"resreq={self.resreq}, backfill={self.is_backfill})")
+
+
+class JobInfo:
+    """PodGroup-level aggregate (ref: job_info.go:140-388)."""
+
+    def __init__(self, uid: str, *tasks: TaskInfo):
+        self.uid: str = uid
+        self.name: str = ""
+        self.namespace: str = ""
+        self.queue: str = ""
+        self.priority: int = 0
+        self.node_selector: Dict[str, str] = {}
+        self.min_available: int = 0
+        #: node -> fit-delta Resource for unschedulable diagnostics
+        self.nodes_fit_delta: Dict[str, Resource] = {}
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        self.allocated: Resource = Resource.empty()
+        self.total_request: Resource = Resource.empty()
+        self.creation_timestamp: float = 0.0
+        self.pod_group: Optional[PodGroup] = None
+        for t in tasks:
+            self.add_task_info(t)
+
+    # --- PodGroup binding -------------------------------------------------
+    def set_pod_group(self, pg: PodGroup) -> None:
+        self.name = pg.name
+        self.namespace = pg.namespace
+        self.min_available = pg.min_member
+        self.queue = pg.queue
+        self.creation_timestamp = pg.creation_timestamp
+        self.pod_group = pg
+
+    def unset_pod_group(self) -> None:
+        self.pod_group = None
+
+    # --- task index maintenance (ref: job_info.go:231-292) ---------------
+    def _add_task_index(self, ti: TaskInfo) -> None:
+        self.task_status_index.setdefault(ti.status, {})[ti.uid] = ti
+
+    def add_task_info(self, ti: TaskInfo) -> None:
+        self.tasks[ti.uid] = ti
+        self._add_task_index(ti)
+        if ti.pod.priority is not None:
+            self.priority = ti.pod.priority
+        self.total_request.add(ti.resreq)
+        if allocated_status(ti.status):
+            self.allocated.add(ti.resreq)
+
+    def delete_task_info(self, ti: TaskInfo) -> None:
+        task = self.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(
+                f"failed to find task <{ti.namespace}/{ti.name}> in job "
+                f"<{self.namespace}/{self.name}>")
+        self.total_request.sub(task.resreq)
+        if allocated_status(task.status):
+            self.allocated.sub(task.resreq)
+        del self.tasks[task.uid]
+        index = self.task_status_index.get(task.status)
+        if index is not None:
+            index.pop(task.uid, None)
+            if not index:
+                del self.task_status_index[task.status]
+
+    def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        validate_status_update(task.status, status)
+        self.delete_task_info(task)
+        task.status = status
+        self.add_task_info(task)
+
+    def get_tasks(self, *statuses: TaskStatus) -> List[TaskInfo]:
+        """Clones of tasks in the given states (ref: job_info.go:217-229)."""
+        res: List[TaskInfo] = []
+        for status in statuses:
+            for task in self.task_status_index.get(status, {}).values():
+                res.append(task.clone())
+        return res
+
+    def count(self, *statuses: TaskStatus) -> int:
+        return sum(len(self.task_status_index.get(s, {})) for s in statuses)
+
+    # --- readiness (fork semantics, ref: job_info.go:374-388) -------------
+    def get_readiness(self) -> JobReadiness:
+        allocated_cnt = self.count(*allocated_statuses())
+        if allocated_cnt >= self.min_available:
+            return JobReadiness.READY
+        over_backfill_cnt = self.count(TaskStatus.ALLOCATED_OVER_BACKFILL)
+        if allocated_cnt + over_backfill_cnt >= self.min_available:
+            return JobReadiness.ALMOST_READY
+        return JobReadiness.NOT_READY
+
+    def fit_error(self) -> str:
+        """Human-readable unschedulable explanation
+        (ref: job_info.go:343-372)."""
+        if not self.nodes_fit_delta:
+            return "0 nodes are available"
+        reasons: Dict[str, int] = {}
+        for delta in self.nodes_fit_delta.values():
+            if delta.milli_cpu < 0:
+                reasons["cpu"] = reasons.get("cpu", 0) + 1
+            if delta.memory < 0:
+                reasons["memory"] = reasons.get("memory", 0) + 1
+            if delta.milli_gpu < 0:
+                reasons["GPU"] = reasons.get("GPU", 0) + 1
+        parts = sorted(f"{v} insufficient {k}" for k, v in reasons.items())
+        return (f"0/{len(self.nodes_fit_delta)} nodes are available, "
+                f"{', '.join(parts)}.")
+
+    def clone(self) -> "JobInfo":
+        info = JobInfo(self.uid)
+        info.name = self.name
+        info.namespace = self.namespace
+        info.queue = self.queue
+        info.priority = self.priority
+        info.min_available = self.min_available
+        info.node_selector = dict(self.node_selector)
+        info.creation_timestamp = self.creation_timestamp
+        info.pod_group = self.pod_group
+        for task in self.tasks.values():
+            info.add_task_info(task.clone())
+        return info
+
+    def __repr__(self) -> str:
+        return (f"Job({self.uid}): ns={self.namespace} queue={self.queue} "
+                f"name={self.name} minAvailable={self.min_available} "
+                f"tasks={len(self.tasks)}")
+
+
+def job_terminated(job: JobInfo) -> bool:
+    """ref: api/helpers.go:99-104."""
+    return job.pod_group is None and not job.tasks

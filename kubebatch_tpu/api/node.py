@@ -1,0 +1,131 @@
+"""NodeInfo — per-node resource accounting.
+
+ref: pkg/scheduler/api/node_info.go. The Idle/Used/Releasing/Backfilled
+relations here are what the solver tensors project onto the node axis.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..objects import Node
+from .job import TaskInfo, pod_key
+from .resource import Resource
+from .types import TaskStatus
+
+
+class NodeInfo:
+    """Per-node aggregate (ref: node_info.go:27-45).
+
+    - idle:       allocatable minus everything placed (non-pipelined)
+    - used:       running + terminating placements
+    - releasing:  resreq of tasks being deleted, less pipelined reuse
+    - backfilled: resreq occupied by backfill tasks (fork feature)
+    """
+
+    def __init__(self, node: Optional[Node] = None):
+        self.name: str = node.name if node else ""
+        self.node: Optional[Node] = node
+        self.releasing = Resource.empty()
+        self.used = Resource.empty()
+        self.backfilled = Resource.empty()
+        if node is not None:
+            self.idle = Resource.from_resource_list(node.allocatable)
+            self.allocatable = Resource.from_resource_list(node.allocatable)
+            self.capability = Resource.from_resource_list(node.capacity)
+        else:
+            self.idle = Resource.empty()
+            self.allocatable = Resource.empty()
+            self.capability = Resource.empty()
+        self.tasks: Dict[str, TaskInfo] = {}
+
+    def clone(self) -> "NodeInfo":
+        res = NodeInfo(self.node)
+        for task in self.tasks.values():
+            res.add_task(task)
+        return res
+
+    def set_node(self, node: Node) -> None:
+        """Recompute accounting from scratch for a (re)seen node
+        (ref: node_info.go:95-111)."""
+        self.name = node.name
+        self.node = node
+        self.allocatable = Resource.from_resource_list(node.allocatable)
+        self.capability = Resource.from_resource_list(node.capacity)
+        self.idle = Resource.from_resource_list(node.allocatable)
+        # Reference resets only Idle here (node_info.go:101), double-counting
+        # Used/Releasing on repeated node events and never refreshing
+        # Backfilled — an accounting bug we fix, like accessible().
+        self.used = Resource.empty()
+        self.releasing = Resource.empty()
+        self.backfilled = Resource.empty()
+        for task in self.tasks.values():
+            if task.is_backfill:
+                self.backfilled.add(task.resreq)
+            if task.status == TaskStatus.RELEASING:
+                self.releasing.add(task.resreq)
+            self.idle.sub(task.resreq)
+            self.used.add(task.resreq)
+
+    def add_task(self, task: TaskInfo) -> None:
+        """ref: node_info.go:113-145. Holds a CLONE of the task so later
+        session status flips can't corrupt node accounting."""
+        key = pod_key(task.pod)
+        if key in self.tasks:
+            raise KeyError(f"task <{task.namespace}/{task.name}> already on "
+                           f"node <{self.name}>")
+        ti = task.clone()
+        if self.node is not None:
+            if task.is_backfill:
+                self.backfilled.add(task.resreq)
+            if ti.status == TaskStatus.RELEASING:
+                self.releasing.add(ti.resreq)
+                self.idle.sub(ti.resreq)
+            elif ti.status == TaskStatus.PIPELINED:
+                self.releasing.sub(ti.resreq)
+            else:
+                self.idle.sub(ti.resreq)
+            self.used.add(ti.resreq)
+        self.tasks[key] = ti
+
+    def remove_task(self, ti: TaskInfo) -> None:
+        """ref: node_info.go:147-177 (inverse of add_task)."""
+        key = pod_key(ti.pod)
+        task = self.tasks.get(key)
+        if task is None:
+            raise KeyError(f"failed to find task <{ti.namespace}/{ti.name}> "
+                           f"on host <{self.name}>")
+        if self.node is not None:
+            if task.is_backfill:
+                self.backfilled.sub(task.resreq)
+            if task.status == TaskStatus.RELEASING:
+                self.releasing.sub(task.resreq)
+                self.idle.add(task.resreq)
+            elif task.status == TaskStatus.PIPELINED:
+                self.releasing.add(task.resreq)
+            else:
+                self.idle.add(task.resreq)
+            self.used.sub(task.resreq)
+        del self.tasks[key]
+
+    def update_task(self, ti: TaskInfo) -> None:
+        self.remove_task(ti)
+        self.add_task(ti)
+
+    def accessible(self) -> Resource:
+        """Idle + Backfilled — the resources an allocation may claim when it
+        is allowed to displace backfill tasks (fork feature).
+
+        ref: node_info.go:209-211 (GetAccessibleResource). The reference
+        implementation mutates Idle in place while computing this
+        (``ni.Idle.Add(...)``) — an accounting bug we do not reproduce;
+        this is a pure read.
+        """
+        return self.idle.plus(self.backfilled)
+
+    def pods(self):
+        return [t.pod for t in self.tasks.values()]
+
+    def __repr__(self) -> str:
+        return (f"Node({self.name}): idle={self.idle}, used={self.used}, "
+                f"releasing={self.releasing}, backfilled={self.backfilled}, "
+                f"tasks={len(self.tasks)}")

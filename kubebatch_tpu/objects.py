@@ -1,0 +1,289 @@
+"""Cluster API objects — the vocabulary the scheduler watches and mutates.
+
+These are the framework's equivalent of the Kubernetes core/v1 + CRD types the
+reference consumes (ref: pkg/apis/scheduling/v1alpha1/types.go, plus the
+subset of v1.Pod / v1.Node fields the scheduler actually reads). They are
+plain dataclasses so that synthetic event streams, tests and the gRPC
+boundary can construct them cheaply; nothing in here imports JAX.
+
+Resource quantities convention (ref: pkg/scheduler/api/resource_info.go:58-73):
+CPU and GPU are *milli* units, memory is bytes, ``pods`` is a count.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional, Tuple
+
+# --- well-known keys (ref: pkg/apis/scheduling/v1alpha1/labels.go:221-223) ---
+GROUP_NAME_ANNOTATION = "scheduling.k8s.io/group-name"
+BACKFILL_ANNOTATION = "scheduling.k8s.io/kube-batch/backfill"
+
+# resource names (ref: resource_info.go:37, v1.ResourceCPU/Memory/Pods)
+CPU = "cpu"
+MEMORY = "memory"
+GPU = "nvidia.com/gpu"
+PODS = "pods"
+
+DEFAULT_SCHEDULER_NAME = "kube-batch"
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid(prefix: str = "obj") -> str:
+    return f"{prefix}-{next(_uid_counter):08d}"
+
+
+def resource_list(cpu: float = 0.0, memory: float = 0.0, gpu: float = 0.0,
+                  pods: float = 0.0) -> Dict[str, float]:
+    """Build a ResourceList. cpu/gpu in millis, memory in bytes."""
+    rl: Dict[str, float] = {}
+    if cpu:
+        rl[CPU] = float(cpu)
+    if memory:
+        rl[MEMORY] = float(memory)
+    if gpu:
+        rl[GPU] = float(gpu)
+    if pods:
+        rl[PODS] = float(pods)
+    return rl
+
+
+class PodPhase(str, Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+class TaintEffect(str, Enum):
+    NO_SCHEDULE = "NoSchedule"
+    PREFER_NO_SCHEDULE = "PreferNoSchedule"
+    NO_EXECUTE = "NoExecute"
+
+
+@dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: TaintEffect = TaintEffect.NO_SCHEDULE
+
+
+@dataclass
+class Toleration:
+    key: str = ""            # empty key + Exists matches everything
+    operator: str = "Equal"  # "Equal" | "Exists"
+    value: str = ""
+    effect: str = ""         # empty matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect.value:
+            return False
+        if not self.key and self.operator == "Exists":
+            return True
+        if self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+@dataclass
+class MatchExpression:
+    """A single node/pod selector requirement (key op values)."""
+    key: str
+    operator: str            # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: List[str] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        has = self.key in labels
+        val = labels.get(self.key)
+        if self.operator == "In":
+            return has and val in self.values
+        if self.operator == "NotIn":
+            return not has or val not in self.values
+        if self.operator == "Exists":
+            return has
+        if self.operator == "DoesNotExist":
+            return not has
+        if self.operator in ("Gt", "Lt"):
+            lhs = _as_int(val) if has else None
+            rhs = _as_int(self.values[0]) if self.values else None
+            if lhs is None or rhs is None:
+                return False
+            return lhs > rhs if self.operator == "Gt" else lhs < rhs
+        return False
+
+
+def _as_int(v) -> Optional[int]:
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return None
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: List[MatchExpression] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return all(e.matches(labels) for e in self.match_expressions)
+
+
+@dataclass
+class NodeAffinity:
+    # ORed terms; empty list = no requirement
+    required: List[NodeSelectorTerm] = field(default_factory=list)
+    # (weight, term) preferences summed into node score
+    preferred: List[Tuple[int, NodeSelectorTerm]] = field(default_factory=list)
+
+
+@dataclass
+class PodAffinityTerm:
+    """Inter-pod (anti-)affinity term: match pods by label selector within a
+    topology domain (we support the node-hostname topology, the only one the
+    reference's e2e suite exercises)."""
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    topology_key: str = "kubernetes.io/hostname"
+    namespaces: List[str] = field(default_factory=list)  # empty = pod's own ns
+
+    def selects(self, pod: "Pod") -> bool:
+        return all(pod.labels.get(k) == v for k, v in self.match_labels.items())
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity_required: List[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_required: List[PodAffinityTerm] = field(default_factory=list)
+    pod_affinity_preferred: List[Tuple[int, PodAffinityTerm]] = field(default_factory=list)
+    pod_anti_affinity_preferred: List[Tuple[int, PodAffinityTerm]] = field(default_factory=list)
+
+
+@dataclass
+class Container:
+    requests: Dict[str, float] = field(default_factory=dict)
+    ports: List[int] = field(default_factory=list)  # host ports
+
+
+@dataclass
+class Pod:
+    """The subset of v1.Pod the scheduler reads."""
+    name: str
+    namespace: str = "default"
+    uid: str = field(default_factory=lambda: new_uid("pod"))
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    node_name: str = ""
+    phase: PodPhase = PodPhase.PENDING
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    deletion_timestamp: Optional[float] = None
+    creation_timestamp: float = 0.0
+    owner_uid: str = ""       # controller owner (ref: pkg/apis/utils/utils.go:305)
+    status_conditions: List[Dict[str, str]] = field(default_factory=list)
+
+    @property
+    def group_name(self) -> str:
+        return self.annotations.get(GROUP_NAME_ANNOTATION, "")
+
+    def host_ports(self) -> List[int]:
+        ports: List[int] = []
+        for c in self.containers:
+            ports.extend(c.ports)
+        return ports
+
+
+class PodGroupPhase(str, Enum):
+    """ref: pkg/apis/scheduling/v1alpha1/types.go:28-39"""
+    PENDING = "Pending"
+    RUNNING = "Running"
+    UNKNOWN = "Unknown"
+
+
+# PodGroup condition types (ref: types.go:41-46; Backfilled is fork-specific)
+UNSCHEDULABLE_CONDITION = "Unschedulable"
+BACKFILLED_CONDITION = "Backfilled"
+NOT_ENOUGH_RESOURCES_REASON = "NotEnoughResources"
+NOT_ENOUGH_PODS_REASON = "NotEnoughPodsScheduled"
+
+
+@dataclass
+class PodGroupCondition:
+    type: str
+    status: str = "True"
+    transition_id: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class PodGroupStatus:
+    phase: PodGroupPhase = PodGroupPhase.PENDING
+    conditions: List[PodGroupCondition] = field(default_factory=list)
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class PodGroup:
+    """ref: pkg/apis/scheduling/v1alpha1/types.go:90-149"""
+    name: str
+    namespace: str = "default"
+    uid: str = field(default_factory=lambda: new_uid("pg"))
+    min_member: int = 0
+    queue: str = ""
+    priority_class_name: str = ""
+    creation_timestamp: float = 0.0
+    status: PodGroupStatus = field(default_factory=PodGroupStatus)
+
+
+@dataclass
+class Queue:
+    """ref: pkg/apis/scheduling/v1alpha1/types.go:170-186"""
+    name: str
+    weight: int = 1
+    uid: str = field(default_factory=lambda: new_uid("queue"))
+
+
+@dataclass
+class PriorityClass:
+    name: str
+    value: int = 0
+    global_default: bool = False
+
+
+@dataclass
+class Node:
+    """The subset of v1.Node the scheduler reads."""
+    name: str
+    uid: str = field(default_factory=lambda: new_uid("node"))
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    allocatable: Dict[str, float] = field(default_factory=dict)
+    capacity: Dict[str, float] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+
+    def __post_init__(self):
+        if not self.capacity and self.allocatable:
+            self.capacity = dict(self.allocatable)
+        # every node implicitly carries its hostname label, like kubelet does
+        self.labels.setdefault("kubernetes.io/hostname", self.name)
+
+
+def is_backfill_pod(pod: Pod) -> bool:
+    """ref: pkg/scheduler/api/job_info.go:72-84 (invalid values -> False)."""
+    val = pod.annotations.get(BACKFILL_ANNOTATION, "")
+    if not val:
+        return False
+    return val.strip().lower() in ("1", "t", "true")

@@ -1,0 +1,56 @@
+"""Shared test fixture builders (ref: pkg/scheduler/api/test_utils.go)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kubebatch_tpu.objects import (BACKFILL_ANNOTATION, GROUP_NAME_ANNOTATION,
+                                   Container, Node, Pod, PodGroup, PodPhase,
+                                   Queue, resource_list)
+
+GiB = 1024 ** 3
+
+
+def rl(cpu_milli: float = 0.0, mem_bytes: float = 0.0, gpu_milli: float = 0.0,
+       pods: float = 0.0) -> Dict[str, float]:
+    return resource_list(cpu=cpu_milli, memory=mem_bytes, gpu=gpu_milli,
+                         pods=pods)
+
+
+def build_node(name: str, alloc: Dict[str, float], labels=None,
+               taints=None, unschedulable=False) -> Node:
+    return Node(name=name, allocatable=dict(alloc), capacity=dict(alloc),
+                labels=dict(labels or {}), taints=list(taints or []),
+                unschedulable=unschedulable)
+
+
+def build_pod(ns: str, name: str, node_name: str, phase: PodPhase,
+              req: Dict[str, float], group: str = "",
+              labels: Optional[Dict[str, str]] = None,
+              priority: Optional[int] = None,
+              backfill: bool = False,
+              owner_uid: str = "",
+              ports: Optional[List[int]] = None,
+              creation_timestamp: float = 0.0,
+              **kwargs) -> Pod:
+    annotations = {}
+    if group:
+        annotations[GROUP_NAME_ANNOTATION] = group
+    if backfill:
+        annotations[BACKFILL_ANNOTATION] = "true"
+    return Pod(
+        uid=f"{ns}-{name}",
+        name=name, namespace=ns, node_name=node_name, phase=phase,
+        containers=[Container(requests=dict(req), ports=list(ports or []))],
+        labels=dict(labels or {}), annotations=annotations,
+        priority=priority, owner_uid=owner_uid,
+        creation_timestamp=creation_timestamp, **kwargs)
+
+
+def build_group(ns: str, name: str, min_member: int, queue: str = "",
+                creation_timestamp: float = 0.0) -> PodGroup:
+    return PodGroup(name=name, namespace=ns, min_member=min_member,
+                    queue=queue, creation_timestamp=creation_timestamp)
+
+
+def build_queue(name: str, weight: int = 1) -> Queue:
+    return Queue(name=name, weight=weight)

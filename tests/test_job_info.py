@@ -1,0 +1,130 @@
+"""JobInfo / TaskInfo index maintenance (ref: api/job_info_test.go,
+api/pod_info_test.go)."""
+import pytest
+
+from kubebatch_tpu.api import (JobInfo, JobReadiness, Resource, TaskInfo,
+                               TaskStatus)
+from kubebatch_tpu.objects import Container, Pod, PodPhase
+
+from .fixtures import GiB, build_group, build_pod, rl
+
+
+def task(ns, name, node, phase, cpu, mem, group="j1", **kw):
+    return TaskInfo(build_pod(ns, name, node, phase, rl(cpu, mem),
+                              group=group, **kw))
+
+
+def test_add_task_info_indexes_and_sums():
+    job = JobInfo("default/j1")
+    t1 = task("default", "p1", "", PodPhase.PENDING, 1000, GiB)
+    t2 = task("default", "p2", "n1", PodPhase.RUNNING, 2000, 2 * GiB)
+    job.add_task_info(t1)
+    job.add_task_info(t2)
+    assert set(job.tasks) == {t1.uid, t2.uid}
+    assert set(job.task_status_index) == {TaskStatus.PENDING,
+                                          TaskStatus.RUNNING}
+    assert job.total_request.equal(Resource(3000, 3 * GiB, 0))
+    # only allocated-family statuses count toward Allocated
+    assert job.allocated.equal(Resource(2000, 2 * GiB, 0))
+
+
+def test_delete_task_info_cleans_empty_index():
+    job = JobInfo("default/j1")
+    t1 = task("default", "p1", "n1", PodPhase.RUNNING, 1000, GiB)
+    job.add_task_info(t1)
+    job.delete_task_info(t1)
+    assert job.tasks == {}
+    assert job.task_status_index == {}
+    assert job.allocated.equal(Resource())
+    with pytest.raises(KeyError):
+        job.delete_task_info(t1)
+
+
+def test_update_task_status_moves_index():
+    job = JobInfo("default/j1")
+    t = task("default", "p1", "", PodPhase.PENDING, 1000, GiB)
+    job.add_task_info(t)
+    job.update_task_status(t, TaskStatus.ALLOCATED)
+    assert t.uid in job.task_status_index[TaskStatus.ALLOCATED]
+    assert TaskStatus.PENDING not in job.task_status_index
+    assert job.allocated.equal(Resource(1000, GiB, 0))
+
+
+def test_readiness_three_states():
+    job = JobInfo("default/j1")
+    job.min_available = 2
+    t1 = task("default", "p1", "", PodPhase.PENDING, 100, 0)
+    t2 = task("default", "p2", "", PodPhase.PENDING, 100, 0)
+    job.add_task_info(t1)
+    job.add_task_info(t2)
+    assert job.get_readiness() == JobReadiness.NOT_READY
+    job.update_task_status(t1, TaskStatus.ALLOCATED)
+    assert job.get_readiness() == JobReadiness.NOT_READY
+    job.update_task_status(t2, TaskStatus.ALLOCATED_OVER_BACKFILL)
+    assert job.get_readiness() == JobReadiness.ALMOST_READY
+    job.update_task_status(t2, TaskStatus.ALLOCATED)
+    assert job.get_readiness() == JobReadiness.READY
+
+
+def test_is_backfill_from_annotation():
+    t = task("default", "p1", "", PodPhase.PENDING, 100, 0, backfill=True)
+    assert t.is_backfill
+    t2 = task("default", "p2", "", PodPhase.PENDING, 100, 0)
+    assert not t2.is_backfill
+
+
+def test_init_container_max_vs_sum():
+    # ref: pod_info_test.go — init containers max per dimension, app
+    # containers summed
+    pod = Pod(name="p", namespace="ns",
+              containers=[Container(requests=rl(2000, GiB)),
+                          Container(requests=rl(1000, GiB))],
+              init_containers=[Container(requests=rl(2000, GiB)),
+                               Container(requests=rl(2000, 3 * GiB))])
+    t = TaskInfo(pod)
+    assert t.resreq.equal(Resource(3000, 2 * GiB, 0))
+    assert t.init_resreq.equal(Resource(3000, 3 * GiB, 0))
+
+
+def test_set_pod_group():
+    job = JobInfo("default/j1")
+    pg = build_group("default", "j1", 3, queue="q1", creation_timestamp=42.0)
+    job.set_pod_group(pg)
+    assert job.min_available == 3
+    assert job.queue == "q1"
+    assert job.creation_timestamp == 42.0
+    assert job.name == "j1" and job.namespace == "default"
+
+
+def test_clone_deep():
+    job = JobInfo("default/j1")
+    job.set_pod_group(build_group("default", "j1", 1))
+    t = task("default", "p1", "", PodPhase.PENDING, 1000, GiB)
+    job.add_task_info(t)
+    c = job.clone()
+    c.update_task_status(c.tasks[t.uid], TaskStatus.ALLOCATED)
+    assert job.tasks[t.uid].status == TaskStatus.PENDING
+    assert c.tasks[t.uid].status == TaskStatus.ALLOCATED
+    assert job.allocated.equal(Resource())
+
+
+def test_fit_error_histogram():
+    job = JobInfo("default/j1")
+    assert job.fit_error() == "0 nodes are available"
+    job.nodes_fit_delta["n1"] = Resource(-10, 5, 0)
+    job.nodes_fit_delta["n2"] = Resource(-10, -5, 0)
+    msg = job.fit_error()
+    assert msg.startswith("0/2 nodes are available")
+    assert "2 insufficient cpu" in msg
+    assert "1 insufficient memory" in msg
+
+
+def test_job_priority_follows_task_pod_priority():
+    job = JobInfo("default/j1")
+    t = task("default", "p1", "", PodPhase.PENDING, 100, 0, priority=7)
+    job.add_task_info(t)
+    assert job.priority == 7
+    assert t.priority == 7
+    # pods without explicit priority default task priority to 1
+    t2 = task("default", "p2", "", PodPhase.PENDING, 100, 0)
+    assert t2.priority == 1

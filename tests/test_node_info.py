@@ -1,0 +1,137 @@
+"""NodeInfo accounting (ref: api/node_info_test.go), incl. backfill."""
+import pytest
+
+from kubebatch_tpu.api import NodeInfo, Resource, TaskInfo, TaskStatus
+from kubebatch_tpu.objects import PodPhase
+
+from .fixtures import GiB, build_node, build_pod, rl
+
+
+def mk_node(cpu=8000, mem=10 * GiB):
+    return NodeInfo(build_node("n1", rl(cpu, mem)))
+
+
+def test_add_two_running_pods():
+    ni = mk_node()
+    ni.add_task(TaskInfo(build_pod("c1", "p1", "n1", PodPhase.RUNNING,
+                                   rl(1000, GiB))))
+    ni.add_task(TaskInfo(build_pod("c1", "p2", "n1", PodPhase.RUNNING,
+                                   rl(2000, 2 * GiB))))
+    assert ni.idle.equal(Resource(5000, 7 * GiB, 0))
+    assert ni.used.equal(Resource(3000, 3 * GiB, 0))
+    assert ni.releasing.equal(Resource())
+    assert set(ni.tasks) == {"c1/p1", "c1/p2"}
+
+
+def test_remove_pod_restores_idle():
+    ni = mk_node()
+    tasks = [TaskInfo(build_pod("c1", f"p{i}", "n1", PodPhase.RUNNING,
+                                rl(i * 1000, i * GiB))) for i in (1, 2, 3)]
+    for t in tasks:
+        ni.add_task(t)
+    ni.remove_task(tasks[1])
+    assert ni.idle.equal(Resource(4000, 6 * GiB, 0))
+    assert ni.used.equal(Resource(4000, 4 * GiB, 0))
+    assert set(ni.tasks) == {"c1/p1", "c1/p3"}
+    with pytest.raises(KeyError):
+        ni.remove_task(tasks[1])
+
+
+def test_duplicate_add_rejected():
+    ni = mk_node()
+    t = TaskInfo(build_pod("c1", "p1", "n1", PodPhase.RUNNING, rl(1000, GiB)))
+    ni.add_task(t)
+    with pytest.raises(KeyError):
+        ni.add_task(t)
+
+
+def test_releasing_and_pipelined_accounting():
+    ni = mk_node()
+    releasing = TaskInfo(build_pod("c1", "p1", "n1", PodPhase.RUNNING,
+                                   rl(2000, 2 * GiB),
+                                   deletion_timestamp=1.0))
+    assert releasing.status == TaskStatus.RELEASING
+    ni.add_task(releasing)
+    assert ni.releasing.equal(Resource(2000, 2 * GiB, 0))
+    assert ni.idle.equal(Resource(6000, 8 * GiB, 0))
+    # a pipelined task reuses releasing resources: releasing shrinks,
+    # idle untouched
+    pipelined = TaskInfo(build_pod("c1", "p2", "n1", PodPhase.PENDING,
+                                   rl(1000, GiB)))
+    pipelined.status = TaskStatus.PIPELINED
+    ni.add_task(pipelined)
+    assert ni.releasing.equal(Resource(1000, GiB, 0))
+    assert ni.idle.equal(Resource(6000, 8 * GiB, 0))
+    assert ni.used.equal(Resource(3000, 3 * GiB, 0))
+    # removal inverts both
+    ni.remove_task(pipelined)
+    ni.remove_task(releasing)
+    assert ni.releasing.equal(Resource())
+    assert ni.idle.equal(Resource(8000, 10 * GiB, 0))
+    assert ni.used.equal(Resource())
+
+
+def test_backfill_accounting_and_accessible():
+    ni = mk_node()
+    bf = TaskInfo(build_pod("c1", "bf1", "n1", PodPhase.RUNNING,
+                            rl(3000, 3 * GiB), backfill=True))
+    ni.add_task(bf)
+    assert ni.backfilled.equal(Resource(3000, 3 * GiB, 0))
+    assert ni.idle.equal(Resource(5000, 7 * GiB, 0))
+    # accessible = idle + backfilled, and MUST NOT mutate idle
+    # (the reference's GetAccessibleResource mutates — documented divergence)
+    acc = ni.accessible()
+    assert acc.equal(Resource(8000, 10 * GiB, 0))
+    assert ni.idle.equal(Resource(5000, 7 * GiB, 0))
+    acc2 = ni.accessible()
+    assert acc2.equal(Resource(8000, 10 * GiB, 0))
+    ni.remove_task(bf)
+    assert ni.backfilled.equal(Resource())
+
+
+def test_node_clone_independent():
+    ni = mk_node()
+    t = TaskInfo(build_pod("c1", "p1", "n1", PodPhase.RUNNING, rl(1000, GiB)))
+    ni.add_task(t)
+    c = ni.clone()
+    c.remove_task(t)
+    assert "c1/p1" in ni.tasks and "c1/p1" not in c.tasks
+    assert ni.idle.equal(Resource(7000, 9 * GiB, 0))
+    assert c.idle.equal(Resource(8000, 10 * GiB, 0))
+
+
+def test_node_holds_clone_of_task():
+    # status flip on the session's task must not corrupt node accounting
+    ni = mk_node()
+    t = TaskInfo(build_pod("c1", "p1", "n1", PodPhase.PENDING, rl(1000, GiB)))
+    t.status = TaskStatus.ALLOCATED
+    ni.add_task(t)
+    t.status = TaskStatus.RELEASING
+    ni.remove_task(t)  # removal keyed by pod, uses the stored clone's status
+    assert ni.idle.equal(Resource(8000, 10 * GiB, 0))
+
+
+def test_set_node_recomputes():
+    ni = NodeInfo()
+    t = TaskInfo(build_pod("c1", "p1", "n1", PodPhase.RUNNING, rl(1000, GiB)))
+    ni.add_task(t)  # placeholder node: no accounting yet
+    assert ni.idle.equal(Resource())
+    ni.set_node(build_node("n1", rl(8000, 10 * GiB)))
+    assert ni.idle.equal(Resource(7000, 9 * GiB, 0))
+    assert ni.used.equal(Resource(1000, GiB, 0))
+    # repeated node events must not double-count used/releasing (the
+    # reference resets only Idle here — fixed divergence)
+    ni.set_node(build_node("n1", rl(8000, 10 * GiB)))
+    assert ni.used.equal(Resource(1000, GiB, 0))
+    assert ni.releasing.equal(Resource())
+
+
+def test_set_node_recomputes_backfilled():
+    ni = NodeInfo()
+    bf = TaskInfo(build_pod("c1", "b1", "n1", PodPhase.RUNNING, rl(500, GiB),
+                            backfill=True))
+    ni.add_task(bf)
+    ni.set_node(build_node("n1", rl(8000, 10 * GiB)))
+    assert ni.backfilled.equal(Resource(500, GiB, 0))
+    ni.set_node(build_node("n1", rl(8000, 10 * GiB)))
+    assert ni.backfilled.equal(Resource(500, GiB, 0))

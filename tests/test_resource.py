@@ -1,0 +1,109 @@
+"""Resource epsilon semantics (ref: resource_info.go + implied behavior)."""
+import numpy as np
+
+from kubebatch_tpu.api import (MIN_MEMORY, MIN_MILLI_CPU, Resource, res_min,
+                               share, vecs)
+from kubebatch_tpu.objects import CPU, GPU, MEMORY
+
+from .fixtures import GiB, rl
+
+
+def test_from_resource_list_units():
+    r = Resource.from_resource_list(rl(4000, 8 * GiB, 2000, pods=110))
+    assert r.milli_cpu == 4000
+    assert r.memory == 8 * GiB
+    assert r.milli_gpu == 2000
+    assert r.max_task_num == 110
+
+
+def test_arithmetic_chainable_and_mutating():
+    r = Resource(1000, GiB, 0)
+    out = r.add(Resource(500, GiB, 100))
+    assert out is r
+    assert r.milli_cpu == 1500 and r.memory == 2 * GiB and r.milli_gpu == 100
+    r.sub(Resource(500, GiB, 100))
+    assert r.equal(Resource(1000, GiB, 0))
+    r.multi(2.0)
+    assert r.milli_cpu == 2000 and r.memory == 2 * GiB
+
+
+def test_max_task_num_excluded_from_arithmetic():
+    r = Resource(0, 0, 0, max_task_num=10)
+    r.add(Resource(100, 100, 100, max_task_num=5))
+    assert r.max_task_num == 10
+
+
+def test_is_empty_epsilons():
+    assert Resource(9.99, MIN_MEMORY - 1, 9.99).is_empty()
+    assert not Resource(MIN_MILLI_CPU, 0, 0).is_empty()
+    assert not Resource(0, MIN_MEMORY, 0).is_empty()
+    assert not Resource(0, 0, 10).is_empty()
+
+
+def test_is_zero_per_dimension():
+    r = Resource(5, 20 * 1024 * 1024, 15)
+    assert r.is_zero(CPU)
+    assert not r.is_zero(MEMORY)
+    assert not r.is_zero(GPU)
+
+
+def test_less_strict_all_dimensions():
+    # less is a strict < on EVERY dimension — equal memory fails it
+    assert Resource(1, 1, 1).less(Resource(2, 2, 2))
+    assert not Resource(1, 1, 1).less(Resource(2, 1, 2))
+
+
+def test_less_equal_epsilon_tolerance():
+    big = Resource(1000, GiB, 0)
+    # within epsilon on each dimension counts as <=
+    near = Resource(1000 + MIN_MILLI_CPU - 1, GiB + MIN_MEMORY - 1, 5)
+    assert near.less_equal(big)
+    assert not Resource(1000 + MIN_MILLI_CPU, GiB, 0).less_equal(big)
+    # zero request always fits
+    assert Resource().less_equal(Resource())
+
+
+def test_fit_delta_pads_requested_dimensions_only():
+    avail = Resource(1000, GiB, 0)
+    out = avail.fit_delta(Resource(500, 0, 0))
+    assert out is avail
+    assert avail.milli_cpu == 1000 - 500 - MIN_MILLI_CPU
+    assert avail.memory == GiB  # untouched: request had no memory
+    assert avail.milli_gpu == 0
+
+
+def test_set_max():
+    r = Resource(100, 5, 300)
+    r.set_max(Resource(50, 10, 400))
+    assert (r.milli_cpu, r.memory, r.milli_gpu) == (100, 10, 400)
+
+
+def test_accessible_pattern_is_pure():
+    a, b = Resource(100, 100, 100), Resource(1, 1, 1)
+    c = a.plus(b)
+    assert a.equal(Resource(100, 100, 100))
+    assert c.equal(Resource(101, 101, 101))
+
+
+def test_share_conventions():
+    assert share(0, 0) == 0.0
+    assert share(5, 0) == 1.0
+    assert share(1, 4) == 0.25
+
+
+def test_res_min():
+    m = res_min(Resource(1, 10, 3), Resource(2, 5, 3))
+    assert (m.milli_cpu, m.memory, m.milli_gpu) == (1, 5, 3)
+
+
+def test_to_vec_mib_scaling():
+    v = Resource(1500, 256 * 1024 * 1024, 2000).to_vec()
+    np.testing.assert_allclose(v, np.array([1500.0, 256.0, 2000.0]))
+    assert v.dtype == np.float32
+
+
+def test_vecs_stacking_empty_and_full():
+    assert vecs([]).shape == (0, 3)
+    m = vecs([Resource(1, 1024 ** 2, 0), Resource(2, 2 * 1024 ** 2, 1)])
+    assert m.shape == (2, 3)
+    np.testing.assert_allclose(m[:, 1], [1.0, 2.0])
