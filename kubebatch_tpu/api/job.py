@@ -6,7 +6,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from ..objects import (Pod, PodGroup, PodPhase, is_backfill_pod)
+from ..objects import (Pod, PodDisruptionBudget, PodGroup, PodPhase,
+                       is_backfill_pod)
 from .resource import Resource
 from .types import (JobReadiness, TaskStatus, allocated_status,
                     allocated_statuses, validate_status_update)
@@ -125,6 +126,7 @@ class JobInfo:
         self.total_request: Resource = Resource.empty()
         self.creation_timestamp: float = 0.0
         self.pod_group: Optional[PodGroup] = None
+        self.pdb: Optional[PodDisruptionBudget] = None
         for t in tasks:
             self.add_task_info(t)
 
@@ -140,6 +142,17 @@ class JobInfo:
     def unset_pod_group(self) -> None:
         self.pod_group = None
 
+    def set_pdb(self, pdb: PodDisruptionBudget) -> None:
+        """Legacy grouping path (ref: job_info.go:204-211)."""
+        self.name = pdb.name
+        self.namespace = pdb.namespace
+        self.min_available = pdb.min_available
+        self.creation_timestamp = pdb.creation_timestamp
+        self.pdb = pdb
+
+    def unset_pdb(self) -> None:
+        self.pdb = None
+
     # --- task index maintenance (ref: job_info.go:231-292) ---------------
     def _add_task_index(self, ti: TaskInfo) -> None:
         self.task_status_index.setdefault(ti.status, {})[ti.uid] = ti
@@ -147,8 +160,7 @@ class JobInfo:
     def add_task_info(self, ti: TaskInfo) -> None:
         self.tasks[ti.uid] = ti
         self._add_task_index(ti)
-        if ti.pod.priority is not None:
-            self.priority = ti.pod.priority
+        self.priority = ti.priority
         self.total_request.add(ti.resreq)
         if allocated_status(ti.status):
             self.allocated.add(ti.resreq)
@@ -223,6 +235,7 @@ class JobInfo:
         info.node_selector = dict(self.node_selector)
         info.creation_timestamp = self.creation_timestamp
         info.pod_group = self.pod_group
+        info.pdb = self.pdb
         for task in self.tasks.values():
             info.add_task_info(task.clone())
         return info
@@ -235,4 +248,4 @@ class JobInfo:
 
 def job_terminated(job: JobInfo) -> bool:
     """ref: api/helpers.go:99-104."""
-    return job.pod_group is None and not job.tasks
+    return job.pod_group is None and job.pdb is None and not job.tasks

@@ -63,7 +63,13 @@ class NodeInfo:
                 self.backfilled.add(task.resreq)
             if task.status == TaskStatus.RELEASING:
                 self.releasing.add(task.resreq)
-            self.idle.sub(task.resreq)
+                self.idle.sub(task.resreq)
+            elif task.status == TaskStatus.PIPELINED:
+                # pipelined tasks reuse releasing resources (same invariant
+                # as add_task; the reference recompute misses this too)
+                self.releasing.sub(task.resreq)
+            else:
+                self.idle.sub(task.resreq)
             self.used.add(task.resreq)
 
     def add_task(self, task: TaskInfo) -> None:

@@ -27,7 +27,8 @@ RESOURCE_DIM = len(RESOURCE_NAMES)
 #: host->device unit scaling: memory is carried in MiB on device so float32
 #: stays exact at cluster scale; with this scaling every epsilon is 10.0.
 VEC_SCALE = np.array([1.0, 1.0 / (1024 * 1024), 1.0], dtype=np.float64)
-VEC_EPS = np.array([MIN_MILLI_CPU, 10.0, MIN_MILLI_GPU], dtype=np.float32)
+VEC_EPS = (np.array([MIN_MILLI_CPU, MIN_MEMORY, MIN_MILLI_GPU],
+                    dtype=np.float64) * VEC_SCALE).astype(np.float32)
 
 
 class Resource:

@@ -1,0 +1,599 @@
+"""SchedulerCache — the cluster-state mirror between sessions.
+
+ref: pkg/scheduler/cache/cache.go + event_handlers.go + util.go.
+
+Architecture notes (TPU-first redesign, not a Go translation):
+
+- Event ingestion is a plain method surface (``add_pod``/``update_node``/...)
+  fed by any event source — the synthetic ``sim`` cluster, the gRPC
+  front-end, or (out of scope here) a real k8s informer adapter. The
+  reference binds these same handlers to client-go informers
+  (cache.go:217-295).
+- Decision write-back (bind/evict/status) updates local state under the
+  lock, then fires the seam call on a thread pool — the reference uses
+  goroutines (cache.go:377-382, 423-429). Failures enqueue the task on a
+  rate-limited retry queue whose worker re-fetches ground truth and
+  replays the cache update (``sync_task``, ref event_handlers.go:88-106).
+  ``drain()`` gives tests/benchmarks a deterministic barrier.
+- ``snapshot()`` deep-clones into an immutable-by-convention ClusterInfo
+  (ref cache.go:515-583). At 10k x 5k this clone is the second bottleneck
+  after the solve; the tensorization in kernels/ reads from the snapshot,
+  and a native C++ packer can replace this path (see kernels/tensorize).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo,
+                   TaskStatus, job_terminated)
+from ..objects import (Node, Pod, PodDisruptionBudget, PodGroup,
+                       PodGroupPhase, PodPhase, PriorityClass, Queue,
+                       UNSCHEDULABLE_CONDITION)
+from .interface import (Binder, EventRecorder, Evictor, ListRecorder,
+                        NullBinder, NullEvictor, NullStatusUpdater,
+                        NullVolumeBinder, StatusUpdater, VolumeBinder)
+
+SHADOW_POD_GROUP_KEY = "kube-batch/shadow-pod-group"
+
+
+def shadow_pod_group(pg: Optional[PodGroup]) -> bool:
+    """ref: cache/util.go:104-111 (nil PodGroup counts as shadow)."""
+    return pg is None or SHADOW_POD_GROUP_KEY in pg.annotations
+
+
+def create_shadow_pod_group(pod: Pod) -> PodGroup:
+    """Implicit single-member gang for ownerless/ungrouped pods
+    (ref: cache/util.go:113-136)."""
+    job_id = pod.owner_uid or pod.uid
+    return PodGroup(name=str(job_id), namespace=pod.namespace, min_member=1,
+                    annotations={SHADOW_POD_GROUP_KEY: str(job_id)})
+
+
+def _is_terminated(status: TaskStatus) -> bool:
+    return status in (TaskStatus.SUCCEEDED, TaskStatus.FAILED)
+
+
+class RetryQueue:
+    """Rate-limited retry queue (the workqueue.RateLimiting equivalent).
+
+    Items become due after an exponential backoff (5ms * 2^retries, capped).
+    ``pop_due`` is pumped by the cache's worker loop or ``drain()``.
+    """
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 10.0):
+        self._items: deque = deque()
+        self._retries: Dict[int, int] = {}
+        self._base = base_delay
+        self._max = max_delay
+        self._lock = threading.Lock()
+
+    def add_rate_limited(self, item) -> None:
+        with self._lock:
+            n = self._retries.get(id(item), 0)
+            self._retries[id(item)] = n + 1
+            delay = min(self._base * (2 ** n), self._max)
+            self._items.append((time.monotonic() + delay, item))
+
+    def forget(self, item) -> None:
+        with self._lock:
+            self._retries.pop(id(item), None)
+
+    def pop_due(self) -> List:
+        now = time.monotonic()
+        due, later = [], deque()
+        with self._lock:
+            for ready_at, item in self._items:
+                (due if ready_at <= now else later).append((ready_at, item))
+            self._items = deque(later)
+        return [item for _, item in due]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def next_due_in(self) -> Optional[float]:
+        with self._lock:
+            if not self._items:
+                return None
+            return max(0.0, min(t for t, _ in self._items) - time.monotonic())
+
+
+class SchedulerCache:
+    """ref: cache/cache.go:70-105."""
+
+    def __init__(self,
+                 scheduler_name: str = "kube-batch",
+                 default_queue: str = "default",
+                 binder: Optional[Binder] = None,
+                 evictor: Optional[Evictor] = None,
+                 status_updater: Optional[StatusUpdater] = None,
+                 volume_binder: Optional[VolumeBinder] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 pod_lister: Optional[Callable[[str, str], Optional[Pod]]] = None,
+                 async_writeback: bool = True):
+        self._lock = threading.RLock()
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.priority_classes: Dict[str, PriorityClass] = {}
+        self.default_priority_class: Optional[PriorityClass] = None
+        self.default_priority: int = 0
+
+        self.binder = binder if binder is not None else NullBinder()
+        self.evictor = evictor if evictor is not None else NullEvictor()
+        self.status_updater = (status_updater if status_updater is not None
+                               else NullStatusUpdater())
+        self.volume_binder = (volume_binder if volume_binder is not None
+                              else NullVolumeBinder())
+        self.recorder = recorder if recorder is not None else ListRecorder()
+
+        #: ground-truth pod lookup for the resync repair loop; None means
+        #: "replay from the task's own pod" (no external source of truth)
+        self.pod_lister = pod_lister
+
+        self.err_tasks = RetryQueue()
+        self.deleted_jobs = RetryQueue()
+
+        self._async = async_writeback
+        self._pool: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(max_workers=8,
+                               thread_name_prefix="kb-writeback")
+            if async_writeback else None)
+        self._inflight: set = set()
+        self._inflight_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # lifecycle (ref: cache.go:300-331)
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        """Start the resync/cleanup repair worker."""
+        if self._worker is None:
+            self._worker = threading.Thread(target=self._repair_loop,
+                                            name="kb-cache-repair",
+                                            daemon=True)
+            self._worker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+
+    def wait_for_cache_sync(self) -> bool:
+        """Event sources here are synchronous pushes; always synced."""
+        return True
+
+    def _repair_loop(self) -> None:
+        while not self._stop.is_set():
+            self.process_resync_tasks()
+            self.process_cleanup_jobs()
+            self._stop.wait(0.005)
+
+    # ------------------------------------------------------------------
+    # write-back plumbing
+    # ------------------------------------------------------------------
+    def _submit(self, fn: Callable[[], None]) -> None:
+        if self._pool is not None:
+            fut: Future = self._pool.submit(fn)
+            with self._inflight_lock:
+                self._inflight.add(fut)
+            fut.add_done_callback(self._discard_inflight)
+        else:
+            fn()
+
+    def _discard_inflight(self, fut: Future) -> None:
+        with self._inflight_lock:
+            self._inflight.discard(fut)
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Barrier: wait for in-flight write-backs and due retries. Returns
+        False on timeout. Test/benchmark helper; the reference relies on
+        channel waits in tests instead."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                pending = list(self._inflight)
+            if pending:
+                for fut in pending:
+                    fut.result(timeout=max(0.0, deadline - time.monotonic()))
+                continue
+            self.process_resync_tasks()
+            self.process_cleanup_jobs()
+            if not self.err_tasks and not self.deleted_jobs:
+                with self._inflight_lock:
+                    if not self._inflight:
+                        return True
+                continue
+            nxt = self.err_tasks.next_due_in()
+            nxt2 = self.deleted_jobs.next_due_in()
+            waits = [w for w in (nxt, nxt2) if w is not None]
+            time.sleep(min(min(waits, default=0.001), 0.01))
+        return False
+
+    # ------------------------------------------------------------------
+    # pod/task ingestion (ref: event_handlers.go:37-247)
+    # ------------------------------------------------------------------
+    def _pod_relevant(self, pod: Pod) -> bool:
+        """Informer filter (ref: cache.go:246-258): pending pods only for
+        our scheduler; non-pending pods always (they occupy nodes)."""
+        if pod.phase == PodPhase.PENDING:
+            return pod.scheduler_name == self.scheduler_name
+        return True
+
+    def _get_or_create_job(self, ti: TaskInfo) -> JobInfo:
+        """ref: event_handlers.go:41-61 (shadow PodGroup for ungrouped)."""
+        if not ti.job:
+            pg = create_shadow_pod_group(ti.pod)
+            ti.job = pg.name
+            if ti.job not in self.jobs:
+                job = JobInfo(ti.job)
+                job.set_pod_group(pg)
+                job.queue = self.default_queue
+                self.jobs[ti.job] = job
+        elif ti.job not in self.jobs:
+            self.jobs[ti.job] = JobInfo(ti.job)
+        return self.jobs[ti.job]
+
+    def _add_task(self, ti: TaskInfo) -> None:
+        job = self._get_or_create_job(ti)
+        job.add_task_info(ti)
+        if ti.node_name:
+            if ti.node_name not in self.nodes:
+                # placeholder until the node event arrives
+                self.nodes[ti.node_name] = NodeInfo(None)
+            if not _is_terminated(ti.status):
+                self.nodes[ti.node_name].add_task(ti)
+
+    def _delete_task(self, ti: TaskInfo) -> None:
+        errs = []
+        if ti.job:
+            job = self.jobs.get(ti.job)
+            if job is not None:
+                try:
+                    job.delete_task_info(ti)
+                except KeyError as e:
+                    errs.append(e)
+            else:
+                errs.append(KeyError(f"failed to find Job <{ti.job}> for "
+                                     f"Task {ti.namespace}/{ti.name}"))
+        if ti.node_name:
+            node = self.nodes.get(ti.node_name)
+            if node is not None:
+                try:
+                    node.remove_task(ti)
+                except KeyError as e:
+                    errs.append(e)
+        if errs:
+            raise KeyError("; ".join(str(e) for e in errs))
+
+    def add_pod(self, pod: Pod) -> None:
+        if not self._pod_relevant(pod):
+            return
+        with self._lock:
+            self._add_task(TaskInfo(pod))
+
+    def update_pod(self, old: Pod, new: Pod) -> None:
+        """Delete + re-add (ref: event_handlers.go:108-122)."""
+        if not self._pod_relevant(new) and not self._pod_relevant(old):
+            return
+        with self._lock:
+            self._delete_pod_locked(old)
+            self._add_task(TaskInfo(new))
+
+    def delete_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._delete_pod_locked(pod)
+
+    def _delete_pod_locked(self, pod: Pod) -> None:
+        """ref: event_handlers.go:151-171 — prefer the cache's own task (it
+        may be in Binding state with a node the stale event lacks)."""
+        ti = TaskInfo(pod)
+        job = self.jobs.get(ti.job)
+        task = ti
+        if job is not None:
+            task = job.tasks.get(ti.uid, ti)
+        self._delete_task(task)
+        if job is not None and job_terminated(job):
+            self.deleted_jobs.add_rate_limited(job)
+
+    # ------------------------------------------------------------------
+    # node ingestion (ref: event_handlers.go:249-356)
+    # ------------------------------------------------------------------
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            if node.name in self.nodes:
+                self.nodes[node.name].set_node(node)
+            else:
+                self.nodes[node.name] = NodeInfo(node)
+
+    def update_node(self, old: Node, new: Node) -> None:
+        with self._lock:
+            ni = self.nodes.get(new.name)
+            if ni is None:
+                raise KeyError(f"node <{new.name}> does not exist")
+            if (old.allocatable != new.allocatable or old.taints != new.taints
+                    or old.labels != new.labels
+                    or old.unschedulable != new.unschedulable):
+                ni.set_node(new)
+
+    def delete_node(self, node: Node) -> None:
+        with self._lock:
+            if node.name not in self.nodes:
+                raise KeyError(f"node <{node.name}> does not exist")
+            del self.nodes[node.name]
+
+    # ------------------------------------------------------------------
+    # PodGroup / PDB / Queue / PriorityClass (ref: event_handlers.go:358-769)
+    # ------------------------------------------------------------------
+    def add_pod_group(self, pg: PodGroup) -> None:
+        with self._lock:
+            self._set_pod_group(pg)
+
+    def update_pod_group(self, old: PodGroup, new: PodGroup) -> None:
+        with self._lock:
+            self._set_pod_group(new)
+
+    def delete_pod_group(self, pg: PodGroup) -> None:
+        with self._lock:
+            job_id = f"{pg.namespace}/{pg.name}"
+            job = self.jobs.get(job_id)
+            if job is None:
+                raise KeyError(f"can not find job {job_id}")
+            job.unset_pod_group()
+            self.deleted_jobs.add_rate_limited(job)
+
+    def _set_pod_group(self, pg: PodGroup) -> None:
+        job_id = f"{pg.namespace}/{pg.name}"
+        if job_id not in self.jobs:
+            self.jobs[job_id] = JobInfo(job_id)
+        self.jobs[job_id].set_pod_group(pg)
+        if not pg.queue:
+            self.jobs[job_id].queue = self.default_queue
+
+    def add_pdb(self, pdb: PodDisruptionBudget) -> None:
+        with self._lock:
+            self._set_pdb(pdb)
+
+    def update_pdb(self, old: PodDisruptionBudget,
+                   new: PodDisruptionBudget) -> None:
+        with self._lock:
+            self._set_pdb(new)
+
+    def delete_pdb(self, pdb: PodDisruptionBudget) -> None:
+        with self._lock:
+            job_id = pdb.owner_uid
+            job = self.jobs.get(job_id)
+            if job is None:
+                raise KeyError(f"can not find job {job_id}")
+            job.unset_pdb()
+            self.deleted_jobs.add_rate_limited(job)
+
+    def _set_pdb(self, pdb: PodDisruptionBudget) -> None:
+        """PDBs are grouped by their controller owner
+        (ref: event_handlers.go:477-493)."""
+        job_id = pdb.owner_uid
+        if not job_id:
+            raise ValueError("the controller of PodDisruptionBudget is empty")
+        if job_id not in self.jobs:
+            self.jobs[job_id] = JobInfo(job_id)
+        self.jobs[job_id].set_pdb(pdb)
+        self.jobs[job_id].queue = self.default_queue
+
+    def add_queue(self, queue: Queue) -> None:
+        with self._lock:
+            qi = QueueInfo(queue)
+            self.queues[qi.uid] = qi
+
+    def update_queue(self, old: Queue, new: Queue) -> None:
+        with self._lock:
+            self.queues.pop(old.name, None)
+            qi = QueueInfo(new)
+            self.queues[qi.uid] = qi
+
+    def delete_queue(self, queue: Queue) -> None:
+        with self._lock:
+            self.queues.pop(queue.name, None)
+
+    def add_priority_class(self, pc: PriorityClass) -> None:
+        with self._lock:
+            self._add_priority_class(pc)
+
+    def update_priority_class(self, old: PriorityClass,
+                              new: PriorityClass) -> None:
+        with self._lock:
+            self._delete_priority_class(old)
+            self._add_priority_class(new)
+
+    def delete_priority_class(self, pc: PriorityClass) -> None:
+        with self._lock:
+            self._delete_priority_class(pc)
+
+    def _add_priority_class(self, pc: PriorityClass) -> None:
+        if pc.global_default:
+            self.default_priority_class = pc
+            self.default_priority = pc.value
+        self.priority_classes[pc.name] = pc
+
+    def _delete_priority_class(self, pc: PriorityClass) -> None:
+        if pc.global_default:
+            self.default_priority_class = None
+            self.default_priority = 0
+        self.priority_classes.pop(pc.name, None)
+
+    # ------------------------------------------------------------------
+    # decisions out (ref: cache.go:349-442)
+    # ------------------------------------------------------------------
+    def _find_job_and_task(self, ti: TaskInfo) -> Tuple[JobInfo, TaskInfo]:
+        job = self.jobs.get(ti.job)
+        if job is None:
+            raise KeyError(f"failed to find Job {ti.job} for Task {ti.uid}")
+        task = job.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(f"failed to find task in status {ti.status} "
+                           f"by id {ti.uid}")
+        return job, task
+
+    def bind(self, ti: TaskInfo, hostname: str) -> None:
+        """Local state flips to Binding under the lock; the API call runs
+        async with resync-on-failure (ref: cache.go:392-432)."""
+        with self._lock:
+            job, task = self._find_job_and_task(ti)
+            node = self.nodes.get(hostname)
+            if node is None:
+                raise KeyError(f"failed to bind Task {task.uid} to host "
+                               f"{hostname}, host does not exist")
+            job.update_task_status(task, TaskStatus.BINDING)
+            task.node_name = hostname
+            node.add_task(task)
+            pod = task.pod
+
+        def do_bind(task=task, pod=pod, hostname=hostname):
+            try:
+                self.binder.bind(pod, hostname)
+            except Exception:
+                self.resync_task(task)
+            else:
+                self.recorder.eventf(
+                    pod, "Normal", "Scheduled",
+                    f"Successfully assigned {pod.namespace}/{pod.name} "
+                    f"to {hostname}")
+
+        self._submit(do_bind)
+
+    def evict(self, ti: TaskInfo, reason: str) -> None:
+        """ref: cache.go:349-389."""
+        with self._lock:
+            job, task = self._find_job_and_task(ti)
+            node = self.nodes.get(task.node_name)
+            if node is None:
+                raise KeyError(f"failed to evict Task {task.uid} on host "
+                               f"{task.node_name}, host does not exist")
+            job.update_task_status(task, TaskStatus.RELEASING)
+            node.update_task(task)
+            pod = task.pod
+            pg = job.pod_group
+
+        def do_evict(task=task, pod=pod):
+            try:
+                self.evictor.evict(pod)
+            except Exception:
+                self.resync_task(task)
+
+        self._submit(do_evict)
+        if not shadow_pod_group(pg):
+            self.recorder.eventf(pg, "Normal", "Evict", reason)
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        self.volume_binder.allocate_volumes(task, hostname)
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        self.volume_binder.bind_volumes(task)
+
+    # ------------------------------------------------------------------
+    # repair loops (ref: cache.go:464-513, event_handlers.go:88-106)
+    # ------------------------------------------------------------------
+    def resync_task(self, task: TaskInfo) -> None:
+        self.err_tasks.add_rate_limited(task)
+
+    def process_resync_tasks(self) -> None:
+        for task in self.err_tasks.pop_due():
+            try:
+                self.sync_task(task)
+                self.err_tasks.forget(task)
+            except Exception:
+                self.err_tasks.add_rate_limited(task)
+
+    def sync_task(self, old_task: TaskInfo) -> None:
+        """Re-fetch ground truth and replay (ref: event_handlers.go:88-106)."""
+        with self._lock:
+            if self.pod_lister is None:
+                # no external truth: replay the task's own pod state
+                new_pod: Optional[Pod] = old_task.pod
+            else:
+                new_pod = self.pod_lister(old_task.namespace, old_task.name)
+            if new_pod is None:
+                self._delete_task(old_task)
+                return
+            self._delete_task(old_task)
+            self._add_task(TaskInfo(new_pod))
+
+    def process_cleanup_jobs(self) -> None:
+        for job in self.deleted_jobs.pop_due():
+            with self._lock:
+                if job_terminated(job):
+                    self.jobs.pop(job.uid, None)
+                    self.deleted_jobs.forget(job)
+                else:
+                    self.deleted_jobs.add_rate_limited(job)
+
+    # ------------------------------------------------------------------
+    # snapshot (ref: cache.go:515-583)
+    # ------------------------------------------------------------------
+    def snapshot(self) -> ClusterInfo:
+        with self._lock:
+            snap = ClusterInfo()
+            for name, node in self.nodes.items():
+                snap.nodes[node.name] = node.clone()
+            for uid, q in self.queues.items():
+                snap.queues[uid] = q.clone()
+            for uid, job in self.jobs.items():
+                if job.pod_group is None and job.pdb is None:
+                    continue
+                if job.queue not in snap.queues:
+                    continue
+                if job.pod_group is not None:
+                    job.priority = self.default_priority
+                    pc = self.priority_classes.get(
+                        job.pod_group.priority_class_name)
+                    if pc is not None:
+                        job.priority = pc.value
+                snap.jobs[uid] = job.clone()
+            return snap
+
+    # ------------------------------------------------------------------
+    # status write-back (ref: cache.go:615-658)
+    # ------------------------------------------------------------------
+    def task_unschedulable(self, task: TaskInfo, message: str) -> None:
+        """ref: cache.go:445-462."""
+        pod = task.pod
+        self.recorder.eventf(pod, "Warning", "Unschedulable", message)
+        self.status_updater.update_pod_condition(pod, {
+            "type": "PodScheduled",
+            "status": "False",
+            "reason": "Unschedulable",
+            "message": message,
+        })
+
+    def record_job_status_event(self, job: JobInfo) -> None:
+        """ref: cache.go:616-643."""
+        job_err = job.fit_error()
+        if not shadow_pod_group(job.pod_group):
+            pg_unschedulable = job.pod_group is not None and (
+                job.pod_group.status.phase in (PodGroupPhase.PENDING,
+                                               PodGroupPhase.UNKNOWN))
+            pdb_unschedulable = (job.pdb is not None
+                                 and job.count(TaskStatus.PENDING) != 0)
+            if pg_unschedulable or pdb_unschedulable:
+                msg = (f"{job.count(TaskStatus.PENDING)}/{len(job.tasks)} "
+                       f"tasks in gang unschedulable: {job_err}")
+                self.recorder.eventf(job.pod_group, "Warning",
+                                     UNSCHEDULABLE_CONDITION, msg)
+        for status in (TaskStatus.ALLOCATED, TaskStatus.PENDING):
+            for task in list(job.task_status_index.get(status, {}).values()):
+                self.task_unschedulable(task, job_err)
+
+    def update_job_status(self, job: JobInfo) -> JobInfo:
+        """ref: cache.go:646-658."""
+        if not shadow_pod_group(job.pod_group):
+            pg = self.status_updater.update_pod_group(job.pod_group)
+            job.pod_group = pg
+        self.record_job_status_event(job)
+        return job

@@ -1,0 +1,136 @@
+"""Prometheus metrics — same taxonomy as the reference's kube_batch
+namespace (ref: pkg/scheduler/metrics/metrics.go:38-121), plus solver-kernel
+timings the reference has no counterpart for.
+
+All durations passed to the update functions are SECONDS (Python
+convention); conversion to the reference's ms/us units happens here.
+"""
+from __future__ import annotations
+
+try:
+    from prometheus_client import Counter, Gauge, Histogram
+    _PROM = True
+except Exception:  # pragma: no cover - prometheus is baked in
+    _PROM = False
+
+NAMESPACE = "kube_batch"
+ON_SESSION_OPEN = "OnSessionOpen"
+ON_SESSION_CLOSE = "OnSessionClose"
+
+
+def _buckets(start: float, factor: float, count: int):
+    out, v = [], start
+    for _ in range(count):
+        out.append(v)
+        v *= factor
+    return out
+
+
+if _PROM:
+    e2e_scheduling_latency = Histogram(
+        "e2e_scheduling_latency_milliseconds",
+        "E2e scheduling latency in milliseconds "
+        "(scheduling algorithm + binding)",
+        namespace=NAMESPACE, buckets=_buckets(5, 2, 10))
+    plugin_scheduling_latency = Histogram(
+        "plugin_scheduling_latency_microseconds",
+        "Plugin scheduling latency in microseconds",
+        ["plugin", "OnSession"],
+        namespace=NAMESPACE, buckets=_buckets(5, 2, 10))
+    action_scheduling_latency = Histogram(
+        "action_scheduling_latency_microseconds",
+        "Action scheduling latency in microseconds",
+        ["action"], namespace=NAMESPACE, buckets=_buckets(5, 2, 10))
+    task_scheduling_latency = Histogram(
+        "task_scheduling_latency_microseconds",
+        "Task scheduling latency in microseconds",
+        namespace=NAMESPACE, buckets=_buckets(5, 2, 10))
+    schedule_attempts = Counter(
+        "schedule_attempts_total",
+        "Number of attempts to schedule pods, by the result.",
+        ["result"], namespace=NAMESPACE)
+    preemption_victims = Gauge(
+        "pod_preemption_victims", "Number of selected preemption victims",
+        namespace=NAMESPACE)
+    preemption_attempts = Counter(
+        "total_preemption_attempts",
+        "Total preemption attempts in the cluster till now",
+        namespace=NAMESPACE)
+    unschedule_task_count = Gauge(
+        "unschedule_task_count", "Number of tasks could not be scheduled",
+        ["job_id"], namespace=NAMESPACE)
+    unschedule_job_count = Gauge(
+        "unschedule_job_count", "Number of jobs could not be scheduled",
+        namespace=NAMESPACE)
+    job_retry_counts = Counter(
+        "job_retry_counts", "Number of retry counts for one job",
+        ["job_id"], namespace=NAMESPACE)
+    # TPU-native extras (no reference counterpart)
+    solver_kernel_latency = Histogram(
+        "solver_kernel_latency_microseconds",
+        "JAX solver kernel wall time in microseconds",
+        ["kernel"], namespace=NAMESPACE, buckets=_buckets(5, 2, 14))
+    tensorize_latency = Histogram(
+        "tensorize_latency_microseconds",
+        "Snapshot tensorization wall time in microseconds",
+        namespace=NAMESPACE, buckets=_buckets(5, 2, 14))
+
+
+def update_plugin_duration(plugin: str, phase: str, seconds: float) -> None:
+    if _PROM:
+        plugin_scheduling_latency.labels(plugin, phase).observe(seconds * 1e6)
+
+
+def update_action_duration(action: str, seconds: float) -> None:
+    if _PROM:
+        action_scheduling_latency.labels(action).observe(seconds * 1e6)
+
+
+def update_e2e_duration(seconds: float) -> None:
+    if _PROM:
+        e2e_scheduling_latency.observe(seconds * 1e3)
+
+
+def update_task_schedule_duration(seconds: float) -> None:
+    if _PROM:
+        task_scheduling_latency.observe(seconds * 1e6)
+
+
+def update_pod_schedule_status(result: str, count: int) -> None:
+    if _PROM and count:
+        schedule_attempts.labels(result).inc(count)
+
+
+def update_preemption_victims_count(count: int) -> None:
+    if _PROM:
+        preemption_victims.set(count)
+
+
+def register_preemption_attempts() -> None:
+    if _PROM:
+        preemption_attempts.inc()
+
+
+def update_unschedule_task_count(job_id: str, count: int) -> None:
+    if _PROM:
+        unschedule_task_count.labels(job_id).set(count)
+
+
+def update_unschedule_job_count(count: int) -> None:
+    if _PROM:
+        unschedule_job_count.set(count)
+
+
+def register_job_retries(job_id: str) -> None:
+    if _PROM:
+        job_retry_counts.labels(job_id).inc()
+
+
+def update_solver_kernel_duration(kernel: str, seconds: float) -> None:
+    if _PROM:
+        solver_kernel_latency.labels(kernel).observe(seconds * 1e6)
+
+
+def update_tensorize_duration(seconds: float) -> None:
+    if _PROM:
+        tensorize_latency.observe(seconds * 1e6)

@@ -244,6 +244,7 @@ class PodGroup:
     queue: str = ""
     priority_class_name: str = ""
     creation_timestamp: float = 0.0
+    annotations: Dict[str, str] = field(default_factory=dict)
     status: PodGroupStatus = field(default_factory=PodGroupStatus)
 
 
@@ -260,6 +261,19 @@ class PriorityClass:
     name: str
     value: int = 0
     global_default: bool = False
+
+
+@dataclass
+class PodDisruptionBudget:
+    """Legacy gang-grouping path kept for reference parity
+    (ref: job_info.go:204-211; cache/event_handlers.go:477-515)."""
+    name: str
+    namespace: str = "default"
+    uid: str = field(default_factory=lambda: new_uid("pdb"))
+    min_available: int = 0
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = 0.0
+    owner_uid: str = ""
 
 
 @dataclass

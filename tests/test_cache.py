@@ -1,0 +1,243 @@
+"""SchedulerCache behavior (ref: cache/cache_test.go + event handler paths).
+
+Fixtures flow through the REAL event handlers; seams are faked — the
+reference's tier-2 test pattern (SURVEY.md sect. 4).
+"""
+import pytest
+
+from kubebatch_tpu.api import Resource, TaskInfo, TaskStatus
+from kubebatch_tpu.cache import SchedulerCache, shadow_pod_group
+from kubebatch_tpu.objects import PodPhase, PriorityClass, Queue
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+def mk_cache(**kw):
+    kw.setdefault("async_writeback", False)
+    return SchedulerCache(**kw)
+
+
+class FailingOnceBinder:
+    def __init__(self):
+        self.calls = 0
+        self.bound = []
+
+    def bind(self, pod, hostname):
+        self.calls += 1
+        if self.calls == 1:
+            raise RuntimeError("api flake")
+        self.bound.append((f"{pod.namespace}/{pod.name}", hostname))
+        pod.node_name = hostname
+
+
+def test_add_pod_creates_shadow_job_and_node_placeholder():
+    c = mk_cache()
+    pod = build_pod("ns", "p1", "n-unseen", PodPhase.RUNNING, rl(1000, GiB),
+                    owner_uid="rs-1")
+    c.add_pod(pod)
+    # shadow podgroup keyed by owner uid; node placeholder auto-created
+    assert "rs-1" in c.jobs
+    assert shadow_pod_group(c.jobs["rs-1"].pod_group)
+    assert c.jobs["rs-1"].min_available == 1
+    assert c.jobs["rs-1"].queue == "default"
+    assert "n-unseen" in c.nodes
+    # placeholder has no Node object -> no accounting yet
+    assert c.nodes["n-unseen"].idle.equal(Resource())
+    # when the real node arrives, set_node recomputes
+    c.add_node(build_node("n-unseen", rl(8000, 10 * GiB)))
+    assert c.nodes["n-unseen"].idle.equal(Resource(7000, 9 * GiB, 0))
+
+
+def test_pending_pod_other_scheduler_filtered():
+    c = mk_cache()
+    pod = build_pod("ns", "p1", "", PodPhase.PENDING, rl(1000, GiB))
+    pod.scheduler_name = "default-scheduler"
+    c.add_pod(pod)
+    assert c.jobs == {}
+    # but a RUNNING pod of another scheduler still occupies its node
+    pod2 = build_pod("ns", "p2", "n1", PodPhase.RUNNING, rl(1000, GiB))
+    pod2.scheduler_name = "default-scheduler"
+    c.add_node(build_node("n1", rl(8000, 10 * GiB)))
+    c.add_pod(pod2)
+    assert c.nodes["n1"].used.equal(Resource(1000, GiB, 0))
+
+
+def test_grouped_pods_single_job():
+    c = mk_cache()
+    c.add_pod_group(build_group("ns", "pg1", 2, queue="q1"))
+    for i in range(3):
+        c.add_pod(build_pod("ns", f"p{i}", "", PodPhase.PENDING,
+                            rl(1000, GiB), group="pg1"))
+    assert len(c.jobs) == 1
+    job = c.jobs["ns/pg1"]
+    assert len(job.tasks) == 3
+    assert job.min_available == 2
+    assert job.queue == "q1"
+
+
+def test_pod_group_empty_queue_defaults():
+    c = mk_cache(default_queue="dq")
+    c.add_pod_group(build_group("ns", "pg1", 2))
+    assert c.jobs["ns/pg1"].queue == "dq"
+
+
+def test_update_pod_is_delete_add():
+    c = mk_cache()
+    c.add_node(build_node("n1", rl(8000, 10 * GiB)))
+    old = build_pod("ns", "p1", "", PodPhase.PENDING, rl(1000, GiB),
+                    owner_uid="o1")
+    c.add_pod(old)
+    new = build_pod("ns", "p1", "n1", PodPhase.RUNNING, rl(1000, GiB),
+                    owner_uid="o1")
+    new.uid = old.uid
+    c.update_pod(old, new)
+    job = c.jobs["o1"]
+    assert job.tasks[new.uid].status == TaskStatus.RUNNING
+    assert c.nodes["n1"].used.equal(Resource(1000, GiB, 0))
+
+
+def test_snapshot_skips_unqueued_and_stamps_priority():
+    c = mk_cache()
+    c.add_queue(build_queue("q1", 4))
+    c.add_priority_class(PriorityClass("high", 100))
+    c.add_priority_class(PriorityClass("low", 1, global_default=True))
+    pg_ok = build_group("ns", "pg-ok", 1, queue="q1")
+    pg_ok.priority_class_name = "high"
+    c.add_pod_group(pg_ok)
+    c.add_pod_group(build_group("ns", "pg-noqueue", 1, queue="missing"))
+    c.add_pod(build_pod("ns", "px", "", PodPhase.PENDING, rl(100, 0),
+                        group="pg-orphanless"))  # job without podgroup spec
+    snap = c.snapshot()
+    assert set(snap.jobs) == {"ns/pg-ok"}
+    assert snap.jobs["ns/pg-ok"].priority == 100
+    # default priority applies when class name missing
+    pg2 = build_group("ns", "pg2", 1, queue="q1")
+    c.add_pod_group(pg2)
+    snap2 = c.snapshot()
+    assert snap2.jobs["ns/pg2"].priority == 1
+
+
+def test_snapshot_is_deep_copy():
+    c = mk_cache()
+    c.add_queue(build_queue("q1"))
+    c.add_pod_group(build_group("ns", "pg1", 1, queue="q1"))
+    c.add_pod(build_pod("ns", "p1", "", PodPhase.PENDING, rl(1000, GiB),
+                        group="pg1"))
+    c.add_node(build_node("n1", rl(8000, 10 * GiB)))
+    snap = c.snapshot()
+    t = snap.jobs["ns/pg1"].tasks["ns-p1"]
+    snap.jobs["ns/pg1"].update_task_status(t, TaskStatus.ALLOCATED)
+    snap.nodes["n1"].add_task(t)
+    assert c.jobs["ns/pg1"].tasks["ns-p1"].status == TaskStatus.PENDING
+    assert c.nodes["n1"].idle.equal(Resource(8000, 10 * GiB, 0))
+
+
+def test_bind_updates_state_and_calls_binder():
+    c = mk_cache()
+    c.add_queue(build_queue("q1"))
+    c.add_pod_group(build_group("ns", "pg1", 1, queue="q1"))
+    pod = build_pod("ns", "p1", "", PodPhase.PENDING, rl(1000, GiB),
+                    group="pg1")
+    c.add_pod(pod)
+    c.add_node(build_node("n1", rl(8000, 10 * GiB)))
+    task = c.jobs["ns/pg1"].tasks[pod.uid]
+    c.bind(task, "n1")
+    assert task.status == TaskStatus.BINDING
+    assert task.node_name == "n1"
+    assert c.nodes["n1"].idle.equal(Resource(7000, 9 * GiB, 0))
+    assert pod.node_name == "n1"  # NullBinder flips the pod
+    # binding to unknown host raises, state unchanged
+    with pytest.raises(KeyError):
+        c.bind(task, "ghost")
+
+
+def test_bind_failure_resyncs_via_pod_lister():
+    binder = FailingOnceBinder()
+    # ground truth: the pod is still pending unbound
+    truth = {}
+
+    def lister(ns, name):
+        return truth.get(f"{ns}/{name}")
+
+    c = mk_cache(binder=binder, pod_lister=lister)
+    c.add_queue(build_queue("q1"))
+    c.add_pod_group(build_group("ns", "pg1", 1, queue="q1"))
+    pod = build_pod("ns", "p1", "", PodPhase.PENDING, rl(1000, GiB),
+                    group="pg1")
+    truth["ns/p1"] = pod
+    c.add_pod(pod)
+    c.add_node(build_node("n1", rl(8000, 10 * GiB)))
+    task = c.jobs["ns/pg1"].tasks[pod.uid]
+    c.bind(task, "n1")  # binder throws once -> resync enqueued
+    assert len(c.err_tasks) == 1
+    assert c.drain(timeout=5.0)
+    # resync replayed ground truth: task back to Pending, node idle restored
+    t = c.jobs["ns/pg1"].tasks[pod.uid]
+    assert t.status == TaskStatus.PENDING
+    assert c.nodes["n1"].idle.equal(Resource(8000, 10 * GiB, 0))
+
+
+def test_evict_flips_to_releasing():
+    c = mk_cache()
+    c.add_queue(build_queue("q1"))
+    c.add_pod_group(build_group("ns", "pg1", 1, queue="q1"))
+    pod = build_pod("ns", "p1", "n1", PodPhase.RUNNING, rl(1000, GiB),
+                    group="pg1")
+    c.add_node(build_node("n1", rl(8000, 10 * GiB)))
+    c.add_pod(pod)
+    task = c.jobs["ns/pg1"].tasks[pod.uid]
+    c.evict(task, "preempted")
+    assert task.status == TaskStatus.RELEASING
+    ni = c.nodes["n1"]
+    assert ni.releasing.equal(Resource(1000, GiB, 0))
+    assert ni.used.equal(Resource(1000, GiB, 0))
+    # eviction recorded on the pod group
+    assert any(r == "Evict" for (_, _, r, _) in c.recorder.events)
+
+
+def test_deleted_job_gc():
+    c = mk_cache()
+    c.add_pod_group(build_group("ns", "pg1", 1, queue=""))
+    c.add_queue(build_queue("default"))
+    pod = build_pod("ns", "p1", "", PodPhase.PENDING, rl(100, 0), group="pg1")
+    c.add_pod(pod)
+    c.delete_pod(pod)
+    c.delete_pod_group(c.jobs["ns/pg1"].pod_group)
+    assert c.drain(timeout=5.0)
+    assert "ns/pg1" not in c.jobs
+
+
+def test_delete_pod_prefers_cached_binding_task():
+    # delete event carries a stale pod (no node), but cache task is Binding
+    c = mk_cache()
+    c.add_queue(build_queue("q1"))
+    c.add_pod_group(build_group("ns", "pg1", 1, queue="q1"))
+    pod = build_pod("ns", "p1", "", PodPhase.PENDING, rl(1000, GiB),
+                    group="pg1")
+    c.add_pod(pod)
+    c.add_node(build_node("n1", rl(8000, 10 * GiB)))
+    c.bind(c.jobs["ns/pg1"].tasks[pod.uid], "n1")
+    stale = build_pod("ns", "p1", "", PodPhase.PENDING, rl(1000, GiB),
+                      group="pg1")
+    stale.uid = pod.uid
+    c.delete_pod(stale)
+    assert c.nodes["n1"].idle.equal(Resource(8000, 10 * GiB, 0))
+    assert pod.uid not in c.jobs["ns/pg1"].tasks
+
+
+def test_node_update_only_on_relevant_change():
+    c = mk_cache()
+    n1 = build_node("n1", rl(8000, 10 * GiB))
+    c.add_node(n1)
+    ni = c.nodes["n1"]
+    # irrelevant update: same allocatable/labels/taints
+    n1b = build_node("n1", rl(8000, 10 * GiB))
+    c.update_node(n1, n1b)
+    assert c.nodes["n1"] is ni
+    n2 = build_node("n1", rl(4000, 10 * GiB))
+    c.update_node(n1, n2)
+    assert c.nodes["n1"].allocatable.equal(Resource(4000, 10 * GiB, 0))
+    with pytest.raises(KeyError):
+        c.update_node(n1, build_node("ghost", rl(1, 1)))
+    c.delete_node(n2)
+    assert "n1" not in c.nodes

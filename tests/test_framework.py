@@ -1,0 +1,264 @@
+"""Framework: tier dispatch semantics, session mutators, Statement
+(ref: framework/session_plugins.go + statement.go)."""
+import pytest
+
+from kubebatch_tpu.api import (JobReadiness, Resource, TaskInfo, TaskStatus,
+                               ValidateResult)
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import (EventHandler, Session, Statement,
+                                     open_session, validate_jobs)
+from kubebatch_tpu.objects import PodPhase
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+def mk_cache_with(jobs_pods, nodes=None, min_member=1):
+    c = SchedulerCache(async_writeback=False)
+    c.add_queue(build_queue("q1"))
+    for n in nodes or []:
+        c.add_node(n)
+    groups = {}
+    for pod in jobs_pods:
+        g = pod.group_name
+        if g and g not in groups:
+            groups[g] = build_group(pod.namespace, g, min_member, queue="q1")
+            c.add_pod_group(groups[g])
+        c.add_pod(pod)
+    return c
+
+
+def tiers(*plugin_specs):
+    """plugin_specs: lists of PluginOption per tier."""
+    return [Tier(plugins=list(specs)) for specs in plugin_specs]
+
+
+def mk_session(cache, the_tiers=()):
+    ssn = open_session(cache)
+    ssn.tiers = list(the_tiers)
+    return ssn
+
+
+def t(name):
+    return PluginOption(name=name)
+
+
+class TestTierDispatch:
+    def _session(self, the_tiers):
+        c = SchedulerCache(async_writeback=False)
+        return mk_session(c, the_tiers)
+
+    def _task(self, name="p1", group="g1"):
+        return TaskInfo(build_pod("ns", name, "", PodPhase.PENDING,
+                                  rl(100, 0), group=group))
+
+    def test_evictable_intersection_within_tier(self):
+        ssn = self._session(tiers([t("a"), t("b")]))
+        v1, v2, v3 = (self._task(f"v{i}") for i in range(3))
+        ssn.add_preemptable_fn("a", lambda e, ees: [v1, v2])
+        ssn.add_preemptable_fn("b", lambda e, ees: [v2, v3])
+        out = ssn.preemptable(self._task(), [v1, v2, v3])
+        assert [x.uid for x in out] == [v2.uid]
+
+    def test_evictable_first_tier_with_result_wins(self):
+        ssn = self._session(tiers([t("a")], [t("b")]))
+        v1, v2 = self._task("v1"), self._task("v2")
+        ssn.add_preemptable_fn("a", lambda e, ees: [v1])
+        ssn.add_preemptable_fn("b", lambda e, ees: [v2])
+        out = ssn.preemptable(self._task(), [v1, v2])
+        assert [x.uid for x in out] == [v1.uid]
+
+    def test_evictable_empty_list_is_decision(self):
+        # tier 1 returns empty (non-None) -> decision made, tier 2 ignored
+        ssn = self._session(tiers([t("a")], [t("b")]))
+        v1 = self._task("v1")
+        ssn.add_preemptable_fn("a", lambda e, ees: [])
+        ssn.add_preemptable_fn("b", lambda e, ees: [v1])
+        assert ssn.preemptable(self._task(), [v1]) == []
+
+    def test_evictable_none_falls_through(self):
+        ssn = self._session(tiers([t("a")], [t("b")]))
+        v1 = self._task("v1")
+        ssn.add_preemptable_fn("a", lambda e, ees: None)
+        ssn.add_preemptable_fn("b", lambda e, ees: [v1])
+        out = ssn.preemptable(self._task(), [v1])
+        assert [x.uid for x in out] == [v1.uid]
+
+    def test_evictable_disable_flag(self):
+        opt = t("a")
+        opt.preemptable_disabled = True
+        ssn = self._session(tiers([opt, t("b")]))
+        v1, v2 = self._task("v1"), self._task("v2")
+        ssn.add_preemptable_fn("a", lambda e, ees: [v1])
+        ssn.add_preemptable_fn("b", lambda e, ees: [v2])
+        out = ssn.preemptable(self._task(), [v1, v2])
+        assert [x.uid for x in out] == [v2.uid]
+
+    def test_overused_any_true(self):
+        ssn = self._session(tiers([t("a"), t("b")]))
+        ssn.add_overused_fn("a", lambda q: False)
+        ssn.add_overused_fn("b", lambda q: True)
+        assert ssn.overused(None) is True
+
+    def test_job_ready_first_fn_wins(self):
+        ssn = self._session(tiers([t("a"), t("b")]))
+        ssn.add_job_ready_fn("a", lambda j: JobReadiness.NOT_READY)
+        ssn.add_job_ready_fn("b", lambda j: JobReadiness.READY)
+        assert ssn.job_ready(None) is False
+        assert ssn.job_almost_ready(None) is False
+
+    def test_job_ready_default_true(self):
+        ssn = self._session(tiers([t("a")]))
+        assert ssn.job_ready(None) is True
+
+    def test_predicate_and_semantics(self):
+        ssn = self._session(tiers([t("a"), t("b")]))
+        calls = []
+        ssn.add_predicate_fn("a", lambda task, node: calls.append("a"))
+
+        def reject(task, node):
+            calls.append("b")
+            raise RuntimeError("no")
+
+        ssn.add_predicate_fn("b", reject)
+        with pytest.raises(RuntimeError):
+            ssn.predicate_fn(None, None)
+        assert calls == ["a", "b"]
+
+    def test_node_order_sum(self):
+        ssn = self._session(tiers([t("a")], [t("b")]))
+        ssn.add_node_order_fn("a", lambda task, node: 3.0)
+        ssn.add_node_order_fn("b", lambda task, node: 4.0)
+        assert ssn.node_order_fn(None, None) == 7.0
+
+    def test_order_first_nonzero_else_timestamp_uid(self):
+        ssn = self._session(tiers([t("a"), t("b")]))
+        ssn.add_job_order_fn("a", lambda l, r: 0)
+        ssn.add_job_order_fn("b", lambda l, r: -1)
+        assert ssn.job_order_fn(object(), object()) is True
+
+        ssn2 = self._session(tiers([]))
+        from kubebatch_tpu.api import JobInfo
+        j1, j2 = JobInfo("a"), JobInfo("b")
+        j1.creation_timestamp, j2.creation_timestamp = 1.0, 2.0
+        assert ssn2.job_order_fn(j1, j2) is True
+        j2.creation_timestamp = 1.0
+        assert ssn2.job_order_fn(j1, j2) is True  # uid tiebreak
+        assert ssn2.job_order_fn(j2, j1) is False
+
+    def test_job_valid_first_failure(self):
+        ssn = self._session(tiers([t("a"), t("b")]))
+        ssn.add_job_valid_fn("a", lambda j: ValidateResult(True))
+        ssn.add_job_valid_fn("b", lambda j: ValidateResult(False, "r", "m"))
+        vr = ssn.job_valid(None)
+        assert vr is not None and not vr.passed and vr.reason == "r"
+
+
+class TestSessionMutators:
+    def _setup(self, min_member=1, n_pods=1):
+        pods = [build_pod("ns", f"p{i}", "", PodPhase.PENDING, rl(1000, GiB),
+                          group="g1") for i in range(n_pods)]
+        cache = mk_cache_with(pods, nodes=[build_node("n1", rl(8000, 10*GiB))],
+                              min_member=min_member)
+        ssn = mk_session(cache, tiers([t("gangish")]))
+        # gang-style readiness barrier
+        ssn.add_job_ready_fn("gangish", lambda j: j.get_readiness())
+        return cache, ssn
+
+    def test_allocate_dispatches_when_ready(self):
+        cache, ssn = self._setup(min_member=2, n_pods=2)
+        job = ssn.jobs["ns/g1"]
+        tasks = sorted(job.tasks.values(), key=lambda x: x.name)
+        ssn.allocate(tasks[0], "n1")
+        # gang barrier: 1/2 allocated -> nothing bound yet
+        assert tasks[0].status == TaskStatus.ALLOCATED
+        assert cache.jobs["ns/g1"].tasks[tasks[0].uid].status == TaskStatus.PENDING
+        ssn.allocate(tasks[1], "n1")
+        # both dispatched: session Binding, cache Binding, pods bound
+        for task in tasks:
+            assert task.status == TaskStatus.BINDING
+            assert cache.jobs["ns/g1"].tasks[task.uid].status == TaskStatus.BINDING
+            assert task.pod.node_name == "n1"
+
+    def test_allocate_fires_event_handlers(self):
+        cache, ssn = self._setup()
+        seen = []
+        ssn.add_event_handler(EventHandler(
+            allocate_func=lambda e: seen.append(("alloc", e.task.name)),
+            deallocate_func=lambda e: seen.append(("dealloc", e.task.name))))
+        task = next(iter(ssn.jobs["ns/g1"].tasks.values()))
+        ssn.allocate(task, "n1")
+        assert ("alloc", task.name) in seen
+
+    def test_allocate_over_backfill_status(self):
+        cache, ssn = self._setup(min_member=1)
+        task = next(iter(ssn.jobs["ns/g1"].tasks.values()))
+        # force min_member high so dispatch doesn't fire
+        ssn.jobs["ns/g1"].min_available = 5
+        ssn.allocate(task, "n1", using_backfill_task_res=True)
+        assert task.status == TaskStatus.ALLOCATED_OVER_BACKFILL
+
+    def test_pipeline_session_only(self):
+        cache, ssn = self._setup()
+        task = next(iter(ssn.jobs["ns/g1"].tasks.values()))
+        ssn.pipeline(task, "n1")
+        assert task.status == TaskStatus.PIPELINED
+        # nothing reached the cache
+        assert cache.jobs["ns/g1"].tasks[task.uid].status == TaskStatus.PENDING
+
+
+class TestStatement:
+    def _running_setup(self):
+        pods = [build_pod("ns", "victim", "n1", PodPhase.RUNNING,
+                          rl(4000, 4 * GiB), group="gv"),
+                build_pod("ns", "preemptor", "", PodPhase.PENDING,
+                          rl(4000, 4 * GiB), group="gp")]
+        cache = mk_cache_with(pods, nodes=[build_node("n1", rl(6000, 8*GiB))])
+        ssn = mk_session(cache)
+        return cache, ssn
+
+    def test_discard_rolls_back_in_reverse(self):
+        cache, ssn = self._running_setup()
+        victim = next(iter(ssn.jobs["ns/gv"].tasks.values()))
+        preemptor = next(iter(ssn.jobs["ns/gp"].tasks.values()))
+        node = ssn.nodes["n1"]
+        idle0 = node.idle.clone()
+        stmt = Statement(ssn)
+        stmt.evict(victim, "test")
+        assert victim.status == TaskStatus.RELEASING
+        assert node.releasing.equal(Resource(4000, 4 * GiB, 0))
+        stmt.pipeline(preemptor, "n1")
+        assert preemptor.status == TaskStatus.PIPELINED
+        stmt.discard()
+        assert victim.status == TaskStatus.RUNNING
+        assert preemptor.status == TaskStatus.PENDING
+        assert preemptor.node_name == ""
+        assert node.idle.equal(idle0)
+        assert node.releasing.equal(Resource())
+        # nothing hit the cache
+        cv = cache.jobs["ns/gv"].tasks[victim.uid]
+        assert cv.status == TaskStatus.RUNNING
+
+    def test_commit_replays_evictions(self):
+        cache, ssn = self._running_setup()
+        victim = next(iter(ssn.jobs["ns/gv"].tasks.values()))
+        stmt = Statement(ssn)
+        stmt.evict(victim, "preempt")
+        stmt.commit()
+        cv = cache.jobs["ns/gv"].tasks[victim.uid]
+        assert cv.status == TaskStatus.RELEASING
+        assert cache.nodes["n1"].releasing.equal(Resource(4000, 4*GiB, 0))
+
+
+class TestValidateJobs:
+    def test_invalid_jobs_dropped_with_condition(self):
+        pods = [build_pod("ns", "p0", "", PodPhase.PENDING, rl(100, 0),
+                          group="g1")]
+        cache = mk_cache_with(pods, min_member=3)
+        ssn = mk_session(cache, tiers([t("gangish")]))
+        ssn.add_job_valid_fn(
+            "gangish",
+            lambda j: ValidateResult(len(j.tasks) >= j.min_available,
+                                     "NotEnoughPods", "gang unsatisfied"))
+        validate_jobs(ssn)
+        assert ssn.jobs == {}

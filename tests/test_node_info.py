@@ -126,6 +126,24 @@ def test_set_node_recomputes():
     assert ni.releasing.equal(Resource())
 
 
+def test_set_node_preserves_pipelined_invariant():
+    # set_node must reproduce add_task's accounting for pipelined tasks:
+    # they borrow releasing resources, not idle
+    ni = mk_node(8000, 10 * GiB)
+    releasing = TaskInfo(build_pod("c1", "r", "n1", PodPhase.RUNNING,
+                                   rl(2000, 2 * GiB), deletion_timestamp=1.0))
+    pipelined = TaskInfo(build_pod("c1", "p", "n1", PodPhase.PENDING,
+                                   rl(1000, GiB)))
+    pipelined.status = TaskStatus.PIPELINED
+    ni.add_task(releasing)
+    ni.add_task(pipelined)
+    before = (ni.idle.clone(), ni.releasing.clone(), ni.used.clone())
+    ni.set_node(build_node("n1", rl(8000, 10 * GiB)))
+    assert ni.idle.equal(before[0])
+    assert ni.releasing.equal(before[1])
+    assert ni.used.equal(before[2])
+
+
 def test_set_node_recomputes_backfilled():
     ni = NodeInfo()
     bf = TaskInfo(build_pod("c1", "b1", "n1", PodPhase.RUNNING, rl(500, GiB),
