@@ -1,0 +1,201 @@
+"""allocate — the primary scheduling action.
+
+ref: pkg/scheduler/actions/allocate/allocate.go. Control flow is preserved
+exactly (queue PQ with one entry per job, overused queues dropped, one job
+per queue visit, job re-pushed only when it crosses readiness, job dropped
+on first unassignable task, queue re-pushed after every visit). What
+changes is the inner loop: instead of per-(task,node) predicate/score
+callbacks, the whole job visit is solved by ONE jitted scan on TPU
+(kernels/solver.py) that returns a decision per task.
+
+``mode="host"`` runs the reference's literal per-pair loops through the
+session callbacks — the semantic oracle the kernel is tested against.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..api import JobInfo, TaskInfo, TaskStatus
+from ..framework import Action, Session, register_action
+from ..kernels.solver import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
+                              DeviceSession)
+from ..kernels.tensorize import TaskBatch
+from ..kernels.terms import pred_and_score_matrices
+from ..util import PriorityQueue, select_best_node
+
+
+def _effective_min_available(ssn: Session, job: JobInfo) -> int:
+    """The readiness threshold the kernel enforces in-scan. With a job-ready
+    fn installed (gang), readiness = allocated-family count reaching
+    MinAvailable; with none, the session defaults to Ready (ref:
+    session_plugins.go:167-186) which the kernel encodes as threshold 0."""
+    for tier in ssn.tiers:
+        for plugin in tier.plugins:
+            if plugin.job_ready_disabled:
+                continue
+            if plugin.name in ssn.job_ready_fns:
+                return int(job.min_available)
+    return 0
+
+
+def _init_allocated(job: JobInfo) -> int:
+    return job.count(TaskStatus.BOUND, TaskStatus.BINDING, TaskStatus.RUNNING,
+                     TaskStatus.ALLOCATED)
+
+
+class AllocateAction(Action):
+    def __init__(self, mode: Optional[str] = None):
+        self._mode = mode
+
+    @property
+    def name(self) -> str:
+        return "allocate"
+
+    @property
+    def mode(self) -> str:
+        return self._mode or os.environ.get("KUBEBATCH_SOLVER", "jax")
+
+    def execute(self, ssn: Session) -> None:
+        queues = PriorityQueue(ssn.queue_order_fn)
+        jobs_map: Dict[str, PriorityQueue] = {}
+        for job in ssn.jobs.values():
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            # one queue entry per job, as the reference does (allocate.go:50)
+            queues.push(queue)
+            jobs_map.setdefault(job.queue, PriorityQueue(ssn.job_order_fn))
+            jobs_map[job.queue].push(job)
+
+        pending_tasks: Dict[str, PriorityQueue] = {}
+        device: Optional[DeviceSession] = None
+        if self.mode == "jax":
+            if ssn.device_snapshot is None:
+                ssn.device_snapshot = DeviceSession(ssn.nodes)
+            device = ssn.device_snapshot
+
+        while not queues.empty():
+            queue = queues.pop()
+            if ssn.overused(queue):
+                continue
+            jobs = jobs_map.get(queue.uid)
+            if jobs is None or jobs.empty():
+                continue
+            job = jobs.pop()
+            if job.uid not in pending_tasks:
+                tasks = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index.get(TaskStatus.PENDING,
+                                                      {}).values():
+                    if task.resreq.is_empty():
+                        continue  # BestEffort handled by backfill
+                    tasks.push(task)
+                pending_tasks[job.uid] = tasks
+            tasks = pending_tasks[job.uid]
+
+            if not tasks.empty():
+                if device is not None:
+                    self._visit_job_device(ssn, device, job, tasks, jobs)
+                else:
+                    self._visit_job_host(ssn, job, tasks, jobs)
+
+            queues.push(queue)
+
+    # ------------------------------------------------------------------
+    # device path
+    # ------------------------------------------------------------------
+    def _visit_job_device(self, ssn: Session, device: DeviceSession,
+                          job: JobInfo, tasks: PriorityQueue,
+                          jobs: PriorityQueue) -> None:
+        ordered: List[TaskInfo] = []
+        while not tasks.empty():
+            ordered.append(tasks.pop())
+        batch = TaskBatch.from_tasks(ordered)
+        scores, pred = pred_and_score_matrices(ssn, device, batch)
+        decisions, _ = device.solve_job(
+            batch, _effective_min_available(ssn, job), _init_allocated(job),
+            scores=scores, pred_mask=pred)
+        try:
+            for task, dec in zip(ordered, decisions):
+                if dec.kind == ALLOC:
+                    ssn.allocate(task, dec.node_name, False)
+                elif dec.kind == ALLOC_OB:
+                    ssn.allocate(task, dec.node_name, True)
+                elif dec.kind == PIPELINE:
+                    ssn.pipeline(task, dec.node_name)
+                elif dec.kind == FAIL:
+                    self._record_fit_deltas(ssn, job, task)
+                    return  # job dropped (allocate.go:187-189)
+                elif dec.kind == SKIP:
+                    tasks.push(task)  # not processed; next visit
+            if ssn.job_ready(job):
+                jobs.push(job)
+        except Exception:
+            # host apply diverged (e.g. volume binder failure): device state
+            # no longer matches host truth; rebuild before the next visit
+            device.resync(ssn.nodes)
+            raise
+
+    def _record_fit_deltas(self, ssn: Session, job: JobInfo,
+                           task: TaskInfo) -> None:
+        """NodesFitDelta for the breaking task (ref: allocate.go:124-126 and
+        164-170: the map holds deltas of the last task that failed)."""
+        job.nodes_fit_delta = {}
+        for node in ssn.nodes.values():
+            delta = node.idle.clone()
+            delta.fit_delta(task.resreq)
+            job.nodes_fit_delta[node.name] = delta
+
+    # ------------------------------------------------------------------
+    # host path — the reference algorithm verbatim (the oracle)
+    # ------------------------------------------------------------------
+    def _visit_job_host(self, ssn: Session, job: JobInfo,
+                        tasks: PriorityQueue, jobs: PriorityQueue) -> None:
+        while not tasks.empty():
+            task = tasks.pop()
+            assigned = False
+            if job.nodes_fit_delta:
+                job.nodes_fit_delta = {}
+
+            predicate_nodes = []
+            for node in ssn.nodes.values():
+                try:
+                    ssn.predicate_fn(task, node)
+                except Exception:
+                    continue
+                predicate_nodes.append(node)
+
+            node_scores: Dict[float, list] = {}
+            for node in predicate_nodes:
+                score = ssn.node_order_fn(task, node)
+                node_scores.setdefault(score, []).append(node)
+
+            for node in select_best_node(node_scores):
+                if task.init_resreq.less_equal(node.accessible()):
+                    ssn.allocate(task, node.name,
+                                 not task.init_resreq.less_equal(node.idle))
+                    assigned = True
+                    break
+                else:
+                    delta = node.idle.clone()
+                    delta.fit_delta(task.resreq)
+                    job.nodes_fit_delta[node.name] = delta
+                if task.init_resreq.less_equal(node.releasing):
+                    ssn.pipeline(task, node.name)
+                    assigned = True
+                    break
+
+            if not assigned:
+                break
+            if ssn.job_ready(job):
+                jobs.push(job)
+                break
+
+
+def new() -> AllocateAction:
+    return AllocateAction()
+
+
+register_action(AllocateAction())

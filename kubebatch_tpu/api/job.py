@@ -160,7 +160,12 @@ class JobInfo:
     def add_task_info(self, ti: TaskInfo) -> None:
         self.tasks[ti.uid] = ti
         self._add_task_index(ti)
-        self.priority = ti.priority
+        # Only an explicit pod priority overrides the job's priority; the
+        # reference overwrites unconditionally (job_info.go:242) because in
+        # real k8s admission always stamps pod.Spec.Priority — here a None
+        # must not clobber the priority-class value stamped by snapshot().
+        if ti.pod.priority is not None:
+            self.priority = ti.priority
         self.total_request.add(ti.resreq)
         if allocated_status(ti.status):
             self.allocated.add(ti.resreq)

@@ -26,6 +26,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo,
@@ -202,8 +203,12 @@ class SchedulerCache:
             with self._inflight_lock:
                 pending = list(self._inflight)
             if pending:
-                for fut in pending:
-                    fut.result(timeout=max(0.0, deadline - time.monotonic()))
+                try:
+                    for fut in pending:
+                        fut.result(
+                            timeout=max(0.0, deadline - time.monotonic()))
+                except FuturesTimeoutError:
+                    return False
                 continue
             self.process_resync_tasks()
             self.process_cleanup_jobs()
@@ -281,12 +286,14 @@ class SchedulerCache:
             self._add_task(TaskInfo(pod))
 
     def update_pod(self, old: Pod, new: Pod) -> None:
-        """Delete + re-add (ref: event_handlers.go:108-122)."""
-        if not self._pod_relevant(new) and not self._pod_relevant(old):
-            return
+        """Delete + re-add (ref: event_handlers.go:108-122). Relevance is
+        per-side: a pod that was filtered at add time (old irrelevant) is
+        treated as a fresh add, like client-go's filtering handler does."""
         with self._lock:
-            self._delete_pod_locked(old)
-            self._add_task(TaskInfo(new))
+            if self._pod_relevant(old):
+                self._delete_pod_locked(old)
+            if self._pod_relevant(new):
+                self._add_task(TaskInfo(new))
 
     def delete_pod(self, pod: Pod) -> None:
         with self._lock:

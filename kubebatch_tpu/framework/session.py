@@ -111,25 +111,25 @@ class Session:
     def _evictable(self, fns: Dict[str, EvictableFn], disabled_attr: str,
                    evictor: TaskInfo,
                    evictees: List[TaskInfo]) -> List[TaskInfo]:
-        """Per-tier intersection of plugin victim lists; the first tier
-        producing a non-None result decides (session_plugins.go:67-148)."""
+        """Per-tier intersection of plugin victim lists; the first tier with
+        a NON-EMPTY intersection decides (session_plugins.go:67-148 — in Go
+        an empty intersection is a nil slice, so it falls through to the
+        next tier exactly like no plugin answering)."""
         for tier in self.tiers:
             victims: Optional[List[TaskInfo]] = None
-            init = False
             for plugin in tier.plugins:
                 if getattr(plugin, disabled_attr):
                     continue
                 fn = fns.get(plugin.name)
                 if fn is None:
                     continue
-                candidates = fn(evictor, evictees)
-                if not init:
-                    victims = candidates
-                    init = True
-                elif victims is not None:
-                    cand_ids = {c.uid for c in (candidates or [])}
+                candidates = fn(evictor, evictees) or []
+                if victims is None:
+                    victims = list(candidates)
+                else:
+                    cand_ids = {c.uid for c in candidates}
                     victims = [v for v in victims if v.uid in cand_ids]
-            if victims is not None:
+            if victims:
                 return victims
         return []
 

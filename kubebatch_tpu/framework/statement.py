@@ -11,7 +11,6 @@ from __future__ import annotations
 from typing import List, Tuple
 
 from ..api import TaskInfo, TaskStatus
-from .event import Event
 
 
 class Statement:
@@ -28,9 +27,7 @@ class Statement:
         node = self.ssn.nodes.get(reclaimee.node_name)
         if node is not None:
             node.update_task(reclaimee)
-        for eh in self.ssn.event_handlers:
-            if eh.deallocate_func is not None:
-                eh.deallocate_func(Event(reclaimee))
+        self.ssn._fire_deallocate(reclaimee)
         self.operations.append(("evict", (reclaimee, reason)))
 
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
@@ -42,9 +39,7 @@ class Statement:
         node = self.ssn.nodes.get(hostname)
         if node is not None:
             node.add_task(task)
-        for eh in self.ssn.event_handlers:
-            if eh.allocate_func is not None:
-                eh.allocate_func(Event(task))
+        self.ssn._fire_allocate(task)
         self.operations.append(("pipeline", (task, hostname)))
 
     # --- rollback helpers --------------------------------------------------
@@ -56,9 +51,7 @@ class Statement:
         node = self.ssn.nodes.get(reclaimee.node_name)
         if node is not None:
             node.update_task(reclaimee)
-        for eh in self.ssn.event_handlers:
-            if eh.allocate_func is not None:
-                eh.allocate_func(Event(reclaimee))
+        self.ssn._fire_allocate(reclaimee)
 
     def _unpipeline(self, task: TaskInfo) -> None:
         """ref: statement.go:156-192."""
@@ -69,9 +62,7 @@ class Statement:
         if node is not None:
             node.remove_task(task)
         task.node_name = ""
-        for eh in self.ssn.event_handlers:
-            if eh.deallocate_func is not None:
-                eh.deallocate_func(Event(task))
+        self.ssn._fire_deallocate(task)
 
     # --- transaction close -------------------------------------------------
     def commit(self) -> None:

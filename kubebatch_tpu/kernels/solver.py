@@ -1,0 +1,199 @@
+"""The allocate solver — a capacity-carrying assignment scan on TPU.
+
+Replaces the reference's O(tasks x nodes x plugins) per-pair loops
+(actions/allocate/allocate.go:128-186) with ONE jitted lax.scan per job
+visit: for each task (in task-order) the scan computes the predicate mask
+and score over ALL nodes at once, selects the best feasible node, and
+updates the idle/releasing capacity carry before the next task — preserving
+the reference's sequential-greedy semantics while amortizing device
+dispatch over the whole job.
+
+Decision codes (host applies them through Session.allocate/pipeline so all
+plugin event handlers and the gang dispatch barrier still fire):
+
+  0 SKIP      task not processed (job became ready first — reference
+              re-pushes the job and handles the rest next visit)
+  1 ALLOC     init_resreq fits node idle -> Allocated
+  2 ALLOC_OB  fits idle+backfilled but not idle -> AllocatedOverBackfill
+              (fork feature, allocate.go:157)
+  3 PIPELINE  fits releasing -> Pipelined onto releasing resources
+  4 FAIL      no feasible node -> job dropped this cycle (allocate.go:187)
+
+Fit rules mirror allocate.go:153-184: a node is feasible if the launch
+request fits accessible (idle+backfilled) OR releasing; the highest-scoring
+feasible node wins (ties -> lowest node index; the reference's tie order is
+Go map iteration, i.e. unspecified); the fit kind is then read off that
+node. Readiness crossing counts only ALLOC decisions — AllocatedOverBackfill
+and Pipelined don't advance gang readiness (api/types.go:82-84).
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import NodeInfo
+from ..metrics import update_solver_kernel_duration, update_tensorize_duration
+from .tensorize import VEC_EPS, NodeState, TaskBatch
+
+SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
+
+
+class _Carry(NamedTuple):
+    idle: jnp.ndarray        # [N,R]
+    releasing: jnp.ndarray   # [N,R]
+    n_tasks: jnp.ndarray     # [N]
+    allocated: jnp.ndarray   # scalar i32: ALLOC count so far (incl. initial)
+    done: jnp.ndarray        # scalar bool
+
+
+class _TaskIn(NamedTuple):
+    resreq: jnp.ndarray       # [R]
+    init_resreq: jnp.ndarray  # [R]
+    valid: jnp.ndarray        # scalar bool
+    score: jnp.ndarray        # [N]
+    pred: jnp.ndarray         # [N] per-task predicate mask
+
+
+@partial(jax.jit, donate_argnums=())
+def _allocate_scan(idle, releasing, backfilled, max_task_num, n_tasks,
+                   node_ok, resreq, init_resreq, task_valid, scores,
+                   pred_mask, min_available, init_allocated):
+    """One job visit. Shapes: nodes [N,R]/[N]; tasks [T,R]/[T]; scores and
+    pred_mask [T,N]. Returns (decisions[T], node_idx[T], new_idle,
+    new_releasing, new_n_tasks, became_ready)."""
+    eps = jnp.asarray(VEC_EPS)
+
+    def step(carry: _Carry, t: _TaskIn):
+        accessible = carry.idle + backfilled
+        room = carry.n_tasks < max_task_num
+        pred = node_ok & room & t.pred
+        fit_alloc = jnp.all(t.init_resreq <= accessible + eps, axis=-1)
+        fit_idle = jnp.all(t.init_resreq <= carry.idle + eps, axis=-1)
+        fit_pipe = jnp.all(t.init_resreq <= carry.releasing + eps, axis=-1)
+        eligible = pred & (fit_alloc | fit_pipe)
+        masked_score = jnp.where(eligible, t.score, -jnp.inf)
+        best = jnp.argmax(masked_score)
+        feasible = eligible[best]
+
+        is_alloc = fit_alloc[best]
+        over_backfill = is_alloc & ~fit_idle[best]
+        active = t.valid & ~carry.done
+        do = active & feasible
+
+        decision = jnp.where(
+            ~active, SKIP,
+            jnp.where(~feasible, FAIL,
+                      jnp.where(~is_alloc, PIPELINE,
+                                jnp.where(over_backfill, ALLOC_OB, ALLOC))))
+
+        take = jnp.where(do, t.resreq, jnp.zeros_like(t.resreq))
+        one_hot = (jnp.arange(carry.idle.shape[0]) == best)
+        alloc_take = jnp.where(is_alloc, 1.0, 0.0) * take
+        pipe_take = jnp.where(is_alloc, 0.0, 1.0) * take
+        new_idle = carry.idle - one_hot[:, None] * alloc_take[None, :]
+        new_rel = carry.releasing - one_hot[:, None] * pipe_take[None, :]
+        new_ntasks = carry.n_tasks + (one_hot & do).astype(jnp.int32)
+
+        new_allocated = carry.allocated + jnp.where(
+            do & is_alloc & ~over_backfill, 1, 0)
+        ready_now = new_allocated >= min_available
+        # stop after the assignment that crossed readiness, or on failure
+        new_done = carry.done | (active & ~feasible) | (do & ready_now)
+
+        out = (decision.astype(jnp.int32), best.astype(jnp.int32))
+        return _Carry(new_idle, new_rel, new_ntasks, new_allocated,
+                      new_done), out
+
+    init = _Carry(idle, releasing, n_tasks,
+                  jnp.asarray(init_allocated, jnp.int32),
+                  jnp.asarray(False))
+    tasks = _TaskIn(resreq, init_resreq, task_valid, scores, pred_mask)
+    final, (decisions, node_idx) = jax.lax.scan(step, init, tasks)
+    became_ready = final.allocated >= min_available
+    return (decisions, node_idx, final.idle, final.releasing, final.n_tasks,
+            became_ready)
+
+
+class Decision(NamedTuple):
+    kind: int
+    node_name: str
+
+
+class DeviceSession:
+    """Per-session device state: node arrays uploaded once, carried across
+    job visits, and kept in lock-step with the host Session's NodeInfo maps
+    (the host applies exactly the decisions the kernel produced)."""
+
+    def __init__(self, nodes: Dict[str, NodeInfo], min_bucket: int = 8):
+        start = time.perf_counter()
+        self.state = NodeState.from_nodes(nodes, min_bucket)
+        self.idle = jnp.asarray(self.state.idle)
+        self.releasing = jnp.asarray(self.state.releasing)
+        self.backfilled = jnp.asarray(self.state.backfilled)
+        self.n_tasks = jnp.asarray(self.state.n_tasks)
+        self.max_task_num = jnp.asarray(self.state.max_task_num)
+        self.node_ok = jnp.asarray(self.state.schedulable & self.state.valid)
+        update_tensorize_duration(time.perf_counter() - start)
+
+    @property
+    def n_padded(self) -> int:
+        return self.state.n_padded
+
+    def node_name(self, idx: int) -> str:
+        return self.state.names[idx]
+
+    def node_index(self, name: str) -> Optional[int]:
+        return self.state.index.get(name)
+
+    def resync(self, nodes: Dict[str, NodeInfo]) -> None:
+        """Rebuild device arrays from host truth (used if a host-side apply
+        failed halfway, or after actions that mutated nodes host-side)."""
+        fresh = DeviceSession(nodes, min_bucket=self.n_padded)
+        self.state = fresh.state
+        self.idle = fresh.idle
+        self.releasing = fresh.releasing
+        self.backfilled = fresh.backfilled
+        self.n_tasks = fresh.n_tasks
+        self.max_task_num = fresh.max_task_num
+        self.node_ok = fresh.node_ok
+
+    def solve_job(self, batch: TaskBatch, min_available: int,
+                  init_allocated: int,
+                  scores: Optional[np.ndarray] = None,
+                  pred_mask: Optional[np.ndarray] = None
+                  ) -> Tuple[List[Decision], bool]:
+        """Run the allocate scan for one job's pending tasks and commit the
+        updated capacity carry to device state. Returns per-real-task
+        decisions plus whether the job crossed readiness."""
+        t_pad, n_pad = batch.t_padded, self.n_padded
+        if scores is None:
+            scores = np.zeros((t_pad, n_pad), np.float32)
+        if pred_mask is None:
+            pred_mask = np.ones((t_pad, n_pad), bool)
+        start = time.perf_counter()
+        (decisions, node_idx, idle, releasing, n_tasks,
+         became_ready) = _allocate_scan(
+            self.idle, self.releasing, self.backfilled, self.max_task_num,
+            self.n_tasks, self.node_ok,
+            jnp.asarray(batch.resreq), jnp.asarray(batch.init_resreq),
+            jnp.asarray(batch.valid), jnp.asarray(scores),
+            jnp.asarray(pred_mask),
+            jnp.asarray(min_available, jnp.int32),
+            jnp.asarray(init_allocated, jnp.int32))
+        decisions = np.asarray(decisions)
+        node_idx = np.asarray(node_idx)
+        self.idle, self.releasing, self.n_tasks = idle, releasing, n_tasks
+        update_solver_kernel_duration("allocate_scan",
+                                      time.perf_counter() - start)
+        out: List[Decision] = []
+        for i in range(len(batch.tasks)):
+            kind = int(decisions[i])
+            name = (self.state.names[int(node_idx[i])]
+                    if kind in (ALLOC, ALLOC_OB, PIPELINE) else "")
+            out.append(Decision(kind, name))
+        return out, bool(became_ready)

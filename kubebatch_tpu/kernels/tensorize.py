@@ -1,0 +1,123 @@
+"""Snapshot tensorization — ClusterInfo becomes dense device arrays.
+
+This is the layer with no reference counterpart: the per-entity structs of
+pkg/scheduler/api (Resource rows, NodeInfo accounting, TaskInfo requests)
+are projected onto fixed-shape float32/int32 arrays so the scheduling inner
+loops run as XLA programs on TPU. Axis conventions:
+
+- node axis: order of ``NodeState.names`` (padded to a pow2 bucket so jit
+  traces are reused across cycles; padded rows are masked invalid)
+- resource axis: [cpu_milli, mem_MiB, gpu_milli] (api.resource.RESOURCE_NAMES)
+
+The epsilon-fit rule on device is elementwise ``req <= avail + VEC_EPS``
+(strictly mirroring Resource.less_equal: ``r < R or |R - r| < eps`` equals
+``r < R + eps`` for the operands we produce, since requests and availability
+are finite floats).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..api import NodeInfo, TaskInfo
+from ..api.resource import RESOURCE_DIM, VEC_EPS
+
+__all__ = ["NodeState", "TaskBatch", "pad_to_bucket", "VEC_EPS"]
+
+
+def pad_to_bucket(n: int, minimum: int = 8) -> int:
+    """Next power-of-two bucket >= max(n, minimum) — keeps jit cache hits
+    across cycles while cluster size drifts."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class NodeState:
+    """Device-side mirror of the mutable node accounting.
+
+    Carried through assignment scans and updated functionally; the host
+    NodeInfo structs remain the source of truth between actions
+    (see kernels/solver.py sync discipline).
+    """
+    names: List[str]
+    #: [N,R] float32 arrays (MiB-scaled memory)
+    idle: np.ndarray
+    releasing: np.ndarray
+    backfilled: np.ndarray
+    allocatable: np.ndarray
+    #: [N] int32 / bool
+    max_task_num: np.ndarray
+    n_tasks: np.ndarray
+    schedulable: np.ndarray   # NOT unschedulable and real (non-padded) node
+    valid: np.ndarray         # non-padded row
+    index: Dict[str, int] = field(default_factory=dict)
+
+    @classmethod
+    def from_nodes(cls, nodes: Dict[str, NodeInfo],
+                   min_bucket: int = 8) -> "NodeState":
+        ordered = sorted(nodes.values(), key=lambda ni: ni.name)
+        n = len(ordered)
+        n_pad = pad_to_bucket(n, min_bucket)
+        shape = (n_pad, RESOURCE_DIM)
+        idle = np.zeros(shape, np.float32)
+        releasing = np.zeros(shape, np.float32)
+        backfilled = np.zeros(shape, np.float32)
+        allocatable = np.zeros(shape, np.float32)
+        max_task_num = np.zeros(n_pad, np.int32)
+        n_tasks = np.zeros(n_pad, np.int32)
+        schedulable = np.zeros(n_pad, bool)
+        valid = np.zeros(n_pad, bool)
+        index: Dict[str, int] = {}
+        for i, ni in enumerate(ordered):
+            idle[i] = ni.idle.to_vec()
+            releasing[i] = ni.releasing.to_vec()
+            backfilled[i] = ni.backfilled.to_vec()
+            allocatable[i] = ni.allocatable.to_vec()
+            max_task_num[i] = ni.allocatable.max_task_num
+            n_tasks[i] = len(ni.tasks)
+            unsched = bool(ni.node.unschedulable) if ni.node else True
+            schedulable[i] = not unsched
+            valid[i] = True
+            index[ni.name] = i
+        return cls(names=[ni.name for ni in ordered], idle=idle,
+                   releasing=releasing, backfilled=backfilled,
+                   allocatable=allocatable, max_task_num=max_task_num,
+                   n_tasks=n_tasks, schedulable=schedulable, valid=valid,
+                   index=index)
+
+    @property
+    def n_padded(self) -> int:
+        return self.idle.shape[0]
+
+
+@dataclass
+class TaskBatch:
+    """A job's pending tasks, in task-order, padded to a pow2 bucket."""
+    tasks: List[TaskInfo]
+    resreq: np.ndarray        # [T,R] steady-state request (node accounting)
+    init_resreq: np.ndarray   # [T,R] launch request (fit checks)
+    valid: np.ndarray         # [T] non-padded row
+
+    @classmethod
+    def from_tasks(cls, tasks: Sequence[TaskInfo],
+                   min_bucket: int = 8) -> "TaskBatch":
+        t = len(tasks)
+        t_pad = pad_to_bucket(t, min_bucket)
+        resreq = np.zeros((t_pad, RESOURCE_DIM), np.float32)
+        init_resreq = np.zeros((t_pad, RESOURCE_DIM), np.float32)
+        valid = np.zeros(t_pad, bool)
+        for i, task in enumerate(tasks):
+            resreq[i] = task.resreq.to_vec()
+            init_resreq[i] = task.init_resreq.to_vec()
+            valid[i] = True
+        return cls(tasks=list(tasks), resreq=resreq,
+                   init_resreq=init_resreq, valid=valid)
+
+    @property
+    def t_padded(self) -> int:
+        return self.resreq.shape[0]

@@ -1,0 +1,67 @@
+"""Plugin tensor terms — how plugins contribute to the device solve.
+
+A plugin may implement two optional vectorized hooks alongside its per-pair
+callbacks:
+
+    predicate_mask(ssn, device, batch) -> bool[T, N] | None
+    score_matrix(ssn, device, batch)  -> float32[T, N] | None
+
+The solver combines them with the same tier semantics as the host dispatch
+(AND for predicates, SUM for scores — session_plugins.go:331-370). A plugin
+that registered a per-pair fn but provides no tensor hook is still honored:
+its callback is evaluated pairwise on host into the matrix (correct but
+slow — all seven built-in plugins provide tensor hooks).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from .tensorize import TaskBatch
+
+
+def pred_and_score_matrices(ssn, device, batch: TaskBatch
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    t_pad, n_pad = batch.t_padded, device.n_padded
+    scores = np.zeros((t_pad, n_pad), np.float32)
+    pred = np.ones((t_pad, n_pad), bool)
+
+    real_nodes = [(device.node_index(name), node)
+                  for name, node in ssn.nodes.items()]
+
+    for tier in ssn.tiers:
+        for opt in tier.plugins:
+            plugin = ssn.plugins.get(opt.name)
+
+            if not opt.predicate_disabled and opt.name in ssn.predicate_fns:
+                mask = None
+                if plugin is not None and hasattr(plugin, "predicate_mask"):
+                    mask = plugin.predicate_mask(ssn, device, batch)
+                if mask is not None:
+                    pred &= mask
+                else:
+                    fn = ssn.predicate_fns[opt.name]
+                    for ti, task in enumerate(batch.tasks):
+                        for ni, node in real_nodes:
+                            if ni is None or not pred[ti, ni]:
+                                continue
+                            try:
+                                fn(task, node)
+                            except Exception:
+                                pred[ti, ni] = False
+
+            if not opt.node_order_disabled and opt.name in ssn.node_order_fns:
+                mat = None
+                if plugin is not None and hasattr(plugin, "score_matrix"):
+                    mat = plugin.score_matrix(ssn, device, batch)
+                if mat is not None:
+                    scores += mat
+                else:
+                    fn = ssn.node_order_fns[opt.name]
+                    for ti, task in enumerate(batch.tasks):
+                        for ni, node in real_nodes:
+                            if ni is not None:
+                                scores[ti, ni] += fn(task, node)
+
+    return scores, pred

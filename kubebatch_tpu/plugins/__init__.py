@@ -1,0 +1,12 @@
+"""Policy plugins (ref: pkg/scheduler/plugins).
+
+Importing this package registers all built-in plugin builders, mirroring
+the reference's blank-import self-registration (plugins/factory.go:253-263).
+"""
+from ..framework import register_plugin_builder
+from . import gang, priority
+
+register_plugin_builder(gang.NAME, gang.new)
+register_plugin_builder(priority.NAME, priority.new)
+
+__all__ = ["gang", "priority"]

@@ -68,13 +68,16 @@ class TestTierDispatch:
         out = ssn.preemptable(self._task(), [v1, v2])
         assert [x.uid for x in out] == [v1.uid]
 
-    def test_evictable_empty_list_is_decision(self):
-        # tier 1 returns empty (non-None) -> decision made, tier 2 ignored
-        ssn = self._session(tiers([t("a")], [t("b")]))
-        v1 = self._task("v1")
-        ssn.add_preemptable_fn("a", lambda e, ees: [])
-        ssn.add_preemptable_fn("b", lambda e, ees: [v1])
-        assert ssn.preemptable(self._task(), [v1]) == []
+    def test_evictable_empty_intersection_falls_through(self):
+        # Go semantics: an empty intersection is a nil slice -> next tier
+        # is consulted (session_plugins.go:99-102 with nil victims)
+        ssn = self._session(tiers([t("a"), t("a2")], [t("b")]))
+        v1, v2, v3 = (self._task(f"v{i}") for i in range(3))
+        ssn.add_preemptable_fn("a", lambda e, ees: [v1])
+        ssn.add_preemptable_fn("a2", lambda e, ees: [v2])  # disjoint
+        ssn.add_preemptable_fn("b", lambda e, ees: [v3])
+        out = ssn.preemptable(self._task(), [v1, v2, v3])
+        assert [x.uid for x in out] == [v3.uid]
 
     def test_evictable_none_falls_through(self):
         ssn = self._session(tiers([t("a")], [t("b")]))
