@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Benchmark driver — measures scheduling-cycle latency on the BASELINE.md
+configs and prints ONE JSON line.
+
+The reference publishes no numbers (BASELINE.md: "measured, not copied");
+`vs_baseline` is therefore reported against the north-star target of 15 ms
+p50 cycle latency at the stress config — vs_baseline > 1.0 means beating
+the target.
+
+Usage: python bench.py [--config N] [--cycles M] [--mode jax|host]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run_config(config: int, cycles: int, mode: str):
+    from kubebatch_tpu import actions, plugins  # noqa: F401
+    from kubebatch_tpu.actions.allocate import AllocateAction
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.conf import PluginOption, Tier
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.sim import baseline_cluster
+
+    tiers = [Tier(plugins=[PluginOption(name="priority"),
+                           PluginOption(name="gang")])]
+
+    latencies = []
+    bound_total = 0
+    bind_seconds = 0.0
+    for cycle in range(cycles):
+        sim = baseline_cluster(config)
+        binds = {}
+
+        class _B:
+            def bind(self, pod, hostname):
+                binds[pod.uid] = hostname
+                pod.node_name = hostname
+
+        cache = SchedulerCache(binder=_B(), async_writeback=False)
+        sim.populate(cache)
+        t0 = time.perf_counter()
+        ssn = OpenSession(cache, tiers)
+        AllocateAction(mode=mode).execute(ssn)
+        CloseSession(ssn)
+        dt = time.perf_counter() - t0
+        if cycle > 0 or cycles == 1:   # first cycle pays jit compile
+            latencies.append(dt)
+            bound_total += len(binds)
+            bind_seconds += dt
+    return latencies, bound_total, bind_seconds
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=2, choices=[1, 2, 3, 4, 5],
+                    help="BASELINE config number")
+    ap.add_argument("--cycles", type=int, default=4)
+    ap.add_argument("--mode", default="jax", choices=["jax", "host"])
+    args = ap.parse_args(argv)
+
+    latencies, bound, seconds = run_config(args.config, args.cycles,
+                                           args.mode)
+    p50_ms = float(np.percentile(latencies, 50) * 1e3)
+    p95_ms = float(np.percentile(latencies, 95) * 1e3)
+    pods_per_sec = bound / seconds if seconds > 0 else 0.0
+    north_star_ms = 15.0
+    print(json.dumps({
+        "metric": f"sched_cycle_p50_ms_cfg{args.config}",
+        "value": round(p50_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(north_star_ms / p50_ms, 4) if p50_ms else 0.0,
+        "p95_ms": round(p95_ms, 3),
+        "pods_bound_per_sec": round(pods_per_sec, 1),
+        "pods_bound_per_cycle": bound // max(1, len(latencies)),
+        "mode": args.mode,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

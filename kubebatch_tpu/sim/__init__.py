@@ -1,0 +1,6 @@
+"""Synthetic cluster generation + simulated e2e harness."""
+from .cluster import (BASELINE_SPECS, ClusterSpec, SimCluster,
+                      baseline_cluster, build_cluster)
+
+__all__ = ["BASELINE_SPECS", "ClusterSpec", "SimCluster", "baseline_cluster",
+           "build_cluster"]

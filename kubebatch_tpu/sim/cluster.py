@@ -1,0 +1,161 @@
+"""Synthetic cluster generation — the simulated e2e substrate.
+
+Plays the role the reference's kubemark/DIND harness plays (SURVEY.md
+sect. 4 tier 3) without needing a real k8s cluster: deterministic
+generators for nodes, queues, PodGroups and pods sized to the BASELINE.md
+benchmark configs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..cache import SchedulerCache
+from ..objects import (Node, Pod, PodGroup, PodPhase, PriorityClass, Queue,
+                       Container, GROUP_NAME_ANNOTATION, resource_list)
+
+GiB = 1024 ** 3
+
+
+@dataclass
+class ClusterSpec:
+    n_nodes: int = 50
+    node_cpu_millis: int = 8000
+    node_mem_bytes: float = 16 * GiB
+    node_pods: int = 110
+    n_groups: int = 100
+    pods_per_group: int = 8
+    min_member: Optional[int] = None     # default: pods_per_group (full gang)
+    pod_cpu_millis: int = 1000
+    pod_mem_bytes: float = 2 * GiB
+    n_queues: int = 1
+    queue_weights: Tuple[int, ...] = ()
+    priority_classes: Tuple[Tuple[str, int], ...] = ()
+    #: fraction of cluster pre-filled with running pods
+    running_fill: float = 0.0
+    seed: int = 0
+    jitter: float = 0.0                  # relative size jitter on requests
+
+
+@dataclass
+class SimCluster:
+    spec: ClusterSpec
+    nodes: List[Node] = field(default_factory=list)
+    queues: List[Queue] = field(default_factory=list)
+    groups: List[PodGroup] = field(default_factory=list)
+    pods: List[Pod] = field(default_factory=list)
+    priority_classes: List[PriorityClass] = field(default_factory=list)
+
+    def populate(self, cache: SchedulerCache) -> None:
+        for q in self.queues:
+            cache.add_queue(q)
+        for pc in self.priority_classes:
+            cache.add_priority_class(pc)
+        for n in self.nodes:
+            cache.add_node(n)
+        for g in self.groups:
+            cache.add_pod_group(g)
+        for p in self.pods:
+            cache.add_pod(p)
+
+    def pod_lister(self, ns: str, name: str) -> Optional[Pod]:
+        for p in self.pods:
+            if p.namespace == ns and p.name == name:
+                return p
+        return None
+
+
+def build_cluster(spec: ClusterSpec) -> SimCluster:
+    rng = np.random.default_rng(spec.seed)
+    sim = SimCluster(spec)
+
+    n_queues = max(1, spec.n_queues)
+    weights = (spec.queue_weights if spec.queue_weights
+               else tuple([1] * n_queues))
+    for i in range(n_queues):
+        sim.queues.append(Queue(name=f"q{i + 1}", weight=weights[i]))
+    for name, value in spec.priority_classes:
+        sim.priority_classes.append(PriorityClass(name=name, value=value))
+
+    def _jit(v: float) -> float:
+        if spec.jitter <= 0:
+            return v
+        return float(v * (1.0 + rng.uniform(-spec.jitter, spec.jitter)))
+
+    for i in range(spec.n_nodes):
+        alloc = resource_list(cpu=_jit(spec.node_cpu_millis),
+                              memory=_jit(spec.node_mem_bytes),
+                              pods=spec.node_pods)
+        sim.nodes.append(Node(name=f"node-{i:05d}", allocatable=alloc))
+
+    pc_names = [name for name, _ in spec.priority_classes]
+    min_member = (spec.min_member if spec.min_member is not None
+                  else spec.pods_per_group)
+    for g in range(spec.n_groups):
+        queue = sim.queues[g % n_queues].name
+        pg = PodGroup(name=f"job-{g:05d}", namespace="sim",
+                      min_member=min_member, queue=queue,
+                      creation_timestamp=float(g))
+        if pc_names:
+            pg.priority_class_name = pc_names[g % len(pc_names)]
+        sim.groups.append(pg)
+        for p in range(spec.pods_per_group):
+            pod = Pod(
+                name=f"job-{g:05d}-{p:03d}", namespace="sim",
+                annotations={GROUP_NAME_ANNOTATION: pg.name},
+                containers=[Container(requests=resource_list(
+                    cpu=_jit(spec.pod_cpu_millis),
+                    memory=_jit(spec.pod_mem_bytes)))],
+                creation_timestamp=float(g * 10000 + p))
+            sim.pods.append(pod)
+
+    # pre-fill part of the cluster with running pods (for preempt/reclaim
+    # scenarios): round-robin placement until the fill fraction is reached
+    if spec.running_fill > 0:
+        budget = spec.running_fill * spec.n_nodes * spec.node_cpu_millis
+        used = 0.0
+        i = 0
+        while used + spec.pod_cpu_millis <= budget:
+            node = sim.nodes[i % spec.n_nodes]
+            pg_name = f"fill-{i:05d}"
+            sim.groups.append(PodGroup(
+                name=pg_name, namespace="sim", min_member=1,
+                queue=sim.queues[i % n_queues].name,
+                creation_timestamp=-1.0))
+            sim.pods.append(Pod(
+                name=f"fill-{i:05d}", namespace="sim",
+                node_name=node.name, phase=PodPhase.RUNNING,
+                annotations={GROUP_NAME_ANNOTATION: pg_name},
+                containers=[Container(requests=resource_list(
+                    cpu=spec.pod_cpu_millis,
+                    memory=spec.pod_mem_bytes))]))
+            used += spec.pod_cpu_millis
+            i += 1
+    return sim
+
+
+#: BASELINE.md benchmark configs (sect. "Metrics to measure")
+BASELINE_SPECS: Dict[int, ClusterSpec] = {
+    1: ClusterSpec(n_nodes=1, node_cpu_millis=8000, node_mem_bytes=16 * GiB,
+                   n_groups=1, pods_per_group=3, pod_cpu_millis=1000,
+                   pod_mem_bytes=GiB),
+    2: ClusterSpec(n_nodes=50, n_groups=100, pods_per_group=8),
+    3: ClusterSpec(n_nodes=500, n_groups=1000, pods_per_group=4,
+                   n_queues=4, queue_weights=(1, 2, 3, 4),
+                   pod_cpu_millis=800, pod_mem_bytes=GiB),
+    4: ClusterSpec(n_nodes=2000, n_groups=625, pods_per_group=8,
+                   min_member=4, running_fill=0.6,
+                   priority_classes=(("low", 10), ("mid", 100),
+                                     ("high", 1000)),
+                   pod_cpu_millis=1000, pod_mem_bytes=2 * GiB),
+    5: ClusterSpec(n_nodes=5000, n_groups=1250, pods_per_group=8,
+                   n_queues=4, queue_weights=(1, 2, 3, 4),
+                   pod_cpu_millis=1000, pod_mem_bytes=2 * GiB,
+                   jitter=0.2),
+}
+
+
+def baseline_cluster(config: int) -> SimCluster:
+    return build_cluster(BASELINE_SPECS[config])

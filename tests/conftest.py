@@ -1,9 +1,13 @@
 """Test configuration: run JAX on a virtual 8-device CPU mesh.
 
-Must set the env vars before jax is imported anywhere, so this lives at the
-top of conftest. The real TPU path is exercised by bench.py and
-__graft_entry__.py; unit/integration tests validate semantics and sharding
-on host devices.
+The environment preloads jax via sitecustomize and pins the experimental
+'axon' TPU platform, so env vars alone don't take effect — jax is already
+in sys.modules when pytest starts. jax.config.update('jax_platforms')
+still works as long as no computation has run, and XLA_FLAGS is read when
+the CPU client is first created, so both overrides below are applied
+before any backend initialization. Unit/integration tests validate
+semantics and sharding on host devices; bench.py and __graft_entry__.py
+exercise the real TPU.
 """
 import os
 
@@ -12,3 +16,11 @@ xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the host CPU backend, got "
+    f"{jax.devices()[0].platform!r}")
+assert len(jax.devices()) >= 8, "expected an 8-device virtual CPU mesh"
