@@ -1,15 +1,20 @@
 """allocate — the primary scheduling action.
 
+Three solver modes (KUBEBATCH_SOLVER env or constructor arg):
+- "fused" (default): the whole cycle in ONE device dispatch
+  (kernels/fused.py) — queue/job/task selection and fairness state live
+  in-kernel; host replays the decisions through Session.allocate/pipeline
+  so plugins and the gang barrier observe identical events.
+- "jax": one device scan per job visit (kernels/solver.py) — more
+  dispatches, used when the configured plugins fall outside the fused
+  kernel's key vocabulary.
+- "host": the reference-literal per-pair loops — the semantic oracle.
+
+
 ref: pkg/scheduler/actions/allocate/allocate.go. Control flow is preserved
 exactly (queue PQ with one entry per job, overused queues dropped, one job
 per queue visit, job re-pushed only when it crosses readiness, job dropped
-on first unassignable task, queue re-pushed after every visit). What
-changes is the inner loop: instead of per-(task,node) predicate/score
-callbacks, the whole job visit is solved by ONE jitted scan on TPU
-(kernels/solver.py) that returns a decision per task.
-
-``mode="host"`` runs the reference's literal per-pair loops through the
-session callbacks — the semantic oracle the kernel is tested against.
+on first unassignable task, queue re-pushed after every visit).
 """
 from __future__ import annotations
 
@@ -56,9 +61,19 @@ class AllocateAction(Action):
 
     @property
     def mode(self) -> str:
-        return self._mode or os.environ.get("KUBEBATCH_SOLVER", "jax")
+        return self._mode or os.environ.get("KUBEBATCH_SOLVER", "fused")
 
     def execute(self, ssn: Session) -> None:
+        if self.mode == "fused":
+            from .allocate_fused import execute_fused, fused_supported
+            if fused_supported(ssn):
+                execute_fused(ssn)
+                return
+            # configured plugins exceed the fused key vocabulary; fall back
+            # to the per-visit device solver
+        self._execute_queued(ssn)
+
+    def _execute_queued(self, ssn: Session) -> None:
         queues = PriorityQueue(ssn.queue_order_fn)
         jobs_map: Dict[str, PriorityQueue] = {}
         for job in ssn.jobs.values():
@@ -72,7 +87,7 @@ class AllocateAction(Action):
 
         pending_tasks: Dict[str, PriorityQueue] = {}
         device: Optional[DeviceSession] = None
-        if self.mode == "jax":
+        if self.mode in ("jax", "fused"):
             if ssn.device_snapshot is None:
                 ssn.device_snapshot = DeviceSession(ssn.nodes)
             device = ssn.device_snapshot

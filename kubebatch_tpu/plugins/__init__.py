@@ -4,9 +4,11 @@ Importing this package registers all built-in plugin builders, mirroring
 the reference's blank-import self-registration (plugins/factory.go:253-263).
 """
 from ..framework import register_plugin_builder
-from . import gang, priority
+from . import drf, gang, priority, proportion
 
 register_plugin_builder(gang.NAME, gang.new)
 register_plugin_builder(priority.NAME, priority.new)
+register_plugin_builder(drf.NAME, drf.new)
+register_plugin_builder(proportion.NAME, proportion.new)
 
-__all__ = ["gang", "priority"]
+__all__ = ["drf", "gang", "priority", "proportion"]

@@ -1,0 +1,163 @@
+"""proportion — weighted proportional fairness across queues.
+
+ref: pkg/scheduler/plugins/proportion/proportion.go. The iterative
+weighted water-filling of per-queue ``deserved`` is reproduced exactly,
+including the reference's cumulative ``remaining`` bookkeeping (remaining
+is decremented by each round's TOTAL deserved sum, going negative on the
+final round — the negative value only feeds the is_empty termination
+check, proportion.go:100-142).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..api import (QueueInfo, Resource, TaskInfo, allocated_status, res_min,
+                   resource_names, share)
+from ..api.types import TaskStatus
+from ..framework import EventHandler, Plugin, Session
+
+NAME = "proportion"
+
+
+class QueueAttr:
+    __slots__ = ("queue_id", "name", "weight", "share", "deserved",
+                 "allocated", "request")
+
+    def __init__(self, queue: QueueInfo):
+        self.queue_id = queue.uid
+        self.name = queue.name
+        self.weight = queue.weight
+        self.share = 0.0
+        self.deserved = Resource.empty()
+        self.allocated = Resource.empty()
+        self.request = Resource.empty()
+
+
+class ProportionPlugin(Plugin):
+    def __init__(self, arguments=None):
+        self.arguments = arguments or {}
+        self.total_resource = Resource.empty()
+        self.queue_opts: Dict[str, QueueAttr] = {}
+
+    @property
+    def name(self) -> str:
+        return NAME
+
+    def _update_share(self, attr: QueueAttr) -> None:
+        """share = max over resources of allocated/deserved
+        (ref: proportion.go:229-241)."""
+        attr.share = max(
+            (share(attr.allocated.get(rn), attr.deserved.get(rn))
+             for rn in resource_names()), default=0.0)
+
+    def on_session_open(self, ssn: Session) -> None:
+        for node in ssn.nodes.values():
+            self.total_resource.add(node.allocatable)
+
+        # queue attributes only for queues that have jobs
+        # (ref: proportion.go:66-98)
+        for job in ssn.jobs.values():
+            if job.queue not in self.queue_opts:
+                queue = ssn.queues.get(job.queue)
+                if queue is None:
+                    continue
+                self.queue_opts[job.queue] = QueueAttr(queue)
+            attr = self.queue_opts[job.queue]
+            for status, tasks in job.task_status_index.items():
+                if allocated_status(status):
+                    for t in tasks.values():
+                        attr.allocated.add(t.resreq)
+                        attr.request.add(t.resreq)
+                elif status == TaskStatus.PENDING:
+                    for t in tasks.values():
+                        attr.request.add(t.resreq)
+
+        # weighted water-filling (ref: proportion.go:100-142, quirks intact)
+        remaining = self.total_resource.clone()
+        met = set()
+        while True:
+            total_weight = sum(a.weight for a in self.queue_opts.values()
+                               if a.queue_id not in met)
+            if total_weight == 0:
+                break
+            deserved_sum = Resource.empty()
+            for attr in self.queue_opts.values():
+                if attr.queue_id in met:
+                    continue
+                attr.deserved.add(
+                    remaining.clone().multi(attr.weight / total_weight))
+                if not attr.deserved.less_equal(attr.request):
+                    attr.deserved = res_min(attr.deserved, attr.request)
+                    met.add(attr.queue_id)
+                self._update_share(attr)
+                deserved_sum.add(attr.deserved)
+            remaining.sub(deserved_sum)
+            if remaining.is_empty():
+                break
+
+        def queue_order_fn(l: QueueInfo, r: QueueInfo) -> int:
+            ls = self.queue_opts[l.uid].share
+            rs = self.queue_opts[r.uid].share
+            if ls == rs:
+                return 0
+            return -1 if ls < rs else 1
+
+        ssn.add_queue_order_fn(NAME, queue_order_fn)
+
+        def reclaimable_fn(reclaimer: TaskInfo,
+                           reclaimees: List[TaskInfo]) -> List[TaskInfo]:
+            """Victim allowed iff its queue stays at/above deserved after
+            losing it (ref: proportion.go:159-184)."""
+            victims = []
+            allocations: Dict[str, Resource] = {}
+            for reclaimee in reclaimees:
+                job = ssn.jobs.get(reclaimee.job)
+                if job is None or job.queue not in self.queue_opts:
+                    continue
+                attr = self.queue_opts[job.queue]
+                if job.queue not in allocations:
+                    allocations[job.queue] = attr.allocated.clone()
+                allocated = allocations[job.queue]
+                if allocated.less(reclaimee.resreq):
+                    continue
+                allocated.sub(reclaimee.resreq)
+                if attr.deserved.less_equal(allocated):
+                    victims.append(reclaimee)
+            return victims
+
+        ssn.add_reclaimable_fn(NAME, reclaimable_fn)
+
+        def overused_fn(queue: QueueInfo) -> bool:
+            attr = self.queue_opts.get(queue.uid)
+            if attr is None:
+                return False
+            return attr.deserved.less_equal(attr.allocated)
+
+        ssn.add_overused_fn(NAME, overused_fn)
+
+        def on_allocate(event):
+            job = ssn.jobs.get(event.task.job)
+            if job is None or job.queue not in self.queue_opts:
+                return
+            attr = self.queue_opts[job.queue]
+            attr.allocated.add(event.task.resreq)
+            self._update_share(attr)
+
+        def on_deallocate(event):
+            job = ssn.jobs.get(event.task.job)
+            if job is None or job.queue not in self.queue_opts:
+                return
+            attr = self.queue_opts[job.queue]
+            attr.allocated.sub(event.task.resreq)
+            self._update_share(attr)
+
+        ssn.add_event_handler(EventHandler(allocate_func=on_allocate,
+                                           deallocate_func=on_deallocate))
+
+    def on_session_close(self, ssn: Session) -> None:
+        self.total_resource = Resource.empty()
+        self.queue_opts = {}
+
+
+def new(arguments=None) -> ProportionPlugin:
+    return ProportionPlugin(arguments)

@@ -17,7 +17,7 @@ from kubebatch_tpu.objects import PodGroupPhase, PodPhase
 
 from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
 
-MODES = ["host", "jax"]
+MODES = ["host", "jax", "fused"]
 
 
 class RecordingBinder:
