@@ -86,8 +86,14 @@ class AllocateAction(Action):
             jobs_map[job.queue].push(job)
 
         pending_tasks: Dict[str, PriorityQueue] = {}
+        # predicate/node-order callbacks read session state that mutates
+        # DURING a visit (anti-affinity vs a just-assigned task,
+        # least-requested vs fresh usage); the batched scan evaluates them
+        # once per visit, so such sessions take the host path until the
+        # in-kernel affinity/usage carries land
+        stateful = bool(ssn.predicate_fns or ssn.node_order_fns)
         device: Optional[DeviceSession] = None
-        if self.mode in ("jax", "fused"):
+        if self.mode in ("jax", "fused") and not stateful:
             if ssn.device_snapshot is None:
                 ssn.device_snapshot = DeviceSession(ssn.nodes)
             device = ssn.device_snapshot

@@ -1,0 +1,280 @@
+"""predicates + nodeorder plugin scenarios
+(ref: test/e2e/predicates.go:29-193, test/e2e/nodeorder.go:29-175)."""
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import (Affinity, MatchExpression, NodeAffinity,
+                                   NodeSelectorTerm, PodAffinityTerm,
+                                   PodPhase, Taint, TaintEffect, Toleration)
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+# with predicate/node-order fns installed every solver mode routes to the
+# host path (allocate.py stateful gate); "jax" here only verifies that
+# routing — the full matrix runs once via "host"
+MODES = ["host"]
+ROUTING_MODES = ["jax", "fused"]
+
+
+def full_tiers(nodeorder_args=None):
+    return [Tier(plugins=[PluginOption(name="priority"),
+                          PluginOption(name="gang"),
+                          PluginOption(name="conformance")]),
+            Tier(plugins=[PluginOption(name="drf"),
+                          PluginOption(name="predicates"),
+                          PluginOption(name="proportion"),
+                          PluginOption(name="nodeorder",
+                                       arguments=nodeorder_args or {})])]
+
+
+class RecordingBinder:
+    def __init__(self):
+        self.binds = {}
+
+    def bind(self, pod, hostname):
+        self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+        pod.node_name = hostname
+
+
+def run(nodes, groups, pods, mode, queues=("q1",), tiers=None):
+    binder = RecordingBinder()
+    cache = SchedulerCache(binder=binder, async_writeback=False)
+    for q in queues:
+        cache.add_queue(build_queue(q))
+    for n in nodes:
+        cache.add_node(n)
+    for g in groups:
+        cache.add_pod_group(g)
+    for p in pods:
+        cache.add_pod(p)
+    ssn = OpenSession(cache, tiers if tiers is not None else full_tiers())
+    AllocateAction(mode=mode).execute(ssn)
+    CloseSession(ssn)
+    cache.drain(timeout=5.0)
+    return binder.binds, cache
+
+
+@pytest.mark.parametrize("mode", MODES)
+class TestPredicates:
+    def test_node_selector(self, mode):
+        pod = build_pod("ns", "p", "", PodPhase.PENDING, rl(1000, GiB),
+                        group="g")
+        pod.node_selector = {"disk": "ssd"}
+        binds, _ = run(
+            [build_node("n-hdd", rl(8000, 16 * GiB, pods=110),
+                        labels={"disk": "hdd"}),
+             build_node("n-ssd", rl(8000, 16 * GiB, pods=110),
+                        labels={"disk": "ssd"})],
+            [build_group("ns", "g", 1, queue="q1")], [pod], mode)
+        assert binds == {"ns/p": "n-ssd"}
+
+    def test_required_node_affinity(self, mode):
+        # ref: test/e2e/predicates.go NodeAffinity case
+        pod = build_pod("ns", "p", "", PodPhase.PENDING, rl(1000, GiB),
+                        group="g")
+        pod.affinity = Affinity(node_affinity=NodeAffinity(required=[
+            NodeSelectorTerm([MatchExpression("zone", "In", ["east"])])]))
+        binds, _ = run(
+            [build_node("n-west", rl(8000, 16 * GiB, pods=110),
+                        labels={"zone": "west"}),
+             build_node("n-east", rl(8000, 16 * GiB, pods=110),
+                        labels={"zone": "east"})],
+            [build_group("ns", "g", 1, queue="q1")], [pod], mode)
+        assert binds == {"ns/p": "n-east"}
+
+    def test_host_ports_conflict(self, mode):
+        # ref: test/e2e/predicates.go Hostport case
+        occupying = build_pod("ns", "old", "n1", PodPhase.RUNNING,
+                              rl(100, GiB), group="gold", ports=[8080])
+        newpod = build_pod("ns", "new", "", PodPhase.PENDING, rl(100, GiB),
+                           group="g", ports=[8080])
+        binds, _ = run(
+            [build_node("n1", rl(8000, 16 * GiB, pods=110)),
+             build_node("n2", rl(8000, 16 * GiB, pods=110))],
+            [build_group("ns", "gold", 1, queue="q1"),
+             build_group("ns", "g", 1, queue="q1")],
+            [occupying, newpod], mode)
+        assert binds == {"ns/new": "n2"}
+
+    def test_taints_block_untolerated(self, mode):
+        # ref: test/e2e/predicates.go Taints/Tolerations
+        pod = build_pod("ns", "p", "", PodPhase.PENDING, rl(1000, GiB),
+                        group="g")
+        binds, cache = run(
+            [build_node("n-tainted", rl(8000, 16 * GiB, pods=110),
+                        taints=[Taint("dedicated", "gpu",
+                                      TaintEffect.NO_SCHEDULE)])],
+            [build_group("ns", "g", 1, queue="q1")], [pod], mode)
+        assert binds == {}
+        # tolerated -> schedules
+        pod2 = build_pod("ns", "p2", "", PodPhase.PENDING, rl(1000, GiB),
+                         group="g2")
+        pod2.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                       value="gpu", effect="NoSchedule")]
+        binds2, _ = run(
+            [build_node("n-tainted", rl(8000, 16 * GiB, pods=110),
+                        taints=[Taint("dedicated", "gpu",
+                                      TaintEffect.NO_SCHEDULE)])],
+            [build_group("ns", "g2", 1, queue="q1")], [pod2], mode)
+        assert binds2 == {"ns/p2": "n-tainted"}
+
+    def test_pod_anti_affinity_spreads(self, mode):
+        # two pods with required anti-affinity on app=web land on
+        # different nodes
+        pods = []
+        for i in range(2):
+            p = build_pod("ns", f"w{i}", "", PodPhase.PENDING, rl(1000, GiB),
+                          group="g", labels={"app": "web"})
+            p.affinity = Affinity(pod_anti_affinity_required=[
+                PodAffinityTerm(match_labels={"app": "web"})])
+            pods.append(p)
+        binds, _ = run(
+            [build_node("n1", rl(8000, 16 * GiB, pods=110)),
+             build_node("n2", rl(8000, 16 * GiB, pods=110))],
+            [build_group("ns", "g", 2, queue="q1")], pods, mode)
+        assert len(binds) == 2
+        assert binds["ns/w0"] != binds["ns/w1"]
+
+    def test_pod_affinity_colocates(self, mode):
+        # ref: test/e2e/predicates.go Pod Affinity: follower must land on
+        # the leader's node; first pod allowed via self-match special case
+        leader = build_pod("ns", "leader", "", PodPhase.PENDING,
+                           rl(1000, GiB), group="g",
+                           labels={"role": "db"},
+                           creation_timestamp=1.0)
+        follower = build_pod("ns", "follower", "", PodPhase.PENDING,
+                             rl(1000, GiB), group="g",
+                             creation_timestamp=2.0)
+        follower.affinity = Affinity(pod_affinity_required=[
+            PodAffinityTerm(match_labels={"role": "db"})])
+        binds, _ = run(
+            [build_node("n1", rl(8000, 16 * GiB, pods=110)),
+             build_node("n2", rl(8000, 16 * GiB, pods=110))],
+            [build_group("ns", "g", 2, queue="q1")], [leader, follower],
+            mode)
+        assert len(binds) == 2
+        assert binds["ns/leader"] == binds["ns/follower"]
+
+    def test_max_task_num(self, mode):
+        pod = build_pod("ns", "p", "", PodPhase.PENDING, rl(100, GiB),
+                        group="g")
+        binds, _ = run(
+            [build_node("full", rl(8000, 16 * GiB, pods=1)),
+             build_node("free", rl(8000, 16 * GiB, pods=110))],
+            [build_group("ns", "gold", 1, queue="q1"),
+             build_group("ns", "g", 1, queue="q1")],
+            [build_pod("ns", "old", "full", PodPhase.RUNNING, rl(100, GiB),
+                       group="gold"),
+             pod], mode)
+        assert binds["ns/p"] == "free"
+
+
+@pytest.mark.parametrize("mode", ROUTING_MODES)
+def test_stateful_plugins_route_to_host_path(mode):
+    # anti-affinity needs per-assignment state: the device modes must fall
+    # back and still produce the spread placement
+    pods = []
+    for i in range(2):
+        p = build_pod("ns", f"w{i}", "", PodPhase.PENDING, rl(1000, GiB),
+                      group="g", labels={"app": "web"})
+        p.affinity = Affinity(pod_anti_affinity_required=[
+            PodAffinityTerm(match_labels={"app": "web"})])
+        pods.append(p)
+    binds, _ = run(
+        [build_node("n1", rl(8000, 16 * GiB, pods=110)),
+         build_node("n2", rl(8000, 16 * GiB, pods=110))],
+        [build_group("ns", "g", 2, queue="q1")], pods, mode)
+    assert len(binds) == 2
+    assert binds["ns/w0"] != binds["ns/w1"]
+
+
+def test_missing_topology_key_never_matches():
+    # upstream semantics: a node lacking the topology label is in NO
+    # domain; anti-affinity with topology_key='zone' on unlabeled nodes
+    # must not reject cluster-wide
+    pods = []
+    for i in range(2):
+        p = build_pod("ns", f"w{i}", "", PodPhase.PENDING, rl(1000, GiB),
+                      group="g", labels={"app": "web"})
+        p.affinity = Affinity(pod_anti_affinity_required=[
+            PodAffinityTerm(match_labels={"app": "web"},
+                            topology_key="zone")])
+        pods.append(p)
+    binds, _ = run(
+        [build_node("n1", rl(8000, 16 * GiB, pods=110)),
+         build_node("n2", rl(8000, 16 * GiB, pods=110))],
+        [build_group("ns", "g", 2, queue="q1")], pods, "host")
+    assert len(binds) == 2
+
+
+@pytest.mark.parametrize("mode", MODES)
+class TestNodeOrder:
+    def test_least_requested_prefers_empty_node(self, mode):
+        # ref: test/e2e/nodeorder.go least-requested: new pod goes to the
+        # less loaded node
+        busy_pod = build_pod("ns", "busy", "n1", PodPhase.RUNNING,
+                             rl(4000, 8 * GiB), group="gb")
+        pod = build_pod("ns", "p", "", PodPhase.PENDING, rl(1000, GiB),
+                        group="g")
+        binds, _ = run(
+            [build_node("n1", rl(8000, 16 * GiB, pods=110)),
+             build_node("n2", rl(8000, 16 * GiB, pods=110))],
+            [build_group("ns", "gb", 1, queue="q1"),
+             build_group("ns", "g", 1, queue="q1")],
+            [busy_pod, pod], mode)
+        assert binds["ns/p"] == "n2"
+
+    def test_preferred_node_affinity_wins(self, mode):
+        pod = build_pod("ns", "p", "", PodPhase.PENDING, rl(1000, GiB),
+                        group="g")
+        pod.affinity = Affinity(node_affinity=NodeAffinity(preferred=[
+            (50, NodeSelectorTerm([MatchExpression("zone", "In",
+                                                   ["east"])]))]))
+        binds, _ = run(
+            [build_node("n-west", rl(8000, 16 * GiB, pods=110),
+                        labels={"zone": "west"}),
+             build_node("n-east", rl(8000, 16 * GiB, pods=110),
+                        labels={"zone": "east"})],
+            [build_group("ns", "g", 1, queue="q1")], [pod], mode)
+        assert binds == {"ns/p": "n-east"}
+
+    def test_preferred_pod_affinity_colocates(self, mode):
+        # ref: test/e2e/nodeorder.go pod affinity: soft affinity pulls the
+        # new pod next to the running one
+        anchor = build_pod("ns", "anchor", "n2", PodPhase.RUNNING,
+                           rl(100, GiB), group="ga",
+                           labels={"app": "cache"})
+        pod = build_pod("ns", "p", "", PodPhase.PENDING, rl(100, GiB),
+                        group="g")
+        pod.affinity = Affinity(pod_affinity_preferred=[
+            (100, PodAffinityTerm(match_labels={"app": "cache"}))])
+        binds, _ = run(
+            [build_node("n1", rl(8000, 16 * GiB, pods=110)),
+             build_node("n2", rl(8000, 16 * GiB, pods=110))],
+            [build_group("ns", "ga", 1, queue="q1"),
+             build_group("ns", "g", 1, queue="q1")],
+            [anchor, pod], mode)
+        assert binds["ns/p"] == "n2"
+
+    def test_weight_arguments_respected(self, mode):
+        # crank podaffinity weight so it dominates least-requested
+        anchor = build_pod("ns", "anchor", "n-busy", PodPhase.RUNNING,
+                           rl(6000, 12 * GiB), group="ga",
+                           labels={"app": "cache"})
+        pod = build_pod("ns", "p", "", PodPhase.PENDING, rl(100, GiB),
+                        group="g")
+        pod.affinity = Affinity(pod_affinity_preferred=[
+            (100, PodAffinityTerm(match_labels={"app": "cache"}))])
+        tiers = full_tiers(nodeorder_args={"podaffinity.weight": "10",
+                                           "leastrequested.weight": "1"})
+        binds, _ = run(
+            [build_node("n-busy", rl(8000, 16 * GiB, pods=110)),
+             build_node("n-free", rl(8000, 16 * GiB, pods=110))],
+            [build_group("ns", "ga", 1, queue="q1"),
+             build_group("ns", "g", 1, queue="q1")],
+            [anchor, pod], mode, tiers=tiers)
+        assert binds["ns/p"] == "n-busy"
