@@ -47,8 +47,11 @@ def _effective_min_available(ssn: Session, job: JobInfo) -> int:
 
 
 def _init_allocated(job: JobInfo) -> int:
+    """Initial ready-task count for the kernels' in-scan readiness — gang's
+    pipelined-inclusive definition (plugins/gang.py ready_task_num)."""
     return job.count(TaskStatus.BOUND, TaskStatus.BINDING, TaskStatus.RUNNING,
-                     TaskStatus.ALLOCATED)
+                     TaskStatus.ALLOCATED, TaskStatus.SUCCEEDED,
+                     TaskStatus.PIPELINED)
 
 
 class AllocateAction(Action):

@@ -1,0 +1,103 @@
+"""reclaim — cross-queue resource recovery toward weighted fair share.
+
+ref: pkg/scheduler/actions/reclaim/reclaim.go. Victims are Running tasks
+of jobs in OTHER queues; evictions go straight through the session (no
+Statement — reclaim.go:159-173); the reclaimer is pipelined onto the node
+once enough resource is being released.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..api import Resource, TaskStatus
+from ..framework import Action, Session, register_action
+from ..util import PriorityQueue
+from .preempt import validate_victims
+
+
+class ReclaimAction(Action):
+    @property
+    def name(self) -> str:
+        return "reclaim"
+
+    def execute(self, ssn: Session) -> None:
+        queues = PriorityQueue(ssn.queue_order_fn)
+        queue_map = {}
+        preemptors_map: Dict[str, PriorityQueue] = {}
+        preemptor_tasks: Dict[str, PriorityQueue] = {}
+
+        for job in ssn.jobs.values():
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            if queue.uid not in queue_map:
+                queue_map[queue.uid] = queue
+                queues.push(queue)
+            if job.count(TaskStatus.PENDING) != 0:
+                preemptors_map.setdefault(
+                    job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
+                tasks = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index.get(TaskStatus.PENDING,
+                                                      {}).values():
+                    tasks.push(task)
+                preemptor_tasks[job.uid] = tasks
+
+        while not queues.empty():
+            queue = queues.pop()
+            if ssn.overused(queue):
+                continue
+            jobs = preemptors_map.get(queue.uid)
+            if jobs is None or jobs.empty():
+                continue
+            job = jobs.pop()
+            tasks = preemptor_tasks.get(job.uid)
+            if tasks is None or tasks.empty():
+                continue
+            task = tasks.pop()
+
+            assigned = False
+            for node in ssn.nodes.values():
+                try:
+                    ssn.predicate_fn(task, node)
+                except Exception:
+                    continue
+
+                resreq = task.init_resreq.clone()
+                reclaimed = Resource.empty()
+                reclaimees = []
+                for t in node.tasks.values():
+                    if t.status != TaskStatus.RUNNING:
+                        continue
+                    j = ssn.jobs.get(t.job)
+                    if j is not None and j.queue != job.queue:
+                        # clone so session status flips don't corrupt the
+                        # node's accounting (reclaim.go:137)
+                        reclaimees.append(t.clone())
+                victims = ssn.reclaimable(task, reclaimees)
+                if not validate_victims(victims, resreq):
+                    continue
+
+                for reclaimee in victims:
+                    try:
+                        ssn.evict(reclaimee, "reclaim")
+                    except Exception:
+                        continue
+                    reclaimed.add(reclaimee.resreq)
+                    if resreq.less_equal(reclaimee.resreq):
+                        break
+                    resreq.sub(reclaimee.resreq)
+
+                if task.init_resreq.less_equal(reclaimed):
+                    ssn.pipeline(task, node.name)
+                    assigned = True
+                    break
+
+            if assigned:
+                queues.push(queue)
+
+
+def new() -> ReclaimAction:
+    return ReclaimAction()
+
+
+register_action(ReclaimAction())

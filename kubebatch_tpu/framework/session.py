@@ -62,6 +62,13 @@ class Session:
         self.job_valid_fns: Dict[str, Callable[[JobInfo],
                                                Optional[ValidateResult]]] = {}
         self.backfill_eligible_fns: Dict[str, Callable[[JobInfo], bool]] = {}
+        #: final AND-filters over victim lists, applied AFTER tier dispatch.
+        #: Divergence from the reference: its per-tier intersection lets an
+        #: EMPTY tier-1 intersection fall through to tier 2, where drf can
+        #: select victims conformance vetoed — critical pods become
+        #: evictable through the gap (session_plugins.go:99-102 nil
+        #: fall-through). Safety vetoes registered here always hold.
+        self.victim_veto_fns: Dict[str, EvictableFn] = {}
 
         #: device-side snapshot, built on first use by kernels.tensorize
         self.device_snapshot = None
@@ -102,6 +109,9 @@ class Session:
     def add_backfill_eligible_fn(self, name: str, fn) -> None:
         self.backfill_eligible_fns[name] = fn
 
+    def add_victim_veto_fn(self, name: str, fn: EvictableFn) -> None:
+        self.victim_veto_fns[name] = fn
+
     def add_event_handler(self, eh: EventHandler) -> None:
         self.event_handlers.append(eh)
 
@@ -130,8 +140,15 @@ class Session:
                     cand_ids = {c.uid for c in candidates}
                     victims = [v for v in victims if v.uid in cand_ids]
             if victims:
-                return victims
+                return self._apply_vetoes(evictor, victims)
         return []
+
+    def _apply_vetoes(self, evictor: TaskInfo,
+                      victims: List[TaskInfo]) -> List[TaskInfo]:
+        for fn in self.victim_veto_fns.values():
+            allowed = {t.uid for t in (fn(evictor, victims) or [])}
+            victims = [v for v in victims if v.uid in allowed]
+        return victims
 
     def reclaimable(self, reclaimer: TaskInfo,
                     reclaimees: List[TaskInfo]) -> List[TaskInfo]:

@@ -218,9 +218,9 @@ def fused_allocate(
             do, s.q_allocated.at[jqi].add(t_req), s.q_allocated)
         new_j_alloc = jnp.where(do, s.j_allocated.at[ji].add(t_req),
                                 s.j_allocated)
-        plain_alloc = do & is_alloc & ~over_backfill
-        new_alloc_cnt = s.alloc_cnt.at[ji].add(
-            jnp.where(plain_alloc, 1, 0))
+        # pipelined-inclusive readiness (see kernels/solver.py)
+        counted = do & ~over_backfill
+        new_alloc_cnt = s.alloc_cnt.at[ji].add(jnp.where(counted, 1, 0))
 
         # ---- visit lifecycle --------------------------------------------
         if gang_enabled:

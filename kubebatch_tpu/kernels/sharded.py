@@ -73,8 +73,8 @@ def _sharded_scan_body(backfilled, max_task_num, node_ok, min_available):
         releasing = releasing - jnp.where(is_alloc, 0.0, 1.0) * take
         n_tasks = n_tasks + one_hot.astype(jnp.int32)
 
-        allocated = allocated + jnp.where(do & is_alloc & ~over_backfill,
-                                          1, 0)
+        # pipelined-inclusive readiness (see kernels/solver.py)
+        allocated = allocated + jnp.where(do & ~over_backfill, 1, 0)
         done = done | (active & ~feasible) | (do & (allocated >= min_available))
         return ((idle, releasing, n_tasks, allocated, done),
                 (decision.astype(jnp.int32), best.astype(jnp.int32)))

@@ -99,8 +99,10 @@ def _allocate_scan(idle, releasing, backfilled, max_task_num, n_tasks,
         new_rel = carry.releasing - one_hot[:, None] * pipe_take[None, :]
         new_ntasks = carry.n_tasks + (one_hot & do).astype(jnp.int32)
 
-        new_allocated = carry.allocated + jnp.where(
-            do & is_alloc & ~over_backfill, 1, 0)
+        # readiness counts plain Allocated AND Pipelined (gang's
+        # pipelined-inclusive ready_task_num); only AllocatedOverBackfill
+        # stays outside the quorum
+        new_allocated = carry.allocated + jnp.where(do & ~over_backfill, 1, 0)
         ready_now = new_allocated >= min_available
         # stop after the assignment that crossed readiness, or on failure
         new_done = carry.done | (active & ~feasible) | (do & ready_now)

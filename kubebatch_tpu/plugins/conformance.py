@@ -38,6 +38,10 @@ class ConformancePlugin(Plugin):
 
         ssn.add_preemptable_fn(NAME, evictable)
         ssn.add_reclaimable_fn(NAME, evictable)
+        # also a hard veto: critical pods stay protected even when an empty
+        # tier intersection falls through to a tier conformance isn't in
+        # (see Session.victim_veto_fns)
+        ssn.add_victim_veto_fn(NAME, evictable)
 
 
 def new(arguments=None) -> ConformancePlugin:

@@ -6,8 +6,8 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from ..api import (JobInfo, TaskInfo, TaskStatus, ValidateResult,
-                   allocated_status)
+from ..api import (JobInfo, JobReadiness, TaskInfo, TaskStatus,
+                   ValidateResult, allocated_status)
 from ..framework import Plugin, Session
 from ..metrics import (register_job_retries, update_unschedule_job_count,
                        update_unschedule_task_count)
@@ -88,9 +88,10 @@ class GangPlugin(Plugin):
         ssn.add_backfill_eligible_fn(NAME, backfill_eligible)
 
         def job_order_fn(l: JobInfo, r: JobInfo) -> int:
-            """Not-ready jobs before ready jobs (ref: gang.go:136-160)."""
-            l_ready = l.get_readiness() == l.get_readiness().READY
-            r_ready = r.get_readiness() == r.get_readiness().READY
+            """Not-ready jobs before ready jobs (ref: gang.go:136-160),
+            using the corrected pipelined-inclusive readiness."""
+            l_ready = ready_task_num(l) >= l.min_available
+            r_ready = ready_task_num(r) >= r.min_available
             if l_ready and r_ready:
                 return 0
             if l_ready:
@@ -100,14 +101,31 @@ class GangPlugin(Plugin):
             return 0
 
         ssn.add_job_order_fn(NAME, job_order_fn)
-        ssn.add_job_ready_fn(NAME, lambda job: job.get_readiness())
+
+        def job_ready_fn(job: JobInfo) -> JobReadiness:
+            """Gang readiness counting Pipelined + Succeeded like upstream
+            v0.4.1's readyTaskNum (and this fork's own OnSessionClose,
+            gang.go:171-174). The fork wired JobReadyFn to GetReadiness()
+            (gang.go:163), which excludes Pipelined — that makes every
+            preemption Statement discard (preempt.go:134-144 can never see
+            Ready), a regression we do not reproduce. AlmostReady keeps the
+            fork's AllocatedOverBackfill semantics on top."""
+            ready = ready_task_num(job)
+            if ready >= job.min_available:
+                return JobReadiness.READY
+            over_backfill = job.count(TaskStatus.ALLOCATED_OVER_BACKFILL)
+            if ready + over_backfill >= job.min_available:
+                return JobReadiness.ALMOST_READY
+            return JobReadiness.NOT_READY
+
+        ssn.add_job_ready_fn(NAME, job_ready_fn)
 
     def on_session_close(self, ssn: Session) -> None:
         """Stamp Unschedulable/Backfilled conditions for unready jobs
         (ref: gang.go:166-210)."""
         unschedulable_jobs = 0
         for job in ssn.jobs.values():
-            if job.get_readiness() == job.get_readiness().READY:
+            if ready_task_num(job) >= job.min_available:
                 continue
             unready = job.min_available - ready_task_num(job)
             msg = (f"{unready}/{len(job.tasks)} tasks in gang unschedulable: "

@@ -185,6 +185,26 @@ class PredicatesPlugin(Plugin):
         return NAME
 
     def on_session_open(self, ssn: Session) -> None:
+        # candidate list is identical across the N predicate calls for one
+        # allocation step; memoize per allocation epoch (same pattern as
+        # nodeorder's interpod count cache)
+        from ..framework import EventHandler
+
+        memo = {"epoch": -1, "tasks": None}
+        epoch = [0]
+
+        def _bump(event):
+            epoch[0] += 1
+
+        ssn.add_event_handler(EventHandler(allocate_func=_bump,
+                                           deallocate_func=_bump))
+
+        def cached_candidates():
+            if memo["epoch"] != epoch[0]:
+                memo["epoch"] = epoch[0]
+                memo["tasks"] = candidate_tasks(ssn)
+            return memo["tasks"]
+
         def predicate(task: TaskInfo, node: NodeInfo) -> None:
             # pod count (ref: predicates.go:127)
             if node.allocatable.max_task_num <= len(node.tasks):
@@ -208,7 +228,7 @@ class PredicatesPlugin(Plugin):
                 raise PredicateError(
                     f"task <{task.namespace}/{task.name}> does not "
                     f"tolerate node <{node.name}> taints")
-            candidates = candidate_tasks(ssn)
+            candidates = cached_candidates()
             if not satisfies_pod_affinity(ssn, task, node, candidates):
                 raise PredicateError(
                     f"task <{task.namespace}/{task.name}> "

@@ -1,0 +1,237 @@
+"""preempt + reclaim actions (ref: actions/preempt, actions/reclaim;
+e2e scenarios 'Preemption', 'Multiple Preemption', 'Reclaim')."""
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.actions.preempt import PreemptAction
+from kubebatch_tpu.actions.reclaim import ReclaimAction
+from kubebatch_tpu.api import TaskStatus
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import PodPhase
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+def shipped_tiers():
+    # config/kube-batch-conf.yaml shape
+    return [Tier(plugins=[PluginOption(name="priority"),
+                          PluginOption(name="gang"),
+                          PluginOption(name="conformance")]),
+            Tier(plugins=[PluginOption(name="drf"),
+                          PluginOption(name="predicates"),
+                          PluginOption(name="proportion"),
+                          PluginOption(name="nodeorder")])]
+
+
+class Harness:
+    """Multi-cycle sim: tracks binds and completes evictions between
+    cycles like the kubelet would."""
+
+    def __init__(self):
+        self.binds = {}
+        self.evicted = []
+        self.cache = SchedulerCache(binder=self, evictor=self,
+                                    async_writeback=False)
+
+    def bind(self, pod, hostname):
+        self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+        pod.node_name = hostname
+
+    def evict(self, pod):
+        self.evicted.append(f"{pod.namespace}/{pod.name}")
+        pod.deletion_timestamp = 1.0
+
+    def finish_evictions(self):
+        """Deletion completes: remove evicted pods from the cache."""
+        for job in list(self.cache.jobs.values()):
+            for task in list(job.tasks.values()):
+                if task.status == TaskStatus.RELEASING:
+                    self.cache.delete_pod(task.pod)
+
+    def cycle(self, *actions_to_run):
+        """Run one scheduling cycle; returns {task_key: session status}
+        captured before session close (pipelined state is session-only)."""
+        ssn = OpenSession(self.cache, shipped_tiers())
+        for act in actions_to_run:
+            act.execute(ssn)
+        statuses = {}
+        for job in ssn.jobs.values():
+            for task in job.tasks.values():
+                statuses[task.key] = task.status
+        CloseSession(ssn)
+        self.cache.drain(timeout=5.0)
+        return statuses
+
+
+def test_priority_preemption_two_cycles():
+    h = Harness()
+    h.cache.add_queue(build_queue("q1"))
+    h.cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+    # low-priority job fills the node
+    h.cache.add_pod_group(build_group("ns", "low", 1, queue="q1"))
+    for i in range(2):
+        h.cache.add_pod(build_pod("ns", f"low-{i}", "n1", PodPhase.RUNNING,
+                                  rl(2000, 4 * GiB), group="low",
+                                  priority=1))
+    # high-priority gang arrives
+    h.cache.add_pod_group(build_group("ns", "high", 1, queue="q1"))
+    h.cache.add_pod(build_pod("ns", "high-0", "", PodPhase.PENDING,
+                              rl(2000, 4 * GiB), group="high", priority=100))
+
+    statuses = h.cycle(AllocateAction(mode="host"), PreemptAction())
+    # preemptor pipelined, one victim evicted (Releasing)
+    assert statuses["ns/high-0"] == TaskStatus.PIPELINED
+    assert len(h.evicted) == 1
+    assert h.binds == {}
+
+    # kubelet finishes deleting the victim; next cycle binds the preemptor
+    h.finish_evictions()
+    h.cycle(AllocateAction(mode="host"))
+    assert h.binds == {"ns/high-0": "n1"}
+
+
+def test_gang_blocked_tier_falls_through_to_drf():
+    # victim job min_available=2 with exactly 2 running: gang (tier 1)
+    # rejects both victims, so tier 1's intersection is EMPTY and — Go
+    # nil-slice semantics — dispatch falls through to tier 2 where DRF
+    # allows evicting ONE pod (equal post-shares). Reference parity: the
+    # gang quorum is soft protection under the shipped config.
+    h = Harness()
+    h.cache.add_queue(build_queue("q1"))
+    h.cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+    h.cache.add_pod_group(build_group("ns", "low", 2, queue="q1"))
+    for i in range(2):
+        h.cache.add_pod(build_pod("ns", f"low-{i}", "n1", PodPhase.RUNNING,
+                                  rl(2000, 4 * GiB), group="low",
+                                  priority=1))
+    h.cache.add_pod_group(build_group("ns", "high", 1, queue="q1"))
+    h.cache.add_pod(build_pod("ns", "high-0", "", PodPhase.PENDING,
+                              rl(2000, 4 * GiB), group="high", priority=100))
+    statuses = h.cycle(AllocateAction(mode="host"), PreemptAction())
+    assert len(h.evicted) == 1
+    assert statuses["ns/high-0"] == TaskStatus.PIPELINED
+
+
+def test_conformance_protects_critical_pods():
+    h = Harness()
+    h.cache.add_queue(build_queue("q1"))
+    h.cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+    h.cache.add_pod_group(build_group("kube-system", "sys", 1, queue="q1"))
+    for i in range(2):
+        h.cache.add_pod(build_pod("kube-system", f"sys-{i}", "n1",
+                                  PodPhase.RUNNING, rl(2000, 4 * GiB),
+                                  group="sys", priority=1))
+    h.cache.add_pod_group(build_group("ns", "high", 1, queue="q1"))
+    h.cache.add_pod(build_pod("ns", "high-0", "", PodPhase.PENDING,
+                              rl(2000, 4 * GiB), group="high", priority=100))
+    h.cycle(AllocateAction(mode="host"), PreemptAction())
+    assert h.evicted == []
+
+
+def test_multiple_preemption():
+    # preemptor needs 4 cpu; victims are 2x2cpu tasks -> both evicted
+    h = Harness()
+    h.cache.add_queue(build_queue("q1"))
+    h.cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+    h.cache.add_pod_group(build_group("ns", "low", 1, queue="q1"))
+    for i in range(2):
+        h.cache.add_pod(build_pod("ns", f"low-{i}", "n1", PodPhase.RUNNING,
+                                  rl(2000, 4 * GiB), group="low",
+                                  priority=1))
+    h.cache.add_pod_group(build_group("ns", "big", 1, queue="q1"))
+    h.cache.add_pod(build_pod("ns", "big-0", "", PodPhase.PENDING,
+                              rl(4000, 8 * GiB), group="big", priority=100))
+    statuses = h.cycle(AllocateAction(mode="host"), PreemptAction())
+    assert sorted(h.evicted) == ["ns/low-0", "ns/low-1"]
+    assert statuses["ns/big-0"] == TaskStatus.PIPELINED
+    h.finish_evictions()
+    h.cycle(AllocateAction(mode="host"))
+    assert h.binds == {"ns/big-0": "n1"}
+
+
+def test_statement_discard_when_gang_cannot_be_satisfied():
+    # high gang needs 2 pods but only 1 can be freed -> statement discarded,
+    # victims stay Running
+    h = Harness()
+    h.cache.add_queue(build_queue("q1"))
+    h.cache.add_node(build_node("n1", rl(2000, 4 * GiB, pods=110)))
+    h.cache.add_node(build_node("n2", rl(2000, 4 * GiB, pods=110)))
+    h.cache.add_pod_group(build_group("ns", "low", 1, queue="q1"))
+    h.cache.add_pod(build_pod("ns", "low-0", "n1", PodPhase.RUNNING,
+                              rl(2000, 4 * GiB), group="low", priority=1))
+    # n2 occupied by a min=2 gang that cannot be preempted
+    h.cache.add_pod_group(build_group("ns", "solid", 2, queue="q1"))
+    h.cache.add_pod(build_pod("ns", "solid-0", "n2", PodPhase.RUNNING,
+                              rl(2000, 4 * GiB), group="solid", priority=1))
+    h.cache.add_pod(build_pod("ns", "solid-1", "n1", PodPhase.RUNNING,
+                              rl(10, 1024 ** 2), group="solid", priority=1))
+    h.cache.add_pod_group(build_group("ns", "high", 2, queue="q1"))
+    for i in range(2):
+        h.cache.add_pod(build_pod("ns", f"high-{i}", "", PodPhase.PENDING,
+                                  rl(2000, 4 * GiB), group="high",
+                                  priority=100))
+    statuses = h.cycle(PreemptAction())
+    # only low-0 was evictable; gang high never reached ready -> discard
+    assert h.evicted == []
+    assert statuses["ns/low-0"] == TaskStatus.RUNNING
+
+
+def test_reclaim_cross_queue_to_fair_share():
+    # q2's job reclaims from q1 which is above its weighted share
+    h = Harness()
+    h.cache.add_queue(build_queue("q1", 1))
+    h.cache.add_queue(build_queue("q2", 1))
+    h.cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+    h.cache.add_pod_group(build_group("ns", "hog", 1, queue="q1"))
+    for i in range(2):
+        h.cache.add_pod(build_pod("ns", f"hog-{i}", "n1", PodPhase.RUNNING,
+                                  rl(2000, 4 * GiB), group="hog"))
+    h.cache.add_pod_group(build_group("ns", "newb", 1, queue="q2"))
+    h.cache.add_pod(build_pod("ns", "newb-0", "", PodPhase.PENDING,
+                              rl(2000, 4 * GiB), group="newb"))
+    statuses = h.cycle(ReclaimAction())
+    assert len(h.evicted) == 1
+    assert statuses["ns/newb-0"] == TaskStatus.PIPELINED
+    h.finish_evictions()
+    h.cycle(AllocateAction(mode="host"))
+    assert h.binds == {"ns/newb-0": "n1"}
+
+
+def test_reclaim_respects_deserved_floor():
+    # victim job min=2 (gang blocks -> tier 1 empty -> falls through to
+    # proportion in tier 2), and q1 sits exactly at its deserved share ->
+    # proportion refuses: nothing reclaimable
+    h = Harness()
+    h.cache.add_queue(build_queue("q1", 1))
+    h.cache.add_queue(build_queue("q2", 1))
+    h.cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+    h.cache.add_pod_group(build_group("ns", "fair", 2, queue="q1"))
+    for i in range(2):
+        h.cache.add_pod(build_pod("ns", f"fair-{i}", "n1", PodPhase.RUNNING,
+                                  rl(1000, 2 * GiB), group="fair"))
+    h.cache.add_pod_group(build_group("ns", "newb", 1, queue="q2"))
+    h.cache.add_pod(build_pod("ns", "newb-0", "", PodPhase.PENDING,
+                              rl(2000, 4 * GiB), group="newb"))
+    h.cycle(ReclaimAction())
+    assert h.evicted == []
+
+
+def test_reclaim_min1_quirk_bypasses_proportion_floor():
+    # reference parity: victim job with MinAvailable==1 is allowed by gang
+    # in tier 1 (the fork quirk), so the non-empty tier-1 intersection
+    # DECIDES and proportion's deserved floor in tier 2 is never consulted
+    h = Harness()
+    h.cache.add_queue(build_queue("q1", 1))
+    h.cache.add_queue(build_queue("q2", 1))
+    h.cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+    h.cache.add_pod_group(build_group("ns", "fair", 1, queue="q1"))
+    h.cache.add_pod(build_pod("ns", "fair-0", "n1", PodPhase.RUNNING,
+                              rl(2000, 4 * GiB), group="fair"))
+    h.cache.add_pod_group(build_group("ns", "newb", 1, queue="q2"))
+    h.cache.add_pod(build_pod("ns", "newb-0", "", PodPhase.PENDING,
+                              rl(2000, 4 * GiB), group="newb"))
+    h.cycle(ReclaimAction())
+    assert h.evicted == ["ns/fair-0"]
