@@ -3,6 +3,6 @@
 Importing this package registers all built-in actions, mirroring the
 reference's blank-import self-registration (actions/factory.go:231-236).
 """
-from . import allocate, preempt, reclaim
+from . import allocate, backfill, preempt, reclaim
 
-__all__ = ["allocate", "preempt", "reclaim"]
+__all__ = ["allocate", "backfill", "preempt", "reclaim"]

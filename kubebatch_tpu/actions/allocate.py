@@ -47,11 +47,9 @@ def _effective_min_available(ssn: Session, job: JobInfo) -> int:
 
 
 def _init_allocated(job: JobInfo) -> int:
-    """Initial ready-task count for the kernels' in-scan readiness — gang's
-    pipelined-inclusive definition (plugins/gang.py ready_task_num)."""
-    return job.count(TaskStatus.BOUND, TaskStatus.BINDING, TaskStatus.RUNNING,
-                     TaskStatus.ALLOCATED, TaskStatus.SUCCEEDED,
-                     TaskStatus.PIPELINED)
+    """Initial ready-task count for the kernels' in-scan readiness."""
+    from ..api import ready_statuses
+    return job.count(*ready_statuses())
 
 
 class AllocateAction(Action):

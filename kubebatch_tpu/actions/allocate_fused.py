@@ -15,7 +15,7 @@ from typing import Dict, List, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..api import JobInfo, TaskInfo, TaskStatus, allocated_statuses
+from ..api import JobInfo, TaskInfo, TaskStatus, ready_statuses
 from ..framework import Session
 from ..kernels.fused import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
                              K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
@@ -131,7 +131,7 @@ def execute_fused(ssn: Session) -> None:
     for i, j in enumerate(jobs):
         min_av[i] = j.min_available if gang else 0
         order_min_av[i] = j.min_available
-        init_alloc[i] = j.count(*allocated_statuses())
+        init_alloc[i] = j.count(*ready_statuses())
         job_queue[i] = q_index[j.queue]
         job_priority[i] = j.priority
         job_create_rank[i] = j_rank[j.uid]

@@ -8,7 +8,7 @@ from .resource import (MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_GPU, RESOURCE_DIM,
                        RESOURCE_NAMES, Resource, res_min, resource_names,
                        share, vecs)
 from .types import (JobReadiness, TaskStatus, ValidateResult,
-                    allocated_status, allocated_statuses,
+                    allocated_status, allocated_statuses, ready_statuses,
                     validate_status_update)
 
 __all__ = [
@@ -16,7 +16,8 @@ __all__ = [
     "TaskStatus", "JobReadiness", "ValidateResult",
     "MIN_MEMORY", "MIN_MILLI_CPU", "MIN_MILLI_GPU",
     "RESOURCE_DIM", "RESOURCE_NAMES",
-    "allocated_status", "allocated_statuses", "validate_status_update",
+    "allocated_status", "allocated_statuses", "ready_statuses",
+    "validate_status_update",
     "get_job_id", "get_pod_resource_request",
     "get_pod_resource_without_init_containers", "get_task_status",
     "job_terminated", "pod_key", "res_min", "resource_names", "share", "vecs",

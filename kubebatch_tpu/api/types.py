@@ -69,6 +69,15 @@ def allocated_statuses() -> List[TaskStatus]:
             TaskStatus.ALLOCATED]
 
 
+def ready_statuses() -> List[TaskStatus]:
+    """States counting toward gang readiness — the pipelined-inclusive
+    definition (upstream v0.4.1 readyTaskNum; see plugins/gang.py for why
+    the fork's narrower set is a regression). Single source of truth for
+    gang, the allocate paths, and the kernels' init counters."""
+    return [TaskStatus.BOUND, TaskStatus.BINDING, TaskStatus.RUNNING,
+            TaskStatus.ALLOCATED, TaskStatus.SUCCEEDED, TaskStatus.PIPELINED]
+
+
 def allocated_status(status: TaskStatus) -> bool:
     """ref: api/helpers.go:63-70."""
     return status in (TaskStatus.BOUND, TaskStatus.BINDING,

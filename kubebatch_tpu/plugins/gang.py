@@ -33,12 +33,8 @@ def valid_task_num(job: JobInfo) -> int:
 
 def ready_task_num(job: JobInfo) -> int:
     """ref: gang.go:212-222 (NB: excludes AllocatedOverBackfill)."""
-    cnt = 0
-    for status, tasks in job.task_status_index.items():
-        if (allocated_status(status) or status == TaskStatus.SUCCEEDED
-                or status == TaskStatus.PIPELINED):
-            cnt += len(tasks)
-    return cnt
+    from ..api import ready_statuses
+    return job.count(*ready_statuses())
 
 
 def backfill_eligible(job: JobInfo) -> bool:

@@ -1,0 +1,108 @@
+"""File-lease leader election — HA for multiple scheduler replicas.
+
+The reference elects through a ConfigMap resource lock with a
+15s lease / 10s renew / 5s retry (cmd/kube-batch/app/server.go:103-106,
+170-193) and kills the process on lost leadership. Without an API server,
+the shared medium here is a lock file on a shared filesystem carrying the
+holder's identity and lease expiry; semantics (acquire, renew, fatal on
+loss) are preserved.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+
+class FileLease:
+    def __init__(self, path: str, lease_duration: float = 15.0,
+                 renew_deadline: float = 10.0, retry_period: float = 5.0,
+                 identity: Optional[str] = None):
+        self.path = path
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.identity = identity or f"{socket.gethostname()}_{uuid.uuid4()}"
+
+    def _read(self):
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write(self) -> bool:
+        record = {"holder": self.identity,
+                  "renew_time": time.time(),
+                  "lease_duration": self.lease_duration}
+        tmp = f"{self.path}.{self.identity}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, self.path)
+            return True
+        except OSError:
+            return False
+
+    def try_acquire_or_renew(self) -> bool:
+        rec = self._read()
+        now = time.time()
+        if rec is not None and rec.get("holder") != self.identity:
+            expires = rec.get("renew_time", 0) + rec.get("lease_duration",
+                                                         self.lease_duration)
+            if now < expires:
+                return False  # someone else holds a live lease
+        return self._write()
+
+    def run(self, on_started_leading: Callable[[threading.Event], None],
+            on_stopped_leading: Callable[[], None],
+            stop: Optional[threading.Event] = None) -> None:
+        """Block until leadership is acquired, run the workload, and call
+        on_stopped_leading if the lease is ever lost (the reference
+        glog.Fatalf's there — callers should treat it as fatal)."""
+        stop = stop or threading.Event()
+        while not stop.is_set():
+            if self.try_acquire_or_renew():
+                break
+            stop.wait(self.retry_period)
+        if stop.is_set():
+            return
+
+        lost = threading.Event()
+
+        def renew_loop():
+            while not stop.is_set() and not lost.is_set():
+                deadline = time.time() + self.renew_deadline
+                ok = False
+                while time.time() < deadline:
+                    if self.try_acquire_or_renew():
+                        ok = True
+                        break
+                    stop.wait(min(1.0, self.retry_period))
+                if not ok:
+                    lost.set()
+                    return
+                stop.wait(self.retry_period)
+
+        renewer = threading.Thread(target=renew_loop, daemon=True,
+                                   name="kb-lease-renew")
+        renewer.start()
+
+        workload_stop = threading.Event()
+
+        def watchdog():
+            while not stop.is_set() and not lost.is_set():
+                lost.wait(0.2)
+            workload_stop.set()
+
+        threading.Thread(target=watchdog, daemon=True,
+                         name="kb-lease-watchdog").start()
+        try:
+            on_started_leading(workload_stop)
+        finally:
+            if lost.is_set():
+                on_stopped_leading()

@@ -1,0 +1,100 @@
+"""Scheduler loop (ref: pkg/scheduler/scheduler.go + pkg/scheduler/util.go).
+
+Every ``schedule_period`` the loop opens a Session against the cache,
+executes the configured actions in order with per-action latency metrics,
+and closes the session (status write-back). Malformed policy config falls
+back to the compiled-in default; an unknown action name is an error
+(util.go:148-169).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from .. import actions as _actions  # noqa: F401  (self-registration)
+from .. import plugins as _plugins  # noqa: F401  (self-registration)
+from ..conf import SchedulerConfiguration, Tier, parse_scheduler_conf
+from ..framework import (Action, CloseSession, OpenSession, get_action)
+from ..metrics import update_action_duration, update_e2e_duration
+
+DEFAULT_SCHEDULER_CONF = """
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def load_scheduler_conf(conf_str: str) -> Tuple[List[Action], List[Tier]]:
+    """ref: util.go:148-169 — unknown action name is an error."""
+    conf: SchedulerConfiguration = parse_scheduler_conf(conf_str)
+    actions: List[Action] = []
+    for name in conf.actions.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        action = get_action(name)
+        if action is None:
+            raise ValueError(f"failed to find Action {name}, ignore it")
+        actions.append(action)
+    return actions, conf.tiers
+
+
+class Scheduler:
+    """ref: scheduler.go:33-105."""
+
+    def __init__(self, cache, scheduler_conf: str = "",
+                 schedule_period: float = 1.0,
+                 enable_preemption: bool = False):
+        self.cache = cache
+        self.schedule_period = schedule_period
+        self.enable_preemption = enable_preemption
+        self.actions, self.tiers = self._load_conf(scheduler_conf)
+        self._stop = threading.Event()
+
+    @staticmethod
+    def _load_conf(conf_str: str):
+        """Malformed conf falls back to the default
+        (ref: scheduler.go:71-83)."""
+        if conf_str:
+            try:
+                return load_scheduler_conf(conf_str)
+            except Exception:
+                pass
+        return load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+
+    def run(self, stop: Optional[threading.Event] = None) -> None:
+        """Blocking loop: cache workers + periodic run_once
+        (ref: scheduler.go:63-86)."""
+        stop = stop or self._stop
+        self.cache.run()
+        self.cache.wait_for_cache_sync()
+        while not stop.is_set():
+            start = time.perf_counter()
+            self.run_once()
+            elapsed = time.perf_counter() - start
+            stop.wait(max(0.0, self.schedule_period - elapsed))
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run_once(self) -> None:
+        """One scheduling cycle (ref: scheduler.go:88-105)."""
+        start = time.perf_counter()
+        ssn = OpenSession(self.cache, self.tiers, self.enable_preemption)
+        for action in self.actions:
+            action.initialize()
+            action_start = time.perf_counter()
+            action.execute(ssn)
+            update_action_duration(action.name,
+                                   time.perf_counter() - action_start)
+            action.uninitialize()
+        CloseSession(ssn)
+        update_e2e_duration(time.perf_counter() - start)

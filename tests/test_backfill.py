@@ -1,0 +1,117 @@
+"""backfill action (ref: actions/backfill; e2e 'Backfill'/'BestEffort')."""
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.actions.backfill import BackfillAction
+from kubebatch_tpu.api import TaskStatus
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import BACKFILLED_CONDITION, PodPhase
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+def tiers():
+    return [Tier(plugins=[PluginOption(name="priority"),
+                          PluginOption(name="gang")]),
+            Tier(plugins=[PluginOption(name="drf"),
+                          PluginOption(name="proportion")])]
+
+
+class RecordingBinder:
+    def __init__(self):
+        self.binds = {}
+
+    def bind(self, pod, hostname):
+        self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+        pod.node_name = hostname
+
+
+def mk(nodes, groups, pods):
+    binder = RecordingBinder()
+    cache = SchedulerCache(binder=binder, async_writeback=False)
+    cache.add_queue(build_queue("q1"))
+    for n in nodes:
+        cache.add_node(n)
+    for g in groups:
+        cache.add_pod_group(g)
+    for p in pods:
+        cache.add_pod(p)
+    return cache, binder
+
+
+def test_best_effort_backfilled_on_full_node():
+    # node is resource-full, but a BestEffort pod (no requests) still lands
+    cache, binder = mk(
+        [build_node("n1", rl(2000, 4 * GiB, pods=110))],
+        [build_group("ns", "full", 1, queue="q1"),
+         build_group("ns", "be", 1, queue="q1")],
+        [build_pod("ns", "big", "n1", PodPhase.RUNNING, rl(2000, 4 * GiB),
+                   group="full"),
+         build_pod("ns", "effortless", "", PodPhase.PENDING, rl(0, 0),
+                   group="be")])
+    ssn = OpenSession(cache, tiers())
+    AllocateAction(mode="host").execute(ssn)
+    assert binder.binds == {}
+    BackfillAction().execute(ssn)
+    CloseSession(ssn)
+    cache.drain(timeout=5.0)
+    assert binder.binds == {"ns/effortless": "n1"}
+
+
+def test_reserved_backfill_marks_tasks_and_condition():
+    # top-dog gang (min=2) reserves one slot but can never be ready;
+    # reserved backfill releases it and backfills the all-pending job with
+    # IsBackfill=true; gang close stamps the Backfilled condition
+    cache, binder = mk(
+        [build_node("n1", rl(2000, 4 * GiB, pods=110))],
+        [build_group("ns", "topdog", 3, queue="q1"),
+         build_group("ns", "filler", 1, queue="q1")],
+        [build_pod("ns", f"td-{i}", "", PodPhase.PENDING, rl(1000, 2 * GiB),
+                   group="topdog", creation_timestamp=1.0 + i)
+         for i in range(3)] +
+        [build_pod("ns", "fill-0", "", PodPhase.PENDING, rl(1000, 2 * GiB),
+                   group="filler", creation_timestamp=10.0)])
+    ssn = OpenSession(cache, tiers())
+    # simulate allocate having reserved partial resources for the top dog
+    td = ssn.jobs["ns/topdog"]
+    td_tasks = sorted(td.tasks.values(), key=lambda t: t.name)
+    ssn.allocate(td_tasks[0], "n1")
+    ssn.allocate(td_tasks[1], "n1")
+    assert ssn.jobs["ns/topdog"].count(TaskStatus.ALLOCATED) == 2
+    # backfill with the fork's reserved path enabled
+    BackfillAction(reserved=True).execute(ssn)
+    # top dog released (not ready: 2 < 3 and no way to finish)
+    assert td.count(TaskStatus.ALLOCATED) == 0
+    # filler backfilled with the backfill mark, and dispatched (min=1)
+    filler_task = next(iter(ssn.jobs["ns/filler"].tasks.values()))
+    assert filler_task.is_backfill
+    CloseSession(ssn)
+    cache.drain(timeout=5.0)
+    assert binder.binds == {"ns/fill-0": "n1"}
+    # gang session close stamped Backfilled on the unready backfilled job?
+    # filler became Ready so no condition there; topdog gets Unschedulable
+    td_conds = [c.type for c in
+                cache.jobs["ns/topdog"].pod_group.status.conditions]
+    assert "Unschedulable" in td_conds
+
+
+def test_backfilled_condition_for_unready_backfill_job():
+    # a backfilled gang that stays unready gets the Backfilled condition
+    # at session close (fork semantics, gang.go:189-200)
+    cache, binder = mk(
+        [build_node("n1", rl(2000, 4 * GiB, pods=110))],
+        [build_group("ns", "bf", 2, queue="q1")],
+        [build_pod("ns", "bf-0", "", PodPhase.PENDING, rl(1000, 2 * GiB),
+                   group="bf"),
+         build_pod("ns", "bf-1", "", PodPhase.PENDING, rl(4000, 8 * GiB),
+                   group="bf")])  # second task can never fit
+    ssn = OpenSession(cache, tiers())
+    BackfillAction(reserved=True).execute(ssn)
+    # bf-0 was backfilled then released (job unready), but keeps its mark
+    job = ssn.jobs["ns/bf"]
+    assert any(t.is_backfill for t in job.tasks.values())
+    CloseSession(ssn)
+    conds = [c.type for c in
+             cache.jobs["ns/bf"].pod_group.status.conditions]
+    assert BACKFILLED_CONDITION in conds

@@ -1,0 +1,142 @@
+"""Scheduler loop, conf loading, CLI, leader election
+(ref: scheduler.go, util.go, cmd/kube-batch)."""
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.runtime import (DEFAULT_SCHEDULER_CONF, Scheduler,
+                                   load_scheduler_conf)
+from kubebatch_tpu.runtime.leaderelection import FileLease
+from kubebatch_tpu.sim import ClusterSpec, build_cluster
+
+from .fixtures import GiB
+
+
+def test_default_conf_parses():
+    actions, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+    assert [a.name for a in actions] == ["allocate", "backfill"]
+    assert [p.name for p in tiers[0].plugins] == ["priority", "gang"]
+    assert [p.name for p in tiers[1].plugins] == ["drf", "predicates",
+                                                  "proportion", "nodeorder"]
+
+
+def test_shipped_conf_parses():
+    with open("config/kube-batch-conf.yaml") as f:
+        actions, tiers = load_scheduler_conf(f.read())
+    assert [a.name for a in actions] == ["reclaim", "allocate", "backfill",
+                                         "preempt"]
+    assert len(tiers) == 2
+
+
+def test_unknown_action_errors():
+    with pytest.raises(ValueError):
+        load_scheduler_conf('actions: "allocate, warp-drive"\ntiers: []\n')
+
+
+def test_malformed_conf_falls_back_to_default():
+    sched = Scheduler(SchedulerCache(async_writeback=False),
+                      scheduler_conf=":::not yaml {{{")
+    assert [a.name for a in sched.actions] == ["allocate", "backfill"]
+
+
+def test_disable_flags_parsed():
+    conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+    disablePreemptable: true
+    disableJobOrder: true
+    arguments:
+      foo: bar
+"""
+    _, tiers = load_scheduler_conf(conf)
+    opt = tiers[0].plugins[0]
+    assert opt.preemptable_disabled is True
+    assert opt.job_order_disabled is True
+    assert opt.predicate_disabled is False
+    assert opt.arguments == {"foo": "bar"}
+
+
+def test_scheduler_loop_schedules_sim_cluster():
+    binds = {}
+
+    class B:
+        def bind(self, pod, hostname):
+            binds[pod.uid] = hostname
+            pod.node_name = hostname
+
+    cache = SchedulerCache(binder=B(), async_writeback=False)
+    sim = build_cluster(ClusterSpec(n_nodes=4, n_groups=4, pods_per_group=2,
+                                    pod_cpu_millis=1000,
+                                    pod_mem_bytes=GiB))
+    sim.populate(cache)
+    sched = Scheduler(cache, schedule_period=0.01)
+    stop = threading.Event()
+    t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while len(binds) < 8 and time.time() < deadline:
+        time.sleep(0.02)
+    stop.set()
+    t.join(timeout=5)
+    assert len(binds) == 8
+
+
+def test_cli_version_and_cycles():
+    out = subprocess.run(
+        [sys.executable, "-m", "kubebatch_tpu", "--version"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    assert "kubebatch-tpu" in out.stdout
+
+    out = subprocess.run(
+        [sys.executable, "-m", "kubebatch_tpu", "--sim-config", "1",
+         "--cycles", "2", "--listen-address", "", "--solver", "host"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+
+
+def test_file_lease_single_holder(tmp_path):
+    path = str(tmp_path / "leader.lock")
+    a = FileLease(path, lease_duration=0.5, renew_deadline=0.3,
+                  retry_period=0.1, identity="a")
+    b = FileLease(path, lease_duration=0.5, renew_deadline=0.3,
+                  retry_period=0.1, identity="b")
+    assert a.try_acquire_or_renew() is True
+    assert b.try_acquire_or_renew() is False
+    assert a.try_acquire_or_renew() is True  # renew own lease
+    # lease expires -> b can take it
+    time.sleep(0.6)
+    assert b.try_acquire_or_renew() is True
+    assert a.try_acquire_or_renew() is False
+
+
+def test_file_lease_run_and_loss(tmp_path):
+    path = str(tmp_path / "leader.lock")
+    lease = FileLease(path, lease_duration=0.4, renew_deadline=0.2,
+                      retry_period=0.05, identity="runner")
+    events = []
+    stop = threading.Event()
+
+    def work(workload_stop):
+        events.append("started")
+        # steal the lease from outside to force loss
+        thief = FileLease(path, lease_duration=60, identity="thief")
+        with open(path, "w") as f:
+            json.dump({"holder": "thief", "renew_time": time.time() + 100,
+                       "lease_duration": 60}, f)
+        assert thief  # silence lint
+        workload_stop.wait(timeout=5)
+        events.append("workload-stopped")
+
+    def lost():
+        events.append("lost")
+
+    lease.run(work, lost, stop)
+    assert events == ["started", "workload-stopped", "lost"]
