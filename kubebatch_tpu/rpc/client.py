@@ -1,0 +1,126 @@
+"""Client for the solver sidecar: builds a SnapshotRequest from a Session
+and applies the returned decisions — the front-end half of the gRPC
+boundary (SURVEY.md sect. 2.9)."""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import grpc
+import numpy as np
+
+from ..actions.allocate_fused import (_gang_enabled, _job_order_spec,
+                                      fused_supported)
+from ..api import TaskStatus, ready_statuses
+from ..framework import Session
+from ..kernels.fused import (ALLOC, ALLOC_OB, K_DRF_SHARE, K_PRIORITY,
+                             PIPELINE)
+from . import solver_pb2
+from .server import SERVICE
+
+
+class SolverClient:
+    def __init__(self, target: str):
+        self._channel = grpc.insecure_channel(target)
+        self._solve = self._channel.unary_unary(
+            f"/{SERVICE}/Solve",
+            request_serializer=solver_pb2.SnapshotRequest.SerializeToString,
+            response_deserializer=solver_pb2.DecisionsResponse.FromString)
+
+    def close(self):
+        self._channel.close()
+
+    # ------------------------------------------------------------------
+    def snapshot_from_session(self, ssn: Session):
+        """Returns (SnapshotRequest, {task_uid: TaskInfo}). Raises
+        ValueError for configurations the sidecar kernel cannot express
+        (custom order fns, predicate/node-order plugins) — silent
+        divergence from the in-process path is worse than an error."""
+        if not fused_supported(ssn):
+            raise ValueError(
+                "session plugins exceed the sidecar solver's vocabulary; "
+                "run allocate in-process for this configuration")
+        req = solver_pb2.SnapshotRequest()
+        node_names = sorted(ssn.nodes)
+        node_index = {n: i for i, n in enumerate(node_names)}
+        for name in node_names:
+            ni = ssn.nodes[name]
+            req.nodes.names.append(name)
+            req.nodes.idle.extend(ni.idle.to_vec().tolist())
+            req.nodes.releasing.extend(ni.releasing.to_vec().tolist())
+            req.nodes.backfilled.extend(ni.backfilled.to_vec().tolist())
+            req.nodes.max_task_num.append(ni.allocatable.max_task_num)
+            req.nodes.n_tasks.append(len(ni.tasks))
+            req.nodes.schedulable.append(
+                ni.node is not None and not ni.node.unschedulable)
+
+        queue_names = sorted(ssn.queues)
+        q_index = {q: i for i, q in enumerate(queue_names)}
+        prop = ssn.plugins.get("proportion")
+        for qn in queue_names:
+            req.queues.names.append(qn)
+            req.queues.weight.append(ssn.queues[qn].weight)
+            attr = getattr(prop, "queue_opts", {}).get(qn) if prop else None
+            if attr is not None:
+                req.queues.deserved.extend(attr.deserved.to_vec().tolist())
+                req.queues.allocated.extend(attr.allocated.to_vec().tolist())
+            else:
+                req.queues.deserved.extend([0.0, 0.0, 0.0])
+                req.queues.allocated.extend([0.0, 0.0, 0.0])
+
+        jobs = [jb for jb in ssn.jobs.values() if jb.queue in q_index]
+        rank = {jb.uid: r for r, jb in enumerate(
+            sorted(jobs, key=lambda x: (x.creation_timestamp, x.uid)))}
+        tasks_by_uid: Dict[str, object] = {}
+        for ji, jb in enumerate(jobs):
+            req.jobs.uids.append(jb.uid)
+            req.jobs.min_available.append(jb.min_available)
+            req.jobs.init_ready.append(jb.count(*ready_statuses()))
+            req.jobs.queue_index.append(q_index[jb.queue])
+            req.jobs.priority.append(jb.priority)
+            req.jobs.create_rank.append(rank[jb.uid])
+            pend = [t for t in jb.task_status_index.get(TaskStatus.PENDING,
+                                                        {}).values()
+                    if not t.resreq.is_empty()]
+            pend.sort(key=functools.cmp_to_key(
+                lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
+            for r, t in enumerate(pend):
+                req.tasks.uids.append(t.uid)
+                req.tasks.resreq.extend(t.resreq.to_vec().tolist())
+                req.tasks.init_resreq.extend(t.init_resreq.to_vec().tolist())
+                req.tasks.job_index.append(ji)
+                req.tasks.rank.append(r)
+                tasks_by_uid[t.uid] = t
+
+        # derive flags the same way the in-process fused path does, so
+        # per-tier disable flags are honored identically
+        job_keys, _ = _job_order_spec(ssn)
+        req.gang_enabled = _gang_enabled(ssn)
+        req.proportion_enabled = (
+            "proportion" in ssn.overused_fns
+            and any(opt.name == "proportion" for tier in ssn.tiers
+                    for opt in tier.plugins))
+        req.drf_enabled = K_DRF_SHARE in job_keys
+        req.priority_enabled = K_PRIORITY in job_keys
+        req.job_order_keys.extend(job_keys)  # exact tier-dispatch order
+        drf = ssn.plugins.get("drf")
+        if drf is not None:
+            req.cluster_total.extend(
+                drf.total_resource.to_vec().tolist())
+        return req, tasks_by_uid
+
+    def solve_and_apply(self, ssn: Session) -> solver_pb2.DecisionsResponse:
+        """One remote solve; decisions replayed through the Session."""
+        req, tasks_by_uid = self.snapshot_from_session(ssn)
+        resp = self._solve(req)
+        decisions = [d for d in resp.decisions if d.order >= 0]
+        decisions.sort(key=lambda d: d.order)
+        for d in decisions:
+            task = tasks_by_uid.get(d.task_uid)
+            if task is None:
+                continue
+            if d.kind in (ALLOC, ALLOC_OB):
+                ssn.allocate(task, d.node_name, d.kind == ALLOC_OB)
+            elif d.kind == PIPELINE:
+                ssn.pipeline(task, d.node_name)
+        return resp

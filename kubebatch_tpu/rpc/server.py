@@ -1,0 +1,183 @@
+"""gRPC solver sidecar — dense snapshots in, assignment decisions out.
+
+Serves the fused allocate kernel behind the Solver service defined in
+solver.proto. The service wiring is hand-written over grpc generic
+handlers (grpcio-tools is not available in this image; message classes
+are protoc-generated into solver_pb2.py).
+"""
+from __future__ import annotations
+
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+import jax.numpy as jnp
+import numpy as np
+
+from ..kernels.fused import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
+                             K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
+                             K_PROP_SHARE, fused_allocate)
+from ..kernels.tensorize import pad_to_bucket
+from . import solver_pb2
+
+SERVICE = "kubebatch_tpu.Solver"
+
+
+def _mat(values, n, r=3) -> np.ndarray:
+    out = np.zeros((n, r), np.float32)
+    flat = np.asarray(values, np.float32)
+    out.flat[:flat.size] = flat
+    return out
+
+
+def solve_snapshot(req: solver_pb2.SnapshotRequest
+                   ) -> solver_pb2.DecisionsResponse:
+    nodes, tasks, jobs, queues = req.nodes, req.tasks, req.jobs, req.queues
+    n = len(nodes.names)
+    t = len(tasks.uids)
+    j = len(jobs.uids)
+    q = max(1, len(queues.names))
+    n_pad = pad_to_bucket(n)
+    t_pad = pad_to_bucket(t)
+    j_pad = pad_to_bucket(j, 4)
+    q_pad = pad_to_bucket(q, 4)
+
+    idle = np.zeros((n_pad, 3), np.float32)
+    idle[:n] = _mat(nodes.idle, n)
+    releasing = np.zeros((n_pad, 3), np.float32)
+    releasing[:n] = _mat(nodes.releasing, n)
+    backfilled = np.zeros((n_pad, 3), np.float32)
+    backfilled[:n] = _mat(nodes.backfilled, n)
+    mtn = np.zeros(n_pad, np.int32)
+    mtn[:n] = nodes.max_task_num
+    ntasks = np.zeros(n_pad, np.int32)
+    ntasks[:n] = nodes.n_tasks
+    node_ok = np.zeros(n_pad, bool)
+    node_ok[:n] = nodes.schedulable
+
+    resreq = np.zeros((t_pad, 3), np.float32)
+    resreq[:t] = _mat(tasks.resreq, t)
+    init_resreq = np.zeros((t_pad, 3), np.float32)
+    init_resreq[:t] = _mat(tasks.init_resreq, t)
+    task_job = np.full(t_pad, -1, np.int32)
+    task_job[:t] = tasks.job_index
+    task_rank = np.zeros(t_pad, np.int32)
+    task_rank[:t] = tasks.rank
+    task_valid = np.zeros(t_pad, bool)
+    task_valid[:t] = True
+
+    min_av = np.zeros(j_pad, np.int32)
+    min_av[:j] = jobs.min_available if req.gang_enabled else [0] * j
+    order_min_av = np.zeros(j_pad, np.int32)
+    order_min_av[:j] = jobs.min_available
+    init_ready = np.zeros(j_pad, np.int32)
+    init_ready[:j] = jobs.init_ready
+    job_queue = np.zeros(j_pad, np.int32)
+    job_queue[:j] = jobs.queue_index
+    job_priority = np.zeros(j_pad, np.float32)
+    job_priority[:j] = jobs.priority
+    job_create_rank = np.zeros(j_pad, np.int32)
+    job_create_rank[:j] = jobs.create_rank
+    job_valid = np.zeros(j_pad, bool)
+    job_valid[:j] = True
+
+    q_weight = np.zeros(q_pad, np.float32)
+    q_weight[:len(queues.weight)] = queues.weight
+    q_entries = np.zeros(q_pad, np.int32)
+    for ji_ in range(j):
+        q_entries[jobs.queue_index[ji_]] += 1
+    q_create_rank = np.arange(q_pad, dtype=np.int32)
+    q_deserved = np.zeros((q_pad, 3), np.float32)
+    if len(queues.deserved):
+        q_deserved[:len(queues.names)] = _mat(queues.deserved,
+                                              len(queues.names))
+    q_alloc0 = np.zeros((q_pad, 3), np.float32)
+    if len(queues.allocated):
+        q_alloc0[:len(queues.names)] = _mat(queues.allocated,
+                                            len(queues.names))
+
+    cluster_total = np.ones(3, np.float32)
+    if len(req.cluster_total):
+        cluster_total = np.asarray(req.cluster_total, np.float32)
+
+    if req.job_order_keys:
+        job_keys = [k for k in req.job_order_keys
+                    if k in (K_PRIORITY, K_GANG_READY, K_DRF_SHARE)]
+    else:
+        job_keys = []
+        if req.priority_enabled:
+            job_keys.append(K_PRIORITY)
+        if req.gang_enabled:
+            job_keys.append(K_GANG_READY)
+        if req.drf_enabled:
+            job_keys.append(K_DRF_SHARE)
+    queue_keys = (K_PROP_SHARE,) if req.proportion_enabled else ()
+
+    scores = np.zeros((t_pad, n_pad), np.float32)
+    pred = np.ones((t_pad, n_pad), bool)
+    j_alloc0 = np.zeros((j_pad, 3), np.float32)
+
+    start = time.perf_counter()
+    (task_state, task_node, task_seq, *_rest, iters) = fused_allocate(
+        idle, releasing, backfilled, mtn, ntasks, node_ok,
+        jnp.asarray(resreq), jnp.asarray(init_resreq),
+        jnp.asarray(task_job), jnp.asarray(task_rank),
+        jnp.asarray(task_valid), jnp.asarray(scores), jnp.asarray(pred),
+        jnp.asarray(min_av), jnp.asarray(order_min_av),
+        jnp.asarray(init_ready), jnp.asarray(job_queue),
+        jnp.asarray(job_priority), jnp.asarray(job_create_rank),
+        jnp.asarray(job_valid), jnp.asarray(q_weight),
+        jnp.asarray(q_entries), jnp.asarray(q_create_rank),
+        jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
+        jnp.asarray(j_alloc0), jnp.asarray(cluster_total),
+        job_keys=tuple(job_keys), queue_keys=queue_keys,
+        gang_enabled=req.gang_enabled,
+        prop_overused=req.proportion_enabled,
+        max_iters=int(t_pad + 3 * j_pad + q_pad + 8))
+    solve_ms = (time.perf_counter() - start) * 1e3
+    task_state = np.asarray(task_state)
+    task_node = np.asarray(task_node)
+    task_seq = np.asarray(task_seq)
+
+    resp = solver_pb2.DecisionsResponse(solve_ms=solve_ms,
+                                        iterations=int(iters))
+    for i in range(t):
+        kind = int(task_state[i])
+        resp.decisions.append(solver_pb2.Decision(
+            task_uid=tasks.uids[i], kind=kind,
+            node_name=(nodes.names[int(task_node[i])]
+                       if kind in (ALLOC, ALLOC_OB, PIPELINE) else ""),
+            order=int(task_seq[i]) if kind != SKIP else -1))
+    return resp
+
+
+def _solve_handler(request: bytes, context) -> bytes:
+    req = solver_pb2.SnapshotRequest.FromString(request)
+    return solve_snapshot(req).SerializeToString()
+
+
+def make_server(address: str = "127.0.0.1:0",
+                max_workers: int = 4) -> tuple:
+    """Returns (grpc.Server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    handler = grpc.method_handlers_generic_handler(SERVICE, {
+        "Solve": grpc.unary_unary_rpc_method_handler(
+            _solve_handler,
+            request_deserializer=None,   # raw bytes in
+            response_serializer=None),   # raw bytes out
+    })
+    server.add_generic_rpc_handlers((handler,))
+    port = server.add_insecure_port(address)
+    return server, port
+
+
+def serve(address: str = "127.0.0.1:50061") -> None:  # pragma: no cover
+    server, port = make_server(address)
+    server.start()
+    print(f"kubebatch-tpu solver sidecar listening on port {port}")
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    serve()

@@ -49,14 +49,29 @@ class FileLease:
             return False
 
     def try_acquire_or_renew(self) -> bool:
-        rec = self._read()
-        now = time.time()
-        if rec is not None and rec.get("holder") != self.identity:
-            expires = rec.get("renew_time", 0) + rec.get("lease_duration",
-                                                         self.lease_duration)
-            if now < expires:
-                return False  # someone else holds a live lease
-        return self._write()
+        """Read-check-write under an flock guard so two replicas racing an
+        empty/expired lease cannot both win (the reference gets this
+        atomicity from the API server's compare-and-swap)."""
+        import fcntl
+
+        guard_path = f"{self.path}.guard"
+        try:
+            guard = open(guard_path, "a+")
+        except OSError:
+            return False
+        try:
+            fcntl.flock(guard, fcntl.LOCK_EX)
+            rec = self._read()
+            now = time.time()
+            if rec is not None and rec.get("holder") != self.identity:
+                expires = rec.get("renew_time", 0) + rec.get(
+                    "lease_duration", self.lease_duration)
+                if now < expires:
+                    return False  # someone else holds a live lease
+            return self._write()
+        finally:
+            fcntl.flock(guard, fcntl.LOCK_UN)
+            guard.close()
 
     def run(self, on_started_leading: Callable[[threading.Event], None],
             on_stopped_leading: Callable[[], None],

@@ -61,13 +61,11 @@ class Scheduler:
 
     @staticmethod
     def _load_conf(conf_str: str):
-        """Malformed conf falls back to the default
-        (ref: scheduler.go:71-83)."""
+        """Only file-READ errors fall back to the default (handled by the
+        CLI); a conf that parses wrong or names an unknown action is fatal,
+        like the reference's panic (scheduler.go:80-83)."""
         if conf_str:
-            try:
-                return load_scheduler_conf(conf_str)
-            except Exception:
-                pass
+            return load_scheduler_conf(conf_str)
         return load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
 
     def run(self, stop: Optional[threading.Event] = None) -> None:
@@ -78,7 +76,11 @@ class Scheduler:
         self.cache.wait_for_cache_sync()
         while not stop.is_set():
             start = time.perf_counter()
-            self.run_once()
+            try:
+                self.run_once()
+            except Exception:  # a failed cycle must not kill the loop
+                import traceback
+                traceback.print_exc()
             elapsed = time.perf_counter() - start
             stop.wait(max(0.0, self.schedule_period - elapsed))
 
@@ -86,15 +88,19 @@ class Scheduler:
         self._stop.set()
 
     def run_once(self) -> None:
-        """One scheduling cycle (ref: scheduler.go:88-105)."""
+        """One scheduling cycle (ref: scheduler.go:88-105). CloseSession is
+        guaranteed even when an action throws (the reference defers it) so
+        status write-back happens and the loop survives."""
         start = time.perf_counter()
         ssn = OpenSession(self.cache, self.tiers, self.enable_preemption)
-        for action in self.actions:
-            action.initialize()
-            action_start = time.perf_counter()
-            action.execute(ssn)
-            update_action_duration(action.name,
-                                   time.perf_counter() - action_start)
-            action.uninitialize()
-        CloseSession(ssn)
-        update_e2e_duration(time.perf_counter() - start)
+        try:
+            for action in self.actions:
+                action.initialize()
+                action_start = time.perf_counter()
+                action.execute(ssn)
+                update_action_duration(action.name,
+                                       time.perf_counter() - action_start)
+                action.uninitialize()
+        finally:
+            CloseSession(ssn)
+            update_e2e_duration(time.perf_counter() - start)

@@ -1,0 +1,93 @@
+"""gRPC solver sidecar: snapshot in, decisions out, applied through the
+session — must match the in-process fused path."""
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import PodPhase
+from kubebatch_tpu.rpc import SolverClient, make_server
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+def tiers():
+    return [Tier(plugins=[PluginOption(name="priority"),
+                          PluginOption(name="gang")]),
+            Tier(plugins=[PluginOption(name="drf"),
+                          PluginOption(name="proportion")])]
+
+
+class RecordingBinder:
+    def __init__(self):
+        self.binds = {}
+
+    def bind(self, pod, hostname):
+        self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+        pod.node_name = hostname
+
+
+def mk_cluster():
+    binder = RecordingBinder()
+    cache = SchedulerCache(binder=binder, async_writeback=False)
+    cache.add_queue(build_queue("q1", 1))
+    cache.add_queue(build_queue("q2", 2))
+    for i in range(4):
+        cache.add_node(build_node(f"n{i}", rl(4000, 8 * GiB, pods=110)))
+    for g in range(4):
+        q = "q1" if g % 2 == 0 else "q2"
+        cache.add_pod_group(build_group("ns", f"pg{g}", 2, queue=q,
+                                        creation_timestamp=float(g)))
+        for p in range(2):
+            cache.add_pod(build_pod("ns", f"g{g}-p{p}", "", PodPhase.PENDING,
+                                    rl(1000, 2 * GiB), group=f"pg{g}"))
+    return cache, binder
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    server, port = make_server("127.0.0.1:0")
+    server.start()
+    client = SolverClient(f"127.0.0.1:{port}")
+    yield client
+    client.close()
+    server.stop(grace=None)
+
+
+def test_sidecar_matches_in_process_fused(sidecar):
+    # in-process fused
+    cache_a, binder_a = mk_cluster()
+    ssn = OpenSession(cache_a, tiers())
+    AllocateAction(mode="fused").execute(ssn)
+    CloseSession(ssn)
+    cache_a.drain(timeout=5.0)
+
+    # remote sidecar
+    cache_b, binder_b = mk_cluster()
+    ssn_b = OpenSession(cache_b, tiers())
+    resp = sidecar.solve_and_apply(ssn_b)
+    CloseSession(ssn_b)
+    cache_b.drain(timeout=5.0)
+
+    assert binder_a.binds == binder_b.binds
+    assert len(binder_b.binds) == 8
+    assert resp.solve_ms > 0
+    assert resp.iterations > 0
+
+
+def test_sidecar_gang_barrier(sidecar):
+    binder = RecordingBinder()
+    cache = SchedulerCache(binder=binder, async_writeback=False)
+    cache.add_queue(build_queue("q1"))
+    cache.add_node(build_node("n1", rl(2000, 4 * GiB, pods=110)))
+    cache.add_pod_group(build_group("ns", "pg", 3, queue="q1"))
+    for p in range(3):
+        cache.add_pod(build_pod("ns", f"p{p}", "", PodPhase.PENDING,
+                                rl(1000, 2 * GiB), group="pg"))
+    ssn = OpenSession(cache, tiers())
+    sidecar.solve_and_apply(ssn)
+    CloseSession(ssn)
+    cache.drain(timeout=5.0)
+    assert binder.binds == {}  # 3-gang cannot fit on a 2-slot node

@@ -38,10 +38,13 @@ def test_unknown_action_errors():
         load_scheduler_conf('actions: "allocate, warp-drive"\ntiers: []\n')
 
 
-def test_malformed_conf_falls_back_to_default():
-    sched = Scheduler(SchedulerCache(async_writeback=False),
-                      scheduler_conf=":::not yaml {{{")
-    assert [a.name for a in sched.actions] == ["allocate", "backfill"]
+def test_malformed_conf_is_fatal():
+    # only file-READ errors fall back (handled in the CLI); a conf that
+    # parses wrong or names an unknown action panics like the reference
+    # (scheduler.go:80-83)
+    with pytest.raises(Exception):
+        Scheduler(SchedulerCache(async_writeback=False),
+                  scheduler_conf=":::not yaml {{{")
 
 
 def test_disable_flags_parsed():
