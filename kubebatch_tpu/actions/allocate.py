@@ -93,11 +93,15 @@ class AllocateAction(Action):
         # once per visit, so such sessions take the host path until the
         # in-kernel affinity/usage carries land
         stateful = bool(ssn.predicate_fns or ssn.node_order_fns)
-        device: Optional[DeviceSession] = None
+        device = None
         if self.mode in ("jax", "fused") and not stateful:
             if ssn.device_snapshot is None:
                 ssn.device_snapshot = DeviceSession(ssn.nodes)
             device = ssn.device_snapshot
+        elif self.mode == "native" and not stateful:
+            from ..native import NativeSession, native_available
+            if native_available():
+                device = NativeSession(ssn.nodes)
 
         while not queues.empty():
             queue = queues.pop()

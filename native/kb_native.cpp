@@ -1,0 +1,140 @@
+// kb_native — native runtime components for kubebatch_tpu.
+//
+// The reference implements its scheduler loops in compiled Go; the JAX
+// kernels are this framework's TPU compute path, and this library is the
+// native HOST path: the per-visit allocate solve (predicate mask, score
+// argmax, epsilon fit, capacity carry — the same contract as
+// kernels/solver.py::_allocate_scan) over packed float32 arrays, plus the
+// resource-vector packing helpers. Used as (a) a fast CPU backend when no
+// accelerator is attached and (b) a differential oracle for the JAX
+// kernels at scales where the Python oracle is too slow.
+//
+// ABI: plain C, consumed via ctypes (no pybind11 in this image).
+// Axis order matches api/resource.py: [cpu_milli, mem_MiB, gpu_milli];
+// epsilons are 10.0 across the board after MiB scaling.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int R = 3;
+constexpr float EPS[R] = {10.0f, 10.0f, 10.0f};
+
+// decision codes — keep in sync with kernels/solver.py
+enum Decision : int32_t {
+    SKIP = 0,
+    ALLOC = 1,
+    ALLOC_OB = 2,
+    PIPELINE = 3,
+    FAIL = 4,
+};
+
+inline bool fits(const float* req, const float* avail) {
+    for (int r = 0; r < R; ++r) {
+        if (!(req[r] <= avail[r] + EPS[r])) return false;
+    }
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Convert raw resource rows [n, 3] of (cpu_milli, mem_bytes, gpu_milli)
+// float64 into MiB-scaled float32 rows (the VEC_SCALE transform).
+void kb_pack_resources(const double* raw, int64_t n, float* out) {
+    constexpr double kMiB = 1.0 / (1024.0 * 1024.0);
+    for (int64_t i = 0; i < n; ++i) {
+        out[i * R + 0] = static_cast<float>(raw[i * R + 0]);
+        out[i * R + 1] = static_cast<float>(raw[i * R + 1] * kMiB);
+        out[i * R + 2] = static_cast<float>(raw[i * R + 2]);
+    }
+}
+
+// One job visit: tasks in task-order against the node capacity carry.
+// Mirrors kernels/solver.py::_allocate_scan exactly (see its docstring
+// for the decision semantics). Arrays are modified in place:
+//   idle, releasing: [n, 3] f32; n_tasks: [n] i32
+// Inputs:
+//   backfilled [n,3], max_task_num [n], node_ok [n] (u8),
+//   resreq/init_resreq [t,3], task_valid [t] (u8),
+//   scores [t,n] f32, pred [t,n] u8,
+//   min_available, init_allocated (pipelined-inclusive ready count)
+// Outputs: decisions [t] i32, node_idx [t] i32; returns 1 if the job
+// crossed readiness.
+int32_t kb_solve_job(float* idle, float* releasing, const float* backfilled,
+                     const int32_t* max_task_num, int32_t* n_tasks,
+                     const uint8_t* node_ok, int64_t n,
+                     const float* resreq, const float* init_resreq,
+                     const uint8_t* task_valid, int64_t t,
+                     const float* scores, const uint8_t* pred,
+                     int32_t min_available, int32_t init_allocated,
+                     int32_t* decisions, int32_t* node_idx) {
+    int32_t allocated = init_allocated;
+    bool done = false;
+    for (int64_t i = 0; i < t; ++i) {
+        decisions[i] = SKIP;
+        node_idx[i] = -1;
+        if (!task_valid[i] || done) continue;
+
+        const float* treq = &resreq[i * R];
+        const float* tinit = &init_resreq[i * R];
+        const float* srow = &scores[i * n];
+        const uint8_t* prow = &pred[i * n];
+
+        // best eligible node: highest score, ties -> lowest index
+        int64_t best = -1;
+        float best_score = 0.0f;
+        bool best_alloc = false, best_idle_fit = false;
+        for (int64_t j = 0; j < n; ++j) {
+            if (!node_ok[j] || !prow[j]) continue;
+            if (n_tasks[j] >= max_task_num[j]) continue;
+            float accessible[R];
+            for (int r = 0; r < R; ++r)
+                accessible[r] = idle[j * R + r] + backfilled[j * R + r];
+            const bool fit_alloc = fits(tinit, accessible);
+            const bool fit_pipe = fits(tinit, &releasing[j * R]);
+            if (!fit_alloc && !fit_pipe) continue;
+            if (best < 0 || srow[j] > best_score) {
+                best = j;
+                best_score = srow[j];
+                best_alloc = fit_alloc;
+                best_idle_fit = fit_alloc && fits(tinit, &idle[j * R]);
+            }
+        }
+
+        if (best < 0) {
+            decisions[i] = FAIL;
+            done = true;  // job dropped (allocate.go:187-189)
+            continue;
+        }
+
+        node_idx[i] = static_cast<int32_t>(best);
+        bool counts_ready;
+        if (best_alloc && best_idle_fit) {
+            decisions[i] = ALLOC;
+            counts_ready = true;
+        } else if (best_alloc) {
+            decisions[i] = ALLOC_OB;
+            counts_ready = false;  // over-backfill stays outside the quorum
+        } else {
+            decisions[i] = PIPELINE;
+            counts_ready = true;  // pipelined-inclusive readiness
+        }
+        for (int r = 0; r < R; ++r) {
+            if (decisions[i] == PIPELINE)
+                releasing[best * R + r] -= treq[r];
+            else
+                idle[best * R + r] -= treq[r];
+        }
+        n_tasks[best] += 1;
+        if (counts_ready) allocated += 1;
+        if (allocated >= min_available) done = true;  // ready: visit ends
+    }
+    return allocated >= min_available ? 1 : 0;
+}
+
+int32_t kb_abi_version() { return 1; }
+
+}  // extern "C"

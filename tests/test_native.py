@@ -1,0 +1,143 @@
+"""Native C++ solver: must match the JAX scan bit-for-bit, at speed."""
+import time
+
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.native import NativeSession, load_native, native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="kb_native.so unavailable")
+
+
+def test_pack_resources_scaling():
+    lib = load_native()
+    raw = np.array([[1500.0, 256 * 1024 * 1024.0, 2000.0],
+                    [0.0, 1024 ** 3, 10.0]], np.float64)
+    out = np.zeros((2, 3), np.float32)
+    lib.kb_pack_resources(np.ascontiguousarray(raw), 2, out)
+    np.testing.assert_allclose(out, [[1500.0, 256.0, 2000.0],
+                                     [0.0, 1024.0, 10.0]])
+
+
+def test_native_solve_matches_jax_scan():
+    import jax.numpy as jnp
+
+    from kubebatch_tpu.kernels.solver import _allocate_scan
+    from kubebatch_tpu.kernels.tensorize import NodeState, TaskBatch
+
+    lib = load_native()
+    rng = np.random.default_rng(11)
+    for trial in range(4):
+        n, t = 64, 16
+        idle = rng.uniform(10, 200, (n, 3)).astype(np.float32)
+        releasing = rng.uniform(0, 50, (n, 3)).astype(np.float32)
+        backfilled = rng.uniform(0, 30, (n, 3)).astype(np.float32)
+        mtn = np.full(n, 20, np.int32)
+        ntasks = rng.integers(0, 3, n).astype(np.int32)
+        ok = (rng.random(n) > 0.1)
+        resreq = rng.uniform(5, 80, (t, 3)).astype(np.float32)
+        init_resreq = (resreq *
+                       rng.uniform(1.0, 1.3, (t, 1))).astype(np.float32)
+        tvalid = np.ones(t, bool)
+        scores = rng.integers(0, 5, (t, n)).astype(np.float32)
+        pred = (rng.random((t, n)) > 0.05)
+        min_av, init_alloc = 6, 0
+
+        jd, jn_, jidle, jrel, jnt, jready = [
+            np.asarray(x) for x in _allocate_scan(
+                idle, releasing, backfilled, mtn, ntasks, ok, resreq,
+                init_resreq, tvalid, scores, pred,
+                jnp.asarray(min_av, jnp.int32),
+                jnp.asarray(init_alloc, jnp.int32))]
+
+        c_idle = idle.copy()
+        c_rel = releasing.copy()
+        c_nt = ntasks.copy()
+        c_dec = np.zeros(t, np.int32)
+        c_node = np.zeros(t, np.int32)
+        ready = lib.kb_solve_job(
+            c_idle, c_rel, np.ascontiguousarray(backfilled), mtn, c_nt,
+            np.ascontiguousarray(ok.astype(np.uint8)), n,
+            np.ascontiguousarray(resreq), np.ascontiguousarray(init_resreq),
+            np.ascontiguousarray(tvalid.astype(np.uint8)), t,
+            np.ascontiguousarray(scores),
+            np.ascontiguousarray(pred.astype(np.uint8)),
+            np.int32(min_av), np.int32(init_alloc), c_dec, c_node)
+
+        np.testing.assert_array_equal(jd, c_dec, f"trial {trial} decisions")
+        placed = np.isin(c_dec, (1, 2, 3))
+        np.testing.assert_array_equal(jn_[placed], c_node[placed],
+                                      f"trial {trial} nodes")
+        np.testing.assert_allclose(jidle, c_idle, rtol=1e-6)
+        np.testing.assert_allclose(jrel, c_rel, rtol=1e-6)
+        np.testing.assert_array_equal(jnt, c_nt)
+        assert bool(jready) == bool(ready)
+
+
+def test_native_allocate_mode_end_to_end():
+    from kubebatch_tpu.actions.allocate import AllocateAction
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.conf import PluginOption, Tier
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.objects import PodPhase
+
+    from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+    results = {}
+    for mode in ("host", "native"):
+        binds = {}
+
+        class B:
+            def bind(self, pod, hostname):
+                binds[f"{pod.namespace}/{pod.name}"] = hostname
+                pod.node_name = hostname
+
+        cache = SchedulerCache(binder=B(), async_writeback=False)
+        cache.add_queue(build_queue("q1"))
+        for i in range(4):
+            cache.add_node(build_node(f"n{i}", rl(4000, 8 * GiB, pods=110)))
+        for g in range(4):
+            cache.add_pod_group(build_group("ns", f"pg{g}", 2, queue="q1",
+                                            creation_timestamp=float(g)))
+            for p in range(2):
+                cache.add_pod(build_pod("ns", f"g{g}-p{p}", "",
+                                        PodPhase.PENDING, rl(1000, 2 * GiB),
+                                        group=f"pg{g}"))
+        ssn = OpenSession(cache, [Tier(plugins=[PluginOption(name="priority"),
+                                                PluginOption(name="gang")])])
+        AllocateAction(mode=mode).execute(ssn)
+        CloseSession(ssn)
+        cache.drain(timeout=5.0)
+        results[mode] = binds
+    assert results["host"] == results["native"]
+    assert len(results["native"]) == 8
+
+
+def test_native_speed_at_scale():
+    # the native visit solve must clear 10k tasks x 1k nodes in well under
+    # a second (it exists to be the fast CPU path / big oracle)
+    lib = load_native()
+    rng = np.random.default_rng(3)
+    n, t = 1024, 8192
+    idle = rng.uniform(1000, 16000, (n, 3)).astype(np.float32)
+    releasing = np.zeros((n, 3), np.float32)
+    backfilled = np.zeros((n, 3), np.float32)
+    mtn = np.full(n, 110, np.int32)
+    ntasks = np.zeros(n, np.int32)
+    ok = np.ones(n, np.uint8)
+    resreq = rng.uniform(100, 500, (t, 3)).astype(np.float32)
+    tvalid = np.ones(t, np.uint8)
+    scores = np.zeros((t, n), np.float32)
+    pred = np.ones((t, n), np.uint8)
+    dec = np.zeros(t, np.int32)
+    node = np.zeros(t, np.int32)
+    start = time.perf_counter()
+    lib.kb_solve_job(idle, releasing, backfilled, mtn, ntasks, ok, n,
+                     np.ascontiguousarray(resreq),
+                     np.ascontiguousarray(resreq), tvalid, t,
+                     scores, pred, np.int32(t), np.int32(0), dec, node)
+    elapsed = time.perf_counter() - start
+    assert (dec == 1).sum() > 0
+    assert elapsed < 1.0, f"native solve too slow: {elapsed:.3f}s"
