@@ -130,12 +130,12 @@ def test_file_lease_run_and_loss(tmp_path):
     def work(workload_stop):
         events.append("started")
         # steal the lease from outside to force loss
-        thief = FileLease(path, lease_duration=60, identity="thief")
         with open(path, "w") as f:
             json.dump({"holder": "thief", "renew_time": time.time() + 100,
                        "lease_duration": 60}, f)
-        assert thief  # silence lint
-        workload_stop.wait(timeout=5)
+        # generous timeout: under full-suite CPU load (jit compiles) the
+        # renew loop can be delayed well past its nominal deadline
+        assert workload_stop.wait(timeout=30), "loss never detected"
         events.append("workload-stopped")
 
     def lost():
