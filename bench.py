@@ -7,7 +7,7 @@ The reference publishes no numbers (BASELINE.md: "measured, not copied");
 p50 cycle latency at the stress config — vs_baseline > 1.0 means beating
 the target.
 
-Usage: python bench.py [--config N] [--cycles M] [--mode jax|host]
+Usage: python bench.py [--config N] [--cycles M] [--mode fused|jax|host]
 """
 from __future__ import annotations
 
@@ -61,7 +61,8 @@ def main(argv=None):
     ap.add_argument("--config", type=int, default=2, choices=[1, 2, 3, 4, 5],
                     help="BASELINE config number")
     ap.add_argument("--cycles", type=int, default=4)
-    ap.add_argument("--mode", default="jax", choices=["jax", "host"])
+    ap.add_argument("--mode", default="fused",
+                    choices=["fused", "jax", "host"])
     args = ap.parse_args(argv)
 
     latencies, bound, seconds = run_config(args.config, args.cycles,

@@ -19,7 +19,7 @@ from ..api import JobInfo, TaskInfo, TaskStatus, ready_statuses
 from ..framework import Session
 from ..kernels.fused import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
                              K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
-                             K_PROP_SHARE, fused_allocate)
+                             K_PROP_SHARE, fused_allocate, unpack_host_block)
 from ..kernels.solver import DeviceSession
 from ..kernels.tensorize import TaskBatch, pad_to_bucket
 from ..kernels.terms import pred_and_score_matrices
@@ -185,8 +185,7 @@ def execute_fused(ssn: Session) -> None:
     max_iters = int(t_pad + 3 * j_pad + q_pad + 8)
 
     start = time.perf_counter()
-    (task_state, task_node, task_seq, idle_f, rel_f, ntasks_f,
-     iters) = fused_allocate(
+    (host_block, idle_f, rel_f, ntasks_f) = fused_allocate(
         device.idle, device.releasing, device.backfilled,
         device.max_task_num, device.n_tasks, device.node_ok,
         jnp.asarray(batch.resreq), jnp.asarray(batch.init_resreq),
@@ -203,9 +202,8 @@ def execute_fused(ssn: Session) -> None:
         job_keys=job_keys, queue_keys=queue_keys,
         gang_enabled=gang, prop_overused=prop_overused,
         max_iters=max_iters)
-    task_state = np.asarray(task_state)
-    task_node = np.asarray(task_node)
-    task_seq = np.asarray(task_seq)
+    host_block = np.asarray(host_block)   # the cycle's ONE blocking read
+    task_state, task_node, task_seq, _ = unpack_host_block(host_block)
     device.idle, device.releasing, device.n_tasks = idle_f, rel_f, ntasks_f
     update_solver_kernel_duration("fused_allocate",
                                   time.perf_counter() - start)

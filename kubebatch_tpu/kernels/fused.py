@@ -76,6 +76,14 @@ def _lex_argmin(keys, valid):
     return jnp.where(jnp.any(mask), idx, -1)
 
 
+def unpack_host_block(host_block):
+    """Decode fused_allocate's packed host block into
+    (task_state, task_node, task_seq, iters). Counterpart of the encoding
+    at the bottom of fused_allocate — keep the two in sync."""
+    task_state, task_node, task_seq = host_block[:, :-1]
+    return task_state, task_node, task_seq, host_block[0, -1]
+
+
 class FusedState(NamedTuple):
     idle: jnp.ndarray          # [N,R]
     releasing: jnp.ndarray     # [N,R]
@@ -260,5 +268,12 @@ def fused_allocate(
         task_seq=jnp.full(t, jnp.iinfo(jnp.int32).max, jnp.int32),
         current_job=jnp.int32(-1), seq=jnp.int32(0), it=jnp.int32(0))
     final = jax.lax.while_loop(cond, body, init)
-    return (final.task_state, final.task_node, final.task_seq, final.idle,
-            final.releasing, final.n_tasks, final.it)
+    # everything the host must read back travels in ONE int32 block —
+    # row 0 task_state, row 1 task_node, row 2 task_seq, and the iteration
+    # count in the extra trailing column — so applying the cycle's
+    # decisions costs a single device->host transfer (the axon tunnel
+    # charges a full round trip per blocking read)
+    host_block = jnp.concatenate(
+        [jnp.stack([final.task_state, final.task_node, final.task_seq]),
+         jnp.broadcast_to(final.it, (3, 1))], axis=1)
+    return host_block, final.idle, final.releasing, final.n_tasks

@@ -17,7 +17,7 @@ import numpy as np
 
 from ..kernels.fused import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
                              K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
-                             K_PROP_SHARE, fused_allocate)
+                             K_PROP_SHARE, fused_allocate, unpack_host_block)
 from ..kernels.tensorize import pad_to_bucket
 from . import solver_pb2
 
@@ -119,7 +119,7 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
     j_alloc0 = np.zeros((j_pad, 3), np.float32)
 
     start = time.perf_counter()
-    (task_state, task_node, task_seq, *_rest, iters) = fused_allocate(
+    (host_block, *_device_state) = fused_allocate(
         idle, releasing, backfilled, mtn, ntasks, node_ok,
         jnp.asarray(resreq), jnp.asarray(init_resreq),
         jnp.asarray(task_job), jnp.asarray(task_rank),
@@ -136,9 +136,8 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
         prop_overused=req.proportion_enabled,
         max_iters=int(t_pad + 3 * j_pad + q_pad + 8))
     solve_ms = (time.perf_counter() - start) * 1e3
-    task_state = np.asarray(task_state)
-    task_node = np.asarray(task_node)
-    task_seq = np.asarray(task_seq)
+    host_block = np.asarray(host_block)   # one device->host transfer
+    task_state, task_node, task_seq, iters = unpack_host_block(host_block)
 
     resp = solver_pb2.DecisionsResponse(solve_ms=solve_ms,
                                         iterations=int(iters))
