@@ -27,8 +27,15 @@ def run_config(config: int, cycles: int, mode: str):
     from kubebatch_tpu.framework import CloseSession, OpenSession
     from kubebatch_tpu.sim import baseline_cluster
 
+    # the shipped config's full multi-tier stack (config/kube-batch-conf.yaml
+    # parity; BASELINE cfg5 calls for the full stack)
     tiers = [Tier(plugins=[PluginOption(name="priority"),
-                           PluginOption(name="gang")])]
+                           PluginOption(name="gang"),
+                           PluginOption(name="conformance")]),
+             Tier(plugins=[PluginOption(name="drf"),
+                           PluginOption(name="predicates"),
+                           PluginOption(name="proportion"),
+                           PluginOption(name="nodeorder")])]
 
     latencies = []
     bound_total = 0

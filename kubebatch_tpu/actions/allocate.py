@@ -28,7 +28,8 @@ from ..framework import Action, Session, register_action
 from ..kernels.solver import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
                               DeviceSession)
 from ..kernels.tensorize import TaskBatch
-from ..kernels.terms import pred_and_score_matrices
+from ..kernels.terms import (device_supported, pred_and_score_matrices,
+                             solver_terms)
 from ..util import PriorityQueue, select_best_node
 
 
@@ -67,16 +68,18 @@ class AllocateAction(Action):
     def execute(self, ssn: Session) -> None:
         if self.mode == "fused":
             from .allocate_fused import execute_fused, fused_supported
-            if fused_supported(ssn):
-                execute_fused(ssn)
+            # execute_fused itself returns False (without consuming state)
+            # when the snapshot carries features the kernel can't model
+            if fused_supported(ssn) and execute_fused(ssn):
                 return
-            # configured plugins exceed the fused key vocabulary; fall back
-            # to the per-visit device solver
+            # configured plugins exceed the fused vocabulary; fall back to
+            # the per-visit device solver
         self._execute_queued(ssn)
 
     def _execute_queued(self, ssn: Session) -> None:
         queues = PriorityQueue(ssn.queue_order_fn)
         jobs_map: Dict[str, PriorityQueue] = {}
+        pending_all: List[TaskInfo] = []
         for job in ssn.jobs.values():
             queue = ssn.queues.get(job.queue)
             if queue is None:
@@ -85,20 +88,30 @@ class AllocateAction(Action):
             queues.push(queue)
             jobs_map.setdefault(job.queue, PriorityQueue(ssn.job_order_fn))
             jobs_map[job.queue].push(job)
+            pending_all.extend(
+                t for t in job.task_status_index.get(TaskStatus.PENDING,
+                                                     {}).values()
+                if not t.resreq.is_empty())
 
         pending_tasks: Dict[str, PriorityQueue] = {}
-        # predicate/node-order callbacks read session state that mutates
-        # DURING a visit (anti-affinity vs a just-assigned task,
-        # least-requested vs fresh usage); the batched scan evaluates them
-        # once per visit, so such sessions take the host path until the
-        # in-kernel affinity/usage carries land
-        stateful = bool(ssn.predicate_fns or ssn.node_order_fns)
+        # registered predicate/node-order callbacks run on device when
+        # kernels/terms can express them (static sig matrices + in-kernel
+        # least-requested/balanced terms); snapshots with features the
+        # kernels can't model (inter-pod affinity, pending host ports,
+        # third-party callbacks) take the reference-literal host path
         device = None
-        if self.mode in ("jax", "fused") and not stateful:
+        terms = None
+        if self.mode in ("jax", "fused") \
+                and device_supported(ssn, pending_all):
+            # the cheap gate above keeps fallback cycles from paying the
+            # full-cluster tensorize + device upload
             if ssn.device_snapshot is None:
                 ssn.device_snapshot = DeviceSession(ssn.nodes)
-            device = ssn.device_snapshot
-        elif self.mode == "native" and not stateful:
+            terms = solver_terms(ssn, ssn.device_snapshot, pending_all)
+            if terms is not None:
+                device = ssn.device_snapshot
+        elif self.mode == "native" and not (ssn.predicate_fns
+                                            or ssn.node_order_fns):
             from ..native import NativeSession, native_available
             if native_available():
                 device = NativeSession(ssn.nodes)
@@ -123,7 +136,8 @@ class AllocateAction(Action):
 
             if not tasks.empty():
                 if device is not None:
-                    self._visit_job_device(ssn, device, job, tasks, jobs)
+                    self._visit_job_device(ssn, device, job, tasks, jobs,
+                                           terms)
                 else:
                     self._visit_job_host(ssn, job, tasks, jobs)
 
@@ -134,15 +148,20 @@ class AllocateAction(Action):
     # ------------------------------------------------------------------
     def _visit_job_device(self, ssn: Session, device: DeviceSession,
                           job: JobInfo, tasks: PriorityQueue,
-                          jobs: PriorityQueue) -> None:
+                          jobs: PriorityQueue, terms=None) -> None:
         ordered: List[TaskInfo] = []
         while not tasks.empty():
             ordered.append(tasks.pop())
         batch = TaskBatch.from_tasks(ordered)
-        scores, pred = pred_and_score_matrices(ssn, device, batch)
+        if terms is not None:
+            scores, pred = terms.matrices(batch)
+            dyn = terms.dynamic
+        else:
+            scores, pred = pred_and_score_matrices(ssn, device, batch)
+            dyn = None
         decisions, _ = device.solve_job(
             batch, _effective_min_available(ssn, job), _init_allocated(job),
-            scores=scores, pred_mask=pred)
+            scores=scores, pred_mask=pred, dyn=dyn)
         try:
             for task, dec in zip(ordered, decisions):
                 if dec.kind == ALLOC:

@@ -22,7 +22,7 @@ from ..kernels.fused import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
                              K_PROP_SHARE, fused_allocate, unpack_host_block)
 from ..kernels.solver import DeviceSession
 from ..kernels.tensorize import TaskBatch, pad_to_bucket
-from ..kernels.terms import pred_and_score_matrices
+from ..kernels.terms import device_supported, solver_terms
 from ..metrics import update_solver_kernel_duration
 
 #: job-order plugins the kernel can express, in any tier order
@@ -60,17 +60,17 @@ def _queue_order_spec(ssn: Session) -> Tuple[Tuple[str, ...], bool]:
 def fused_supported(ssn: Session) -> bool:
     """The fused kernel expresses the built-in order/fairness plugins; any
     custom job/queue order, overused, or ready fn falls back to the
-    per-visit path. Predicate / node-order fns also fall back for now: the
-    fused kernel evaluates scores and masks once from cycle-start state,
-    which is wrong for allocation-dependent plugins (least-requested,
-    pod-affinity); their in-kernel terms land with the predicates/nodeorder
-    port."""
+    per-visit path. Predicate / node-order callbacks are supported through
+    kernels/terms.solver_terms — static terms as sig-indexed matrices,
+    least-requested / balanced-resource in-kernel; snapshots with
+    allocation-dependent features the kernels can't model (inter-pod
+    affinity, pending host ports — terms.py) are rejected inside
+    execute_fused, which then returns False."""
     _, ok_j = _job_order_spec(ssn)
     _, ok_q = _queue_order_spec(ssn)
     custom_overused = any(name != "proportion" for name in ssn.overused_fns)
     custom_ready = any(name != "gang" for name in ssn.job_ready_fns)
-    return (ok_j and ok_q and not custom_overused and not custom_ready
-            and not ssn.predicate_fns and not ssn.node_order_fns)
+    return ok_j and ok_q and not custom_overused and not custom_ready
 
 
 def _gang_enabled(ssn: Session) -> bool:
@@ -81,11 +81,10 @@ def _gang_enabled(ssn: Session) -> bool:
     return False
 
 
-def execute_fused(ssn: Session) -> None:
-    if ssn.device_snapshot is None:
-        ssn.device_snapshot = DeviceSession(ssn.nodes)
-    device: DeviceSession = ssn.device_snapshot
-
+def execute_fused(ssn: Session) -> bool:
+    """Run the whole allocate action as one dispatch. Returns False —
+    without consuming any state — when the snapshot has features the
+    kernel can't express (the caller falls back to the host path)."""
     # ---- queues ----------------------------------------------------------
     queue_ids = sorted(ssn.queues)          # uid order = order fallback
     q_index = {q: i for i, q in enumerate(queue_ids)}
@@ -115,7 +114,17 @@ def execute_fused(ssn: Session) -> None:
             task_job_idx.append(j_index[j.uid])
             task_ranks.append(rank)
     if not tasks:
-        return
+        return True
+    # cheap feature gate BEFORE tensorizing/uploading the cluster — a
+    # fallback cycle must not pay the device transfer
+    if not device_supported(ssn, tasks):
+        return False
+    if ssn.device_snapshot is None:
+        ssn.device_snapshot = DeviceSession(ssn.nodes)
+    device: DeviceSession = ssn.device_snapshot
+    terms = solver_terms(ssn, device, tasks)
+    if terms is None:
+        return False
     batch = TaskBatch.from_tasks(tasks)
     t_pad = batch.t_padded
 
@@ -179,18 +188,29 @@ def execute_fused(ssn: Session) -> None:
             if attr is not None:
                 j_alloc0[j_index[j.uid]] = attr.allocated.to_vec()
 
-    # ---- scores / predicates --------------------------------------------
-    scores, pred = pred_and_score_matrices(ssn, device, batch)
+    # ---- scores / predicates (sig-indexed static + in-kernel dynamic) ---
+    task_sig = terms.task_sig(tasks, t_pad)
+    s_pad = pad_to_bucket(terms.static.n_sigs, 4)
+    sig_scores = np.zeros((s_pad, device.n_padded), np.float32)
+    sig_pred = np.zeros((s_pad, device.n_padded), bool)
+    sig_scores[:terms.static.n_sigs] = terms.static.score
+    sig_pred[:terms.static.n_sigs] = terms.static.pred
+    dyn_enabled = terms.dynamic.enabled
+    dyn_weights = np.asarray([terms.dynamic.least_requested,
+                              terms.dynamic.balanced_resource], np.float32)
 
     max_iters = int(t_pad + 3 * j_pad + q_pad + 8)
 
     start = time.perf_counter()
-    (host_block, idle_f, rel_f, ntasks_f) = fused_allocate(
+    (host_block, idle_f, rel_f, ntasks_f, nz_f) = fused_allocate(
         device.idle, device.releasing, device.backfilled,
+        device.allocatable_cm, device.nz_req,
         device.max_task_num, device.n_tasks, device.node_ok,
         jnp.asarray(batch.resreq), jnp.asarray(batch.init_resreq),
-        jnp.asarray(task_job), jnp.asarray(task_rank),
-        jnp.asarray(batch.valid), jnp.asarray(scores), jnp.asarray(pred),
+        jnp.asarray(batch.nz_req), jnp.asarray(task_job),
+        jnp.asarray(task_rank), jnp.asarray(task_sig),
+        jnp.asarray(batch.valid), jnp.asarray(sig_scores),
+        jnp.asarray(sig_pred),
         jnp.asarray(min_av), jnp.asarray(order_min_av),
         jnp.asarray(init_alloc), jnp.asarray(job_queue),
         jnp.asarray(job_priority), jnp.asarray(job_create_rank),
@@ -199,12 +219,14 @@ def execute_fused(ssn: Session) -> None:
         jnp.asarray(q_create_rank), jnp.asarray(q_deserved),
         jnp.asarray(q_alloc0),
         jnp.asarray(j_alloc0), jnp.asarray(cluster_total),
+        jnp.asarray(dyn_weights),
         job_keys=job_keys, queue_keys=queue_keys,
         gang_enabled=gang, prop_overused=prop_overused,
-        max_iters=max_iters)
+        dyn_enabled=dyn_enabled, max_iters=max_iters)
     host_block = np.asarray(host_block)   # the cycle's ONE blocking read
     task_state, task_node, task_seq, _ = unpack_host_block(host_block)
     device.idle, device.releasing, device.n_tasks = idle_f, rel_f, ntasks_f
+    device.nz_req = nz_f
     update_solver_kernel_duration("fused_allocate",
                                   time.perf_counter() - start)
 
@@ -238,3 +260,4 @@ def execute_fused(ssn: Session) -> None:
         # device state holds phantom allocations — rebuild from host truth
         device.resync(ssn.nodes)
         raise
+    return True

@@ -1,0 +1,233 @@
+"""Static-term encoder: (task signature x node profile) -> dense matrices.
+
+The reference evaluates predicates and node scores per (task, node) call
+(plugins/predicates/predicates.go, plugins/nodeorder/nodeorder.go). Most of
+those checks are *static* within a scheduling cycle — they read only pod
+spec fields and node labels/taints, which no action mutates. This module
+evaluates them once per (unique task signature, unique node profile) pair —
+reusing the host matcher functions verbatim, so semantics cannot drift —
+and broadcasts the results to dense ``[S, N_pad]`` matrices the solver
+kernels index by ``task_sig``.
+
+Why signatures/profiles: pods of one PodGroup share a template, and nodes
+share label shapes, so S and P are tiny (≈ #jobs, #node-pools) while T x N
+is huge (10k x 5k at the stress config). The Python cost is O(S x P); the
+broadcast is a numpy gather.
+
+Dynamic terms are NOT encoded here:
+- least-requested / balanced-resource scores depend on each node's running
+  request sum, which changes with every in-cycle assignment — the solver
+  kernels compute them from the capacity carry (kernels/solver.py,
+  kernels/fused.py), mirroring nodeorder.go's per-call recompute.
+- inter-pod (anti-)affinity and host-port conflicts depend on in-cycle
+  assignments in ways the kernels don't model yet; `dynamic_features`
+  detects them and the allocate action falls back to the host path
+  (actions/allocate.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..api import TaskInfo
+from ..objects import Pod
+from ..plugins.predicates import match_node_selector, tolerates_node_taints
+from .tensorize import NodeState
+
+
+def _expr_key(e) -> Tuple:
+    return (e.key, e.operator, tuple(e.values))
+
+
+def _term_key(term) -> Tuple:
+    return tuple(_expr_key(e) for e in term.match_expressions)
+
+
+def _node_affinity_keys(pod: Pod) -> Tuple[Tuple, Tuple]:
+    """(required, preferred) signature components of a pod's node affinity."""
+    aff = pod.affinity
+    if aff is None or aff.node_affinity is None:
+        return (), ()
+    req = tuple(_term_key(t) for t in aff.node_affinity.required)
+    pref = tuple((w, _term_key(t)) for w, t in aff.node_affinity.preferred)
+    return req, pref
+
+
+def _toleration_key(pod: Pod) -> Tuple:
+    return tuple((t.key, t.operator, t.value, t.effect)
+                 for t in pod.tolerations)
+
+
+def task_signature(pod: Pod) -> Tuple:
+    """Everything the static predicate/score terms read from the pod."""
+    na_req, na_pref = _node_affinity_keys(pod)
+    return (tuple(sorted(pod.node_selector.items())), na_req, na_pref,
+            _toleration_key(pod))
+
+
+def referenced_label_keys(pods: Sequence[Pod]) -> Set[str]:
+    """Label keys the pod set can observe on nodes — the node profile only
+    needs to distinguish nodes on these keys."""
+    keys: Set[str] = set()
+    for pod in pods:
+        keys.update(pod.node_selector)
+        aff = pod.affinity
+        if aff is not None and aff.node_affinity is not None:
+            for term in aff.node_affinity.required:
+                keys.update(e.key for e in term.match_expressions)
+            for _, term in aff.node_affinity.preferred:
+                keys.update(e.key for e in term.match_expressions)
+    return keys
+
+
+class _FakeNode:
+    """Just enough node for tolerates_node_taints."""
+    __slots__ = ("taints",)
+
+    def __init__(self, taints):
+        self.taints = taints
+
+
+@dataclass
+class StaticTerms:
+    """Sig-indexed static predicate mask and score for one cycle.
+
+    ``pred``/``score`` rows are per unique task signature; ``sig_of`` maps a
+    TaskInfo uid to its row. Columns follow NodeState order (padded columns
+    are masked by the kernels' node validity, not here).
+    """
+    pred: np.ndarray            # [S, N_pad] bool
+    score: np.ndarray           # [S, N_pad] float32
+    sig_of: Dict[str, int]      # task uid -> sig row
+
+    def task_rows(self, tasks: Sequence[TaskInfo], t_pad: int
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """Gather [T_pad, N] score/pred matrices for a task batch."""
+        sig = np.zeros(t_pad, np.int32)
+        for i, t in enumerate(tasks):
+            sig[i] = self.sig_of[t.uid]
+        return self.score[sig], self.pred[sig]
+
+    def task_sig(self, tasks: Sequence[TaskInfo], t_pad: int) -> np.ndarray:
+        sig = np.zeros(t_pad, np.int32)
+        for i, t in enumerate(tasks):
+            sig[i] = self.sig_of[t.uid]
+        return sig
+
+    @property
+    def n_sigs(self) -> int:
+        return self.pred.shape[0]
+
+
+def build_static_terms(state: NodeState, tasks: Sequence[TaskInfo],
+                       node_labels: Dict[str, Dict[str, str]],
+                       node_taints: Dict[str, list],
+                       with_predicates: bool,
+                       with_node_affinity_score: bool,
+                       node_affinity_weight: int = 1) -> StaticTerms:
+    """Evaluate static terms per (signature, profile) and broadcast.
+
+    node_labels/node_taints are keyed by node name (NodeState column order
+    comes from state.names).
+    """
+    pods = [t.pod for t in tasks]
+    rel_keys = tuple(sorted(referenced_label_keys(pods)))
+
+    # --- unique task signatures --------------------------------------
+    sig_of: Dict[str, int] = {}
+    sig_pods: List[Pod] = []          # exemplar pod per signature
+    sig_index: Dict[Tuple, int] = {}
+    for t in tasks:
+        key = task_signature(t.pod)
+        s = sig_index.get(key)
+        if s is None:
+            s = len(sig_pods)
+            sig_index[key] = s
+            sig_pods.append(t.pod)
+        sig_of[t.uid] = s
+    n_sigs = max(1, len(sig_pods))
+
+    # --- unique node profiles ----------------------------------------
+    profile_of = np.zeros(state.n_padded, np.int32)
+    profiles: List[Tuple[Dict[str, str], list]] = []
+    prof_index: Dict[Tuple, int] = {}
+    for col, name in enumerate(state.names):
+        labels = node_labels.get(name, {})
+        taints = node_taints.get(name, [])
+        restricted = {k: labels[k] for k in rel_keys if k in labels}
+        key = (tuple(sorted(restricted.items())),
+               tuple((t.key, t.value, t.effect) for t in taints))
+        p = prof_index.get(key)
+        if p is None:
+            p = len(profiles)
+            prof_index[key] = p
+            profiles.append((restricted, taints))
+        profile_of[col] = p
+    n_prof = max(1, len(profiles))
+
+    # --- evaluate per (sig, profile) via the host matchers ------------
+    pred_sp = np.ones((n_sigs, n_prof), bool)
+    score_sp = np.zeros((n_sigs, n_prof), np.float32)
+    for s, pod in enumerate(sig_pods):
+        aff = pod.affinity
+        preferred = (aff.node_affinity.preferred
+                     if (aff is not None and aff.node_affinity is not None)
+                     else [])
+        for p, (labels, taints) in enumerate(profiles):
+            if with_predicates:
+                ok = (match_node_selector(pod, labels)
+                      and tolerates_node_taints(pod, _FakeNode(taints)))
+                pred_sp[s, p] = ok
+            if with_node_affinity_score and preferred:
+                total = sum(w for w, term in preferred
+                            if term.matches(labels))
+                score_sp[s, p] = total * node_affinity_weight
+
+    # --- broadcast to [S, N_pad] --------------------------------------
+    return StaticTerms(pred=pred_sp[:, profile_of],
+                       score=score_sp[:, profile_of], sig_of=sig_of)
+
+
+# ---------------------------------------------------------------------
+# dynamic-feature detection (forces the host path)
+# ---------------------------------------------------------------------
+
+def _has_pod_affinity(pod: Pod) -> bool:
+    aff = pod.affinity
+    if aff is None:
+        return False
+    return bool(aff.pod_affinity_required or aff.pod_anti_affinity_required
+                or aff.pod_affinity_preferred
+                or aff.pod_anti_affinity_preferred)
+
+
+def dynamic_features(ssn, pending: Sequence[TaskInfo]) -> Optional[str]:
+    """Why this snapshot can't use the static encoder, or None if it can.
+
+    - a pending task with host ports can conflict with a port claimed by an
+      assignment made earlier in the same cycle (predicates.go's session-
+      backed host-port check);
+    - any pod with inter-pod (anti-)affinity makes both the affinity
+      predicate and nodeorder's interpod score allocation-dependent
+      (including the symmetry checks that affect OTHER pods).
+    """
+    for t in pending:
+        if t.pod.host_ports():
+            return "pending task with host ports"
+    for t in pending:
+        if _has_pod_affinity(t.pod):
+            return "pending task with pod (anti-)affinity"
+    for job in ssn.jobs.values():
+        for task in job.tasks.values():
+            if _has_pod_affinity(task.pod):
+                return "existing pod with pod (anti-)affinity"
+    # standalone pods sitting on nodes (outside any job) can still reject
+    # others through anti-affinity symmetry; existing pods' host PORTS only
+    # matter to port-requesting pending tasks, screened above
+    for node in ssn.nodes.values():
+        for task in node.tasks.values():
+            if _has_pod_affinity(task.pod):
+                return "existing pod with pod (anti-)affinity"
+    return None

@@ -88,6 +88,7 @@ class FusedState(NamedTuple):
     idle: jnp.ndarray          # [N,R]
     releasing: jnp.ndarray     # [N,R]
     n_tasks: jnp.ndarray       # [N]
+    nz_req: jnp.ndarray        # [N,2] nonzero (cpu,mem) request sums
     entries: jnp.ndarray       # [Q] remaining queue entries
     q_allocated: jnp.ndarray   # [Q,R] proportion allocated
     j_allocated: jnp.ndarray   # [J,R] drf allocated
@@ -102,13 +103,17 @@ class FusedState(NamedTuple):
 
 
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys", "gang_enabled",
-                                   "prop_overused", "max_iters"))
+                                   "prop_overused", "dyn_enabled",
+                                   "max_iters"))
 def fused_allocate(
         # nodes
-        idle, releasing, backfilled, max_task_num, n_tasks, node_ok,
-        # tasks
-        resreq, init_resreq, task_job, task_rank, task_valid, scores,
-        pred_mask,
+        idle, releasing, backfilled, allocatable_cm, nz_req0, max_task_num,
+        n_tasks, node_ok,
+        # tasks; sig_scores/sig_pred are [S,N] rows indexed by task_sig[T]
+        # (pods sharing a template share a row — the upload stays small at
+        # 10k x 5k scale)
+        resreq, init_resreq, task_nz, task_job, task_rank, task_sig,
+        task_valid, sig_scores, sig_pred,
         # jobs; min_available gates readiness/dispatch (zeroed when the
         # configured job-ready fn is disabled), order_min_available feeds
         # the gang ready-last ORDER key (always the true MinAvailable)
@@ -118,12 +123,18 @@ def fused_allocate(
         q_weight, q_entries, q_create_rank, q_deserved, q_alloc0,
         # drf
         j_alloc0, cluster_total,
+        # dynamic nodeorder terms: [least_requested_w, balanced_resource_w]
+        dyn_weights=None,
         # static config
         job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY, K_DRF_SHARE),
         queue_keys: Tuple[str, ...] = (K_PROP_SHARE,),
         gang_enabled: bool = True,
         prop_overused: bool = True,
+        dyn_enabled: bool = False,
         max_iters: int = 0):
+    from .solver import dynamic_node_score
+    if dyn_weights is None:
+        dyn_weights = jnp.zeros(2, jnp.float32)
     eps = jnp.asarray(VEC_EPS)
     n_nodes = idle.shape[0]
     n_jobs = min_available.shape[0]
@@ -187,12 +198,16 @@ def fused_allocate(
         t_init = init_resreq[ti]
         accessible = s.idle + backfilled
         room = s.n_tasks < max_task_num
-        pred = node_ok & room & pred_mask[ti]
+        pred = node_ok & room & sig_pred[task_sig[ti]]
         fit_alloc = jnp.all(t_init <= accessible + eps, axis=-1)
         fit_idle = jnp.all(t_init <= s.idle + eps, axis=-1)
         fit_pipe = jnp.all(t_init <= s.releasing + eps, axis=-1)
         eligible = pred & (fit_alloc | fit_pipe)
-        masked = jnp.where(eligible, scores[ti], -jnp.inf)
+        score = sig_scores[task_sig[ti]]
+        if dyn_enabled:
+            score = score + dynamic_node_score(s.nz_req, task_nz[ti],
+                                               allocatable_cm, dyn_weights)
+        masked = jnp.where(eligible, score, -jnp.inf)
         best = jnp.argmax(masked)
         feasible = eligible[best] & have_task
         is_alloc = fit_alloc[best]
@@ -217,6 +232,8 @@ def fused_allocate(
         new_idle = s.idle - jnp.where(is_alloc, 1.0, 0.0) * take
         new_releasing = s.releasing - jnp.where(is_alloc, 0.0, 1.0) * take
         new_ntasks = s.n_tasks + one_hot.astype(jnp.int32)
+        new_nz = s.nz_req + jnp.where(one_hot[:, None],
+                                      task_nz[ti][None, :], 0.0)
 
         # fairness updates fire for EVERY assignment kind; use the job's
         # own queue (during a resumed visit qi is this iteration's argmin
@@ -245,7 +262,7 @@ def fused_allocate(
 
         return FusedState(
             idle=new_idle, releasing=new_releasing, n_tasks=new_ntasks,
-            entries=new_entries, q_allocated=new_q_alloc,
+            nz_req=new_nz, entries=new_entries, q_allocated=new_q_alloc,
             j_allocated=new_j_alloc, alloc_cnt=new_alloc_cnt,
             job_in_pq=new_job_in_pq, task_state=new_task_state,
             task_node=new_task_node, task_seq=new_task_seq,
@@ -258,7 +275,7 @@ def fused_allocate(
 
     t = task_valid.shape[0]
     init = FusedState(
-        idle=idle, releasing=releasing, n_tasks=n_tasks,
+        idle=idle, releasing=releasing, n_tasks=n_tasks, nz_req=nz_req0,
         entries=q_entries.astype(jnp.int32),
         q_allocated=q_alloc0, j_allocated=j_alloc0,
         alloc_cnt=init_allocated.astype(jnp.int32),
@@ -276,4 +293,5 @@ def fused_allocate(
     host_block = jnp.concatenate(
         [jnp.stack([final.task_state, final.task_node, final.task_seq]),
          jnp.broadcast_to(final.it, (3, 1))], axis=1)
-    return host_block, final.idle, final.releasing, final.n_tasks
+    return (host_block, final.idle, final.releasing, final.n_tasks,
+            final.nz_req)

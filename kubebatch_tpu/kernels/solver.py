@@ -47,6 +47,7 @@ class _Carry(NamedTuple):
     idle: jnp.ndarray        # [N,R]
     releasing: jnp.ndarray   # [N,R]
     n_tasks: jnp.ndarray     # [N]
+    nz_req: jnp.ndarray      # [N,2] nonzero (cpu,mem) request sums
     allocated: jnp.ndarray   # scalar i32: ALLOC count so far (incl. initial)
     done: jnp.ndarray        # scalar bool
 
@@ -54,18 +55,46 @@ class _Carry(NamedTuple):
 class _TaskIn(NamedTuple):
     resreq: jnp.ndarray       # [R]
     init_resreq: jnp.ndarray  # [R]
+    nz: jnp.ndarray           # [2] nonzero (cpu,mem) request
     valid: jnp.ndarray        # scalar bool
     score: jnp.ndarray        # [N]
     pred: jnp.ndarray         # [N] per-task predicate mask
 
 
-@partial(jax.jit, donate_argnums=())
-def _allocate_scan(idle, releasing, backfilled, max_task_num, n_tasks,
-                   node_ok, resreq, init_resreq, task_valid, scores,
-                   pred_mask, min_available, init_allocated):
-    """One job visit. Shapes: nodes [N,R]/[N]; tasks [T,R]/[T]; scores and
-    pred_mask [T,N]. Returns (decisions[T], node_idx[T], new_idle,
-    new_releasing, new_n_tasks, became_ready)."""
+def dynamic_node_score(nz_req, t_nz, allocatable_cm, dyn_weights):
+    """nodeorder's allocation-dependent terms, from the capacity carry.
+
+    Mirrors plugins/nodeorder.py least_requested_score /
+    balanced_resource_score (upstream k8s-1.13 arithmetic) over all nodes
+    at once. The Go integer division ``((cap - req) * 10) // cap`` is
+    evaluated as a threshold count (how many d in 1..10 satisfy
+    (cap-req)*10 >= d*cap) — division-free, so float32 rounding can only
+    bite when a product pair is genuinely within f32 ulp of equal.
+    dyn_weights: [least_requested_w, balanced_resource_w] float32.
+    """
+    req = nz_req + t_nz[None, :]                      # [N,2]
+    cap = allocatable_cm                              # [N,2]
+    d = jnp.arange(1.0, 11.0, dtype=jnp.float32)      # [10]
+    ge = ((cap - req)[None] * 10.0 >= d[:, None, None] * cap[None])
+    dim = jnp.where((cap > 0) & (req <= cap),
+                    ge.sum(axis=0).astype(jnp.float32), 0.0)   # [N,2]
+    least = jnp.floor((dim[:, 0] + dim[:, 1]) / 2.0)
+
+    frac = jnp.where(cap > 0, req / cap, 1.0)
+    diff = jnp.abs(frac[:, 0] - frac[:, 1])
+    balanced = jnp.where((frac[:, 0] >= 1.0) | (frac[:, 1] >= 1.0), 0.0,
+                         jnp.trunc(10.0 - diff * 10.0))
+    return least * dyn_weights[0] + balanced * dyn_weights[1]
+
+
+@partial(jax.jit, static_argnames=("dyn_enabled",))
+def _allocate_scan(idle, releasing, backfilled, allocatable_cm, nz_req,
+                   max_task_num, n_tasks, node_ok, resreq, init_resreq,
+                   task_nz, task_valid, scores, pred_mask, min_available,
+                   init_allocated, dyn_weights, dyn_enabled: bool = False):
+    """One job visit. Shapes: nodes [N,R]/[N,2]/[N]; tasks [T,R]/[T,2]/[T];
+    scores and pred_mask [T,N]. Returns (decisions[T], node_idx[T],
+    new_idle, new_releasing, new_n_tasks, new_nz_req, became_ready)."""
     eps = jnp.asarray(VEC_EPS)
 
     def step(carry: _Carry, t: _TaskIn):
@@ -76,7 +105,11 @@ def _allocate_scan(idle, releasing, backfilled, max_task_num, n_tasks,
         fit_idle = jnp.all(t.init_resreq <= carry.idle + eps, axis=-1)
         fit_pipe = jnp.all(t.init_resreq <= carry.releasing + eps, axis=-1)
         eligible = pred & (fit_alloc | fit_pipe)
-        masked_score = jnp.where(eligible, t.score, -jnp.inf)
+        score = t.score
+        if dyn_enabled:
+            score = score + dynamic_node_score(carry.nz_req, t.nz,
+                                               allocatable_cm, dyn_weights)
+        masked_score = jnp.where(eligible, score, -jnp.inf)
         best = jnp.argmax(masked_score)
         feasible = eligible[best]
 
@@ -98,6 +131,10 @@ def _allocate_scan(idle, releasing, backfilled, max_task_num, n_tasks,
         new_idle = carry.idle - one_hot[:, None] * alloc_take[None, :]
         new_rel = carry.releasing - one_hot[:, None] * pipe_take[None, :]
         new_ntasks = carry.n_tasks + (one_hot & do).astype(jnp.int32)
+        # every assignment kind lands in node.tasks host-side, so each one
+        # feeds the nonzero-request sums the dynamic scores read
+        new_nz = carry.nz_req + jnp.where(
+            do, one_hot[:, None] * t.nz[None, :], 0.0)
 
         # readiness counts plain Allocated AND Pipelined (gang's
         # pipelined-inclusive ready_task_num); only AllocatedOverBackfill
@@ -108,17 +145,18 @@ def _allocate_scan(idle, releasing, backfilled, max_task_num, n_tasks,
         new_done = carry.done | (active & ~feasible) | (do & ready_now)
 
         out = (decision.astype(jnp.int32), best.astype(jnp.int32))
-        return _Carry(new_idle, new_rel, new_ntasks, new_allocated,
+        return _Carry(new_idle, new_rel, new_ntasks, new_nz, new_allocated,
                       new_done), out
 
-    init = _Carry(idle, releasing, n_tasks,
+    init = _Carry(idle, releasing, n_tasks, nz_req,
                   jnp.asarray(init_allocated, jnp.int32),
                   jnp.asarray(False))
-    tasks = _TaskIn(resreq, init_resreq, task_valid, scores, pred_mask)
+    tasks = _TaskIn(resreq, init_resreq, task_nz, task_valid, scores,
+                    pred_mask)
     final, (decisions, node_idx) = jax.lax.scan(step, init, tasks)
     became_ready = final.allocated >= min_available
     return (decisions, node_idx, final.idle, final.releasing, final.n_tasks,
-            became_ready)
+            final.nz_req, became_ready)
 
 
 class Decision(NamedTuple):
@@ -137,6 +175,8 @@ class DeviceSession:
         self.idle = jnp.asarray(self.state.idle)
         self.releasing = jnp.asarray(self.state.releasing)
         self.backfilled = jnp.asarray(self.state.backfilled)
+        self.allocatable_cm = jnp.asarray(self.state.allocatable[:, :2])
+        self.nz_req = jnp.asarray(self.state.nz_requested)
         self.n_tasks = jnp.asarray(self.state.n_tasks)
         self.max_task_num = jnp.asarray(self.state.max_task_num)
         self.node_ok = jnp.asarray(self.state.schedulable & self.state.valid)
@@ -160,6 +200,8 @@ class DeviceSession:
         self.idle = fresh.idle
         self.releasing = fresh.releasing
         self.backfilled = fresh.backfilled
+        self.allocatable_cm = fresh.allocatable_cm
+        self.nz_req = fresh.nz_req
         self.n_tasks = fresh.n_tasks
         self.max_task_num = fresh.max_task_num
         self.node_ok = fresh.node_ok
@@ -167,29 +209,36 @@ class DeviceSession:
     def solve_job(self, batch: TaskBatch, min_available: int,
                   init_allocated: int,
                   scores: Optional[np.ndarray] = None,
-                  pred_mask: Optional[np.ndarray] = None
-                  ) -> Tuple[List[Decision], bool]:
+                  pred_mask: Optional[np.ndarray] = None,
+                  dyn=None) -> Tuple[List[Decision], bool]:
         """Run the allocate scan for one job's pending tasks and commit the
         updated capacity carry to device state. Returns per-real-task
-        decisions plus whether the job crossed readiness."""
+        decisions plus whether the job crossed readiness. ``dyn`` is a
+        terms.DynamicScoreSpec enabling the in-kernel nodeorder terms."""
         t_pad, n_pad = batch.t_padded, self.n_padded
         if scores is None:
             scores = np.zeros((t_pad, n_pad), np.float32)
         if pred_mask is None:
             pred_mask = np.ones((t_pad, n_pad), bool)
+        dyn_enabled = bool(dyn is not None and dyn.enabled)
+        dyn_weights = np.asarray(
+            [dyn.least_requested, dyn.balanced_resource] if dyn_enabled
+            else [0.0, 0.0], np.float32)
         start = time.perf_counter()
-        (decisions, node_idx, idle, releasing, n_tasks,
+        (decisions, node_idx, idle, releasing, n_tasks, nz_req,
          became_ready) = _allocate_scan(
-            self.idle, self.releasing, self.backfilled, self.max_task_num,
-            self.n_tasks, self.node_ok,
+            self.idle, self.releasing, self.backfilled, self.allocatable_cm,
+            self.nz_req, self.max_task_num, self.n_tasks, self.node_ok,
             jnp.asarray(batch.resreq), jnp.asarray(batch.init_resreq),
-            jnp.asarray(batch.valid), jnp.asarray(scores),
-            jnp.asarray(pred_mask),
+            jnp.asarray(batch.nz_req), jnp.asarray(batch.valid),
+            jnp.asarray(scores), jnp.asarray(pred_mask),
             jnp.asarray(min_available, jnp.int32),
-            jnp.asarray(init_allocated, jnp.int32))
+            jnp.asarray(init_allocated, jnp.int32),
+            jnp.asarray(dyn_weights), dyn_enabled=dyn_enabled)
         decisions = np.asarray(decisions)
         node_idx = np.asarray(node_idx)
         self.idle, self.releasing, self.n_tasks = idle, releasing, n_tasks
+        self.nz_req = nz_req
         update_solver_kernel_duration("allocate_scan",
                                       time.perf_counter() - start)
         out: List[Decision] = []

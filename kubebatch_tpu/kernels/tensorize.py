@@ -24,7 +24,20 @@ import numpy as np
 from ..api import NodeInfo, TaskInfo
 from ..api.resource import RESOURCE_DIM, VEC_EPS
 
-__all__ = ["NodeState", "TaskBatch", "pad_to_bucket", "VEC_EPS"]
+__all__ = ["NodeState", "TaskBatch", "pad_to_bucket", "VEC_EPS",
+           "NONZERO_MILLI_CPU", "NONZERO_MEM_MIB", "nz_request_vec"]
+
+#: upstream DefaultNonZeroRequest (priorityutil.GetNonzeroRequests) in
+#: device units: 100m CPU, 200MB memory (= 200 MiB exactly)
+NONZERO_MILLI_CPU = 100.0
+NONZERO_MEM_MIB = 200.0
+
+
+def nz_request_vec(resreq_vec: np.ndarray) -> np.ndarray:
+    """[cpu_milli, mem_MiB] with upstream NonZero defaults applied."""
+    cpu = resreq_vec[0] if resreq_vec[0] != 0 else NONZERO_MILLI_CPU
+    mem = resreq_vec[1] if resreq_vec[1] != 0 else NONZERO_MEM_MIB
+    return np.array([cpu, mem], np.float32)
 
 
 def pad_to_bucket(n: int, minimum: int = 8) -> int:
@@ -50,6 +63,10 @@ class NodeState:
     releasing: np.ndarray
     backfilled: np.ndarray
     allocatable: np.ndarray
+    #: [N,2] float32 — nonzero-request (cpu_milli, mem_MiB) sums over the
+    #: node's tasks, upstream GetNonzeroRequests semantics (feeds the
+    #: in-kernel least-requested / balanced-resource scores)
+    nz_requested: np.ndarray
     #: [N] int32 / bool
     max_task_num: np.ndarray
     n_tasks: np.ndarray
@@ -68,6 +85,7 @@ class NodeState:
         releasing = np.zeros(shape, np.float32)
         backfilled = np.zeros(shape, np.float32)
         allocatable = np.zeros(shape, np.float32)
+        nz_requested = np.zeros((n_pad, 2), np.float32)
         max_task_num = np.zeros(n_pad, np.int32)
         n_tasks = np.zeros(n_pad, np.int32)
         schedulable = np.zeros(n_pad, bool)
@@ -78,6 +96,8 @@ class NodeState:
             releasing[i] = ni.releasing.to_vec()
             backfilled[i] = ni.backfilled.to_vec()
             allocatable[i] = ni.allocatable.to_vec()
+            for t in ni.tasks.values():
+                nz_requested[i] += nz_request_vec(t.resreq.to_vec())
             max_task_num[i] = ni.allocatable.max_task_num
             n_tasks[i] = len(ni.tasks)
             unsched = bool(ni.node.unschedulable) if ni.node else True
@@ -86,9 +106,9 @@ class NodeState:
             index[ni.name] = i
         return cls(names=[ni.name for ni in ordered], idle=idle,
                    releasing=releasing, backfilled=backfilled,
-                   allocatable=allocatable, max_task_num=max_task_num,
-                   n_tasks=n_tasks, schedulable=schedulable, valid=valid,
-                   index=index)
+                   allocatable=allocatable, nz_requested=nz_requested,
+                   max_task_num=max_task_num, n_tasks=n_tasks,
+                   schedulable=schedulable, valid=valid, index=index)
 
     @property
     def n_padded(self) -> int:
@@ -101,6 +121,7 @@ class TaskBatch:
     tasks: List[TaskInfo]
     resreq: np.ndarray        # [T,R] steady-state request (node accounting)
     init_resreq: np.ndarray   # [T,R] launch request (fit checks)
+    nz_req: np.ndarray        # [T,2] nonzero (cpu,mem) for dynamic scoring
     valid: np.ndarray         # [T] non-padded row
 
     @classmethod
@@ -110,13 +131,15 @@ class TaskBatch:
         t_pad = pad_to_bucket(t, min_bucket)
         resreq = np.zeros((t_pad, RESOURCE_DIM), np.float32)
         init_resreq = np.zeros((t_pad, RESOURCE_DIM), np.float32)
+        nz_req = np.zeros((t_pad, 2), np.float32)
         valid = np.zeros(t_pad, bool)
         for i, task in enumerate(tasks):
             resreq[i] = task.resreq.to_vec()
             init_resreq[i] = task.init_resreq.to_vec()
+            nz_req[i] = nz_request_vec(resreq[i])
             valid[i] = True
         return cls(tasks=list(tasks), resreq=resreq,
-                   init_resreq=init_resreq, valid=valid)
+                   init_resreq=init_resreq, nz_req=nz_req, valid=valid)
 
     @property
     def t_padded(self) -> int:

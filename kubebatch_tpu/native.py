@@ -102,8 +102,10 @@ class NativeSession:
     def solve_job(self, batch: TaskBatch, min_available: int,
                   init_allocated: int,
                   scores: Optional[np.ndarray] = None,
-                  pred_mask: Optional[np.ndarray] = None
-                  ) -> Tuple[List[Decision], bool]:
+                  pred_mask: Optional[np.ndarray] = None,
+                  dyn=None) -> Tuple[List[Decision], bool]:
+        # the native solver has no dynamic-score support; the action only
+        # routes here when no node-order callback is registered (dyn None)
         t_pad, n_pad = batch.t_padded, self.n_padded
         if scores is None:
             scores = np.zeros((t_pad, n_pad), np.float32)

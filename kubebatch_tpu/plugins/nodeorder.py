@@ -27,6 +27,7 @@ from typing import Dict, List
 
 from ..api import NodeInfo, TaskInfo, allocated_status
 from ..framework import EventHandler, Plugin, Session
+from ..kernels import tensorize as _tz
 from ..objects import Pod
 
 NAME = "nodeorder"
@@ -36,9 +37,11 @@ POD_AFFINITY_WEIGHT = "podaffinity.weight"
 LEAST_REQUESTED_WEIGHT = "leastrequested.weight"
 BALANCED_RESOURCE_WEIGHT = "balancedresource.weight"
 
-#: upstream DefaultNonZeroRequest (priorityutil)
-NONZERO_MILLI_CPU = 100.0
-NONZERO_MEMORY = 200.0 * 1024 * 1024
+#: upstream DefaultNonZeroRequest (priorityutil) — canonical values live in
+#: kernels/tensorize.py (device units); derived here in host units (bytes)
+#: so the in-kernel dynamic scores can never drift from the host scores
+NONZERO_MILLI_CPU = _tz.NONZERO_MILLI_CPU
+NONZERO_MEMORY = _tz.NONZERO_MEM_MIB * 1024 * 1024
 #: upstream v1.DefaultHardPodAffinitySymmetricWeight
 HARD_POD_AFFINITY_SYMMETRIC_WEIGHT = 1
 
@@ -186,13 +189,15 @@ def interpod_affinity_counts(ssn: Session, task: TaskInfo) -> Dict[str, float]:
 class NodeOrderPlugin(Plugin):
     def __init__(self, arguments=None):
         self.arguments = arguments or {}
+        #: read by kernels/terms.py to weight the in-kernel dynamic terms
+        self.weights = _weights(self.arguments)
 
     @property
     def name(self) -> str:
         return NAME
 
     def on_session_open(self, ssn: Session) -> None:
-        weights = _weights(self.arguments)
+        weights = self.weights
         # interpod counts are identical across the N node_order calls for
         # one task; memoize per (task, allocation epoch) — the epoch bumps
         # on every allocate/evict event

@@ -114,16 +114,25 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
             job_keys.append(K_DRF_SHARE)
     queue_keys = (K_PROP_SHARE,) if req.proportion_enabled else ()
 
-    scores = np.zeros((t_pad, n_pad), np.float32)
-    pred = np.ones((t_pad, n_pad), bool)
+    # the wire protocol carries no predicate/score terms yet: trivial sig
+    # space (every task -> sig 0, all nodes allowed, dynamic terms off)
+    sig_scores = np.zeros((1, n_pad), np.float32)
+    sig_pred = np.ones((1, n_pad), bool)
+    task_sig = np.zeros(t_pad, np.int32)
+    task_nz = np.zeros((t_pad, 2), np.float32)
+    allocatable_cm = np.zeros((n_pad, 2), np.float32)
+    nz_req0 = np.zeros((n_pad, 2), np.float32)
     j_alloc0 = np.zeros((j_pad, 3), np.float32)
 
     start = time.perf_counter()
     (host_block, *_device_state) = fused_allocate(
-        idle, releasing, backfilled, mtn, ntasks, node_ok,
+        idle, releasing, backfilled, jnp.asarray(allocatable_cm),
+        jnp.asarray(nz_req0), mtn, ntasks, node_ok,
         jnp.asarray(resreq), jnp.asarray(init_resreq),
-        jnp.asarray(task_job), jnp.asarray(task_rank),
-        jnp.asarray(task_valid), jnp.asarray(scores), jnp.asarray(pred),
+        jnp.asarray(task_nz), jnp.asarray(task_job),
+        jnp.asarray(task_rank), jnp.asarray(task_sig),
+        jnp.asarray(task_valid), jnp.asarray(sig_scores),
+        jnp.asarray(sig_pred),
         jnp.asarray(min_av), jnp.asarray(order_min_av),
         jnp.asarray(init_ready), jnp.asarray(job_queue),
         jnp.asarray(job_priority), jnp.asarray(job_create_rank),

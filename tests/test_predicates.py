@@ -13,10 +13,12 @@ from kubebatch_tpu.objects import (Affinity, MatchExpression, NodeAffinity,
 
 from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
 
-# with predicate/node-order fns installed every solver mode routes to the
-# host path (allocate.py stateful gate); "jax" here only verifies that
-# routing — the full matrix runs once via "host"
-MODES = ["host"]
+# every scenario must produce identical placements in every solver mode:
+# static predicate/score terms run on device via the sig encoder
+# (kernels/encode.py), dynamic nodeorder terms in-kernel, and snapshots
+# with features the kernels can't model (inter-pod affinity, host ports)
+# fall back to the host path automatically inside the action
+MODES = ["host", "jax", "fused"]
 ROUTING_MODES = ["jax", "fused"]
 
 

@@ -32,7 +32,18 @@ def test_sharded_matches_single_device():
         args = _random_problem(rng, n=64, t=16)
         min_av = jnp.asarray(6, jnp.int32)
         init_alloc = jnp.asarray(0, jnp.int32)
-        single = _allocate_scan(*args, min_av, init_alloc)
+        (idle, releasing, backfilled, mtn, ntasks, ok, resreq,
+         init_resreq, tvalid, scores, pred) = args
+        n = idle.shape[0]
+        single_full = _allocate_scan(
+            idle, releasing, backfilled,
+            (idle[:, :2] * 2.0).astype(np.float32),
+            np.zeros((n, 2), np.float32), mtn, ntasks, ok, resreq,
+            init_resreq, np.maximum(resreq[:, :2], 1.0).astype(np.float32),
+            tvalid, scores, pred, min_av, init_alloc,
+            jnp.zeros(2, jnp.float32))
+        # drop the nz_req output; the sharded kernel doesn't carry it
+        single = single_full[:5] + single_full[6:]
         sharded = run(*args, min_av, init_alloc)
         for name, a, b in zip(
                 ["decisions", "node_idx", "idle", "releasing", "n_tasks",

@@ -1,0 +1,164 @@
+"""Static-term encoder and device/host equivalence under the full plugin
+stack (kernels/encode.py, kernels/terms.py, in-kernel dynamic scores)."""
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import (Affinity, MatchExpression, NodeAffinity,
+                                   NodeSelectorTerm, PodPhase, Taint,
+                                   TaintEffect, Toleration)
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+ZONES = ["east", "west", "north"]
+DISKS = ["ssd", "hdd"]
+
+
+def _random_cluster(rng, n_nodes=12, n_groups=6, pods_per_group=3):
+    nodes = []
+    for i in range(n_nodes):
+        labels = {"zone": ZONES[int(rng.integers(len(ZONES)))],
+                  "disk": DISKS[int(rng.integers(len(DISKS)))]}
+        taints = []
+        if rng.random() < 0.3:
+            taints.append(Taint("dedicated", "batch",
+                                TaintEffect.NO_SCHEDULE))
+        nodes.append(build_node(
+            f"n{i:02d}", rl(8000 + 500 * int(rng.integers(4)),
+                            16 * GiB, pods=110),
+            labels=labels, taints=taints))
+
+    groups, pods = [], []
+    for g in range(n_groups):
+        groups.append(build_group("ns", f"pg{g}", pods_per_group,
+                                  queue="q1", creation_timestamp=float(g)))
+        sel = {}
+        aff = None
+        tol = []
+        roll = rng.random()
+        if roll < 0.3:
+            sel = {"disk": DISKS[int(rng.integers(len(DISKS)))]}
+        elif roll < 0.5:
+            aff = Affinity(node_affinity=NodeAffinity(
+                required=[NodeSelectorTerm([MatchExpression(
+                    "zone", "In",
+                    [ZONES[int(rng.integers(len(ZONES)))]])])],
+                preferred=[(int(rng.integers(1, 5)), NodeSelectorTerm(
+                    [MatchExpression("disk", "In", ["ssd"])]))]))
+        if rng.random() < 0.4:
+            tol = [Toleration(key="dedicated", operator="Equal",
+                              value="batch", effect="NoSchedule")]
+        for p in range(pods_per_group):
+            pod = build_pod(
+                "ns", f"pg{g}-{p}", "", PodPhase.PENDING,
+                rl(500 + 100 * int(rng.integers(5)), GiB), group=f"pg{g}",
+                creation_timestamp=float(g * 100 + p))
+            pod.node_selector = dict(sel)
+            pod.affinity = aff
+            pod.tolerations = list(tol)
+            pods.append(pod)
+    return nodes, groups, pods
+
+
+def _full_tiers():
+    return [Tier(plugins=[PluginOption(name="priority"),
+                          PluginOption(name="gang"),
+                          PluginOption(name="conformance")]),
+            Tier(plugins=[PluginOption(name="drf"),
+                          PluginOption(name="predicates"),
+                          PluginOption(name="proportion"),
+                          PluginOption(name="nodeorder")])]
+
+
+def _run(nodes, groups, pods, mode):
+    binds = {}
+
+    class B:
+        def bind(self, pod, hostname):
+            binds[f"{pod.namespace}/{pod.name}"] = hostname
+            pod.node_name = hostname
+
+    cache = SchedulerCache(binder=B(), async_writeback=False)
+    cache.add_queue(build_queue("q1"))
+    for n in nodes:
+        cache.add_node(n)
+    for g in groups:
+        cache.add_pod_group(g)
+    for p in pods:
+        cache.add_pod(p)
+    ssn = OpenSession(cache, _full_tiers())
+    AllocateAction(mode=mode).execute(ssn)
+    CloseSession(ssn)
+    cache.drain(timeout=5.0)
+    return binds
+
+
+def test_encoder_matches_pairwise_host_evaluation():
+    """The sig-indexed static mask/score must equal per-pair evaluation of
+    the host matcher functions across random label/taint clusters."""
+    from kubebatch_tpu.kernels.encode import build_static_terms
+    from kubebatch_tpu.kernels.tensorize import NodeState
+    from kubebatch_tpu.plugins.nodeorder import node_affinity_score
+    from kubebatch_tpu.plugins.predicates import (match_node_selector,
+                                                  tolerates_node_taints)
+    from kubebatch_tpu.api import NodeInfo, TaskInfo
+
+    rng = np.random.default_rng(7)
+    for trial in range(3):
+        nodes, groups, pods = _random_cluster(rng)
+        node_infos = {n.name: NodeInfo(n) for n in nodes}
+        tasks = [TaskInfo(p) for p in pods]
+        state = NodeState.from_nodes(node_infos)
+        terms = build_static_terms(
+            state, tasks,
+            {n.name: n.labels for n in nodes},
+            {n.name: n.taints for n in nodes},
+            with_predicates=True, with_node_affinity_score=True)
+        scores, pred = terms.task_rows(tasks, len(tasks))
+        by_name = {n.name: n for n in nodes}
+        for ti, task in enumerate(tasks):
+            for col, name in enumerate(state.names):
+                node = by_name[name]
+                want_ok = (match_node_selector(task.pod, node.labels)
+                           and tolerates_node_taints(task.pod, node))
+                assert pred[ti, col] == want_ok, (trial, task.name, name)
+                ninfo = node_infos[name]
+                want_score = node_affinity_score(task.pod, ninfo)
+                assert scores[ti, col] == want_score, (trial, task.name,
+                                                       name)
+
+
+@pytest.mark.parametrize("mode", ["jax", "fused"])
+def test_device_modes_match_host_on_random_labeled_clusters(mode):
+    """Full-stack equivalence: same binds from the host oracle and the
+    device paths on clusters with selectors/affinity/taints + dynamic
+    nodeorder scoring."""
+    rng = np.random.default_rng(13)
+    for trial in range(3):
+        seed = int(rng.integers(1 << 30))
+        r1 = np.random.default_rng(seed)
+        r2 = np.random.default_rng(seed)
+        host = _run(*_random_cluster(r1), "host")
+        dev = _run(*_random_cluster(r2), mode)
+        assert host == dev, f"trial {trial} (seed {seed}) diverged"
+        assert host, "scenario bound nothing — fixture too restrictive"
+
+
+@pytest.mark.parametrize("mode", ["jax", "fused"])
+def test_dynamic_least_requested_spreads_on_device(mode):
+    """In-kernel least-requested must react to in-cycle assignments: two
+    equal pods of one job spread across two empty identical nodes instead
+    of stacking (the second task sees the first's usage in the carry)."""
+    nodes = [build_node("n1", rl(8000, 16 * GiB, pods=110)),
+             build_node("n2", rl(8000, 16 * GiB, pods=110))]
+    groups = [build_group("ns", "pg", 2, queue="q1")]
+    pods = [build_pod("ns", f"p{i}", "", PodPhase.PENDING,
+                      rl(3000, 6 * GiB), group="pg",
+                      creation_timestamp=float(i)) for i in range(2)]
+    binds = _run(nodes, groups, pods, mode)
+    assert len(binds) == 2
+    assert binds["ns/p0"] != binds["ns/p1"], binds
