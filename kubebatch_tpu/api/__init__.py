@@ -6,7 +6,7 @@ from .job import (JobInfo, TaskInfo, get_job_id, get_pod_resource_request,
 from .node import NodeInfo
 from .resource import (MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_GPU, RESOURCE_DIM,
                        RESOURCE_NAMES, Resource, res_min, resource_names,
-                       share, vecs)
+                       dominant_share, share, vecs)
 from .types import (JobReadiness, TaskStatus, ValidateResult,
                     allocated_status, allocated_statuses, ready_statuses,
                     validate_status_update)
@@ -20,5 +20,5 @@ __all__ = [
     "validate_status_update",
     "get_job_id", "get_pod_resource_request",
     "get_pod_resource_without_init_containers", "get_task_status",
-    "job_terminated", "pod_key", "res_min", "resource_names", "share", "vecs",
+    "dominant_share", "job_terminated", "pod_key", "res_min", "resource_names", "share", "vecs",
 ]

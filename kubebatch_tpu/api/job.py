@@ -187,10 +187,35 @@ class JobInfo:
                 del self.task_status_index[task.status]
 
     def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        """Semantically delete_task_info + add_task_info (ref:
+        job_info.go:251-259), flattened: the status flip is the hottest
+        operation of the decision replay (10k+ per cycle at the stress
+        config), so the net-zero total_request sub/add and the task-dict
+        delete/re-insert are skipped when the stored task IS the incoming
+        one (also avoiding float round-trip drift the naive pair has)."""
         validate_status_update(task.status, status)
-        self.delete_task_info(task)
+        stored = self.tasks.get(task.uid)
+        if stored is None:
+            raise KeyError(
+                f"failed to find task <{task.namespace}/{task.name}> in job "
+                f"<{self.namespace}/{self.name}>")
+        if allocated_status(stored.status):
+            self.allocated.sub(stored.resreq)
+        if stored is not task:
+            self.total_request.sub(stored.resreq)
+            self.total_request.add(task.resreq)
+        index = self.task_status_index.get(stored.status)
+        if index is not None:
+            index.pop(stored.uid, None)
+            if not index:
+                del self.task_status_index[stored.status]
         task.status = status
-        self.add_task_info(task)
+        self.tasks[task.uid] = task
+        self._add_task_index(task)
+        if task.pod.priority is not None:
+            self.priority = task.priority
+        if allocated_status(status):
+            self.allocated.add(task.resreq)
 
     def get_tasks(self, *statuses: TaskStatus) -> List[TaskInfo]:
         """Clones of tasks in the given states (ref: job_info.go:217-229)."""

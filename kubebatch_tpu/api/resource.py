@@ -195,6 +195,15 @@ def share(l: float, r: float) -> float:
     return l / r
 
 
+def dominant_share(alloc: "Resource", denom: "Resource") -> float:
+    """max over the resource dimensions of share(alloc, denom) — the DRF /
+    proportion share formula, unrolled (it runs once per allocation
+    event)."""
+    return max(share(alloc.milli_cpu, denom.milli_cpu),
+               share(alloc.memory, denom.memory),
+               share(alloc.milli_gpu, denom.milli_gpu))
+
+
 def vecs(resources: Iterable[Resource]) -> np.ndarray:
     """Stack Resources into an [n, RESOURCE_DIM] float32 matrix."""
     rows = [r.to_vec() for r in resources]

@@ -170,14 +170,25 @@ class Session:
         return False
 
     def _job_readiness(self, job) -> JobReadiness:
-        """First registered job-ready fn wins (session_plugins.go:167-207)."""
-        for tier in self.tiers:
-            for plugin in tier.plugins:
-                if plugin.job_ready_disabled:
-                    continue
-                fn = self.job_ready_fns.get(plugin.name)
+        """First registered job-ready fn wins (session_plugins.go:167-207).
+        The tier walk is memoized — job_ready runs once per allocation, and
+        plugins only register fns during OnSessionOpen."""
+        fn = getattr(self, "_ready_fn_memo", False)
+        if fn is False:
+            fn = None
+            for tier in self.tiers:
+                for plugin in tier.plugins:
+                    if plugin.job_ready_disabled:
+                        continue
+                    f = self.job_ready_fns.get(plugin.name)
+                    if f is not None:
+                        fn = f
+                        break
                 if fn is not None:
-                    return fn(job)
+                    break
+            self._ready_fn_memo = fn
+        if fn is not None:
+            return fn(job)
         return JobReadiness.READY
 
     def job_ready(self, job) -> bool:

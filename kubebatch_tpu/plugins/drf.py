@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Dict, List
 
 from ..api import (JobInfo, Resource, TaskInfo, allocated_status,
-                   resource_names, share)
+                   dominant_share, resource_names, share)
 from ..framework import EventHandler, Plugin, Session
 
 NAME = "drf"
@@ -37,8 +37,7 @@ class DrfPlugin(Plugin):
         return NAME
 
     def _calculate_share(self, allocated: Resource) -> float:
-        return max((share(allocated.get(rn), self.total_resource.get(rn))
-                    for rn in resource_names()), default=0.0)
+        return dominant_share(allocated, self.total_resource)
 
     def _update_share(self, attr: DrfAttr) -> None:
         attr.share = self._calculate_share(attr.allocated)

@@ -31,10 +31,18 @@ def valid_task_num(job: JobInfo) -> int:
     return occupied
 
 
+_READY_STATUSES = None
+
+
 def ready_task_num(job: JobInfo) -> int:
-    """ref: gang.go:212-222 (NB: excludes AllocatedOverBackfill)."""
-    from ..api import ready_statuses
-    return job.count(*ready_statuses())
+    """ref: gang.go:212-222 (NB: excludes AllocatedOverBackfill). Runs once
+    per allocation event — the status tuple is resolved once, not per call
+    (the lazy init avoids an import cycle at module load)."""
+    global _READY_STATUSES
+    if _READY_STATUSES is None:
+        from ..api import ready_statuses
+        _READY_STATUSES = tuple(ready_statuses())
+    return job.count(*_READY_STATUSES)
 
 
 def backfill_eligible(job: JobInfo) -> bool:

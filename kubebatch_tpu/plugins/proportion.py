@@ -11,8 +11,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from ..api import (QueueInfo, Resource, TaskInfo, allocated_status, res_min,
-                   resource_names, share)
+from ..api import (QueueInfo, Resource, TaskInfo, allocated_status, dominant_share,
+                   res_min, resource_names, share)
 from ..api.types import TaskStatus
 from ..framework import EventHandler, Plugin, Session
 
@@ -46,9 +46,7 @@ class ProportionPlugin(Plugin):
     def _update_share(self, attr: QueueAttr) -> None:
         """share = max over resources of allocated/deserved
         (ref: proportion.go:229-241)."""
-        attr.share = max(
-            (share(attr.allocated.get(rn), attr.deserved.get(rn))
-             for rn in resource_names()), default=0.0)
+        attr.share = dominant_share(attr.allocated, attr.deserved)
 
     def on_session_open(self, ssn: Session) -> None:
         for node in ssn.nodes.values():
