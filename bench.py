@@ -7,7 +7,8 @@ The reference publishes no numbers (BASELINE.md: "measured, not copied");
 p50 cycle latency at the stress config — vs_baseline > 1.0 means beating
 the target.
 
-Usage: python bench.py [--config N] [--cycles M] [--mode fused|jax|host]
+Usage: python bench.py [--config N] [--cycles M]
+                       [--mode batched|fused|jax|host]
 """
 from __future__ import annotations
 
@@ -69,7 +70,7 @@ def main(argv=None):
                     help="BASELINE config number")
     ap.add_argument("--cycles", type=int, default=4)
     ap.add_argument("--mode", default="fused",
-                    choices=["fused", "jax", "host"])
+                    choices=["batched", "fused", "jax", "host"])
     args = ap.parse_args(argv)
 
     latencies, bound, seconds = run_config(args.config, args.cycles,

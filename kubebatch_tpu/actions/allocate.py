@@ -1,10 +1,14 @@
 """allocate — the primary scheduling action.
 
-Three solver modes (KUBEBATCH_SOLVER env or constructor arg):
+Solver modes (KUBEBATCH_SOLVER env or constructor arg):
+- "batched": the round-based throughput solver (kernels/batched.py) —
+  many placements per device step, fairness refreshed between rounds;
+  the engine the north-star latency target is measured on.
 - "fused" (default): the whole cycle in ONE device dispatch
   (kernels/fused.py) — queue/job/task selection and fairness state live
-  in-kernel; host replays the decisions through Session.allocate/pipeline
-  so plugins and the gang barrier observe identical events.
+  in-kernel, bit-exact vs the host heap algorithm; host replays the
+  decisions through Session.allocate/pipeline so plugins and the gang
+  barrier observe identical events.
 - "jax": one device scan per job visit (kernels/solver.py) — more
   dispatches, used when the configured plugins fall outside the fused
   kernel's key vocabulary.
@@ -66,7 +70,13 @@ class AllocateAction(Action):
         return self._mode or os.environ.get("KUBEBATCH_SOLVER", "fused")
 
     def execute(self, ssn: Session) -> None:
-        if self.mode == "fused":
+        if self.mode == "batched":
+            from .allocate_batched import batched_supported, execute_batched
+            # execute_batched itself returns False (without consuming
+            # state) when the snapshot carries unsupported features
+            if batched_supported(ssn) and execute_batched(ssn):
+                return
+        elif self.mode == "fused":
             from .allocate_fused import execute_fused, fused_supported
             # execute_fused itself returns False (without consuming state)
             # when the snapshot carries features the kernel can't model
@@ -101,7 +111,7 @@ class AllocateAction(Action):
         # third-party callbacks) take the reference-literal host path
         device = None
         terms = None
-        if self.mode in ("jax", "fused") \
+        if self.mode in ("jax", "fused", "batched") \
                 and device_supported(ssn, pending_all):
             # the cheap gate above keeps fallback cycles from paying the
             # full-cluster tensorize + device upload

@@ -8,197 +8,34 @@ only *decides*, the Session still *applies*.
 """
 from __future__ import annotations
 
-import functools
 import time
-from typing import Dict, List, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
-from ..api import JobInfo, TaskInfo, TaskStatus, ready_statuses
 from ..framework import Session
-from ..kernels.fused import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
-                             K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
-                             K_PROP_SHARE, fused_allocate, unpack_host_block)
-from ..kernels.solver import DeviceSession
-from ..kernels.tensorize import TaskBatch, pad_to_bucket
-from ..kernels.terms import device_supported, solver_terms
+from ..kernels.fused import fused_allocate, unpack_host_block
 from ..metrics import update_solver_kernel_duration
+from .cycle_inputs import (EMPTY_CYCLE, build_cycle_inputs, cycle_supported,
+                           replay_decisions)
 
-#: job-order plugins the kernel can express, in any tier order
-_JOB_KEYS = {"priority": K_PRIORITY, "gang": K_GANG_READY,
-             "drf": K_DRF_SHARE}
-_QUEUE_KEYS = {"proportion": K_PROP_SHARE}
-
-
-def _job_order_spec(ssn: Session) -> Tuple[Tuple[str, ...], bool]:
-    keys: List[str] = []
-    for tier in ssn.tiers:
-        for opt in tier.plugins:
-            if opt.job_order_disabled or opt.name not in ssn.job_order_fns:
-                continue
-            key = _JOB_KEYS.get(opt.name)
-            if key is None:
-                return (), False
-            keys.append(key)
-    return tuple(keys), True
-
-
-def _queue_order_spec(ssn: Session) -> Tuple[Tuple[str, ...], bool]:
-    keys: List[str] = []
-    for tier in ssn.tiers:
-        for opt in tier.plugins:
-            if opt.queue_order_disabled or opt.name not in ssn.queue_order_fns:
-                continue
-            key = _QUEUE_KEYS.get(opt.name)
-            if key is None:
-                return (), False
-            keys.append(key)
-    return tuple(keys), True
-
-
-def fused_supported(ssn: Session) -> bool:
-    """The fused kernel expresses the built-in order/fairness plugins; any
-    custom job/queue order, overused, or ready fn falls back to the
-    per-visit path. Predicate / node-order callbacks are supported through
-    kernels/terms.solver_terms — static terms as sig-indexed matrices,
-    least-requested / balanced-resource in-kernel; snapshots with
-    allocation-dependent features the kernels can't model (inter-pod
-    affinity, pending host ports — terms.py) are rejected inside
-    execute_fused, which then returns False."""
-    _, ok_j = _job_order_spec(ssn)
-    _, ok_q = _queue_order_spec(ssn)
-    custom_overused = any(name != "proportion" for name in ssn.overused_fns)
-    custom_ready = any(name != "gang" for name in ssn.job_ready_fns)
-    return ok_j and ok_q and not custom_overused and not custom_ready
-
-
-def _gang_enabled(ssn: Session) -> bool:
-    for tier in ssn.tiers:
-        for opt in tier.plugins:
-            if not opt.job_ready_disabled and opt.name in ssn.job_ready_fns:
-                return True
-    return False
+# compatibility re-exports (tests and older callers import these from here)
+fused_supported = cycle_supported
 
 
 def execute_fused(ssn: Session) -> bool:
     """Run the whole allocate action as one dispatch. Returns False —
     without consuming any state — when the snapshot has features the
     kernel can't express (the caller falls back to the host path)."""
-    # ---- queues ----------------------------------------------------------
-    queue_ids = sorted(ssn.queues)          # uid order = order fallback
-    q_index = {q: i for i, q in enumerate(queue_ids)}
-    q_pad = pad_to_bucket(len(queue_ids), 4)
-
-    # ---- jobs ------------------------------------------------------------
-    jobs: List[JobInfo] = [j for j in ssn.jobs.values()
-                           if j.queue in q_index]
-    # creation-rank tie-break (creation_timestamp, uid)
-    jobs_sorted = sorted(jobs, key=lambda j: (j.creation_timestamp, j.uid))
-    j_rank = {j.uid: r for r, j in enumerate(jobs_sorted)}
-    j_pad = pad_to_bucket(len(jobs), 4)
-    j_index = {j.uid: i for i, j in enumerate(jobs)}
-
-    # ---- tasks (pending, non-BestEffort, in task-order per job) ----------
-    tasks: List[TaskInfo] = []
-    task_job_idx: List[int] = []
-    task_ranks: List[int] = []
-    for j in jobs:
-        pend = [t for t in j.task_status_index.get(TaskStatus.PENDING,
-                                                   {}).values()
-                if not t.resreq.is_empty()]
-        pend.sort(key=functools.cmp_to_key(
-            lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
-        for rank, t in enumerate(pend):
-            tasks.append(t)
-            task_job_idx.append(j_index[j.uid])
-            task_ranks.append(rank)
-    if not tasks:
+    inputs = build_cycle_inputs(ssn)
+    if inputs is EMPTY_CYCLE:
         return True
-    # cheap feature gate BEFORE tensorizing/uploading the cluster — a
-    # fallback cycle must not pay the device transfer
-    if not device_supported(ssn, tasks):
+    if inputs is None:
         return False
-    if ssn.device_snapshot is None:
-        ssn.device_snapshot = DeviceSession(ssn.nodes)
-    device: DeviceSession = ssn.device_snapshot
-    terms = solver_terms(ssn, device, tasks)
-    if terms is None:
-        return False
-    batch = TaskBatch.from_tasks(tasks)
-    t_pad = batch.t_padded
-
-    # ---- job arrays ------------------------------------------------------
-    gang = _gang_enabled(ssn)
-    min_av = np.zeros(j_pad, np.int32)
-    order_min_av = np.zeros(j_pad, np.int32)
-    init_alloc = np.zeros(j_pad, np.int32)
-    job_queue = np.zeros(j_pad, np.int32)
-    job_priority = np.zeros(j_pad, np.float32)
-    job_create_rank = np.zeros(j_pad, np.int32)
-    job_valid = np.zeros(j_pad, bool)
-    for i, j in enumerate(jobs):
-        min_av[i] = j.min_available if gang else 0
-        order_min_av[i] = j.min_available
-        init_alloc[i] = j.count(*ready_statuses())
-        job_queue[i] = q_index[j.queue]
-        job_priority[i] = j.priority
-        job_create_rank[i] = j_rank[j.uid]
-        job_valid[i] = True
-
-    # ---- task arrays -----------------------------------------------------
-    task_job = np.full(t_pad, -1, np.int32)
-    task_rank = np.zeros(t_pad, np.int32)
-    task_job[:len(tasks)] = task_job_idx
-    task_rank[:len(tasks)] = task_ranks
-
-    # ---- queue arrays ----------------------------------------------------
-    q_weight = np.zeros(q_pad, np.float32)
-    q_entries = np.zeros(q_pad, np.int32)
-    q_create_rank = np.arange(q_pad, dtype=np.int32)
-    q_deserved = np.zeros((q_pad, 3), np.float32)
-    q_alloc0 = np.zeros((q_pad, 3), np.float32)
-    for q, i in q_index.items():
-        q_weight[i] = ssn.queues[q].weight
-    for j in jobs:
-        q_entries[q_index[j.queue]] += 1
-
-    prop = ssn.plugins.get("proportion")
-    queue_keys, _ = _queue_order_spec(ssn)
-    prop_overused = ("proportion" in ssn.overused_fns
-                     and any(opt.name == "proportion"
-                             for tier in ssn.tiers
-                             for opt in tier.plugins))
-    if prop is not None and getattr(prop, "queue_opts", None):
-        for q, attr in prop.queue_opts.items():
-            i = q_index.get(q)
-            if i is not None:
-                q_deserved[i] = attr.deserved.to_vec()
-                q_alloc0[i] = attr.allocated.to_vec()
-
-    # ---- drf arrays ------------------------------------------------------
-    job_keys, _ = _job_order_spec(ssn)
-    j_alloc0 = np.zeros((j_pad, 3), np.float32)
-    cluster_total = np.ones(3, np.float32)
-    drf = ssn.plugins.get("drf")
-    if K_DRF_SHARE in job_keys and drf is not None:
-        cluster_total = drf.total_resource.to_vec()
-        for j in jobs:
-            attr = drf.job_opts.get(j.uid)
-            if attr is not None:
-                j_alloc0[j_index[j.uid]] = attr.allocated.to_vec()
-
-    # ---- scores / predicates (sig-indexed static + in-kernel dynamic) ---
-    task_sig = terms.task_sig(tasks, t_pad)
-    s_pad = pad_to_bucket(terms.static.n_sigs, 4)
-    sig_scores = np.zeros((s_pad, device.n_padded), np.float32)
-    sig_pred = np.zeros((s_pad, device.n_padded), bool)
-    sig_scores[:terms.static.n_sigs] = terms.static.score
-    sig_pred[:terms.static.n_sigs] = terms.static.pred
-    dyn_enabled = terms.dynamic.enabled
-    dyn_weights = np.asarray([terms.dynamic.least_requested,
-                              terms.dynamic.balanced_resource], np.float32)
-
+    device = inputs.device
+    t_pad = inputs.task_valid.shape[0]
+    j_pad = inputs.job_valid.shape[0]
+    q_pad = inputs.q_weight.shape[0]
     max_iters = int(t_pad + 3 * j_pad + q_pad + 8)
 
     start = time.perf_counter()
@@ -206,23 +43,26 @@ def execute_fused(ssn: Session) -> bool:
         device.idle, device.releasing, device.backfilled,
         device.allocatable_cm, device.nz_req,
         device.max_task_num, device.n_tasks, device.node_ok,
-        jnp.asarray(batch.resreq), jnp.asarray(batch.init_resreq),
-        jnp.asarray(batch.nz_req), jnp.asarray(task_job),
-        jnp.asarray(task_rank), jnp.asarray(task_sig),
-        jnp.asarray(batch.valid), jnp.asarray(sig_scores),
-        jnp.asarray(sig_pred),
-        jnp.asarray(min_av), jnp.asarray(order_min_av),
-        jnp.asarray(init_alloc), jnp.asarray(job_queue),
-        jnp.asarray(job_priority), jnp.asarray(job_create_rank),
-        jnp.asarray(job_valid),
-        jnp.asarray(q_weight), jnp.asarray(q_entries),
-        jnp.asarray(q_create_rank), jnp.asarray(q_deserved),
-        jnp.asarray(q_alloc0),
-        jnp.asarray(j_alloc0), jnp.asarray(cluster_total),
-        jnp.asarray(dyn_weights),
-        job_keys=job_keys, queue_keys=queue_keys,
-        gang_enabled=gang, prop_overused=prop_overused,
-        dyn_enabled=dyn_enabled, max_iters=max_iters)
+        jnp.asarray(inputs.resreq), jnp.asarray(inputs.init_resreq),
+        jnp.asarray(inputs.task_nz), jnp.asarray(inputs.task_job),
+        jnp.asarray(inputs.task_rank), jnp.asarray(inputs.task_sig),
+        jnp.asarray(inputs.task_valid), jnp.asarray(inputs.sig_scores),
+        jnp.asarray(inputs.sig_pred),
+        jnp.asarray(inputs.min_available),
+        jnp.asarray(inputs.order_min_available),
+        jnp.asarray(inputs.init_allocated), jnp.asarray(inputs.job_queue),
+        jnp.asarray(inputs.job_priority),
+        jnp.asarray(inputs.job_create_rank),
+        jnp.asarray(inputs.job_valid),
+        jnp.asarray(inputs.q_weight), jnp.asarray(inputs.q_entries),
+        jnp.asarray(inputs.q_create_rank), jnp.asarray(inputs.q_deserved),
+        jnp.asarray(inputs.q_alloc0),
+        jnp.asarray(inputs.j_alloc0), jnp.asarray(inputs.cluster_total),
+        jnp.asarray(inputs.dyn_weights),
+        job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+        gang_enabled=inputs.gang_enabled,
+        prop_overused=inputs.prop_overused,
+        dyn_enabled=inputs.dyn_enabled, max_iters=max_iters)
     host_block = np.asarray(host_block)   # the cycle's ONE blocking read
     task_state, task_node, task_seq, _ = unpack_host_block(host_block)
     device.idle, device.releasing, device.n_tasks = idle_f, rel_f, ntasks_f
@@ -230,34 +70,5 @@ def execute_fused(ssn: Session) -> bool:
     update_solver_kernel_duration("fused_allocate",
                                   time.perf_counter() - start)
 
-    # ---- replay decisions through the Session, in kernel order ----------
-    order = [i for i in range(len(tasks))
-             if task_state[i] != SKIP]
-    order.sort(key=lambda i: task_seq[i])
-    try:
-        for i in order:
-            task = tasks[i]
-            kind = int(task_state[i])
-            if kind in (ALLOC, ALLOC_OB, PIPELINE):
-                node_name = device.node_name(int(task_node[i]))
-                if kind == PIPELINE:
-                    ssn.pipeline(task, node_name)
-                else:
-                    ssn.allocate(task, node_name, kind == ALLOC_OB)
-            elif kind == FAIL:
-                # fit-delta diagnostics for the task that broke its job,
-                # against node state at failure time (host nodes mirror the
-                # kernel here)
-                job = ssn.jobs.get(task.job)
-                if job is not None:
-                    job.nodes_fit_delta = {}
-                    for node in ssn.nodes.values():
-                        delta = node.idle.clone()
-                        delta.fit_delta(task.resreq)
-                        job.nodes_fit_delta[node.name] = delta
-    except Exception:
-        # host replay stopped mid-way (e.g. volume allocation failure):
-        # device state holds phantom allocations — rebuild from host truth
-        device.resync(ssn.nodes)
-        raise
+    replay_decisions(ssn, inputs, task_state, task_node, task_seq)
     return True

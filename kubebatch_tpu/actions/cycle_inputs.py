@@ -1,0 +1,329 @@
+"""Cycle tensorization shared by the whole-cycle device solvers.
+
+Builds every array the fused (kernels/fused.py) and batched
+(kernels/batched.py) allocate kernels consume from an open Session:
+queue / job / task index spaces, fairness seeds (proportion deserved +
+allocated, DRF allocated + cluster total), order-key specs, and the
+sig-indexed static predicate/score terms.  Returns None when the session
+carries plugins/features outside the device vocabulary — callers fall
+back to the per-visit or host paths.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import JobInfo, TaskInfo, TaskStatus, ready_statuses
+from ..framework import Session
+from ..kernels.fused import (K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
+                             K_PROP_SHARE)
+from ..kernels.solver import DeviceSession
+from ..kernels.tensorize import TaskBatch, pad_to_bucket
+from ..kernels.terms import device_supported, solver_terms
+
+#: job-order plugins the kernels can express, in any tier order
+_JOB_KEYS = {"priority": K_PRIORITY, "gang": K_GANG_READY,
+             "drf": K_DRF_SHARE}
+_QUEUE_KEYS = {"proportion": K_PROP_SHARE}
+
+#: build_cycle_inputs result when the cycle has no schedulable pending
+#: tasks at all — callers succeed without doing any work (distinct from
+#: None, which means "unsupported, fall back")
+EMPTY_CYCLE = "empty-cycle"
+
+
+def job_order_spec(ssn: Session) -> Tuple[Tuple[str, ...], bool]:
+    keys: List[str] = []
+    for tier in ssn.tiers:
+        for opt in tier.plugins:
+            if opt.job_order_disabled or opt.name not in ssn.job_order_fns:
+                continue
+            key = _JOB_KEYS.get(opt.name)
+            if key is None:
+                return (), False
+            keys.append(key)
+    return tuple(keys), True
+
+
+def queue_order_spec(ssn: Session) -> Tuple[Tuple[str, ...], bool]:
+    keys: List[str] = []
+    for tier in ssn.tiers:
+        for opt in tier.plugins:
+            if opt.queue_order_disabled or opt.name not in ssn.queue_order_fns:
+                continue
+            key = _QUEUE_KEYS.get(opt.name)
+            if key is None:
+                return (), False
+            keys.append(key)
+    return tuple(keys), True
+
+
+def cycle_supported(ssn: Session) -> bool:
+    """The whole-cycle kernels express the built-in order/fairness plugins;
+    any custom job/queue order, overused, or ready fn falls back to the
+    per-visit path.  Predicate / node-order callbacks are checked later by
+    kernels/terms (static sig matrices + in-kernel dynamic terms)."""
+    _, ok_j = job_order_spec(ssn)
+    _, ok_q = queue_order_spec(ssn)
+    custom_overused = any(name != "proportion" for name in ssn.overused_fns)
+    custom_ready = any(name != "gang" for name in ssn.job_ready_fns)
+    return ok_j and ok_q and not custom_overused and not custom_ready
+
+
+def gang_enabled(ssn: Session) -> bool:
+    for tier in ssn.tiers:
+        for opt in tier.plugins:
+            if not opt.job_ready_disabled and opt.name in ssn.job_ready_fns:
+                return True
+    return False
+
+
+@dataclass
+class CycleInputs:
+    """Everything a whole-cycle kernel needs, plus the host-side indexes
+    to map decisions back to Session objects."""
+    # host-side indexes
+    queue_ids: List[str]
+    jobs: List[JobInfo]
+    tasks: List[TaskInfo]
+    device: DeviceSession
+    # task arrays ([T_pad])
+    resreq: np.ndarray
+    init_resreq: np.ndarray
+    task_nz: np.ndarray
+    task_job: np.ndarray
+    task_rank: np.ndarray
+    task_sig: np.ndarray
+    task_valid: np.ndarray
+    # sig arrays ([S_pad, N] / [S_pad, ...])
+    sig_scores: np.ndarray
+    sig_pred: np.ndarray
+    sig_nz: np.ndarray
+    sig_req: np.ndarray
+    # job arrays ([J_pad])
+    min_available: np.ndarray
+    order_min_available: np.ndarray
+    init_allocated: np.ndarray
+    job_queue: np.ndarray
+    job_priority: np.ndarray
+    job_create_rank: np.ndarray
+    job_valid: np.ndarray
+    # queue arrays ([Q_pad])
+    q_weight: np.ndarray
+    q_entries: np.ndarray
+    q_create_rank: np.ndarray
+    q_deserved: np.ndarray
+    q_alloc0: np.ndarray
+    # drf
+    j_alloc0: np.ndarray
+    cluster_total: np.ndarray
+    # dynamic nodeorder terms
+    dyn_weights: np.ndarray
+    dyn_enabled: bool
+    # order/flag specs
+    job_keys: Tuple[str, ...]
+    queue_keys: Tuple[str, ...]
+    gang_enabled: bool
+    prop_overused: bool
+
+    @property
+    def n_tasks_real(self) -> int:
+        return len(self.tasks)
+
+
+def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
+    """Tensorize the session for a whole-cycle solve, or None when some
+    registered callback / snapshot feature can't run on device (callers
+    then fall back without having paid the device upload)."""
+    # ---- queues ----------------------------------------------------------
+    queue_ids = sorted(ssn.queues)          # uid order = order fallback
+    q_index = {q: i for i, q in enumerate(queue_ids)}
+    q_pad = pad_to_bucket(len(queue_ids), 4)
+
+    # ---- jobs ------------------------------------------------------------
+    jobs: List[JobInfo] = [j for j in ssn.jobs.values()
+                           if j.queue in q_index]
+    # creation-rank tie-break (creation_timestamp, uid)
+    jobs_sorted = sorted(jobs, key=lambda j: (j.creation_timestamp, j.uid))
+    j_rank = {j.uid: r for r, j in enumerate(jobs_sorted)}
+    j_pad = pad_to_bucket(len(jobs), 4)
+    j_index = {j.uid: i for i, j in enumerate(jobs)}
+
+    # ---- tasks (pending, non-BestEffort, in task-order per job) ----------
+    tasks: List[TaskInfo] = []
+    task_job_idx: List[int] = []
+    task_ranks: List[int] = []
+    for j in jobs:
+        pend = [t for t in j.task_status_index.get(TaskStatus.PENDING,
+                                                   {}).values()
+                if not t.resreq.is_empty()]
+        pend.sort(key=functools.cmp_to_key(
+            lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
+        for rank, t in enumerate(pend):
+            tasks.append(t)
+            task_job_idx.append(j_index[j.uid])
+            task_ranks.append(rank)
+    if not tasks:
+        return EMPTY_CYCLE
+    # cheap feature gate BEFORE tensorizing/uploading the cluster — a
+    # fallback cycle must not pay the device transfer
+    if not device_supported(ssn, tasks):
+        return None
+    if ssn.device_snapshot is None:
+        ssn.device_snapshot = DeviceSession(ssn.nodes)
+    device: DeviceSession = ssn.device_snapshot
+    terms = solver_terms(ssn, device, tasks)
+    if terms is None:
+        return None
+    batch = TaskBatch.from_tasks(tasks)
+    t_pad = batch.t_padded
+
+    # ---- job arrays ------------------------------------------------------
+    gang = gang_enabled(ssn)
+    min_av = np.zeros(j_pad, np.int32)
+    order_min_av = np.zeros(j_pad, np.int32)
+    init_alloc = np.zeros(j_pad, np.int32)
+    job_queue = np.zeros(j_pad, np.int32)
+    job_priority = np.zeros(j_pad, np.float32)
+    job_create_rank = np.zeros(j_pad, np.int32)
+    job_valid = np.zeros(j_pad, bool)
+    for i, j in enumerate(jobs):
+        min_av[i] = j.min_available if gang else 0
+        order_min_av[i] = j.min_available
+        init_alloc[i] = j.count(*ready_statuses())
+        job_queue[i] = q_index[j.queue]
+        job_priority[i] = j.priority
+        job_create_rank[i] = j_rank[j.uid]
+        job_valid[i] = True
+
+    # ---- task arrays -----------------------------------------------------
+    task_job = np.full(t_pad, -1, np.int32)
+    task_rank = np.zeros(t_pad, np.int32)
+    task_job[:len(tasks)] = task_job_idx
+    task_rank[:len(tasks)] = task_ranks
+
+    # ---- queue arrays ----------------------------------------------------
+    q_weight = np.zeros(q_pad, np.float32)
+    q_entries = np.zeros(q_pad, np.int32)
+    q_create_rank = np.arange(q_pad, dtype=np.int32)
+    q_deserved = np.zeros((q_pad, 3), np.float32)
+    q_alloc0 = np.zeros((q_pad, 3), np.float32)
+    for q, i in q_index.items():
+        q_weight[i] = ssn.queues[q].weight
+    for j in jobs:
+        q_entries[q_index[j.queue]] += 1
+
+    prop = ssn.plugins.get("proportion")
+    queue_keys, _ = queue_order_spec(ssn)
+    prop_overused = ("proportion" in ssn.overused_fns
+                     and any(opt.name == "proportion"
+                             for tier in ssn.tiers
+                             for opt in tier.plugins))
+    if prop is not None and getattr(prop, "queue_opts", None):
+        for q, attr in prop.queue_opts.items():
+            i = q_index.get(q)
+            if i is not None:
+                q_deserved[i] = attr.deserved.to_vec()
+                q_alloc0[i] = attr.allocated.to_vec()
+
+    # ---- drf arrays ------------------------------------------------------
+    job_keys, _ = job_order_spec(ssn)
+    j_alloc0 = np.zeros((j_pad, 3), np.float32)
+    cluster_total = np.ones(3, np.float32)
+    drf = ssn.plugins.get("drf")
+    if K_DRF_SHARE in job_keys and drf is not None:
+        cluster_total = drf.total_resource.to_vec()
+        for j in jobs:
+            attr = drf.job_opts.get(j.uid)
+            if attr is not None:
+                j_alloc0[j_index[j.uid]] = attr.allocated.to_vec()
+
+    # ---- scores / predicates (sig-indexed static + in-kernel dynamic) ---
+    task_sig = terms.task_sig(tasks, t_pad)
+    s_pad = pad_to_bucket(terms.static.n_sigs, 4)
+    sig_scores = np.zeros((s_pad, device.n_padded), np.float32)
+    sig_pred = np.zeros((s_pad, device.n_padded), bool)
+    sig_scores[:terms.static.n_sigs] = terms.static.score
+    sig_pred[:terms.static.n_sigs] = terms.static.pred
+    dyn_enabled = terms.dynamic.enabled
+    dyn_weights = np.asarray([terms.dynamic.least_requested,
+                              terms.dynamic.balanced_resource], np.float32)
+
+    # per-sig mean request / nonzero-request (waterfall capacity estimates
+    # in the batched kernel; exactness is not required — acceptance checks
+    # real per-task requests)
+    n_real = len(tasks)
+    sig_real = task_sig[:n_real]
+    counts = np.bincount(sig_real, minlength=s_pad).astype(np.float32)
+    denom = np.maximum(counts, 1.0)[:, None]
+    sig_req = np.zeros((s_pad, batch.resreq.shape[1]), np.float32)
+    sig_nz = np.zeros((s_pad, 2), np.float32)
+    for c in range(batch.resreq.shape[1]):
+        sig_req[:, c] = np.bincount(sig_real, weights=batch.resreq[:n_real, c],
+                                    minlength=s_pad)
+    for c in range(2):
+        sig_nz[:, c] = np.bincount(sig_real, weights=batch.nz_req[:n_real, c],
+                                   minlength=s_pad)
+    sig_req /= denom
+    sig_nz /= denom
+
+    return CycleInputs(
+        queue_ids=queue_ids, jobs=jobs, tasks=tasks, device=device,
+        resreq=batch.resreq, init_resreq=batch.init_resreq,
+        task_nz=batch.nz_req, task_job=task_job, task_rank=task_rank,
+        task_sig=task_sig, task_valid=batch.valid,
+        sig_scores=sig_scores, sig_pred=sig_pred, sig_nz=sig_nz,
+        sig_req=sig_req,
+        min_available=min_av, order_min_available=order_min_av,
+        init_allocated=init_alloc, job_queue=job_queue,
+        job_priority=job_priority, job_create_rank=job_create_rank,
+        job_valid=job_valid,
+        q_weight=q_weight, q_entries=q_entries, q_create_rank=q_create_rank,
+        q_deserved=q_deserved, q_alloc0=q_alloc0,
+        j_alloc0=j_alloc0, cluster_total=cluster_total,
+        dyn_weights=dyn_weights, dyn_enabled=dyn_enabled,
+        job_keys=job_keys, queue_keys=queue_keys, gang_enabled=gang,
+        prop_overused=prop_overused)
+
+
+def replay_decisions(ssn: Session, inputs: CycleInputs,
+                     task_state: np.ndarray, task_node: np.ndarray,
+                     task_seq: np.ndarray) -> None:
+    """Apply a whole-cycle kernel's decisions through the Session in the
+    kernel's assignment order, so host plugin state, event handlers, and
+    the gang dispatch barrier observe identical events."""
+    from ..kernels.fused import ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP
+
+    device = inputs.device
+    tasks = inputs.tasks
+    order = [i for i in range(len(tasks)) if task_state[i] != SKIP]
+    order.sort(key=lambda i: task_seq[i])
+    try:
+        for i in order:
+            task = tasks[i]
+            kind = int(task_state[i])
+            if kind in (ALLOC, ALLOC_OB, PIPELINE):
+                node_name = device.node_name(int(task_node[i]))
+                if kind == PIPELINE:
+                    ssn.pipeline(task, node_name)
+                else:
+                    ssn.allocate(task, node_name, kind == ALLOC_OB)
+            elif kind == FAIL:
+                # fit-delta diagnostics for the task that broke its job,
+                # against node state at failure time (host nodes mirror the
+                # kernel here)
+                job = ssn.jobs.get(task.job)
+                if job is not None:
+                    job.nodes_fit_delta = {}
+                    for node in ssn.nodes.values():
+                        delta = node.idle.clone()
+                        delta.fit_delta(task.resreq)
+                        job.nodes_fit_delta[node.name] = delta
+    except Exception:
+        # host replay stopped mid-way (e.g. volume allocation failure):
+        # device state holds phantom allocations — rebuild from host truth
+        device.resync(ssn.nodes)
+        raise

@@ -1,0 +1,465 @@
+"""Batched allocate solver — many placements per device step.
+
+The fused kernel (kernels/fused.py) replays the reference's heap algorithm
+one placement per ``while_loop`` iteration; at 10k pending tasks that is
+10k+ sequential device steps (~100 us each).  This module is the
+TPU-idiomatic alternative: a **round-based** solver where every round
+places as many tasks as capacity allows, in parallel, and only the few
+capacity *conflicts* spill to the next round.  A 10k-task cycle resolves
+in a handful of rounds, and the whole round loop runs inside ONE device
+dispatch (the axon tunnel charges ~70 ms per device->host transfer, so
+the cycle performs exactly one blocking read).
+
+Round structure (all tensor ops):
+
+1. **Order** — queue shares (proportion water-fill state), DRF job shares
+   and gang readiness are recomputed from the committed state, composed
+   into the configured lexicographic job order (the same key vocabulary as
+   kernels/fused.py), and flattened into a global task rank.
+2. **Eligibility** — the exact per-(task, node) predicate+fit matrix
+   against round-start capacity: sig-indexed static predicates AND
+   task-count room AND (fits idle+backfilled OR fits releasing), mirroring
+   allocate.go:153-184.  A participating task with no eligible node FAILs
+   and (gang semantics) kills its job's later-ranked tasks — the batch
+   equivalent of "job dropped on first unassignable task"
+   (allocate.go:187-189).
+3. **Proposals** — tasks pick target nodes.  Identical tasks must spread
+   (argmax alone would pile every replica of a template onto one node and
+   serialize into per-node rounds), so tasks of one signature are
+   *waterfalled*: nodes sorted by score, estimated integer capacities
+   cumulated, and the cohort's m-th task proposes the node covering
+   position m.  Tasks whose waterfall slot is infeasible for their exact
+   request fall back to their individual masked argmax.
+4. **Acceptance** — per node, proposers are taken in global-rank order
+   while the cumulative exact requests fit the pool (segmented scans keep
+   float error per-node, not global).  The top-ranked proposer on each
+   node always fits (eligibility checked the full pool), so every round
+   makes progress.  Rejected proposers simply retry next round against
+   refreshed state.
+5. **Commit** — accepted placements update capacity, fairness shares,
+   and gang counters via per-node / per-job / per-queue segment sums.
+
+Faithfulness contract (vs the reference allocate action):
+- capacity, predicates, epsilon fit rules, AllocatedOverBackfill and
+  Pipelined decisions are exact (same arithmetic as kernels/fused.py);
+- gang all-or-nothing, job-drop-on-failure, overused-queue exclusion and
+  the pipelined-inclusive readiness count are preserved;
+- *ordering* is round-granular: fairness shares and the derived queue/job
+  order refresh between rounds, not between every single placement, and a
+  queue/job visit sequence is not materialized.  Under contention the
+  task->node map can differ from the sequential heap schedule while
+  satisfying the same policy constraints.  The fused and host modes remain
+  the bit-exact engines; this is the throughput engine the north-star
+  latency target is measured on (BASELINE.md).
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metrics import update_solver_kernel_duration
+from .fused import (ALLOC, ALLOC_OB, FAIL, K_DRF_SHARE, K_GANG_READY,
+                    K_PRIORITY, K_PROP_SHARE, PIPELINE, SKIP, _share)
+from .solver import dynamic_node_score
+from .tensorize import VEC_EPS
+
+_IMAX = jnp.iinfo(jnp.int32).max
+
+
+class RoundState(NamedTuple):
+    """Device state carried across rounds."""
+    idle: jnp.ndarray         # [N,R]
+    releasing: jnp.ndarray    # [N,R]
+    n_tasks: jnp.ndarray      # [N]
+    nz_req: jnp.ndarray       # [N,2]
+    q_allocated: jnp.ndarray  # [Q,R]
+    j_allocated: jnp.ndarray  # [J,R]
+    alloc_cnt: jnp.ndarray    # [J] allocated-family count (readiness)
+    job_alive: jnp.ndarray    # [J] bool — not yet dropped on failure
+    task_state: jnp.ndarray   # [T] SKIP while pending
+    task_node: jnp.ndarray    # [T]
+    task_seq: jnp.ndarray     # [T] round * T_pad + in-round rank
+
+
+class CycleArrays(NamedTuple):
+    """Arrays static across rounds (uploaded once per cycle)."""
+    backfilled: jnp.ndarray       # [N,R]
+    allocatable_cm: jnp.ndarray   # [N,2]
+    max_task_num: jnp.ndarray     # [N]
+    node_ok: jnp.ndarray          # [N]
+    resreq: jnp.ndarray           # [T,R]
+    init_resreq: jnp.ndarray      # [T,R]
+    task_nz: jnp.ndarray          # [T,2]
+    task_job: jnp.ndarray         # [T]
+    task_rank: jnp.ndarray        # [T]
+    task_sig: jnp.ndarray         # [T]
+    task_valid: jnp.ndarray       # [T]
+    sig_scores: jnp.ndarray       # [S,N]
+    sig_pred: jnp.ndarray         # [S,N]
+    sig_nz: jnp.ndarray           # [S,2]
+    sig_req: jnp.ndarray          # [S,R]
+    order_min_available: jnp.ndarray  # [J]
+    job_queue: jnp.ndarray        # [J]
+    job_priority: jnp.ndarray     # [J]
+    job_create_rank: jnp.ndarray  # [J]
+    job_valid: jnp.ndarray        # [J]
+    q_deserved: jnp.ndarray       # [Q,R]
+    q_create_rank: jnp.ndarray    # [Q]
+    cluster_total: jnp.ndarray    # [R]
+    dyn_weights: jnp.ndarray      # [2]
+
+
+def _segmented_prefix(values: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive prefix sums within segments of a sorted array.
+
+    ``starts[i]`` is the index where row i's segment begins (rows sorted by
+    segment).  An associative segmented scan keeps rounding error bounded
+    by the segment length (a node's task count), not the global sum —
+    float32 stays well inside the resource epsilons.
+    """
+    flag = jnp.arange(values.shape[0]) == starts          # segment head
+    if values.ndim == 2:
+        flag = flag[:, None]
+
+    def comb(a, b):
+        sa, fa = a
+        sb, fb = b
+        return jnp.where(fb, sb, sa + sb), fa | fb
+
+    sums, _ = jax.lax.associative_scan(comb, (values, flag))
+    return sums - values                                   # exclusive
+
+
+def _round(state: RoundState, a: CycleArrays, round_idx,
+           job_keys: Tuple[str, ...], queue_keys: Tuple[str, ...],
+           prop_overused: bool, dyn_enabled: bool):
+    """One allocation round.  Returns (new_state, progress)."""
+    eps = jnp.asarray(VEC_EPS)
+    t_pad = a.task_valid.shape[0]
+    n_pad = a.node_ok.shape[0]
+
+    # ---- 1. ordering ----------------------------------------------------
+    overused = jnp.zeros(a.q_deserved.shape[0], bool)
+    if prop_overused:
+        overused = jnp.all(a.q_deserved < state.q_allocated + eps, axis=-1)
+
+    q_share = jnp.zeros(a.q_deserved.shape[0], jnp.float32)
+    for k in queue_keys:
+        if k == K_PROP_SHARE:
+            q_share = _share(state.q_allocated, a.q_deserved)
+
+    jkeys = []
+    for k in job_keys:
+        if k == K_PRIORITY:
+            jkeys.append(-a.job_priority.astype(jnp.float32))
+        elif k == K_GANG_READY:
+            ready = (state.alloc_cnt >= a.order_min_available)
+            jkeys.append(ready.astype(jnp.float32))
+        elif k == K_DRF_SHARE:
+            jkeys.append(_share(state.j_allocated, a.cluster_total[None, :]))
+    # queue keys lead (the reference pops the best queue first), then the
+    # configured job keys, then creation rank; lexsort's LAST key is primary
+    keys = ([a.job_create_rank.astype(jnp.float32)]
+            + list(reversed(jkeys))
+            + [a.q_create_rank[a.job_queue].astype(jnp.float32),
+               q_share[a.job_queue]])
+    job_order = jnp.lexsort(keys)
+    job_sort_rank = jnp.zeros_like(job_order).at[job_order].set(
+        jnp.arange(job_order.shape[0]))
+
+    participating = (a.task_valid & (state.task_state == SKIP)
+                     & state.job_alive[a.task_job] & a.job_valid[a.task_job]
+                     & ~overused[a.job_queue[a.task_job]])
+
+    # global task rank: (job order, task order); non-participants last
+    jr = jnp.where(participating, job_sort_rank[a.task_job], _IMAX)
+    order = jnp.lexsort([a.task_rank, jr])
+    global_rank = jnp.zeros(t_pad, jnp.int32).at[order].set(
+        jnp.arange(t_pad, dtype=jnp.int32))
+
+    # ---- 2. exact eligibility ------------------------------------------
+    accessible = state.idle + a.backfilled
+    room = state.n_tasks < a.max_task_num
+    base = a.node_ok & room
+    fit_alloc = jnp.all(a.init_resreq[:, None, :] <= accessible[None] + eps,
+                        axis=-1)
+    fit_pipe = jnp.all(
+        a.init_resreq[:, None, :] <= state.releasing[None] + eps, axis=-1)
+    pred_t = a.sig_pred[a.task_sig]
+    eligible = pred_t & base[None, :] & (fit_alloc | fit_pipe)
+    any_elig = jnp.any(eligible, axis=1)
+
+    fail_now = participating & ~any_elig
+    # first failing rank per job kills the job's later-ranked tasks; only
+    # the breaking task itself is marked FAIL (allocate.go:187-189 — the
+    # rest simply stay Pending once the job leaves the queue)
+    fail_rank = jax.ops.segment_min(
+        jnp.where(fail_now, global_rank, _IMAX),
+        jnp.maximum(a.task_job, 0), num_segments=a.job_valid.shape[0])
+    job_killed = fail_rank < _IMAX
+    fail_first = fail_now & (global_rank == fail_rank[a.task_job])
+    blocked = participating & (global_rank > fail_rank[a.task_job])
+    part2 = participating & ~fail_now & ~blocked
+
+    # ---- 3. proposals ---------------------------------------------------
+    dyn_term = jnp.zeros_like(a.sig_scores)
+    if dyn_enabled:
+        dyn_term = jax.vmap(
+            lambda nz: dynamic_node_score(state.nz_req, nz,
+                                          a.allocatable_cm,
+                                          a.dyn_weights))(a.sig_nz)
+    sc = a.sig_scores + dyn_term                          # [S,N]
+    ord_idx = jnp.argsort(-sc, axis=1, stable=True)       # [S,N]
+
+    tiny = jnp.float32(1e-6)
+    mean_fit_acc = jnp.all(a.sig_req[:, None, :] <= accessible[None] + eps,
+                           axis=-1)
+    mean_fit_pipe = jnp.all(a.sig_req[:, None, :] <= state.releasing[None]
+                            + eps, axis=-1)
+    per_r_acc = jnp.floor((accessible[None] + eps)
+                          / jnp.maximum(a.sig_req[:, None, :], tiny))
+    per_r_pipe = jnp.floor((state.releasing[None] + eps)
+                           / jnp.maximum(a.sig_req[:, None, :], tiny))
+    big_cap = jnp.float32(1e6)
+    cap_acc = jnp.min(jnp.where(a.sig_req[:, None, :] > 0, per_r_acc,
+                                big_cap), axis=-1)
+    cap_pipe = jnp.min(jnp.where(a.sig_req[:, None, :] > 0, per_r_pipe,
+                                 big_cap), axis=-1)
+    cap = jnp.where(mean_fit_acc, cap_acc,
+                    jnp.where(mean_fit_pipe, cap_pipe, 0.0))
+    room_cnt = (a.max_task_num - state.n_tasks).astype(jnp.float32)
+    cap = jnp.minimum(cap, jnp.maximum(room_cnt, 0.0)[None, :])
+    cap = jnp.where(a.sig_pred & base[None, :], cap, 0.0)
+    cap = jnp.maximum(cap, 0.0)     # keep the cumsum monotone
+    cum = jnp.cumsum(jnp.take_along_axis(cap, ord_idx, axis=1), axis=1)
+
+    # cohort position m: rank among part2 tasks of the same sig
+    s_pad = a.sig_pred.shape[0]
+    sig_key = jnp.where(part2, a.task_sig, s_pad)
+    perm = jnp.lexsort([global_rank, sig_key])
+    sorted_sig = sig_key[perm]
+    first = jnp.searchsorted(sorted_sig, sorted_sig, side="left")
+    m_sorted = jnp.arange(t_pad) - first
+    m = jnp.zeros(t_pad, jnp.int32).at[perm].set(m_sorted.astype(jnp.int32))
+
+    cum_rows = cum[a.task_sig]                            # [T,N]
+    slot = jax.vmap(lambda row, mm: jnp.searchsorted(row, mm, side="right"))(
+        cum_rows, m.astype(jnp.float32))
+    slot_ok = slot < n_pad
+    slot_c = jnp.minimum(slot, n_pad - 1)
+    p_water = jnp.take_along_axis(ord_idx[a.task_sig], slot_c[:, None],
+                                  axis=1)[:, 0]
+    water_elig = jnp.take_along_axis(eligible, p_water[:, None],
+                                     axis=1)[:, 0] & slot_ok
+
+    sc_rows = sc[a.task_sig]                              # [T,N]
+    fb = jnp.argmax(jnp.where(eligible, sc_rows, -jnp.inf), axis=1)
+    proposal = jnp.where(water_elig, p_water, fb).astype(jnp.int32)
+
+    # ---- 4. acceptance --------------------------------------------------
+    prop_alloc = jnp.take_along_axis(fit_alloc, proposal[:, None],
+                                     axis=1)[:, 0]        # else pipeline
+    node_key = jnp.where(part2, proposal, n_pad)
+    perm2 = jnp.lexsort([global_rank, node_key])
+    nid = node_key[perm2]
+    seg_start = jnp.searchsorted(nid, nid, side="left")
+    nid_c = jnp.minimum(nid, n_pad - 1)
+
+    s_req = a.resreq[perm2]
+    s_init = a.init_resreq[perm2]
+    s_alloc = prop_alloc[perm2]
+    s_part = part2[perm2]
+
+    alloc_vals = jnp.where((s_alloc & s_part)[:, None], s_req, 0.0)
+    pipe_vals = jnp.where((~s_alloc & s_part)[:, None], s_req, 0.0)
+    cnt_vals = s_part.astype(jnp.int32)
+
+    excl_alloc = _segmented_prefix(alloc_vals, seg_start)
+    excl_pipe = _segmented_prefix(pipe_vals, seg_start)
+    excl_cnt = _segmented_prefix(cnt_vals, seg_start)
+
+    pool_acc = accessible[nid_c]
+    pool_idle = state.idle[nid_c]
+    pool_rel = state.releasing[nid_c]
+    room_left = (a.max_task_num[nid_c] - state.n_tasks[nid_c]
+                 - excl_cnt) > 0
+
+    ok_alloc = (s_alloc & s_part & room_left
+                & jnp.all(s_init <= pool_acc - excl_alloc + eps, axis=-1))
+    ok_pipe = (~s_alloc & s_part & room_left
+               & jnp.all(s_init <= pool_rel - excl_pipe + eps, axis=-1))
+    accept_s = ok_alloc | ok_pipe
+    # over-backfill: the accepted launch request no longer fits what's left
+    # of plain idle after earlier-ranked accepted alloc takes
+    ob_s = ok_alloc & ~jnp.all(s_init <= pool_idle - excl_alloc + eps,
+                               axis=-1)
+
+    inv2 = jnp.zeros(t_pad, jnp.int32).at[perm2].set(
+        jnp.arange(t_pad, dtype=jnp.int32))
+    accept = accept_s[inv2]
+    ob = ob_s[inv2]
+    is_alloc = prop_alloc & accept
+    is_pipe = ~prop_alloc & accept
+
+    # ---- 5. commit ------------------------------------------------------
+    node_seg = jnp.where(accept, proposal, 0)
+    take_alloc = jnp.where(is_alloc[:, None], a.resreq, 0.0)
+    take_pipe = jnp.where(is_pipe[:, None], a.resreq, 0.0)
+    new_idle = state.idle - jax.ops.segment_sum(take_alloc, node_seg,
+                                                num_segments=n_pad)
+    new_rel = state.releasing - jax.ops.segment_sum(take_pipe, node_seg,
+                                                    num_segments=n_pad)
+    new_ntasks = state.n_tasks + jax.ops.segment_sum(
+        accept.astype(jnp.int32), node_seg, num_segments=n_pad)
+    new_nz = state.nz_req + jax.ops.segment_sum(
+        jnp.where(accept[:, None], a.task_nz, 0.0), node_seg,
+        num_segments=n_pad)
+
+    job_seg = jnp.where(accept, a.task_job, 0)
+    take_any = jnp.where(accept[:, None], a.resreq, 0.0)
+    n_jobs = a.job_valid.shape[0]
+    new_j_alloc = state.j_allocated + jax.ops.segment_sum(
+        take_any, job_seg, num_segments=n_jobs)
+    queue_seg = jnp.where(accept, a.job_queue[jnp.maximum(a.task_job, 0)], 0)
+    new_q_alloc = state.q_allocated + jax.ops.segment_sum(
+        take_any, queue_seg, num_segments=a.q_deserved.shape[0])
+    # pipelined-inclusive readiness; over-backfill stays outside the quorum
+    counted = accept & ~ob
+    new_alloc_cnt = state.alloc_cnt + jax.ops.segment_sum(
+        counted.astype(jnp.int32), job_seg, num_segments=n_jobs)
+
+    decision = jnp.where(
+        fail_first, FAIL,
+        jnp.where(is_pipe, PIPELINE,
+                  jnp.where(is_alloc & ob, ALLOC_OB,
+                            jnp.where(is_alloc, ALLOC, SKIP))))
+    changed = accept | fail_first
+    new_task_state = jnp.where(changed, decision, state.task_state)
+    new_task_node = jnp.where(accept, proposal, state.task_node)
+    new_task_seq = jnp.where(changed, round_idx * t_pad + global_rank,
+                             state.task_seq)
+
+    new_alive = state.job_alive & ~job_killed
+    progress = jnp.any(changed)
+
+    new_state = RoundState(
+        idle=new_idle, releasing=new_rel, n_tasks=new_ntasks, nz_req=new_nz,
+        q_allocated=new_q_alloc, j_allocated=new_j_alloc,
+        alloc_cnt=new_alloc_cnt, job_alive=new_alive,
+        task_state=new_task_state, task_node=new_task_node,
+        task_seq=new_task_seq)
+    return new_state, progress
+
+
+@partial(jax.jit, static_argnames=("job_keys", "queue_keys",
+                                   "prop_overused", "dyn_enabled"))
+def batched_round(state: RoundState, a: CycleArrays, round_idx,
+                  job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY,
+                                               K_DRF_SHARE),
+                  queue_keys: Tuple[str, ...] = (K_PROP_SHARE,),
+                  prop_overused: bool = True,
+                  dyn_enabled: bool = False):
+    """Single-round entry point (tests / diagnostics)."""
+    return _round(state, a, round_idx, job_keys, queue_keys, prop_overused,
+                  dyn_enabled)
+
+
+@partial(jax.jit, static_argnames=("job_keys", "queue_keys",
+                                   "prop_overused", "dyn_enabled",
+                                   "max_rounds"))
+def batched_allocate(state: RoundState, a: CycleArrays,
+                     job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY,
+                                                  K_DRF_SHARE),
+                     queue_keys: Tuple[str, ...] = (K_PROP_SHARE,),
+                     prop_overused: bool = True,
+                     dyn_enabled: bool = False,
+                     max_rounds: int = 64):
+    """The whole allocate cycle: rounds run in a device-side while_loop
+    until a round makes no progress — ONE dispatch, one readback."""
+    def cond(carry):
+        _, round_idx, progress = carry
+        return progress & (round_idx < max_rounds)
+
+    def body(carry):
+        s, round_idx, _ = carry
+        ns, progress = _round(s, a, round_idx, job_keys, queue_keys,
+                              prop_overused, dyn_enabled)
+        return ns, round_idx + 1, progress
+
+    init = (state, jnp.int32(0), jnp.asarray(True))
+    final, rounds, _ = jax.lax.while_loop(cond, body, init)
+    return final, rounds
+
+
+def solve_batched(device, inputs, max_rounds: int = 0):
+    """Drive the round loop.  ``device`` is a solver.DeviceSession (its
+    capacity arrays are committed on return); ``inputs`` a CycleInputs
+    (actions/cycle_inputs.py).  Returns (task_state, task_node, task_seq)
+    as numpy plus the round count."""
+    t_pad = inputs.task_valid.shape[0]
+    if max_rounds <= 0:
+        # every productive round places >= 1 task or fails >= 1 job; the
+        # bound is a safety net, not the expected round count
+        max_rounds = int(t_pad) + 8
+
+    state = RoundState(
+        idle=device.idle, releasing=device.releasing,
+        n_tasks=device.n_tasks, nz_req=device.nz_req,
+        q_allocated=jnp.asarray(inputs.q_alloc0),
+        j_allocated=jnp.asarray(inputs.j_alloc0),
+        alloc_cnt=jnp.asarray(inputs.init_allocated, jnp.int32),
+        job_alive=jnp.asarray(inputs.job_valid),
+        task_state=jnp.full(t_pad, SKIP, jnp.int32),
+        task_node=jnp.full(t_pad, -1, jnp.int32),
+        task_seq=jnp.full(t_pad, _IMAX, jnp.int32))
+
+    arrays = CycleArrays(
+        backfilled=device.backfilled, allocatable_cm=device.allocatable_cm,
+        max_task_num=device.max_task_num, node_ok=device.node_ok,
+        resreq=jnp.asarray(inputs.resreq),
+        init_resreq=jnp.asarray(inputs.init_resreq),
+        task_nz=jnp.asarray(inputs.task_nz),
+        task_job=jnp.asarray(inputs.task_job),
+        task_rank=jnp.asarray(inputs.task_rank),
+        task_sig=jnp.asarray(inputs.task_sig),
+        task_valid=jnp.asarray(inputs.task_valid),
+        sig_scores=jnp.asarray(inputs.sig_scores),
+        sig_pred=jnp.asarray(inputs.sig_pred),
+        sig_nz=jnp.asarray(inputs.sig_nz),
+        sig_req=jnp.asarray(inputs.sig_req),
+        order_min_available=jnp.asarray(inputs.order_min_available),
+        job_queue=jnp.asarray(inputs.job_queue),
+        job_priority=jnp.asarray(inputs.job_priority),
+        job_create_rank=jnp.asarray(inputs.job_create_rank),
+        job_valid=jnp.asarray(inputs.job_valid),
+        q_deserved=jnp.asarray(inputs.q_deserved),
+        q_create_rank=jnp.asarray(inputs.q_create_rank),
+        cluster_total=jnp.asarray(inputs.cluster_total),
+        dyn_weights=jnp.asarray(inputs.dyn_weights))
+
+    start = time.perf_counter()
+    final, rounds = batched_allocate(
+        state, arrays,
+        job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+        prop_overused=inputs.prop_overused,
+        dyn_enabled=inputs.dyn_enabled,
+        max_rounds=min(max_rounds, 4096))
+
+    device.idle = final.idle
+    device.releasing = final.releasing
+    device.n_tasks = final.n_tasks
+    device.nz_req = final.nz_req
+    # one pipelined transfer for everything the host needs
+    for arr in (final.task_state, final.task_node, final.task_seq, rounds):
+        arr.copy_to_host_async()
+    task_state = np.asarray(final.task_state)
+    task_node = np.asarray(final.task_node)
+    task_seq = np.asarray(final.task_seq)
+    update_solver_kernel_duration("batched_allocate",
+                                  time.perf_counter() - start)
+    return task_state, task_node, task_seq, int(rounds)

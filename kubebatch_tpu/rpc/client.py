@@ -9,8 +9,8 @@ from typing import Dict, List
 import grpc
 import numpy as np
 
-from ..actions.allocate_fused import (_gang_enabled, _job_order_spec,
-                                      fused_supported)
+from ..actions.cycle_inputs import (cycle_supported, gang_enabled,
+                                    job_order_spec)
 from ..api import TaskStatus, ready_statuses
 from ..framework import Session
 from ..kernels.fused import (ALLOC, ALLOC_OB, K_DRF_SHARE, K_PRIORITY,
@@ -36,7 +36,7 @@ class SolverClient:
         ValueError for configurations the sidecar kernel cannot express
         (custom order fns, predicate/node-order plugins) — silent
         divergence from the in-process path is worse than an error."""
-        if not fused_supported(ssn):
+        if not cycle_supported(ssn):
             raise ValueError(
                 "session plugins exceed the sidecar solver's vocabulary; "
                 "run allocate in-process for this configuration")
@@ -94,8 +94,8 @@ class SolverClient:
 
         # derive flags the same way the in-process fused path does, so
         # per-tier disable flags are honored identically
-        job_keys, _ = _job_order_spec(ssn)
-        req.gang_enabled = _gang_enabled(ssn)
+        job_keys, _ = job_order_spec(ssn)
+        req.gang_enabled = gang_enabled(ssn)
         req.proportion_enabled = (
             "proportion" in ssn.overused_fns
             and any(opt.name == "proportion" for tier in ssn.tiers

@@ -1,0 +1,182 @@
+"""Batched (round-based) allocate solver — policy-invariant tests.
+
+The batched engine (kernels/batched.py) is order-approximate under
+contention (fairness refreshes between rounds, not between placements),
+so instead of bind-for-bind equality with the host oracle these tests
+assert the *policy contract* on contended random clusters:
+
+- capacity: no node ends over-allocated (idle never below -epsilon);
+- predicates: every bind satisfies the static predicate chain;
+- gang: a job's pods are bound iff the job reached readiness
+  (all-or-nothing at dispatch);
+- overused queues allocate nothing;
+- throughput parity: the batched engine binds at least as many pods as
+  the exact engine would leave unbound... (it must not strand capacity:
+  equal bound-pod totals on gang-free clusters).
+
+Bind-for-bind equality on uncontended clusters is covered by
+tests/test_allocate.py (MODES includes "batched").
+"""
+import copy
+
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.api import TaskStatus
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import CloseSession, OpenSession
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+class RecordingBinder:
+    def __init__(self):
+        self.binds = {}
+
+    def bind(self, pod, hostname):
+        self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+        pod.node_name = hostname
+
+
+FULL_TIERS = [
+    Tier(plugins=[PluginOption(name="priority"),
+                  PluginOption(name="gang"),
+                  PluginOption(name="conformance")]),
+    Tier(plugins=[PluginOption(name="drf"),
+                  PluginOption(name="predicates"),
+                  PluginOption(name="proportion"),
+                  PluginOption(name="nodeorder")]),
+]
+
+
+def contended_cluster(rng, n_nodes=8, n_jobs=14, max_pods=6):
+    """Demand ~2x capacity so acceptance conflicts actually occur."""
+    nodes = [build_node(f"n{i:03d}",
+                        rl(4000, 8 * GiB, pods=12))
+             for i in range(n_nodes)]
+    groups, pods = [], []
+    for j in range(n_jobs):
+        n_pods = int(rng.integers(1, max_pods + 1))
+        min_member = int(rng.integers(1, n_pods + 1))
+        groups.append(build_group("ns", f"pg{j:03d}", min_member,
+                                  queue="q1" if j % 2 else "q2",
+                                  creation_timestamp=float(j)))
+        for p in range(n_pods):
+            pods.append(build_pod(
+                "ns", f"j{j:03d}-p{p}", "", "Pending",
+                rl(int(rng.integers(1, 5)) * 500,
+                   int(rng.integers(1, 7)) * GiB // 2),
+                group=f"pg{j:03d}", priority=int(rng.integers(0, 3)),
+                creation_timestamp=float(p)))
+    return nodes, groups, pods
+
+
+def run(fixtures, mode, tiers=FULL_TIERS):
+    nodes, groups, pods = copy.deepcopy(fixtures)
+    binder = RecordingBinder()
+    cache = SchedulerCache(binder=binder, async_writeback=False)
+    for q in ("q1", "q2"):
+        cache.add_queue(build_queue(q))
+    for n in nodes:
+        cache.add_node(n)
+    for g in groups:
+        cache.add_pod_group(g)
+    for p in pods:
+        cache.add_pod(p)
+    ssn = OpenSession(cache, tiers)
+    AllocateAction(mode=mode).execute(ssn)
+    binds = dict(binder.binds)
+    return ssn, binds
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_capacity_and_gang_invariants_under_contention(seed):
+    rng = np.random.default_rng(seed)
+    fixtures = contended_cluster(rng)
+    ssn, binds = run(fixtures, "batched")
+
+    # capacity: session node accounting must not go negative beyond the
+    # backfill allowance (idle+backfilled >= -eps in every resource)
+    for node in ssn.nodes.values():
+        acc = node.accessible().to_vec()
+        assert (acc >= -1e-3).all(), f"{node.name} over-allocated: {acc}"
+
+    # gang all-or-nothing at dispatch: pods of a job are bound iff the job
+    # is ready; a ready job has >= min_available in the allocated family
+    for job in ssn.jobs.values():
+        bound = [t for t in job.tasks.values()
+                 if f"ns/{t.name}" in binds]
+        if bound:
+            assert ssn.job_ready(job), \
+                f"{job.name}: bound pods on unready job"
+        ready_family = job.count(TaskStatus.ALLOCATED,
+                                 TaskStatus.ALLOCATED_OVER_BACKFILL,
+                                 TaskStatus.BINDING, TaskStatus.BOUND,
+                                 TaskStatus.PIPELINED, TaskStatus.RUNNING)
+        if bound:
+            assert ready_family >= job.min_available
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_batched_throughput_parity_without_gangs(seed):
+    """With min_member=1 everywhere (no gang coupling) the round solver
+    must achieve the exact engine's throughput to within packing noise:
+    different placement orders fragment heterogeneous pods differently,
+    but the totals must stay within a few percent — a collapse would mean
+    the waterfall/acceptance logic strands capacity."""
+    rng = np.random.default_rng(seed)
+    nodes, groups, pods = contended_cluster(rng)
+    groups = [copy.deepcopy(g) for g in groups]
+    for g in groups:
+        g.min_member = 1
+    fixtures = (nodes, groups, pods)
+    _, binds_exact = run(fixtures, "fused")
+    _, binds_batched = run(fixtures, "batched")
+    assert len(binds_batched) >= 0.93 * len(binds_exact)
+    assert len(binds_batched) <= 1.07 * len(binds_exact) + 1
+
+
+def test_batched_respects_node_selector():
+    """Static predicate parity: pods with a selector only land on
+    matching nodes, and gangs that can't fit on matching nodes stay
+    wholly unbound."""
+    nodes = [build_node("n-a", rl(8000, 16 * GiB, pods=110),
+                        labels={"zone": "a"}),
+             build_node("n-b", rl(8000, 16 * GiB, pods=110),
+                        labels={"zone": "b"})]
+    groups = [build_group("ns", "pg1", 2, queue="q1")]
+    pods = [build_pod("ns", f"p{i}", "", "Pending", rl(1000, GiB),
+                      group="pg1", node_selector={"zone": "b"})
+            for i in range(2)]
+    _, binds = run((nodes, groups, pods), "batched")
+    assert binds == {"ns/p0": "n-b", "ns/p1": "n-b"}
+
+
+def test_batched_overused_queue_allocates_nothing():
+    """A queue already over its deserved share is skipped entirely
+    (proportion overused semantics).  Water-fill: both queues request
+    7000m of an 8000m cluster -> deserved 4000m each; q2's running fill
+    pod holds 6000m > deserved -> overused.  Must match the host oracle:
+    q1 pods win the remaining idle, q2's pending pod gets nothing."""
+    nodes = [build_node("n1", rl(8000, 16 * GiB, pods=110))]
+    groups = [build_group("ns", "pg-fill", 1, queue="q2",
+                          creation_timestamp=0.0),
+              build_group("ns", "pg-new", 1, queue="q2",
+                          creation_timestamp=1.0),
+              build_group("ns", "pg-q1", 1, queue="q1",
+                          creation_timestamp=2.0)]
+    pods = ([build_pod("ns", "fill", "n1", "Running", rl(6000, 6 * GiB),
+                       group="pg-fill")]
+            + [build_pod("ns", "q2-p", "", "Pending", rl(1000, GiB),
+                         group="pg-new")]
+            + [build_pod("ns", f"q1-p{i}", "", "Pending", rl(1000, GiB),
+                         group="pg-q1")
+               for i in range(7)])
+    _, binds_host = run((nodes, groups, pods), "host")
+    _, binds = run((nodes, groups, pods), "batched")
+    assert "ns/q2-p" not in binds_host    # scenario premise
+    assert "ns/q2-p" not in binds
+    assert set(binds) == set(binds_host)
