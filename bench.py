@@ -66,11 +66,16 @@ def run_config(config: int, cycles: int, mode: str):
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, default=2, choices=[1, 2, 3, 4, 5],
-                    help="BASELINE config number")
+    ap.add_argument("--config", type=int, default=5, choices=[1, 2, 3, 4, 5],
+                    help="BASELINE config number (default: the 10k pods x "
+                         "5k nodes stress config — BASELINE.md's primary "
+                         "metric)")
     ap.add_argument("--cycles", type=int, default=4)
-    ap.add_argument("--mode", default="fused",
-                    choices=["batched", "fused", "jax", "host"])
+    ap.add_argument("--mode", default="batched",
+                    choices=["batched", "fused", "jax", "host"],
+                    help="allocate engine: batched = round-based throughput "
+                         "engine (policy-exact, order-approximate); fused = "
+                         "bind-for-bind faithful scan engine")
     args = ap.parse_args(argv)
 
     latencies, bound, seconds = run_config(args.config, args.cycles,

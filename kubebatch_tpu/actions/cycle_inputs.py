@@ -98,11 +98,9 @@ class CycleInputs:
     task_rank: np.ndarray
     task_sig: np.ndarray
     task_valid: np.ndarray
-    # sig arrays ([S_pad, N] / [S_pad, ...])
+    # sig arrays ([S_pad, N])
     sig_scores: np.ndarray
     sig_pred: np.ndarray
-    sig_nz: np.ndarray
-    sig_req: np.ndarray
     # job arrays ([J_pad])
     min_available: np.ndarray
     order_min_available: np.ndarray
@@ -128,10 +126,69 @@ class CycleInputs:
     queue_keys: Tuple[str, ...]
     gang_enabled: bool
     prop_overused: bool
+    # lazy cache for pair_terms(): (max_pairs budget, result)
+    _pair_terms: Optional[tuple] = None
 
     @property
     def n_tasks_real(self) -> int:
         return len(self.tasks)
+
+    def pair_terms(self, max_pairs: int = 2048):
+        """Cohorts for the batched kernel's scoring/waterfall at (sig,
+        nonzero-request) granularity: tasks in one pair share the static
+        sig AND (exactly or within a quantization bucket) the nonzero
+        request, so per-pair dynamic node scores equal per-task scores —
+        fixing the cohort-mean divergence a sig-only grouping has for
+        heterogeneous same-sig pods.
+
+        Returns (task_pair [T_pad] int32, pair_sig [P_pad] int32,
+        pair_nz [P_pad,2] f32 member mean, exact: bool). When the exact
+        pair count exceeds
+        ``max_pairs``, nz is bucketed on a log2 grid, coarsening by octave
+        fractions until the count fits — scores then deviate by at most
+        the bucket width instead of by cohort heterogeneity. The result is
+        cached per budget value."""
+        if self._pair_terms is not None and self._pair_terms[0] == max_pairs:
+            return self._pair_terms[1]
+        n_real = len(self.tasks)
+        t_pad = self.task_sig.shape[0]
+        sig = self.task_sig[:n_real].astype(np.int64)
+        nz = self.task_nz[:n_real]
+        exact = True
+        # bucket fractions: exact first, then 16ths of an octave downward
+        for steps in (0, 16, 8, 4, 2, 1):
+            if steps == 0:
+                key_nz = nz
+            else:
+                exact = False
+                with np.errstate(divide="ignore"):
+                    key_nz = np.exp2(
+                        np.round(np.log2(np.maximum(nz, 1e-9)) * steps)
+                        / steps).astype(np.float32)
+            keys = np.concatenate(
+                [sig[:, None].astype(np.float64),
+                 key_nz.astype(np.float64)], axis=1)
+            uniq, inverse = np.unique(keys, axis=0, return_inverse=True)
+            if uniq.shape[0] <= max_pairs:
+                break
+        else:  # pragma: no cover — 1-octave buckets always fit max_pairs
+            uniq, inverse = np.unique(keys, axis=0, return_inverse=True)
+        p = uniq.shape[0]
+        p_pad = pad_to_bucket(p, 4)
+        pair_sig = np.zeros(p_pad, np.int32)
+        pair_sig[:p] = uniq[:, 0].astype(np.int32)
+        # member means (exact pairs: mean of identical values = the value)
+        counts = np.bincount(inverse, minlength=p_pad).astype(np.float64)
+        denom = np.maximum(counts, 1.0)
+        pair_nz = np.zeros((p_pad, 2), np.float32)
+        for c in range(2):
+            pair_nz[:, c] = (np.bincount(inverse, weights=nz[:, c],
+                                         minlength=p_pad) / denom)
+        task_pair = np.zeros(t_pad, np.int32)
+        task_pair[:n_real] = inverse.astype(np.int32)
+        result = (task_pair, pair_sig, pair_nz, exact)
+        self._pair_terms = (max_pairs, result)
+        return result
 
 
 def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
@@ -252,31 +309,12 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
     dyn_weights = np.asarray([terms.dynamic.least_requested,
                               terms.dynamic.balanced_resource], np.float32)
 
-    # per-sig mean request / nonzero-request (waterfall capacity estimates
-    # in the batched kernel; exactness is not required — acceptance checks
-    # real per-task requests)
-    n_real = len(tasks)
-    sig_real = task_sig[:n_real]
-    counts = np.bincount(sig_real, minlength=s_pad).astype(np.float32)
-    denom = np.maximum(counts, 1.0)[:, None]
-    sig_req = np.zeros((s_pad, batch.resreq.shape[1]), np.float32)
-    sig_nz = np.zeros((s_pad, 2), np.float32)
-    for c in range(batch.resreq.shape[1]):
-        sig_req[:, c] = np.bincount(sig_real, weights=batch.resreq[:n_real, c],
-                                    minlength=s_pad)
-    for c in range(2):
-        sig_nz[:, c] = np.bincount(sig_real, weights=batch.nz_req[:n_real, c],
-                                   minlength=s_pad)
-    sig_req /= denom
-    sig_nz /= denom
-
     return CycleInputs(
         queue_ids=queue_ids, jobs=jobs, tasks=tasks, device=device,
         resreq=batch.resreq, init_resreq=batch.init_resreq,
         task_nz=batch.nz_req, task_job=task_job, task_rank=task_rank,
         task_sig=task_sig, task_valid=batch.valid,
-        sig_scores=sig_scores, sig_pred=sig_pred, sig_nz=sig_nz,
-        sig_req=sig_req,
+        sig_scores=sig_scores, sig_pred=sig_pred,
         min_available=min_av, order_min_available=order_min_av,
         init_allocated=init_alloc, job_queue=job_queue,
         job_priority=job_priority, job_create_rank=job_create_rank,
@@ -289,12 +327,46 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
         prop_overused=prop_overused)
 
 
+#: event-handler owners the bulk replay can apply as aggregates (drf /
+#: proportion: share sums) or collapse to one call (nodeorder / predicates:
+#: idempotent memo invalidation)
+_BULK_EVENT_OWNERS = frozenset({"drf", "proportion", "nodeorder",
+                                "predicates"})
+
+
 def replay_decisions(ssn: Session, inputs: CycleInputs,
                      task_state: np.ndarray, task_node: np.ndarray,
                      task_seq: np.ndarray) -> None:
-    """Apply a whole-cycle kernel's decisions through the Session in the
-    kernel's assignment order, so host plugin state, event handlers, and
-    the gang dispatch barrier observe identical events."""
+    """Apply a whole-cycle kernel's decisions through the Session so host
+    plugin state, event handlers, and the gang dispatch barrier end up in
+    the same state the per-visit path would produce.
+
+    Two implementations with identical final state: the exact per-event
+    replay (one ssn.allocate/pipeline per decision, in kernel assignment
+    order) and a bulk path that applies the same mutations as per-job /
+    per-node / per-queue aggregates. The bulk path only runs when every
+    registered event handler is a recognized built-in and the volume
+    binder is the no-op default — anything custom gets the per-event
+    ordering it may depend on."""
+    if _bulk_replay_supported(ssn):
+        _replay_bulk(ssn, inputs, task_state, task_node, task_seq)
+    else:
+        _replay_ordered(ssn, inputs, task_state, task_node, task_seq)
+
+
+def _bulk_replay_supported(ssn: Session) -> bool:
+    from ..cache.interface import NullVolumeBinder
+
+    if type(getattr(ssn.cache, "volume_binder", None)) is not NullVolumeBinder:
+        return False
+    if not hasattr(ssn.cache, "bind_many"):
+        return False
+    return all(eh.owner in _BULK_EVENT_OWNERS for eh in ssn.event_handlers)
+
+
+def _replay_ordered(ssn: Session, inputs: CycleInputs,
+                    task_state: np.ndarray, task_node: np.ndarray,
+                    task_seq: np.ndarray) -> None:
     from ..kernels.fused import ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP
 
     device = inputs.device
@@ -327,3 +399,288 @@ def replay_decisions(ssn: Session, inputs: CycleInputs,
         # device state holds phantom allocations — rebuild from host truth
         device.resync(ssn.nodes)
         raise
+
+
+def _replay_bulk(ssn: Session, inputs: CycleInputs,
+                 task_state: np.ndarray, task_node: np.ndarray,
+                 task_seq: np.ndarray) -> None:
+    """Aggregate application of kernel decisions. Per decision it performs
+    exactly the mutations Session.allocate/pipeline/dispatch would, inlined
+    (no per-task validate / net-zero arithmetic / per-bind locking), with
+    the gang dispatch barrier precomputed per job (readiness is monotone in
+    this replay, so the final count decides) — a task of a ready job flips
+    PENDING -> ALLOCATED -> BINDING in one index move. Event-handler
+    effects apply as per-job / per-queue sums afterwards."""
+    from ..api import Resource
+    from ..api.types import TaskStatus, allocated_status
+    from ..kernels.fused import ALLOC, ALLOC_OB, FAIL, PIPELINE
+
+    device = inputs.device
+    tasks = inputs.tasks
+    n = len(tasks)
+    state = task_state[:n]
+    placed_sel = np.nonzero((state == ALLOC) | (state == ALLOC_OB)
+                            | (state == PIPELINE))[0]
+    placed_sel = placed_sel[np.argsort(task_seq[placed_sel], kind="stable")]
+    fail_sel = np.nonzero(state == FAIL)[0]
+
+    # --- per-job dispatch barrier, vectorized (gang semantics) ----------
+    # The ordered path only checks readiness inside ssn.allocate, so the
+    # deciding count is readiness AS OF THE JOB'S LAST ALLOCATE EVENT —
+    # a PIPELINE event that crosses the quorum afterwards must NOT cause
+    # a dispatch (session.pipeline has no dispatch step). ready_task_num
+    # = count at session open (init_allocated is built as exactly that) +
+    # ALLOC/PIPELINE events up to that seq (ALLOC_OB counts toward
+    # AlmostReady only). cycle_supported() guarantees the only possible
+    # job-ready fn is gang's.
+    placed_states = state[placed_sel]
+    placed_job_idx = inputs.task_job[placed_sel]
+    placed_seq = task_seq[placed_sel]
+    j_pad = inputs.order_min_available.shape[0]
+    if gang_enabled(ssn):
+        alloc_ev = (placed_states == ALLOC) | (placed_states == ALLOC_OB)
+        last_alloc_seq = np.full(j_pad, np.iinfo(np.int64).min, np.int64)
+        np.maximum.at(last_alloc_seq, placed_job_idx[alloc_ev],
+                      placed_seq[alloc_ev].astype(np.int64))
+        ready_ev = (placed_states == ALLOC) | (placed_states == PIPELINE)
+        re_jobs = placed_job_idx[ready_ev]
+        in_time = (placed_seq[ready_ev].astype(np.int64)
+                   <= last_alloc_seq[re_jobs])
+        ready_count = inputs.init_allocated + np.bincount(
+            re_jobs[in_time], minlength=j_pad).astype(np.int32)
+        job_ready = ready_count >= inputs.order_min_available
+    else:
+        # no enabled ready fn: every job is Ready (session.py:190-192)
+        job_ready = np.ones(j_pad, bool)
+
+    alloc_status = TaskStatus.ALLOCATED
+    binding = TaskStatus.BINDING
+    status_of = {int(ALLOC): alloc_status,
+                 int(ALLOC_OB): TaskStatus.ALLOCATED_OVER_BACKFILL,
+                 int(PIPELINE): TaskStatus.PIPELINED}
+    int_pipeline = int(PIPELINE)
+    int_alloc = int(ALLOC)
+    jobs = ssn.jobs
+    nodes = ssn.nodes
+    allocate_volumes = ssn.cache.allocate_volumes
+    bind_volumes = ssn.cache.bind_volumes
+    pending = TaskStatus.PENDING
+
+    #: job uid -> summed resreq of this replay's allocate-events (drf view)
+    job_event_sum: Dict[str, Resource] = {}
+    #: job uid -> (JobInfo, job index) for jobs that saw >=1 ALLOC/ALLOC_OB
+    alloc_jobs: Dict[str, tuple] = {}
+    #: (task, hostname) for cache.bind_many, in assignment order
+    bindings: List[tuple] = []
+
+    try:
+        for i in placed_sel:
+            task = tasks[i]
+            kind = int(state[i])
+            new_status = status_of[kind]
+            node_name = device.node_name(int(task_node[i]))
+            node = nodes.get(node_name)
+            job = jobs.get(task.job)
+            if kind != int_pipeline:
+                if job is None:
+                    raise KeyError(f"failed to find job {task.job}")
+                if node is None:
+                    raise KeyError(f"failed to find node {node_name}")
+                allocate_volumes(task, node_name)
+                alloc_jobs.setdefault(job.uid,
+                                      (job, int(inputs.task_job[i])))
+
+            task.status = new_status
+            task.node_name = node_name
+
+            # --- node accounting (NodeInfo.add_task, inlined; the node
+            #     clone carries allocation-time status, like the ordered
+            #     path where dispatch happens after add_task) ------------
+            if node is not None:
+                key = task.key
+                if key in node.tasks:
+                    raise KeyError(f"task <{task.namespace}/{task.name}> "
+                                   f"already on node <{node.name}>")
+                if node.node is not None:
+                    rr = task.resreq
+                    if task.is_backfill:
+                        node.backfilled.add(rr)
+                    if new_status is TaskStatus.PIPELINED:
+                        node.releasing.sub(rr)
+                    else:
+                        node.idle.sub(rr)
+                    node.used.add(rr)
+                node.tasks[key] = task.clone()
+
+            # --- dispatch decision + single job index move ---------------
+            if (kind == int_alloc
+                    and job_ready[inputs.task_job[i]]):
+                bind_volumes(task)
+                bindings.append((task, node_name))
+                task.status = binding
+            if job is not None:
+                index = job.task_status_index
+                pend = index.get(pending)
+                if pend is not None:
+                    pend.pop(task.uid, None)
+                    if not pend:
+                        del index[pending]
+                bucket = index.get(task.status)
+                if bucket is None:
+                    bucket = index[task.status] = {}
+                bucket[task.uid] = task
+                if task.pod.priority is not None:
+                    job.priority = task.priority
+                if allocated_status(task.status):
+                    job.allocated.add(task.resreq)
+
+            # --- event-handler aggregate (allocate events fire for
+            #     pipeline too, session.py:321) -------------------------
+            acc = job_event_sum.get(task.job)
+            if acc is None:
+                acc = job_event_sum[task.job] = Resource.empty()
+            acc.add(task.resreq)
+
+        if bindings:
+            ssn.cache.bind_many(bindings)
+        _apply_event_aggregates(ssn, job_event_sum)
+        _dispatch_ready_jobs(ssn, alloc_jobs, job_ready)
+        if len(fail_sel):
+            _record_fit_deltas(ssn, inputs, state, task_node, task_seq,
+                               placed_sel, fail_sel)
+    except Exception:
+        device.resync(ssn.nodes)
+        raise
+
+
+def _apply_event_aggregates(ssn: Session,
+                            job_event_sum: Dict[str, "Resource"]) -> None:
+    """Net effect of the built-in drf/proportion allocate handlers: shares
+    recompute from sums, so applying per-job / per-queue totals and
+    updating each touched share once matches the per-event final state."""
+    if not job_event_sum:
+        return
+    owners = {eh.owner for eh in ssn.event_handlers}
+    drf = ssn.plugins.get("drf") if "drf" in owners else None
+    prop = ssn.plugins.get("proportion") if "proportion" in owners else None
+    # nodeorder/predicates handlers only invalidate per-epoch memos — one
+    # firing is equivalent to one per event
+    for eh in ssn.event_handlers:
+        if eh.owner in ("nodeorder", "predicates") and eh.allocate_func:
+            from ..framework.event import Event
+            eh.allocate_func(Event(None))
+    if drf is not None:
+        for job_uid, total in job_event_sum.items():
+            attr = drf.job_opts.get(job_uid)
+            if attr is not None:
+                attr.allocated.add(total)
+                drf._update_share(attr)
+    if prop is not None:
+        touched = {}
+        for job_uid, total in job_event_sum.items():
+            job = ssn.jobs.get(job_uid)
+            if job is None or job.queue not in prop.queue_opts:
+                continue
+            attr = prop.queue_opts[job.queue]
+            attr.allocated.add(total)
+            touched[job.queue] = attr
+        for attr in touched.values():
+            prop._update_share(attr)
+
+
+def _dispatch_ready_jobs(ssn: Session, alloc_jobs: Dict[str, tuple],
+                         job_ready: np.ndarray):
+    """Straggler sweep of the gang dispatch barrier: tasks this replay
+    placed are dispatched inline by _replay_bulk, but a job that became
+    Ready may still hold ALLOCATED tasks from an EARLIER action of the same
+    session — the ordered path's per-allocation dispatch loop
+    (session.py:340-343) would bind those too. Readiness comes from the
+    same as-of-last-allocate flags the inline dispatch used, NOT the final
+    session state (a later PIPELINE crossing must not dispatch)."""
+    from ..api.types import TaskStatus
+
+    bindings = []
+    flips = []
+    for job, ji in alloc_jobs.values():
+        allocated = job.task_status_index.get(TaskStatus.ALLOCATED)
+        if not allocated or not job_ready[ji]:
+            continue
+        for task in allocated.values():
+            ssn.cache.bind_volumes(task)
+            bindings.append((task, task.node_name))
+            flips.append((job, task))
+    if not bindings:
+        return
+    ssn.cache.bind_many(bindings)
+    binding = TaskStatus.BINDING
+    for job, task in flips:
+        index = job.task_status_index
+        bucket = index.get(TaskStatus.ALLOCATED)
+        if bucket is not None:
+            bucket.pop(task.uid, None)
+            if not bucket:
+                del index[TaskStatus.ALLOCATED]
+        task.status = binding
+        index.setdefault(binding, {})[task.uid] = task
+        # ALLOCATED and BINDING both count as allocated: job.allocated is
+        # net-unchanged, and skipping the sub/add avoids float drift
+
+
+def _record_fit_deltas(ssn: Session, inputs: CycleInputs, state: np.ndarray,
+                       task_node: np.ndarray, task_seq: np.ndarray,
+                       placed_sel: np.ndarray, fail_sel: np.ndarray) -> None:
+    """nodes_fit_delta diagnostics with ordered-replay parity: the ordered
+    path overwrites job.nodes_fit_delta at every FAIL, so only the LAST
+    failed task per job (by kernel seq) is visible, measured against node
+    idle state at that point of the replay. Reconstructs those intermediate
+    idle states by walking placements backward from the final state."""
+    from ..api import Resource
+    from ..api.resource import (MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_GPU)
+    from ..kernels.fused import PIPELINE
+
+    tasks = inputs.tasks
+    device = inputs.device
+
+    # last FAIL per job, processed in descending seq
+    last_fail: Dict[str, int] = {}
+    for i in sorted(fail_sel, key=lambda i: task_seq[i]):
+        if ssn.jobs.get(tasks[i].job) is not None:
+            last_fail[tasks[i].job] = i
+    if not last_fail:
+        return
+    fails = sorted(last_fail.values(), key=lambda i: -task_seq[i])
+
+    node_list = list(ssn.nodes.values())
+    row = {node.name: r for r, node in enumerate(node_list)}
+    idle = np.array([[nd.idle.milli_cpu, nd.idle.memory, nd.idle.milli_gpu]
+                     for nd in node_list], dtype=np.float64)
+    max_tasks = [nd.idle.max_task_num for nd in node_list]
+
+    # placements that consumed idle (pipeline reuses releasing instead),
+    # walked backward
+    idle_placed = [i for i in placed_sel if int(state[i]) != int(PIPELINE)]
+    p = len(idle_placed) - 1
+    eps = np.array([MIN_MILLI_CPU, MIN_MEMORY, MIN_MILLI_GPU])
+    for fi in fails:
+        fseq = task_seq[fi]
+        while p >= 0 and task_seq[idle_placed[p]] > fseq:
+            t = tasks[idle_placed[p]]
+            r = row.get(device.node_name(int(task_node[idle_placed[p]])))
+            if r is not None:
+                idle[r, 0] += t.resreq.milli_cpu
+                idle[r, 1] += t.resreq.memory
+                idle[r, 2] += t.resreq.milli_gpu
+            p -= 1
+        task = tasks[fi]
+        req = np.array([task.resreq.milli_cpu, task.resreq.memory,
+                        task.resreq.milli_gpu])
+        delta = np.where(req > 0, idle - (req + eps), idle)
+        job = ssn.jobs[task.job]
+        job.nodes_fit_delta = {}
+        for r, node in enumerate(node_list):
+            d = object.__new__(Resource)
+            d.milli_cpu = float(delta[r, 0])
+            d.memory = float(delta[r, 1])
+            d.milli_gpu = float(delta[r, 2])
+            d.max_task_num = max_tasks[r]
+            job.nodes_fit_delta[node.name] = d

@@ -256,6 +256,12 @@ class JobInfo:
                 f"{', '.join(parts)}.")
 
     def clone(self) -> "JobInfo":
+        """Deep copy (ref: job_info.go:294-326). Copies the maintained
+        aggregates and rebuilds the double-index from cloned tasks directly
+        — equivalent to re-running add_task_info per task (which this
+        method did originally; it runs O(jobs) per snapshot, every cycle),
+        including the reference's quirk that tasks carrying an explicit pod
+        priority re-stamp the job priority in insertion order."""
         info = JobInfo(self.uid)
         info.name = self.name
         info.namespace = self.namespace
@@ -266,8 +272,17 @@ class JobInfo:
         info.creation_timestamp = self.creation_timestamp
         info.pod_group = self.pod_group
         info.pdb = self.pdb
-        for task in self.tasks.values():
-            info.add_task_info(task.clone())
+        tasks = info.tasks
+        for uid, task in self.tasks.items():
+            t = task.clone()
+            tasks[uid] = t
+            if t.pod.priority is not None:
+                info.priority = t.priority
+        info.task_status_index = {
+            status: {uid: tasks[uid] for uid in bucket}
+            for status, bucket in self.task_status_index.items()}
+        info.allocated = self.allocated.clone()
+        info.total_request = self.total_request.clone()
         return info
 
     def __repr__(self) -> str:

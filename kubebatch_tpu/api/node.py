@@ -39,9 +39,19 @@ class NodeInfo:
         self.tasks: Dict[str, TaskInfo] = {}
 
     def clone(self) -> "NodeInfo":
-        res = NodeInfo(self.node)
-        for task in self.tasks.values():
-            res.add_task(task)
+        """Deep copy: the maintained accounting is copied rather than
+        re-derived task by task (equivalent, since add_task maintains it
+        incrementally; this runs O(nodes) per snapshot, every cycle)."""
+        res = object.__new__(NodeInfo)
+        res.name = self.name
+        res.node = self.node
+        res.releasing = self.releasing.clone()
+        res.used = self.used.clone()
+        res.backfilled = self.backfilled.clone()
+        res.idle = self.idle.clone()
+        res.allocatable = self.allocatable.clone()
+        res.capability = self.capability.clone()
+        res.tasks = {key: t.clone() for key, t in self.tasks.items()}
         return res
 
     def set_node(self, node: Node) -> None:

@@ -54,22 +54,32 @@ class Resource:
 
     @classmethod
     def from_resource_list(cls, rl: Dict[str, float]) -> "Resource":
-        """ref: resource_info.go:58-73 (NewResource)."""
-        r = cls()
-        for name, quant in (rl or {}).items():
-            if name == CPU:
-                r.milli_cpu += float(quant)
-            elif name == MEMORY:
-                r.memory += float(quant)
-            elif name == GPU:
-                r.milli_gpu += float(quant)
-            elif name == PODS:
-                r.max_task_num += int(quant)
+        """ref: resource_info.go:58-73 (NewResource). Keyed gets instead of
+        a key loop (dict keys are unique, so the reference's += per seen key
+        reduces to one get per known resource); runs O(nodes+tasks) times
+        per snapshot."""
+        r = object.__new__(cls)
+        if rl:
+            r.milli_cpu = float(rl.get(CPU, 0.0))
+            r.memory = float(rl.get(MEMORY, 0.0))
+            r.milli_gpu = float(rl.get(GPU, 0.0))
+            r.max_task_num = int(rl.get(PODS, 0))
+        else:
+            r.milli_cpu = 0.0
+            r.memory = 0.0
+            r.milli_gpu = 0.0
+            r.max_task_num = 0
         return r
 
     def clone(self) -> "Resource":
-        return Resource(self.milli_cpu, self.memory, self.milli_gpu,
-                        self.max_task_num)
+        # bypasses __init__ — clones run O(tasks) times per cycle and the
+        # fields are known-normalized already
+        r = object.__new__(Resource)
+        r.milli_cpu = self.milli_cpu
+        r.memory = self.memory
+        r.milli_gpu = self.milli_gpu
+        r.max_task_num = self.max_task_num
+        return r
 
     # --- mutating arithmetic (reference style; return self for chaining) --
     def add(self, rr: "Resource") -> "Resource":

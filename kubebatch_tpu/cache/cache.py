@@ -30,7 +30,7 @@ from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo,
-                   TaskStatus, job_terminated)
+                   TaskStatus, allocated_status, job_terminated)
 from ..objects import (Node, Pod, PodDisruptionBudget, PodGroup,
                        PodGroupPhase, PodPhase, PriorityClass, Queue,
                        UNSCHEDULABLE_CONDITION)
@@ -474,6 +474,56 @@ class SchedulerCache:
                     f"to {hostname}")
 
         self._submit(do_bind)
+
+    def bind_many(self, bindings: List[Tuple[TaskInfo, str]]) -> None:
+        """Batched bind: identical state flips to per-task bind(), but one
+        lock acquisition for the whole decision batch. The reference has no
+        counterpart (it fires one goroutine per bind, cache.go:423-429);
+        whole-cycle device solvers hand back thousands of decisions at once
+        and the per-bind lock/unlock churn dominates replay without this."""
+        submits = []
+        binding = TaskStatus.BINDING
+        with self._lock:
+            for ti, hostname in bindings:
+                job, task = self._find_job_and_task(ti)
+                node = self.nodes.get(hostname)
+                if node is None:
+                    raise KeyError(f"failed to bind Task {task.uid} to host "
+                                   f"{hostname}, host does not exist")
+                # update_task_status(task, BINDING), inlined for the batch:
+                # the stored task IS ti's cache twin, so the net-zero
+                # total_request ops drop out; Pending isn't an allocated
+                # status, Binding is
+                index = job.task_status_index
+                bucket = index.get(task.status)
+                if bucket is not None:
+                    bucket.pop(task.uid, None)
+                    if not bucket:
+                        del index[task.status]
+                if allocated_status(task.status):
+                    job.allocated.sub(task.resreq)
+                task.status = binding
+                index.setdefault(binding, {})[task.uid] = task
+                if task.pod.priority is not None:
+                    job.priority = task.priority
+                job.allocated.add(task.resreq)
+                task.node_name = hostname
+                node.add_task(task)
+                submits.append((task, task.pod, hostname))
+
+        for task, pod, hostname in submits:
+            def do_bind(task=task, pod=pod, hostname=hostname):
+                try:
+                    self.binder.bind(pod, hostname)
+                except Exception:
+                    self.resync_task(task)
+                else:
+                    self.recorder.eventf(
+                        pod, "Normal", "Scheduled",
+                        f"Successfully assigned {pod.namespace}/{pod.name} "
+                        f"to {hostname}")
+
+            self._submit(do_bind)
 
     def evict(self, ti: TaskInfo, reason: str) -> None:
         """ref: cache.go:349-389."""

@@ -25,11 +25,17 @@ Round structure (all tensor ops):
    (allocate.go:187-189).
 3. **Proposals** — tasks pick target nodes.  Identical tasks must spread
    (argmax alone would pile every replica of a template onto one node and
-   serialize into per-node rounds), so tasks of one signature are
+   serialize into per-node rounds), so tasks of one cohort are
    *waterfalled*: nodes sorted by score, estimated integer capacities
    cumulated, and the cohort's m-th task proposes the node covering
    position m.  Tasks whose waterfall slot is infeasible for their exact
-   request fall back to their individual masked argmax.
+   request fall back to their individual masked argmax.  Cohorts are
+   (signature, nonzero-request) PAIRS — scores, including the dynamic
+   least-requested / balanced-resource terms, are evaluated with the
+   cohort's own request, so same-sig pods of different sizes score
+   per-task (CycleInputs.pair_terms; when a cycle carries more distinct
+   request shapes than the pair budget, requests quantize onto a log2
+   grid and scores deviate by at most the bucket width).
 4. **Acceptance** — per node, proposers are taken in global-rank order
    while the cumulative exact requests fit the pool (segmented scans keep
    float error per-node, not global).  The top-ranked proposer on each
@@ -97,12 +103,13 @@ class CycleArrays(NamedTuple):
     task_nz: jnp.ndarray          # [T,2]
     task_job: jnp.ndarray         # [T]
     task_rank: jnp.ndarray        # [T]
-    task_sig: jnp.ndarray         # [T]
+    task_sig: jnp.ndarray         # [T]  (predicate rows)
+    task_pair: jnp.ndarray        # [T]  (scoring/waterfall cohorts)
     task_valid: jnp.ndarray       # [T]
     sig_scores: jnp.ndarray       # [S,N]
     sig_pred: jnp.ndarray         # [S,N]
-    sig_nz: jnp.ndarray           # [S,2]
-    sig_req: jnp.ndarray          # [S,R]
+    pair_sig: jnp.ndarray         # [P] pair -> sig
+    pair_nz: jnp.ndarray          # [P,2] cohort nonzero-request
     order_min_available: jnp.ndarray  # [J]
     job_queue: jnp.ndarray        # [J]
     job_priority: jnp.ndarray     # [J]
@@ -207,118 +214,178 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     part2 = participating & ~fail_now & ~blocked
 
     # ---- 3. proposals ---------------------------------------------------
-    dyn_term = jnp.zeros_like(a.sig_scores)
+    # Scores run per (sig, nonzero-request) PAIR cohort: the dynamic terms
+    # are evaluated with the cohort's own request (exact per-task when the
+    # host built exact pairs), not a sig-wide mean.
+    pair_pred = a.sig_pred[a.pair_sig]                    # [P,N]
+    dyn_term = jnp.zeros_like(pair_pred, jnp.float32)
     if dyn_enabled:
         dyn_term = jax.vmap(
             lambda nz: dynamic_node_score(state.nz_req, nz,
                                           a.allocatable_cm,
-                                          a.dyn_weights))(a.sig_nz)
-    sc = a.sig_scores + dyn_term                          # [S,N]
-    ord_idx = jnp.argsort(-sc, axis=1, stable=True)       # [S,N]
+                                          a.dyn_weights))(a.pair_nz)
+    sc = a.sig_scores[a.pair_sig] + dyn_term              # [P,N]
 
-    tiny = jnp.float32(1e-6)
-    mean_fit_acc = jnp.all(a.sig_req[:, None, :] <= accessible[None] + eps,
-                           axis=-1)
-    mean_fit_pipe = jnp.all(a.sig_req[:, None, :] <= state.releasing[None]
-                            + eps, axis=-1)
-    per_r_acc = jnp.floor((accessible[None] + eps)
-                          / jnp.maximum(a.sig_req[:, None, :], tiny))
-    per_r_pipe = jnp.floor((state.releasing[None] + eps)
-                           / jnp.maximum(a.sig_req[:, None, :], tiny))
-    big_cap = jnp.float32(1e6)
-    cap_acc = jnp.min(jnp.where(a.sig_req[:, None, :] > 0, per_r_acc,
-                                big_cap), axis=-1)
-    cap_pipe = jnp.min(jnp.where(a.sig_req[:, None, :] > 0, per_r_pipe,
-                                 big_cap), axis=-1)
-    cap = jnp.where(mean_fit_acc, cap_acc,
-                    jnp.where(mean_fit_pipe, cap_pipe, 0.0))
-    room_cnt = (a.max_task_num - state.n_tasks).astype(jnp.float32)
-    cap = jnp.minimum(cap, jnp.maximum(room_cnt, 0.0)[None, :])
-    cap = jnp.where(a.sig_pred & base[None, :], cap, 0.0)
-    cap = jnp.maximum(cap, 0.0)     # keep the cumsum monotone
-    cum = jnp.cumsum(jnp.take_along_axis(cap, ord_idx, axis=1), axis=1)
+    # The waterfall is ONE shared mass ledger (independent per-cohort
+    # waterfalls over-propose the globally best nodes and serialize into
+    # hundreds of conflict rounds): nodes in the demand-majority cohort's
+    # score order, capacity cumulated as resource VECTORS, and each task
+    # proposes the first node whose cumulative capacity covers the total
+    # mass of all higher-ranked tasks plus its own request — the parallel
+    # emulation of sequential fill. Placement spread is heuristic; fit,
+    # predicates and acceptance stay exact per task (water_elig / phase
+    # checks), and mismatched tasks fall back to their pair argmax.
+    p_pad = a.pair_sig.shape[0]
+    pair_demand = jax.ops.segment_sum(
+        part2.astype(jnp.int32), a.task_pair, num_segments=p_pad)
+    maj_pair = jnp.argmax(pair_demand)
+    shared_sc = sc[maj_pair]                              # [N]
+    ord_sh = jnp.argsort(-shared_sc, stable=True)         # [N]
+    cap_mass = jnp.where(
+        (pair_pred[maj_pair] & base)[:, None],
+        jnp.maximum(accessible, 0.0), 0.0)                # [N,R]
+    room_cnt = jnp.maximum(
+        (a.max_task_num - state.n_tasks), 0).astype(jnp.float32)
+    cum_mass = jnp.cumsum(cap_mass[ord_sh], axis=0)       # [N,R]
+    cum_cnt = jnp.cumsum(jnp.where(pair_pred[maj_pair] & base,
+                                   room_cnt, 0.0)[ord_sh])
 
-    # cohort position m: rank among part2 tasks of the same sig
-    s_pad = a.sig_pred.shape[0]
-    sig_key = jnp.where(part2, a.task_sig, s_pad)
-    perm = jnp.lexsort([global_rank, sig_key])
-    sorted_sig = sig_key[perm]
-    first = jnp.searchsorted(sorted_sig, sorted_sig, side="left")
-    m_sorted = jnp.arange(t_pad) - first
-    m = jnp.zeros(t_pad, jnp.int32).at[perm].set(m_sorted.astype(jnp.int32))
+    # exclusive prefix mass over part2 tasks in global-rank order
+    rank_perm = jnp.argsort(global_rank)
+    mass_sorted = jnp.where(part2, 1.0, 0.0)[rank_perm, None] \
+        * a.resreq[rank_perm]
+    prefix_sorted = jnp.cumsum(mass_sorted, axis=0) - mass_sorted
+    cnt_sorted = jnp.where(part2, 1.0, 0.0)[rank_perm]
+    cnt_prefix_sorted = jnp.cumsum(cnt_sorted) - cnt_sorted
+    prefix = jnp.zeros_like(mass_sorted).at[rank_perm].set(prefix_sorted)
+    cnt_prefix = jnp.zeros_like(cnt_sorted).at[rank_perm].set(
+        cnt_prefix_sorted)
 
-    cum_rows = cum[a.task_sig]                            # [T,N]
-    slot = jax.vmap(lambda row, mm: jnp.searchsorted(row, mm, side="right"))(
-        cum_rows, m.astype(jnp.float32))
+    need = prefix + a.resreq                              # [T,R]
+    # per-dim searchsorted, max across dims (+ the task-count ledger)
+    slots = [jnp.searchsorted(cum_mass[:, d], need[:, d], side="left")
+             for d in range(need.shape[1])]
+    slots.append(jnp.searchsorted(cum_cnt, cnt_prefix + 1.0, side="left"))
+    slot = slots[0]
+    for s in slots[1:]:
+        slot = jnp.maximum(slot, s)
     slot_ok = slot < n_pad
     slot_c = jnp.minimum(slot, n_pad - 1)
-    p_water = jnp.take_along_axis(ord_idx[a.task_sig], slot_c[:, None],
-                                  axis=1)[:, 0]
+    p_water = ord_sh[slot_c].astype(jnp.int32)
     water_elig = jnp.take_along_axis(eligible, p_water[:, None],
                                      axis=1)[:, 0] & slot_ok
 
-    sc_rows = sc[a.task_sig]                              # [T,N]
+    sc_rows = sc[a.task_pair]                             # [T,N]
     fb = jnp.argmax(jnp.where(eligible, sc_rows, -jnp.inf), axis=1)
-    proposal = jnp.where(water_elig, p_water, fb).astype(jnp.int32)
+    proposal1 = jnp.where(water_elig, p_water, fb).astype(jnp.int32)
 
-    # ---- 4. acceptance --------------------------------------------------
-    prop_alloc = jnp.take_along_axis(fit_alloc, proposal[:, None],
-                                     axis=1)[:, 0]        # else pipeline
-    node_key = jnp.where(part2, proposal, n_pad)
-    perm2 = jnp.lexsort([global_rank, node_key])
-    nid = node_key[perm2]
-    seg_start = jnp.searchsorted(nid, nid, side="left")
-    nid_c = jnp.minimum(nid, n_pad - 1)
+    # ---- 4. acceptance (two phases) ------------------------------------
+    # Phase 1 accepts waterfall/argmax proposals; rejected tasks get a
+    # SECOND CHANCE in the same round, re-proposing their best node against
+    # phase-1-committed capacity — recovering most of the packing quality
+    # the sequential engine gets from per-placement state refresh, without
+    # another round's ordering pass.
+    def accept_phase(proposal, mask, idle_c, rel_c, ntasks_c):
+        acc_c = idle_c + a.backfilled
+        fit_alloc_c = jnp.take_along_axis(
+            jnp.all(a.init_resreq[:, None, :] <= acc_c[None] + eps, axis=-1),
+            proposal[:, None], axis=1)[:, 0]
+        prop_alloc = fit_alloc_c                          # else pipeline
+        node_key = jnp.where(mask, proposal, n_pad)
+        perm2 = jnp.lexsort([global_rank, node_key])
+        nid = node_key[perm2]
+        seg_start = jnp.searchsorted(nid, nid, side="left")
+        nid_c = jnp.minimum(nid, n_pad - 1)
 
-    s_req = a.resreq[perm2]
-    s_init = a.init_resreq[perm2]
-    s_alloc = prop_alloc[perm2]
-    s_part = part2[perm2]
+        s_req = a.resreq[perm2]
+        s_init = a.init_resreq[perm2]
+        s_alloc = prop_alloc[perm2]
+        s_part = mask[perm2]
 
-    alloc_vals = jnp.where((s_alloc & s_part)[:, None], s_req, 0.0)
-    pipe_vals = jnp.where((~s_alloc & s_part)[:, None], s_req, 0.0)
-    cnt_vals = s_part.astype(jnp.int32)
+        alloc_vals = jnp.where((s_alloc & s_part)[:, None], s_req, 0.0)
+        pipe_vals = jnp.where((~s_alloc & s_part)[:, None], s_req, 0.0)
+        cnt_vals = s_part.astype(jnp.int32)
 
-    excl_alloc = _segmented_prefix(alloc_vals, seg_start)
-    excl_pipe = _segmented_prefix(pipe_vals, seg_start)
-    excl_cnt = _segmented_prefix(cnt_vals, seg_start)
+        excl_alloc = _segmented_prefix(alloc_vals, seg_start)
+        excl_pipe = _segmented_prefix(pipe_vals, seg_start)
+        excl_cnt = _segmented_prefix(cnt_vals, seg_start)
 
-    pool_acc = accessible[nid_c]
-    pool_idle = state.idle[nid_c]
-    pool_rel = state.releasing[nid_c]
-    room_left = (a.max_task_num[nid_c] - state.n_tasks[nid_c]
-                 - excl_cnt) > 0
+        pool_acc = acc_c[nid_c]
+        pool_idle = idle_c[nid_c]
+        pool_rel = rel_c[nid_c]
+        room_left = (a.max_task_num[nid_c] - ntasks_c[nid_c]
+                     - excl_cnt) > 0
 
-    ok_alloc = (s_alloc & s_part & room_left
-                & jnp.all(s_init <= pool_acc - excl_alloc + eps, axis=-1))
-    ok_pipe = (~s_alloc & s_part & room_left
-               & jnp.all(s_init <= pool_rel - excl_pipe + eps, axis=-1))
-    accept_s = ok_alloc | ok_pipe
-    # over-backfill: the accepted launch request no longer fits what's left
-    # of plain idle after earlier-ranked accepted alloc takes
-    ob_s = ok_alloc & ~jnp.all(s_init <= pool_idle - excl_alloc + eps,
-                               axis=-1)
+        ok_alloc = (s_alloc & s_part & room_left
+                    & jnp.all(s_init <= pool_acc - excl_alloc + eps,
+                              axis=-1))
+        ok_pipe = (~s_alloc & s_part & room_left
+                   & jnp.all(s_init <= pool_rel - excl_pipe + eps, axis=-1))
+        accept_s = ok_alloc | ok_pipe
+        # over-backfill: the accepted launch request no longer fits what's
+        # left of plain idle after earlier-ranked accepted alloc takes
+        ob_s = ok_alloc & ~jnp.all(s_init <= pool_idle - excl_alloc + eps,
+                                   axis=-1)
 
-    inv2 = jnp.zeros(t_pad, jnp.int32).at[perm2].set(
-        jnp.arange(t_pad, dtype=jnp.int32))
-    accept = accept_s[inv2]
-    ob = ob_s[inv2]
+        inv2 = jnp.zeros(t_pad, jnp.int32).at[perm2].set(
+            jnp.arange(t_pad, dtype=jnp.int32))
+        return accept_s[inv2], ob_s[inv2], prop_alloc
+
+    def commit_node(accept, is_alloc, is_pipe, proposal, idle_c, rel_c,
+                    ntasks_c, nz_c):
+        node_seg = jnp.where(accept, proposal, 0)
+        take_alloc = jnp.where(is_alloc[:, None], a.resreq, 0.0)
+        take_pipe = jnp.where(is_pipe[:, None], a.resreq, 0.0)
+        idle_n = idle_c - jax.ops.segment_sum(take_alloc, node_seg,
+                                              num_segments=n_pad)
+        rel_n = rel_c - jax.ops.segment_sum(take_pipe, node_seg,
+                                            num_segments=n_pad)
+        ntasks_n = ntasks_c + jax.ops.segment_sum(
+            accept.astype(jnp.int32), node_seg, num_segments=n_pad)
+        nz_n = nz_c + jax.ops.segment_sum(
+            jnp.where(accept[:, None], a.task_nz, 0.0), node_seg,
+            num_segments=n_pad)
+        return idle_n, rel_n, ntasks_n, nz_n
+
+    accept1, ob1, prop_alloc1 = accept_phase(
+        proposal1, part2, state.idle, state.releasing, state.n_tasks)
+    idle1, rel1, ntasks1, nz1 = commit_node(
+        accept1, prop_alloc1 & accept1, ~prop_alloc1 & accept1, proposal1,
+        state.idle, state.releasing, state.n_tasks, state.nz_req)
+
+    # retry phase: rejected tasks re-propose their argmax against the
+    # committed mid-round state. ONE retry measures best: it recovers most
+    # of the packing the sequential engine gets from per-placement state
+    # refresh, while further same-round eagerness starts to lock in
+    # placements the next round's refreshed fairness order would improve.
+    accept, ob, proposal, prop_alloc = accept1, ob1, proposal1, prop_alloc1
+    idle_c, rel_c, ntasks_c, nz_c = idle1, rel1, ntasks1, nz1
+    for _ in range(1):
+        retry = part2 & ~accept
+        acc_c = idle_c + a.backfilled
+        fit_r = (jnp.all(a.init_resreq[:, None, :] <= acc_c[None] + eps,
+                         axis=-1)
+                 | jnp.all(a.init_resreq[:, None, :] <= rel_c[None] + eps,
+                           axis=-1))
+        room_r = ntasks_c < a.max_task_num
+        eligible_r = pred_t & (a.node_ok & room_r)[None, :] & fit_r
+        fb_r = jnp.argmax(jnp.where(eligible_r, sc_rows, -jnp.inf),
+                          axis=1).astype(jnp.int32)
+        retry = retry & jnp.any(eligible_r, axis=1)
+        accept_r, ob_r, prop_alloc_r = accept_phase(fb_r, retry, idle_c,
+                                                    rel_c, ntasks_c)
+        idle_c, rel_c, ntasks_c, nz_c = commit_node(
+            accept_r, prop_alloc_r & accept_r, ~prop_alloc_r & accept_r,
+            fb_r, idle_c, rel_c, ntasks_c, nz_c)
+        accept = accept | accept_r
+        ob = jnp.where(accept_r, ob_r, ob)
+        proposal = jnp.where(accept_r, fb_r, proposal)
+        prop_alloc = jnp.where(accept_r, prop_alloc_r, prop_alloc)
+    new_idle, new_rel, new_ntasks, new_nz = idle_c, rel_c, ntasks_c, nz_c
     is_alloc = prop_alloc & accept
     is_pipe = ~prop_alloc & accept
 
-    # ---- 5. commit ------------------------------------------------------
-    node_seg = jnp.where(accept, proposal, 0)
-    take_alloc = jnp.where(is_alloc[:, None], a.resreq, 0.0)
-    take_pipe = jnp.where(is_pipe[:, None], a.resreq, 0.0)
-    new_idle = state.idle - jax.ops.segment_sum(take_alloc, node_seg,
-                                                num_segments=n_pad)
-    new_rel = state.releasing - jax.ops.segment_sum(take_pipe, node_seg,
-                                                    num_segments=n_pad)
-    new_ntasks = state.n_tasks + jax.ops.segment_sum(
-        accept.astype(jnp.int32), node_seg, num_segments=n_pad)
-    new_nz = state.nz_req + jax.ops.segment_sum(
-        jnp.where(accept[:, None], a.task_nz, 0.0), node_seg,
-        num_segments=n_pad)
+    # ---- 5. commit (job / queue aggregates) -----------------------------
 
     job_seg = jnp.where(accept, a.task_job, 0)
     take_any = jnp.where(accept[:, None], a.resreq, 0.0)
@@ -406,6 +473,7 @@ def solve_batched(device, inputs, max_rounds: int = 0):
         # every productive round places >= 1 task or fails >= 1 job; the
         # bound is a safety net, not the expected round count
         max_rounds = int(t_pad) + 8
+    task_pair, pair_sig, pair_nz, _ = inputs.pair_terms()
 
     state = RoundState(
         idle=device.idle, releasing=device.releasing,
@@ -427,11 +495,12 @@ def solve_batched(device, inputs, max_rounds: int = 0):
         task_job=jnp.asarray(inputs.task_job),
         task_rank=jnp.asarray(inputs.task_rank),
         task_sig=jnp.asarray(inputs.task_sig),
+        task_pair=jnp.asarray(task_pair),
         task_valid=jnp.asarray(inputs.task_valid),
         sig_scores=jnp.asarray(inputs.sig_scores),
         sig_pred=jnp.asarray(inputs.sig_pred),
-        sig_nz=jnp.asarray(inputs.sig_nz),
-        sig_req=jnp.asarray(inputs.sig_req),
+        pair_sig=jnp.asarray(pair_sig),
+        pair_nz=jnp.asarray(pair_nz),
         order_min_available=jnp.asarray(inputs.order_min_available),
         job_queue=jnp.asarray(inputs.job_queue),
         job_priority=jnp.asarray(inputs.job_priority),

@@ -22,7 +22,7 @@ from typing import Dict, List, Sequence
 import numpy as np
 
 from ..api import NodeInfo, TaskInfo
-from ..api.resource import RESOURCE_DIM, VEC_EPS
+from ..api.resource import RESOURCE_DIM, VEC_EPS, VEC_SCALE
 
 __all__ = ["NodeState", "TaskBatch", "pad_to_bucket", "VEC_EPS",
            "NONZERO_MILLI_CPU", "NONZERO_MEM_MIB", "nz_request_vec"]
@@ -91,19 +91,33 @@ class NodeState:
         schedulable = np.zeros(n_pad, bool)
         valid = np.zeros(n_pad, bool)
         index: Dict[str, int] = {}
+        if n:
+            # one tuple-comprehension pass instead of per-Resource to_vec
+            # array allocations — this runs over every node each snapshot
+            raw = np.array(
+                [(ni.idle.milli_cpu, ni.idle.memory, ni.idle.milli_gpu,
+                  ni.releasing.milli_cpu, ni.releasing.memory,
+                  ni.releasing.milli_gpu,
+                  ni.backfilled.milli_cpu, ni.backfilled.memory,
+                  ni.backfilled.milli_gpu,
+                  ni.allocatable.milli_cpu, ni.allocatable.memory,
+                  ni.allocatable.milli_gpu) for ni in ordered],
+                np.float64).reshape(n, 4, RESOURCE_DIM)
+            raw *= VEC_SCALE
+            raw32 = raw.astype(np.float32)
+            idle[:n] = raw32[:, 0]
+            releasing[:n] = raw32[:, 1]
+            backfilled[:n] = raw32[:, 2]
+            allocatable[:n] = raw32[:, 3]
+            max_task_num[:n] = [ni.allocatable.max_task_num for ni in ordered]
+            n_tasks[:n] = [len(ni.tasks) for ni in ordered]
+            schedulable[:n] = [not (bool(ni.node.unschedulable) if ni.node
+                                    else True) for ni in ordered]
+            valid[:n] = True
         for i, ni in enumerate(ordered):
-            idle[i] = ni.idle.to_vec()
-            releasing[i] = ni.releasing.to_vec()
-            backfilled[i] = ni.backfilled.to_vec()
-            allocatable[i] = ni.allocatable.to_vec()
+            index[ni.name] = i
             for t in ni.tasks.values():
                 nz_requested[i] += nz_request_vec(t.resreq.to_vec())
-            max_task_num[i] = ni.allocatable.max_task_num
-            n_tasks[i] = len(ni.tasks)
-            unsched = bool(ni.node.unschedulable) if ni.node else True
-            schedulable[i] = not unsched
-            valid[i] = True
-            index[ni.name] = i
         return cls(names=[ni.name for ni in ordered], idle=idle,
                    releasing=releasing, backfilled=backfilled,
                    allocatable=allocatable, nz_requested=nz_requested,
@@ -133,11 +147,22 @@ class TaskBatch:
         init_resreq = np.zeros((t_pad, RESOURCE_DIM), np.float32)
         nz_req = np.zeros((t_pad, 2), np.float32)
         valid = np.zeros(t_pad, bool)
-        for i, task in enumerate(tasks):
-            resreq[i] = task.resreq.to_vec()
-            init_resreq[i] = task.init_resreq.to_vec()
-            nz_req[i] = nz_request_vec(resreq[i])
-            valid[i] = True
+        if t:
+            # one tuple-comprehension pass (see NodeState.from_nodes)
+            raw = np.array(
+                [(tk.resreq.milli_cpu, tk.resreq.memory, tk.resreq.milli_gpu,
+                  tk.init_resreq.milli_cpu, tk.init_resreq.memory,
+                  tk.init_resreq.milli_gpu) for tk in tasks],
+                np.float64).reshape(t, 2, RESOURCE_DIM)
+            raw *= VEC_SCALE
+            raw32 = raw.astype(np.float32)
+            resreq[:t] = raw32[:, 0]
+            init_resreq[:t] = raw32[:, 1]
+            nz_req[:t, 0] = np.where(resreq[:t, 0] != 0, resreq[:t, 0],
+                                     NONZERO_MILLI_CPU)
+            nz_req[:t, 1] = np.where(resreq[:t, 1] != 0, resreq[:t, 1],
+                                     NONZERO_MEM_MIB)
+            valid[:t] = True
         return cls(tasks=list(tasks), resreq=resreq,
                    init_resreq=init_resreq, nz_req=nz_req, valid=valid)
 

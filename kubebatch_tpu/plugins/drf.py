@@ -103,7 +103,8 @@ class DrfPlugin(Plugin):
             self._update_share(attr)
 
         ssn.add_event_handler(EventHandler(allocate_func=on_allocate,
-                                           deallocate_func=on_deallocate))
+                                           deallocate_func=on_deallocate,
+                                           owner=NAME))
 
     def on_session_close(self, ssn: Session) -> None:
         self.total_resource = Resource.empty()

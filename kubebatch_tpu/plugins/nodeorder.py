@@ -207,8 +207,11 @@ class NodeOrderPlugin(Plugin):
         def _bump(event):
             epoch[0] += 1
 
+        # owner tag lets the bulk decision-replay collapse the N bumps of a
+        # decision batch into one — invalidation is idempotent
         ssn.add_event_handler(EventHandler(allocate_func=_bump,
-                                           deallocate_func=_bump))
+                                           deallocate_func=_bump,
+                                           owner=NAME))
 
         def node_order(task: TaskInfo, node: NodeInfo) -> float:
             score = 0.0

@@ -196,8 +196,11 @@ class PredicatesPlugin(Plugin):
         def _bump(event):
             epoch[0] += 1
 
+        # owner tag lets the bulk decision-replay collapse the N bumps of a
+        # decision batch into one — invalidation is idempotent
         ssn.add_event_handler(EventHandler(allocate_func=_bump,
-                                           deallocate_func=_bump))
+                                           deallocate_func=_bump,
+                                           owner=NAME))
 
         def cached_candidates():
             if memo["epoch"] != epoch[0]:
