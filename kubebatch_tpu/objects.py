@@ -35,18 +35,49 @@ def new_uid(prefix: str = "obj") -> str:
     return f"{prefix}-{next(_uid_counter):08d}"
 
 
-def resource_list(cpu: float = 0.0, memory: float = 0.0, gpu: float = 0.0,
-                  pods: float = 0.0) -> Dict[str, float]:
-    """Build a ResourceList. cpu/gpu in millis, memory in bytes."""
+_QUANTITY_SUFFIXES = {
+    "Ki": 1024.0, "Mi": 1024.0 ** 2, "Gi": 1024.0 ** 3, "Ti": 1024.0 ** 4,
+    "Pi": 1024.0 ** 5, "Ei": 1024.0 ** 6,
+    "n": 1e-9, "u": 1e-6, "m": 1e-3,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+}
+
+
+def parse_quantity(s) -> float:
+    """Parse a Kubernetes resource.Quantity string to its plain value
+    ("500m" -> 0.5, "1Gi" -> 1073741824, "2" -> 2.0, "1e3" -> 1000.0) —
+    the subset of the apimachinery Quantity grammar pod specs actually use
+    (binary Ki..Ei, decimal n/u/m/k..E, plain and scientific numbers)."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    s = s.strip()
+    for suffix, mult in _QUANTITY_SUFFIXES.items():
+        if s.endswith(suffix):
+            head = s[:-len(suffix)]
+            # "1e3" must parse as scientific, not exa ("E" suffix needs a
+            # bare integer head; "1e3E" is not produced by k8s anyway)
+            if suffix == "E" and ("e" in head or "E" in head):
+                continue
+            return float(head) * mult
+    return float(s)
+
+
+def resource_list(cpu=0.0, memory=0.0, gpu=0.0, pods=0.0) -> Dict[str, float]:
+    """Build a ResourceList. Numeric arguments follow the internal
+    convention (cpu/gpu in MILLIS, memory in bytes); string arguments are
+    Kubernetes quantity strings with their k8s meaning (cpu="1" is one
+    core = 1000 millis, cpu="500m" is 500 millis, memory="1Gi" is
+    1073741824 bytes), matching what a pod spec would carry."""
+    def _cores_to_millis(v):
+        return parse_quantity(v) * 1000.0 if isinstance(v, str) else float(v)
+
     rl: Dict[str, float] = {}
-    if cpu:
-        rl[CPU] = float(cpu)
-    if memory:
-        rl[MEMORY] = float(memory)
-    if gpu:
-        rl[GPU] = float(gpu)
-    if pods:
-        rl[PODS] = float(pods)
+    for key, value in ((CPU, _cores_to_millis(cpu)),
+                       (MEMORY, parse_quantity(memory)),
+                       (GPU, _cores_to_millis(gpu)),
+                       (PODS, parse_quantity(pods))):
+        if value:       # "0"/"0m" and 0 alike omit the key
+            rl[key] = value
     return rl
 
 

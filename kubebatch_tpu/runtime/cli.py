@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="address for the /metrics endpoint")
     p.add_argument("--version", action="store_true",
                    help="show version and quit")
+    p.add_argument("--v", type=int, default=0, dest="verbosity",
+                   help="log level verbosity (glog-style: 0 = warnings, "
+                        "1+ = per-cycle lines, 3+ = per-action detail)")
     # sim-mode extensions
     p.add_argument("--sim-config", type=int, default=0,
                    choices=[0, 1, 2, 3, 4, 5],
@@ -66,6 +69,15 @@ def main(argv=None) -> int:
         from .. import __version__
         print(f"kubebatch-tpu {__version__}")
         return 0
+
+    import logging
+
+    level = (logging.WARNING if args.verbosity <= 0
+             else logging.INFO if args.verbosity < 3 else logging.DEBUG)
+    logging.basicConfig(
+        level=level,
+        format="%(levelname).1s%(asctime)s %(name)s] %(message)s",
+        datefmt="%m%d %H:%M:%S")
 
     import os
 

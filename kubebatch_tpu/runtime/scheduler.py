@@ -8,9 +8,12 @@ back to the compiled-in default; an unknown action name is an error
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import List, Optional, Tuple
+
+log = logging.getLogger("kubebatch")
 
 from .. import actions as _actions  # noqa: F401  (self-registration)
 from .. import plugins as _plugins  # noqa: F401  (self-registration)
@@ -93,14 +96,22 @@ class Scheduler:
         status write-back happens and the loop survives."""
         start = time.perf_counter()
         ssn = OpenSession(self.cache, self.tiers, self.enable_preemption)
+        jobs, nodes = len(ssn.jobs), len(ssn.nodes)
         try:
             for action in self.actions:
                 action.initialize()
                 action_start = time.perf_counter()
                 action.execute(ssn)
-                update_action_duration(action.name,
-                                       time.perf_counter() - action_start)
+                action_dur = time.perf_counter() - action_start
+                update_action_duration(action.name, action_dur)
+                log.debug("action %s took %.2fms", action.name,
+                          1e3 * action_dur)
                 action.uninitialize()
         finally:
             CloseSession(ssn)
-            update_e2e_duration(time.perf_counter() - start)
+            elapsed = time.perf_counter() - start
+            update_e2e_duration(elapsed)
+            # the glog V(2)-style cycle line (ref: scheduler.go:92 metric;
+            # verbosity wired by the CLI --v flag)
+            log.info("scheduling cycle: %d jobs / %d nodes in %.2fms",
+                     jobs, nodes, 1e3 * elapsed)

@@ -1,0 +1,322 @@
+"""End-to-end scenarios through the runtime Scheduler loop — the sim
+equivalent of the reference's DIND e2e suite (ref: test/e2e/job.go,
+test/e2e/queue.go; harness util.go).
+
+Where the reference drives a real kubeadm cluster and waits on pod phase,
+these tests drive Scheduler.run_once over a SchedulerCache whose seams are
+played by a SimKubelet: bound pods transition to Running between cycles
+(kubelet), evicted pods are deleted and recreated as fresh Pending pods
+(the Job controller's re-creation loop) — so multi-cycle behavior
+(gang blocking, preemption, reclaim, convergence-by-rescheduling) is
+exercised exactly as the reference's e2e does, without a k8s API server.
+"""
+import itertools
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.objects import (PodGroupPhase, PodPhase,
+                                   UNSCHEDULABLE_CONDITION)
+from kubebatch_tpu.runtime.scheduler import Scheduler
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+#: shipped-config parity (config/kube-batch-conf.yaml)
+FULL_CONF = """
+actions: "reclaim, allocate, backfill, preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+DEFAULT_CONF = ""   # compiled-in default: allocate, backfill
+
+
+class SimKubelet:
+    """Binder/evictor seams + the between-cycle lifecycle transitions."""
+
+    def __init__(self):
+        self.cache = None
+        self.binds = {}          # pod key -> hostname
+        self._newly_bound = []
+        self._evicted = []
+        self._respawn = itertools.count(1)
+
+    # --- seams ---------------------------------------------------------
+    def bind(self, pod, hostname):
+        self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+        pod.node_name = hostname
+        self._newly_bound.append(pod)
+
+    def evict(self, pod):
+        self._evicted.append(pod)
+
+    # --- lifecycle tick (kubelet + Job controller) ---------------------
+    def tick(self, recreate_evicted=True):
+        """Bound pods start Running; evicted pods vanish and the
+        controller replaces them with fresh Pending pods."""
+        for pod in self._newly_bound:
+            old = _clone_pod(pod)
+            pod.phase = PodPhase.RUNNING
+            self.cache.update_pod(old, pod)
+        self._newly_bound = []
+        for pod in self._evicted:
+            self.cache.delete_pod(pod)
+            if recreate_evicted:
+                repl = _clone_pod(pod)
+                gen = next(self._respawn)
+                repl.uid = f"{pod.uid}-r{gen}"
+                repl.name = f"{pod.name}-r{gen}"
+                repl.node_name = ""
+                repl.phase = PodPhase.PENDING
+                self.cache.add_pod(repl)
+        self._evicted = []
+
+
+def _clone_pod(pod):
+    import copy
+
+    return copy.copy(pod)
+
+
+def make_env(conf=DEFAULT_CONF, queues=("default",), weights=None,
+             enable_preemption=False):
+    kubelet = SimKubelet()
+    cache = SchedulerCache(binder=kubelet, evictor=kubelet,
+                           async_writeback=False)
+    kubelet.cache = cache
+    for i, q in enumerate(queues):
+        cache.add_queue(build_queue(q, weight=(weights or {}).get(q, 1)))
+    sched = Scheduler(cache, scheduler_conf=conf,
+                      enable_preemption=enable_preemption)
+    return kubelet, cache, sched
+
+
+def add_job(cache, name, n_pods, min_member, req, queue="", ns="e2e",
+            phase="Pending", node=None, priority=None, backfill=False):
+    """createJob equivalent (ref: test/e2e/util.go:280-342)."""
+    cache.add_pod_group(build_group(ns, name, min_member, queue=queue))
+    pods = []
+    for p in range(n_pods):
+        pod = build_pod(ns, f"{name}-{p}", node or "", phase, req,
+                        group=name, priority=priority, backfill=backfill)
+        cache.add_pod(pod)
+        pods.append(pod)
+    return pods
+
+
+def cycles(sched, kubelet, n, recreate_evicted=True):
+    for _ in range(n):
+        sched.run_once()
+        kubelet.tick(recreate_evicted=recreate_evicted)
+
+
+# ---------------------------------------------------------------------------
+# Scenarios (ref: test/e2e/job.go)
+# ---------------------------------------------------------------------------
+
+def test_schedule_job_end_to_end():
+    """'Schedule Job' — every replica binds and runs (job.go:28-40)."""
+    kubelet, cache, sched = make_env()
+    add_job(cache, "qj", 3, 3, rl(1000, GiB))
+    for i in range(2):
+        cache.add_node(build_node(f"n{i}", rl(4000, 8 * GiB, pods=110)))
+    cycles(sched, kubelet, 2)
+    assert len(kubelet.binds) == 3
+    pg = cache.jobs["e2e/qj"].pod_group
+    assert pg.status.phase == PodGroupPhase.RUNNING
+    assert pg.status.running == 3
+
+
+def test_gang_unschedulable_until_blocker_deleted():
+    """'Gang scheduling' — the signature scenario (job.go:83-117): a
+    replica-set blocker fills the cluster; a gang that cannot fully fit
+    binds NOTHING and its PodGroup carries the Unschedulable condition;
+    deleting the blocker lets the whole gang in."""
+    kubelet, cache, sched = make_env()
+    cache.add_node(build_node("n0", rl(4000, 8 * GiB, pods=110)))
+    # blocker: ownerless running pods occupying 3.5 of 4 cores
+    blockers = [build_pod("e2e", f"blk-{i}", "n0", "Running",
+                          rl(1750, GiB), owner_uid=f"rs-{i}")
+                for i in range(2)]
+    for b in blockers:
+        cache.add_pod(b)
+    # gang of 3 x 1000m cannot fully fit in the remaining 500m
+    add_job(cache, "gang", 3, 3, rl(1000, GiB))
+    cycles(sched, kubelet, 2)
+    assert kubelet.binds == {}
+    pg = cache.jobs["e2e/gang"].pod_group
+    assert pg.status.phase == PodGroupPhase.PENDING
+    conds = {c.type for c in pg.status.conditions}
+    assert UNSCHEDULABLE_CONDITION in conds
+    # delete the blocker (kubectl delete rs)
+    for b in blockers:
+        cache.delete_pod(b)
+    cycles(sched, kubelet, 2)
+    assert len(kubelet.binds) == 3
+    assert cache.jobs["e2e/gang"].pod_group.status.phase \
+        == PodGroupPhase.RUNNING
+
+
+def test_gang_partial_capacity_binds_nothing_but_smaller_gang_fits():
+    """'Gang Full Occupied' flavor: an oversized gang binds nothing while
+    an earlier gang that fits proceeds. (NB: job order is creation-stamped
+    — were the oversized gang FIRST in order, its phantom in-session
+    allocations would hold the capacity and starve the smaller job, which
+    is faithful v0.4.1 behavior; the fork's dormant backfill-over-reserved
+    feature exists to relieve exactly that.)"""
+    kubelet, cache, sched = make_env()
+    cache.add_node(build_node("n0", rl(4000, 8 * GiB, pods=110)))
+    cache.add_pod_group(build_group("e2e", "small", 2,
+                                    creation_timestamp=1.0))
+    for p in range(2):
+        cache.add_pod(build_pod("e2e", f"small-{p}", "", "Pending",
+                                rl(1000, GiB), group="small"))
+    cache.add_pod_group(build_group("e2e", "big", 5,
+                                    creation_timestamp=2.0))
+    for p in range(5):                       # needs 5 cores > 4
+        cache.add_pod(build_pod("e2e", f"big-{p}", "", "Pending",
+                                rl(1000, GiB), group="big"))
+    cycles(sched, kubelet, 2)
+    bound = sorted(kubelet.binds)
+    assert bound == ["e2e/small-0", "e2e/small-1"]
+    assert cache.jobs["e2e/big"].pod_group.status.phase \
+        == PodGroupPhase.PENDING
+
+
+def test_preemption_high_priority_gang_evicts_low():
+    """'Preemption' (job.go:214-246): a running low-priority job gives way
+    to a higher-priority gang; evicted pods are recreated Pending and
+    re-land once capacity allows."""
+    kubelet, cache, sched = make_env(conf=FULL_CONF, enable_preemption=True)
+    cache.add_node(build_node("n0", rl(4000, 8 * GiB, pods=110)))
+    add_job(cache, "low", 4, 1, rl(1000, GiB), priority=1)
+    cycles(sched, kubelet, 2)
+    assert len(kubelet.binds) == 4          # low fills the node
+    kubelet.binds.clear()
+    evicted_names = []
+    orig_tick = kubelet.tick
+
+    def tick(recreate_evicted=True):
+        evicted_names.extend(p.name for p in kubelet._evicted)
+        orig_tick(recreate_evicted)
+
+    kubelet.tick = tick
+    add_job(cache, "high", 2, 2, rl(1000, GiB), priority=100)
+    cycles(sched, kubelet, 4)
+    high_bound = [k for k in kubelet.binds if k.startswith("e2e/high")]
+    assert sorted(high_bound) == ["e2e/high-0", "e2e/high-1"]
+    # victims really left through the evictor seam, and the high gang is
+    # running; capacity is never oversubscribed. (Which/how many low pods
+    # end up re-running is intentionally not pinned: with min_member=1 the
+    # gang plugin's MinAvailable==1 quirk admits same-priority intra-job
+    # victims in tier 1, so the reference's own phase-2 preemption churns
+    # replacements — faithful behavior, not a scheduling invariant.)
+    assert any(n.startswith("low") for n in evicted_names)
+    running = [t for j in cache.jobs.values() for t in j.tasks.values()
+               if t.pod.phase == PodPhase.RUNNING]
+    assert sum(t.resreq.milli_cpu for t in running) <= 4000
+    assert {f"e2e/{t.name}" for t in running} >= {"e2e/high-0",
+                                                  "e2e/high-1"}
+
+
+def test_reclaim_cross_queue_to_weighted_share():
+    """'Reclaim' (queue.go:26-70): q2 (weight 2) reclaims from q1
+    (weight 1) until the weighted fair share is restored."""
+    kubelet, cache, sched = make_env(conf=FULL_CONF,
+                                     queues=("q1", "q2"),
+                                     weights={"q1": 1, "q2": 2})
+    cache.add_node(build_node("n0", rl(3000, 6 * GiB, pods=110)))
+    add_job(cache, "greedy", 3, 1, rl(1000, GiB), queue="q1")
+    cycles(sched, kubelet, 2)
+    assert len(kubelet.binds) == 3
+    kubelet.binds.clear()
+    add_job(cache, "claimer", 2, 1, rl(1000, GiB), queue="q2")
+    cycles(sched, kubelet, 4)
+    claimed = [k for k in kubelet.binds if k.startswith("e2e/claimer")]
+    assert len(claimed) == 2                # q2 reaches its 2/3 share
+
+
+def test_best_effort_pods_backfill():
+    """'BestEffort' (job.go): zero-request pods land even on a node whose
+    resources are fully requested."""
+    kubelet, cache, sched = make_env()
+    cache.add_node(build_node("n0", rl(2000, 4 * GiB, pods=110)))
+    add_job(cache, "full", 2, 2, rl(1000, 2 * GiB))
+    add_job(cache, "be", 1, 1, rl(0, 0))
+    cycles(sched, kubelet, 2)
+    assert "e2e/be-0" in kubelet.binds
+    assert len(kubelet.binds) == 3
+
+
+def test_task_priority_within_job():
+    """'TaskPriority': when capacity covers only part of a job, the
+    higher-priority tasks win the slots."""
+    kubelet, cache, sched = make_env()
+    cache.add_node(build_node("n0", rl(2000, 8 * GiB, pods=110)))
+    cache.add_pod_group(build_group("e2e", "tp", 1))
+    for i, prio in enumerate([1, 100, 1, 100]):
+        cache.add_pod(build_pod("e2e", f"tp-{i}", "", "Pending",
+                                rl(1000, GiB), group="tp", priority=prio))
+    cycles(sched, kubelet, 1)
+    assert sorted(kubelet.binds) == ["e2e/tp-1", "e2e/tp-3"]
+
+
+def test_job_priority_between_jobs():
+    """'Job Priority': the higher-priority job is admitted first when both
+    cannot fit."""
+    kubelet, cache, sched = make_env()
+    cache.add_node(build_node("n0", rl(2000, 8 * GiB, pods=110)))
+    add_job(cache, "back", 2, 2, rl(1000, GiB), priority=1)
+    add_job(cache, "front", 2, 2, rl(1000, GiB), priority=100)
+    cycles(sched, kubelet, 2)
+    assert sorted(kubelet.binds) == ["e2e/front-0", "e2e/front-1"]
+
+
+def test_convergence_after_node_added():
+    """Convergence-by-rescheduling: an unschedulable job converges once
+    capacity appears (statelessness — SURVEY sect. 5 recovery item 4)."""
+    kubelet, cache, sched = make_env()
+    cache.add_node(build_node("n0", rl(1000, 2 * GiB, pods=110)))
+    add_job(cache, "wait", 2, 2, rl(1000, GiB))
+    cycles(sched, kubelet, 2)
+    assert kubelet.binds == {}
+    cache.add_node(build_node("n1", rl(2000, 4 * GiB, pods=110)))
+    cycles(sched, kubelet, 2)
+    assert len(kubelet.binds) == 2
+
+
+def test_running_pods_survive_restart_rebuild():
+    """Statelessness on restart: a fresh cache rebuilt from the same pod
+    set (the informer LIST) reproduces accounting — running pods keep
+    their nodes, pending pods schedule into what is left."""
+    kubelet, cache, sched = make_env()
+    cache.add_node(build_node("n0", rl(3000, 6 * GiB, pods=110)))
+    add_job(cache, "ab", 2, 1, rl(1000, GiB))
+    cycles(sched, kubelet, 2)
+    assert len(kubelet.binds) == 2
+    # "restart": rebuild a new cache from the current pod truth
+    kubelet2 = SimKubelet()
+    cache2 = SchedulerCache(binder=kubelet2, evictor=kubelet2,
+                            async_writeback=False)
+    kubelet2.cache = cache2
+    cache2.add_queue(build_queue("default"))
+    cache2.add_node(build_node("n0", rl(3000, 6 * GiB, pods=110)))
+    for job in cache.jobs.values():
+        if job.pod_group is not None:
+            cache2.add_pod_group(job.pod_group)
+        for t in job.tasks.values():
+            cache2.add_pod(t.pod)
+    add_job(cache2, "late", 1, 1, rl(1000, GiB))
+    sched2 = Scheduler(cache2)
+    sched2.run_once()
+    kubelet2.tick()
+    assert "e2e/late-0" in kubelet2.binds
+    node = cache2.nodes["n0"]
+    assert len(node.tasks) == 3

@@ -107,3 +107,37 @@ def test_vecs_stacking_empty_and_full():
     m = vecs([Resource(1, 1024 ** 2, 0), Resource(2, 2 * 1024 ** 2, 1)])
     assert m.shape == (2, 3)
     np.testing.assert_allclose(m[:, 1], [1.0, 2.0])
+
+
+class TestQuantityStrings:
+    """Kubernetes quantity-string grammar (apimachinery resource.Quantity
+    subset) accepted by objects.resource_list / parse_quantity."""
+
+    def test_parse_quantity_grammar(self):
+        from kubebatch_tpu.objects import parse_quantity
+        assert parse_quantity("2") == 2.0
+        assert parse_quantity("500m") == 0.5
+        assert parse_quantity("1Gi") == 1024 ** 3
+        assert parse_quantity("128Mi") == 128 * 1024 ** 2
+        assert parse_quantity("1Ki") == 1024
+        assert parse_quantity("2k") == 2000.0
+        assert parse_quantity("1G") == 1e9
+        assert parse_quantity("1e3") == 1000.0
+        assert parse_quantity("250u") == 250e-6
+        assert parse_quantity(1500) == 1500.0
+
+    def test_resource_list_accepts_pod_spec_strings(self):
+        from kubebatch_tpu.objects import CPU, GPU, MEMORY, resource_list
+        rl = resource_list(cpu="1", memory="1Gi", gpu="2")
+        assert rl[CPU] == 1000.0            # one core = 1000 millis
+        assert rl[MEMORY] == 1024 ** 3
+        assert rl[GPU] == 2000.0
+        rl = resource_list(cpu="250m", memory="512Mi")
+        assert rl[CPU] == 250.0
+        assert rl[MEMORY] == 512 * 1024 ** 2
+
+    def test_resource_list_numeric_convention_unchanged(self):
+        from kubebatch_tpu.objects import CPU, MEMORY, resource_list
+        rl = resource_list(cpu=1000, memory=1024 ** 3)
+        assert rl[CPU] == 1000.0            # already millis
+        assert rl[MEMORY] == 1024 ** 3
