@@ -126,6 +126,9 @@ class CycleInputs:
     queue_keys: Tuple[str, ...]
     gang_enabled: bool
     prop_overused: bool
+    #: False when no node carries releasing resources at cycle start —
+    #: lets the batched kernel fold away all pipeline-fit work statically
+    pipe_enabled: bool = True
     # lazy cache for pair_terms(): (max_pairs budget, result)
     _pair_terms: Optional[tuple] = None
 
@@ -324,7 +327,10 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
         j_alloc0=j_alloc0, cluster_total=cluster_total,
         dyn_weights=dyn_weights, dyn_enabled=dyn_enabled,
         job_keys=job_keys, queue_keys=queue_keys, gang_enabled=gang,
-        prop_overused=prop_overused)
+        prop_overused=prop_overused,
+        pipe_enabled=any(n.releasing.milli_cpu > 0 or n.releasing.memory > 0
+                         or n.releasing.milli_gpu > 0
+                         for n in ssn.nodes.values()))
 
 
 #: event-handler owners the bulk replay can apply as aggregates (drf /

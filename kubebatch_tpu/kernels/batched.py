@@ -144,8 +144,14 @@ def _segmented_prefix(values: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
 
 def _round(state: RoundState, a: CycleArrays, round_idx,
            job_keys: Tuple[str, ...], queue_keys: Tuple[str, ...],
-           prop_overused: bool, dyn_enabled: bool):
-    """One allocation round.  Returns (new_state, progress)."""
+           prop_overused: bool, dyn_enabled: bool,
+           pipe_enabled: bool = True, seq_stride: int = 0):
+    """One allocation round.  Returns (new_state, progress).
+
+    ``pipe_enabled`` is a static specialization: when the host saw no
+    releasing resources anywhere at cycle start (the common case — and
+    allocate never creates releasing), every pipeline-fit matrix folds to
+    False at trace time, halving the [T,N] fit work per round."""
     eps = jnp.asarray(VEC_EPS)
     t_pad = a.task_valid.shape[0]
     n_pad = a.node_ok.shape[0]
@@ -195,8 +201,12 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     base = a.node_ok & room
     fit_alloc = jnp.all(a.init_resreq[:, None, :] <= accessible[None] + eps,
                         axis=-1)
-    fit_pipe = jnp.all(
-        a.init_resreq[:, None, :] <= state.releasing[None] + eps, axis=-1)
+    if pipe_enabled:
+        fit_pipe = jnp.all(
+            a.init_resreq[:, None, :] <= state.releasing[None] + eps,
+            axis=-1)
+    else:
+        fit_pipe = jnp.zeros_like(fit_alloc)
     pred_t = a.sig_pred[a.task_sig]
     eligible = pred_t & base[None, :] & (fit_alloc | fit_pipe)
     any_elig = jnp.any(eligible, axis=1)
@@ -319,8 +329,12 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
         ok_alloc = (s_alloc & s_part & room_left
                     & jnp.all(s_init <= pool_acc - excl_alloc + eps,
                               axis=-1))
-        ok_pipe = (~s_alloc & s_part & room_left
-                   & jnp.all(s_init <= pool_rel - excl_pipe + eps, axis=-1))
+        if pipe_enabled:
+            ok_pipe = (~s_alloc & s_part & room_left
+                       & jnp.all(s_init <= pool_rel - excl_pipe + eps,
+                                 axis=-1))
+        else:
+            ok_pipe = jnp.zeros_like(ok_alloc)
         accept_s = ok_alloc | ok_pipe
         # over-backfill: the accepted launch request no longer fits what's
         # left of plain idle after earlier-ranked accepted alloc takes
@@ -363,10 +377,11 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     for _ in range(1):
         retry = part2 & ~accept
         acc_c = idle_c + a.backfilled
-        fit_r = (jnp.all(a.init_resreq[:, None, :] <= acc_c[None] + eps,
-                         axis=-1)
-                 | jnp.all(a.init_resreq[:, None, :] <= rel_c[None] + eps,
-                           axis=-1))
+        fit_r = jnp.all(a.init_resreq[:, None, :] <= acc_c[None] + eps,
+                        axis=-1)
+        if pipe_enabled:
+            fit_r = fit_r | jnp.all(
+                a.init_resreq[:, None, :] <= rel_c[None] + eps, axis=-1)
         room_r = ntasks_c < a.max_task_num
         eligible_r = pred_t & (a.node_ok & room_r)[None, :] & fit_r
         fb_r = jnp.argmax(jnp.where(eligible_r, sc_rows, -jnp.inf),
@@ -408,7 +423,8 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     changed = accept | fail_first
     new_task_state = jnp.where(changed, decision, state.task_state)
     new_task_node = jnp.where(accept, proposal, state.task_node)
-    new_task_seq = jnp.where(changed, round_idx * t_pad + global_rank,
+    stride = seq_stride if seq_stride else t_pad
+    new_task_seq = jnp.where(changed, round_idx * stride + global_rank,
                              state.task_seq)
 
     new_alive = state.job_alive & ~job_killed
@@ -424,100 +440,238 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
 
 
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys",
-                                   "prop_overused", "dyn_enabled"))
+                                   "prop_overused", "dyn_enabled",
+                                   "pipe_enabled"))
 def batched_round(state: RoundState, a: CycleArrays, round_idx,
                   job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY,
                                                K_DRF_SHARE),
                   queue_keys: Tuple[str, ...] = (K_PROP_SHARE,),
                   prop_overused: bool = True,
-                  dyn_enabled: bool = False):
+                  dyn_enabled: bool = False,
+                  pipe_enabled: bool = True):
     """Single-round entry point (tests / diagnostics)."""
     return _round(state, a, round_idx, job_keys, queue_keys, prop_overused,
-                  dyn_enabled)
+                  dyn_enabled, pipe_enabled)
+
+
+#: task-axis fields of CycleArrays (compacted for the post-round-0 loop)
+_TASK_FIELDS = ("resreq", "init_resreq", "task_nz", "task_job", "task_rank",
+                "task_sig", "task_pair", "task_valid")
 
 
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys",
                                    "prop_overused", "dyn_enabled",
-                                   "max_rounds"))
+                                   "pipe_enabled", "max_rounds",
+                                   "compact_bucket"))
 def batched_allocate(state: RoundState, a: CycleArrays,
                      job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY,
                                                   K_DRF_SHARE),
                      queue_keys: Tuple[str, ...] = (K_PROP_SHARE,),
                      prop_overused: bool = True,
                      dyn_enabled: bool = False,
-                     max_rounds: int = 64):
+                     pipe_enabled: bool = True,
+                     max_rounds: int = 64,
+                     compact_bucket: int = 0):
     """The whole allocate cycle: rounds run in a device-side while_loop
-    until a round makes no progress — ONE dispatch, one readback."""
-    def cond(carry):
-        _, round_idx, progress = carry
-        return progress & (round_idx < max_rounds)
+    until a round makes no progress — ONE dispatch, one readback.
 
-    def body(carry):
-        s, round_idx, _ = carry
-        ns, progress = _round(s, a, round_idx, job_keys, queue_keys,
-                              prop_overused, dyn_enabled)
-        return ns, round_idx + 1, progress
+    ``compact_bucket``: round 0 typically resolves ~90%% of tasks; the
+    leftovers are gathered into a bucket of this size and the remaining
+    rounds run at [bucket, N] instead of [T, N] cost (1/8th the fit/score
+    HBM traffic). If more than ``compact_bucket`` tasks survive round 0, a
+    lax.cond falls back to the full-width loop — same results either way,
+    task seqs stay globally ordered via the shared seq stride."""
+    t_pad = a.task_valid.shape[0]
 
-    init = (state, jnp.int32(0), jnp.asarray(True))
-    final, rounds, _ = jax.lax.while_loop(cond, body, init)
-    return final, rounds
+    def loop(st, arrays, start_round):
+        def cond(carry):
+            _, round_idx, progress = carry
+            return progress & (round_idx < max_rounds)
+
+        def body(carry):
+            s, round_idx, _ = carry
+            ns, progress = _round(s, arrays, round_idx, job_keys,
+                                  queue_keys, prop_overused, dyn_enabled,
+                                  pipe_enabled, seq_stride=t_pad)
+            return ns, round_idx + 1, progress
+
+        init = (st, jnp.int32(start_round), jnp.asarray(True))
+        return jax.lax.while_loop(cond, body, init)
+
+    if compact_bucket <= 0 or compact_bucket >= t_pad:
+        final, rounds, _ = loop(state, a, 0)
+        return final, rounds
+
+    state, _ = _round(state, a, jnp.int32(0), job_keys, queue_keys,
+                      prop_overused, dyn_enabled, pipe_enabled,
+                      seq_stride=t_pad)
+    unresolved = (a.task_valid & (state.task_state == SKIP)
+                  & state.job_alive[jnp.maximum(a.task_job, 0)])
+    if prop_overused:
+        # queue overuse is monotone in-cycle (q_allocated only grows), so
+        # tasks of queues overused after round 0 can never resolve — keep
+        # them out of the bucket (and out of the overflow count)
+        eps = jnp.asarray(VEC_EPS)
+        overused0 = jnp.all(a.q_deserved < state.q_allocated + eps, axis=-1)
+        unresolved = unresolved & ~overused0[
+            a.job_queue[jnp.maximum(a.task_job, 0)]]
+    cnt = unresolved.sum()
+    idx = jnp.nonzero(unresolved, size=compact_bucket, fill_value=t_pad)[0]
+    valid_k = idx < t_pad
+    idx_c = jnp.minimum(idx, t_pad - 1)
+
+    def done_path(st):
+        return st, jnp.int32(1)
+
+    def compact_path(st):
+        ca = a._replace(**{f: getattr(a, f)[idx_c] for f in _TASK_FIELDS})
+        ca = ca._replace(task_valid=ca.task_valid & valid_k)
+        cs = st._replace(task_state=st.task_state[idx_c],
+                         task_node=st.task_node[idx_c],
+                         task_seq=st.task_seq[idx_c])
+        fs, rounds, _ = loop(cs, ca, 1)
+
+        def put(full, comp):
+            # unclipped indices + drop: fill slots (idx == t_pad) scatter
+            # nowhere, so they can't collide with row t_pad-1
+            return full.at[idx].set(comp, mode="drop")
+
+        return fs._replace(
+            task_state=put(st.task_state, fs.task_state),
+            task_node=put(st.task_node, fs.task_node),
+            task_seq=put(st.task_seq, fs.task_seq)), rounds
+
+    def full_path(st):
+        fs, rounds, _ = loop(st, a, 1)
+        return fs, rounds
+
+    return jax.lax.cond(
+        cnt > compact_bucket, full_path,
+        lambda s: jax.lax.cond(cnt == 0, done_path, compact_path, s),
+        state)
 
 
-def solve_batched(device, inputs, max_rounds: int = 0):
+#: (buffer kind, CycleArrays/RoundState source) for the packed upload; the
+#: order defines buffer layout.  Node-axis arrays live on the DeviceSession
+#: (uploaded once per session), everything per-cycle ships as THREE host
+#: buffers instead of ~20 individual transfers — each device_put through
+#: the axon tunnel pays latency, so transfer count dominates, not bytes.
+_PACK_F32 = ("resreq", "init_resreq", "task_nz", "sig_scores",
+             "job_priority", "q_deserved", "cluster_total", "dyn_weights",
+             "pair_nz", "q_alloc0", "j_alloc0")
+_PACK_I32 = ("task_job", "task_rank", "task_sig", "task_pair",
+             "order_min_available", "job_queue", "job_create_rank",
+             "q_create_rank", "init_allocated", "pair_sig")
+_PACK_BOOL = ("task_valid", "job_valid", "sig_pred")
+
+
+def _pack(values, dtype):
+    """Concatenate arrays into one flat buffer + a static layout tuple."""
+    layout = []
+    flats = []
+    off = 0
+    for name, arr in values:
+        arr = np.asarray(arr)
+        size = arr.size
+        layout.append((name, off, tuple(arr.shape)))
+        flats.append(arr.ravel().astype(dtype, copy=False))
+        off += size
+    buf = (np.concatenate(flats) if flats
+           else np.zeros(0, dtype))
+    return buf, tuple(layout)
+
+
+def _unpack(buf, layout):
+    return {name: jax.lax.slice(buf, (off,), (off + int(np.prod(shape)),))
+            .reshape(shape) if shape else buf[off]
+            for name, off, shape in layout}
+
+
+@partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",
+                                   "queue_keys", "prop_overused",
+                                   "dyn_enabled", "pipe_enabled",
+                                   "max_rounds", "compact_bucket"))
+def _batched_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
+                    backfilled, allocatable_cm, max_task_num, node_ok,
+                    lay_f, lay_i, lay_b, job_keys, queue_keys,
+                    prop_overused, dyn_enabled, pipe_enabled, max_rounds,
+                    compact_bucket):
+    f = _unpack(buf_f, lay_f)
+    i = _unpack(buf_i, lay_i)
+    b = _unpack(buf_b, lay_b)
+    t_pad = i["task_job"].shape[0]
+    state = RoundState(
+        idle=idle, releasing=releasing, n_tasks=n_tasks, nz_req=nz_req,
+        q_allocated=f["q_alloc0"], j_allocated=f["j_alloc0"],
+        alloc_cnt=i["init_allocated"], job_alive=b["job_valid"],
+        task_state=jnp.full(t_pad, SKIP, jnp.int32),
+        task_node=jnp.full(t_pad, -1, jnp.int32),
+        task_seq=jnp.full(t_pad, _IMAX, jnp.int32))
+    arrays = CycleArrays(
+        backfilled=backfilled, allocatable_cm=allocatable_cm,
+        max_task_num=max_task_num, node_ok=node_ok,
+        resreq=f["resreq"], init_resreq=f["init_resreq"],
+        task_nz=f["task_nz"], task_job=i["task_job"],
+        task_rank=i["task_rank"], task_sig=i["task_sig"],
+        task_pair=i["task_pair"], task_valid=b["task_valid"],
+        sig_scores=f["sig_scores"], sig_pred=b["sig_pred"],
+        pair_sig=i["pair_sig"], pair_nz=f["pair_nz"],
+        order_min_available=i["order_min_available"],
+        job_queue=i["job_queue"], job_priority=f["job_priority"],
+        job_create_rank=i["job_create_rank"], job_valid=b["job_valid"],
+        q_deserved=f["q_deserved"], q_create_rank=i["q_create_rank"],
+        cluster_total=f["cluster_total"], dyn_weights=f["dyn_weights"])
+    return batched_allocate(
+        state, arrays, job_keys=job_keys, queue_keys=queue_keys,
+        prop_overused=prop_overused, dyn_enabled=dyn_enabled,
+        pipe_enabled=pipe_enabled, max_rounds=max_rounds,
+        compact_bucket=compact_bucket)
+
+
+def solve_batched(device, inputs, max_rounds: int = 0,
+                  compact_bucket=None):
     """Drive the round loop.  ``device`` is a solver.DeviceSession (its
     capacity arrays are committed on return); ``inputs`` a CycleInputs
     (actions/cycle_inputs.py).  Returns (task_state, task_node, task_seq)
-    as numpy plus the round count."""
+    as numpy plus the round count.  ``compact_bucket``: None = auto-size
+    the post-round-0 compaction (tests pass 0 to force the full-width
+    loop for equivalence checks)."""
     t_pad = inputs.task_valid.shape[0]
     if max_rounds <= 0:
         # every productive round places >= 1 task or fails >= 1 job; the
         # bound is a safety net, not the expected round count
         max_rounds = int(t_pad) + 8
     task_pair, pair_sig, pair_nz, _ = inputs.pair_terms()
+    extra = {"task_pair": task_pair, "pair_sig": pair_sig,
+             "pair_nz": pair_nz}
 
-    state = RoundState(
-        idle=device.idle, releasing=device.releasing,
-        n_tasks=device.n_tasks, nz_req=device.nz_req,
-        q_allocated=jnp.asarray(inputs.q_alloc0),
-        j_allocated=jnp.asarray(inputs.j_alloc0),
-        alloc_cnt=jnp.asarray(inputs.init_allocated, jnp.int32),
-        job_alive=jnp.asarray(inputs.job_valid),
-        task_state=jnp.full(t_pad, SKIP, jnp.int32),
-        task_node=jnp.full(t_pad, -1, jnp.int32),
-        task_seq=jnp.full(t_pad, _IMAX, jnp.int32))
+    def rows(names):
+        return [(n, extra[n] if n in extra else getattr(inputs, n))
+                for n in names]
 
-    arrays = CycleArrays(
-        backfilled=device.backfilled, allocatable_cm=device.allocatable_cm,
-        max_task_num=device.max_task_num, node_ok=device.node_ok,
-        resreq=jnp.asarray(inputs.resreq),
-        init_resreq=jnp.asarray(inputs.init_resreq),
-        task_nz=jnp.asarray(inputs.task_nz),
-        task_job=jnp.asarray(inputs.task_job),
-        task_rank=jnp.asarray(inputs.task_rank),
-        task_sig=jnp.asarray(inputs.task_sig),
-        task_pair=jnp.asarray(task_pair),
-        task_valid=jnp.asarray(inputs.task_valid),
-        sig_scores=jnp.asarray(inputs.sig_scores),
-        sig_pred=jnp.asarray(inputs.sig_pred),
-        pair_sig=jnp.asarray(pair_sig),
-        pair_nz=jnp.asarray(pair_nz),
-        order_min_available=jnp.asarray(inputs.order_min_available),
-        job_queue=jnp.asarray(inputs.job_queue),
-        job_priority=jnp.asarray(inputs.job_priority),
-        job_create_rank=jnp.asarray(inputs.job_create_rank),
-        job_valid=jnp.asarray(inputs.job_valid),
-        q_deserved=jnp.asarray(inputs.q_deserved),
-        q_create_rank=jnp.asarray(inputs.q_create_rank),
-        cluster_total=jnp.asarray(inputs.cluster_total),
-        dyn_weights=jnp.asarray(inputs.dyn_weights))
+    buf_f, lay_f = _pack(rows(_PACK_F32), np.float32)
+    buf_i, lay_i = _pack(rows(_PACK_I32), np.int32)
+    buf_b, lay_b = _pack(rows(_PACK_BOOL), np.bool_)
 
     start = time.perf_counter()
-    final, rounds = batched_allocate(
-        state, arrays,
+    # compact continuation pays off once the [T,N] matrices dwarf the
+    # straggler count; below ~2k tasks the full-width rounds are cheap
+    if compact_bucket is None:
+        compact = max(256, t_pad // 8) if t_pad >= 2048 else 0
+    else:
+        compact = compact_bucket
+    final, rounds = _batched_packed(
+        buf_f, buf_i, buf_b,
+        device.idle, device.releasing, device.n_tasks, device.nz_req,
+        device.backfilled, device.allocatable_cm, device.max_task_num,
+        device.node_ok,
+        lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
         job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
         prop_overused=inputs.prop_overused,
+        pipe_enabled=inputs.pipe_enabled,
         dyn_enabled=inputs.dyn_enabled,
-        max_rounds=min(max_rounds, 4096))
+        max_rounds=min(max_rounds, 4096),
+        compact_bucket=compact)
 
     device.idle = final.idle
     device.releasing = final.releasing

@@ -237,3 +237,57 @@ def test_replay_pipeline_crossing_quorum_does_not_dispatch():
         p0 = next(t for t in job.tasks.values() if t.name == "p0")
         assert p0.status == TaskStatus.ALLOCATED, (replay.__name__, p0)
         assert binder.binds == {}, replay.__name__
+
+
+def test_compact_continuation_equivalent_to_full_width():
+    """The post-round-0 compaction (gather stragglers into a small bucket)
+    must produce bit-identical decisions to the full-width loop — covering
+    the gather/scatter round-trip including fill-slot handling and the
+    seq-stride consistency across compact rounds."""
+    import numpy as np
+
+    from kubebatch_tpu.actions.cycle_inputs import build_cycle_inputs
+    from kubebatch_tpu.kernels.batched import solve_batched
+
+    rng = np.random.default_rng(7)
+    nodes = [build_node(f"n{i}", rl(4000, 8 * GiB, pods=40))
+             for i in range(6)]
+    groups, pods = [], []
+    for j in range(80):                      # 2400 tasks -> t_pad 4096
+        groups.append(build_group("ns", f"pg{j:03d}", 1, queue="q1",
+                                  creation_timestamp=float(j)))
+        for p in range(30):
+            pods.append(build_pod(
+                "ns", f"j{j:03d}-p{p}", "", "Pending",
+                rl(int(rng.integers(1, 9)) * 100,
+                   int(rng.integers(1, 5)) * GiB // 4),
+                group=f"pg{j:03d}",
+                creation_timestamp=float(p)))
+    fixtures = (nodes, groups, pods)
+
+    def solve(bucket):
+        nodes, groups, pods = copy.deepcopy(fixtures)
+        cache = SchedulerCache(binder=RecordingBinder(),
+                               async_writeback=False)
+        for q in ("q1", "q2"):
+            cache.add_queue(build_queue(q))
+        for n in nodes:
+            cache.add_node(n)
+        for g in groups:
+            cache.add_pod_group(g)
+        for p in pods:
+            cache.add_pod(p)
+        ssn = OpenSession(cache, FULL_TIERS)
+        inputs = build_cycle_inputs(ssn)
+        assert inputs is not None and inputs != "empty-cycle"
+        ts, tn, tq, rounds = solve_batched(inputs.device, inputs,
+                                           compact_bucket=bucket)
+        n_real = len(inputs.tasks)
+        return ts[:n_real], tn[:n_real], tq[:n_real], rounds
+
+    ts_full, tn_full, tq_full, r_full = solve(0)
+    ts_c, tn_c, tq_c, r_c = solve(512)
+    assert r_c > 1, "compact continuation did not engage"
+    np.testing.assert_array_equal(ts_full, ts_c)
+    np.testing.assert_array_equal(tn_full, tn_c)
+    np.testing.assert_array_equal(tq_full, tq_c)
