@@ -204,8 +204,16 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
     q_pad = pad_to_bucket(len(queue_ids), 4)
 
     # ---- jobs ------------------------------------------------------------
-    jobs: List[JobInfo] = [j for j in ssn.jobs.values()
-                           if j.queue in q_index]
+    # Only jobs with pending tasks occupy kernel job rows: the reference
+    # pushes every job into its queue PQ (allocate.go:45-63), but popping
+    # a job with no pending tasks changes no state — it only burns a queue
+    # entry, and q_entries below counts exactly the rows built here. Keeps
+    # the job axis at the pending-job count instead of the cluster job
+    # count (cfg4: 625 rows instead of 10k+ when running fill pods each
+    # carry their own PodGroup).
+    jobs: List[JobInfo] = [
+        j for j in ssn.jobs.values()
+        if j.queue in q_index and TaskStatus.PENDING in j.task_status_index]
     # creation-rank tie-break (creation_timestamp, uid)
     jobs_sorted = sorted(jobs, key=lambda j: (j.creation_timestamp, j.uid))
     j_rank = {j.uid: r for r, j in enumerate(jobs_sorted)}

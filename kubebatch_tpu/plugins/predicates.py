@@ -131,8 +131,18 @@ def _cluster_has_match(ssn: Session, term: PodAffinityTerm, pod: Pod,
     return False
 
 
+def anti_affinity_candidates(tasks: List[TaskInfo]) -> List[TaskInfo]:
+    """The sublist carrying required anti-affinity — the only candidates
+    the symmetry check must scan (normally empty)."""
+    return [t for t in tasks
+            if t.pod.affinity is not None
+            and t.pod.affinity.pod_anti_affinity_required]
+
+
 def satisfies_pod_affinity(ssn: Session, task: TaskInfo, node: NodeInfo,
-                           candidates: List[TaskInfo]) -> bool:
+                           candidates: List[TaskInfo],
+                           anti_candidates: Optional[List[TaskInfo]] = None
+                           ) -> bool:
     # symmetry check applies to pods WITHOUT own affinity too
     aff = task.pod.affinity or Affinity()
     for term in aff.pod_affinity_required:
@@ -151,11 +161,12 @@ def satisfies_pod_affinity(ssn: Session, task: TaskInfo, node: NodeInfo,
         if _term_matches_on_node(ssn, term, node, task.pod, candidates):
             return False
     # symmetry: existing pods' required ANTI-affinity must not reject us
+    # (callers precompute the anti-affinity-carrying sublist per epoch)
+    if anti_candidates is None:
+        anti_candidates = anti_affinity_candidates(candidates)
     topo_cache: Dict[str, Optional[str]] = {}
-    for t in candidates:
+    for t in anti_candidates:
         other_aff = t.pod.affinity
-        if other_aff is None or not other_aff.pod_anti_affinity_required:
-            continue
         other_node = ssn.nodes.get(t.node_name)
         if other_node is None:
             continue
@@ -206,7 +217,11 @@ class PredicatesPlugin(Plugin):
             if memo["epoch"] != epoch[0]:
                 memo["epoch"] = epoch[0]
                 memo["tasks"] = candidate_tasks(ssn)
-            return memo["tasks"]
+                # the symmetry check only cares about candidates carrying
+                # required anti-affinity — normally none, and scanning the
+                # full list per (task, node) call dominates whole actions
+                memo["anti"] = anti_affinity_candidates(memo["tasks"])
+            return memo["tasks"], memo["anti"]
 
         def predicate(task: TaskInfo, node: NodeInfo) -> None:
             # pod count (ref: predicates.go:127)
@@ -231,8 +246,9 @@ class PredicatesPlugin(Plugin):
                 raise PredicateError(
                     f"task <{task.namespace}/{task.name}> does not "
                     f"tolerate node <{node.name}> taints")
-            candidates = cached_candidates()
-            if not satisfies_pod_affinity(ssn, task, node, candidates):
+            candidates, anti_candidates = cached_candidates()
+            if not satisfies_pod_affinity(ssn, task, node, candidates,
+                                          anti_candidates):
                 raise PredicateError(
                     f"task <{task.namespace}/{task.name}> "
                     f"affinity/anti-affinity failed on node <{node.name}>")
