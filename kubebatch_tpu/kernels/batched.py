@@ -71,6 +71,8 @@ import numpy as np
 from ..metrics import update_solver_kernel_duration
 from .fused import (ALLOC, ALLOC_OB, FAIL, K_DRF_SHARE, K_GANG_READY,
                     K_PRIORITY, K_PROP_SHARE, PIPELINE, SKIP, _share)
+from .pack import pack as _pack
+from .pack import unpack as _unpack
 from .solver import dynamic_node_score
 from .tensorize import VEC_EPS
 
@@ -563,28 +565,6 @@ _PACK_I32 = ("task_job", "task_rank", "task_sig", "task_pair",
              "order_min_available", "job_queue", "job_create_rank",
              "q_create_rank", "init_allocated", "pair_sig")
 _PACK_BOOL = ("task_valid", "job_valid", "sig_pred")
-
-
-def _pack(values, dtype):
-    """Concatenate arrays into one flat buffer + a static layout tuple."""
-    layout = []
-    flats = []
-    off = 0
-    for name, arr in values:
-        arr = np.asarray(arr)
-        size = arr.size
-        layout.append((name, off, tuple(arr.shape)))
-        flats.append(arr.ravel().astype(dtype, copy=False))
-        off += size
-    buf = (np.concatenate(flats) if flats
-           else np.zeros(0, dtype))
-    return buf, tuple(layout)
-
-
-def _unpack(buf, layout):
-    return {name: jax.lax.slice(buf, (off,), (off + int(np.prod(shape)),))
-            .reshape(shape) if shape else buf[off]
-            for name, off, shape in layout}
 
 
 @partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",

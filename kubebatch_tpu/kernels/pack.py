@@ -1,0 +1,40 @@
+"""Host->device input packing.
+
+Through a high-latency link (the axon tunnel charges ~70 ms per
+transfer), per-cycle upload cost is dominated by TRANSFER COUNT, not
+bytes: ~20 individual device_puts cost more than one concatenated
+buffer. Solvers pack their per-cycle inputs into one flat buffer per
+dtype class plus a static layout tuple; the jitted entry slices the
+buffers back into arrays at trace time (free for XLA — static offsets).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["pack", "unpack"]
+
+
+def pack(values, dtype):
+    """Concatenate (name, array) pairs into one flat buffer + a static
+    (hashable) layout tuple of (name, offset, shape)."""
+    layout = []
+    flats = []
+    off = 0
+    for name, arr in values:
+        arr = np.asarray(arr)
+        layout.append((name, off, tuple(arr.shape)))
+        flats.append(arr.ravel().astype(dtype, copy=False))
+        off += arr.size
+    buf = np.concatenate(flats) if flats else np.zeros(0, dtype)
+    return buf, tuple(layout)
+
+
+def unpack(buf, layout):
+    """Slice a packed buffer back into named arrays (inside jit; offsets
+    and shapes are static)."""
+    out = {}
+    for name, off, shape in layout:
+        size = int(np.prod(shape)) if shape else 1
+        arr = buf[off:off + size]
+        out[name] = arr.reshape(shape) if shape else arr[0]
+    return out

@@ -241,3 +241,81 @@ def test_node_update_only_on_relevant_change():
         c.update_node(n1, build_node("ghost", rl(1, 1)))
     c.delete_node(n2)
     assert "n1" not in c.nodes
+
+
+class TestPdbLegacyGrouping:
+    """PDB-based gang grouping — the legacy path kept for reference parity
+    (ref: cache/event_handlers.go:477-515, job_info.go:204-211)."""
+
+    def _cache(self):
+        from kubebatch_tpu.cache import SchedulerCache
+        cache = SchedulerCache(async_writeback=False)
+        cache.add_queue(build_queue("default"))
+        return cache
+
+    def test_pdb_groups_ownerless_pods_by_controller(self):
+        from kubebatch_tpu.objects import PodDisruptionBudget
+        cache = self._cache()
+        for i in range(3):
+            cache.add_pod(build_pod("ns", f"w{i}", "", "Pending",
+                                    rl(1000, GiB), owner_uid="rs-1"))
+        pdb = PodDisruptionBudget(name="pdb1", namespace="ns",
+                                  min_available=3, owner_uid="rs-1")
+        cache.add_pdb(pdb)
+        job = cache.jobs["rs-1"]
+        assert job.min_available == 3
+        assert job.pdb is pdb
+        assert len(job.tasks) == 3
+        assert job.queue == "default"
+
+    def test_pdb_job_schedules_as_gang(self):
+        """A PDB-grouped job obeys the same all-or-nothing gang semantics
+        as a PodGroup (the session treats min_available identically)."""
+        from kubebatch_tpu import actions, plugins  # noqa: F401
+        from kubebatch_tpu.actions.allocate import AllocateAction
+        from kubebatch_tpu.conf import PluginOption, Tier
+        from kubebatch_tpu.framework import CloseSession, OpenSession
+        from kubebatch_tpu.objects import PodDisruptionBudget
+
+        binds = {}
+
+        class _B:
+            def bind(self, pod, hostname):
+                binds[f"{pod.namespace}/{pod.name}"] = hostname
+                pod.node_name = hostname
+
+        from kubebatch_tpu.cache import SchedulerCache
+        cache = SchedulerCache(binder=_B(), async_writeback=False)
+        cache.add_queue(build_queue("default"))
+        cache.add_node(build_node("n0", rl(2000, 8 * GiB, pods=110)))
+        for i in range(3):   # gang of 3 x 1000m cannot fit in 2000m
+            cache.add_pod(build_pod("ns", f"g{i}", "", "Pending",
+                                    rl(1000, GiB), owner_uid="rs-2"))
+        cache.add_pdb(PodDisruptionBudget(name="pdb2", namespace="ns",
+                                          min_available=3,
+                                          owner_uid="rs-2"))
+        tiers = [Tier(plugins=[PluginOption(name="priority"),
+                               PluginOption(name="gang")])]
+        ssn = OpenSession(cache, tiers)
+        AllocateAction(mode="host").execute(ssn)
+        CloseSession(ssn)
+        assert binds == {}          # all-or-nothing holds
+        # grow the node -> whole gang lands next cycle
+        cache.update_node(cache.nodes["n0"].node,
+                          build_node("n0", rl(4000, 8 * GiB, pods=110)))
+        ssn = OpenSession(cache, tiers)
+        AllocateAction(mode="host").execute(ssn)
+        CloseSession(ssn)
+        assert len(binds) == 3
+
+    def test_delete_pdb_unsets_job_grouping(self):
+        from kubebatch_tpu.objects import PodDisruptionBudget
+        cache = self._cache()
+        cache.add_pod(build_pod("ns", "w0", "", "Pending", rl(1000, GiB),
+                                owner_uid="rs-3"))
+        pdb = PodDisruptionBudget(name="pdb3", namespace="ns",
+                                  min_available=1, owner_uid="rs-3")
+        cache.add_pdb(pdb)
+        assert cache.jobs["rs-3"].pdb is pdb
+        cache.delete_pdb(pdb)
+        assert cache.jobs["rs-3"].pdb is None
