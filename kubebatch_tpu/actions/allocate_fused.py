@@ -16,8 +16,8 @@ import numpy as np
 
 from ..framework import Session
 from ..kernels.fused import fused_allocate, unpack_host_block
-from ..kernels.pack import pack, unpack
-from ..metrics import update_solver_kernel_duration
+from ..kernels.pack import pack_inputs, unpack
+from ..metrics import solver_trace, update_solver_kernel_duration
 from .cycle_inputs import (EMPTY_CYCLE, build_cycle_inputs, cycle_supported,
                            replay_decisions)
 
@@ -78,23 +78,22 @@ def execute_fused(ssn: Session) -> bool:
     q_pad = inputs.q_weight.shape[0]
     max_iters = int(t_pad + 3 * j_pad + q_pad + 8)
 
-    rows = lambda names: [(n, getattr(inputs, n)) for n in names]  # noqa: E731
-    buf_f, lay_f = pack(rows(_F32), np.float32)
-    buf_i, lay_i = pack(rows(_I32), np.int32)
-    buf_b, lay_b = pack(rows(_BOOL), np.bool_)
+    buf_f, lay_f, buf_i, lay_i, buf_b, lay_b = pack_inputs(
+        lambda n: getattr(inputs, n), _F32, _I32, _BOOL)
 
     start = time.perf_counter()
-    (host_block, idle_f, rel_f, ntasks_f, nz_f) = _fused_packed(
-        buf_f, buf_i, buf_b,
-        device.idle, device.releasing, device.backfilled,
-        device.allocatable_cm, device.nz_req,
-        device.max_task_num, device.n_tasks, device.node_ok,
-        lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
-        job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
-        gang_enabled=inputs.gang_enabled,
-        prop_overused=inputs.prop_overused,
-        dyn_enabled=inputs.dyn_enabled, max_iters=max_iters)
-    host_block = np.asarray(host_block)   # the cycle's ONE blocking read
+    with solver_trace("fused_allocate"):
+        (host_block, idle_f, rel_f, ntasks_f, nz_f) = _fused_packed(
+            buf_f, buf_i, buf_b,
+            device.idle, device.releasing, device.backfilled,
+            device.allocatable_cm, device.nz_req,
+            device.max_task_num, device.n_tasks, device.node_ok,
+            lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
+            job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+            gang_enabled=inputs.gang_enabled,
+            prop_overused=inputs.prop_overused,
+            dyn_enabled=inputs.dyn_enabled, max_iters=max_iters)
+        host_block = np.asarray(host_block)   # the cycle's ONE blocking read
     task_state, task_node, task_seq, _ = unpack_host_block(host_block)
     device.idle, device.releasing, device.n_tasks = idle_f, rel_f, ntasks_f
     device.nz_req = nz_f

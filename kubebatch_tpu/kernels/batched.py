@@ -68,10 +68,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..metrics import update_solver_kernel_duration
+from ..metrics import solver_trace, update_solver_kernel_duration
 from .fused import (ALLOC, ALLOC_OB, FAIL, K_DRF_SHARE, K_GANG_READY,
                     K_PRIORITY, K_PROP_SHARE, PIPELINE, SKIP, _share)
-from .pack import pack as _pack
+from .pack import pack_inputs
 from .pack import unpack as _unpack
 from .solver import dynamic_node_score
 from .tensorize import VEC_EPS
@@ -624,14 +624,9 @@ def solve_batched(device, inputs, max_rounds: int = 0,
     task_pair, pair_sig, pair_nz, _ = inputs.pair_terms()
     extra = {"task_pair": task_pair, "pair_sig": pair_sig,
              "pair_nz": pair_nz}
-
-    def rows(names):
-        return [(n, extra[n] if n in extra else getattr(inputs, n))
-                for n in names]
-
-    buf_f, lay_f = _pack(rows(_PACK_F32), np.float32)
-    buf_i, lay_i = _pack(rows(_PACK_I32), np.int32)
-    buf_b, lay_b = _pack(rows(_PACK_BOOL), np.bool_)
+    buf_f, lay_f, buf_i, lay_i, buf_b, lay_b = pack_inputs(
+        lambda n: extra[n] if n in extra else getattr(inputs, n),
+        _PACK_F32, _PACK_I32, _PACK_BOOL)
 
     start = time.perf_counter()
     # compact continuation pays off once the [T,N] matrices dwarf the
@@ -640,29 +635,33 @@ def solve_batched(device, inputs, max_rounds: int = 0,
         compact = max(256, t_pad // 8) if t_pad >= 2048 else 0
     else:
         compact = compact_bucket
-    final, rounds = _batched_packed(
-        buf_f, buf_i, buf_b,
-        device.idle, device.releasing, device.n_tasks, device.nz_req,
-        device.backfilled, device.allocatable_cm, device.max_task_num,
-        device.node_ok,
-        lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
-        job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
-        prop_overused=inputs.prop_overused,
-        pipe_enabled=inputs.pipe_enabled,
-        dyn_enabled=inputs.dyn_enabled,
-        max_rounds=min(max_rounds, 4096),
-        compact_bucket=compact)
+    with solver_trace("batched_allocate"):
+        final, rounds = _batched_packed(
+            buf_f, buf_i, buf_b,
+            device.idle, device.releasing, device.n_tasks, device.nz_req,
+            device.backfilled, device.allocatable_cm, device.max_task_num,
+            device.node_ok,
+            lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
+            job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+            prop_overused=inputs.prop_overused,
+            pipe_enabled=inputs.pipe_enabled,
+            dyn_enabled=inputs.dyn_enabled,
+            max_rounds=min(max_rounds, 4096),
+            compact_bucket=compact)
+        # one pipelined transfer for everything the host needs; the
+        # blocking reads stay inside the trace so a one-shot capture
+        # includes the device execution, not just the async dispatch
+        for arr in (final.task_state, final.task_node, final.task_seq,
+                    rounds):
+            arr.copy_to_host_async()
+        task_state = np.asarray(final.task_state)
+        task_node = np.asarray(final.task_node)
+        task_seq = np.asarray(final.task_seq)
 
     device.idle = final.idle
     device.releasing = final.releasing
     device.n_tasks = final.n_tasks
     device.nz_req = final.nz_req
-    # one pipelined transfer for everything the host needs
-    for arr in (final.task_state, final.task_node, final.task_seq, rounds):
-        arr.copy_to_host_async()
-    task_state = np.asarray(final.task_state)
-    task_node = np.asarray(final.task_node)
-    task_seq = np.asarray(final.task_seq)
     update_solver_kernel_duration("batched_allocate",
                                   time.perf_counter() - start)
     return task_state, task_node, task_seq, int(rounds)

@@ -11,7 +11,16 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["pack", "unpack"]
+__all__ = ["pack", "unpack", "pack_inputs"]
+
+
+def pack_inputs(get, f32_names, i32_names, bool_names):
+    """Pack one buffer per dtype class. ``get(name)`` resolves an array;
+    returns (buf_f, lay_f, buf_i, lay_i, buf_b, lay_b)."""
+    buf_f, lay_f = pack([(n, get(n)) for n in f32_names], np.float32)
+    buf_i, lay_i = pack([(n, get(n)) for n in i32_names], np.int32)
+    buf_b, lay_b = pack([(n, get(n)) for n in bool_names], np.bool_)
+    return buf_f, lay_f, buf_i, lay_i, buf_b, lay_b
 
 
 def pack(values, dtype):

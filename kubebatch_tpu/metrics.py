@@ -134,3 +134,52 @@ def update_solver_kernel_duration(kernel: str, seconds: float) -> None:
 def update_tensorize_duration(seconds: float) -> None:
     if _PROM:
         tensorize_latency.observe(seconds * 1e6)
+
+
+# ---------------------------------------------------------------------------
+# device-side tracing (SURVEY.md sect. 5: keep the reference's histogram
+# taxonomy, add jax.profiler traces around the kernels)
+# ---------------------------------------------------------------------------
+import contextlib
+import os
+
+#: set when the one-shot KUBEBATCH_PROFILE_DIR capture has fired
+_profile_captured = False
+
+
+def solver_trace(name: str):
+    """Context manager annotating a solver dispatch for the jax profiler.
+
+    Always emits a TraceAnnotation (visible in any surrounding profiler
+    session); when KUBEBATCH_PROFILE_DIR is set, the FIRST annotated
+    dispatch of the process also captures a standalone trace of itself
+    into that directory.
+    """
+    try:
+        import jax.profiler as _prof
+    except Exception:  # pragma: no cover - jax always present in this env
+        return contextlib.nullcontext()
+    global _profile_captured
+    target = os.environ.get("KUBEBATCH_PROFILE_DIR", "")
+    if target and not _profile_captured:
+        _profile_captured = True
+
+        @contextlib.contextmanager
+        def _capture():
+            try:
+                _prof.start_trace(target)
+            except Exception:
+                # a surrounding profiler session is already active — the
+                # annotation below still lands in it; a profiling env var
+                # must never abort a scheduling cycle
+                with _prof.TraceAnnotation(name):
+                    yield
+                return
+            try:
+                with _prof.TraceAnnotation(name):
+                    yield
+            finally:
+                _prof.stop_trace()
+
+        return _capture()
+    return _prof.TraceAnnotation(name)
