@@ -9,10 +9,10 @@ check, proportion.go:100-142).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
-from ..api import (QueueInfo, Resource, TaskInfo, allocated_status, dominant_share,
-                   res_min, resource_names, share)
+from ..api import (QueueInfo, Resource, TaskInfo, allocated_status,
+                   dominant_share, res_min, share)
 from ..api.types import TaskStatus
 from ..framework import EventHandler, Plugin, Session
 

@@ -14,8 +14,8 @@ import itertools
 
 from kubebatch_tpu import actions, plugins  # noqa: F401
 from kubebatch_tpu.cache import SchedulerCache
-from kubebatch_tpu.objects import (PodGroupPhase, PodPhase,
-                                   UNSCHEDULABLE_CONDITION)
+from kubebatch_tpu.objects import (PodGroupPhase, PodPhase, Taint,
+                                   Toleration, UNSCHEDULABLE_CONDITION)
 from kubebatch_tpu.runtime.scheduler import Scheduler
 
 from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
@@ -320,3 +320,42 @@ def test_running_pods_survive_restart_rebuild():
     assert "e2e/late-0" in kubelet2.binds
     node = cache2.nodes["n0"]
     assert len(node.tasks) == 3
+
+
+def test_tainted_node_requires_toleration_end_to_end():
+    """'Taints/Tolerations' e2e (ref: test/e2e/predicates.go): a tainted
+    node only receives tolerating pods; the non-tolerating gang waits
+    until an untainted node appears (taint removal via node update)."""
+    kubelet, cache, sched = make_env()
+    tainted = build_node("n0", rl(4000, 8 * GiB, pods=110),
+                         taints=[Taint(key="dedicated", value="infra")])
+    cache.add_node(tainted)
+    add_job(cache, "plain", 2, 2, rl(1000, GiB))
+    cache.add_pod_group(build_group("e2e", "tol", 2))
+    for p in range(2):
+        pod = build_pod("e2e", f"tol-{p}", "", "Pending", rl(1000, GiB),
+                        group="tol")
+        pod.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                      value="infra")]
+        cache.add_pod(pod)
+    cycles(sched, kubelet, 2)
+    assert sorted(kubelet.binds) == ["e2e/tol-0", "e2e/tol-1"]
+    # remove the taint (kubectl taint node ... dedicated-)
+    cache.update_node(tainted, build_node("n0", rl(4000, 8 * GiB,
+                                                   pods=110)))
+    cycles(sched, kubelet, 2)
+    assert "e2e/plain-0" in kubelet.binds and "e2e/plain-1" in kubelet.binds
+
+
+def test_least_requested_spreads_across_nodes_end_to_end():
+    """'nodeorder' placement-quality e2e (ref: test/e2e/nodeorder.go):
+    with least-requested scoring, replicas spread across empty nodes
+    instead of stacking on one."""
+    kubelet, cache, sched = make_env()
+    for i in range(4):
+        cache.add_node(build_node(f"n{i}", rl(8000, 16 * GiB, pods=110)))
+    add_job(cache, "spread", 4, 1, rl(1000, GiB))
+    cycles(sched, kubelet, 2)
+    assert len(kubelet.binds) == 4
+    used_nodes = set(kubelet.binds.values())
+    assert len(used_nodes) >= 3, f"pods stacked: {kubelet.binds}"
