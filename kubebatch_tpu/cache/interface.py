@@ -97,6 +97,44 @@ class NullVolumeBinder:
         return None
 
 
+class SimVolumeBinder:
+    """Functional volume binder for simulation: tracks per-host volume
+    capacity (volumes pending + bound per hostname) and fails allocation
+    when a host is out of slots — the sim stand-in for the upstream
+    volumebinder's AssumePodVolumes/BindPodVolumes pair
+    (ref: cache/cache.go:164-184, k8s.io/kubernetes volumebinder).
+
+    A non-default volume binder also forces the decision replay onto the
+    exact per-event path (actions/cycle_inputs.py bulk-replay gate), so
+    this class doubles as the seam tests use to exercise that fallback
+    and mid-replay failure recovery.
+    """
+
+    def __init__(self, slots_per_host: int = 0):
+        #: 0 = unlimited
+        self.slots_per_host = slots_per_host
+        self.allocated: dict = {}      # hostname -> set of task uids
+        self.bound: set = set()        # task uids with bound volumes
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        holders = self.allocated.setdefault(hostname, set())
+        if (self.slots_per_host
+                and len(holders) >= self.slots_per_host
+                and task.uid not in holders):
+            raise RuntimeError(
+                f"host {hostname} has no volume slots left for "
+                f"{task.namespace}/{task.name}")
+        holders.add(task.uid)
+        task.volume_ready = True
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        if not task.volume_ready:
+            raise RuntimeError(
+                f"volumes for {task.namespace}/{task.name} were never "
+                f"allocated")
+        self.bound.add(task.uid)
+
+
 class ListRecorder:
     """Collects (event_type, reason, message) tuples; the sim equivalent of
     the k8s event stream."""
