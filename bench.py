@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 import time
@@ -72,29 +73,46 @@ def run_config(config: int, cycles: int, mode: str):
                            PluginOption(name="proportion"),
                            PluginOption(name="nodeorder")])]
 
+    import gc
+
     latencies = []
     bound_total = 0
     bind_seconds = 0.0
-    for cycle in range(cycles):
-        sim = baseline_cluster(config)
-        binds = {}
+    # GC discipline mirrors runtime/scheduler.py: automatic collection off
+    # during the timed cycle (a gen2 pass scans the whole 100k+ object
+    # cluster graph mid-cycle otherwise), explicit collection between
+    # cycles, off the latency path
+    gc.disable()
+    try:
+        for cycle in range(cycles):
+            sim = baseline_cluster(config)
+            binds = {}
 
-        class _B:
-            def bind(self, pod, hostname):
-                binds[pod.uid] = hostname
-                pod.node_name = hostname
+            class _B:
+                def bind(self, pod, hostname):
+                    binds[pod.uid] = hostname
+                    pod.node_name = hostname
 
-        cache = SchedulerCache(binder=_B(), async_writeback=False)
-        sim.populate(cache)
-        t0 = time.perf_counter()
-        ssn = OpenSession(cache, tiers)
-        AllocateAction(mode=mode).execute(ssn)
-        CloseSession(ssn)
-        dt = time.perf_counter() - t0
-        if cycle > 0 or cycles == 1:   # first cycle pays jit compile
-            latencies.append(dt)
-            bound_total += len(binds)
-            bind_seconds += dt
+            cache = SchedulerCache(binder=_B(), async_writeback=False)
+            sim.populate(cache)
+            gc.collect()
+            t0 = time.perf_counter()
+            ssn = OpenSession(cache, tiers)
+            t1 = time.perf_counter()
+            AllocateAction(mode=mode).execute(ssn)
+            t2 = time.perf_counter()
+            CloseSession(ssn)
+            dt = time.perf_counter() - t0
+            if os.environ.get("KB_BENCH_DEBUG"):
+                print(f"cycle {cycle}: open={t1 - t0:.3f}s "
+                      f"allocate={t2 - t1:.3f}s close={dt - (t2 - t0):.3f}s",
+                      file=sys.stderr)
+            if cycle > 0 or cycles == 1:   # first cycle pays jit compile
+                latencies.append(dt)
+                bound_total += len(binds)
+                bind_seconds += dt
+    finally:
+        gc.enable()
     return latencies, bound_total, bind_seconds
 
 
@@ -105,11 +123,13 @@ def main(argv=None):
                          "5k nodes stress config — BASELINE.md's primary "
                          "metric)")
     ap.add_argument("--cycles", type=int, default=4)
-    ap.add_argument("--mode", default="batched",
-                    choices=["batched", "fused", "jax", "host"],
-                    help="allocate engine: batched = round-based throughput "
-                         "engine (policy-exact, order-approximate); fused = "
-                         "bind-for-bind faithful scan engine")
+    ap.add_argument("--mode", default="auto",
+                    choices=["auto", "batched", "fused", "jax", "host"],
+                    help="allocate engine: auto = size-based selection "
+                         "(the shipped default); batched = round-based "
+                         "throughput engine (policy-exact, order-"
+                         "approximate); fused = bind-for-bind faithful "
+                         "scan engine")
     args = ap.parse_args(argv)
 
     backend = ensure_responsive_backend()

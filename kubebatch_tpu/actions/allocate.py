@@ -1,10 +1,14 @@
 """allocate — the primary scheduling action.
 
 Solver modes (KUBEBATCH_SOLVER env or constructor arg):
+- "auto" (default): "batched" when the cycle carries at least
+  AUTO_BATCHED_MIN pending tasks, else "fused" — the big configs get the
+  throughput engine without env vars while small/exact cycles keep the
+  bit-exact one.
 - "batched": the round-based throughput solver (kernels/batched.py) —
   many placements per device step, fairness refreshed between rounds;
   the engine the north-star latency target is measured on.
-- "fused" (default): the whole cycle in ONE device dispatch
+- "fused": the whole cycle in ONE device dispatch
   (kernels/fused.py) — queue/job/task selection and fairness state live
   in-kernel, bit-exact vs the host heap algorithm; host replays the
   decisions through Session.allocate/pipeline so plugins and the gang
@@ -35,6 +39,11 @@ from ..kernels.tensorize import TaskBatch
 from ..kernels.terms import (device_supported, pred_and_score_matrices,
                              solver_terms)
 from ..util import PriorityQueue, select_best_node
+
+#: auto mode switches to the batched engine at this many pending tasks —
+#: below it the fused engine's one-placement-per-step while_loop is cheap
+#: and keeps bind-for-bind ordering exactness
+AUTO_BATCHED_MIN = 512
 
 
 def _effective_min_available(ssn: Session, job: JobInfo) -> int:
@@ -67,16 +76,22 @@ class AllocateAction(Action):
 
     @property
     def mode(self) -> str:
-        return self._mode or os.environ.get("KUBEBATCH_SOLVER", "fused")
+        return self._mode or os.environ.get("KUBEBATCH_SOLVER", "auto")
 
     def execute(self, ssn: Session) -> None:
-        if self.mode == "batched":
+        mode = self.mode
+        if mode == "auto":
+            pending = sum(
+                len(j.task_status_index.get(TaskStatus.PENDING, {}))
+                for j in ssn.jobs.values())
+            mode = ("batched" if pending >= AUTO_BATCHED_MIN else "fused")
+        if mode == "batched":
             from .allocate_batched import batched_supported, execute_batched
             # execute_batched itself returns False (without consuming
             # state) when the snapshot carries unsupported features
             if batched_supported(ssn) and execute_batched(ssn):
                 return
-        elif self.mode == "fused":
+        elif mode == "fused":
             from .allocate_fused import execute_fused, fused_supported
             # execute_fused itself returns False (without consuming state)
             # when the snapshot carries features the kernel can't model
@@ -84,9 +99,11 @@ class AllocateAction(Action):
                 return
             # configured plugins exceed the fused vocabulary; fall back to
             # the per-visit device solver
-        self._execute_queued(ssn)
+        self._execute_queued(ssn, mode)
 
-    def _execute_queued(self, ssn: Session) -> None:
+    def _execute_queued(self, ssn: Session, mode: Optional[str] = None) -> None:
+        if mode is None:
+            mode = self.mode
         queues = PriorityQueue(ssn.queue_order_fn)
         jobs_map: Dict[str, PriorityQueue] = {}
         pending_all: List[TaskInfo] = []
@@ -111,17 +128,18 @@ class AllocateAction(Action):
         # third-party callbacks) take the reference-literal host path
         device = None
         terms = None
-        if self.mode in ("jax", "fused", "batched") \
+        if mode in ("jax", "fused", "batched") \
                 and device_supported(ssn, pending_all):
             # the cheap gate above keeps fallback cycles from paying the
             # full-cluster tensorize + device upload
             if ssn.device_snapshot is None:
                 ssn.device_snapshot = DeviceSession(ssn.nodes)
-            terms = solver_terms(ssn, ssn.device_snapshot, pending_all)
+            terms = solver_terms(ssn, ssn.device_snapshot, pending_all,
+                                 assume_supported=True)
             if terms is not None:
                 device = ssn.device_snapshot
-        elif self.mode == "native" and not (ssn.predicate_fns
-                                            or ssn.node_order_fns):
+        elif mode == "native" and not (ssn.predicate_fns
+                                       or ssn.node_order_fns):
             from ..native import NativeSession, native_available
             if native_available():
                 device = NativeSession(ssn.nodes)

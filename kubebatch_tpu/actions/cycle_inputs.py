@@ -81,6 +81,22 @@ def gang_enabled(ssn: Session) -> bool:
     return False
 
 
+def fast_task_sort_key(ssn: Session):
+    """A tuple sort key equivalent to ``ssn.task_order_fn`` when the only
+    enabled task-order callback is the built-in priority plugin's
+    (descending priority, then the session's creation-timestamp/uid
+    tie-break) — a key sort is ~10x a cmp_to_key sort over 10k tasks.
+    Returns None when a custom task-order fn is registered."""
+    names = [opt.name for tier in ssn.tiers for opt in tier.plugins
+             if not opt.task_order_disabled
+             and opt.name in ssn.task_order_fns]
+    if any(n != "priority" for n in names):
+        return None
+    if names:
+        return lambda t: (-t.priority, t.pod.creation_timestamp, t.uid)
+    return lambda t: (t.pod.creation_timestamp, t.uid)
+
+
 @dataclass
 class CycleInputs:
     """Everything a whole-cycle kernel needs, plus the host-side indexes
@@ -224,12 +240,16 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
     tasks: List[TaskInfo] = []
     task_job_idx: List[int] = []
     task_ranks: List[int] = []
+    fast_key = fast_task_sort_key(ssn)
     for j in jobs:
         pend = [t for t in j.task_status_index.get(TaskStatus.PENDING,
                                                    {}).values()
                 if not t.resreq.is_empty()]
-        pend.sort(key=functools.cmp_to_key(
-            lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
+        if fast_key is not None:
+            pend.sort(key=fast_key)
+        else:
+            pend.sort(key=functools.cmp_to_key(
+                lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
         for rank, t in enumerate(pend):
             tasks.append(t)
             task_job_idx.append(j_index[j.uid])
@@ -243,7 +263,7 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
     if ssn.device_snapshot is None:
         ssn.device_snapshot = DeviceSession(ssn.nodes)
     device: DeviceSession = ssn.device_snapshot
-    terms = solver_terms(ssn, device, tasks)
+    terms = solver_terms(ssn, device, tasks, assume_supported=True)
     if terms is None:
         return None
     batch = TaskBatch.from_tasks(tasks)

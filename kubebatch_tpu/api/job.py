@@ -63,13 +63,16 @@ class TaskInfo:
 
     __slots__ = ("uid", "job", "name", "namespace", "resreq", "init_resreq",
                  "node_name", "status", "priority", "volume_ready", "pod",
-                 "is_backfill")
+                 "is_backfill", "key")
 
     def __init__(self, pod: Pod):
         self.uid: str = pod.uid
         self.job: str = get_job_id(pod)
         self.name: str = pod.name
         self.namespace: str = pod.namespace
+        #: 'namespace/name' node-map key, precomputed — node add/remove and
+        #: the bulk replay build it per placement otherwise
+        self.key: str = pod_key(pod)
         #: steady-state request (app containers only)
         self.resreq: Resource = get_pod_resource_without_init_containers(pod)
         #: launch-time request (max with init containers) — what predicates use
@@ -87,19 +90,20 @@ class TaskInfo:
         t.job = self.job
         t.name = self.name
         t.namespace = self.namespace
-        t.resreq = self.resreq.clone()
-        t.init_resreq = self.init_resreq.clone()
+        # request vectors are immutable after construction (all arithmetic
+        # happens on node/job aggregates, never on a task's own vectors), so
+        # clones SHARE them — a task clone runs O(tasks) per snapshot and
+        # again per node placement, and the two Resource copies dominated it
+        t.resreq = self.resreq
+        t.init_resreq = self.init_resreq
         t.node_name = self.node_name
         t.status = self.status
         t.priority = self.priority
         t.volume_ready = self.volume_ready
         t.pod = self.pod
         t.is_backfill = self.is_backfill
+        t.key = self.key
         return t
-
-    @property
-    def key(self) -> str:
-        return pod_key(self.pod)
 
     def __repr__(self) -> str:
         return (f"Task({self.namespace}/{self.name}: job={self.job}, "

@@ -8,7 +8,7 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from ..objects import Node
-from .job import TaskInfo, pod_key
+from .job import TaskInfo
 from .resource import Resource
 from .types import TaskStatus
 
@@ -85,7 +85,7 @@ class NodeInfo:
     def add_task(self, task: TaskInfo) -> None:
         """ref: node_info.go:113-145. Holds a CLONE of the task so later
         session status flips can't corrupt node accounting."""
-        key = pod_key(task.pod)
+        key = task.key
         if key in self.tasks:
             raise KeyError(f"task <{task.namespace}/{task.name}> already on "
                            f"node <{self.name}>")
@@ -105,7 +105,7 @@ class NodeInfo:
 
     def remove_task(self, ti: TaskInfo) -> None:
         """ref: node_info.go:147-177 (inverse of add_task)."""
-        key = pod_key(ti.pod)
+        key = ti.key
         task = self.tasks.get(key)
         if task is None:
             raise KeyError(f"failed to find task <{ti.namespace}/{ti.name}> "

@@ -587,6 +587,25 @@ def _batched_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
         task_state=jnp.full(t_pad, SKIP, jnp.int32),
         task_node=jnp.full(t_pad, -1, jnp.int32),
         task_seq=jnp.full(t_pad, _IMAX, jnp.int32))
+    return _pack_result(*_run_batched(state, f, i, b, backfilled,
+                                      allocatable_cm, max_task_num, node_ok,
+                                      job_keys, queue_keys, prop_overused,
+                                      dyn_enabled, pipe_enabled, max_rounds,
+                                      compact_bucket))
+
+
+def _pack_result(final: RoundState, rounds):
+    """Decisions + round count as ONE int32 buffer: every blocking
+    device->host read pays full tunnel latency (~70 ms on axon), so the
+    host reads back a single [3*T+1] array instead of four."""
+    return final, jnp.concatenate(
+        [final.task_state, final.task_node, final.task_seq,
+         rounds.astype(jnp.int32)[None]])
+
+
+def _run_batched(state, f, i, b, backfilled, allocatable_cm, max_task_num,
+                 node_ok, job_keys, queue_keys, prop_overused, dyn_enabled,
+                 pipe_enabled, max_rounds, compact_bucket):
     arrays = CycleArrays(
         backfilled=backfilled, allocatable_cm=allocatable_cm,
         max_task_num=max_task_num, node_ok=node_ok,
@@ -636,7 +655,7 @@ def solve_batched(device, inputs, max_rounds: int = 0,
     else:
         compact = compact_bucket
     with solver_trace("batched_allocate"):
-        final, rounds = _batched_packed(
+        final, packed = _batched_packed(
             buf_f, buf_i, buf_b,
             device.idle, device.releasing, device.n_tasks, device.nz_req,
             device.backfilled, device.allocatable_cm, device.max_task_num,
@@ -648,15 +667,14 @@ def solve_batched(device, inputs, max_rounds: int = 0,
             dyn_enabled=inputs.dyn_enabled,
             max_rounds=min(max_rounds, 4096),
             compact_bucket=compact)
-        # one pipelined transfer for everything the host needs; the
-        # blocking reads stay inside the trace so a one-shot capture
-        # includes the device execution, not just the async dispatch
-        for arr in (final.task_state, final.task_node, final.task_seq,
-                    rounds):
-            arr.copy_to_host_async()
-        task_state = np.asarray(final.task_state)
-        task_node = np.asarray(final.task_node)
-        task_seq = np.asarray(final.task_seq)
+        # ONE blocking transfer for everything the host needs; it stays
+        # inside the trace so a one-shot capture includes the device
+        # execution, not just the async dispatch
+        out = np.asarray(packed)
+        task_state = out[:t_pad]
+        task_node = out[t_pad:2 * t_pad]
+        task_seq = out[2 * t_pad:3 * t_pad]
+        rounds = out[3 * t_pad]
 
     device.idle = final.idle
     device.releasing = final.releasing

@@ -61,10 +61,16 @@ def _toleration_key(pod: Pod) -> Tuple:
 
 
 def task_signature(pod: Pod) -> Tuple:
-    """Everything the static predicate/score terms read from the pod."""
-    na_req, na_pref = _node_affinity_keys(pod)
-    return (tuple(sorted(pod.node_selector.items())), na_req, na_pref,
-            _toleration_key(pod))
+    """Everything the static predicate/score terms read from the pod.
+    Cached on the pod object — pod spec fields are immutable for the pod's
+    lifetime, and this runs per pending task per cycle otherwise."""
+    sig = getattr(pod, "_kb_sig", None)
+    if sig is None:
+        na_req, na_pref = _node_affinity_keys(pod)
+        sig = (tuple(sorted(pod.node_selector.items())), na_req, na_pref,
+               _toleration_key(pod))
+        pod._kb_sig = sig
+    return sig
 
 
 def referenced_label_keys(pods: Sequence[Pod]) -> Set[str]:
@@ -195,12 +201,16 @@ def build_static_terms(state: NodeState, tasks: Sequence[TaskInfo],
 # ---------------------------------------------------------------------
 
 def _has_pod_affinity(pod: Pod) -> bool:
-    aff = pod.affinity
-    if aff is None:
-        return False
-    return bool(aff.pod_affinity_required or aff.pod_anti_affinity_required
-                or aff.pod_affinity_preferred
-                or aff.pod_anti_affinity_preferred)
+    flag = getattr(pod, "_kb_podaff", None)
+    if flag is None:
+        aff = pod.affinity
+        flag = bool(aff is not None
+                    and (aff.pod_affinity_required
+                         or aff.pod_anti_affinity_required
+                         or aff.pod_affinity_preferred
+                         or aff.pod_anti_affinity_preferred))
+        pod._kb_podaff = flag
+    return flag
 
 
 def dynamic_features(ssn, pending: Sequence[TaskInfo]) -> Optional[str]:

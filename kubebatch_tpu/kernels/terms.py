@@ -86,11 +86,13 @@ def device_supported(ssn, pending: Sequence[TaskInfo]) -> bool:
     return True
 
 
-def solver_terms(ssn, device, pending: Sequence[TaskInfo]
-                 ) -> Optional[SolverTerms]:
+def solver_terms(ssn, device, pending: Sequence[TaskInfo],
+                 assume_supported: bool = False) -> Optional[SolverTerms]:
     """Static+dynamic terms for the cycle, or None when some registered
-    callback can't run on device (the action then takes the host path)."""
-    if not device_supported(ssn, pending):
+    callback can't run on device (the action then takes the host path).
+    ``assume_supported`` skips the re-check when the caller already ran
+    device_supported on the same pending set (it walks every job's tasks)."""
+    if not assume_supported and not device_supported(ssn, pending):
         return None
     pred_plugins = _active(ssn, ssn.predicate_fns, "predicate_disabled")
     order_plugins = _active(ssn, ssn.node_order_fns, "node_order_disabled")

@@ -58,7 +58,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cycles", type=int, default=0,
                    help="stop after N cycles (0 = run forever)")
     p.add_argument("--solver", default="",
-                   choices=["", "host", "jax", "fused", "batched", "native"],
+                   choices=["", "auto", "host", "jax", "fused", "batched",
+                            "native"],
                    help="override the allocate solver mode")
     return p
 

@@ -73,19 +73,39 @@ class Scheduler:
 
     def run(self, stop: Optional[threading.Event] = None) -> None:
         """Blocking loop: cache workers + periodic run_once
-        (ref: scheduler.go:63-86)."""
+        (ref: scheduler.go:63-86).
+
+        GC discipline: a cycle allocates tens of thousands of short-lived
+        objects (snapshot clones, decision tuples); CPython's automatic
+        collector fires gen2 passes mid-cycle that scan the entire
+        long-lived cluster graph. The loop freezes the pre-existing heap,
+        turns automatic collection off, and collects explicitly between
+        cycles — off the latency path. Go gets the equivalent from its
+        concurrent collector; here it is an explicit scheduling-loop
+        concern."""
+        import gc
+
         stop = stop or self._stop
         self.cache.run()
         self.cache.wait_for_cache_sync()
-        while not stop.is_set():
-            start = time.perf_counter()
-            try:
-                self.run_once()
-            except Exception:  # a failed cycle must not kill the loop
-                import traceback
-                traceback.print_exc()
-            elapsed = time.perf_counter() - start
-            stop.wait(max(0.0, self.schedule_period - elapsed))
+        gc.freeze()
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            while not stop.is_set():
+                start = time.perf_counter()
+                try:
+                    self.run_once()
+                except Exception:  # a failed cycle must not kill the loop
+                    import traceback
+                    traceback.print_exc()
+                gc.collect()
+                elapsed = time.perf_counter() - start
+                stop.wait(max(0.0, self.schedule_period - elapsed))
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+            gc.unfreeze()
 
     def stop(self) -> None:
         self._stop.set()

@@ -60,11 +60,17 @@ class SimCluster:
         for p in self.pods:
             cache.add_pod(p)
 
+    _pod_index: Optional[Dict[Tuple[str, str], Pod]] = None
+
     def pod_lister(self, ns: str, name: str) -> Optional[Pod]:
-        for p in self.pods:
-            if p.namespace == ns and p.name == name:
-                return p
-        return None
+        """O(1) ground-truth lookup for the resync repair loop (every
+        err_tasks retry calls this; a linear scan walks 10k pods at the
+        stress config)."""
+        index = self._pod_index
+        if index is None or len(index) != len(self.pods):
+            index = {(p.namespace, p.name): p for p in self.pods}
+            self._pod_index = index
+        return index.get((ns, name))
 
 
 def build_cluster(spec: ClusterSpec) -> SimCluster:
