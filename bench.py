@@ -55,9 +55,32 @@ def ensure_responsive_backend(timeout: float = 120.0) -> str:
         return "cpu-fallback"
 
 
+#: per-config action order (BASELINE.md scenarios; cfg4/cfg5 use the
+#: shipped config/kube-batch-conf.yaml order)
+CONFIG_ACTIONS = {
+    1: ("allocate",),
+    2: ("allocate",),
+    3: ("allocate", "backfill"),
+    4: ("reclaim", "allocate", "backfill", "preempt"),
+    5: ("reclaim", "allocate", "backfill", "preempt"),
+}
+
+
+def build_actions(config: int, mode: str):
+    from kubebatch_tpu.actions.allocate import AllocateAction
+    from kubebatch_tpu.actions.backfill import BackfillAction
+    from kubebatch_tpu.actions.preempt import PreemptAction
+    from kubebatch_tpu.actions.reclaim import ReclaimAction
+
+    mk = {"allocate": lambda: AllocateAction(mode=mode),
+          "backfill": BackfillAction,
+          "preempt": PreemptAction,
+          "reclaim": ReclaimAction}
+    return [(name, mk[name]()) for name in CONFIG_ACTIONS[config]]
+
+
 def run_config(config: int, cycles: int, mode: str):
     from kubebatch_tpu import actions, plugins  # noqa: F401
-    from kubebatch_tpu.actions.allocate import AllocateAction
     from kubebatch_tpu.cache import SchedulerCache
     from kubebatch_tpu.conf import PluginOption, Tier
     from kubebatch_tpu.framework import CloseSession, OpenSession
@@ -78,6 +101,9 @@ def run_config(config: int, cycles: int, mode: str):
     latencies = []
     bound_total = 0
     bind_seconds = 0.0
+    evicted_total = 0
+    action_seconds = {name: 0.0 for name in CONFIG_ACTIONS[config]}
+    measured_cycles = 0
     # GC discipline mirrors runtime/scheduler.py: automatic collection off
     # during the timed cycle (a gen2 pass scans the whole 100k+ object
     # cluster graph mid-cycle otherwise), explicit collection between
@@ -87,33 +113,51 @@ def run_config(config: int, cycles: int, mode: str):
         for cycle in range(cycles):
             sim = baseline_cluster(config)
             binds = {}
+            evicted = []
 
             class _B:
                 def bind(self, pod, hostname):
                     binds[pod.uid] = hostname
                     pod.node_name = hostname
 
-            cache = SchedulerCache(binder=_B(), async_writeback=False)
+                def evict(self, pod):
+                    evicted.append(pod.uid)
+                    pod.deletion_timestamp = 1.0
+
+            seam = _B()
+            cache = SchedulerCache(binder=seam, evictor=seam,
+                                   async_writeback=False)
             sim.populate(cache)
+            acts = build_actions(config, mode)
             gc.collect()
             t0 = time.perf_counter()
             ssn = OpenSession(cache, tiers)
             t1 = time.perf_counter()
-            AllocateAction(mode=mode).execute(ssn)
+            act_times = []
+            for name, act in acts:
+                a0 = time.perf_counter()
+                act.execute(ssn)
+                act_times.append((name, time.perf_counter() - a0))
             t2 = time.perf_counter()
             CloseSession(ssn)
             dt = time.perf_counter() - t0
             if os.environ.get("KB_BENCH_DEBUG"):
-                print(f"cycle {cycle}: open={t1 - t0:.3f}s "
-                      f"allocate={t2 - t1:.3f}s close={dt - (t2 - t0):.3f}s",
-                      file=sys.stderr)
+                per = " ".join(f"{n}={s:.3f}s" for n, s in act_times)
+                print(f"cycle {cycle}: open={t1 - t0:.3f}s {per} "
+                      f"close={dt - (t2 - t0):.3f}s", file=sys.stderr)
             if cycle > 0 or cycles == 1:   # first cycle pays jit compile
                 latencies.append(dt)
                 bound_total += len(binds)
                 bind_seconds += dt
+                evicted_total += len(evicted)
+                for name, s in act_times:
+                    action_seconds[name] += s
+                measured_cycles += 1
     finally:
         gc.enable()
-    return latencies, bound_total, bind_seconds
+    action_ms = {name: round(1e3 * s / max(1, measured_cycles), 3)
+                 for name, s in action_seconds.items()}
+    return latencies, bound_total, bind_seconds, evicted_total, action_ms
 
 
 def main(argv=None):
@@ -139,13 +183,13 @@ def main(argv=None):
         # the scheduler end-to-end and the JSON is labeled cpu-fallback
         args.config = min(args.config, 2)
         args.mode = "host"
-    latencies, bound, seconds = run_config(args.config, args.cycles,
-                                           args.mode)
+    latencies, bound, seconds, evicted, action_ms = run_config(
+        args.config, args.cycles, args.mode)
     p50_ms = float(np.percentile(latencies, 50) * 1e3)
     p95_ms = float(np.percentile(latencies, 95) * 1e3)
     pods_per_sec = bound / seconds if seconds > 0 else 0.0
     north_star_ms = 15.0
-    print(json.dumps({
+    out = {
         "metric": f"sched_cycle_p50_ms_cfg{args.config}",
         "value": round(p50_ms, 3),
         "unit": "ms",
@@ -153,9 +197,13 @@ def main(argv=None):
         "p95_ms": round(p95_ms, 3),
         "pods_bound_per_sec": round(pods_per_sec, 1),
         "pods_bound_per_cycle": bound // max(1, len(latencies)),
+        "action_ms": action_ms,
         "mode": args.mode,
         "backend": backend,
-    }))
+    }
+    if evicted:
+        out["evictions_per_cycle"] = evicted // max(1, len(latencies))
+    print(json.dumps(out))
     return 0
 
 

@@ -577,6 +577,7 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
 
         if bindings:
             ssn.cache.bind_many(bindings)
+            _observe_dispatch_latency(bindings)
         _apply_event_aggregates(ssn, job_event_sum)
         _dispatch_ready_jobs(ssn, alloc_jobs, job_ready)
         if len(fail_sel):
@@ -585,6 +586,19 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
     except Exception:
         device.resync(ssn.nodes)
         raise
+
+
+def _observe_dispatch_latency(bindings) -> None:
+    """Creation -> bind latency for every dispatched task, batched
+    (ordered-path parity: Session.dispatch observes per task,
+    ref session.go:319)."""
+    import time as _time
+
+    from ..metrics import update_task_schedule_durations
+
+    now = _time.time()
+    update_task_schedule_durations(
+        [max(0.0, now - t.pod.creation_timestamp) for t, _ in bindings])
 
 
 def _apply_event_aggregates(ssn: Session,
@@ -646,6 +660,7 @@ def _dispatch_ready_jobs(ssn: Session, alloc_jobs: Dict[str, tuple],
     if not bindings:
         return
     ssn.cache.bind_many(bindings)
+    _observe_dispatch_latency(bindings)
     binding = TaskStatus.BINDING
     for job, task in flips:
         index = job.task_status_index

@@ -4,6 +4,14 @@ ref: pkg/scheduler/actions/reclaim/reclaim.go. Victims are Running tasks
 of jobs in OTHER queues; evictions go straight through the session (no
 Statement — reclaim.go:159-173); the reclaimer is pipelined onto the node
 once enough resource is being released.
+
+Two engines share the identical outer control flow (see actions/preempt.py
+for the same split): the device path analyses a whole node visit — nodes
+in host iteration order, tiered gang/conformance/proportion victim masks —
+in one kernel dispatch (kernels/victims.py) and replays the chosen node's
+eviction walk through ssn.evict in float64; nodes where proportion's
+sequential skip-guard trips are handed to the exact host block.
+KUBEBATCH_VICTIM_SOLVER=host forces the reference-literal loops.
 """
 from __future__ import annotations
 
@@ -21,6 +29,11 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn: Session) -> None:
+        from ..kernels.victims import build_action_solver
+        solver = build_action_solver(ssn, "reclaimable_fns",
+                                     "reclaimable_disabled",
+                                     score_nodes=False)
+
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_map = {}
         preemptors_map: Dict[str, PriorityQueue] = {}
@@ -55,45 +68,108 @@ class ReclaimAction(Action):
                 continue
             task = tasks.pop()
 
-            assigned = False
-            for node in ssn.nodes.values():
-                try:
-                    ssn.predicate_fn(task, node)
-                except Exception:
-                    continue
+            if solver is not None:
+                assigned = self._reclaim_one_device(ssn, solver, task, job)
+            else:
+                assigned = self._reclaim_one_host(ssn, task, job)
 
-                resreq = task.init_resreq.clone()
-                reclaimed = Resource.empty()
+            if assigned:
+                queues.push(queue)
+
+    # ------------------------------------------------------------------
+    # host path — the reference algorithm verbatim (the oracle)
+    # ------------------------------------------------------------------
+    def _reclaim_one_host(self, ssn: Session, task, job) -> bool:
+        for node in ssn.nodes.values():
+            try:
+                ssn.predicate_fn(task, node)
+            except Exception:
+                continue
+
+            reclaimees = []
+            for t in node.tasks.values():
+                if t.status != TaskStatus.RUNNING:
+                    continue
+                j = ssn.jobs.get(t.job)
+                if j is not None and j.queue != job.queue:
+                    # clone so session status flips don't corrupt the
+                    # node's accounting (reclaim.go:137)
+                    reclaimees.append(t.clone())
+            victims = ssn.reclaimable(task, reclaimees)
+            if not validate_victims(victims, task.init_resreq):
+                continue
+
+            if self._evict_walk(ssn, task, victims, None):
+                ssn.pipeline(task, node.name)
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    # device path
+    # ------------------------------------------------------------------
+    def _reclaim_one_device(self, ssn: Session, solver, task, job) -> bool:
+        import numpy as np
+
+        state = solver.state
+        visited = np.zeros(state.n_pad, bool)
+        while True:
+            res = solver.visit(task, "other_queue", visited)
+            if not res.found:
+                return False
+            node = ssn.nodes.get(res.node_name)
+            if node is None:  # pragma: no cover — names come from the snapshot
+                return False
+
+            if res.prop_guard:
+                # proportion's skip-guard tripped: victim set for this node
+                # is sequential-only — evaluate the node with the exact
+                # host block (real plugin callbacks)
                 reclaimees = []
                 for t in node.tasks.values():
                     if t.status != TaskStatus.RUNNING:
                         continue
                     j = ssn.jobs.get(t.job)
                     if j is not None and j.queue != job.queue:
-                        # clone so session status flips don't corrupt the
-                        # node's accounting (reclaim.go:137)
                         reclaimees.append(t.clone())
                 victims = ssn.reclaimable(task, reclaimees)
-                if not validate_victims(victims, resreq):
+                if not validate_victims(victims, task.init_resreq):
+                    visited[res.node_idx] = True
                     continue
+                covered = self._evict_walk(ssn, task, victims, state)
+            else:
+                victims = [state.victims[row].task.clone()
+                           for row in res.victim_rows]
+                covered = self._evict_walk(ssn, task, victims, state)
 
-                for reclaimee in victims:
-                    try:
-                        ssn.evict(reclaimee, "reclaim")
-                    except Exception:
-                        continue
-                    reclaimed.add(reclaimee.resreq)
-                    if resreq.less_equal(reclaimee.resreq):
-                        break
-                    resreq.sub(reclaimee.resreq)
+            if covered:
+                ssn.pipeline(task, res.node_name)
+                state.apply_pipeline(task, res.node_idx)
+                return True
+            visited[res.node_idx] = True   # evictions stand; state changed
 
-                if task.init_resreq.less_equal(reclaimed):
-                    ssn.pipeline(task, node.name)
-                    assigned = True
-                    break
-
-            if assigned:
-                queues.push(queue)
+    # ------------------------------------------------------------------
+    def _evict_walk(self, ssn: Session, task, victims, state) -> bool:
+        """The reference's cumulative eviction loop (reclaim.go:159-176):
+        evict victims in candidate order until the remaining request fits
+        inside the current victim; a failed evict is skipped without
+        advancing the cumulative bookkeeping. Mirrors (device path) track
+        successful evictions only."""
+        resreq = task.init_resreq.clone()
+        reclaimed = Resource.empty()
+        for reclaimee in victims:
+            try:
+                ssn.evict(reclaimee, "reclaim")
+            except Exception:
+                continue
+            if state is not None:
+                row = state.row_of.get(reclaimee.uid)
+                if row is not None:
+                    state.apply_evict(row)
+            reclaimed.add(reclaimee.resreq)
+            if resreq.less_equal(reclaimee.resreq):
+                break
+            resreq.sub(reclaimee.resreq)
+        return task.init_resreq.less_equal(reclaimed)
 
 
 def new() -> ReclaimAction:

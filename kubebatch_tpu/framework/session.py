@@ -15,12 +15,14 @@ callbacks stay as ground truth for tests and for host-side odds and ends.
 """
 from __future__ import annotations
 
+import time as _time
 import uuid as _uuid
 from typing import Callable, Dict, List, Optional
 
 from ..api import (ClusterInfo, JobInfo, JobReadiness, NodeInfo, QueueInfo,
                    TaskInfo, TaskStatus, ValidateResult)
 from ..conf import Tier
+from ..metrics import update_pod_schedule_status, update_task_schedule_duration
 from ..objects import (PodGroupCondition, PodGroupPhase, PodGroupStatus,
                        UNSCHEDULABLE_CONDITION)
 from .event import Event, EventHandler
@@ -349,6 +351,9 @@ class Session:
         job = self.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.BINDING)
+        # creation -> bind latency (ref: session.go:319)
+        update_task_schedule_duration(
+            max(0.0, _time.time() - task.pod.creation_timestamp))
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """Real eviction through the cache plus session bookkeeping
@@ -435,12 +440,20 @@ def job_status(ssn: Session, job: JobInfo) -> PodGroupStatus:
 
 def close_session(ssn: Session) -> None:
     """Write job status back through the cache (ref: session.go:124-156)."""
+    scheduled = 0
+    unschedulable = 0
     for job in ssn.jobs.values():
+        scheduled += job.count(TaskStatus.BINDING)
+        unschedulable += job.count(TaskStatus.PENDING)
         if job.pod_group is None:
             ssn.cache.record_job_status_event(job)
             continue
         job.pod_group.status = job_status(ssn, job)
         ssn.cache.update_job_status(job)
+    # per-cycle attempt results (ref: metrics.go schedule_attempts_total;
+    # results follow the upstream scheduler's vocabulary)
+    update_pod_schedule_status("scheduled", scheduled)
+    update_pod_schedule_status("unschedulable", unschedulable)
     ssn.jobs = {}
     ssn.nodes = {}
     ssn.queues = {}

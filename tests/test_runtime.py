@@ -199,3 +199,68 @@ def test_prometheus_metric_taxonomy():
     ]
     missing = [n for n in expected if not any(n in x for x in names)]
     assert not missing, f"missing reference metrics: {missing}"
+
+
+def test_task_latency_and_attempt_metrics_observe():
+    """The two reference metrics wired at dispatch/close actually record:
+    task_scheduling_latency (session.go:319) on both the ordered dispatch
+    and the bulk replay, schedule_attempts_total per cycle result."""
+    try:
+        from prometheus_client import REGISTRY
+    except ImportError:
+        import pytest
+        pytest.skip("prometheus_client not available")
+
+    from kubebatch_tpu import actions, plugins  # noqa: F401
+    from kubebatch_tpu.actions.allocate import AllocateAction
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.conf import PluginOption, Tier
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.objects import PodPhase
+
+    from .fixtures import GiB, build_group, build_node, build_pod, \
+        build_queue, rl
+
+    def sample(name, labels=None):
+        v = REGISTRY.get_sample_value(name, labels or {})
+        return v or 0.0
+
+    for mode in ("host", "batched"):
+        before_lat = sample(
+            "kube_batch_task_scheduling_latency_microseconds_count")
+        before_ok = sample("kube_batch_schedule_attempts_total",
+                           {"result": "scheduled"})
+        before_un = sample("kube_batch_schedule_attempts_total",
+                           {"result": "unschedulable"})
+
+        class _B:
+            def bind(self, pod, hostname):
+                pod.node_name = hostname
+
+        cache = SchedulerCache(binder=_B(), async_writeback=False)
+        cache.add_queue(build_queue("q1"))
+        cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+        cache.add_pod_group(build_group("ns", "g", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "g-0", "", PodPhase.PENDING,
+                                rl(1000, GiB), group="g"))
+        # an unschedulable singleton too (too big for the node)
+        cache.add_pod_group(build_group("ns", "big", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "big-0", "", PodPhase.PENDING,
+                                rl(64000, GiB), group="big"))
+        tiers = [Tier(plugins=[PluginOption(name="priority"),
+                               PluginOption(name="gang")]),
+                 Tier(plugins=[PluginOption(name="drf"),
+                               PluginOption(name="predicates"),
+                               PluginOption(name="proportion"),
+                               PluginOption(name="nodeorder")])]
+        ssn = OpenSession(cache, tiers)
+        AllocateAction(mode=mode).execute(ssn)
+        CloseSession(ssn)
+
+        after_lat = sample(
+            "kube_batch_task_scheduling_latency_microseconds_count")
+        assert after_lat > before_lat, f"no latency observation ({mode})"
+        assert sample("kube_batch_schedule_attempts_total",
+                      {"result": "scheduled"}) > before_ok, mode
+        assert sample("kube_batch_schedule_attempts_total",
+                      {"result": "unschedulable"}) > before_un, mode

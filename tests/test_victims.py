@@ -1,0 +1,325 @@
+"""Device victim-selection kernel vs the host oracle (kernels/victims.py
+vs the reference-literal loops in actions/preempt.py / actions/reclaim.py).
+
+Every scenario runs twice — KUBEBATCH_VICTIM_SOLVER=host (the oracle) and
+=device — and must produce identical session task statuses, evictions and
+binds. Mirrors the equivalence pattern of tests/test_batched.py.
+"""
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.actions.preempt import PreemptAction
+from kubebatch_tpu.actions.reclaim import ReclaimAction
+from kubebatch_tpu.api import TaskStatus
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import PodPhase
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+def shipped_tiers():
+    return [Tier(plugins=[PluginOption(name="priority"),
+                          PluginOption(name="gang"),
+                          PluginOption(name="conformance")]),
+            Tier(plugins=[PluginOption(name="drf"),
+                          PluginOption(name="predicates"),
+                          PluginOption(name="proportion"),
+                          PluginOption(name="nodeorder")])]
+
+
+class Recorder:
+    def __init__(self):
+        self.binds = {}
+        self.evicted = []
+
+    def bind(self, pod, hostname):
+        self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+        pod.node_name = hostname
+
+    def evict(self, pod):
+        self.evicted.append(f"{pod.namespace}/{pod.name}")
+        pod.deletion_timestamp = 1.0
+
+
+def run_scenario(build, acts, solver, monkeypatch):
+    monkeypatch.setenv("KUBEBATCH_VICTIM_SOLVER", solver)
+    rec = Recorder()
+    cache = SchedulerCache(binder=rec, evictor=rec, async_writeback=False)
+    build(cache)
+    ssn = OpenSession(cache, shipped_tiers())
+    for act in acts():
+        act.execute(ssn)
+    statuses = {}
+    placed = {}
+    for job in ssn.jobs.values():
+        for task in job.tasks.values():
+            statuses[task.key] = task.status
+            placed[task.key] = task.node_name
+    CloseSession(ssn)
+    cache.drain(timeout=5.0)
+    return statuses, placed, rec
+
+
+def assert_equivalent(build, acts, monkeypatch):
+    s_h, p_h, r_h = run_scenario(build, acts, "host", monkeypatch)
+    s_d, p_d, r_d = run_scenario(build, acts, "device", monkeypatch)
+    assert s_d == s_h, "session statuses diverge"
+    assert p_d == p_h, "placements diverge"
+    assert sorted(r_d.evicted) == sorted(r_h.evicted), "evictions diverge"
+    assert r_d.binds == r_h.binds, "binds diverge"
+    return s_h, r_h
+
+
+# ---------------------------------------------------------------------
+# targeted scenarios
+# ---------------------------------------------------------------------
+
+def test_inter_job_preemption_equivalence(monkeypatch):
+    """High-priority gang preempts a low-priority job on a full node."""
+    def build(cache):
+        cache.add_queue(build_queue("q1"))
+        cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+        cache.add_pod_group(build_group("ns", "low", 1, queue="q1"))
+        for i in range(2):
+            cache.add_pod(build_pod("ns", f"low-{i}", "n1", PodPhase.RUNNING,
+                                    rl(2000, 4 * GiB), group="low",
+                                    priority=1))
+        cache.add_pod_group(build_group("ns", "high", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "high-0", "", PodPhase.PENDING,
+                                rl(2000, 4 * GiB), group="high",
+                                priority=100))
+
+    statuses, rec = assert_equivalent(
+        build, lambda: [AllocateAction(mode="host"), PreemptAction()],
+        monkeypatch)
+    assert statuses["ns/high-0"] == TaskStatus.PIPELINED
+    assert len(rec.evicted) == 1
+
+
+def test_min_available_one_quirk_equivalence(monkeypatch):
+    """The MinAvailable==1 fork quirk: the last task of a min=1 job stays
+    evictable even though eviction takes the job below its quorum."""
+    def build(cache):
+        cache.add_queue(build_queue("q1"))
+        cache.add_node(build_node("n1", rl(2000, 4 * GiB, pods=110)))
+        cache.add_pod_group(build_group("ns", "solo", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "solo-0", "n1", PodPhase.RUNNING,
+                                rl(2000, 4 * GiB), group="solo", priority=1))
+        cache.add_pod_group(build_group("ns", "vip", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "vip-0", "", PodPhase.PENDING,
+                                rl(2000, 4 * GiB), group="vip",
+                                priority=100))
+
+    statuses, rec = assert_equivalent(
+        build, lambda: [PreemptAction()], monkeypatch)
+    assert rec.evicted == ["ns/solo-0"]
+    assert statuses["ns/vip-0"] == TaskStatus.PIPELINED
+
+
+def test_conformance_protects_critical_equivalence(monkeypatch):
+    """Critical pods are never victims, in both engines."""
+    def build(cache):
+        cache.add_queue(build_queue("q1"))
+        cache.add_node(build_node("n1", rl(2000, 4 * GiB, pods=110)))
+        cache.add_pod_group(build_group("ns", "crit", 1, queue="q1"))
+        cache.add_pod(build_pod(
+            "ns", "crit-0", "n1", PodPhase.RUNNING, rl(2000, 4 * GiB),
+            group="crit", priority=1,
+            priority_class_name="system-cluster-critical"))
+        cache.add_pod_group(build_group("ns", "vip", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "vip-0", "", PodPhase.PENDING,
+                                rl(2000, 4 * GiB), group="vip",
+                                priority=100))
+
+    statuses, rec = assert_equivalent(
+        build, lambda: [PreemptAction()], monkeypatch)
+    assert rec.evicted == []
+    assert statuses["ns/vip-0"] == TaskStatus.PENDING
+
+
+def test_gang_quorum_blocks_eviction_equivalence(monkeypatch):
+    """A job exactly at MinAvailable (min=2, 2 running) is not evictable
+    (gang tier yields nothing; drf tier then decides)."""
+    def build(cache):
+        cache.add_queue(build_queue("q1"))
+        cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+        cache.add_pod_group(build_group("ns", "pair", 2, queue="q1"))
+        for i in range(2):
+            cache.add_pod(build_pod("ns", f"pair-{i}", "n1",
+                                    PodPhase.RUNNING, rl(2000, 4 * GiB),
+                                    group="pair", priority=1))
+        cache.add_pod_group(build_group("ns", "vip", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "vip-0", "", PodPhase.PENDING,
+                                rl(2000, 4 * GiB), group="vip",
+                                priority=100))
+
+    assert_equivalent(build, lambda: [PreemptAction()], monkeypatch)
+
+
+def test_case_b_spill_across_nodes_equivalence(monkeypatch):
+    """A node that validates (victims' total not strictly-less in every
+    dimension) but whose eviction walk cannot cover the request keeps its
+    evictions, and the preemptor lands on a later node — reference
+    preempt.go:340-350 behavior, both engines."""
+    def build(cache):
+        cache.add_queue(build_queue("q1"))
+        # n1: victim rich in cpu, poor in memory -> validate passes
+        # (cpu 5000 > 4000), covers fails (mem 2GiB < 6GiB)
+        cache.add_node(build_node("n1", rl(5000, 8 * GiB, pods=110)))
+        cache.add_node(build_node("n2", rl(4000, 8 * GiB, pods=110)))
+        cache.add_pod_group(build_group("ns", "wide", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "wide-0", "n1", PodPhase.RUNNING,
+                                rl(5000, 2 * GiB), group="wide", priority=1))
+        cache.add_pod_group(build_group("ns", "tall", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "tall-0", "n2", PodPhase.RUNNING,
+                                rl(4000, 6 * GiB), group="tall", priority=1))
+        cache.add_pod_group(build_group("ns", "vip", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "vip-0", "", PodPhase.PENDING,
+                                rl(4000, 6 * GiB), group="vip",
+                                priority=100))
+
+    statuses, rec = assert_equivalent(
+        build, lambda: [PreemptAction()], monkeypatch)
+    assert statuses["ns/vip-0"] == TaskStatus.PIPELINED
+
+
+def test_reclaim_cross_queue_equivalence(monkeypatch):
+    """Under-share queue reclaims from the over-share queue; proportion's
+    deserved floor is respected identically."""
+    def build(cache):
+        cache.add_queue(build_queue("qa", weight=1))
+        cache.add_queue(build_queue("qb", weight=1))
+        cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+        cache.add_pod_group(build_group("ns", "hog", 1, queue="qa"))
+        for i in range(4):
+            cache.add_pod(build_pod("ns", f"hog-{i}", "n1",
+                                    PodPhase.RUNNING, rl(1000, 2 * GiB),
+                                    group="hog", priority=1))
+        cache.add_pod_group(build_group("ns", "newb", 1, queue="qb"))
+        cache.add_pod(build_pod("ns", "newb-0", "", PodPhase.PENDING,
+                                rl(1000, 2 * GiB), group="newb", priority=1))
+
+    statuses, rec = assert_equivalent(
+        build, lambda: [ReclaimAction()], monkeypatch)
+    assert statuses["ns/newb-0"] == TaskStatus.PIPELINED
+    assert len(rec.evicted) >= 1
+
+
+def test_preempt_then_reclaim_full_cycle_equivalence(monkeypatch):
+    """The shipped action order (reclaim, allocate, preempt) on a mixed
+    two-queue cluster."""
+    def build(cache):
+        cache.add_queue(build_queue("qa", weight=1))
+        cache.add_queue(build_queue("qb", weight=3))
+        for n in range(3):
+            cache.add_node(build_node(f"n{n}", rl(4000, 8 * GiB, pods=110)))
+        cache.add_pod_group(build_group("ns", "old", 1, queue="qa"))
+        for i in range(5):
+            cache.add_pod(build_pod("ns", f"old-{i}", f"n{i % 3}",
+                                    PodPhase.RUNNING, rl(2000, 4 * GiB),
+                                    group="old", priority=10))
+        cache.add_pod_group(build_group("ns", "gang", 2, queue="qb"))
+        for i in range(3):
+            cache.add_pod(build_pod("ns", f"gang-{i}", "", PodPhase.PENDING,
+                                    rl(2000, 4 * GiB), group="gang",
+                                    priority=100))
+
+    assert_equivalent(
+        build,
+        lambda: [ReclaimAction(), AllocateAction(mode="host"),
+                 PreemptAction()],
+        monkeypatch)
+
+
+# ---------------------------------------------------------------------
+# randomized sweep
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_randomized_equivalence(monkeypatch, seed):
+    """Seeded random clusters: nodes with jittered capacity, running fill
+    across queues/priorities, pending gangs — device == host on the full
+    reclaim+allocate+preempt cycle."""
+    rng = np.random.default_rng(seed)
+    n_nodes = int(rng.integers(3, 8))
+    n_queues = int(rng.integers(1, 4))
+    caps = [(int(rng.integers(2, 6)) * 1000, int(rng.integers(4, 12)) * GiB)
+            for _ in range(n_nodes)]
+    fills = []
+    for i in range(int(rng.integers(3, 10))):
+        fills.append((f"fill-{i}", int(rng.integers(0, n_nodes)),
+                      int(rng.integers(1, 3)) * 500,
+                      int(rng.integers(1, 4)) * GiB,
+                      int(rng.integers(0, n_queues)),
+                      int(rng.integers(1, 20))))
+    gangs = []
+    for g in range(int(rng.integers(1, 4))):
+        size = int(rng.integers(1, 4))
+        gangs.append((f"gang-{g}", size, max(1, size - 1),
+                      int(rng.integers(1, 3)) * 500,
+                      int(rng.integers(1, 4)) * GiB,
+                      int(rng.integers(0, n_queues)),
+                      int(rng.integers(50, 200))))
+
+    def build(cache):
+        for q in range(n_queues):
+            cache.add_queue(build_queue(f"q{q}", weight=q + 1))
+        for i, (cpu, mem) in enumerate(caps):
+            cache.add_node(build_node(f"n{i}", rl(cpu, mem, pods=20)))
+        for name, node, cpu, mem, q, pri in fills:
+            cache.add_pod_group(build_group("ns", name, 1, queue=f"q{q}"))
+            cache.add_pod(build_pod("ns", f"{name}-0", f"n{node}",
+                                    PodPhase.RUNNING, rl(cpu, mem),
+                                    group=name, priority=pri))
+        for name, size, minav, cpu, mem, q, pri in gangs:
+            cache.add_pod_group(build_group("ns", name, minav,
+                                            queue=f"q{q}"))
+            for i in range(size):
+                cache.add_pod(build_pod("ns", f"{name}-{i}", "",
+                                        PodPhase.PENDING, rl(cpu, mem),
+                                        group=name, priority=pri))
+
+    assert_equivalent(
+        build,
+        lambda: [ReclaimAction(), AllocateAction(mode="host"),
+                 PreemptAction()],
+        monkeypatch)
+
+
+def test_device_path_actually_runs(monkeypatch):
+    """Guard against silent fallback: the shipped-tier scenario must build
+    a device solver (not return None)."""
+    from kubebatch_tpu.kernels import victims as kv
+
+    built = []
+    orig = kv.build_victim_solver
+
+    def probe(*a, **k):
+        r = orig(*a, **k)
+        built.append(r is not None)
+        return r
+
+    monkeypatch.setattr(kv, "build_victim_solver", probe)
+    monkeypatch.setenv("KUBEBATCH_VICTIM_SOLVER", "device")
+
+    def build(cache):
+        cache.add_queue(build_queue("q1"))
+        cache.add_node(build_node("n1", rl(2000, 4 * GiB, pods=110)))
+        cache.add_pod_group(build_group("ns", "a", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "a-0", "n1", PodPhase.RUNNING,
+                                rl(2000, 4 * GiB), group="a", priority=1))
+        cache.add_pod_group(build_group("ns", "b", 1, queue="q1"))
+        cache.add_pod(build_pod("ns", "b-0", "", PodPhase.PENDING,
+                                rl(2000, 4 * GiB), group="b", priority=100))
+
+    rec = Recorder()
+    cache = SchedulerCache(binder=rec, evictor=rec, async_writeback=False)
+    build(cache)
+    ssn = OpenSession(cache, shipped_tiers())
+    PreemptAction().execute(ssn)
+    CloseSession(ssn)
+    assert built and all(built), "device solver must be built, not fall back"
