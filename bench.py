@@ -82,19 +82,13 @@ def build_actions(config: int, mode: str):
 def run_config(config: int, cycles: int, mode: str):
     from kubebatch_tpu import actions, plugins  # noqa: F401
     from kubebatch_tpu.cache import SchedulerCache
-    from kubebatch_tpu.conf import PluginOption, Tier
+    from kubebatch_tpu.conf import shipped_tiers
     from kubebatch_tpu.framework import CloseSession, OpenSession
     from kubebatch_tpu.sim import baseline_cluster
 
     # the shipped config's full multi-tier stack (config/kube-batch-conf.yaml
     # parity; BASELINE cfg5 calls for the full stack)
-    tiers = [Tier(plugins=[PluginOption(name="priority"),
-                           PluginOption(name="gang"),
-                           PluginOption(name="conformance")]),
-             Tier(plugins=[PluginOption(name="drf"),
-                           PluginOption(name="predicates"),
-                           PluginOption(name="proportion"),
-                           PluginOption(name="nodeorder")])]
+    tiers = shipped_tiers()
 
     import gc
 
@@ -168,7 +162,8 @@ def main(argv=None):
                          "metric)")
     ap.add_argument("--cycles", type=int, default=4)
     ap.add_argument("--mode", default="auto",
-                    choices=["auto", "batched", "fused", "jax", "host"],
+                    choices=["auto", "batched", "sharded", "fused", "jax",
+                             "host"],
                     help="allocate engine: auto = size-based selection "
                          "(the shipped default); batched = round-based "
                          "throughput engine (policy-exact, order-"

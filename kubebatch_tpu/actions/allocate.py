@@ -32,7 +32,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..api import JobInfo, TaskInfo, TaskStatus
-from ..framework import Action, Session, register_action
+from ..framework import (Action, Session, VolumeAllocationError,
+                         register_action)
 from ..kernels.solver import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
                               DeviceSession)
 from ..kernels.tensorize import TaskBatch
@@ -85,12 +86,14 @@ class AllocateAction(Action):
                 len(j.task_status_index.get(TaskStatus.PENDING, {}))
                 for j in ssn.jobs.values())
             mode = ("batched" if pending >= AUTO_BATCHED_MIN else "fused")
-        if mode == "batched":
+        if mode in ("batched", "sharded"):
             from .allocate_batched import batched_supported, execute_batched
             # execute_batched itself returns False (without consuming
             # state) when the snapshot carries unsupported features
-            if batched_supported(ssn) and execute_batched(ssn):
+            if batched_supported(ssn) \
+                    and execute_batched(ssn, sharded=(mode == "sharded")):
                 return
+            mode = "batched"   # device fallback path below
         elif mode == "fused":
             from .allocate_fused import execute_fused, fused_supported
             # execute_fused itself returns False (without consuming state)
@@ -247,8 +250,16 @@ class AllocateAction(Action):
 
             for node in select_best_node(node_scores):
                 if task.init_resreq.less_equal(node.accessible()):
-                    ssn.allocate(task, node.name,
-                                 not task.init_resreq.less_equal(node.idle))
+                    try:
+                        ssn.allocate(task, node.name,
+                                     not task.init_resreq.less_equal(
+                                         node.idle))
+                    except VolumeAllocationError:
+                        # pre-mutation volume failure: try the next node
+                        # (ref: allocate.go:157-161). Post-mutation errors
+                        # propagate — retrying elsewhere would double-place
+                        # the task.
+                        continue
                     assigned = True
                     break
                 else:

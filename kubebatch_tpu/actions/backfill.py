@@ -20,7 +20,8 @@ import os
 from typing import Optional
 
 from ..api import JobInfo, TaskStatus
-from ..framework import Action, Session, register_action
+from ..framework import (Action, Session, VolumeAllocationError,
+                         register_action)
 
 
 def release_reserved_resources(ssn: Session, job: JobInfo) -> None:
@@ -86,7 +87,9 @@ class BackfillAction(Action):
                         continue
                     try:
                         ssn.allocate(task, node.name, False)
-                    except Exception:
+                    except VolumeAllocationError:
+                        # pre-mutation failure only; post-mutation errors
+                        # propagate (see actions/allocate.py host path)
                         continue
                     break
 

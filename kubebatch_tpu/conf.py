@@ -67,3 +67,27 @@ def parse_scheduler_conf(conf_str: str) -> SchedulerConfiguration:
             plugins.append(opt)
         tiers.append(Tier(plugins=plugins))
     return SchedulerConfiguration(actions=raw.get("actions", ""), tiers=tiers)
+
+
+#: the shipped policy (config/kube-batch-conf.yaml, mirroring the
+#: reference's config file): actions + the two-tier plugin stack
+SHIPPED_CONF = """
+actions: "reclaim, allocate, backfill, preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def shipped_tiers() -> List[Tier]:
+    """The shipped two-tier plugin stack as parsed Tier objects — the
+    single construction point benches, the multichip dryrun, and the
+    equivalence suites share."""
+    return parse_scheduler_conf(SHIPPED_CONF).tiers

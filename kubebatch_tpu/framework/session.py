@@ -38,6 +38,13 @@ class PredicateError(Exception):
     """A predicate rejection with a user-facing reason."""
 
 
+class VolumeAllocationError(Exception):
+    """allocate_volumes failed BEFORE any session mutation — the one
+    ssn.allocate failure callers may safely answer with try-the-next-node
+    (ref: allocate.go:157-161). Later failures (dispatch/bind) leave
+    mutated session state behind and must propagate."""
+
+
 class Session:
     def __init__(self, cache, snapshot: ClusterInfo,
                  enable_preemption: bool = False):
@@ -326,7 +333,10 @@ class Session:
                  using_backfill_task_res: bool = False) -> None:
         """Assign task to host within the session; dispatch the whole job
         once it reaches Ready — the gang barrier (ref: session.go:237-297)."""
-        self.cache.allocate_volumes(task, hostname)
+        try:
+            self.cache.allocate_volumes(task, hostname)
+        except Exception as e:
+            raise VolumeAllocationError(str(e)) from e
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job}")

@@ -74,6 +74,14 @@ def device_supported(ssn, pending: Sequence[TaskInfo]) -> bool:
     registered callbacks run on device at all? Lets the action skip
     DeviceSession construction — a full-cluster upload — on snapshots that
     will take the host path anyway."""
+    from ..cache.interface import NullVolumeBinder
+
+    # a real volume binder makes placement feasibility depend on per-node
+    # volume state the kernels don't model (same category as inter-pod
+    # affinity); the host path handles its try-next-node semantics
+    if type(getattr(ssn.cache, "volume_binder", None)) \
+            is not NullVolumeBinder:
+        return False
     pred_plugins = _active(ssn, ssn.predicate_fns, "predicate_disabled")
     order_plugins = _active(ssn, ssn.node_order_fns, "node_order_disabled")
     if any(p not in _DEVICE_PREDICATE_PLUGINS for p in pred_plugins):

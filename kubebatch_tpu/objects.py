@@ -221,6 +221,9 @@ class Pod:
     creation_timestamp: float = 0.0
     owner_uid: str = ""       # controller owner (ref: pkg/apis/utils/utils.go:305)
     status_conditions: List[Dict[str, str]] = field(default_factory=list)
+    #: PersistentVolumeClaim names this pod mounts (same namespace);
+    #: consumed by the PV-aware volume binder seam (sim/source.py)
+    pvc_names: List[str] = field(default_factory=list)
 
     @property
     def group_name(self) -> str:

@@ -1,6 +1,9 @@
 """Client for the solver sidecar: builds a SnapshotRequest from a Session
 and applies the returned decisions — the front-end half of the gRPC
-boundary (SURVEY.md sect. 2.9)."""
+boundary (SURVEY.md sect. 2.9). The wire carries the FULL policy-term
+payload the in-process engines consume: sig-indexed predicate/score
+matrices, dynamic nodeorder weights with their per-task / per-node
+nonzero-request inputs, and the drf/proportion fairness seeds."""
 from __future__ import annotations
 
 import functools
@@ -15,8 +18,19 @@ from ..api import TaskStatus, ready_statuses
 from ..framework import Session
 from ..kernels.fused import (ALLOC, ALLOC_OB, K_DRF_SHARE, K_PRIORITY,
                              PIPELINE)
+from ..kernels.tensorize import NodeState, nz_request_vec
+from ..kernels.terms import solver_terms
 from . import solver_pb2
 from .server import SERVICE
+
+
+class _StateShim:
+    """Adapter: solver_terms reads only ``.state`` off its device arg, so
+    the client can encode terms from a host-side NodeState without a
+    device upload."""
+
+    def __init__(self, state: NodeState):
+        self.state = state
 
 
 class SolverClient:
@@ -107,7 +121,48 @@ class SolverClient:
         if drf is not None:
             req.cluster_total.extend(
                 drf.total_resource.to_vec().tolist())
+            for jb in jobs:
+                attr = drf.job_opts.get(jb.uid)
+                vec = (attr.allocated.to_vec() if attr is not None
+                       else np.zeros(3, np.float32))
+                req.jobs.allocated.extend(vec.tolist())
+
+        self._attach_terms(ssn, req, node_names, tasks_by_uid)
         return req, tasks_by_uid
+
+    @staticmethod
+    def _attach_terms(ssn: Session, req, node_names: List[str],
+                      tasks_by_uid: Dict[str, object]) -> None:
+        """Encode the predicate/score terms (kernels/terms) into the wire
+        payload. Raises ValueError for snapshots whose callbacks the
+        kernels cannot express (inter-pod affinity, host ports, custom
+        plugins) — silent divergence is worse than an error."""
+        pending = list(tasks_by_uid.values())
+        state = NodeState.from_nodes(ssn.nodes)
+        terms = solver_terms(ssn, _StateShim(state), pending)
+        if terms is None:
+            raise ValueError(
+                "session predicates/score callbacks exceed the sidecar "
+                "solver's vocabulary; run allocate in-process")
+        n = len(node_names)
+        t = req.terms
+        static = terms.static
+        t.n_sigs = static.n_sigs
+        t.sig_pred.extend(
+            np.asarray(static.pred[:, :n], bool).reshape(-1).tolist())
+        t.sig_scores.extend(
+            np.asarray(static.score[:, :n], np.float32).reshape(-1).tolist())
+        t.task_sig.extend(static.sig_of[uid] for uid in tasks_by_uid)
+        if terms.dynamic.enabled:
+            t.least_requested_weight = terms.dynamic.least_requested
+            t.balanced_resource_weight = terms.dynamic.balanced_resource
+            for task in pending:
+                t.task_nz.extend(
+                    nz_request_vec(task.resreq.to_vec()).tolist())
+            t.node_nz.extend(
+                state.nz_requested[:n].reshape(-1).tolist())
+            t.allocatable_cm.extend(
+                state.allocatable[:n, :2].reshape(-1).tolist())
 
     def solve_and_apply(self, ssn: Session) -> solver_pb2.DecisionsResponse:
         """One remote solve; decisions replayed through the Session."""

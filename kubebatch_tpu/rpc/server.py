@@ -114,15 +114,40 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
             job_keys.append(K_DRF_SHARE)
     queue_keys = (K_PROP_SHARE,) if req.proportion_enabled else ()
 
-    # the wire protocol carries no predicate/score terms yet: trivial sig
-    # space (every task -> sig 0, all nodes allowed, dynamic terms off)
-    sig_scores = np.zeros((1, n_pad), np.float32)
-    sig_pred = np.ones((1, n_pad), bool)
+    # policy terms from the wire: sig-indexed predicate/score matrices +
+    # dynamic nodeorder config (PolicyTerms); absent fields fall back to
+    # the trivial space (all nodes allowed, zero scores, dynamics off)
+    terms = req.terms
+    n_sigs = max(1, terms.n_sigs)
+    s_pad = pad_to_bucket(n_sigs, 4)
+    sig_scores = np.zeros((s_pad, n_pad), np.float32)
+    sig_pred = np.zeros((s_pad, n_pad), bool)
+    if terms.n_sigs and len(terms.sig_pred):
+        sig_pred[:n_sigs, :n] = np.asarray(
+            terms.sig_pred, bool).reshape(n_sigs, n)
+        sig_scores[:n_sigs, :n] = np.asarray(
+            terms.sig_scores, np.float32).reshape(n_sigs, n)
+    else:
+        sig_pred[:1, :n] = True
     task_sig = np.zeros(t_pad, np.int32)
+    if len(terms.task_sig):
+        task_sig[:t] = terms.task_sig
+
+    dyn_weights = np.asarray([terms.least_requested_weight,
+                              terms.balanced_resource_weight], np.float32)
+    dyn_enabled = bool(dyn_weights.any())
     task_nz = np.zeros((t_pad, 2), np.float32)
     allocatable_cm = np.zeros((n_pad, 2), np.float32)
     nz_req0 = np.zeros((n_pad, 2), np.float32)
+    if dyn_enabled:
+        task_nz[:t] = np.asarray(terms.task_nz, np.float32).reshape(t, 2)
+        nz_req0[:n] = np.asarray(terms.node_nz, np.float32).reshape(n, 2)
+        allocatable_cm[:n] = np.asarray(
+            terms.allocatable_cm, np.float32).reshape(n, 2)
+
     j_alloc0 = np.zeros((j_pad, 3), np.float32)
+    if len(jobs.allocated):
+        j_alloc0[:j] = _mat(jobs.allocated, j)
 
     start = time.perf_counter()
     (host_block, *_device_state) = fused_allocate(
@@ -140,9 +165,11 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
         jnp.asarray(q_entries), jnp.asarray(q_create_rank),
         jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
         jnp.asarray(j_alloc0), jnp.asarray(cluster_total),
+        jnp.asarray(dyn_weights),
         job_keys=tuple(job_keys), queue_keys=queue_keys,
         gang_enabled=req.gang_enabled,
         prop_overused=req.proportion_enabled,
+        dyn_enabled=dyn_enabled,
         max_iters=int(t_pad + 3 * j_pad + q_pad + 8))
     solve_ms = (time.perf_counter() - start) * 1e3
     host_block = np.asarray(host_block)   # one device->host transfer

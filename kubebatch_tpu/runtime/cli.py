@@ -59,7 +59,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stop after N cycles (0 = run forever)")
     p.add_argument("--solver", default="",
                    choices=["", "auto", "host", "jax", "fused", "batched",
-                            "native"],
+                            "sharded", "native"],
                    help="override the allocate solver mode")
     return p
 

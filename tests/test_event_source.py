@@ -1,0 +1,248 @@
+"""Streaming event source + PV/PVC volume binder (sim/source.py) —
+the informer-style ingestion layer and the volume seams, driven through
+real scheduler cycles with failure injection (ref: cache.go:217-295
+informers; cache.go:164-184 volume binder; cache.go:494-513 resync).
+"""
+import threading
+import time
+
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.api import TaskStatus
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import PodPhase
+from kubebatch_tpu.runtime.scheduler import Scheduler
+from kubebatch_tpu.sim import (FlakyBinder, PersistentVolume,
+                               PersistentVolumeClaim, PVVolumeBinder,
+                               StorageClass, StreamingEventSource)
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+def tiers():
+    return [Tier(plugins=[PluginOption(name="priority"),
+                          PluginOption(name="gang")]),
+            Tier(plugins=[PluginOption(name="drf"),
+                          PluginOption(name="predicates"),
+                          PluginOption(name="proportion"),
+                          PluginOption(name="nodeorder")])]
+
+
+class RecordingBinder:
+    def __init__(self):
+        self.binds = {}
+
+    def bind(self, pod, hostname):
+        self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+        pod.node_name = hostname
+
+
+def test_list_watch_replay_builds_cache():
+    """LIST on start, WATCH for later events — same handlers, same state
+    as the direct push surface."""
+    src = StreamingEventSource()
+    src.emit_queue(build_queue("q1"))
+    src.emit_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+    src.emit_group(build_group("ns", "g", 2, queue="q1"))
+    for i in range(2):
+        src.emit_pod(build_pod("ns", f"g-{i}", "", PodPhase.PENDING,
+                               rl(1000, GiB), group="g"))
+
+    cache = SchedulerCache(binder=RecordingBinder(), async_writeback=False)
+    src.start(cache)
+    assert src.sync(5.0)
+    assert len(cache.nodes) == 1 and len(cache.jobs) == 1
+
+    # watch: a node + pods arriving AFTER start flow through the pump
+    src.emit_node(build_node("n2", rl(4000, 8 * GiB, pods=110)))
+    src.emit_pod(build_pod("ns", "g-2", "", PodPhase.PENDING,
+                           rl(1000, GiB), group="g"))
+    assert src.sync(5.0)
+    assert len(cache.nodes) == 2
+    assert sum(len(j.tasks) for j in cache.jobs.values()) == 3
+    src.stop()
+
+
+def test_injected_bind_failures_heal_through_resync():
+    """FlakyBinder fails the first attempt per pod; the rate-limited
+    err_tasks resync loop re-fetches ground truth from the source's
+    pod_lister and replays — all pods end up bound while the scheduler
+    loop keeps cycling (VERDICT r1 item 6)."""
+    real = RecordingBinder()
+    flaky = FlakyBinder(real, failures=1)
+    src = StreamingEventSource()
+    src.emit_queue(build_queue("q1"))
+    for n in range(4):
+        src.emit_node(build_node(f"n{n}", rl(4000, 8 * GiB, pods=110)))
+    for g in range(3):
+        src.emit_group(build_group("ns", f"g{g}", 2, queue="q1"))
+        for p in range(2):
+            src.emit_pod(build_pod("ns", f"g{g}-{p}", "", PodPhase.PENDING,
+                                   rl(1000, GiB), group=f"g{g}"))
+
+    cache = SchedulerCache(binder=flaky, async_writeback=True)
+    src.start(cache)
+    assert src.sync(5.0)
+    sched = Scheduler(cache, schedule_period=0.1)
+    stop = threading.Event()
+    t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and len(real.binds) < 6:
+            time.sleep(0.2)
+    finally:
+        stop.set()
+        t.join(timeout=20)
+        src.stop()
+        cache.stop()
+    assert not t.is_alive()
+    assert len(real.binds) == 6, (real.binds, flaky.attempts)
+    # every pod needed the injected failure + one successful retry
+    assert all(n >= 2 for n in flaky.attempts.values())
+
+
+def _volume_world():
+    vb = PVVolumeBinder(bind_timeout=30.0)
+    src = StreamingEventSource(volume_binder=vb)
+    src.emit_storage_class(StorageClass("standard"))
+    src.emit_queue(build_queue("q1"))
+    src.emit_node(build_node("n1", rl(8000, 16 * GiB, pods=110)))
+    src.emit_node(build_node("n2", rl(8000, 16 * GiB, pods=110)))
+    return vb, src
+
+
+def test_pv_binder_allocate_and_bind():
+    """Claims get fitting PVs at allocate, committed at bind; node-pinned
+    (local) volumes constrain placement host."""
+    vb, src = _volume_world()
+    src.emit_volume(PersistentVolume("pv-small", capacity_bytes=GiB))
+    src.emit_volume(PersistentVolume("pv-big", capacity_bytes=4 * GiB))
+    src.emit_claim(PersistentVolumeClaim("data", namespace="ns",
+                                         request_bytes=GiB))
+    src.emit_group(build_group("ns", "g", 1, queue="q1"))
+    pod = build_pod("ns", "g-0", "", PodPhase.PENDING, rl(1000, GiB),
+                    group="g")
+    pod.pvc_names = ["data"]
+    src.emit_pod(pod)
+
+    binder = RecordingBinder()
+    cache = SchedulerCache(binder=binder, volume_binder=vb,
+                           async_writeback=False)
+    src.start(cache)
+    assert src.sync(5.0)
+    ssn = OpenSession(cache, tiers())
+    AllocateAction(mode="host").execute(ssn)
+    CloseSession(ssn)
+    src.stop()
+
+    assert binder.binds == {"ns/g-0": binder.binds.get("ns/g-0")}
+    # smallest fitting volume was chosen and committed
+    assert vb.volumes["pv-small"].claim_ref == "ns/data"
+    assert vb.volumes["pv-big"].claim_ref == ""
+    assert vb.claims["ns/data"].volume_name == "pv-small"
+
+
+def test_pv_exhaustion_blocks_allocation():
+    """More claims than volumes: the extra pod cannot allocate volumes and
+    stays pending."""
+    vb, src = _volume_world()
+    src.emit_volume(PersistentVolume("pv-0", capacity_bytes=GiB))
+    for i in range(2):
+        src.emit_claim(PersistentVolumeClaim(f"c{i}", namespace="ns",
+                                             request_bytes=GiB))
+        src.emit_group(build_group("ns", f"g{i}", 1, queue="q1"))
+        pod = build_pod("ns", f"g{i}-0", "", PodPhase.PENDING,
+                        rl(1000, GiB), group=f"g{i}")
+        pod.pvc_names = [f"c{i}"]
+        src.emit_pod(pod)
+
+    binder = RecordingBinder()
+    cache = SchedulerCache(binder=binder, volume_binder=vb,
+                           async_writeback=False)
+    src.start(cache)
+    assert src.sync(5.0)
+    sched = Scheduler(cache, schedule_period=0.05)
+    sched.tiers = tiers()
+    sched.run_once()
+    src.stop()
+    assert len(binder.binds) == 1
+    bound_claims = {c.volume_name for c in vb.claims.values()
+                    if c.volume_name}
+    assert bound_claims == {"pv-0"}
+
+
+def test_bind_timeout_expires_assumption():
+    """An assumption older than the bind timeout raises at bind — the
+    reference's 30s volume-bind timeout semantics (cache.go:228)."""
+    now = [0.0]
+    vb = PVVolumeBinder(bind_timeout=30.0, clock=lambda: now[0])
+    vb.add_volume(PersistentVolume("pv", capacity_bytes=GiB))
+    vb.add_claim(PersistentVolumeClaim("c", namespace="ns",
+                                       request_bytes=GiB))
+    pod = build_pod("ns", "p", "", PodPhase.PENDING, rl(100, GiB))
+    pod.pvc_names = ["c"]
+    from kubebatch_tpu.api import TaskInfo
+    task = TaskInfo(pod)
+    vb.allocate_volumes(task, "n1")
+    now[0] = 31.0
+    with pytest.raises(RuntimeError, match="timed out"):
+        vb.bind_volumes(task)
+    # the expired assumption is dropped; a fresh allocate+bind succeeds
+    vb.allocate_volumes(task, "n1")
+    vb.bind_volumes(task)
+    assert vb.volumes["pv"].claim_ref == "ns/c"
+
+
+def test_stale_assumption_expires_and_pv_frees():
+    """A gang that never dispatches leaves an assumption behind; after the
+    bind timeout the PV is reusable by other pods (and by the same task on
+    re-allocation) instead of leaking forever."""
+    now = [0.0]
+    vb = PVVolumeBinder(bind_timeout=30.0, clock=lambda: now[0])
+    vb.add_volume(PersistentVolume("pv", capacity_bytes=GiB))
+    vb.add_claim(PersistentVolumeClaim("a", namespace="ns",
+                                       request_bytes=GiB))
+    vb.add_claim(PersistentVolumeClaim("b", namespace="ns",
+                                       request_bytes=GiB))
+    from kubebatch_tpu.api import TaskInfo
+    pod_a = build_pod("ns", "pa", "", PodPhase.PENDING, rl(100, GiB))
+    pod_a.pvc_names = ["a"]
+    task_a = TaskInfo(pod_a)
+    pod_b = build_pod("ns", "pb", "", PodPhase.PENDING, rl(100, GiB))
+    pod_b.pvc_names = ["b"]
+    task_b = TaskInfo(pod_b)
+
+    vb.allocate_volumes(task_a, "n1")      # assumes the only PV
+    # another pod cannot take it while the assumption is fresh
+    with pytest.raises(RuntimeError, match="no PersistentVolume"):
+        vb.allocate_volumes(task_b, "n1")
+    # the same task re-allocating replaces its own assumption
+    vb.allocate_volumes(task_a, "n2")
+    # after the timeout the stale assumption no longer reserves the PV
+    now[0] = 31.0
+    vb.allocate_volumes(task_b, "n1")
+    vb.bind_volumes(task_b)
+    assert vb.volumes["pv"].claim_ref == "ns/b"
+
+
+def test_lost_assumption_cannot_bind_volumeless():
+    """bind_volumes with claims but no assumption raises and resets
+    volume_ready — never a silent volume-less placement."""
+    vb = PVVolumeBinder()
+    vb.add_volume(PersistentVolume("pv", capacity_bytes=GiB))
+    vb.add_claim(PersistentVolumeClaim("c", namespace="ns",
+                                       request_bytes=GiB))
+    from kubebatch_tpu.api import TaskInfo
+    pod = build_pod("ns", "p", "", PodPhase.PENDING, rl(100, GiB))
+    pod.pvc_names = ["c"]
+    task = TaskInfo(pod)
+    vb.allocate_volumes(task, "n1")
+    vb.unassume(task)                      # e.g. placement rolled back
+    with pytest.raises(RuntimeError, match="re-allocate"):
+        vb.bind_volumes(task)
+    assert task.volume_ready is False

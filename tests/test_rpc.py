@@ -91,3 +91,87 @@ def test_sidecar_gang_barrier(sidecar):
     CloseSession(ssn)
     cache.drain(timeout=5.0)
     assert binder.binds == {}  # 3-gang cannot fit on a 2-slot node
+
+
+from kubebatch_tpu.conf import shipped_tiers as full_tiers  # noqa: E402
+
+
+def mk_policy_cluster():
+    """Selectors + taints + heterogeneous load so the wire must carry real
+    predicate masks and dynamic nodeorder inputs (not the trivial space)."""
+    from kubebatch_tpu.objects import Taint
+
+    binder = RecordingBinder()
+    cache = SchedulerCache(binder=binder, async_writeback=False)
+    cache.add_queue(build_queue("q1", 1))
+    cache.add_queue(build_queue("q2", 2))
+    for i in range(3):
+        cache.add_node(build_node(f"gpu{i}", rl(4000, 8 * GiB, pods=110),
+                                  labels={"pool": "gpu"}))
+    for i in range(3):
+        cache.add_node(build_node(f"cpu{i}", rl(4000, 8 * GiB, pods=110),
+                                  labels={"pool": "cpu"}))
+    cache.add_node(build_node("tainted", rl(8000, 16 * GiB, pods=110),
+                              labels={"pool": "cpu"},
+                              taints=[Taint("dedicated", "infra",
+                                            "NoSchedule")]))
+    # pre-existing load on cpu0 so least-requested scoring differentiates
+    cache.add_pod_group(build_group("ns", "fill", 1, queue="q1"))
+    cache.add_pod(build_pod("ns", "fill-0", "cpu0", PodPhase.RUNNING,
+                            rl(3000, 6 * GiB), group="fill"))
+    for g in range(4):
+        q = "q1" if g % 2 == 0 else "q2"
+        sel = {"pool": "gpu"} if g < 2 else {"pool": "cpu"}
+        cache.add_pod_group(build_group("ns", f"sel{g}", 2, queue=q,
+                                        creation_timestamp=float(g)))
+        for p in range(2):
+            cache.add_pod(build_pod(
+                "ns", f"sel{g}-p{p}", "", PodPhase.PENDING,
+                rl(1500, 2 * GiB), group=f"sel{g}",
+                node_selector=dict(sel)))
+    return cache, binder
+
+
+def test_sidecar_carries_predicates_and_scores(sidecar):
+    """Protocol parity: a cluster with node selectors, a tainted node and
+    dynamic nodeorder scoring solves identically over the wire and
+    in-process (SURVEY 2.9: 'int masks for predicates')."""
+    cache_a, binder_a = mk_policy_cluster()
+    ssn = OpenSession(cache_a, full_tiers())
+    AllocateAction(mode="fused").execute(ssn)
+    CloseSession(ssn)
+    cache_a.drain(timeout=5.0)
+
+    cache_b, binder_b = mk_policy_cluster()
+    ssn_b = OpenSession(cache_b, full_tiers())
+    resp = sidecar.solve_and_apply(ssn_b)
+    CloseSession(ssn_b)
+    cache_b.drain(timeout=5.0)
+
+    assert binder_a.binds == binder_b.binds
+    assert len(binder_b.binds) == 8
+    # selectors respected over the wire
+    for key, host in binder_b.binds.items():
+        if "sel0" in key or "sel1" in key:
+            assert host.startswith("gpu"), (key, host)
+        elif "sel" in key:
+            assert host.startswith("cpu"), (key, host)
+        assert host != "tainted"
+
+
+def test_sidecar_rejects_inexpressible_snapshot(sidecar):
+    """A snapshot with inter-pod affinity must raise, not silently solve
+    without the predicate."""
+    from kubebatch_tpu.objects import Affinity, PodAffinityTerm
+
+    cache, _ = mk_cluster()
+    cache.add_pod_group(build_group("ns", "pga", 1, queue="q1"))
+    pod = build_pod("ns", "aff-0", "", PodPhase.PENDING, rl(500, GiB),
+                    group="pga")
+    pod.affinity = Affinity(pod_anti_affinity_required=[
+        PodAffinityTerm(match_labels={"app": "x"})])
+    cache.add_pod(pod)
+    ssn = OpenSession(cache, full_tiers())
+    with pytest.raises(ValueError):
+        sidecar.snapshot_from_session(ssn)
+    CloseSession(ssn)

@@ -1,0 +1,124 @@
+"""Sharded batched engine vs the single-chip engine (GSPMD node-axis
+partitioning, kernels/batched_sharded.py) on the virtual 8-device CPU
+mesh — decisions must match exactly; the carry matches within reduction-
+order float noise (far below the resource epsilons).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.actions.cycle_inputs import build_cycle_inputs
+from kubebatch_tpu.api import TaskStatus
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.kernels.batched import solve_batched
+from kubebatch_tpu.kernels.batched_sharded import (node_mesh, shard_bucket,
+                                                   solve_batched_sharded)
+from kubebatch_tpu.objects import PodPhase
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+from kubebatch_tpu.conf import shipped_tiers  # noqa: E402
+
+
+def build_cluster(cache, n_nodes=24, n_groups=12, pods_per_group=4,
+                  n_queues=2, seed=0):
+    rng = np.random.default_rng(seed)
+    for q in range(n_queues):
+        cache.add_queue(build_queue(f"q{q}", weight=q + 1))
+    for i in range(n_nodes):
+        cpu = int(rng.integers(2, 8)) * 1000
+        cache.add_node(build_node(f"n{i:03d}", rl(cpu, 8 * GiB, pods=20)))
+    for g in range(n_groups):
+        name = f"g{g:03d}"
+        cache.add_pod_group(build_group("ns", name, max(1, pods_per_group - 1),
+                                        queue=f"q{g % n_queues}",
+                                        creation_timestamp=float(g)))
+        for p in range(pods_per_group):
+            cache.add_pod(build_pod(
+                "ns", f"{name}-{p}", "", PodPhase.PENDING,
+                rl(int(rng.integers(1, 4)) * 500, 2 * GiB), group=name,
+                priority=int(rng.integers(1, 5)),
+                creation_timestamp=float(g * 100 + p)))
+
+
+class _B:
+    def bind(self, pod, hostname):
+        pod.node_name = hostname
+
+
+def _open(seed):
+    cache = SchedulerCache(binder=_B(), async_writeback=False)
+    build_cluster(cache, seed=seed)
+    return OpenSession(cache, shipped_tiers())
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_sharded_decisions_match_single_device(seed):
+    ssn_a = _open(seed)
+    inputs_a = build_cycle_inputs(ssn_a)
+    st_a, nd_a, seq_a, _ = solve_batched(inputs_a.device, inputs_a,
+                                         compact_bucket=0)
+
+    ssn_b = _open(seed)
+    inputs_b = build_cycle_inputs(ssn_b)
+    st_b, nd_b, seq_b, _ = solve_batched_sharded(node_mesh(), inputs_b.device,
+                                                 inputs_b)
+
+    np.testing.assert_array_equal(st_a, st_b)
+    np.testing.assert_array_equal(seq_a, seq_b)
+    placed = np.isin(st_a, [1, 2, 3])
+    np.testing.assert_array_equal(nd_a[placed], nd_b[placed])
+    CloseSession(ssn_a)
+    CloseSession(ssn_b)
+
+
+def test_sharded_mode_end_to_end():
+    """KUBEBATCH_SOLVER=sharded through the action produces the same
+    session state as the batched mode."""
+    results = {}
+    for mode in ("batched", "sharded"):
+        ssn = _open(3)
+        AllocateAction(mode=mode).execute(ssn)
+        statuses = {t.key: (t.status, t.node_name)
+                    for job in ssn.jobs.values()
+                    for t in job.tasks.values()}
+        CloseSession(ssn)
+        results[mode] = statuses
+    assert results["sharded"] == results["batched"]
+
+
+def test_shard_bucket():
+    assert shard_bucket(5000, 8) == 8192
+    assert shard_bucket(8, 8) == 8
+    assert shard_bucket(9, 8) == 16
+    assert shard_bucket(24, 8) == 32
+    # non-power-of-two meshes terminate and get equal shards
+    assert shard_bucket(24, 6) == 36
+    assert shard_bucket(5000, 12) == 8196
+    assert shard_bucket(5000, 12) % 12 == 0
+
+
+@pytest.mark.skipif(not os.environ.get("KB_BIG_SMOKE"),
+                    reason="cfg5-shaped memory-layout smoke (set "
+                           "KB_BIG_SMOKE=1; several GB + minutes on CPU)")
+def test_cfg5_shape_smoke():
+    """The 10k x 5k stress layout compiles and runs one sharded cycle on
+    the 8-device CPU mesh — proves the partitioned memory layout, not
+    latency."""
+    from kubebatch_tpu.sim import baseline_cluster
+
+    sim = baseline_cluster(5)
+    cache = SchedulerCache(binder=_B(), async_writeback=False)
+    sim.populate(cache)
+    ssn = OpenSession(cache, shipped_tiers())
+    inputs = build_cycle_inputs(ssn)
+    st, nd, seq, rounds = solve_batched_sharded(node_mesh(), inputs.device,
+                                                inputs)
+    assert (np.isin(st[:len(inputs.tasks)], [1, 2])).sum() > 9000
+    CloseSession(ssn)

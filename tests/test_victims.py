@@ -21,14 +21,7 @@ from kubebatch_tpu.objects import PodPhase
 from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
 
 
-def shipped_tiers():
-    return [Tier(plugins=[PluginOption(name="priority"),
-                          PluginOption(name="gang"),
-                          PluginOption(name="conformance")]),
-            Tier(plugins=[PluginOption(name="drf"),
-                          PluginOption(name="predicates"),
-                          PluginOption(name="proportion"),
-                          PluginOption(name="nodeorder")])]
+from kubebatch_tpu.conf import shipped_tiers  # noqa: E402
 
 
 class Recorder:
