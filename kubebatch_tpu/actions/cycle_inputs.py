@@ -109,6 +109,7 @@ class CycleInputs:
     # task arrays ([T_pad])
     resreq: np.ndarray
     init_resreq: np.ndarray
+    resreq_raw: np.ndarray        # [T,R] f64 host units (bytes memory)
     task_nz: np.ndarray
     task_job: np.ndarray
     task_rank: np.ndarray
@@ -343,6 +344,7 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
     return CycleInputs(
         queue_ids=queue_ids, jobs=jobs, tasks=tasks, device=device,
         resreq=batch.resreq, init_resreq=batch.init_resreq,
+        resreq_raw=batch.resreq_raw,
         task_nz=batch.nz_req, task_job=task_job, task_rank=task_rank,
         task_sig=task_sig, task_valid=batch.valid,
         sig_scores=sig_scores, sig_pred=sig_pred,
@@ -446,7 +448,7 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
     PENDING -> ALLOCATED -> BINDING in one index move. Event-handler
     effects apply as per-job / per-queue sums afterwards."""
     from ..api import Resource
-    from ..api.types import TaskStatus, allocated_status
+    from ..api.types import TaskStatus
     from ..kernels.fused import ALLOC, ALLOC_OB, FAIL, PIPELINE
 
     device = inputs.device
@@ -496,60 +498,93 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
     int_alloc = int(ALLOC)
     jobs = ssn.jobs
     nodes = ssn.nodes
-    allocate_volumes = ssn.cache.allocate_volumes
-    bind_volumes = ssn.cache.bind_volumes
     pending = TaskStatus.PENDING
 
-    #: job uid -> summed resreq of this replay's allocate-events (drf view)
-    job_event_sum: Dict[str, Resource] = {}
+    # --- vectorized arithmetic: per-node / per-job float64 sums ---------
+    # The ordered path applies one Resource.add/sub per placement; the sums
+    # here are the same values in a different addition order (f64, far
+    # below the fit epsilons). Memory stays in BYTES via resreq_raw.
+    p_nodes = task_node[placed_sel].astype(np.int64)
+    p_jobs_idx = placed_job_idx.astype(np.int64)
+    is_pipe = placed_states == PIPELINE
+    n_cols = int(p_nodes.max()) + 1 if len(p_nodes) else 0
+    sub_idle = np.zeros((n_cols, 3))
+    sub_rel = np.zeros((n_cols, 3))
+    add_used = np.zeros((n_cols, 3))
+    p_raw = inputs.resreq_raw[placed_sel]
+    np.add.at(sub_idle, p_nodes[~is_pipe], p_raw[~is_pipe])
+    np.add.at(sub_rel, p_nodes[is_pipe], p_raw[is_pipe])
+    np.add.at(add_used, p_nodes, p_raw)
+    # job.allocated counts the allocated-status family: ALLOC stays in it
+    # whether or not it dispatches to BINDING (both allocated statuses)
+    is_alloc_ev2 = placed_states == ALLOC
+    j_cols = int(p_jobs_idx.max()) + 1 if len(p_jobs_idx) else 0
+    job_alloc_add = np.zeros((j_cols, 3))
+    np.add.at(job_alloc_add, p_jobs_idx[is_alloc_ev2], p_raw[is_alloc_ev2])
+    # event handlers see every placement (pipeline fires allocate events
+    # too, session.py:321) — keyed by placement COUNT, not value, so
+    # zero-resource placements still fire the epoch-memo handlers
+    job_event_add = np.zeros((j_cols, 3))
+    np.add.at(job_event_add, p_jobs_idx, p_raw)
+    job_event_cnt = np.bincount(p_jobs_idx, minlength=j_cols)
+
     #: job uid -> (JobInfo, job index) for jobs that saw >=1 ALLOC/ALLOC_OB
     alloc_jobs: Dict[str, tuple] = {}
     #: (task, hostname) for cache.bind_many, in assignment order
     bindings: List[tuple] = []
+    #: rare: backfill-annotated placements (per-task Resource add)
+    backfill_adds: List[tuple] = []
+
+    # --- pre-validation: resolve every lookup BEFORE any mutation so a
+    #     bad decision (vanished job/node, duplicate key) cannot leave the
+    #     batch half-applied with the arithmetic sums never landing -------
+    resolved = []
+    seen_keys: Dict[str, set] = {}
+    for i in placed_sel:
+        task = tasks[i]
+        kind = int(state[i])
+        node_name = device.node_name(int(task_node[i]))
+        node = nodes.get(node_name)
+        job = jobs.get(task.job)
+        if kind != int_pipeline:
+            if job is None:
+                raise KeyError(f"failed to find job {task.job}")
+            if node is None:
+                raise KeyError(f"failed to find node {node_name}")
+        if node is not None:
+            keys = seen_keys.setdefault(node_name, set())
+            if task.key in node.tasks or task.key in keys:
+                raise KeyError(f"task <{task.namespace}/{task.name}> "
+                               f"already on node <{node.name}>")
+            keys.add(task.key)
+        resolved.append((i, task, kind, node_name, node, job))
 
     try:
-        for i in placed_sel:
-            task = tasks[i]
-            kind = int(state[i])
+        for i, task, kind, node_name, node, job in resolved:
             new_status = status_of[kind]
-            node_name = device.node_name(int(task_node[i]))
-            node = nodes.get(node_name)
-            job = jobs.get(task.job)
             if kind != int_pipeline:
-                if job is None:
-                    raise KeyError(f"failed to find job {task.job}")
-                if node is None:
-                    raise KeyError(f"failed to find node {node_name}")
-                allocate_volumes(task, node_name)
+                # allocate_volumes: the bulk gate guarantees the Null
+                # volume binder, whose only effect is this flag
+                task.volume_ready = True
                 alloc_jobs.setdefault(job.uid,
                                       (job, int(inputs.task_job[i])))
 
             task.status = new_status
             task.node_name = node_name
 
-            # --- node accounting (NodeInfo.add_task, inlined; the node
-            #     clone carries allocation-time status, like the ordered
-            #     path where dispatch happens after add_task) ------------
+            # --- node task map (NodeInfo.add_task minus the arithmetic,
+            #     which the vectorized sums above cover; the node clone
+            #     carries allocation-time status, like the ordered path
+            #     where dispatch happens after add_task) -----------------
             if node is not None:
-                key = task.key
-                if key in node.tasks:
-                    raise KeyError(f"task <{task.namespace}/{task.name}> "
-                                   f"already on node <{node.name}>")
-                if node.node is not None:
-                    rr = task.resreq
-                    if task.is_backfill:
-                        node.backfilled.add(rr)
-                    if new_status is TaskStatus.PIPELINED:
-                        node.releasing.sub(rr)
-                    else:
-                        node.idle.sub(rr)
-                    node.used.add(rr)
-                node.tasks[key] = task.clone()
+                if task.is_backfill and node.node is not None:
+                    backfill_adds.append((node, task.resreq))
+                node.tasks[task.key] = task.clone()
 
             # --- dispatch decision + single job index move ---------------
             if (kind == int_alloc
                     and job_ready[inputs.task_job[i]]):
-                bind_volumes(task)
+                # bind_volumes is a no-op on the Null volume binder
                 bindings.append((task, node_name))
                 task.status = binding
             if job is not None:
@@ -565,15 +600,24 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
                 bucket[task.uid] = task
                 if task.pod.priority is not None:
                     job.priority = task.priority
-                if allocated_status(task.status):
-                    job.allocated.add(task.resreq)
 
-            # --- event-handler aggregate (allocate events fire for
-            #     pipeline too, session.py:321) -------------------------
-            acc = job_event_sum.get(task.job)
-            if acc is None:
-                acc = job_event_sum[task.job] = Resource.empty()
-            acc.add(task.resreq)
+        # --- apply the vectorized sums --------------------------------
+        for col in np.nonzero(add_used.any(axis=1))[0]:
+            node = nodes.get(device.node_name(int(col)))
+            if node is None or node.node is None:
+                continue
+            _sub_parts(node.idle, sub_idle[col])
+            _sub_parts(node.releasing, sub_rel[col])
+            _add_parts(node.used, add_used[col])
+        for node, rr in backfill_adds:
+            node.backfilled.add(rr)
+        job_event_sum: Dict[str, Resource] = {}
+        for col in np.nonzero(job_event_cnt)[0]:
+            job = inputs.jobs[int(col)]
+            _add_parts(job.allocated, job_alloc_add[col])
+            r = Resource.empty()
+            _add_parts(r, job_event_add[col])
+            job_event_sum[job.uid] = r
 
         if bindings:
             ssn.cache.bind_many(bindings)
@@ -586,6 +630,18 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
     except Exception:
         device.resync(ssn.nodes)
         raise
+
+
+def _sub_parts(res: "Resource", vec) -> None:
+    res.milli_cpu -= vec[0]
+    res.memory -= vec[1]
+    res.milli_gpu -= vec[2]
+
+
+def _add_parts(res: "Resource", vec) -> None:
+    res.milli_cpu += vec[0]
+    res.memory += vec[1]
+    res.milli_gpu += vec[2]
 
 
 def _observe_dispatch_latency(bindings) -> None:

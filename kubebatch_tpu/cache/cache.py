@@ -486,13 +486,31 @@ class SchedulerCache:
         and the per-bind lock/unlock churn dominates replay without this."""
         submits = []
         binding = TaskStatus.BINDING
+        #: hostname -> [cpu, mem, gpu] sums for one idle.sub/used.add per
+        #: node instead of per task (10k+ binds per cycle at cfg5; the
+        #: different addition order is float-immaterial vs the epsilons)
+        node_take: dict = {}
         with self._lock:
+            # resolve every lookup BEFORE mutating: a vanished pod or a
+            # duplicate key must reject the batch while the cache is still
+            # consistent (the deferred arithmetic below never half-applies)
+            resolved = []
+            seen_keys: dict = {}
             for ti, hostname in bindings:
                 job, task = self._find_job_and_task(ti)
                 node = self.nodes.get(hostname)
                 if node is None:
                     raise KeyError(f"failed to bind Task {task.uid} to host "
                                    f"{hostname}, host does not exist")
+                keys = seen_keys.setdefault(hostname, set())
+                if task.key in node.tasks or task.key in keys:
+                    raise KeyError(
+                        f"task <{task.namespace}/{task.name}> already on "
+                        f"node <{node.name}>")
+                keys.add(task.key)
+                resolved.append((job, task, node, hostname))
+
+            for job, task, node, hostname in resolved:
                 # update_task_status(task, BINDING), inlined for the batch:
                 # the stored task IS ti's cache twin, so the net-zero
                 # total_request ops drop out; Pending isn't an allocated
@@ -511,8 +529,31 @@ class SchedulerCache:
                     job.priority = task.priority
                 job.allocated.add(task.resreq)
                 task.node_name = hostname
-                node.add_task(task)
+                # NodeInfo.add_task minus the per-task arithmetic (batched
+                # into node_take below); Binding consumes idle
+                key = task.key
+                if node.node is not None:
+                    rr = task.resreq
+                    if task.is_backfill:
+                        node.backfilled.add(rr)
+                    acc = node_take.get(hostname)
+                    if acc is None:
+                        acc = node_take[hostname] = [0.0, 0.0, 0.0]
+                    acc[0] += rr.milli_cpu
+                    acc[1] += rr.memory
+                    acc[2] += rr.milli_gpu
+                node.tasks[key] = task.clone()
                 submits.append((task, task.pod, hostname))
+
+            for hostname, (cpu, mem, gpu) in node_take.items():
+                node = self.nodes[hostname]
+                idle, used = node.idle, node.used
+                idle.milli_cpu -= cpu
+                idle.memory -= mem
+                idle.milli_gpu -= gpu
+                used.milli_cpu += cpu
+                used.memory += mem
+                used.milli_gpu += gpu
 
         if self._pool is None:
             # sync mode: run inline without the per-task closure allocation

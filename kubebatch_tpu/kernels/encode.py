@@ -60,15 +60,24 @@ def _toleration_key(pod: Pod) -> Tuple:
                  for t in pod.tolerations)
 
 
+#: the signature of a pod with no selectors/affinity/tolerations — the
+#: overwhelmingly common shape; shared so the per-pod fast path is one
+#: truthiness check per field
+_EMPTY_SIG = ((), (), (), ())
+
+
 def task_signature(pod: Pod) -> Tuple:
     """Everything the static predicate/score terms read from the pod.
     Cached on the pod object — pod spec fields are immutable for the pod's
     lifetime, and this runs per pending task per cycle otherwise."""
     sig = getattr(pod, "_kb_sig", None)
     if sig is None:
-        na_req, na_pref = _node_affinity_keys(pod)
-        sig = (tuple(sorted(pod.node_selector.items())), na_req, na_pref,
-               _toleration_key(pod))
+        if not (pod.node_selector or pod.affinity or pod.tolerations):
+            sig = _EMPTY_SIG
+        else:
+            na_req, na_pref = _node_affinity_keys(pod)
+            sig = (tuple(sorted(pod.node_selector.items())), na_req,
+                   na_pref, _toleration_key(pod))
         pod._kb_sig = sig
     return sig
 

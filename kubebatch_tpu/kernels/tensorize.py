@@ -137,6 +137,10 @@ class TaskBatch:
     init_resreq: np.ndarray   # [T,R] launch request (fit checks)
     nz_req: np.ndarray        # [T,2] nonzero (cpu,mem) for dynamic scoring
     valid: np.ndarray         # [T] non-padded row
+    #: [T,R] float64 HOST units (memory in bytes) — the exact values the
+    #: Resource arithmetic uses; the bulk decision replay sums these per
+    #: node/job instead of calling per-task Resource methods
+    resreq_raw: np.ndarray = None
 
     @classmethod
     def from_tasks(cls, tasks: Sequence[TaskInfo],
@@ -147,6 +151,7 @@ class TaskBatch:
         init_resreq = np.zeros((t_pad, RESOURCE_DIM), np.float32)
         nz_req = np.zeros((t_pad, 2), np.float32)
         valid = np.zeros(t_pad, bool)
+        resreq_raw = np.zeros((t_pad, RESOURCE_DIM), np.float64)
         if t:
             # one tuple-comprehension pass (see NodeState.from_nodes)
             raw = np.array(
@@ -154,6 +159,7 @@ class TaskBatch:
                   tk.init_resreq.milli_cpu, tk.init_resreq.memory,
                   tk.init_resreq.milli_gpu) for tk in tasks],
                 np.float64).reshape(t, 2, RESOURCE_DIM)
+            resreq_raw[:t] = raw[:, 0]
             raw *= VEC_SCALE
             raw32 = raw.astype(np.float32)
             resreq[:t] = raw32[:, 0]
@@ -164,7 +170,8 @@ class TaskBatch:
                                      NONZERO_MEM_MIB)
             valid[:t] = True
         return cls(tasks=list(tasks), resreq=resreq,
-                   init_resreq=init_resreq, nz_req=nz_req, valid=valid)
+                   init_resreq=init_resreq, nz_req=nz_req, valid=valid,
+                   resreq_raw=resreq_raw)
 
     @property
     def t_padded(self) -> int:

@@ -291,3 +291,70 @@ def test_compact_continuation_equivalent_to_full_width():
     np.testing.assert_array_equal(ts_full, ts_c)
     np.testing.assert_array_equal(tn_full, tn_c)
     np.testing.assert_array_equal(tq_full, tq_c)
+
+
+def test_bulk_replay_state_matches_ordered():
+    """The vectorized bulk replay must leave the session in the same state
+    as the per-event ordered replay: identical task statuses/placements,
+    node accounting equal to float tolerance (the sums run in a different
+    addition order), identical job allocated totals."""
+    import numpy as np
+
+    from kubebatch_tpu.actions.cycle_inputs import (_replay_bulk,
+                                                    _replay_ordered,
+                                                    build_cycle_inputs)
+    from kubebatch_tpu.kernels.batched import solve_batched
+
+    def scenario():
+        rng = np.random.default_rng(11)
+        binder = RecordingBinder()
+        cache = SchedulerCache(binder=binder, async_writeback=False)
+        cache.add_queue(build_queue("q1"))
+        cache.add_queue(build_queue("q2", 2))
+        for i in range(12):
+            cache.add_node(build_node(
+                f"n{i:02d}", rl(float(rng.uniform(2000, 6000)),
+                                float(rng.uniform(4, 12)) * GiB, pods=20)))
+        for g in range(10):
+            cache.add_pod_group(build_group("ns", f"g{g}", 2,
+                                            queue=f"q{g % 2 + 1}",
+                                            creation_timestamp=float(g)))
+            for p in range(3):
+                cache.add_pod(build_pod(
+                    "ns", f"g{g}-{p}", "", "Pending",
+                    rl(float(rng.uniform(300, 1200)),
+                       float(rng.uniform(0.5, 2.5)) * GiB),
+                    group=f"g{g}", priority=int(rng.integers(1, 4)),
+                    backfill=(g == 3)))
+        ssn = OpenSession(cache, FULL_TIERS)
+        inputs = build_cycle_inputs(ssn)
+        st, nd, seq, _ = solve_batched(inputs.device, inputs,
+                                       compact_bucket=0)
+        return ssn, inputs, st, nd, seq, binder
+
+    states = {}
+    for name, replay in (("ordered", _replay_ordered),
+                         ("bulk", _replay_bulk)):
+        ssn, inputs, st, nd, seq, binder = scenario()
+        replay(ssn, inputs, st, nd, seq)
+        tasks = {t.key: (t.status, t.node_name)
+                 for j in ssn.jobs.values() for t in j.tasks.values()}
+        nodes = {n.name: (n.idle.milli_cpu, n.idle.memory,
+                          n.used.milli_cpu, n.used.memory,
+                          n.releasing.milli_cpu, n.backfilled.milli_cpu,
+                          len(n.tasks))
+                 for n in ssn.nodes.values()}
+        jobs = {j.uid: (j.allocated.milli_cpu, j.allocated.memory)
+                for j in ssn.jobs.values()}
+        states[name] = (tasks, nodes, jobs, dict(binder.binds))
+        CloseSession(ssn)
+
+    assert states["bulk"][0] == states["ordered"][0], "task states diverge"
+    assert states["bulk"][3] == states["ordered"][3], "binds diverge"
+    for scope in (1, 2):
+        b, o = states["bulk"][scope], states["ordered"][scope]
+        assert b.keys() == o.keys()
+        for k in b:
+            np.testing.assert_allclose(
+                np.asarray(b[k], float), np.asarray(o[k], float),
+                rtol=1e-9, atol=1e-3, err_msg=f"{k} accounting diverges")
