@@ -535,31 +535,33 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
     #: rare: backfill-annotated placements (per-task Resource add)
     backfill_adds: List[tuple] = []
 
-    # --- pre-validation: resolve every lookup BEFORE any mutation so a
-    #     bad decision (vanished job/node, duplicate key) cannot leave the
-    #     batch half-applied with the arithmetic sums never landing -------
-    resolved = []
-    seen_keys: Dict[str, set] = {}
-    for i in placed_sel:
-        task = tasks[i]
-        kind = int(state[i])
-        node_name = device.node_name(int(task_node[i]))
-        node = nodes.get(node_name)
-        job = jobs.get(task.job)
-        if kind != int_pipeline:
-            if job is None:
-                raise KeyError(f"failed to find job {task.job}")
-            if node is None:
-                raise KeyError(f"failed to find node {node_name}")
-        if node is not None:
-            keys = seen_keys.setdefault(node_name, set())
-            if task.key in node.tasks or task.key in keys:
-                raise KeyError(f"task <{task.namespace}/{task.name}> "
-                               f"already on node <{node.name}>")
-            keys.add(task.key)
-        resolved.append((i, task, kind, node_name, node, job))
-
     try:
+        # --- pre-validation: resolve every lookup BEFORE any mutation so
+        #     a bad decision (vanished job/node, duplicate key) cannot
+        #     leave the batch half-applied with the arithmetic sums never
+        #     landing; inside the try so the failure path still resyncs
+        #     the device snapshot (it holds the kernel's placements) ------
+        resolved = []
+        seen_keys: Dict[str, set] = {}
+        for i in placed_sel:
+            task = tasks[i]
+            kind = int(state[i])
+            node_name = device.node_name(int(task_node[i]))
+            node = nodes.get(node_name)
+            job = jobs.get(task.job)
+            if kind != int_pipeline:
+                if job is None:
+                    raise KeyError(f"failed to find job {task.job}")
+                if node is None:
+                    raise KeyError(f"failed to find node {node_name}")
+            if node is not None:
+                keys = seen_keys.setdefault(node_name, set())
+                if task.key in node.tasks or task.key in keys:
+                    raise KeyError(f"task <{task.namespace}/{task.name}> "
+                                   f"already on node <{node.name}>")
+                keys.add(task.key)
+            resolved.append((i, task, kind, node_name, node, job))
+
         for i, task, kind, node_name, node, job in resolved:
             new_status = status_of[kind]
             if kind != int_pipeline:
@@ -606,18 +608,17 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
             node = nodes.get(device.node_name(int(col)))
             if node is None or node.node is None:
                 continue
-            _sub_parts(node.idle, sub_idle[col])
-            _sub_parts(node.releasing, sub_rel[col])
-            _add_parts(node.used, add_used[col])
+            node.idle.sub_vec(sub_idle[col])
+            node.releasing.sub_vec(sub_rel[col])
+            node.used.add_vec(add_used[col])
         for node, rr in backfill_adds:
             node.backfilled.add(rr)
         job_event_sum: Dict[str, Resource] = {}
         for col in np.nonzero(job_event_cnt)[0]:
             job = inputs.jobs[int(col)]
-            _add_parts(job.allocated, job_alloc_add[col])
-            r = Resource.empty()
-            _add_parts(r, job_event_add[col])
-            job_event_sum[job.uid] = r
+            job.allocated.add_vec(job_alloc_add[col])
+            job_event_sum[job.uid] = Resource.empty().add_vec(
+                job_event_add[col])
 
         if bindings:
             ssn.cache.bind_many(bindings)
@@ -630,18 +631,6 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
     except Exception:
         device.resync(ssn.nodes)
         raise
-
-
-def _sub_parts(res: "Resource", vec) -> None:
-    res.milli_cpu -= vec[0]
-    res.memory -= vec[1]
-    res.milli_gpu -= vec[2]
-
-
-def _add_parts(res: "Resource", vec) -> None:
-    res.milli_cpu += vec[0]
-    res.memory += vec[1]
-    res.milli_gpu += vec[2]
 
 
 def _observe_dispatch_latency(bindings) -> None:

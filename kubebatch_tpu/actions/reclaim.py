@@ -29,6 +29,15 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn: Session) -> None:
+        # cross-queue reclaim needs at least two distinct queues among the
+        # session's jobs/queues; with one, no task can ever be a victim
+        # (the filter requires a DIFFERENT queue) — observably a no-op,
+        # skipped before paying the solver build
+        queue_names = set(ssn.queues)
+        queue_names.update(j.queue for j in ssn.jobs.values())
+        if len(queue_names) <= 1:
+            return
+
         from ..kernels.victims import build_action_solver
         solver = build_action_solver(ssn, "reclaimable_fns",
                                      "reclaimable_disabled",

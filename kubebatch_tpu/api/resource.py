@@ -119,6 +119,21 @@ class Resource:
             self.milli_gpu -= rr.milli_gpu + MIN_MILLI_GPU
         return self
 
+    def add_vec(self, vec) -> "Resource":
+        """In-place add of a [cpu_milli, mem, gpu_milli] triple in HOST
+        units — the bulk decision replays apply per-node/per-job numpy
+        sums through this instead of hand-unrolling the axis order."""
+        self.milli_cpu += vec[0]
+        self.memory += vec[1]
+        self.milli_gpu += vec[2]
+        return self
+
+    def sub_vec(self, vec) -> "Resource":
+        self.milli_cpu -= vec[0]
+        self.memory -= vec[1]
+        self.milli_gpu -= vec[2]
+        return self
+
     # --- non-mutating sugar ----------------------------------------------
     def plus(self, rr: "Resource") -> "Resource":
         return self.clone().add(rr)

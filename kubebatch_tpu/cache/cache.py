@@ -545,15 +545,10 @@ class SchedulerCache:
                 node.tasks[key] = task.clone()
                 submits.append((task, task.pod, hostname))
 
-            for hostname, (cpu, mem, gpu) in node_take.items():
+            for hostname, take in node_take.items():
                 node = self.nodes[hostname]
-                idle, used = node.idle, node.used
-                idle.milli_cpu -= cpu
-                idle.memory -= mem
-                idle.milli_gpu -= gpu
-                used.milli_cpu += cpu
-                used.memory += mem
-                used.milli_gpu += gpu
+                node.idle.sub_vec(take)
+                node.used.add_vec(take)
 
         if self._pool is None:
             # sync mode: run inline without the per-task closure allocation

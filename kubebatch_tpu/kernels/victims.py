@@ -60,7 +60,9 @@ import numpy as np
 from ..api import NodeInfo, TaskInfo, TaskStatus, ready_statuses
 from ..api.resource import RESOURCE_DIM
 from .solver import dynamic_node_score
-from .tensorize import VEC_EPS, nz_request_vec, pad_to_bucket
+from .tensorize import (NONZERO_MEM_MIB, NONZERO_MILLI_CPU, VEC_EPS,
+                        nz_request_vec, pad_to_bucket)
+from ..api.resource import VEC_SCALE
 
 _IMAX = jnp.iinfo(jnp.int32).max
 _READY = None
@@ -283,16 +285,30 @@ class VictimState:
         self.n_pad = n_pad
         # mutable node mirrors, rebuilt from HOST truth (earlier actions in
         # the session — allocate — have mutated nodes since the device
-        # snapshot was tensorized)
+        # snapshot was tensorized). One tuple-comprehension pass + vector
+        # math instead of per-task array allocations (10k+ node tasks at
+        # the stress configs).
         self.nz_req = np.zeros((n_pad, 2), np.float32)
         self.n_tasks = np.zeros(n_pad, np.int32)
+        rows = []
         for name, node in ssn.nodes.items():
             ni = node_index.get(name)
             if ni is None:
                 continue
             self.n_tasks[ni] = len(node.tasks)
-            for t in node.tasks.values():
-                self.nz_req[ni] += nz_request_vec(t.resreq.to_vec())
+            rows.extend((ni, t.resreq.milli_cpu, t.resreq.memory)
+                        for t in node.tasks.values())
+        if rows:
+            arr = np.asarray(rows, np.float64)
+            idx = arr[:, 0].astype(np.int64)
+            nz = np.empty((len(rows), 2), np.float64)
+            nz[:, 0] = np.where(arr[:, 1] != 0, arr[:, 1],
+                                NONZERO_MILLI_CPU)
+            mem_mib = arr[:, 2] / (1024.0 * 1024.0)
+            nz[:, 1] = np.where(mem_mib != 0, mem_mib, NONZERO_MEM_MIB)
+            acc = np.zeros((n_pad, 2), np.float64)
+            np.add.at(acc, idx, nz)
+            self.nz_req = acc.astype(np.float32)
         self.node_ok = node_ok
         self.max_task_num = max_task_num
         self.allocatable_cm = allocatable_cm
@@ -355,7 +371,8 @@ class VictimState:
                 self.victims.append(_Victim(task, ni, ji))
                 v_node.append(ni)
                 v_job.append(ji)
-                v_res.append(task.resreq.to_vec())
+                rr = task.resreq
+                v_res.append((rr.milli_cpu, rr.memory, rr.milli_gpu))
                 cls = task.pod.priority_class_name
                 v_crit.append(cls in (SYSTEM_CLUSTER_CRITICAL,
                                       SYSTEM_NODE_CRITICAL)
@@ -371,7 +388,9 @@ class VictimState:
         if v:
             self.v_node[:v] = v_node
             self.v_job[:v] = v_job
-            self.v_res[:v] = v_res
+            # host units -> device units in one pass (to_vec semantics)
+            self.v_res[:v] = (np.asarray(v_res, np.float64)
+                              * VEC_SCALE).astype(np.float32)
             self.v_critical[:v] = v_crit
             self.v_live[:v] = v_live
         # pad rows sort to the last node with live=False — harmless
