@@ -15,7 +15,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -24,35 +23,24 @@ import numpy as np
 
 def ensure_responsive_backend(timeout: float = 120.0) -> str:
     """The TPU tunnel can wedge so hard that backend init blocks forever
-    (a bare device query hangs). Probe it in a SUBPROCESS with a timeout;
-    on failure, flip THIS process to the CPU backend before any device
-    query happens (jax is preloaded but uninitialized, so the platform
-    can still be switched). A slow recorded number beats a hung driver."""
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.numpy.zeros(()).block_until_ready(); "
-             "print(jax.default_backend())"],
-            timeout=timeout, check=True, capture_output=True, text=True)
-        # report the platform the run will actually measure on
-        return probe.stdout.strip() or "unknown"
-    except Exception:
-        import jax
+    (a bare device query hangs). The shared watchdog probes in an
+    abandonable subprocess and flips THIS process to the CPU backend on
+    failure (jax is preloaded but uninitialized, so the platform can
+    still be switched). A slow recorded number beats a hung driver."""
+    from kubebatch_tpu.runtime.watchdog import \
+        ensure_responsive_backend as probe
 
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except RuntimeError:
-            # backend already initialized on the wedged platform — running
-            # would hang forever; fail loudly with a parseable line
-            print(json.dumps({"metric": "sched_cycle_p50_ms",
-                              "value": -1.0, "unit": "ms",
-                              "vs_baseline": 0.0,
-                              "error": "accelerator backend unresponsive "
-                                       "and platform pinned"}))
-            sys.exit(1)
-        print("bench: accelerator backend unresponsive, falling back to "
-              "CPU", file=sys.stderr)
-        return "cpu-fallback"
+    backend = probe(timeout, skip_env=None)   # the bench always probes
+    if backend == "pinned":
+        # backend already initialized on the wedged platform — running
+        # would hang forever; fail loudly with a parseable line
+        print(json.dumps({"metric": "sched_cycle_p50_ms",
+                          "value": -1.0, "unit": "ms",
+                          "vs_baseline": 0.0,
+                          "error": "accelerator backend unresponsive "
+                                   "and platform pinned"}))
+        sys.exit(1)
+    return backend
 
 
 #: per-config action order (BASELINE.md scenarios; cfg4/cfg5 use the

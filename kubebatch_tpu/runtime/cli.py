@@ -85,6 +85,11 @@ def main(argv=None) -> int:
     if args.solver:
         os.environ["KUBEBATCH_SOLVER"] = args.solver
 
+    # accelerator wedge watchdog: a hung transport must degrade the daemon
+    # to host scheduling, not hang its first kernel dispatch forever
+    from .watchdog import ensure_responsive_backend
+    ensure_responsive_backend()
+
     from ..cache import SchedulerCache
     from ..sim import baseline_cluster
     from .scheduler import Scheduler

@@ -1,0 +1,74 @@
+"""Accelerator wedge watchdog — shared by bench.py and the CLI daemon.
+
+A hung accelerator transport can block the FIRST device query forever
+(backend init never returns), which would wedge a scheduler daemon at
+its first kernel dispatch with no error and no cycles. The probe runs
+the device query in a SUBPROCESS so the parent can abandon it: a child
+stuck in an uninterruptible driver call cannot be reaped, so on timeout
+it is killed best-effort and left un-waited (start_new_session keeps it
+out of our process group; the zombie is collected when this process
+exits).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+PROBE_SRC = ("import jax; jax.numpy.zeros(()).block_until_ready(); "
+             "print(jax.default_backend())")
+
+
+def probe_backend(timeout: float = 60.0) -> Tuple[str, str]:
+    """Run the device probe in an abandonable subprocess.
+
+    Returns (status, detail): status is "ok" | "timeout" | "error";
+    detail is the backend name for "ok", or the tail of the child's
+    stderr for "error" (so a broken install is reported as what it is,
+    not as an unresponsive device).
+    """
+    proc = subprocess.Popen(
+        [sys.executable, "-c", PROBE_SRC],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    deadline = time.monotonic() + timeout
+    while proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    if proc.poll() is None:
+        proc.kill()   # pends if the child is in D state; do NOT reap
+        return "timeout", ""
+    out, err = proc.communicate()   # child exited; reaping is safe
+    if proc.returncode == 0:
+        return "ok", (out or "").strip() or "unknown"
+    return "error", (err or "").strip()[-400:]
+
+
+def ensure_responsive_backend(timeout: float = 60.0,
+                              skip_env: Optional[str] =
+                              "KUBEBATCH_NO_BACKEND_PROBE") -> str:
+    """Probe the default backend; on timeout/failure flip THIS process to
+    the host platform before any device query happens (jax may be
+    imported but must be uninitialized).
+
+    Returns the probed backend name, or "cpu-fallback" (flipped),
+    "pinned" (flip impossible — running would hang), or "skipped"
+    (``skip_env`` set; tests and CPU-only runs).
+    """
+    if skip_env and os.environ.get(skip_env):
+        return "skipped"
+    status, detail = probe_backend(timeout)
+    if status == "ok":
+        return detail
+    if status == "error" and detail:
+        print(f"backend probe failed:\n{detail}", file=sys.stderr)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        return "pinned"
+    print("accelerator backend unresponsive; continuing on the host "
+          "platform", file=sys.stderr)
+    return "cpu-fallback"

@@ -24,3 +24,7 @@ assert jax.devices()[0].platform == "cpu", (
     "tests must run on the host CPU backend, got "
     f"{jax.devices()[0].platform!r}")
 assert len(jax.devices()) >= 8, "expected an 8-device virtual CPU mesh"
+
+# the CLI's accelerator-wedge watchdog probes a subprocess; pointless (and
+# slow) under the pinned-CPU test environment
+os.environ.setdefault("KUBEBATCH_NO_BACKEND_PROBE", "1")
