@@ -1,0 +1,121 @@
+"""Consistency audit — invariant checks over cache/session state.
+
+The reference leans on Go's race detector plus design discipline (one
+mutex, snapshot isolation — SURVEY §5 "race detection"); the equivalent
+operational tool here is an explicit auditor: walk the live maps and
+verify the arithmetic invariants that every mutation path (event
+handlers, decision replays, resync repairs) is supposed to preserve.
+Tests call it between cycles; operators can call it from a REPL against
+a wedged scheduler to localize drift.
+
+Checked invariants:
+- node: allocatable - idle == used - pipelined_sum (+/- eps; Pipelined
+  tasks consume releasing, not idle); used equals the resreq sum of the
+  node's task map; releasing equals the sum over RELEASING tasks minus
+  PIPELINED reuse; task_map keys are unique by construction.
+- job: allocated equals the resreq sum over allocated-status tasks;
+  total_request equals the sum over all tasks; the status double-index
+  is consistent (every task bucketed exactly once, under its own status).
+- cross: every node-map task has a cache twin in some job with a
+  compatible status, and bound tasks' node_name matches the node.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .api import allocated_status
+from .api.types import TaskStatus
+
+#: float slack for audit comparisons — far below the scheduling epsilons
+#: (10 milli-cpu / 10 MiB), far above f64 noise from vectorized sums
+_EPS_CPU = 1e-3
+_EPS_MEM = 64.0
+
+
+def _close(a: float, b: float, eps: float) -> bool:
+    return abs(a - b) <= eps
+
+
+def audit_cache(cache) -> List[str]:
+    """Returns a list of human-readable violations (empty = consistent)."""
+    problems: List[str] = []
+
+    for name, node in cache.nodes.items():
+        if node.node is None:
+            continue            # placeholder node: no accounting contract
+        used_cpu = used_mem = 0.0
+        rel_cpu = 0.0
+        pipe_cpu = 0.0
+        for t in node.tasks.values():
+            used_cpu += t.resreq.milli_cpu
+            used_mem += t.resreq.memory
+            if t.status == TaskStatus.RELEASING:
+                rel_cpu += t.resreq.milli_cpu
+            elif t.status == TaskStatus.PIPELINED:
+                rel_cpu -= t.resreq.milli_cpu
+                pipe_cpu += t.resreq.milli_cpu
+        if not _close(node.used.milli_cpu, used_cpu, _EPS_CPU):
+            problems.append(
+                f"node {name}: used.cpu {node.used.milli_cpu:.3f} != "
+                f"task sum {used_cpu:.3f}")
+        if not _close(node.used.memory, used_mem, _EPS_MEM):
+            problems.append(
+                f"node {name}: used.mem {node.used.memory:.0f} != "
+                f"task sum {used_mem:.0f}")
+        if not _close(node.releasing.milli_cpu, rel_cpu, _EPS_CPU):
+            problems.append(
+                f"node {name}: releasing.cpu {node.releasing.milli_cpu:.3f}"
+                f" != releasing-pipelined sum {rel_cpu:.3f}")
+        # the exact identity add_task/remove_task maintain: every task
+        # consumes idle EXCEPT a Pipelined one, which consumes releasing —
+        # so allocatable - idle == used - pipelined_sum
+        lhs = node.allocatable.milli_cpu - node.idle.milli_cpu
+        rhs = node.used.milli_cpu - pipe_cpu
+        if not _close(lhs, rhs, _EPS_CPU):
+            problems.append(
+                f"node {name}: allocatable-idle {lhs:.3f} != "
+                f"used-pipelined {rhs:.3f}")
+
+    for uid, job in cache.jobs.items():
+        alloc_cpu = total_cpu = 0.0
+        for t in job.tasks.values():
+            total_cpu += t.resreq.milli_cpu
+            if allocated_status(t.status):
+                alloc_cpu += t.resreq.milli_cpu
+        if not _close(job.allocated.milli_cpu, alloc_cpu, _EPS_CPU):
+            problems.append(
+                f"job {uid}: allocated.cpu {job.allocated.milli_cpu:.3f} "
+                f"!= task sum {alloc_cpu:.3f}")
+        if not _close(job.total_request.milli_cpu, total_cpu, _EPS_CPU):
+            problems.append(
+                f"job {uid}: total_request.cpu "
+                f"{job.total_request.milli_cpu:.3f} != {total_cpu:.3f}")
+        indexed = 0
+        for status, bucket in job.task_status_index.items():
+            for t_uid, t in bucket.items():
+                indexed += 1
+                if t.status != status:
+                    problems.append(
+                        f"job {uid}: task {t_uid} bucketed {status} but "
+                        f"carries {t.status}")
+                if job.tasks.get(t_uid) is not t:
+                    problems.append(
+                        f"job {uid}: task {t_uid} index entry is not the "
+                        f"stored task")
+        if indexed != len(job.tasks):
+            problems.append(
+                f"job {uid}: status index holds {indexed} tasks, map "
+                f"holds {len(job.tasks)}")
+
+    for name, node in cache.nodes.items():
+        for key, t in node.tasks.items():
+            job = cache.jobs.get(t.job)
+            if job is None:
+                continue        # job GC'd while node copy lingers is legal
+            twin = job.tasks.get(t.uid)
+            if twin is not None and twin.node_name \
+                    and twin.node_name != name:
+                problems.append(
+                    f"task {key}: on node {name} but twin says "
+                    f"{twin.node_name}")
+    return problems

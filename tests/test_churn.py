@@ -1,0 +1,112 @@
+"""Multi-cycle churn e2e: pods arrive, run, finish and get evicted while
+the full shipped action pipeline cycles — the incremental paths (cache
+handlers, streaming source, decision replays, resync) must hold the
+accounting invariants (kubebatch_tpu/debug.audit_cache) at every cycle
+boundary. The sim kubelet completes binds into Running and finishes
+evictions like the reference's DIND e2e environment would.
+"""
+import numpy as np
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.actions.backfill import BackfillAction
+from kubebatch_tpu.actions.preempt import PreemptAction
+from kubebatch_tpu.actions.reclaim import ReclaimAction
+from kubebatch_tpu.api import TaskStatus
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.debug import audit_cache
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import PodPhase
+from kubebatch_tpu.sim import StreamingEventSource
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+class Kubelet:
+    """Bind/evict seam that completes asynchronously via the event source,
+    like a real kubelet + API server would."""
+
+    def __init__(self, src: StreamingEventSource):
+        self.src = src
+        self.binds = {}
+        self.evicted = []
+
+    def bind(self, pod, hostname):
+        self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+        old = pod  # the source's truth object IS the pod here
+        pod.node_name = hostname
+        pod.phase = PodPhase.RUNNING
+        self.src.emit_pod_update(old, pod)
+
+    def evict(self, pod):
+        self.evicted.append(f"{pod.namespace}/{pod.name}")
+        pod.deletion_timestamp = 1.0
+
+    def finish_evictions(self, cache):
+        for job in list(cache.jobs.values()):
+            for task in list(job.tasks.values()):
+                if task.status == TaskStatus.RELEASING:
+                    self.src.emit_pod_delete(task.pod)
+
+
+def test_churn_30_cycles_accounting_holds():
+    rng = np.random.default_rng(42)
+    src = StreamingEventSource()
+    kubelet = Kubelet(src)
+    cache = SchedulerCache(binder=kubelet, evictor=kubelet,
+                           async_writeback=False)
+
+    src.emit_queue(build_queue("q1", weight=1))
+    src.emit_queue(build_queue("q2", weight=3))
+    for n in range(12):
+        src.emit_node(build_node(
+            f"n{n:02d}", rl(4000, 8 * GiB, pods=16)))
+    src.start(cache)
+    assert src.sync(5.0)
+
+    acts = [ReclaimAction(), AllocateAction(), BackfillAction(),
+            PreemptAction()]
+    next_group = 0
+    live_groups = []
+
+    for cycle in range(30):
+        # churn: a couple of new gangs arrive each cycle
+        for _ in range(int(rng.integers(1, 3))):
+            g = f"g{next_group:03d}"
+            size = int(rng.integers(1, 4))
+            src.emit_group(build_group("ns", g, max(1, size - 1),
+                                       queue=f"q{next_group % 2 + 1}",
+                                       creation_timestamp=float(cycle)))
+            for p in range(size):
+                src.emit_pod(build_pod(
+                    "ns", f"{g}-{p}", "", PodPhase.PENDING,
+                    rl(int(rng.integers(1, 4)) * 500,
+                       int(rng.integers(1, 3)) * GiB),
+                    group=g, priority=int(rng.integers(1, 5)),
+                    creation_timestamp=float(cycle * 100 + p)))
+            live_groups.append(g)
+            next_group += 1
+        # churn: sometimes a running pod finishes (delete event)
+        if live_groups and rng.random() < 0.5:
+            g = live_groups[int(rng.integers(0, len(live_groups)))]
+            for key, pod in list(src.pods.items()):
+                if pod.name.startswith(g) and pod.phase == PodPhase.RUNNING:
+                    src.emit_pod_delete(pod)
+                    break
+        assert src.sync(5.0)
+
+        ssn = OpenSession(cache, shipped_tiers())
+        for act in acts:
+            act.execute(ssn)
+        CloseSession(ssn)
+        kubelet.finish_evictions(cache)
+        assert src.sync(5.0)
+
+        problems = audit_cache(cache)
+        assert not problems, f"cycle {cycle}: {problems[:5]}"
+
+    assert len(kubelet.binds) > 20, "churn must schedule work"
+    # capacity sanity at the end
+    for node in cache.nodes.values():
+        assert node.idle.milli_cpu >= -1e-3, (node.name, node.idle)

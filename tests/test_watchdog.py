@@ -1,0 +1,56 @@
+"""The accelerator-wedge watchdog (runtime/watchdog.py): every branch of
+the probe, with swapped probe sources standing in for healthy, broken,
+and wedged backends."""
+import time
+
+import pytest
+
+from kubebatch_tpu.runtime.watchdog import (ensure_responsive_backend,
+                                            probe_backend)
+
+
+def test_probe_ok():
+    status, detail = probe_backend(timeout=30.0,
+                                   probe_src="print('fakebackend')")
+    assert status == "ok" and detail == "fakebackend"
+
+
+def test_probe_error_surfaces_stderr():
+    status, detail = probe_backend(
+        timeout=30.0,
+        probe_src="import sys; sys.stderr.write('boom: no driver'); "
+                  "sys.exit(3)")
+    assert status == "error"
+    assert "boom: no driver" in detail
+
+
+def test_probe_error_with_chatty_child_does_not_hang():
+    """>64 KiB of child output must not fill a pipe and turn an error
+    into a timeout (output goes to temp files)."""
+    t0 = time.monotonic()
+    status, detail = probe_backend(
+        timeout=30.0,
+        probe_src="import sys; sys.stderr.write('x' * 300000); "
+                  "sys.exit(1)")
+    assert status == "error"
+    assert time.monotonic() - t0 < 10.0, "chatty child blocked the probe"
+
+
+def test_probe_timeout_abandons_child():
+    t0 = time.monotonic()
+    status, detail = probe_backend(timeout=1.0,
+                                   probe_src="import time; time.sleep(60)")
+    assert status == "timeout"
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_skip_env(monkeypatch):
+    monkeypatch.setenv("KB_TEST_SKIP_PROBE", "1")
+    assert ensure_responsive_backend(
+        skip_env="KB_TEST_SKIP_PROBE") == "skipped"
+
+
+def test_ok_passthrough():
+    assert ensure_responsive_backend(
+        timeout=30.0, skip_env=None,
+        probe_src="print('cpu')") == "cpu"
