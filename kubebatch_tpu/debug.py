@@ -113,8 +113,13 @@ def audit_cache(cache) -> List[str]:
             if job is None:
                 continue        # job GC'd while node copy lingers is legal
             twin = job.tasks.get(t.uid)
-            if twin is not None and twin.node_name \
-                    and twin.node_name != name:
+            if twin is None:
+                # the job exists but lost the task while the node kept its
+                # copy — the leak class this cross-check exists to catch
+                problems.append(
+                    f"task {key}: on node {name} but missing from live "
+                    f"job {t.job}")
+            elif twin.node_name and twin.node_name != name:
                 problems.append(
                     f"task {key}: on node {name} but twin says "
                     f"{twin.node_name}")

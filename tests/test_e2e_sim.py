@@ -359,3 +359,100 @@ def test_least_requested_spreads_across_nodes_end_to_end():
     assert len(kubelet.binds) == 4
     used_nodes = set(kubelet.binds.values())
     assert len(used_nodes) >= 3, f"pods stacked: {kubelet.binds}"
+
+
+def test_multiple_preemption_across_nodes():
+    """'Multiple Preemption' (job.go): several high-priority gangs arrive
+    at once on a full multi-node cluster; victims fall across several
+    nodes and every gang ends Running."""
+    kubelet, cache, sched = make_env(conf=FULL_CONF, enable_preemption=True)
+    for i in range(3):
+        cache.add_node(build_node(f"n{i}", rl(4000, 8 * GiB, pods=110)))
+    add_job(cache, "filler", 12, 1, rl(1000, GiB), priority=1)
+    cycles(sched, kubelet, 2)
+    assert len(kubelet.binds) == 12         # cluster full
+    kubelet.binds.clear()
+    for g in range(2):
+        add_job(cache, f"vip{g}", 3, 3, rl(1000, GiB), priority=100)
+    cycles(sched, kubelet, 5)
+    for g in range(2):
+        bound = [k for k in kubelet.binds if k.startswith(f"e2e/vip{g}")]
+        assert len(bound) == 3, (g, sorted(kubelet.binds))
+    # victims were spread over more than one node
+    vip_hosts = {v for k, v in kubelet.binds.items() if "vip" in k}
+    assert len(vip_hosts) >= 2
+    running = [t for j in cache.jobs.values() for t in j.tasks.values()
+               if t.pod.phase == PodPhase.RUNNING]
+    per_node = {}
+    for t in running:
+        per_node[t.node_name] = per_node.get(t.node_name, 0) \
+            + t.resreq.milli_cpu
+    assert all(v <= 4000 for v in per_node.values()), per_node
+
+
+def test_statement_discard_keeps_victims_running():
+    """'Statement' (job.go): a preemptor gang that can NEVER reach
+    readiness (needs more than the whole cluster) must roll its statement
+    back — no victim is actually evicted, the low job keeps running."""
+    kubelet, cache, sched = make_env(conf=FULL_CONF, enable_preemption=True)
+    cache.add_node(build_node("n0", rl(4000, 8 * GiB, pods=110)))
+    add_job(cache, "steady", 4, 4, rl(1000, GiB), priority=1)
+    cycles(sched, kubelet, 2)
+    assert len(kubelet.binds) == 4
+    kubelet.binds.clear()
+    # 6 x 1000m with min_member=6 cannot fit a 4000m cluster even after
+    # evicting everything -> phase-1 statements always discard
+    add_job(cache, "huge", 6, 6, rl(1000, GiB), priority=100)
+    cycles(sched, kubelet, 4)
+    assert not any("huge" in k for k in kubelet.binds)
+    steady = [t for j in cache.jobs.values() for t in j.tasks.values()
+              if t.name.startswith("steady")
+              and t.pod.phase == PodPhase.RUNNING]
+    assert len(steady) == 4, "statement discard must keep victims running"
+    pg = cache.jobs["e2e/huge"].pod_group
+    assert pg.status.phase == PodGroupPhase.PENDING
+
+
+def test_hostport_conflict_spreads_pods():
+    """'Hostport' (predicates.go:29-193 scenario family): two pods
+    claiming the same host port cannot share a node; a third stays
+    pending when no port-free node remains."""
+    kubelet, cache, sched = make_env(conf=FULL_CONF)
+    for i in range(2):
+        cache.add_node(build_node(f"n{i}", rl(4000, 8 * GiB, pods=110)))
+    for p in range(3):
+        cache.add_pod_group(build_group("e2e", f"hp{p}", 1))
+        cache.add_pod(build_pod("e2e", f"hp{p}-0", "", "Pending",
+                                rl(500, GiB), group=f"hp{p}",
+                                ports=[8080]))
+    cycles(sched, kubelet, 3)
+    hosts = [v for k, v in kubelet.binds.items()]
+    assert len(kubelet.binds) == 2, kubelet.binds
+    assert len(set(hosts)) == 2, "port claimants must spread"
+    from kubebatch_tpu.api import TaskStatus
+
+    pending = [t for j in cache.jobs.values() for t in j.tasks.values()
+               if t.status == TaskStatus.PENDING]
+    assert len(pending) == 1
+
+
+def test_pod_anti_affinity_spreads_end_to_end():
+    """'Pod Affinity' (predicates.go): required anti-affinity on the
+    hostname topology forces replicas onto distinct nodes through the
+    full runtime loop."""
+    from kubebatch_tpu.objects import Affinity, PodAffinityTerm
+
+    kubelet, cache, sched = make_env(conf=FULL_CONF)
+    for i in range(3):
+        cache.add_node(build_node(f"n{i}", rl(4000, 8 * GiB, pods=110)))
+    cache.add_pod_group(build_group("e2e", "web", 3))
+    for p in range(3):
+        pod = build_pod("e2e", f"web-{p}", "", "Pending", rl(500, GiB),
+                        group="web", labels={"app": "web"})
+        pod.affinity = Affinity(pod_anti_affinity_required=[
+            PodAffinityTerm(match_labels={"app": "web"})])
+        cache.add_pod(pod)
+    cycles(sched, kubelet, 3)
+    assert len(kubelet.binds) == 3
+    assert len(set(kubelet.binds.values())) == 3, \
+        f"anti-affinity must spread: {kubelet.binds}"
