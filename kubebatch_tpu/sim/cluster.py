@@ -118,13 +118,34 @@ def build_cluster(spec: ClusterSpec) -> SimCluster:
             sim.pods.append(pod)
 
     # pre-fill part of the cluster with running pods (for preempt/reclaim
-    # scenarios): round-robin placement until the fill fraction is reached
+    # scenarios): round-robin placement until the fill fraction is reached,
+    # skipping nodes whose remaining capacity can't hold another fill pod
+    # (a real cluster never runs pods past allocatable)
     if spec.running_fill > 0:
         budget = spec.running_fill * spec.n_nodes * spec.node_cpu_millis
+        cpu_room = [n.allocatable.get("cpu", spec.node_cpu_millis)
+                    for n in sim.nodes]
+        mem_room = [n.allocatable.get("memory", spec.node_mem_bytes)
+                    for n in sim.nodes]
+        pod_room = [n.allocatable.get("pods", spec.node_pods)
+                    for n in sim.nodes]
         used = 0.0
         i = 0
-        while used + spec.pod_cpu_millis <= budget:
-            node = sim.nodes[i % spec.n_nodes]
+        misses = 0
+        while used + spec.pod_cpu_millis <= budget \
+                and misses < spec.n_nodes:
+            k = i % spec.n_nodes
+            if (cpu_room[k] < spec.pod_cpu_millis
+                    or mem_room[k] < spec.pod_mem_bytes
+                    or pod_room[k] < 1):
+                misses += 1
+                i += 1
+                continue
+            misses = 0
+            cpu_room[k] -= spec.pod_cpu_millis
+            mem_room[k] -= spec.pod_mem_bytes
+            pod_room[k] -= 1
+            node = sim.nodes[k]
             pg_name = f"fill-{i:05d}"
             sim.groups.append(PodGroup(
                 name=pg_name, namespace="sim", min_member=1,

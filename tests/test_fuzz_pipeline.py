@@ -1,0 +1,85 @@
+"""Seeded full-pipeline fuzz: random clusters (fill, gangs, priorities,
+queues, jitter) through reclaim+allocate+backfill+preempt, checking the
+policy invariants that hold in ANY order of events:
+
+- gang: a job that dispatched anything reached readiness at dispatch
+  time, so its ready family (bound + pipelined + running + allocated +
+  succeeded) covers MinAvailable — partially-bound-with-pipelined-rest
+  is legitimate (pipelined tasks bind next cycle);
+- capacity: idle + backfilled never below the epsilon slack times the
+  node's placement count (the reference's LessEqual admits an
+  eps-overdraft per placement);
+- the cache accounting auditor (debug.audit_cache) is clean.
+"""
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.actions.backfill import BackfillAction
+from kubebatch_tpu.actions.preempt import PreemptAction
+from kubebatch_tpu.actions.reclaim import ReclaimAction
+from kubebatch_tpu.api import TaskStatus, ready_statuses
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.debug import audit_cache
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.sim import ClusterSpec, build_cluster
+
+GiB = 1024 ** 3
+
+
+def spec_for(seed: int) -> ClusterSpec:
+    rng = np.random.default_rng(seed)
+    return ClusterSpec(
+        n_nodes=int(rng.integers(20, 80)),
+        n_groups=int(rng.integers(15, 50)),
+        pods_per_group=int(rng.integers(1, 6)),
+        n_queues=int(rng.integers(1, 4)),
+        running_fill=float(rng.uniform(0, 0.9)),
+        priority_classes=(("low", 10), ("high", 1000)),
+        pod_cpu_millis=int(rng.integers(2, 12)) * 250,
+        pod_mem_bytes=int(rng.integers(1, 5)) * GiB,
+        jitter=float(rng.choice([0.0, 0.2])),
+        seed=seed)
+
+
+@pytest.mark.parametrize("seed", [1, 4, 6, 10, 13, 19])
+def test_full_pipeline_invariants(seed):
+    class Seam:
+        def bind(self, pod, hostname):
+            pod.node_name = hostname
+
+        def evict(self, pod):
+            pod.deletion_timestamp = 1.0
+
+    seam = Seam()
+    cache = SchedulerCache(binder=seam, evictor=seam, async_writeback=False)
+    build_cluster(spec_for(seed)).populate(cache)
+
+    ssn = OpenSession(cache, shipped_tiers())
+    for act in (ReclaimAction(), AllocateAction(), BackfillAction(),
+                PreemptAction()):
+        act.execute(ssn)
+
+    ready_family = tuple(ready_statuses())
+    for job in ssn.jobs.values():
+        bound = job.count(TaskStatus.BINDING, TaskStatus.BOUND)
+        if bound:
+            assert job.count(*ready_family) >= job.min_available, (
+                f"{job.name}: dispatched {bound} without readiness "
+                f"(ready family {job.count(*ready_family)} < "
+                f"{job.min_available})")
+
+    for node in ssn.nodes.values():
+        placements = sum(1 for t in node.tasks.values()
+                         if t.status != TaskStatus.RELEASING)
+        slack = 10.0 * max(1, placements)   # eps per epsilon-fit placement
+        acc = node.idle.milli_cpu + node.backfilled.milli_cpu
+        assert acc >= -slack, (
+            f"{node.name}: idle+backfilled {acc:.1f} beyond eps slack "
+            f"{slack:.0f} ({placements} placements)")
+
+    CloseSession(ssn)
+    problems = audit_cache(cache)
+    assert not problems, problems[:5]
