@@ -110,3 +110,48 @@ def test_churn_30_cycles_accounting_holds():
     # capacity sanity at the end
     for node in cache.nodes.values():
         assert node.idle.milli_cpu >= -1e-3, (node.name, node.idle)
+
+
+def test_churn_cfg3_scale_soak():
+    """10 churn cycles at cfg3 scale (100+ nodes): jit-bucket stability
+    across drifting shapes + accounting invariants under load."""
+    rng = np.random.default_rng(7)
+    src = StreamingEventSource()
+    kubelet = Kubelet(src)
+    cache = SchedulerCache(binder=kubelet, evictor=kubelet,
+                           async_writeback=False)
+    src.emit_queue(build_queue("q1", weight=1))
+    src.emit_queue(build_queue("q2", weight=3))
+    for n in range(120):
+        src.emit_node(build_node(f"n{n:03d}", rl(8000, 16 * GiB, pods=32)))
+    src.start(cache)
+    assert src.sync(10.0)
+
+    acts = [ReclaimAction(), AllocateAction(), BackfillAction(),
+            PreemptAction()]
+    g = 0
+    for cycle in range(10):
+        for _ in range(int(rng.integers(20, 60))):
+            name = f"g{g:04d}"
+            size = int(rng.integers(1, 5))
+            src.emit_group(build_group("ns", name, max(1, size - 1),
+                                       queue=f"q{g % 2 + 1}",
+                                       creation_timestamp=float(cycle)))
+            for p in range(size):
+                src.emit_pod(build_pod(
+                    "ns", f"{name}-{p}", "", PodPhase.PENDING,
+                    rl(int(rng.integers(1, 5)) * 500,
+                       int(rng.integers(1, 4)) * GiB),
+                    group=name, priority=int(rng.integers(1, 5)),
+                    creation_timestamp=float(cycle * 1000 + p)))
+            g += 1
+        assert src.sync(10.0)
+        ssn = OpenSession(cache, shipped_tiers())
+        for act in acts:
+            act.execute(ssn)
+        CloseSession(ssn)
+        kubelet.finish_evictions(cache)
+        assert src.sync(10.0)
+        problems = audit_cache(cache)
+        assert not problems, f"cycle {cycle}: {problems[:5]}"
+    assert len(kubelet.binds) > 500

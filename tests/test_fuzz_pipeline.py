@@ -20,6 +20,7 @@ from kubebatch_tpu.actions.backfill import BackfillAction
 from kubebatch_tpu.actions.preempt import PreemptAction
 from kubebatch_tpu.actions.reclaim import ReclaimAction
 from kubebatch_tpu.api import TaskStatus, ready_statuses
+from kubebatch_tpu.api.resource import MIN_MILLI_CPU
 from kubebatch_tpu.cache import SchedulerCache
 from kubebatch_tpu.conf import shipped_tiers
 from kubebatch_tpu.debug import audit_cache
@@ -74,7 +75,8 @@ def test_full_pipeline_invariants(seed):
     for node in ssn.nodes.values():
         placements = sum(1 for t in node.tasks.values()
                          if t.status != TaskStatus.RELEASING)
-        slack = 10.0 * max(1, placements)   # eps per epsilon-fit placement
+        # one LessEqual epsilon of possible overdraft per placement
+        slack = MIN_MILLI_CPU * max(1, placements)
         acc = node.idle.milli_cpu + node.backfilled.milli_cpu
         assert acc >= -slack, (
             f"{node.name}: idle+backfilled {acc:.1f} beyond eps slack "

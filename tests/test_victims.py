@@ -71,23 +71,26 @@ def assert_equivalent(build, acts, monkeypatch):
 # targeted scenarios
 # ---------------------------------------------------------------------
 
+def build_inter_job_scenario(cache):
+    """One full node of low-priority pods + a high-priority claimant —
+    the canonical inter-job preemption fixture, shared by the
+    equivalence and device-option tests."""
+    cache.add_queue(build_queue("q1"))
+    cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+    cache.add_pod_group(build_group("ns", "low", 1, queue="q1"))
+    for i in range(2):
+        cache.add_pod(build_pod("ns", f"low-{i}", "n1", PodPhase.RUNNING,
+                                rl(2000, 4 * GiB), group="low", priority=1))
+    cache.add_pod_group(build_group("ns", "high", 1, queue="q1"))
+    cache.add_pod(build_pod("ns", "high-0", "", PodPhase.PENDING,
+                            rl(2000, 4 * GiB), group="high", priority=100))
+
+
 def test_inter_job_preemption_equivalence(monkeypatch):
     """High-priority gang preempts a low-priority job on a full node."""
-    def build(cache):
-        cache.add_queue(build_queue("q1"))
-        cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
-        cache.add_pod_group(build_group("ns", "low", 1, queue="q1"))
-        for i in range(2):
-            cache.add_pod(build_pod("ns", f"low-{i}", "n1", PodPhase.RUNNING,
-                                    rl(2000, 4 * GiB), group="low",
-                                    priority=1))
-        cache.add_pod_group(build_group("ns", "high", 1, queue="q1"))
-        cache.add_pod(build_pod("ns", "high-0", "", PodPhase.PENDING,
-                                rl(2000, 4 * GiB), group="high",
-                                priority=100))
-
     statuses, rec = assert_equivalent(
-        build, lambda: [AllocateAction(mode="host"), PreemptAction()],
+        build_inter_job_scenario,
+        lambda: [AllocateAction(mode="host"), PreemptAction()],
         monkeypatch)
     assert statuses["ns/high-0"] == TaskStatus.PIPELINED
     assert len(rec.evicted) == 1
@@ -316,3 +319,14 @@ def test_device_path_actually_runs(monkeypatch):
     PreemptAction().execute(ssn)
     CloseSession(ssn)
     assert built and all(built), "device solver must be built, not fall back"
+
+
+def test_device_default_backend_option(monkeypatch):
+    """KUBEBATCH_VICTIM_DEVICE=default routes the visit kernels to the
+    platform-default device (the accelerator on real hardware); results
+    must match the host oracle exactly like the cpu-backend default."""
+    monkeypatch.setenv("KUBEBATCH_VICTIM_DEVICE", "default")
+
+    statuses, _ = assert_equivalent(
+        build_inter_job_scenario, lambda: [PreemptAction()], monkeypatch)
+    assert statuses["ns/high-0"] == TaskStatus.PIPELINED
