@@ -49,6 +49,94 @@ def pad_to_bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+# ---------------------------------------------------------------------
+# optional native attribute packer (native/kb_pack.c)
+# ---------------------------------------------------------------------
+
+_kb_pack = None
+_kb_pack_failed = False
+_kb_pack_lock = None
+
+
+def load_kb_pack():
+    """The C attribute packer, or None (pure-Python fallback). Built on
+    first use via native/Makefile; KUBEBATCH_NATIVE=0 disables. Lives
+    here (not kubebatch_tpu.native) because native.py imports this
+    module."""
+    global _kb_pack, _kb_pack_failed, _kb_pack_lock
+    if _kb_pack is not None or _kb_pack_failed:
+        return _kb_pack
+    import importlib.util
+    import os
+    import subprocess
+    import sys
+    import sysconfig
+    import threading
+
+    if os.environ.get("KUBEBATCH_NATIVE", "1") in ("0", "false"):
+        _kb_pack_failed = True
+        return None
+    if _kb_pack_lock is None:
+        _kb_pack_lock = threading.Lock()
+    with _kb_pack_lock:
+        if _kb_pack is not None or _kb_pack_failed:
+            return _kb_pack
+        return _load_kb_pack_locked(importlib, os, subprocess, sys,
+                                    sysconfig)
+
+
+def _load_kb_pack_locked(importlib, os, subprocess, sys, sysconfig):
+    global _kb_pack, _kb_pack_failed
+    try:
+        native_dir = os.path.join(os.path.dirname(__file__), os.pardir,
+                                  os.pardir, "native")
+        suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+        path = os.path.join(native_dir, f"kb_pack{suffix}")
+        if not os.path.exists(path):
+            # build with THIS interpreter's headers/suffix, not whatever
+            # python3 is on make's PATH
+            subprocess.run(["make", "-C", native_dir, "-s",
+                            f"PYTHON={sys.executable}"], check=True,
+                           capture_output=True, timeout=120)
+        spec = importlib.util.spec_from_file_location("kb_pack", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # smoke the contract once before trusting it for every snapshot
+        probe = np.zeros((1, 1), np.float64)
+
+        class _P:
+            x = 1.5
+        mod.extract_f64([_P()], (("x", None),), probe)
+        if probe[0, 0] != 1.5:
+            raise RuntimeError("kb_pack probe mismatch")
+        _kb_pack = mod
+    except Exception:
+        _kb_pack_failed = True
+    return _kb_pack
+
+
+def _intern_paths(*paths):
+    import sys
+
+    return tuple(tuple(sys.intern(a) if isinstance(a, str) else a
+                       for a in p) for p in paths)
+
+
+_TASK_PATHS = _intern_paths(
+    ("resreq", "milli_cpu"), ("resreq", "memory"), ("resreq", "milli_gpu"),
+    ("init_resreq", "milli_cpu"), ("init_resreq", "memory"),
+    ("init_resreq", "milli_gpu"))
+
+_NODE_PATHS = _intern_paths(
+    ("idle", "milli_cpu"), ("idle", "memory"), ("idle", "milli_gpu"),
+    ("releasing", "milli_cpu"), ("releasing", "memory"),
+    ("releasing", "milli_gpu"),
+    ("backfilled", "milli_cpu"), ("backfilled", "memory"),
+    ("backfilled", "milli_gpu"),
+    ("allocatable", "milli_cpu"), ("allocatable", "memory"),
+    ("allocatable", "milli_gpu"))
+
+
 @dataclass
 class NodeState:
     """Device-side mirror of the mutable node accounting.
@@ -92,17 +180,25 @@ class NodeState:
         valid = np.zeros(n_pad, bool)
         index: Dict[str, int] = {}
         if n:
-            # one tuple-comprehension pass instead of per-Resource to_vec
-            # array allocations — this runs over every node each snapshot
-            raw = np.array(
-                [(ni.idle.milli_cpu, ni.idle.memory, ni.idle.milli_gpu,
-                  ni.releasing.milli_cpu, ni.releasing.memory,
-                  ni.releasing.milli_gpu,
-                  ni.backfilled.milli_cpu, ni.backfilled.memory,
-                  ni.backfilled.milli_gpu,
-                  ni.allocatable.milli_cpu, ni.allocatable.memory,
-                  ni.allocatable.milli_gpu) for ni in ordered],
-                np.float64).reshape(n, 4, RESOURCE_DIM)
+            # one packed pass instead of per-Resource to_vec array
+            # allocations — this runs over every node each snapshot; the
+            # C packer (native/kb_pack.c) fills the buffer directly when
+            # built, else the equivalent tuple-comprehension pass runs
+            pack = load_kb_pack()
+            if pack is not None:
+                raw = np.empty((n, len(_NODE_PATHS)), np.float64)
+                pack.extract_f64(ordered, _NODE_PATHS, raw)
+                raw = raw.reshape(n, 4, RESOURCE_DIM)
+            else:
+                raw = np.array(
+                    [(ni.idle.milli_cpu, ni.idle.memory, ni.idle.milli_gpu,
+                      ni.releasing.milli_cpu, ni.releasing.memory,
+                      ni.releasing.milli_gpu,
+                      ni.backfilled.milli_cpu, ni.backfilled.memory,
+                      ni.backfilled.milli_gpu,
+                      ni.allocatable.milli_cpu, ni.allocatable.memory,
+                      ni.allocatable.milli_gpu) for ni in ordered],
+                    np.float64).reshape(n, 4, RESOURCE_DIM)
             raw *= VEC_SCALE
             raw32 = raw.astype(np.float32)
             idle[:n] = raw32[:, 0]
@@ -153,12 +249,19 @@ class TaskBatch:
         valid = np.zeros(t_pad, bool)
         resreq_raw = np.zeros((t_pad, RESOURCE_DIM), np.float64)
         if t:
-            # one tuple-comprehension pass (see NodeState.from_nodes)
-            raw = np.array(
-                [(tk.resreq.milli_cpu, tk.resreq.memory, tk.resreq.milli_gpu,
-                  tk.init_resreq.milli_cpu, tk.init_resreq.memory,
-                  tk.init_resreq.milli_gpu) for tk in tasks],
-                np.float64).reshape(t, 2, RESOURCE_DIM)
+            # one packed pass (see NodeState.from_nodes)
+            pack = load_kb_pack()
+            if pack is not None:
+                raw = np.empty((t, len(_TASK_PATHS)), np.float64)
+                pack.extract_f64(tasks, _TASK_PATHS, raw)
+                raw = raw.reshape(t, 2, RESOURCE_DIM)
+            else:
+                raw = np.array(
+                    [(tk.resreq.milli_cpu, tk.resreq.memory,
+                      tk.resreq.milli_gpu,
+                      tk.init_resreq.milli_cpu, tk.init_resreq.memory,
+                      tk.init_resreq.milli_gpu) for tk in tasks],
+                    np.float64).reshape(t, 2, RESOURCE_DIM)
             resreq_raw[:t] = raw[:, 0]
             raw *= VEC_SCALE
             raw32 = raw.astype(np.float32)

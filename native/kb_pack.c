@@ -1,0 +1,128 @@
+/* kb_pack — native attribute packer for snapshot tensorization.
+ *
+ * The per-cycle tensorization walks O(tasks + nodes) Python objects and
+ * extracts a few float attributes from each into dense arrays
+ * (kubebatch_tpu/kernels/tensorize.py). This CPython extension performs
+ * that extraction in C: one call packs N objects x K two-level attribute
+ * paths into a caller-provided float64 buffer, skipping the interpreter
+ * loop and the intermediate tuple/list the numpy conversion needs.
+ *
+ * The framework treats this as an optional accelerator: tensorize.py
+ * falls back to the pure-Python pass when the module isn't built
+ * (native/Makefile builds it; see kubebatch_tpu/native.py for the
+ * loading convention shared with kb_native.so).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+/* extract_f64(objs, paths, out)
+ *
+ * objs:  a fast sequence of N objects
+ * paths: tuple of K (attr1, attr2-or-None) string tuples; each yields
+ *        float(getattr(getattr(obj, attr1), attr2)) (or one level when
+ *        attr2 is None)
+ * out:   writable C-contiguous float64 buffer with at least N*K items,
+ *        filled row-major [N, K]
+ *
+ * Returns N. Attribute strings are expected to be interned by the caller
+ * building `paths` once (module-level constant) — lookups then hit the
+ * type's slot/dict cache fast path.
+ */
+static PyObject *
+extract_f64(PyObject *self, PyObject *args)
+{
+    PyObject *objs, *paths;
+    Py_buffer out;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OOw*", &objs, &paths, &out))
+        return NULL;
+    if (!(out.itemsize == (Py_ssize_t)sizeof(double))) {
+        PyBuffer_Release(&out);
+        PyErr_SetString(PyExc_TypeError, "out must be a float64 buffer");
+        return NULL;
+    }
+    PyObject *seq = PySequence_Fast(objs, "objs must be a sequence");
+    if (seq == NULL) {
+        PyBuffer_Release(&out);
+        return NULL;
+    }
+    if (!PyTuple_Check(paths)) {
+        Py_DECREF(seq);
+        PyBuffer_Release(&out);
+        PyErr_SetString(PyExc_TypeError, "paths must be a tuple");
+        return NULL;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    Py_ssize_t k = PyTuple_GET_SIZE(paths);
+    if (out.len < n * k * (Py_ssize_t)sizeof(double)) {
+        Py_DECREF(seq);
+        PyBuffer_Release(&out);
+        PyErr_SetString(PyExc_ValueError, "out buffer too small");
+        return NULL;
+    }
+    /* validate path shapes up front: GET_ITEM below is unchecked */
+    for (Py_ssize_t j = 0; j < k; j++) {
+        PyObject *path = PyTuple_GET_ITEM(paths, j);
+        if (!PyTuple_Check(path) || PyTuple_GET_SIZE(path) != 2
+            || !PyUnicode_Check(PyTuple_GET_ITEM(path, 0))) {
+            Py_DECREF(seq);
+            PyBuffer_Release(&out);
+            PyErr_SetString(PyExc_TypeError,
+                            "paths items must be (str, str-or-None) tuples");
+            return NULL;
+        }
+    }
+    double *dst = (double *)out.buf;
+    PyObject **items = PySequence_Fast_ITEMS(seq);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *obj = items[i];
+        for (Py_ssize_t j = 0; j < k; j++) {
+            PyObject *path = PyTuple_GET_ITEM(paths, j);
+            PyObject *a1 = PyTuple_GET_ITEM(path, 0);
+            PyObject *a2 = PyTuple_GET_ITEM(path, 1);
+            PyObject *mid = PyObject_GetAttr(obj, a1);
+            if (mid == NULL)
+                goto fail;
+            PyObject *leaf;
+            if (a2 == Py_None) {
+                leaf = mid;
+            } else {
+                leaf = PyObject_GetAttr(mid, a2);
+                Py_DECREF(mid);
+                if (leaf == NULL)
+                    goto fail;
+            }
+            double v = PyFloat_AsDouble(leaf);
+            Py_DECREF(leaf);
+            if (v == -1.0 && PyErr_Occurred())
+                goto fail;
+            dst[i * k + j] = v;
+        }
+    }
+    Py_DECREF(seq);
+    PyBuffer_Release(&out);
+    return PyLong_FromSsize_t(n);
+fail:
+    Py_DECREF(seq);
+    PyBuffer_Release(&out);
+    return NULL;
+}
+
+static PyMethodDef kb_pack_methods[] = {
+    {"extract_f64", extract_f64, METH_VARARGS,
+     "Pack two-level float attributes of a sequence of objects into a "
+     "row-major float64 buffer."},
+    {NULL, NULL, 0, NULL}
+};
+
+static struct PyModuleDef kb_pack_module = {
+    PyModuleDef_HEAD_INIT, "kb_pack",
+    "Native attribute packer for snapshot tensorization.", -1,
+    kb_pack_methods, NULL, NULL, NULL, NULL
+};
+
+PyMODINIT_FUNC
+PyInit_kb_pack(void)
+{
+    return PyModule_Create(&kb_pack_module);
+}

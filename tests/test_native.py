@@ -145,3 +145,44 @@ def test_native_speed_at_scale():
     elapsed = time.perf_counter() - start
     assert (dec == 1).sum() > 0
     assert elapsed < 1.0, f"native solve too slow: {elapsed:.3f}s"
+
+
+def test_kb_pack_matches_python_path():
+    """The C attribute packer (native/kb_pack.c) must produce bit-identical
+    tensorization to the pure-Python pass; skipped when no compiler built
+    it (the framework falls back automatically)."""
+    import numpy as np
+    import pytest
+
+    from kubebatch_tpu.kernels import tensorize as tz
+
+    from .fixtures import GiB, build_node, build_pod, rl
+
+    if tz.load_kb_pack() is None:
+        pytest.skip("kb_pack extension unavailable")
+
+    from kubebatch_tpu.api import NodeInfo, TaskInfo
+
+    tasks = [TaskInfo(build_pod("ns", f"p{i}", "", "Pending",
+                                rl(100.0 + i * 7.3, (i + 1) * 0.37 * GiB)))
+             for i in range(50)]
+    nodes = {f"n{i}": NodeInfo(build_node(
+        f"n{i}", rl(4000 + i * 11.1, (8 + i * 0.13) * GiB, pods=10)))
+        for i in range(20)}
+
+    saved = (tz._kb_pack, tz._kb_pack_failed)
+    try:
+        b_native = tz.TaskBatch.from_tasks(tasks)
+        s_native = tz.NodeState.from_nodes(nodes)
+        tz._kb_pack, tz._kb_pack_failed = None, True
+        b_py = tz.TaskBatch.from_tasks(tasks)
+        s_py = tz.NodeState.from_nodes(nodes)
+    finally:
+        tz._kb_pack, tz._kb_pack_failed = saved
+
+    np.testing.assert_array_equal(b_native.resreq, b_py.resreq)
+    np.testing.assert_array_equal(b_native.init_resreq, b_py.init_resreq)
+    np.testing.assert_array_equal(b_native.resreq_raw, b_py.resreq_raw)
+    for field in ("idle", "releasing", "backfilled", "allocatable"):
+        np.testing.assert_array_equal(getattr(s_native, field),
+                                      getattr(s_py, field))
