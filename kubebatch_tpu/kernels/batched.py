@@ -299,9 +299,11 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     # another round's ordering pass.
     def accept_phase(proposal, mask, idle_c, rel_c, ntasks_c):
         acc_c = idle_c + a.backfilled
-        fit_alloc_c = jnp.take_along_axis(
-            jnp.all(a.init_resreq[:, None, :] <= acc_c[None] + eps, axis=-1),
-            proposal[:, None], axis=1)[:, 0]
+        # fit at each task's PROPOSED node only: gather the [T,R] node rows
+        # instead of materializing the full [T,N,R] fit matrix (identical
+        # values, ~N x less HBM traffic)
+        fit_alloc_c = jnp.all(a.init_resreq <= acc_c[proposal] + eps,
+                              axis=-1)
         prop_alloc = fit_alloc_c                          # else pipeline
         node_key = jnp.where(mask, proposal, n_pad)
         perm2 = jnp.lexsort([global_rank, node_key])
