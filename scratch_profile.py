@@ -1,0 +1,77 @@
+"""Scratch profiler: break cfg5 allocate + reclaim into host/device phases."""
+import gc
+import os
+import sys
+import time
+
+if "--cpu" in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.sim import baseline_cluster
+
+
+def build(config=5):
+    sim = baseline_cluster(config)
+    binds = {}
+    evicted = []
+
+    class _B:
+        def bind(self, pod, hostname):
+            binds[pod.uid] = hostname
+            pod.node_name = hostname
+
+        def evict(self, pod):
+            evicted.append(pod.uid)
+            pod.deletion_timestamp = 1.0
+
+    seam = _B()
+    cache = SchedulerCache(binder=seam, evictor=seam, async_writeback=False)
+    sim.populate(cache)
+    return cache
+
+
+def profile_allocate(n=3):
+    from kubebatch_tpu.actions.cycle_inputs import (build_cycle_inputs,
+                                                    replay_decisions)
+    from kubebatch_tpu.kernels.batched import solve_batched
+    from kubebatch_tpu.actions.reclaim import ReclaimAction
+
+    tiers = shipped_tiers()
+    gc.disable()
+    for cycle in range(n):
+        cache = build()
+        gc.collect()
+        t0 = time.perf_counter()
+        ssn = OpenSession(cache, tiers)
+        t1 = time.perf_counter()
+        # reclaim
+        r = ReclaimAction()
+        r.execute(ssn)
+        t2 = time.perf_counter()
+        inputs = build_cycle_inputs(ssn)
+        t3 = time.perf_counter()
+        task_state, task_node, task_seq, nrounds = solve_batched(
+            inputs.device, inputs)
+        # block on the readback (solve_batched may already block; make sure)
+        import numpy as np
+        task_state = np.asarray(task_state)
+        task_node = np.asarray(task_node)
+        task_seq = np.asarray(task_seq)
+        t4 = time.perf_counter()
+        replay_decisions(ssn, inputs, task_state, task_node, task_seq)
+        t5 = time.perf_counter()
+        CloseSession(ssn)
+        t6 = time.perf_counter()
+        print(f"cycle {cycle}: open={t1-t0:.3f} reclaim={t2-t1:.3f} "
+              f"pack={t3-t2:.3f} solve={t4-t3:.3f} replay={t5-t4:.3f} "
+              f"close={t6-t5:.3f} rounds={nrounds}")
+    gc.enable()
+
+
+if __name__ == "__main__":
+    profile_allocate()

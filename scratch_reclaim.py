@@ -1,0 +1,97 @@
+"""Scratch profiler: reclaim internals at cfg5."""
+import gc
+import sys
+import time
+
+if "--cpu" in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.sim import baseline_cluster
+
+
+def build(config=5):
+    sim = baseline_cluster(config)
+
+    class _B:
+        def bind(self, pod, hostname):
+            pod.node_name = hostname
+
+        def evict(self, pod):
+            pod.deletion_timestamp = 1.0
+
+    seam = _B()
+    cache = SchedulerCache(binder=seam, evictor=seam, async_writeback=False)
+    sim.populate(cache)
+    return cache
+
+
+def main(n=3):
+    from kubebatch_tpu.kernels import victims as V
+    from kubebatch_tpu.kernels.terms import solver_terms
+    import kubebatch_tpu.kernels.terms as terms_mod
+
+    # wrap to time
+    orig_build = V.build_victim_solver
+    orig_visit = V.VictimSolver.visit
+    orig_terms = terms_mod.solver_terms
+    orig_state = V.VictimState.__init__
+    stats = {"build": 0.0, "visits": 0.0, "nvisit": 0, "terms": 0.0,
+             "state": 0.0}
+
+    def tbuild(*a, **k):
+        t0 = time.perf_counter()
+        r = orig_build(*a, **k)
+        stats["build"] += time.perf_counter() - t0
+        return r
+
+    def tvisit(self, *a, **k):
+        t0 = time.perf_counter()
+        r = orig_visit(self, *a, **k)
+        stats["visits"] += time.perf_counter() - t0
+        stats["nvisit"] += 1
+        return r
+
+    def tterms(*a, **k):
+        t0 = time.perf_counter()
+        r = orig_terms(*a, **k)
+        stats["terms"] += time.perf_counter() - t0
+        return r
+
+    def tstate(self, *a, **k):
+        t0 = time.perf_counter()
+        r = orig_state(self, *a, **k)
+        stats["state"] += time.perf_counter() - t0
+        return r
+
+    V.build_victim_solver = tbuild
+    V.VictimSolver.visit = tvisit
+    V.solver_terms = tterms
+    terms_mod.solver_terms = tterms
+    V.VictimState.__init__ = tstate
+
+    from kubebatch_tpu.actions.reclaim import ReclaimAction
+    tiers = shipped_tiers()
+    gc.disable()
+    for cycle in range(n):
+        for k in stats:
+            stats[k] = 0
+        cache = build()
+        gc.collect()
+        ssn = OpenSession(cache, tiers)
+        t0 = time.perf_counter()
+        ReclaimAction().execute(ssn)
+        dt = time.perf_counter() - t0
+        CloseSession(ssn)
+        print(f"cycle {cycle}: reclaim={dt:.3f} build={stats['build']:.3f} "
+              f"(terms={stats['terms']:.3f} state={stats['state']:.3f}) "
+              f"visits={stats['visits']:.3f} n={stats['nvisit']}")
+    gc.enable()
+
+
+if __name__ == "__main__":
+    main()

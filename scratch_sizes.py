@@ -1,0 +1,53 @@
+"""Measure packed upload sizes + pair/sig counts at cfg5."""
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.framework import OpenSession
+from kubebatch_tpu.sim import baseline_cluster
+from kubebatch_tpu.actions.cycle_inputs import build_cycle_inputs
+from kubebatch_tpu.kernels.batched import _PACK_F32, _PACK_I32, _PACK_BOOL
+from kubebatch_tpu.kernels.pack import pack_inputs
+
+cfg = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+sim = baseline_cluster(cfg)
+
+
+class _B:
+    def bind(self, pod, hostname):
+        pod.node_name = hostname
+
+    def evict(self, pod):
+        pod.deletion_timestamp = 1.0
+
+
+seam = _B()
+cache = SchedulerCache(binder=seam, evictor=seam, async_writeback=False)
+sim.populate(cache)
+ssn = OpenSession(cache, shipped_tiers())
+inputs = build_cycle_inputs(ssn)
+task_pair, pair_sig, pair_nz, exact = inputs.pair_terms()
+extra = {"task_pair": task_pair, "pair_sig": pair_sig, "pair_nz": pair_nz}
+buf_f, lay_f, buf_i, lay_i, buf_b, lay_b = pack_inputs(
+    lambda n: extra[n] if n in extra else getattr(inputs, n),
+    _PACK_F32, _PACK_I32, _PACK_BOOL)
+print(f"cfg{cfg}: tasks={len(inputs.tasks)} t_pad={inputs.task_valid.shape[0]} "
+      f"n_pad={inputs.device.state.n_padded} jobs={len(inputs.jobs)} "
+      f"sigs={inputs.sig_pred.shape} pairs={pair_sig.shape[0]} exact={exact}")
+print(f"buf_f={buf_f.nbytes/1e6:.2f}MB buf_i={buf_i.nbytes/1e6:.2f}MB "
+      f"buf_b={buf_b.nbytes/1e6:.2f}MB")
+for name in _PACK_F32:
+    a = extra.get(name, getattr(inputs, name, None))
+    if a is not None:
+        a = np.asarray(a)
+        print(f"  f32 {name}: {a.shape} {a.nbytes/1e6:.3f}MB")
+for name in _PACK_BOOL:
+    a = extra.get(name, getattr(inputs, name, None))
+    if a is not None:
+        a = np.asarray(a)
+        print(f"  bool {name}: {a.shape} {a.nbytes/1e6:.3f}MB")
