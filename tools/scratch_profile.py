@@ -1,7 +1,10 @@
 """Scratch profiler: break cfg5 allocate + reclaim into host/device phases."""
-import gc
+
 import os
 import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+import gc
 import time
 
 if "--cpu" in sys.argv:

@@ -1,6 +1,10 @@
 """Scratch profiler: reclaim internals at cfg5."""
-import gc
+
+import os
 import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+import gc
 import time
 
 if "--cpu" in sys.argv:

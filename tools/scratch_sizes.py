@@ -1,5 +1,9 @@
 """Measure packed upload sizes + pair/sig counts at cfg5."""
+
+import os
 import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 import jax
 jax.config.update("jax_platforms", "cpu")
