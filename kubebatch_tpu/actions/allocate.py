@@ -136,7 +136,9 @@ class AllocateAction(Action):
             # the cheap gate above keeps fallback cycles from paying the
             # full-cluster tensorize + device upload
             if ssn.device_snapshot is None:
-                ssn.device_snapshot = DeviceSession(ssn.nodes)
+                mk = getattr(ssn.cache, "device_session", None)
+                ssn.device_snapshot = (mk(ssn) if mk is not None
+                                       else DeviceSession(ssn.nodes))
             terms = solver_terms(ssn, ssn.device_snapshot, pending_all,
                                  assume_supported=True)
             if terms is not None:
@@ -218,6 +220,7 @@ class AllocateAction(Action):
                            task: TaskInfo) -> None:
         """NodesFitDelta for the breaking task (ref: allocate.go:124-126 and
         164-170: the map holds deltas of the last task that failed)."""
+        ssn.touched_jobs.add(job.uid)   # nodes_fit_delta isn't cloned
         job.nodes_fit_delta = {}
         for node in ssn.nodes.values():
             delta = node.idle.clone()
@@ -266,6 +269,7 @@ class AllocateAction(Action):
                     delta = node.idle.clone()
                     delta.fit_delta(task.resreq)
                     job.nodes_fit_delta[node.name] = delta
+                    ssn.touched_jobs.add(job.uid)
                 if task.init_resreq.less_equal(node.releasing):
                     ssn.pipeline(task, node.name)
                     assigned = True

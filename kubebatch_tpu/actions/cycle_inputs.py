@@ -262,7 +262,9 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
     if not device_supported(ssn, tasks):
         return None
     if ssn.device_snapshot is None:
-        ssn.device_snapshot = DeviceSession(ssn.nodes)
+        mk = getattr(ssn.cache, "device_session", None)
+        ssn.device_snapshot = (mk(ssn) if mk is not None
+                               else DeviceSession(ssn.nodes))
     device: DeviceSession = ssn.device_snapshot
     terms = solver_terms(ssn, device, tasks, assume_supported=True)
     if terms is None:
@@ -425,6 +427,7 @@ def _replay_ordered(ssn: Session, inputs: CycleInputs,
                 # kernel here)
                 job = ssn.jobs.get(task.job)
                 if job is not None:
+                    ssn.touched_jobs.add(job.uid)
                     job.nodes_fit_delta = {}
                     for node in ssn.nodes.values():
                         delta = node.idle.clone()
@@ -459,6 +462,14 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
                             | (state == PIPELINE))[0]
     placed_sel = placed_sel[np.argsort(task_seq[placed_sel], kind="stable")]
     fail_sel = np.nonzero(state == FAIL)[0]
+
+    # incremental-snapshot bookkeeping: this path inlines the Session
+    # mutators, so it must record the touched entities itself
+    for i in placed_sel:
+        ssn.touched_jobs.add(tasks[i].job)
+        ssn.touched_nodes.add(device.node_name(int(task_node[i])))
+    for i in fail_sel:
+        ssn.touched_jobs.add(tasks[i].job)
 
     # --- per-job dispatch barrier, vectorized (gang semantics) ----------
     # The ordered path only checks readiness inside ssn.allocate, so the
@@ -581,6 +592,8 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
             if node is not None:
                 if task.is_backfill and node.node is not None:
                     backfill_adds.append((node, task.resreq))
+                if task.pod.has_pod_affinity():
+                    node.affinity_tasks += 1
                 node.tasks[task.key] = task.clone()
 
             # --- dispatch decision + single job index move ---------------

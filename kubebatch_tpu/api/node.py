@@ -37,6 +37,9 @@ class NodeInfo:
             self.allocatable = Resource.empty()
             self.capability = Resource.empty()
         self.tasks: Dict[str, TaskInfo] = {}
+        #: tasks whose pod carries inter-pod (anti-)affinity (see
+        #: JobInfo.affinity_tasks)
+        self.affinity_tasks: int = 0
 
     def clone(self) -> "NodeInfo":
         """Deep copy: the maintained accounting is copied rather than
@@ -52,6 +55,7 @@ class NodeInfo:
         res.allocatable = self.allocatable.clone()
         res.capability = self.capability.clone()
         res.tasks = {key: t.clone() for key, t in self.tasks.items()}
+        res.affinity_tasks = self.affinity_tasks
         return res
 
     def set_node(self, node: Node) -> None:
@@ -101,6 +105,8 @@ class NodeInfo:
             else:
                 self.idle.sub(ti.resreq)
             self.used.add(ti.resreq)
+        if ti.pod.has_pod_affinity():
+            self.affinity_tasks += 1
         self.tasks[key] = ti
 
     def remove_task(self, ti: TaskInfo) -> None:
@@ -121,6 +127,8 @@ class NodeInfo:
             else:
                 self.idle.add(task.resreq)
             self.used.sub(task.resreq)
+        if task.pod.has_pod_affinity():
+            self.affinity_tasks -= 1
         del self.tasks[key]
 
     def update_task(self, ti: TaskInfo) -> None:

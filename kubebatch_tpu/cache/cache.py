@@ -22,6 +22,7 @@ Architecture notes (TPU-first redesign, not a Go translation):
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -115,7 +116,8 @@ class SchedulerCache:
                  volume_binder: Optional[VolumeBinder] = None,
                  recorder: Optional[EventRecorder] = None,
                  pod_lister: Optional[Callable[[str, str], Optional[Pod]]] = None,
-                 async_writeback: bool = True):
+                 async_writeback: bool = True,
+                 incremental_snapshot: Optional[bool] = None):
         self._lock = threading.RLock()
         self.scheduler_name = scheduler_name
         self.default_queue = default_queue
@@ -141,6 +143,47 @@ class SchedulerCache:
 
         self.err_tasks = RetryQueue()
         self.deleted_jobs = RetryQueue()
+
+        # ------------------------------------------------------------
+        # incremental snapshot state (no reference counterpart — the
+        # reference deep-copies the whole cluster every cycle,
+        # cache.go:515-583, which is exactly the steady-state bottleneck
+        # this removes). Invariant: snapshot() output is always
+        # deep-equal to a from-scratch clone of cache truth; entities
+        # whose previous-session clone may diverge from truth are
+        # re-cloned, everything else is reused from the adopted base.
+        # ------------------------------------------------------------
+        if incremental_snapshot is None:
+            incremental_snapshot = os.environ.get(
+                "KUBEBATCH_INCREMENTAL", "1") not in ("0", "false")
+        self._incremental = incremental_snapshot
+        #: previous session's entity clones (jobs-by-uid, nodes-by-name),
+        #: adopted at session close; None = next snapshot is a full clone
+        self._snap_base: Optional[Tuple[Dict[str, JobInfo],
+                                        Dict[str, NodeInfo]]] = None
+        #: entities whose cache truth changed since their base clone
+        self._dirty_jobs: set = set()
+        self._dirty_nodes: set = set()
+        #: bumped by cluster-wide invalidations; a session snapshot handed
+        #: out under an older epoch is refused at adoption
+        self._snap_epoch = 0
+        self._handout_epoch = 0
+        #: bumped on node shape changes; a TermsCache built by a session
+        #: whose snapshot predates the change is refused persistence
+        self._shape_epoch = 0
+        self._handout_shape_epoch = 0
+        #: persistent device-side node arrays (kernels/solver.DeviceSession).
+        #: _dev_dirty holds marks made since the LAST snapshot; at snapshot
+        #: time they migrate to _dev_refresh, the set device_session may
+        #: safely repack from the session's clones (a mark made AFTER the
+        #: snapshot refers to truth the session cannot see — it must wait
+        #: for the next snapshot, not be consumed against stale clones)
+        self._dev_state = None
+        self._dev_dirty: set = set()
+        self._dev_refresh: set = set()
+        #: persistent static-term encoder state (kernels/terms.TermsCache);
+        #: invalidated whenever node labels/taints/shape change
+        self.terms_cache = None
 
         self._async = async_writeback
         self._pool: Optional[ThreadPoolExecutor] = (
@@ -224,6 +267,46 @@ class SchedulerCache:
         return False
 
     # ------------------------------------------------------------------
+    # incremental-snapshot bookkeeping
+    # ------------------------------------------------------------------
+    def _mark_job(self, uid: str) -> None:
+        if self._incremental:
+            self._dirty_jobs.add(uid)
+
+    def _mark_node(self, name: str) -> None:
+        if self._incremental:
+            self._dirty_nodes.add(name)
+            self._dev_dirty.add(name)
+
+    def _mark_node_shape(self, name: str) -> None:
+        """A node's static profile (labels/taints/unschedulable/allocatable)
+        or the node set changed — static-term encodings are stale too."""
+        self._mark_node(name)
+        self.terms_cache = None
+        self._shape_epoch += 1
+
+    def offer_terms_cache(self, tc) -> None:
+        """Persist a session-built TermsCache for later cycles — refused
+        when a node shape change landed after the building session's
+        snapshot (its profiles encode pre-change labels; the session may
+        still use it locally for its own consistent snapshot)."""
+        with self._lock:
+            if self._shape_epoch == self._handout_shape_epoch \
+                    and self.terms_cache is None:
+                self.terms_cache = tc
+
+    def _invalidate_snapshot(self) -> None:
+        """Cluster-wide inputs changed (queue set, priority classes):
+        per-entity dirty tracking can't scope the effect — fall back to a
+        full clone next cycle. The epoch bump also voids adoption of any
+        session snapshot handed out BEFORE the change (its clones carry
+        pre-change priorities/inclusion)."""
+        self._snap_base = None
+        self._dev_state = None
+        self.terms_cache = None
+        self._snap_epoch += 1
+
+    # ------------------------------------------------------------------
     # pod/task ingestion (ref: event_handlers.go:37-247)
     # ------------------------------------------------------------------
     def _pod_relevant(self, pod: Pod) -> bool:
@@ -250,15 +333,21 @@ class SchedulerCache:
     def _add_task(self, ti: TaskInfo) -> None:
         job = self._get_or_create_job(ti)
         job.add_task_info(ti)
+        self._mark_job(job.uid)
         if ti.node_name:
             if ti.node_name not in self.nodes:
                 # placeholder until the node event arrives
                 self.nodes[ti.node_name] = NodeInfo(None)
             if not _is_terminated(ti.status):
                 self.nodes[ti.node_name].add_task(ti)
+            self._mark_node(ti.node_name)
 
     def _delete_task(self, ti: TaskInfo) -> None:
         errs = []
+        if ti.job:
+            self._mark_job(ti.job)
+        if ti.node_name:
+            self._mark_node(ti.node_name)
         if ti.job:
             job = self.jobs.get(ti.job)
             if job is not None:
@@ -320,6 +409,7 @@ class SchedulerCache:
                 self.nodes[node.name].set_node(node)
             else:
                 self.nodes[node.name] = NodeInfo(node)
+            self._mark_node_shape(node.name)
 
     def update_node(self, old: Node, new: Node) -> None:
         with self._lock:
@@ -330,12 +420,14 @@ class SchedulerCache:
                     or old.labels != new.labels
                     or old.unschedulable != new.unschedulable):
                 ni.set_node(new)
+                self._mark_node_shape(new.name)
 
     def delete_node(self, node: Node) -> None:
         with self._lock:
             if node.name not in self.nodes:
                 raise KeyError(f"node <{node.name}> does not exist")
             del self.nodes[node.name]
+            self._mark_node_shape(node.name)
 
     # ------------------------------------------------------------------
     # PodGroup / PDB / Queue / PriorityClass (ref: event_handlers.go:358-769)
@@ -355,6 +447,7 @@ class SchedulerCache:
             if job is None:
                 raise KeyError(f"can not find job {job_id}")
             job.unset_pod_group()
+            self._mark_job(job_id)
             self.deleted_jobs.add_rate_limited(job)
 
     def _set_pod_group(self, pg: PodGroup) -> None:
@@ -362,6 +455,7 @@ class SchedulerCache:
         if job_id not in self.jobs:
             self.jobs[job_id] = JobInfo(job_id)
         self.jobs[job_id].set_pod_group(pg)
+        self._mark_job(job_id)
         if not pg.queue:
             self.jobs[job_id].queue = self.default_queue
 
@@ -381,6 +475,7 @@ class SchedulerCache:
             if job is None:
                 raise KeyError(f"can not find job {job_id}")
             job.unset_pdb()
+            self._mark_job(job_id)
             self.deleted_jobs.add_rate_limited(job)
 
     def _set_pdb(self, pdb: PodDisruptionBudget) -> None:
@@ -392,22 +487,28 @@ class SchedulerCache:
         if job_id not in self.jobs:
             self.jobs[job_id] = JobInfo(job_id)
         self.jobs[job_id].set_pdb(pdb)
+        self._mark_job(job_id)
         self.jobs[job_id].queue = self.default_queue
 
     def add_queue(self, queue: Queue) -> None:
         with self._lock:
             qi = QueueInfo(queue)
             self.queues[qi.uid] = qi
+            # queue membership gates which jobs a snapshot includes
+            # (snapshot() skip rule) — per-entity tracking can't scope it
+            self._invalidate_snapshot()
 
     def update_queue(self, old: Queue, new: Queue) -> None:
         with self._lock:
             self.queues.pop(old.name, None)
             qi = QueueInfo(new)
             self.queues[qi.uid] = qi
+            self._invalidate_snapshot()
 
     def delete_queue(self, queue: Queue) -> None:
         with self._lock:
             self.queues.pop(queue.name, None)
+            self._invalidate_snapshot()
 
     def add_priority_class(self, pc: PriorityClass) -> None:
         with self._lock:
@@ -428,12 +529,16 @@ class SchedulerCache:
             self.default_priority_class = pc
             self.default_priority = pc.value
         self.priority_classes[pc.name] = pc
+        # job.priority is stamped from priority classes at snapshot time
+        # for EVERY job (cache.go:561-576) — scope is cluster-wide
+        self._invalidate_snapshot()
 
     def _delete_priority_class(self, pc: PriorityClass) -> None:
         if pc.global_default:
             self.default_priority_class = None
             self.default_priority = 0
         self.priority_classes.pop(pc.name, None)
+        self._invalidate_snapshot()
 
     # ------------------------------------------------------------------
     # decisions out (ref: cache.go:349-442)
@@ -460,6 +565,8 @@ class SchedulerCache:
             job.update_task_status(task, TaskStatus.BINDING)
             task.node_name = hostname
             node.add_task(task)
+            self._mark_job(job.uid)
+            self._mark_node(hostname)
             pod = task.pod
 
         self._submit(lambda: self._bind_one(task, pod, hostname))
@@ -542,7 +649,11 @@ class SchedulerCache:
                     acc[0] += rr.milli_cpu
                     acc[1] += rr.memory
                     acc[2] += rr.milli_gpu
+                if task.pod.has_pod_affinity():
+                    node.affinity_tasks += 1
                 node.tasks[key] = task.clone()
+                self._mark_job(job.uid)
+                self._mark_node(hostname)
                 submits.append((task, task.pod, hostname))
 
             for hostname, take in node_take.items():
@@ -572,6 +683,8 @@ class SchedulerCache:
                                f"{task.node_name}, host does not exist")
             job.update_task_status(task, TaskStatus.RELEASING)
             node.update_task(task)
+            self._mark_job(job.uid)
+            self._mark_node(task.node_name)
             pod = task.pod
             pg = job.pod_group
 
@@ -632,6 +745,54 @@ class SchedulerCache:
     # snapshot (ref: cache.go:515-583)
     # ------------------------------------------------------------------
     def snapshot(self) -> ClusterInfo:
+        """Deep-copied cluster view for one session. With incremental
+        snapshots enabled, entity clones from the previous session are
+        reused when neither the cache (dirty sets) nor that session
+        (touched sets, folded in at adopt_snapshot) invalidated them —
+        output is deep-equal to snapshot_full() by construction."""
+        with self._lock:
+            self._handout_epoch = self._snap_epoch
+            self._handout_shape_epoch = self._shape_epoch
+            self._dev_refresh |= self._dev_dirty
+            self._dev_dirty = set()
+            base = self._snap_base
+            if not self._incremental or base is None:
+                snap = self.snapshot_full()
+                if self._incremental:
+                    # the full clone IS current truth for every entity
+                    self._dirty_jobs.clear()
+                    self._dirty_nodes.clear()
+                return snap
+            base_jobs, base_nodes = base
+            # the base is consumed: the objects are handed to the new
+            # session, which will mutate them. If the session dies before
+            # adoption, the next snapshot is a full clone.
+            self._snap_base = None
+            dirty_jobs, self._dirty_jobs = self._dirty_jobs, set()
+            dirty_nodes, self._dirty_nodes = self._dirty_nodes, set()
+            snap = ClusterInfo()
+            for name, node in self.nodes.items():
+                reuse = None if name in dirty_nodes else base_nodes.get(name)
+                snap.nodes[name] = node.clone() if reuse is None else reuse
+            for uid, q in self.queues.items():
+                snap.queues[uid] = q.clone()
+            for uid, job in self.jobs.items():
+                if job.pod_group is None and job.pdb is None:
+                    continue
+                if job.queue not in snap.queues:
+                    continue
+                reuse = None if uid in dirty_jobs else base_jobs.get(uid)
+                if reuse is not None:
+                    snap.jobs[uid] = reuse
+                    continue
+                self._stamp_priority(job)
+                snap.jobs[uid] = job.clone()
+            return snap
+
+    def snapshot_full(self) -> ClusterInfo:
+        """From-scratch deep clone (the reference's snapshot semantics,
+        cache.go:515-583) — also the oracle the incremental path is
+        equality-tested against."""
         with self._lock:
             snap = ClusterInfo()
             for name, node in self.nodes.items():
@@ -643,14 +804,63 @@ class SchedulerCache:
                     continue
                 if job.queue not in snap.queues:
                     continue
-                if job.pod_group is not None:
-                    job.priority = self.default_priority
-                    pc = self.priority_classes.get(
-                        job.pod_group.priority_class_name)
-                    if pc is not None:
-                        job.priority = pc.value
+                self._stamp_priority(job)
                 snap.jobs[uid] = job.clone()
             return snap
+
+    def _stamp_priority(self, job: JobInfo) -> None:
+        """ref: cache.go:561-576 (PriorityClass -> job priority)."""
+        if job.pod_group is not None:
+            job.priority = self.default_priority
+            pc = self.priority_classes.get(
+                job.pod_group.priority_class_name)
+            if pc is not None:
+                job.priority = pc.value
+
+    def adopt_snapshot(self, ssn) -> None:
+        """Session close hands its entity clones back as the next cycle's
+        snapshot base. Entities the session mutated (touched sets) may
+        diverge from cache truth — fold them into the dirty sets so the
+        next snapshot re-clones them; everything else is verbatim the
+        state a fresh clone would produce (clones share pod/pod_group/pdb
+        objects with cache truth, so status write-back at close is visible
+        on both sides)."""
+        if not self._incremental:
+            return
+        with self._lock:
+            if self._snap_epoch != self._handout_epoch:
+                # a cluster-wide invalidation landed mid-session: the
+                # session's clones predate it — full clone next cycle
+                return
+            self._dirty_jobs |= ssn.touched_jobs
+            self._dirty_nodes |= ssn.touched_nodes
+            self._dev_dirty |= ssn.touched_nodes
+            self._snap_base = (ssn.jobs, ssn.nodes)
+            if ssn.device_snapshot is not None:
+                self._dev_state = ssn.device_snapshot
+
+    def device_session(self, ssn):
+        """A DeviceSession for this cycle: the previous cycle's device
+        arrays with dirty/touched node rows re-packed from the session's
+        host truth, or a fresh build when the node set changed (or nothing
+        is adoptable). The refresh set includes nodes the CURRENT session
+        already touched (e.g. reclaim evictions run before allocate)."""
+        from ..kernels.solver import DeviceSession
+
+        with self._lock:
+            ds = self._dev_state
+            self._dev_state = None   # consumed; re-adopted at close
+            if not self._incremental or ds is None:
+                # the fresh build reflects the session snapshot — marks up
+                # to THAT point are satisfied; later marks (_dev_dirty)
+                # must survive to the next snapshot
+                self._dev_refresh.clear()
+                return DeviceSession(ssn.nodes)
+            refresh, self._dev_refresh = self._dev_refresh, set()
+        refresh |= ssn.touched_nodes
+        if not ds.update_rows(ssn.nodes, refresh):
+            return DeviceSession(ssn.nodes)
+        return ds
 
     # ------------------------------------------------------------------
     # status write-back (ref: cache.go:615-658)

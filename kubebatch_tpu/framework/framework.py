@@ -20,9 +20,10 @@ from .session import Session, close_session, open_session, validate_jobs
 
 
 def open_session_with_tiers(cache, tiers: List[Tier],
-                            enable_preemption: bool = False) -> Session:
+                            enable_preemption: bool = False,
+                            snapshot=None) -> Session:
     """ref: framework.go:29-50 (OpenSession)."""
-    ssn = open_session(cache, enable_preemption)
+    ssn = open_session(cache, enable_preemption, snapshot=snapshot)
     ssn.tiers = tiers
     for tier in tiers:
         for opt in tier.plugins:

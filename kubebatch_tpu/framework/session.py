@@ -82,6 +82,15 @@ class Session:
         #: device-side snapshot, built on first use by kernels.tensorize
         self.device_snapshot = None
 
+        #: entities this session mutated in ways a fresh cache clone would
+        #: not reproduce — folded into the cache's dirty sets when the
+        #: snapshot is adopted as the next cycle's base (cache.py
+        #: adopt_snapshot). Every session mutator records here; missing a
+        #: site breaks the incremental==full snapshot invariant (pinned by
+        #: tests/test_incremental_snapshot.py).
+        self.touched_jobs: set = set()
+        self.touched_nodes: set = set()
+
     # ------------------------------------------------------------------
     # plugin registration (ref: session_plugins.go:23-65)
     # ------------------------------------------------------------------
@@ -320,6 +329,8 @@ class Session:
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         """Session-only assignment onto releasing resources
         (ref: session.go:199-235)."""
+        self.touched_jobs.add(task.job)
+        self.touched_nodes.add(hostname)
         job = self.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.PIPELINED)
@@ -337,6 +348,8 @@ class Session:
             self.cache.allocate_volumes(task, hostname)
         except Exception as e:
             raise VolumeAllocationError(str(e)) from e
+        self.touched_jobs.add(task.job)
+        self.touched_nodes.add(hostname)
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job}")
@@ -356,6 +369,7 @@ class Session:
 
     def dispatch(self, task: TaskInfo) -> None:
         """Bind an allocated task for real (ref: session.go:299-321)."""
+        self.touched_jobs.add(task.job)
         self.cache.bind_volumes(task)
         self.cache.bind(task, task.node_name)
         job = self.jobs.get(task.job)
@@ -368,6 +382,8 @@ class Session:
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """Real eviction through the cache plus session bookkeeping
         (ref: session.go:323-357)."""
+        self.touched_jobs.add(reclaimee.job)
+        self.touched_nodes.add(reclaimee.node_name)
         self.cache.evict(reclaimee, reason)
         job = self.jobs.get(reclaimee.job)
         if job is not None:
@@ -402,10 +418,13 @@ class Session:
                 eh.deallocate_func(Event(task))
 
 
-def open_session(cache, enable_preemption: bool = False) -> Session:
+def open_session(cache, enable_preemption: bool = False,
+                 snapshot: Optional[ClusterInfo] = None) -> Session:
     """Snapshot the cache and drop gang-invalid jobs
-    (ref: session.go:66-122)."""
-    ssn = Session(cache, cache.snapshot(), enable_preemption)
+    (ref: session.go:66-122). ``snapshot`` lets tests supply a snapshot
+    taken moments earlier (e.g. to compare incremental vs full cloning)."""
+    ssn = Session(cache, snapshot if snapshot is not None
+                  else cache.snapshot(), enable_preemption)
     return ssn
 
 
@@ -464,6 +483,11 @@ def close_session(ssn: Session) -> None:
     # results follow the upstream scheduler's vocabulary)
     update_pod_schedule_status("scheduled", scheduled)
     update_pod_schedule_status("unschedulable", unschedulable)
+    # hand the session's clones back as the next snapshot's base (the
+    # incremental-snapshot protocol; no-op for caches without it)
+    adopt = getattr(ssn.cache, "adopt_snapshot", None)
+    if adopt is not None:
+        adopt(ssn)
     ssn.jobs = {}
     ssn.nodes = {}
     ssn.queues = {}

@@ -136,6 +136,52 @@ class StaticTerms:
         return self.pred.shape[0]
 
 
+def _build_profiles(names: Sequence[str], n_padded: int, rel_keys: Tuple,
+                    labels_of, taints_of):
+    """Dedup nodes into (restricted-labels, taints) profiles. Shared by
+    the per-cycle builder and the persistent TermsCache — their contract
+    is exact equality (test_terms_cache_matches_fresh_build_across_cycles),
+    so the profile key lives in exactly one place."""
+    profile_of = np.zeros(n_padded, np.int32)
+    profiles: List[Tuple[Dict[str, str], list]] = []
+    prof_index: Dict[Tuple, int] = {}
+    for col, name in enumerate(names):
+        labels = labels_of(name)
+        taints = taints_of(name)
+        restricted = {k: labels[k] for k in rel_keys if k in labels}
+        key = (tuple(sorted(restricted.items())),
+               tuple((t.key, t.value, t.effect) for t in taints))
+        p = prof_index.get(key)
+        if p is None:
+            p = len(profiles)
+            prof_index[key] = p
+            profiles.append((restricted, taints))
+        profile_of[col] = p
+    return profile_of, profiles
+
+
+def _eval_sig_rows(pod: Pod, profiles, with_predicates: bool,
+                   with_node_affinity_score: bool,
+                   node_affinity_weight: int):
+    """One signature's (pred, score) row over the node profiles, via the
+    host matcher functions verbatim (shared, see _build_profiles)."""
+    n_prof = max(1, len(profiles))
+    pred_row = np.ones(n_prof, bool)
+    score_row = np.zeros(n_prof, np.float32)
+    aff = pod.affinity
+    preferred = (aff.node_affinity.preferred
+                 if (aff is not None and aff.node_affinity is not None)
+                 else [])
+    for p, (labels, taints) in enumerate(profiles):
+        if with_predicates:
+            pred_row[p] = (match_node_selector(pod, labels)
+                           and tolerates_node_taints(pod, _FakeNode(taints)))
+        if with_node_affinity_score and preferred:
+            total = sum(w for w, term in preferred if term.matches(labels))
+            score_row[p] = total * node_affinity_weight
+    return pred_row, score_row
+
+
 def build_static_terms(state: NodeState, tasks: Sequence[TaskInfo],
                        node_labels: Dict[str, Dict[str, str]],
                        node_taints: Dict[str, list],
@@ -165,40 +211,19 @@ def build_static_terms(state: NodeState, tasks: Sequence[TaskInfo],
     n_sigs = max(1, len(sig_pods))
 
     # --- unique node profiles ----------------------------------------
-    profile_of = np.zeros(state.n_padded, np.int32)
-    profiles: List[Tuple[Dict[str, str], list]] = []
-    prof_index: Dict[Tuple, int] = {}
-    for col, name in enumerate(state.names):
-        labels = node_labels.get(name, {})
-        taints = node_taints.get(name, [])
-        restricted = {k: labels[k] for k in rel_keys if k in labels}
-        key = (tuple(sorted(restricted.items())),
-               tuple((t.key, t.value, t.effect) for t in taints))
-        p = prof_index.get(key)
-        if p is None:
-            p = len(profiles)
-            prof_index[key] = p
-            profiles.append((restricted, taints))
-        profile_of[col] = p
+    profile_of, profiles = _build_profiles(
+        state.names, state.n_padded, rel_keys,
+        lambda name: node_labels.get(name, {}),
+        lambda name: node_taints.get(name, []))
     n_prof = max(1, len(profiles))
 
     # --- evaluate per (sig, profile) via the host matchers ------------
     pred_sp = np.ones((n_sigs, n_prof), bool)
     score_sp = np.zeros((n_sigs, n_prof), np.float32)
     for s, pod in enumerate(sig_pods):
-        aff = pod.affinity
-        preferred = (aff.node_affinity.preferred
-                     if (aff is not None and aff.node_affinity is not None)
-                     else [])
-        for p, (labels, taints) in enumerate(profiles):
-            if with_predicates:
-                ok = (match_node_selector(pod, labels)
-                      and tolerates_node_taints(pod, _FakeNode(taints)))
-                pred_sp[s, p] = ok
-            if with_node_affinity_score and preferred:
-                total = sum(w for w, term in preferred
-                            if term.matches(labels))
-                score_sp[s, p] = total * node_affinity_weight
+        pred_sp[s], score_sp[s] = _eval_sig_rows(
+            pod, profiles, with_predicates, with_node_affinity_score,
+            node_affinity_weight)
 
     # --- broadcast to [S, N_pad] --------------------------------------
     return StaticTerms(pred=pred_sp[:, profile_of],
@@ -206,20 +231,117 @@ def build_static_terms(state: NodeState, tasks: Sequence[TaskInfo],
 
 
 # ---------------------------------------------------------------------
+# persistent encoder state (cross-cycle)
+# ---------------------------------------------------------------------
+
+class TermsCache:
+    """Static-term encoder state persisted across cycles.
+
+    Owned by SchedulerCache.terms_cache and nulled there on ANY node
+    shape change (labels/taints/unschedulable/allocatable, node add or
+    delete — cache.py _mark_node_shape), so while it lives, the node
+    profiles it encoded are exactly the snapshot's. Per cycle the only
+    work left is mapping pending pods to signature rows (memoized on the
+    pod) and evaluating rows for signatures never seen before.
+    """
+
+    #: new signatures beyond this force a full reset (degenerate churn of
+    #: unique selector shapes must not grow the matrices unboundedly)
+    MAX_SIGS = 4096
+
+    def __init__(self):
+        self.ready = False
+        self.names: Optional[List[str]] = None
+        self.rel_keys: frozenset = frozenset()
+        self.flags: Optional[Tuple] = None
+        self.profile_of: Optional[np.ndarray] = None
+        self.profiles: List[Tuple[Dict[str, str], list]] = []
+        self.sig_index: Dict[Tuple, int] = {}
+        #: per-signature rows, stacked lazily (amortized growth — a
+        #: full-matrix copy per new signature would be quadratic)
+        self._pred_rows: List[np.ndarray] = []
+        self._score_rows: List[np.ndarray] = []
+        self._stacked: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    def _rebuild_profiles(self, state: NodeState, ssn,
+                          rel_keys: frozenset) -> None:
+        self.rel_keys = rel_keys
+        self.names = list(state.names)
+        nodes = ssn.nodes
+
+        def labels_of(name):
+            ni = nodes.get(name)
+            return ni.node.labels if (ni is not None and ni.node) else {}
+
+        def taints_of(name):
+            ni = nodes.get(name)
+            return ni.node.taints if (ni is not None and ni.node) else []
+
+        self.profile_of, self.profiles = _build_profiles(
+            state.names, state.n_padded, tuple(sorted(rel_keys)),
+            labels_of, taints_of)
+        self.sig_index = {}
+        self._pred_rows = []
+        self._score_rows = []
+        self._stacked = None
+        self.ready = True
+
+    def _sig_row(self, pod: Pod, with_predicates: bool,
+                 with_node_affinity_score: bool,
+                 node_affinity_weight: int) -> int:
+        key = task_signature(pod)
+        s = self.sig_index.get(key)
+        if s is not None:
+            return s
+        pred_row, score_row = _eval_sig_rows(
+            pod, self.profiles, with_predicates, with_node_affinity_score,
+            node_affinity_weight)
+        s = len(self.sig_index)
+        self.sig_index[key] = s
+        self._pred_rows.append(pred_row)
+        self._score_rows.append(score_row)
+        self._stacked = None
+        return s
+
+    def static_terms(self, state: NodeState, ssn,
+                     tasks: Sequence[TaskInfo],
+                     with_predicates: bool,
+                     with_node_affinity_score: bool,
+                     node_affinity_weight: int = 1) -> StaticTerms:
+        """Same result as build_static_terms, amortized across cycles."""
+        pods = [t.pod for t in tasks]
+        rel = frozenset(referenced_label_keys(pods))
+        flags = (with_predicates, with_node_affinity_score,
+                 node_affinity_weight)
+        if (not self.ready or self.flags != flags
+                or not rel <= self.rel_keys
+                or len(self.sig_index) > self.MAX_SIGS
+                or self.names != list(state.names)):
+            self.flags = flags
+            self._rebuild_profiles(state, ssn, rel | self.rel_keys)
+        sig_of = {
+            t.uid: self._sig_row(t.pod, with_predicates,
+                                 with_node_affinity_score,
+                                 node_affinity_weight)
+            for t in tasks}
+        if not self._pred_rows:             # no tasks at all
+            self._sig_row(Pod(name="-empty-"), with_predicates,
+                          with_node_affinity_score, node_affinity_weight)
+        if self._stacked is None:
+            self._stacked = (np.stack(self._pred_rows),
+                             np.stack(self._score_rows))
+        pred_sp, score_sp = self._stacked
+        return StaticTerms(pred=pred_sp[:, self.profile_of],
+                           score=score_sp[:, self.profile_of],
+                           sig_of=sig_of)
+
+
+# ---------------------------------------------------------------------
 # dynamic-feature detection (forces the host path)
 # ---------------------------------------------------------------------
 
 def _has_pod_affinity(pod: Pod) -> bool:
-    flag = getattr(pod, "_kb_podaff", None)
-    if flag is None:
-        aff = pod.affinity
-        flag = bool(aff is not None
-                    and (aff.pod_affinity_required
-                         or aff.pod_anti_affinity_required
-                         or aff.pod_affinity_preferred
-                         or aff.pod_anti_affinity_preferred))
-        pod._kb_podaff = flag
-    return flag
+    return pod.has_pod_affinity()
 
 
 def dynamic_features(ssn, pending: Sequence[TaskInfo]) -> Optional[str]:
@@ -238,15 +360,13 @@ def dynamic_features(ssn, pending: Sequence[TaskInfo]) -> Optional[str]:
     for t in pending:
         if _has_pod_affinity(t.pod):
             return "pending task with pod (anti-)affinity"
-    for job in ssn.jobs.values():
-        for task in job.tasks.values():
-            if _has_pod_affinity(task.pod):
-                return "existing pod with pod (anti-)affinity"
-    # standalone pods sitting on nodes (outside any job) can still reject
-    # others through anti-affinity symmetry; existing pods' host PORTS only
-    # matter to port-requesting pending tasks, screened above
-    for node in ssn.nodes.values():
-        for task in node.tasks.values():
-            if _has_pod_affinity(task.pod):
-                return "existing pod with pod (anti-)affinity"
+    # the maintained per-entity counters (JobInfo/NodeInfo.affinity_tasks,
+    # pinned by debug.audit_cache) replace the per-task cluster walk this
+    # detection used to cost every cycle. Standalone pods sitting on nodes
+    # (outside any job) can still reject others through anti-affinity
+    # symmetry — the node counter covers them; existing pods' host PORTS
+    # only matter to port-requesting pending tasks, screened above.
+    if any(job.affinity_tasks for job in ssn.jobs.values()) \
+            or any(node.affinity_tasks for node in ssn.nodes.values()):
+        return "existing pod with pod (anti-)affinity"
     return None

@@ -38,7 +38,11 @@ import numpy as np
 
 from ..api import NodeInfo
 from ..metrics import update_solver_kernel_duration, update_tensorize_duration
-from .tensorize import VEC_EPS, NodeState, TaskBatch
+from .tensorize import (VEC_EPS, NodeState, TaskBatch, _intern_paths,
+                        pad_to_bucket)
+
+#: nonzero-request extraction paths for update_rows' native repack
+_NZ_PATHS = _intern_paths(("resreq", "milli_cpu"), ("resreq", "memory"))
 
 SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
 
@@ -191,6 +195,107 @@ class DeviceSession:
 
     def node_index(self, name: str) -> Optional[int]:
         return self.state.index.get(name)
+
+    def update_rows(self, nodes: Dict[str, NodeInfo], names) -> bool:
+        """Re-pack the given nodes' rows from host truth (numpy mirror and
+        device arrays both), reusing everything else from the previous
+        cycle — the steady-state complement of the full per-cycle build.
+        Returns False when the node set changed (caller rebuilds fresh).
+
+        Soundness: rows NOT in ``names`` were neither event-mutated
+        (cache dirty set) nor session-mutated (touched set folded in by
+        the caller) since they were last packed, so both mirrors still
+        hold their host-truth values."""
+        from ..api.resource import VEC_SCALE
+
+        state = self.state
+        if len(nodes) != len(state.names) \
+                or any(n not in state.index for n in nodes):
+            return False
+        rows = sorted(state.index[n] for n in names if n in state.index)
+        if not rows:
+            return True
+        start = time.perf_counter()
+        from .tensorize import (_NODE_PATHS, NONZERO_MEM_MIB,
+                                NONZERO_MILLI_CPU, load_kb_pack)
+        k = len(rows)
+        dirty_nodes = [nodes[state.names[r]] for r in rows]
+        pack = load_kb_pack()
+        if pack is not None:
+            raw = np.empty((k, len(_NODE_PATHS)), np.float64)
+            pack.extract_f64(dirty_nodes, _NODE_PATHS, raw)
+            raw = raw.reshape(k, 4, 3)
+        else:
+            raw = np.array(
+                [(ni.idle.milli_cpu, ni.idle.memory, ni.idle.milli_gpu,
+                  ni.releasing.milli_cpu, ni.releasing.memory,
+                  ni.releasing.milli_gpu,
+                  ni.backfilled.milli_cpu, ni.backfilled.memory,
+                  ni.backfilled.milli_gpu,
+                  ni.allocatable.milli_cpu, ni.allocatable.memory,
+                  ni.allocatable.milli_gpu) for ni in dirty_nodes],
+                np.float64).reshape(k, 4, 3)
+        # nonzero-request sums over the dirty nodes' tasks, vectorized
+        # (upstream GetNonzeroRequests semantics, as NodeState.from_nodes)
+        nz = np.zeros((k, 2), np.float32)
+        t_row: List[int] = []
+        t_tasks: List = []
+        for j, (r, ni) in enumerate(zip(rows, dirty_nodes)):
+            t_tasks.extend(ni.tasks.values())
+            t_row.extend([j] * len(ni.tasks))
+            state.max_task_num[r] = ni.allocatable.max_task_num
+            state.n_tasks[r] = len(ni.tasks)
+            state.schedulable[r] = not (bool(ni.node.unschedulable)
+                                        if ni.node else True)
+        if t_tasks:
+            t_res = np.empty((len(t_tasks), 2), np.float64)
+            if pack is not None:
+                pack.extract_f64(t_tasks, _NZ_PATHS, t_res)
+            else:
+                for i, t in enumerate(t_tasks):
+                    t_res[i] = (t.resreq.milli_cpu, t.resreq.memory)
+            t_nz = np.empty((len(t_tasks), 2), np.float64)
+            t_nz[:, 0] = np.where(t_res[:, 0] != 0, t_res[:, 0],
+                                  NONZERO_MILLI_CPU)
+            mem_mib = t_res[:, 1] / (1024.0 * 1024.0)
+            t_nz[:, 1] = np.where(mem_mib != 0, mem_mib, NONZERO_MEM_MIB)
+            acc = np.zeros((k, 2), np.float64)
+            np.add.at(acc, np.asarray(t_row, np.int64), t_nz)
+            nz = acc.astype(np.float32)
+        raw *= VEC_SCALE
+        raw32 = raw.astype(np.float32)
+        idx = np.asarray(rows, np.int32)
+        state.idle[idx] = raw32[:, 0]
+        state.releasing[idx] = raw32[:, 1]
+        state.backfilled[idx] = raw32[:, 2]
+        state.allocatable[idx] = raw32[:, 3]
+        state.nz_requested[idx] = nz
+        # pad the scatter block to a pow2 bucket by REPEATING the first row
+        # (identical values -> idempotent), so the jitted scatter shape is
+        # stable across cycles instead of recompiling per dirty-row count
+        k_pad = pad_to_bucket(k, 8)
+        if k_pad != k:
+            pad = np.full(k_pad - k, idx[0], np.int32)
+            idx = np.concatenate([idx, pad])
+            raw32 = np.concatenate(
+                [raw32, np.repeat(raw32[:1], k_pad - k, axis=0)])
+            nz = np.concatenate([nz, np.repeat(nz[:1], k_pad - k, axis=0)])
+        jidx = jnp.asarray(idx)
+        self.idle = self.idle.at[jidx].set(jnp.asarray(raw32[:, 0]))
+        self.releasing = self.releasing.at[jidx].set(jnp.asarray(raw32[:, 1]))
+        self.backfilled = self.backfilled.at[jidx].set(
+            jnp.asarray(raw32[:, 2]))
+        self.allocatable_cm = self.allocatable_cm.at[jidx].set(
+            jnp.asarray(raw32[:, 3, :2]))
+        self.nz_req = self.nz_req.at[jidx].set(jnp.asarray(nz))
+        self.n_tasks = self.n_tasks.at[jidx].set(
+            jnp.asarray(state.n_tasks[idx]))
+        self.max_task_num = self.max_task_num.at[jidx].set(
+            jnp.asarray(state.max_task_num[idx]))
+        self.node_ok = self.node_ok.at[jidx].set(
+            jnp.asarray(state.schedulable[idx] & state.valid[idx]))
+        update_tensorize_duration(time.perf_counter() - start)
+        return True
 
     def resync(self, nodes: Dict[str, NodeInfo]) -> None:
         """Rebuild device arrays from host truth (used if a host-side apply

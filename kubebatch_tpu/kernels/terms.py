@@ -122,6 +122,27 @@ def solver_terms(ssn, device, pending: Sequence[TaskInfo],
                                balanced_resource=float(weights["balanced"]))
         node_aff_weight = weights["node_aff"]
 
+    # persistent encoder state: profiles/sig rows survive across cycles
+    # (SchedulerCache nulls terms_cache on any node shape change); fake
+    # caches without the slot fall back to the per-cycle build
+    tc = getattr(ssn.cache, "terms_cache", False) \
+        if ssn.cache is not None else False
+    if tc is not False:
+        if tc is None:
+            from .encode import TermsCache
+            tc = TermsCache()
+            # persistence is refused if a node-shape event landed after
+            # this session's snapshot (tc then stays session-local)
+            offer = getattr(ssn.cache, "offer_terms_cache", None)
+            if offer is not None:
+                offer(tc)
+        static = tc.static_terms(
+            device.state, ssn, pending,
+            with_predicates=bool(pred_plugins),
+            with_node_affinity_score=bool(order_plugins),
+            node_affinity_weight=node_aff_weight)
+        return SolverTerms(static=static, dynamic=dyn)
+
     node_labels = {}
     node_taints = {}
     for name, ni in ssn.nodes.items():

@@ -61,11 +61,39 @@ from ..api import NodeInfo, TaskInfo, TaskStatus, ready_statuses
 from ..api.resource import RESOURCE_DIM
 from .solver import dynamic_node_score
 from .tensorize import (NONZERO_MEM_MIB, NONZERO_MILLI_CPU, VEC_EPS,
-                        nz_request_vec, pad_to_bucket)
+                        _intern_paths, load_kb_pack, nz_request_vec,
+                        pad_to_bucket)
 from ..api.resource import VEC_SCALE
 
 _IMAX = jnp.iinfo(jnp.int32).max
 _READY = None
+
+#: extraction paths for the native packer (VictimState's node-task walk)
+_RES_PATHS = _intern_paths(
+    ("resreq", "milli_cpu"), ("resreq", "memory"), ("resreq", "milli_gpu"))
+
+
+_CRIT_CONSTS = None
+
+
+def _pod_critical(pod) -> bool:
+    """conformance's never-evict rule, memoized on the pod (spec fields
+    are immutable for the pod's lifetime; runs per victim row per
+    action)."""
+    global _CRIT_CONSTS
+    crit = getattr(pod, "_kb_crit", None)
+    if crit is None:
+        if _CRIT_CONSTS is None:
+            from ..plugins.conformance import (NAMESPACE_SYSTEM,
+                                               SYSTEM_CLUSTER_CRITICAL,
+                                               SYSTEM_NODE_CRITICAL)
+            _CRIT_CONSTS = ((SYSTEM_CLUSTER_CRITICAL, SYSTEM_NODE_CRITICAL),
+                            NAMESPACE_SYSTEM)
+        classes, ns_system = _CRIT_CONSTS
+        crit = (pod.priority_class_name in classes
+                or pod.namespace == ns_system)
+        pod._kb_crit = crit
+    return crit
 
 
 def _ready_statuses():
@@ -277,37 +305,45 @@ class VictimState:
     def __init__(self, ssn, node_index: Dict[str, int], n_pad: int,
                  node_ok: np.ndarray, max_task_num: np.ndarray,
                  allocatable_cm: np.ndarray):
-        from ..plugins.conformance import (NAMESPACE_SYSTEM,
-                                           SYSTEM_CLUSTER_CRITICAL,
-                                           SYSTEM_NODE_CRITICAL)
-
         self.node_index = node_index
         self.n_pad = n_pad
         # mutable node mirrors, rebuilt from HOST truth (earlier actions in
         # the session — allocate — have mutated nodes since the device
-        # snapshot was tensorized). One tuple-comprehension pass + vector
-        # math instead of per-task array allocations (10k+ node tasks at
-        # the stress configs).
+        # snapshot was tensorized). ONE walk collects every node task in
+        # (node-index, insertion) order; resreq extraction goes through the
+        # native packer (native/kb_pack.c) when built — this build runs
+        # every preempt/reclaim action at 10k+ node tasks in the stress
+        # configs, and tuple-list -> np.asarray was its hot spot.
         self.nz_req = np.zeros((n_pad, 2), np.float32)
         self.n_tasks = np.zeros(n_pad, np.int32)
-        rows = []
-        for name, node in ssn.nodes.items():
+        all_tasks: List[TaskInfo] = []
+        node_of: List[int] = []
+        for name, node in sorted(ssn.nodes.items(),
+                                 key=lambda kv: node_index.get(kv[0], 0)):
             ni = node_index.get(name)
             if ni is None:
                 continue
             self.n_tasks[ni] = len(node.tasks)
-            rows.extend((ni, t.resreq.milli_cpu, t.resreq.memory)
-                        for t in node.tasks.values())
-        if rows:
-            arr = np.asarray(rows, np.float64)
-            idx = arr[:, 0].astype(np.int64)
-            nz = np.empty((len(rows), 2), np.float64)
-            nz[:, 0] = np.where(arr[:, 1] != 0, arr[:, 1],
+            all_tasks.extend(node.tasks.values())
+            node_of.extend([ni] * len(node.tasks))
+        t_node = (np.asarray(node_of, np.int64) if all_tasks
+                  else np.zeros(0, np.int64))
+        t_res = np.empty((len(all_tasks), RESOURCE_DIM), np.float64)
+        if all_tasks:
+            pack = load_kb_pack()
+            if pack is not None:
+                pack.extract_f64(all_tasks, _RES_PATHS, t_res)
+            else:
+                for i, t in enumerate(all_tasks):
+                    rr = t.resreq
+                    t_res[i] = (rr.milli_cpu, rr.memory, rr.milli_gpu)
+            nz = np.empty((len(all_tasks), 2), np.float64)
+            nz[:, 0] = np.where(t_res[:, 0] != 0, t_res[:, 0],
                                 NONZERO_MILLI_CPU)
-            mem_mib = arr[:, 2] / (1024.0 * 1024.0)
+            mem_mib = t_res[:, 1] / (1024.0 * 1024.0)
             nz[:, 1] = np.where(mem_mib != 0, mem_mib, NONZERO_MEM_MIB)
             acc = np.zeros((n_pad, 2), np.float64)
-            np.add.at(acc, idx, nz)
+            np.add.at(acc, t_node, nz)
             self.nz_req = acc.astype(np.float32)
         self.node_ok = node_ok
         self.max_task_num = max_task_num
@@ -357,27 +393,15 @@ class VictimState:
                     self.q_prop_ok[qi] = True
 
         # ---- victim rows: RUNNING tasks in (node, insertion) order ----
-        self.victims: List[_Victim] = []
-        v_node, v_job, v_res, v_crit, v_live = [], [], [], [], []
-        for name, node in sorted(ssn.nodes.items(),
-                                 key=lambda kv: node_index.get(kv[0], 0)):
-            ni = node_index.get(name)
-            if ni is None:
-                continue
-            for task in node.tasks.values():
-                if task.status != TaskStatus.RUNNING:
-                    continue
-                ji = self.j_index.get(task.job, -1)
-                self.victims.append(_Victim(task, ni, ji))
-                v_node.append(ni)
-                v_job.append(ji)
-                rr = task.resreq
-                v_res.append((rr.milli_cpu, rr.memory, rr.milli_gpu))
-                cls = task.pod.priority_class_name
-                v_crit.append(cls in (SYSTEM_CLUSTER_CRITICAL,
-                                      SYSTEM_NODE_CRITICAL)
-                              or task.namespace == NAMESPACE_SYSTEM)
-                v_live.append(ji >= 0)
+        # (all_tasks above is already in that order)
+        running = TaskStatus.RUNNING
+        run_sel = [i for i, t in enumerate(all_tasks) if t.status == running]
+        j_get = self.j_index.get
+        vtasks = [all_tasks[i] for i in run_sel]
+        vjobs = [j_get(t.job, -1) for t in vtasks]
+        self.victims = [
+            _Victim(t, int(t_node[i]), ji)
+            for t, i, ji in zip(vtasks, run_sel, vjobs)]
         v = len(self.victims)
         v_pad = pad_to_bucket(max(1, v), 8)
         self.v_node = np.full(v_pad, self.n_pad - 1, np.int32)
@@ -386,13 +410,13 @@ class VictimState:
         self.v_critical = np.zeros(v_pad, bool)
         self.v_live = np.zeros(v_pad, bool)
         if v:
-            self.v_node[:v] = v_node
-            self.v_job[:v] = v_job
+            sel = np.asarray(run_sel, np.int64)
+            self.v_node[:v] = t_node[sel]
+            self.v_job[:v] = vjobs
             # host units -> device units in one pass (to_vec semantics)
-            self.v_res[:v] = (np.asarray(v_res, np.float64)
-                              * VEC_SCALE).astype(np.float32)
-            self.v_critical[:v] = v_crit
-            self.v_live[:v] = v_live
+            self.v_res[:v] = (t_res[sel] * VEC_SCALE).astype(np.float32)
+            self.v_critical[:v] = [_pod_critical(t.pod) for t in vtasks]
+            self.v_live[:v] = np.asarray(vjobs, np.int64) >= 0
         # pad rows sort to the last node with live=False — harmless
 
         # static orderings + segment heads
@@ -638,7 +662,9 @@ def build_victim_solver(ssn, pending: Sequence[TaskInfo],
     if not device_supported(ssn, pending):
         return None
     if ssn.device_snapshot is None:
-        ssn.device_snapshot = DeviceSession(ssn.nodes)
+        mk = getattr(ssn.cache, "device_session", None)
+        ssn.device_snapshot = (mk(ssn) if mk is not None
+                               else DeviceSession(ssn.nodes))
     device = ssn.device_snapshot
     terms = solver_terms(ssn, device, pending, assume_supported=True)
     if terms is None:

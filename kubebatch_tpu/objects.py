@@ -235,6 +235,22 @@ class Pod:
             ports.extend(c.ports)
         return ports
 
+    def has_pod_affinity(self) -> bool:
+        """Any inter-pod (anti-)affinity term — the feature class that
+        makes predicates/scores allocation-dependent (kernels/encode.py
+        dynamic_features). Memoized: pod spec fields are immutable for
+        the pod's lifetime."""
+        flag = getattr(self, "_kb_podaff", None)
+        if flag is None:
+            aff = self.affinity
+            flag = bool(aff is not None
+                        and (aff.pod_affinity_required
+                             or aff.pod_anti_affinity_required
+                             or aff.pod_affinity_preferred
+                             or aff.pod_anti_affinity_preferred))
+            self._kb_podaff = flag
+        return flag
+
 
 class PodGroupPhase(str, Enum):
     """ref: pkg/apis/scheduling/v1alpha1/types.go:28-39"""

@@ -10,8 +10,8 @@ from __future__ import annotations
 
 from typing import Dict, List
 
-from ..api import (JobInfo, Resource, TaskInfo, allocated_status,
-                   dominant_share, resource_names, share)
+from ..api import (JobInfo, Resource, TaskInfo, dominant_share,
+                   resource_names, share)
 from ..framework import EventHandler, Plugin, Session
 
 NAME = "drf"
@@ -48,10 +48,11 @@ class DrfPlugin(Plugin):
 
         for job in ssn.jobs.values():
             attr = DrfAttr()
-            for status, tasks in job.task_status_index.items():
-                if allocated_status(status):
-                    for t in tasks.values():
-                        attr.allocated.add(t.resreq)
+            # JobInfo.allocated IS the allocated-status resreq sum — the
+            # aggregate update_task_status maintains and debug.audit_cache
+            # pins (the reference recomputes it per open, drf.go:59-82;
+            # same value, O(jobs) instead of O(jobs x tasks))
+            attr.allocated = job.allocated.clone()
             self._update_share(attr)
             self.job_opts[job.uid] = attr
 

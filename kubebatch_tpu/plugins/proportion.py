@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Dict, List
 
-from ..api import (QueueInfo, Resource, TaskInfo, allocated_status,
+from ..api import (QueueInfo, Resource, TaskInfo,
                    dominant_share, res_min, share)
 from ..api.types import TaskStatus
 from ..framework import EventHandler, Plugin, Session
@@ -61,14 +61,14 @@ class ProportionPlugin(Plugin):
                     continue
                 self.queue_opts[job.queue] = QueueAttr(queue)
             attr = self.queue_opts[job.queue]
-            for status, tasks in job.task_status_index.items():
-                if allocated_status(status):
-                    for t in tasks.values():
-                        attr.allocated.add(t.resreq)
-                        attr.request.add(t.resreq)
-                elif status == TaskStatus.PENDING:
-                    for t in tasks.values():
-                        attr.request.add(t.resreq)
+            # allocated-family sum = the maintained JobInfo.allocated
+            # aggregate (see drf.on_session_open; ref proportion.go:66-98
+            # recomputes per task); only the PENDING bucket needs a walk
+            attr.allocated.add(job.allocated)
+            attr.request.add(job.allocated)
+            for t in job.task_status_index.get(TaskStatus.PENDING,
+                                               {}).values():
+                attr.request.add(t.resreq)
 
         # weighted water-filling (ref: proportion.go:100-142, quirks intact)
         remaining = self.total_resource.clone()

@@ -61,6 +61,65 @@ class SimCluster:
             cache.add_pod(p)
 
     _pod_index: Optional[Dict[Tuple[str, str], Pod]] = None
+    _churn_seq: int = 0
+
+    def churn_tick(self, cache: SchedulerCache, n_pods: int) -> int:
+        """Steady-state churn trickle: the oldest fully-bound gangs finish
+        (pod + PodGroup delete events) and the same number of fresh gangs
+        arrives pending — the regime the 1 s schedule-period loop lives in
+        once the cluster is mostly scheduled (the kubemark plan's
+        density/latency scenario, ref
+        doc/design/Benchmark/kubemark/kubemark-benchmarking.md:40-42).
+        Returns the number of pods actually recycled."""
+        spec = self.spec
+        per = max(1, spec.pods_per_group)
+        n_groups = max(1, n_pods // per)
+        by_group: Dict[str, List[Pod]] = {}
+        for p in self.pods:
+            by_group.setdefault(p.annotations.get(GROUP_NAME_ANNOTATION, ""),
+                                []).append(p)
+        recycled = 0
+        done = 0
+        for g in list(self.groups):
+            if done >= n_groups:
+                break
+            if not g.name.startswith("job-"):
+                continue        # leave cfg4's running fill alone
+            pods = by_group.get(g.name, [])
+            if not pods or not all(p.node_name for p in pods):
+                continue
+            for p in pods:
+                cache.delete_pod(p)
+                self.pods.remove(p)
+            cache.delete_pod_group(g)
+            self.groups.remove(g)
+            recycled += len(pods)
+            done += 1
+        self._pod_index = None
+        base_ts = 1e9 + self._churn_seq
+        for k in range(done):
+            gid = self._churn_seq
+            self._churn_seq += 1
+            queue = self.queues[gid % len(self.queues)].name
+            # named job-* so the next tick can recycle churn gangs too
+            pg = PodGroup(name=f"job-churn-{gid:06d}", namespace="sim",
+                          min_member=per, queue=queue,
+                          creation_timestamp=base_ts + k)
+            self.groups.append(pg)
+            cache.add_pod_group(pg)
+            for p in range(per):
+                pod = Pod(
+                    name=f"{pg.name}-{p:03d}", namespace="sim",
+                    annotations={GROUP_NAME_ANNOTATION: pg.name},
+                    containers=[Container(requests=resource_list(
+                        cpu=spec.pod_cpu_millis,
+                        memory=spec.pod_mem_bytes))],
+                    creation_timestamp=base_ts + k + p / 1000.0)
+                self.pods.append(pod)
+                cache.add_pod(pod)
+        # let the deleted-job GC run (no repair worker in benchmarks)
+        cache.process_cleanup_jobs()
+        return recycled
 
     def pod_lister(self, ns: str, name: str) -> Optional[Pod]:
         """O(1) ground-truth lookup for the resync repair loop (every

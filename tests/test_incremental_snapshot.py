@@ -1,0 +1,205 @@
+"""Incremental snapshot soundness: reused-entity snapshots must be
+deep-equal to a from-scratch clone of cache truth, every cycle.
+
+The incremental protocol (cache.py snapshot/adopt_snapshot + Session
+touched sets) reuses the previous session's entity clones for entities
+neither the cache nor that session mutated. These tests drive real
+multi-cycle churn through the full action pipeline and assert the
+invariant with debug.snapshot_diff before every cycle — any mutation
+path that forgets to mark its entity dirty/touched fails here.
+"""
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.actions.backfill import BackfillAction
+from kubebatch_tpu.actions.preempt import PreemptAction
+from kubebatch_tpu.actions.reclaim import ReclaimAction
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.debug import audit_cache, snapshot_diff
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.kernels.solver import DeviceSession
+from kubebatch_tpu.objects import PodPhase, PriorityClass
+from kubebatch_tpu.sim import StreamingEventSource
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+class Kubelet:
+    def __init__(self, src):
+        self.src = src
+        self.binds = {}
+        self.evicted = []
+
+    def bind(self, pod, hostname):
+        self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+        pod.node_name = hostname
+        pod.phase = PodPhase.RUNNING
+        self.src.emit_pod_update(pod, pod)
+
+    def evict(self, pod):
+        self.evicted.append(f"{pod.namespace}/{pod.name}")
+        pod.deletion_timestamp = 1.0
+
+
+def _mk_cluster(n_nodes=10, pods=16):
+    src = StreamingEventSource()
+    kubelet = Kubelet(src)
+    cache = SchedulerCache(binder=kubelet, evictor=kubelet,
+                           async_writeback=False,
+                           incremental_snapshot=True)
+    src.emit_queue(build_queue("q1", weight=1))
+    src.emit_queue(build_queue("q2", weight=3))
+    for n in range(n_nodes):
+        src.emit_node(build_node(f"n{n:02d}", rl(4000, 8 * GiB, pods=pods)))
+    src.start(cache)
+    assert src.sync(5.0)
+    return src, kubelet, cache
+
+
+def _open_checked(cache, tiers):
+    """Take the incremental snapshot, assert it deep-equals a fresh full
+    clone, and open the session on it."""
+    full = cache.snapshot_full()
+    inc = cache.snapshot()
+    diff = snapshot_diff(inc, full)
+    assert not diff, diff[:8]
+    return OpenSession(cache, tiers, snapshot=inc)
+
+
+def _churn_cycle(src, rng, cycle, next_group):
+    """A couple of gangs arrive; occasionally a running pod finishes."""
+    for _ in range(int(rng.integers(1, 3))):
+        g = f"g{next_group:03d}"
+        size = int(rng.integers(1, 4))
+        src.emit_group(build_group("ns", g, max(1, size - 1),
+                                   queue=f"q{next_group % 2 + 1}",
+                                   creation_timestamp=float(cycle)))
+        for p in range(size):
+            src.emit_pod(build_pod(
+                "ns", f"{g}-{p}", "", PodPhase.PENDING,
+                rl(int(rng.integers(1, 4)) * 500, int(rng.integers(1, 3))
+                   * GiB),
+                group=g, priority=int(rng.integers(1, 5)),
+                creation_timestamp=float(cycle * 100 + p)))
+        next_group += 1
+    if rng.random() < 0.5:
+        for key, pod in list(src.pods.items()):
+            if pod.phase == PodPhase.RUNNING:
+                src.emit_pod_delete(pod)
+                break
+    assert src.sync(5.0)
+    return next_group
+
+
+@pytest.mark.parametrize("mode", ["auto", "batched", "host"])
+def test_incremental_equals_full_under_churn(mode):
+    rng = np.random.default_rng(11)
+    src, kubelet, cache = _mk_cluster()
+    acts = [ReclaimAction(), AllocateAction(mode=mode), BackfillAction(),
+            PreemptAction()]
+    next_group = 0
+    for cycle in range(12):
+        next_group = _churn_cycle(src, rng, cycle, next_group)
+        ssn = _open_checked(cache, shipped_tiers())
+        for act in acts:
+            act.execute(ssn)
+        CloseSession(ssn)
+        assert src.sync(5.0)
+        assert not audit_cache(cache)
+    assert kubelet.binds, "churn must schedule work"
+    # final equality after the last adoption too
+    diff = snapshot_diff(cache.snapshot(), cache.snapshot_full())
+    assert not diff, diff[:8]
+
+
+def test_unready_gang_and_fit_failures_stay_consistent():
+    """The divergence-heavy shapes: a gang too big to fit leaves session
+    tasks ALLOCATED-but-undispatched and records nodes_fit_delta; both
+    must be re-cloned away by the touched tracking."""
+    src, kubelet, cache = _mk_cluster(n_nodes=2)
+    # gang of 6 x 2000m on 2 x 4000m nodes: places 4, then FAILs; never
+    # Ready (min_member 6) so nothing dispatches
+    src.emit_group(build_group("ns", "big", 6, queue="q1"))
+    for p in range(6):
+        src.emit_pod(build_pod("ns", f"big-{p}", "", PodPhase.PENDING,
+                               rl(2000, GiB), group="big",
+                               creation_timestamp=float(p)))
+    assert src.sync(5.0)
+    for cycle in range(3):
+        ssn = _open_checked(cache, shipped_tiers())
+        AllocateAction(mode="fused").execute(ssn)
+        CloseSession(ssn)
+        assert not kubelet.binds
+    # and batched engine over the same snapshot shapes
+    for cycle in range(2):
+        ssn = _open_checked(cache, shipped_tiers())
+        AllocateAction(mode="batched").execute(ssn)
+        CloseSession(ssn)
+    assert not kubelet.binds
+
+
+def test_priority_class_change_invalidates_base():
+    """A PriorityClass event must force re-stamping of every job priority
+    (cluster-wide invalidation, not per-entity dirtiness)."""
+    src, kubelet, cache = _mk_cluster(n_nodes=2)
+    pg = build_group("ns", "g0", 1, queue="q1")
+    pg.priority_class_name = "gold"
+    src.emit_group(pg)
+    src.emit_pod(build_pod("ns", "g0-0", "", PodPhase.PENDING,
+                           rl(500, GiB), group="g0"))
+    assert src.sync(5.0)
+    ssn = _open_checked(cache, shipped_tiers())
+    AllocateAction().execute(ssn)
+    CloseSession(ssn)
+    cache.add_priority_class(PriorityClass(name="gold", value=7777))
+    inc = cache.snapshot()
+    assert inc.jobs["ns/g0"].priority == 7777
+    assert not snapshot_diff(inc, cache.snapshot_full())
+
+
+def test_mid_session_invalidation_refuses_adoption():
+    src, kubelet, cache = _mk_cluster(n_nodes=2)
+    pg = build_group("ns", "g0", 1, queue="q1")
+    pg.priority_class_name = "gold"
+    src.emit_group(pg)
+    src.emit_pod(build_pod("ns", "g0-0", "", PodPhase.PENDING,
+                           rl(500, GiB), group="g0"))
+    assert src.sync(5.0)
+    ssn = _open_checked(cache, shipped_tiers())
+    # cluster-wide event lands while the session is open
+    cache.add_priority_class(PriorityClass(name="gold", value=4242))
+    AllocateAction().execute(ssn)
+    CloseSession(ssn)   # adoption must be refused (epoch mismatch)
+    inc = cache.snapshot()
+    assert inc.jobs["ns/g0"].priority == 4242
+    assert not snapshot_diff(inc, cache.snapshot_full())
+
+
+def test_device_session_row_reuse_matches_fresh_build():
+    """cache.device_session must hand back arrays bit-identical to a
+    fresh DeviceSession built from the same snapshot."""
+    rng = np.random.default_rng(3)
+    src, kubelet, cache = _mk_cluster()
+    acts = [ReclaimAction(), AllocateAction(mode="batched"),
+            BackfillAction(), PreemptAction()]
+    next_group = 0
+    for cycle in range(6):
+        next_group = _churn_cycle(src, rng, cycle, next_group)
+        ssn = _open_checked(cache, shipped_tiers())
+        reused = cache.device_session(ssn)
+        fresh = DeviceSession(ssn.nodes, min_bucket=reused.n_padded)
+        for fld in ("idle", "releasing", "backfilled", "allocatable_cm",
+                    "nz_req", "n_tasks", "max_task_num", "node_ok"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(reused, fld)),
+                np.asarray(getattr(fresh, fld)), err_msg=f"cycle {cycle} "
+                f"field {fld}")
+        assert reused.state.names == fresh.state.names
+        ssn.device_snapshot = reused
+        for act in acts:
+            act.execute(ssn)
+        CloseSession(ssn)
+    assert kubelet.binds

@@ -162,3 +162,75 @@ def test_dynamic_least_requested_spreads_on_device(mode):
     binds = _run(nodes, groups, pods, mode)
     assert len(binds) == 2
     assert binds["ns/p0"] != binds["ns/p1"], binds
+
+
+def test_terms_cache_matches_fresh_build_across_cycles():
+    """The persistent TermsCache must produce the same sig matrices the
+    per-cycle builder would, across churn cycles that add new signature
+    shapes, and invalidate on node label changes."""
+    from kubebatch_tpu.framework import Session
+    from kubebatch_tpu.kernels.encode import build_static_terms
+    from kubebatch_tpu.kernels.solver import DeviceSession
+    from kubebatch_tpu.objects import Node
+
+    rng = np.random.default_rng(5)
+    nodes, groups, pods = _random_cluster(rng)
+    cache = SchedulerCache(async_writeback=False)
+    cache.add_queue(build_queue("q1"))
+    for n in nodes:
+        cache.add_node(n)
+    for g in groups:
+        cache.add_pod_group(g)
+    for p in pods:
+        cache.add_pod(p)
+
+    tiers = [Tier(plugins=[PluginOption("predicates"),
+                           PluginOption("nodeorder")])]
+
+    def check_cycle():
+        from kubebatch_tpu.api import TaskStatus
+        from kubebatch_tpu.kernels.terms import solver_terms
+        ssn = OpenSession(cache, tiers)
+        pending = [t for j in ssn.jobs.values()
+                   for t in j.task_status_index.get(TaskStatus.PENDING,
+                                                    {}).values()]
+        if not pending:
+            CloseSession(ssn)
+            return
+        device = DeviceSession(ssn.nodes)
+        terms = solver_terms(ssn, device, pending)
+        assert terms is not None
+        node_labels = {nm: ni.node.labels if ni.node else {}
+                       for nm, ni in ssn.nodes.items()}
+        node_taints = {nm: ni.node.taints if ni.node else []
+                       for nm, ni in ssn.nodes.items()}
+        want = build_static_terms(device.state, pending, node_labels,
+                                  node_taints, with_predicates=True,
+                                  with_node_affinity_score=True,
+                                  node_affinity_weight=1)
+        t_pad = len(pending) + 1
+        got_s, got_p = terms.static.task_rows(pending, t_pad)
+        want_s, want_p = want.task_rows(pending, t_pad)
+        np.testing.assert_array_equal(got_p, want_p)
+        np.testing.assert_array_equal(got_s, want_s)
+        CloseSession(ssn)
+
+    check_cycle()
+    tc = cache.terms_cache
+    assert tc is not None and tc.ready
+    # churn: new pods with a NEW signature shape (fresh selector value)
+    g2 = build_group("ns", "pgX", 1, queue="q1")
+    cache.add_pod_group(g2)
+    p2 = build_pod("ns", "pgX-0", "", PodPhase.PENDING, rl(500, GiB),
+                   group="pgX", creation_timestamp=999.0)
+    p2.node_selector = {"zone": "north"}
+    cache.add_pod(p2)
+    check_cycle()
+    assert cache.terms_cache is tc, "cache must survive pod churn"
+    # node label change must invalidate
+    old = nodes[0]
+    new = Node(name=old.name, allocatable=dict(old.allocatable),
+               labels={**old.labels, "zone": "west"}, taints=old.taints)
+    cache.update_node(old, new)
+    assert cache.terms_cache is None
+    check_cycle()
