@@ -46,6 +46,12 @@ from ..util import PriorityQueue, select_best_node
 #: and keeps bind-for-bind ordering exactness
 AUTO_BATCHED_MIN = 512
 
+#: auto mode further upgrades batched -> sharded when more than one
+#: device is visible AND the node axis is at least this large — below it
+#: the per-device shard is too small for the partitioning to pay for its
+#: collectives (on a single chip sharded degenerates to batched anyway)
+AUTO_SHARDED_MIN_NODES = 512
+
 
 def _effective_min_available(ssn: Session, job: JobInfo) -> int:
     """The readiness threshold the kernel enforces in-scan. With a job-ready
@@ -85,7 +91,17 @@ class AllocateAction(Action):
             pending = sum(
                 len(j.task_status_index.get(TaskStatus.PENDING, {}))
                 for j in ssn.jobs.values())
-            mode = ("batched" if pending >= AUTO_BATCHED_MIN else "fused")
+            if pending >= AUTO_BATCHED_MIN:
+                mode = "batched"
+                if len(ssn.nodes) >= AUTO_SHARDED_MIN_NODES:
+                    import jax
+                    if len(jax.devices()) > 1:
+                        # multi-chip host, big node axis: the shipped
+                        # default partitions the round engine over the
+                        # mesh (SURVEY §2.9 row 43)
+                        mode = "sharded"
+            else:
+                mode = "fused"
         if mode in ("batched", "sharded"):
             from .allocate_batched import batched_supported, execute_batched
             # execute_batched itself returns False (without consuming

@@ -104,6 +104,59 @@ def test_shard_bucket():
     assert shard_bucket(5000, 12) % 12 == 0
 
 
+def test_sharded_scaled_partitioned_cycle():
+    """A half-cfg5 partitioned run (1.25k nodes x ~2.5k pods over the
+    8-device mesh) executes IN CI — the big-shape layout is exercised on
+    every run, not behind an opt-in env (the full 10k x 5k layout stays
+    in test_cfg5_shape_smoke below)."""
+    from kubebatch_tpu.sim import ClusterSpec, build_cluster
+
+    spec = ClusterSpec(n_nodes=1250, n_groups=312, pods_per_group=8,
+                       n_queues=4, queue_weights=(1, 2, 3, 4),
+                       pod_cpu_millis=1000, pod_mem_bytes=2 * GiB,
+                       jitter=0.2)
+    sim = build_cluster(spec)
+    cache = SchedulerCache(binder=_B(), async_writeback=False)
+    sim.populate(cache)
+    ssn = OpenSession(cache, shipped_tiers())
+    inputs = build_cycle_inputs(ssn)
+    st, nd, seq, rounds = solve_batched_sharded(node_mesh(), inputs.device,
+                                                inputs)
+    n_real = len(inputs.tasks)
+    placed = np.isin(st[:n_real], [1, 2]).sum()
+    assert placed == n_real, f"{placed}/{n_real} placed"
+    CloseSession(ssn)
+
+
+def test_auto_mode_selects_sharded_on_multi_device(monkeypatch):
+    """mode='auto' must route large cycles to the sharded engine when
+    more than one device is visible (the test mesh has 8) and the node
+    axis is large enough."""
+    from kubebatch_tpu.actions import allocate as allocate_mod
+    from kubebatch_tpu.kernels import batched_sharded as bs
+    from kubebatch_tpu.sim import ClusterSpec, build_cluster
+
+    calls = []
+    real = bs.solve_batched_sharded
+
+    def spy(mesh, device, inputs):
+        calls.append(inputs.n_tasks_real)
+        return real(mesh, device, inputs)
+
+    monkeypatch.setattr(bs, "solve_batched_sharded", spy)
+    monkeypatch.setattr(allocate_mod, "AUTO_SHARDED_MIN_NODES", 24)
+    monkeypatch.setattr(allocate_mod, "AUTO_BATCHED_MIN", 32)
+    cache = SchedulerCache(binder=_B(), async_writeback=False)
+    build_cluster_small = build_cluster(ClusterSpec(
+        n_nodes=32, n_groups=16, pods_per_group=4, pod_cpu_millis=500,
+        pod_mem_bytes=GiB))
+    build_cluster_small.populate(cache)
+    ssn = OpenSession(cache, shipped_tiers())
+    AllocateAction(mode="auto").execute(ssn)
+    CloseSession(ssn)
+    assert calls, "auto mode did not dispatch the sharded engine"
+
+
 @pytest.mark.skipif(not os.environ.get("KB_BIG_SMOKE"),
                     reason="cfg5-shaped memory-layout smoke (set "
                            "KB_BIG_SMOKE=1; several GB + minutes on CPU)")
