@@ -456,3 +456,81 @@ def test_pod_anti_affinity_spreads_end_to_end():
     assert len(kubelet.binds) == 3
     assert len(set(kubelet.binds.values())) == 3, \
         f"anti-affinity must spread: {kubelet.binds}"
+
+
+def test_pod_affinity_colocates_end_to_end():
+    """'Pod Affinity' positive half (predicates.go:29-193): pods with
+    required pod-affinity to an existing app's label co-locate onto the
+    node that app runs on."""
+    from kubebatch_tpu.objects import Affinity, PodAffinityTerm
+
+    kubelet, cache, sched = make_env(conf=FULL_CONF)
+    for i in range(3):
+        cache.add_node(build_node(f"n{i}", rl(8000, 16 * GiB, pods=110)))
+    cache.add_pod_group(build_group("e2e", "db", 1))
+    db = build_pod("e2e", "db-0", "", "Pending", rl(500, GiB), group="db",
+                   labels={"app": "db"})
+    cache.add_pod(db)
+    cycles(sched, kubelet, 2)
+    db_host = kubelet.binds["e2e/db-0"]
+
+    cache.add_pod_group(build_group("e2e", "web", 2))
+    for p in range(2):
+        pod = build_pod("e2e", f"web-{p}", "", "Pending", rl(500, GiB),
+                        group="web", labels={"app": "web"})
+        pod.affinity = Affinity(pod_affinity_required=[
+            PodAffinityTerm(match_labels={"app": "db"})])
+        cache.add_pod(pod)
+    cycles(sched, kubelet, 3)
+    assert kubelet.binds.get("e2e/web-0") == db_host, kubelet.binds
+    assert kubelet.binds.get("e2e/web-1") == db_host, kubelet.binds
+
+
+def test_node_affinity_places_on_matching_node_end_to_end():
+    """'NodeAffinity' (predicates.go:29-90): required node affinity pins
+    the pod to the matching node even when other nodes have more room."""
+    from kubebatch_tpu.objects import (Affinity, MatchExpression,
+                                       NodeAffinity, NodeSelectorTerm)
+
+    kubelet, cache, sched = make_env(conf=FULL_CONF)
+    cache.add_node(build_node("n-east", rl(16000, 32 * GiB, pods=110),
+                              labels={"zone": "east"}))
+    cache.add_node(build_node("n-west", rl(4000, 8 * GiB, pods=110),
+                              labels={"zone": "west"}))
+    cache.add_pod_group(build_group("e2e", "pin", 1))
+    pod = build_pod("e2e", "pin-0", "", "Pending", rl(500, GiB),
+                    group="pin")
+    pod.affinity = Affinity(node_affinity=NodeAffinity(
+        required=[NodeSelectorTerm([MatchExpression("zone", "In",
+                                                    ["west"])])]))
+    cache.add_pod(pod)
+    cycles(sched, kubelet, 2)
+    assert kubelet.binds.get("e2e/pin-0") == "n-west", kubelet.binds
+
+
+def test_gang_exactly_fills_cluster_end_to_end():
+    """'Gang Full Occupied' (job.go:119-145): a gang sized to exactly the
+    whole cluster schedules completely and reaches Running; an identical
+    second gang then stays Pending — preemption can never carry it to
+    Ready (drf stops granting victims once shares equalize), so its
+    Statement is discarded and the first gang keeps running."""
+    kubelet, cache, sched = make_env(conf=FULL_CONF)
+    for i in range(2):
+        cache.add_node(build_node(f"n{i}", rl(4000, 8 * GiB, pods=110)))
+    # 8 x 1000m on 2 x 4000m: exactly the cluster's cpu capacity
+    add_job(cache, "gang-fq-qj1", 8, 8, rl(1000, 2 * GiB))
+    cycles(sched, kubelet, 2)
+    assert len(kubelet.binds) == 8, kubelet.binds
+    pg1 = cache.jobs["e2e/gang-fq-qj1"].pod_group
+    assert pg1.status.phase == PodGroupPhase.RUNNING
+    assert pg1.status.running == 8
+
+    add_job(cache, "gang-fq-qj2", 8, 8, rl(1000, 2 * GiB))
+    cycles(sched, kubelet, 3)
+    assert not any(k.startswith("e2e/gang-fq-qj2")
+                   for k in kubelet.binds), kubelet.binds
+    pg2 = cache.jobs["e2e/gang-fq-qj2"].pod_group
+    assert pg2.status.phase == PodGroupPhase.PENDING
+    # the first gang is untouched (victims were rolled back)
+    pg1 = cache.jobs["e2e/gang-fq-qj1"].pod_group
+    assert pg1.status.running == 8
