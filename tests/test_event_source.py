@@ -246,3 +246,89 @@ def test_lost_assumption_cannot_bind_volumeless():
     with pytest.raises(RuntimeError, match="re-allocate"):
         vb.bind_volumes(task)
     assert task.volume_ready is False
+
+
+# ---------------------------------------------------------------------
+# the formal EventSource boundary (cache/source.py)
+# ---------------------------------------------------------------------
+
+def test_informer_map_handlers_exist_and_sources_conform():
+    from kubebatch_tpu.cache import (INFORMER_MAP, EventSource,
+                                     SchedulerCache)
+
+    cache = SchedulerCache(async_writeback=False)
+    for kind, names in INFORMER_MAP.items():
+        for name in names:
+            if name is not None:
+                assert callable(getattr(cache, name)), (kind, name)
+    assert isinstance(StreamingEventSource(), EventSource)
+    from kubebatch_tpu.cache import InformerAdapter
+    assert isinstance(InformerAdapter(), EventSource)
+
+
+def test_informer_adapter_matches_direct_handler_calls():
+    """An InformerAdapter-driven cache ends up state-identical to one
+    driven by direct handler calls (same snapshot, same audit)."""
+    from kubebatch_tpu.cache import (EventType, InformerAdapter,
+                                     SchedulerCache, WatchEvent)
+    from kubebatch_tpu.debug import snapshot_diff
+
+    # ONE fixture set: snapshot_diff compares shared spec objects
+    # (pod/pod_group/node) by identity, so both caches must ingest the
+    # same objects — exactly what two sources over one API server see
+    q = build_queue("q1", weight=2)
+    nodes = [build_node(f"n{i}", rl(4000, 8 * GiB, pods=16))
+             for i in range(3)]
+    pg = build_group("ns", "g0", 2, queue="q1")
+    pods = [build_pod("ns", f"g0-{p}", "", PodPhase.PENDING,
+                      rl(500, GiB), group="g0",
+                      creation_timestamp=float(p)) for p in range(2)]
+    running = build_pod("ns", "g0-run", "n0", PodPhase.RUNNING,
+                        rl(1000, GiB), group="g0")
+
+    def build(direct: bool):
+        cache = SchedulerCache(async_writeback=False)
+        if direct:
+            cache.add_queue(q)
+            for n in nodes:
+                cache.add_node(n)
+            cache.add_pod_group(pg)
+            for p in pods:
+                cache.add_pod(p)
+            cache.add_pod(running)
+            cache.delete_pod(pods[1])
+        else:
+            feed = ([WatchEvent("queues", EventType.ADDED, q)]
+                    + [WatchEvent("nodes", EventType.ADDED, n)
+                       for n in nodes]
+                    + [WatchEvent("podgroups", EventType.ADDED, pg)]
+                    + [WatchEvent("pods", EventType.ADDED, p)
+                       for p in pods]
+                    + [WatchEvent("pods", EventType.ADDED, running),
+                       WatchEvent("pods", EventType.DELETED, pods[1])])
+            adapter = InformerAdapter(feed)
+            adapter.start(cache)
+            assert adapter.sync()
+        return cache
+
+    a = build(direct=True)
+    b = build(direct=False)
+    diff = snapshot_diff(a.snapshot_full(), b.snapshot_full())
+    assert not diff, diff[:5]
+
+
+def test_informer_adapter_routes_volume_kinds_to_sink():
+    from kubebatch_tpu.cache import (EventType, InformerAdapter,
+                                     SchedulerCache, WatchEvent)
+    from kubebatch_tpu.sim import PersistentVolume
+
+    seen = []
+    adapter = InformerAdapter(volume_sink=seen.append)
+    adapter.start(SchedulerCache(async_writeback=False))
+    ev = WatchEvent("persistentvolumes", EventType.ADDED,
+                    PersistentVolume(name="pv0"))
+    adapter.dispatch(ev)
+    assert seen == [ev]
+    import pytest
+    with pytest.raises(KeyError):
+        adapter.dispatch(WatchEvent("gadgets", EventType.ADDED, object()))
