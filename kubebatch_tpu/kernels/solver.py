@@ -38,11 +38,7 @@ import numpy as np
 
 from ..api import NodeInfo
 from ..metrics import update_solver_kernel_duration, update_tensorize_duration
-from .tensorize import (VEC_EPS, NodeState, TaskBatch, _intern_paths,
-                        pad_to_bucket)
-
-#: nonzero-request extraction paths for update_rows' native repack
-_NZ_PATHS = _intern_paths(("resreq", "milli_cpu"), ("resreq", "memory"))
+from .tensorize import VEC_EPS, NodeState, TaskBatch, pad_to_bucket
 
 SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
 
@@ -216,28 +212,10 @@ class DeviceSession:
         if not rows:
             return True
         start = time.perf_counter()
-        from .tensorize import (_NODE_PATHS, NONZERO_MEM_MIB,
-                                NONZERO_MILLI_CPU, load_kb_pack)
+        from .tensorize import accumulate_nz, pack_node_raw
         k = len(rows)
         dirty_nodes = [nodes[state.names[r]] for r in rows]
-        pack = load_kb_pack()
-        if pack is not None:
-            raw = np.empty((k, len(_NODE_PATHS)), np.float64)
-            pack.extract_f64(dirty_nodes, _NODE_PATHS, raw)
-            raw = raw.reshape(k, 4, 3)
-        else:
-            raw = np.array(
-                [(ni.idle.milli_cpu, ni.idle.memory, ni.idle.milli_gpu,
-                  ni.releasing.milli_cpu, ni.releasing.memory,
-                  ni.releasing.milli_gpu,
-                  ni.backfilled.milli_cpu, ni.backfilled.memory,
-                  ni.backfilled.milli_gpu,
-                  ni.allocatable.milli_cpu, ni.allocatable.memory,
-                  ni.allocatable.milli_gpu) for ni in dirty_nodes],
-                np.float64).reshape(k, 4, 3)
-        # nonzero-request sums over the dirty nodes' tasks, vectorized
-        # (upstream GetNonzeroRequests semantics, as NodeState.from_nodes)
-        nz = np.zeros((k, 2), np.float32)
+        raw = pack_node_raw(dirty_nodes)
         t_row: List[int] = []
         t_tasks: List = []
         for j, (r, ni) in enumerate(zip(rows, dirty_nodes)):
@@ -247,21 +225,7 @@ class DeviceSession:
             state.n_tasks[r] = len(ni.tasks)
             state.schedulable[r] = not (bool(ni.node.unschedulable)
                                         if ni.node else True)
-        if t_tasks:
-            t_res = np.empty((len(t_tasks), 2), np.float64)
-            if pack is not None:
-                pack.extract_f64(t_tasks, _NZ_PATHS, t_res)
-            else:
-                for i, t in enumerate(t_tasks):
-                    t_res[i] = (t.resreq.milli_cpu, t.resreq.memory)
-            t_nz = np.empty((len(t_tasks), 2), np.float64)
-            t_nz[:, 0] = np.where(t_res[:, 0] != 0, t_res[:, 0],
-                                  NONZERO_MILLI_CPU)
-            mem_mib = t_res[:, 1] / (1024.0 * 1024.0)
-            t_nz[:, 1] = np.where(mem_mib != 0, mem_mib, NONZERO_MEM_MIB)
-            acc = np.zeros((k, 2), np.float64)
-            np.add.at(acc, np.asarray(t_row, np.int64), t_nz)
-            nz = acc.astype(np.float32)
+        nz = accumulate_nz(t_tasks, t_row, k)
         raw *= VEC_SCALE
         raw32 = raw.astype(np.float32)
         idx = np.asarray(rows, np.int32)

@@ -40,6 +40,52 @@ def nz_request_vec(resreq_vec: np.ndarray) -> np.ndarray:
     return np.array([cpu, mem], np.float32)
 
 
+def pack_node_raw(nodes_seq) -> np.ndarray:
+    """[k, 4, RESOURCE_DIM] float64 HOST-unit idle/releasing/backfilled/
+    allocatable rows for a list of NodeInfo — THE node extraction, shared
+    by the fresh build (NodeState.from_nodes) and the incremental repack
+    (DeviceSession.update_rows) so the two can never drift. Uses the
+    native packer when built."""
+    k = len(nodes_seq)
+    pack = load_kb_pack()
+    if pack is not None:
+        raw = np.empty((k, len(_NODE_PATHS)), np.float64)
+        pack.extract_f64(nodes_seq, _NODE_PATHS, raw)
+        return raw.reshape(k, 4, RESOURCE_DIM)
+    return np.array(
+        [(ni.idle.milli_cpu, ni.idle.memory, ni.idle.milli_gpu,
+          ni.releasing.milli_cpu, ni.releasing.memory,
+          ni.releasing.milli_gpu,
+          ni.backfilled.milli_cpu, ni.backfilled.memory,
+          ni.backfilled.milli_gpu,
+          ni.allocatable.milli_cpu, ni.allocatable.memory,
+          ni.allocatable.milli_gpu) for ni in nodes_seq],
+        np.float64).reshape(k, 4, RESOURCE_DIM)
+
+
+def accumulate_nz(tasks, rows, n_rows: int) -> np.ndarray:
+    """[n_rows, 2] float32 per-row sums of nonzero (cpu_milli, mem_MiB)
+    requests — upstream GetNonzeroRequests semantics, accumulated in
+    float64 and cast ONCE. Shared by NodeState.from_nodes,
+    DeviceSession.update_rows, and VictimState so refreshed rows stay
+    bit-identical to fresh builds."""
+    out = np.zeros((n_rows, 2), np.float64)
+    if tasks:
+        pack = load_kb_pack()
+        res = np.empty((len(tasks), 2), np.float64)
+        if pack is not None:
+            pack.extract_f64(tasks, _NZ_PATHS, res)
+        else:
+            for i, t in enumerate(tasks):
+                res[i] = (t.resreq.milli_cpu, t.resreq.memory)
+        nz = np.empty((len(tasks), 2), np.float64)
+        nz[:, 0] = np.where(res[:, 0] != 0, res[:, 0], NONZERO_MILLI_CPU)
+        mem_mib = res[:, 1] / (1024.0 * 1024.0)
+        nz[:, 1] = np.where(mem_mib != 0, mem_mib, NONZERO_MEM_MIB)
+        np.add.at(out, np.asarray(rows, np.int64), nz)
+    return out.astype(np.float32)
+
+
 def pad_to_bucket(n: int, minimum: int = 8) -> int:
     """Next power-of-two bucket >= max(n, minimum) — keeps jit cache hits
     across cycles while cluster size drifts."""
@@ -136,6 +182,8 @@ _NODE_PATHS = _intern_paths(
     ("allocatable", "milli_cpu"), ("allocatable", "memory"),
     ("allocatable", "milli_gpu"))
 
+_NZ_PATHS = _intern_paths(("resreq", "milli_cpu"), ("resreq", "memory"))
+
 
 @dataclass
 class NodeState:
@@ -182,23 +230,9 @@ class NodeState:
         if n:
             # one packed pass instead of per-Resource to_vec array
             # allocations — this runs over every node each snapshot; the
-            # C packer (native/kb_pack.c) fills the buffer directly when
-            # built, else the equivalent tuple-comprehension pass runs
-            pack = load_kb_pack()
-            if pack is not None:
-                raw = np.empty((n, len(_NODE_PATHS)), np.float64)
-                pack.extract_f64(ordered, _NODE_PATHS, raw)
-                raw = raw.reshape(n, 4, RESOURCE_DIM)
-            else:
-                raw = np.array(
-                    [(ni.idle.milli_cpu, ni.idle.memory, ni.idle.milli_gpu,
-                      ni.releasing.milli_cpu, ni.releasing.memory,
-                      ni.releasing.milli_gpu,
-                      ni.backfilled.milli_cpu, ni.backfilled.memory,
-                      ni.backfilled.milli_gpu,
-                      ni.allocatable.milli_cpu, ni.allocatable.memory,
-                      ni.allocatable.milli_gpu) for ni in ordered],
-                    np.float64).reshape(n, 4, RESOURCE_DIM)
+            # shared pack_node_raw/accumulate_nz helpers keep this path
+            # bit-identical to DeviceSession.update_rows' repack
+            raw = pack_node_raw(ordered)
             raw *= VEC_SCALE
             raw32 = raw.astype(np.float32)
             idle[:n] = raw32[:, 0]
@@ -210,10 +244,14 @@ class NodeState:
             schedulable[:n] = [not (bool(ni.node.unschedulable) if ni.node
                                     else True) for ni in ordered]
             valid[:n] = True
+            all_tasks = []
+            t_row = []
+            for i, ni in enumerate(ordered):
+                all_tasks.extend(ni.tasks.values())
+                t_row.extend([i] * len(ni.tasks))
+            nz_requested[:n] = accumulate_nz(all_tasks, t_row, n)
         for i, ni in enumerate(ordered):
             index[ni.name] = i
-            for t in ni.tasks.values():
-                nz_requested[i] += nz_request_vec(t.resreq.to_vec())
         return cls(names=[ni.name for ni in ordered], idle=idle,
                    releasing=releasing, backfilled=backfilled,
                    allocatable=allocatable, nz_requested=nz_requested,

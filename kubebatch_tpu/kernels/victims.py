@@ -60,9 +60,8 @@ import numpy as np
 from ..api import NodeInfo, TaskInfo, TaskStatus, ready_statuses
 from ..api.resource import RESOURCE_DIM
 from .solver import dynamic_node_score
-from .tensorize import (NONZERO_MEM_MIB, NONZERO_MILLI_CPU, VEC_EPS,
-                        _intern_paths, load_kb_pack, nz_request_vec,
-                        pad_to_bucket)
+from .tensorize import (VEC_EPS, _intern_paths, accumulate_nz, load_kb_pack,
+                        nz_request_vec, pad_to_bucket)
 from ..api.resource import VEC_SCALE
 
 _IMAX = jnp.iinfo(jnp.int32).max
@@ -337,14 +336,8 @@ class VictimState:
                 for i, t in enumerate(all_tasks):
                     rr = t.resreq
                     t_res[i] = (rr.milli_cpu, rr.memory, rr.milli_gpu)
-            nz = np.empty((len(all_tasks), 2), np.float64)
-            nz[:, 0] = np.where(t_res[:, 0] != 0, t_res[:, 0],
-                                NONZERO_MILLI_CPU)
-            mem_mib = t_res[:, 1] / (1024.0 * 1024.0)
-            nz[:, 1] = np.where(mem_mib != 0, mem_mib, NONZERO_MEM_MIB)
-            acc = np.zeros((n_pad, 2), np.float64)
-            np.add.at(acc, t_node, nz)
-            self.nz_req = acc.astype(np.float32)
+            # shared GetNonzeroRequests accumulation (tensorize.py)
+            self.nz_req = accumulate_nz(all_tasks, node_of, n_pad)
         self.node_ok = node_ok
         self.max_task_num = max_task_num
         self.allocatable_cm = allocatable_cm

@@ -80,7 +80,9 @@ class SimCluster:
                                 []).append(p)
         recycled = 0
         done = 0
-        for g in list(self.groups):
+        doomed_pods: set = set()
+        doomed_groups: set = set()
+        for g in self.groups:
             if done >= n_groups:
                 break
             if not g.name.startswith("job-"):
@@ -90,11 +92,17 @@ class SimCluster:
                 continue
             for p in pods:
                 cache.delete_pod(p)
-                self.pods.remove(p)
+                doomed_pods.add(p.uid)
             cache.delete_pod_group(g)
-            self.groups.remove(g)
+            doomed_groups.add(g.name)
             recycled += len(pods)
             done += 1
+        if doomed_pods:
+            # one rebuild instead of per-pod list.remove (each remove is a
+            # field-by-field dataclass scan of the full 10k+ pod list)
+            self.pods = [p for p in self.pods if p.uid not in doomed_pods]
+            self.groups = [g for g in self.groups
+                           if g.name not in doomed_groups]
         self._pod_index = None
         base_ts = 1e9 + self._churn_seq
         for k in range(done):
