@@ -144,6 +144,16 @@ def _segmented_prefix(values: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
     return sums - values                                   # exclusive
 
 
+#: demand-window fraction: jobs whose exclusive cumulative demand prefix
+#: stays under this fraction of the round's available capacity join the
+#: round. Below 1.0 because aggregate capacity overstates what placement
+#: can use (bin-packing fragmentation): admitting demand up to raw
+#: capacity lets dozens of gangs start that cannot all finish, stranding
+#: their partial allocations (gang all-or-nothing). The first engaged job
+#: is always admitted (exclusive prefix 0), so rounds always progress.
+_WINDOW_SLACK = 0.85
+
+
 def _round(state: RoundState, a: CycleArrays, round_idx,
            job_keys: Tuple[str, ...], queue_keys: Tuple[str, ...],
            prop_overused: bool, dyn_enabled: bool,
@@ -187,9 +197,84 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     job_sort_rank = jnp.zeros_like(job_order).at[job_order].set(
         jnp.arange(job_order.shape[0]))
 
-    participating = (a.task_valid & (state.task_state == SKIP)
-                     & state.job_alive[a.task_job] & a.job_valid[a.task_job]
-                     & ~overused[a.job_queue[a.task_job]])
+    engaged = (a.task_valid & (state.task_state == SKIP)
+               & state.job_alive[a.task_job] & a.job_valid[a.task_job]
+               & ~overused[a.job_queue[a.task_job]])
+
+    # ---- demand window --------------------------------------------------
+    # Under contention, unlimited round parallelism fragments capacity
+    # across MANY incomplete gangs (every job places a few tasks, few
+    # reach MinAvailable) — the sequential reference concentrates capacity
+    # job-by-job instead (allocate.go: one job visit at a time). Emulate
+    # that concentration without giving up the single dispatch: only the
+    # best-ranked jobs whose cumulative remaining demand fits inside the
+    # window fraction of the round's available capacity participate;
+    # later jobs wait for a subsequent round, by which point earlier
+    # gangs completed or died. With total demand under the window
+    # fraction of capacity the window admits everyone and behavior is
+    # unchanged; between the fraction and full capacity a small tail is
+    # deferred a round (cheap insurance against stranding).
+    j_pad = a.job_valid.shape[0]
+    avail_pool = jnp.where((a.node_ok
+                            & (state.n_tasks < a.max_task_num))[:, None],
+                           jnp.maximum(state.idle + a.backfilled, 0.0), 0.0
+                           ).sum(axis=0)                      # [R]
+    if pipe_enabled:
+        avail_pool = avail_pool + jnp.maximum(state.releasing, 0.0).sum(
+            axis=0)
+    job_demand = jax.ops.segment_sum(
+        jnp.where(engaged[:, None], a.resreq, 0.0),
+        jnp.maximum(a.task_job, 0), num_segments=j_pad)       # [J,R]
+    eng_job = jnp.any(job_demand > 0, axis=-1)                # [J]
+    # dominant normalized demand (0 when the cluster has no capacity in a
+    # dimension nobody can place anyway)
+    norm = jnp.max(
+        jnp.where(avail_pool[None, :] > 0,
+                  job_demand / jnp.maximum(avail_pool[None, :], 1e-9),
+                  0.0), axis=-1)                              # [J]
+    norm_ord = norm[job_order]
+    cum_excl = jnp.cumsum(norm_ord) - norm_ord
+    in_window = cum_excl <= _WINDOW_SLACK                     # [J] ord
+
+    # per-queue budget: the sequential reference re-checks overuse at
+    # every queue POP, so a queue only ever exceeds its deserved by the
+    # one job in flight; a round that admits a whole queue's backlog at
+    # round-start shares locks an overshoot in before ordering can react.
+    # Admit each queue's jobs (rank order) while their cumulative demand
+    # stays inside the queue's REMAINING deserved; the queue's first
+    # engaged job is always admitted (= the pop in flight).
+    if prop_overused:
+        q_remaining = jnp.maximum(a.q_deserved - state.q_allocated, 0.0)
+        qr_job = q_remaining[a.job_queue]                     # [J,R]
+        # dims with zero remaining are unconstrained for pacing — the
+        # overuse rule itself is all-dims (proportion.go:362-373), and a
+        # queue exhausted in one dim but not others keeps receiving jobs
+        # in the reference until overused actually flips
+        qn = jnp.max(jnp.where(qr_job > 0,
+                               job_demand / jnp.maximum(qr_job, 1e-9),
+                               0.0),
+                     axis=-1)                                 # [J]
+        # group jobs by queue, rank-ordered inside each queue; segment
+        # starts via the same searchsorted idiom as acceptance
+        qperm = jnp.lexsort([job_sort_rank, a.job_queue])
+        qj = a.job_queue[qperm]
+        seg_start = jnp.searchsorted(qj, qj, side="left")
+        q_prefix = _segmented_prefix(qn[qperm], seg_start)
+        eng_cnt = _segmented_prefix(
+            eng_job[qperm].astype(jnp.float32), seg_start)
+        first_engaged = eng_job[qperm] & (eng_cnt == 0.0)
+        q_ok_perm = (q_prefix <= 1.0) | first_engaged
+        q_ok = jnp.zeros(j_pad, bool).at[qperm].set(q_ok_perm)
+        # queue-rejected jobs must not count against the global window —
+        # their demand is NOT consuming capacity this round
+        norm_ord = norm_ord * q_ok[job_order]
+        cum_excl = jnp.cumsum(norm_ord) - norm_ord
+        in_window = cum_excl <= _WINDOW_SLACK
+    else:
+        q_ok = jnp.ones(j_pad, bool)
+
+    admitted = jnp.zeros(j_pad, bool).at[job_order].set(in_window) & q_ok
+    participating = engaged & admitted[a.task_job]
 
     # global task rank: (job order, task order); non-participants last
     jr = jnp.where(participating, job_sort_rank[a.task_job], _IMAX)
@@ -479,12 +564,17 @@ def batched_allocate(state: RoundState, a: CycleArrays,
     """The whole allocate cycle: rounds run in a device-side while_loop
     until a round makes no progress — ONE dispatch, one readback.
 
-    ``compact_bucket``: round 0 typically resolves ~90%% of tasks; the
-    leftovers are gathered into a bucket of this size and the remaining
-    rounds run at [bucket, N] instead of [T, N] cost (1/8th the fit/score
-    HBM traffic). If more than ``compact_bucket`` tasks survive round 0, a
-    lax.cond falls back to the full-width loop — same results either way,
-    task seqs stay globally ordered via the shared seq stride."""
+    ``compact_bucket``: in the common low-contention cycle round 0
+    resolves ~90%% of tasks; the leftovers are gathered into a bucket of
+    this size and the remaining rounds run at [bucket, N] instead of
+    [T, N] cost (1/8th the fit/score HBM traffic). If more than
+    ``compact_bucket`` tasks survive round 0, a lax.cond falls back to
+    the full-width loop — same results either way, task seqs stay
+    globally ordered via the shared seq stride. NB: under contention the
+    demand window intentionally defers whole jobs past round 0, so
+    contended cycles routinely exceed the bucket and run full-width —
+    the compaction is an optimization for the uncontended steady regime,
+    not the contended one."""
     t_pad = a.task_valid.shape[0]
 
     def loop(st, arrays, start_round):
