@@ -244,7 +244,7 @@ def main(argv=None):
                          "default cfg5 run appends to its JSON line")
     ap.add_argument("--mode", default="auto",
                     choices=["auto", "batched", "sharded", "fused", "jax",
-                             "host"],
+                             "host", "rpc"],
                     help="allocate engine: auto = size-based selection "
                          "(the shipped default); batched = round-based "
                          "throughput engine (policy-exact, order-"

@@ -17,6 +17,10 @@ Solver modes (KUBEBATCH_SOLVER env or constructor arg):
   dispatches, used when the configured plugins fall outside the fused
   kernel's key vocabulary.
 - "host": the reference-literal per-pair loops — the semantic oracle.
+- "rpc": the whole action through the gRPC solver sidecar (rpc/), which
+  picks its engine by snapshot size like auto mode; falls back to the
+  in-process auto path when the sidecar is unreachable or the snapshot
+  exceeds its vocabulary.
 
 
 ref: pkg/scheduler/actions/allocate/allocate.go. Control flow is preserved
@@ -85,23 +89,39 @@ class AllocateAction(Action):
     def mode(self) -> str:
         return self._mode or os.environ.get("KUBEBATCH_SOLVER", "auto")
 
+    @staticmethod
+    def _auto_mode(ssn: Session) -> str:
+        """Size-based engine selection (the shipped default and the
+        rpc-unavailable fallback share it)."""
+        pending = sum(
+            len(j.task_status_index.get(TaskStatus.PENDING, {}))
+            for j in ssn.jobs.values())
+        if pending < AUTO_BATCHED_MIN:
+            return "fused"
+        if len(ssn.nodes) >= AUTO_SHARDED_MIN_NODES:
+            import jax
+            if len(jax.devices()) > 1:
+                # multi-chip host, big node axis: the shipped default
+                # partitions the round engine over the mesh
+                # (SURVEY §2.9 row 43)
+                return "sharded"
+        return "batched"
+
     def execute(self, ssn: Session) -> None:
         mode = self.mode
         if mode == "auto":
-            pending = sum(
-                len(j.task_status_index.get(TaskStatus.PENDING, {}))
-                for j in ssn.jobs.values())
-            if pending >= AUTO_BATCHED_MIN:
-                mode = "batched"
-                if len(ssn.nodes) >= AUTO_SHARDED_MIN_NODES:
-                    import jax
-                    if len(jax.devices()) > 1:
-                        # multi-chip host, big node axis: the shipped
-                        # default partitions the round engine over the
-                        # mesh (SURVEY §2.9 row 43)
-                        mode = "sharded"
-            else:
-                mode = "fused"
+            mode = self._auto_mode(ssn)
+        if mode == "rpc":
+            # route the whole action through the gRPC solver sidecar
+            # (KUBEBATCH_SOLVER=rpc; address from KUBEBATCH_SOLVER_ADDR).
+            # The sidecar picks its engine by snapshot size like auto
+            # mode; on connection failure or an out-of-vocabulary
+            # snapshot the action falls back to the in-process auto path
+            # (the reference's convergence-by-rescheduling spirit: a
+            # degraded cycle beats a skipped one)
+            if self._execute_rpc(ssn):
+                return
+            mode = self._auto_mode(ssn)
         if mode in ("batched", "sharded"):
             from .allocate_batched import batched_supported, execute_batched
             # execute_batched itself returns False (without consuming
@@ -119,6 +139,33 @@ class AllocateAction(Action):
             # configured plugins exceed the fused vocabulary; fall back to
             # the per-visit device solver
         self._execute_queued(ssn, mode)
+
+    def _execute_rpc(self, ssn: Session) -> bool:
+        """One remote solve through the sidecar; False = fall back.
+
+        Fallback is only legal BEFORE any session mutation: snapshot
+        encoding and the remote call can fail over to in-process safely,
+        but replay errors propagate (a partially-replayed session must
+        not be re-solved by another engine on inconsistent state)."""
+        import logging
+
+        from ..rpc.client import get_solver_client
+
+        addr = os.environ.get("KUBEBATCH_SOLVER_ADDR", "127.0.0.1:50061")
+        try:
+            client = get_solver_client(addr)
+            req, tasks_by_uid = client.snapshot_from_session(ssn)
+            resp = client.solve(req)
+        except ValueError:
+            # snapshot exceeds the sidecar vocabulary — known, quiet
+            return False
+        except Exception as e:
+            logging.getLogger("kubebatch").warning(
+                "solver sidecar %s unavailable (%s); running in-process",
+                addr, e)
+            return False
+        client.apply_decisions(ssn, resp, tasks_by_uid)
+        return True
 
     def _execute_queued(self, ssn: Session, mode: Optional[str] = None) -> None:
         if mode is None:

@@ -33,6 +33,18 @@ class _StateShim:
         self.state = state
 
 
+#: process-wide client per sidecar address (KUBEBATCH_SOLVER=rpc mode —
+#: one channel per daemon, not one per cycle)
+_CLIENTS: Dict[str, "SolverClient"] = {}
+
+
+def get_solver_client(target: str) -> "SolverClient":
+    client = _CLIENTS.get(target)
+    if client is None:
+        client = _CLIENTS[target] = SolverClient(target)
+    return client
+
+
 class SolverClient:
     def __init__(self, target: str):
         self._channel = grpc.insecure_channel(target)
@@ -153,29 +165,55 @@ class SolverClient:
         t.sig_scores.extend(
             np.asarray(static.score[:, :n], np.float32).reshape(-1).tolist())
         t.task_sig.extend(static.sig_of[uid] for uid in tasks_by_uid)
+        # task_nz always travels: the batched engine's waterfall cohorts
+        # are (sig, nonzero-request) pairs even with dynamic scoring off
+        for task in pending:
+            t.task_nz.extend(
+                nz_request_vec(task.resreq.to_vec()).tolist())
         if terms.dynamic.enabled:
             t.least_requested_weight = terms.dynamic.least_requested
             t.balanced_resource_weight = terms.dynamic.balanced_resource
-            for task in pending:
-                t.task_nz.extend(
-                    nz_request_vec(task.resreq.to_vec()).tolist())
             t.node_nz.extend(
                 state.nz_requested[:n].reshape(-1).tolist())
             t.allocatable_cm.extend(
                 state.allocatable[:n, :2].reshape(-1).tolist())
 
-    def solve_and_apply(self, ssn: Session) -> solver_pb2.DecisionsResponse:
-        """One remote solve; decisions replayed through the Session."""
-        req, tasks_by_uid = self.snapshot_from_session(ssn)
-        resp = self._solve(req)
+    def solve(self, req, timeout: float = 60.0
+              ) -> solver_pb2.DecisionsResponse:
+        """The remote call alone — no session mutation. Callers that want
+        a fallback path must fall back BEFORE apply_decisions runs;
+        after the replay starts the session is committed to the remote
+        decisions."""
+        return self._solve(req, timeout=timeout)
+
+    @staticmethod
+    def apply_decisions(ssn: Session, resp, tasks_by_uid) -> None:
+        """Replay the remote decisions through the Session. A pre-mutation
+        volume-allocation failure skips that task (it stays Pending and
+        reschedules next cycle — the remote solver cannot offer the
+        ordered path's try-next-node, ref allocate.go:157-161); any other
+        error propagates, it must NOT be treated as sidecar
+        unavailability."""
+        from ..framework import VolumeAllocationError
+
         decisions = [d for d in resp.decisions if d.order >= 0]
         decisions.sort(key=lambda d: d.order)
         for d in decisions:
             task = tasks_by_uid.get(d.task_uid)
             if task is None:
                 continue
-            if d.kind in (ALLOC, ALLOC_OB):
-                ssn.allocate(task, d.node_name, d.kind == ALLOC_OB)
-            elif d.kind == PIPELINE:
-                ssn.pipeline(task, d.node_name)
+            try:
+                if d.kind in (ALLOC, ALLOC_OB):
+                    ssn.allocate(task, d.node_name, d.kind == ALLOC_OB)
+                elif d.kind == PIPELINE:
+                    ssn.pipeline(task, d.node_name)
+            except VolumeAllocationError:
+                continue
+
+    def solve_and_apply(self, ssn: Session,
+                        timeout: float = 60.0) -> solver_pb2.DecisionsResponse:
+        """One remote solve; decisions replayed through the Session."""
+        req, tasks_by_uid = self.snapshot_from_session(ssn)
+        resp = self.solve(req, timeout=timeout)
+        self.apply_decisions(ssn, resp, tasks_by_uid)
         return resp

@@ -1,9 +1,12 @@
 """gRPC solver sidecar — dense snapshots in, assignment decisions out.
 
-Serves the fused allocate kernel behind the Solver service defined in
-solver.proto. The service wiring is hand-written over grpc generic
-handlers (grpcio-tools is not available in this image; message classes
-are protoc-generated into solver_pb2.py).
+Serves the allocate kernels behind the Solver service defined in
+solver.proto, selecting the engine by snapshot size exactly like the
+in-process auto mode (actions/allocate.py): snapshots at or above
+AUTO_BATCHED_MIN pending tasks run the round-based batched engine,
+smaller ones the bind-for-bind fused engine. The service wiring is
+hand-written over grpc generic handlers (grpcio-tools is not available
+in this image; message classes are protoc-generated into solver_pb2.py).
 """
 from __future__ import annotations
 
@@ -136,18 +139,44 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
     dyn_weights = np.asarray([terms.least_requested_weight,
                               terms.balanced_resource_weight], np.float32)
     dyn_enabled = bool(dyn_weights.any())
+    # task_nz travels regardless of the dynamic flags: the batched
+    # engine's waterfall cohorts are (sig, nonzero-request) pairs even
+    # when dynamic scoring is off
     task_nz = np.zeros((t_pad, 2), np.float32)
     allocatable_cm = np.zeros((n_pad, 2), np.float32)
     nz_req0 = np.zeros((n_pad, 2), np.float32)
-    if dyn_enabled:
+    if len(terms.task_nz):
         task_nz[:t] = np.asarray(terms.task_nz, np.float32).reshape(t, 2)
+    if len(terms.node_nz):
         nz_req0[:n] = np.asarray(terms.node_nz, np.float32).reshape(n, 2)
+    if len(terms.allocatable_cm):
         allocatable_cm[:n] = np.asarray(
             terms.allocatable_cm, np.float32).reshape(n, 2)
 
     j_alloc0 = np.zeros((j_pad, 3), np.float32)
     if len(jobs.allocated):
         j_alloc0[:j] = _mat(jobs.allocated, j)
+
+    # ---- engine selection by snapshot size (in-process auto parity) ----
+    from ..actions.allocate import AUTO_BATCHED_MIN
+    if t >= AUTO_BATCHED_MIN:
+        return _solve_batched_wire(
+            req, nodes, tasks, n, t,
+            idle=idle, releasing=releasing, backfilled=backfilled,
+            mtn=mtn, ntasks=ntasks, node_ok=node_ok,
+            resreq=resreq, init_resreq=init_resreq, task_job=task_job,
+            task_rank=task_rank, task_valid=task_valid, task_sig=task_sig,
+            sig_scores=sig_scores, sig_pred=sig_pred, task_nz=task_nz,
+            allocatable_cm=allocatable_cm, nz_req0=nz_req0,
+            min_av=min_av, order_min_av=order_min_av,
+            init_ready=init_ready, job_queue=job_queue,
+            job_priority=job_priority, job_create_rank=job_create_rank,
+            job_valid=job_valid, q_weight=q_weight, q_entries=q_entries,
+            q_create_rank=q_create_rank, q_deserved=q_deserved,
+            q_alloc0=q_alloc0, j_alloc0=j_alloc0,
+            cluster_total=cluster_total, dyn_weights=dyn_weights,
+            dyn_enabled=dyn_enabled, job_keys=tuple(job_keys),
+            queue_keys=queue_keys)
 
     start = time.perf_counter()
     (host_block, *_device_state) = fused_allocate(
@@ -177,6 +206,79 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
 
     resp = solver_pb2.DecisionsResponse(solve_ms=solve_ms,
                                         iterations=int(iters))
+    for i in range(t):
+        kind = int(task_state[i])
+        resp.decisions.append(solver_pb2.Decision(
+            task_uid=tasks.uids[i], kind=kind,
+            node_name=(nodes.names[int(task_node[i])]
+                       if kind in (ALLOC, ALLOC_OB, PIPELINE) else ""),
+            order=int(task_seq[i]) if kind != SKIP else -1))
+    return resp
+
+
+class _WireDevice:
+    """DeviceSession stand-in for the sidecar: just the capacity arrays
+    solve_batched reads and commits (no cross-cycle reuse server-side —
+    every request carries its own snapshot)."""
+
+    def __init__(self, idle, releasing, backfilled, allocatable_cm, nz_req,
+                 n_tasks, max_task_num, node_ok):
+        self.idle = jnp.asarray(idle)
+        self.releasing = jnp.asarray(releasing)
+        self.backfilled = jnp.asarray(backfilled)
+        self.allocatable_cm = jnp.asarray(allocatable_cm)
+        self.nz_req = jnp.asarray(nz_req)
+        self.n_tasks = jnp.asarray(n_tasks)
+        self.max_task_num = jnp.asarray(max_task_num)
+        self.node_ok = jnp.asarray(node_ok)
+
+
+def _solve_batched_wire(req, nodes, tasks, n, t, *, idle, releasing,
+                        backfilled, mtn, ntasks, node_ok, resreq,
+                        init_resreq, task_job, task_rank, task_valid,
+                        task_sig, sig_scores, sig_pred, task_nz,
+                        allocatable_cm, nz_req0, min_av, order_min_av,
+                        init_ready, job_queue, job_priority,
+                        job_create_rank, job_valid, q_weight, q_entries,
+                        q_create_rank, q_deserved, q_alloc0, j_alloc0,
+                        cluster_total, dyn_weights, dyn_enabled, job_keys,
+                        queue_keys) -> solver_pb2.DecisionsResponse:
+    """Round-engine path: rebuild CycleInputs from the wire arrays and
+    run the same solve_batched the in-process batched mode uses."""
+    from ..actions.cycle_inputs import CycleInputs
+    from ..kernels.batched import solve_batched
+
+    inputs = CycleInputs(
+        queue_ids=list(req.queues.names), jobs=[], tasks=[None] * t,
+        device=None,
+        resreq=resreq, init_resreq=init_resreq, resreq_raw=None,
+        task_nz=task_nz, task_job=task_job, task_rank=task_rank,
+        task_sig=task_sig, task_valid=task_valid,
+        sig_scores=sig_scores, sig_pred=sig_pred,
+        min_available=min_av, order_min_available=order_min_av,
+        init_allocated=init_ready, job_queue=job_queue,
+        job_priority=job_priority, job_create_rank=job_create_rank,
+        job_valid=job_valid,
+        q_weight=q_weight, q_entries=q_entries,
+        q_create_rank=q_create_rank, q_deserved=q_deserved,
+        q_alloc0=q_alloc0, j_alloc0=j_alloc0,
+        cluster_total=cluster_total,
+        dyn_weights=dyn_weights, dyn_enabled=dyn_enabled,
+        job_keys=job_keys, queue_keys=queue_keys,
+        gang_enabled=req.gang_enabled,
+        prop_overused=req.proportion_enabled,
+        # strictly-positive like the in-process derivation
+        # (cycle_inputs.py pipe_enabled) — negative releasing rows
+        # (pipelined reuse) must not enable the pipeline path
+        pipe_enabled=bool((np.asarray(releasing)[:n] > 0).any()))
+    device = _WireDevice(idle, releasing, backfilled, allocatable_cm,
+                         nz_req0, ntasks, mtn, node_ok)
+    start = time.perf_counter()
+    task_state, task_node, task_seq, rounds = solve_batched(device, inputs)
+    solve_ms = (time.perf_counter() - start) * 1e3
+
+    resp = solver_pb2.DecisionsResponse(solve_ms=solve_ms,
+                                        iterations=int(rounds))
     for i in range(t):
         kind = int(task_state[i])
         resp.decisions.append(solver_pb2.Decision(

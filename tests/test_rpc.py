@@ -175,3 +175,82 @@ def test_sidecar_rejects_inexpressible_snapshot(sidecar):
     with pytest.raises(ValueError):
         sidecar.snapshot_from_session(ssn)
     CloseSession(ssn)
+
+
+def mk_big_cluster():
+    """~1k pending tasks across weighted queues on 120 nodes — enough to
+    cross AUTO_BATCHED_MIN so the sidecar routes to the round engine."""
+    binder = RecordingBinder()
+    cache = SchedulerCache(binder=binder, async_writeback=False)
+    cache.add_queue(build_queue("q1", 1))
+    cache.add_queue(build_queue("q2", 3))
+    for i in range(120):
+        cache.add_node(build_node(f"n{i:03d}", rl(8000, 16 * GiB,
+                                                  pods=110)))
+    for g in range(250):
+        q = "q1" if g % 2 == 0 else "q2"
+        cache.add_pod_group(build_group("ns", f"pg{g:03d}", 3, queue=q,
+                                        creation_timestamp=float(g)))
+        for p in range(4):
+            cache.add_pod(build_pod(
+                "ns", f"g{g:03d}-p{p}", "", PodPhase.PENDING,
+                rl(500 + (g % 5) * 100, GiB), group=f"pg{g:03d}",
+                priority=(g % 3) + 1,
+                creation_timestamp=float(g * 10 + p)))
+    return cache, binder
+
+
+def test_sidecar_batched_engine_matches_in_process(sidecar):
+    """A 1000-task snapshot crosses the sidecar's size threshold: it must
+    run the round engine and produce the same session end state as the
+    in-process batched mode."""
+    from kubebatch_tpu.actions.allocate import AUTO_BATCHED_MIN
+
+    from kubebatch_tpu.api import TaskStatus
+
+    results = {}
+    for path in ("rpc", "batched"):
+        cache, binder = mk_big_cluster()
+        ssn = OpenSession(cache, tiers())
+        pending = sum(len(j.task_status_index.get(TaskStatus.PENDING, {}))
+                      for j in ssn.jobs.values())
+        assert pending >= AUTO_BATCHED_MIN, pending
+        if path == "rpc":
+            resp = sidecar.solve_and_apply(ssn)
+            # the round engine reports rounds (a handful), not the fused
+            # engine's per-placement iterations (1000+)
+            assert resp.iterations < 64, resp.iterations
+        else:
+            AllocateAction(mode="batched").execute(ssn)
+        state = {t.key: (str(t.status), t.node_name)
+                 for job in ssn.jobs.values() for t in job.tasks.values()}
+        CloseSession(ssn)
+        results[path] = (state, dict(binder.binds))
+    assert len(results["batched"][1]) >= AUTO_BATCHED_MIN
+    assert results["rpc"][0] == results["batched"][0]
+    assert results["rpc"][1] == results["batched"][1]
+
+
+def test_rpc_solver_mode_falls_back_without_sidecar(monkeypatch):
+    """KUBEBATCH_SOLVER=rpc with no sidecar running must degrade to the
+    in-process path, not fail the cycle."""
+    monkeypatch.setenv("KUBEBATCH_SOLVER_ADDR", "127.0.0.1:1")
+    cache, binder = mk_cluster()
+    ssn = OpenSession(cache, tiers())
+    AllocateAction(mode="rpc").execute(ssn)
+    CloseSession(ssn)
+    assert len(binder.binds) == 8
+
+
+def test_rpc_solver_mode_end_to_end(monkeypatch):
+    """KUBEBATCH_SOLVER=rpc routes the allocate action through the
+    sidecar and produces the same binds as in-process."""
+    server, port = make_server("127.0.0.1:0")
+    server.start()
+    monkeypatch.setenv("KUBEBATCH_SOLVER_ADDR", f"127.0.0.1:{port}")
+    cache, binder = mk_cluster()
+    ssn = OpenSession(cache, tiers())
+    AllocateAction(mode="rpc").execute(ssn)
+    CloseSession(ssn)
+    server.stop(grace=None)
+    assert len(binder.binds) == 8
