@@ -61,7 +61,7 @@ class _TaskIn(NamedTuple):
     pred: jnp.ndarray         # [N] per-task predicate mask
 
 
-def dynamic_node_score(nz_req, t_nz, allocatable_cm, dyn_weights):
+def dynamic_node_score(nz_req, t_nz, allocatable_cm, dyn_weights, xp=jnp):
     """nodeorder's allocation-dependent terms, from the capacity carry.
 
     Mirrors plugins/nodeorder.py least_requested_score /
@@ -71,19 +71,28 @@ def dynamic_node_score(nz_req, t_nz, allocatable_cm, dyn_weights):
     (cap-req)*10 >= d*cap) — division-free, so float32 rounding can only
     bite when a product pair is genuinely within f32 ulp of equal.
     dyn_weights: [least_requested_w, balanced_resource_w] float32.
+
+    ``xp`` selects the array module: jnp inside the jitted kernels, np
+    for the wave chooser's host-side fresh-score recompute
+    (kernels/victims.py) — ONE implementation so the two can never
+    drift; every scalar is pinned to float32 so numpy matches the
+    kernel's weak-typed float32 arithmetic bit for bit.
     """
+    f32 = xp.float32
+    ten = f32(10.0)
     req = nz_req + t_nz[None, :]                      # [N,2]
     cap = allocatable_cm                              # [N,2]
-    d = jnp.arange(1.0, 11.0, dtype=jnp.float32)      # [10]
-    ge = ((cap - req)[None] * 10.0 >= d[:, None, None] * cap[None])
-    dim = jnp.where((cap > 0) & (req <= cap),
-                    ge.sum(axis=0).astype(jnp.float32), 0.0)   # [N,2]
-    least = jnp.floor((dim[:, 0] + dim[:, 1]) / 2.0)
+    d = xp.arange(1.0, 11.0, dtype=f32)               # [10]
+    ge = ((cap - req)[None] * ten >= d[:, None, None] * cap[None])
+    dim = xp.where((cap > 0) & (req <= cap),
+                   ge.sum(axis=0).astype(f32), f32(0.0))   # [N,2]
+    least = xp.floor((dim[:, 0] + dim[:, 1]) / f32(2.0))
 
-    frac = jnp.where(cap > 0, req / cap, 1.0)
-    diff = jnp.abs(frac[:, 0] - frac[:, 1])
-    balanced = jnp.where((frac[:, 0] >= 1.0) | (frac[:, 1] >= 1.0), 0.0,
-                         jnp.trunc(10.0 - diff * 10.0))
+    frac = xp.where(cap > 0, req / xp.where(cap > 0, cap, f32(1.0)),
+                    f32(1.0))
+    diff = xp.abs(frac[:, 0] - frac[:, 1])
+    balanced = xp.where((frac[:, 0] >= 1.0) | (frac[:, 1] >= 1.0),
+                        f32(0.0), xp.trunc(ten - diff * ten))
     return least * dyn_weights[0] + balanced * dyn_weights[1]
 
 
