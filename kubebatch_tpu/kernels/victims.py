@@ -660,6 +660,13 @@ class VictimSolver:
         self._prop = any("proportion" in t for t in tiers)
         #: dispatch counter (tests assert the wave property)
         self.dispatches = 0
+        #: lazy escalation: a wave lane costs real compute, so on the
+        #: host-process CPU backend (self._dev set, latency ~free) the
+        #: solver starts with cheap per-visit dispatches and only
+        #: escalates to wave caching once the visit count shows a wave
+        #: will amortize; on the platform-default device (accelerator —
+        #: dispatch LATENCY dominates) waves start immediately
+        self._wave_after = 4 if self._dev is not None else 0
 
     def _upload(self):
         """Device copies of the state arrays: the immutable set once per
@@ -692,7 +699,8 @@ class VictimSolver:
     # ------------------------------------------------------------------
     def visit(self, task: TaskInfo, filter_kind: str,
               visited: np.ndarray) -> VisitResult:
-        if not self._wave_on or task.uid not in self._pos:
+        if not self._wave_on or task.uid not in self._pos \
+                or self.dispatches < self._wave_after:
             self.dispatches += 1
             return self._visit_single(task, filter_kind, visited)
         key = (filter_kind, task.uid)
@@ -823,8 +831,13 @@ class VictimSolver:
         if single:
             chunk = [anchor]
         else:
-            pos = self._pos[anchor.uid]
-            chunk = self.pending[pos:pos + self._wave_size]
+            # BLOCK-aligned chunks: consumption order (the actions'
+            # fairness heaps) jumps around the pending list, so pos-based
+            # slices would re-wave on nearly every visit; fixed blocks
+            # keep any consumption order within ceil(len/W) waves
+            block = self._pos[anchor.uid] // self._wave_size
+            start = block * self._wave_size
+            chunk = self.pending[start:start + self._wave_size]
         p = len(chunk)
         p_pad = pad_to_bucket(p, 1 if single else 8)
         n_pad_score = self.terms.static.score.shape[1]

@@ -434,4 +434,6 @@ def test_wave_dispatch_count_sublinear(monkeypatch):
     per_visit = sum(s.dispatches for s in counts["per-visit"])
     wave = sum(s.dispatches for s in counts["wave"])
     assert per_visit >= 10, f"scenario too small ({per_visit} dispatches)"
-    assert wave * 2 <= per_visit, (wave, per_visit)
+    # wave mode pays a few escalation singles up front (the low-visit
+    # protection), then amortizes: comfortably under 60% of per-visit
+    assert wave * 1.67 <= per_visit, (wave, per_visit)
