@@ -156,10 +156,12 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn: Session) -> None:
-        from ..kernels.victims import build_action_solver
+        from ..kernels.victims import SKIP_ACTION, build_action_solver
         solver = build_action_solver(ssn, "preemptable_fns",
                                      "preemptable_disabled",
                                      score_nodes=True)
+        if solver is SKIP_ACTION:
+            return
 
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, PriorityQueue] = {}

@@ -38,10 +38,12 @@ class ReclaimAction(Action):
         if len(queue_names) <= 1:
             return
 
-        from ..kernels.victims import build_action_solver
+        from ..kernels.victims import SKIP_ACTION, build_action_solver
         solver = build_action_solver(ssn, "reclaimable_fns",
                                      "reclaimable_disabled",
                                      score_nodes=False)
+        if solver is SKIP_ACTION:
+            return
 
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_map = {}

@@ -944,21 +944,38 @@ class VictimSolver:
             victims_count=int(vcount), prop_guard=bool(guard))
 
 
+#: build_action_solver sentinel: the action can observably do nothing
+#: (no RUNNING task exists anywhere) — skip its loops entirely. ONE
+#: decision point for both actions, host-oracle mode exempted.
+SKIP_ACTION = object()
+
+
 def build_action_solver(ssn, fns_attr: str, disabled_attr: str,
                         score_nodes: bool):
     """The env-gated entry the preempt/reclaim actions share: collects the
-    session's pending tasks and builds the kernel solver, or returns None
+    session's pending tasks and builds the kernel solver; returns None
     for the host path (KUBEBATCH_VICTIM_SOLVER=host, nothing pending, or
-    an unsupported snapshot)."""
+    an unsupported snapshot), or SKIP_ACTION when no victim can exist —
+    with no RUNNING task in any job, every visit would scan to an empty
+    set, so the action skips the solver build AND its loops (the
+    task_status_index check is exact: empty buckets are deleted)."""
     if os.environ.get("KUBEBATCH_VICTIM_SOLVER", "device") == "host":
         return None
+    if not any(TaskStatus.RUNNING in j.task_status_index
+               for j in ssn.jobs.values()):
+        return SKIP_ACTION
     pending = [t for job in ssn.jobs.values()
                for t in job.task_status_index.get(TaskStatus.PENDING,
                                                   {}).values()]
     if not pending:
         return None
-    return build_victim_solver(ssn, pending, fns_attr, disabled_attr,
-                               score_nodes)
+    solver = build_victim_solver(ssn, pending, fns_attr, disabled_attr,
+                                 score_nodes)
+    if solver is not None and not solver.state.victims:
+        # running tasks exist but none materialized as victim rows
+        # (e.g. all on placeholder nodes)
+        return SKIP_ACTION
+    return solver
 
 
 def build_victim_solver(ssn, pending: Sequence[TaskInfo],
