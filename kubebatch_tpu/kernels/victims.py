@@ -363,6 +363,31 @@ class _Victim:
     job_idx: int
 
 
+class _VictimRows:
+    """Lazy row view over the VictimState's parallel victim arrays —
+    indexing materializes a _Victim for just that row."""
+    __slots__ = ("_state", "tasks")
+
+    def __init__(self, state, tasks):
+        self._state = state
+        self.tasks = tasks
+
+    def __len__(self):
+        return len(self.tasks)
+
+    def __bool__(self):
+        return bool(self.tasks)
+
+    def __getitem__(self, row: int) -> _Victim:
+        # v_node/v_job are PADDED arrays — plain indexing would pair a
+        # real task with pad-row data on negative indices
+        if not 0 <= row < len(self.tasks):
+            raise IndexError(row)
+        st = self._state
+        return _Victim(self.tasks[row], int(st.v_node[row]),
+                       int(st.v_job[row]))
+
+
 class VictimState:
     """Host mirror of the mutable state the visit kernel reads, plus the
     static victim/job/queue index spaces for one preempt/reclaim action.
@@ -457,16 +482,17 @@ class VictimState:
                     self.q_prop_ok[qi] = True
 
         # ---- victim rows: RUNNING tasks in (node, insertion) order ----
-        # (all_tasks above is already in that order)
+        # (all_tasks above is already in that order). Rows live as
+        # parallel arrays + a task list; _Victim objects materialize only
+        # for the few rows the host replay actually touches (the eager
+        # 10k-object build was a measurable slice of every action).
         running = TaskStatus.RUNNING
         run_sel = [i for i, t in enumerate(all_tasks) if t.status == running]
         j_get = self.j_index.get
         vtasks = [all_tasks[i] for i in run_sel]
         vjobs = [j_get(t.job, -1) for t in vtasks]
-        self.victims = [
-            _Victim(t, int(t_node[i]), ji)
-            for t, i, ji in zip(vtasks, run_sel, vjobs)]
-        v = len(self.victims)
+        self.victims = _VictimRows(self, vtasks)
+        v = len(vtasks)
         v_pad = pad_to_bucket(max(1, v), 8)
         self.v_node = np.full(v_pad, self.n_pad - 1, np.int32)
         self.v_job = np.full(v_pad, -1, np.int32)
@@ -498,8 +524,7 @@ class VictimState:
         self.nq_head = np.ones(v_pad, bool)
         self.nq_head[1:] = np.any(nq[1:] != nq[:-1], axis=1)
 
-        #: task.uid -> victim row (for host replay bookkeeping)
-        self.row_of = {vi.task.uid: i for i, vi in enumerate(self.victims)}
+        self._row_of: Optional[Dict[str, int]] = None
 
         #: mutation event log for the wave cache's fine-grained
         #: invalidation (VictimSolver.visit): ("evict", row, node, job),
@@ -507,6 +532,15 @@ class VictimState:
         self.events: List[tuple] = []
         self._job_nodes_memo: Dict[int, frozenset] = {}
         self._queue_nodes_memo: Dict[int, frozenset] = {}
+
+    @property
+    def row_of(self) -> Dict[str, int]:
+        """task.uid -> victim row (host replay bookkeeping), built on
+        first use — most actions never consult it."""
+        if self._row_of is None:
+            self._row_of = {t.uid: i
+                            for i, t in enumerate(self.victims.tasks)}
+        return self._row_of
 
     def job_nodes(self, ji: int) -> frozenset:
         """Node columns hosting running tasks of job row ji (victim rows
@@ -544,10 +578,9 @@ class VictimState:
 
     def apply_evict(self, row: int) -> None:
         self.version += 1
-        vi = self.victims[row]
         self.v_live[row] = False
         res = self.v_res[row]
-        ji = vi.job_idx
+        ji = int(self.v_job[row])
         if ji >= 0:
             self.ready_cnt[ji] -= 1
             self.j_alloc[ji] -= res
@@ -555,14 +588,13 @@ class VictimState:
             if qi >= 0:
                 self.q_alloc[qi] -= res
         # releasing grows; nz/n_tasks unchanged (the task stays on-node)
-        self.events.append(("evict", row, vi.node_idx, ji))
+        self.events.append(("evict", row, int(self.v_node[row]), ji))
 
     def apply_unevict(self, row: int) -> None:
         self.version += 1
-        vi = self.victims[row]
         self.v_live[row] = True
         res = self.v_res[row]
-        ji = vi.job_idx
+        ji = int(self.v_job[row])
         if ji >= 0:
             self.ready_cnt[ji] += 1
             self.j_alloc[ji] += res
