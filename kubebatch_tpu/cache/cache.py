@@ -181,6 +181,11 @@ class SchedulerCache:
         self._dev_state = None
         self._dev_dirty: set = set()
         self._dev_refresh: set = set()
+        #: persistent per-node victim segments (kernels/victims.py
+        #: SegmentStore) — same dirty/refresh discipline as _dev_state
+        self.victim_segments = None
+        self._vic_dirty: set = set()
+        self._vic_refresh: set = set()
         #: persistent static-term encoder state (kernels/terms.TermsCache);
         #: invalidated whenever node labels/taints/shape change
         self.terms_cache = None
@@ -277,6 +282,7 @@ class SchedulerCache:
         if self._incremental:
             self._dirty_nodes.add(name)
             self._dev_dirty.add(name)
+            self._vic_dirty.add(name)
 
     def _mark_node_shape(self, name: str) -> None:
         """A node's static profile (labels/taints/unschedulable/allocatable)
@@ -304,6 +310,7 @@ class SchedulerCache:
         self._snap_base = None
         self._dev_state = None
         self.terms_cache = None
+        self.victim_segments = None
         self._snap_epoch += 1
 
     # ------------------------------------------------------------------
@@ -755,6 +762,8 @@ class SchedulerCache:
             self._handout_shape_epoch = self._shape_epoch
             self._dev_refresh |= self._dev_dirty
             self._dev_dirty = set()
+            self._vic_refresh |= self._vic_dirty
+            self._vic_dirty = set()
             base = self._snap_base
             if not self._incremental or base is None:
                 snap = self.snapshot_full()
@@ -835,9 +844,13 @@ class SchedulerCache:
             self._dirty_jobs |= ssn.touched_jobs
             self._dirty_nodes |= ssn.touched_nodes
             self._dev_dirty |= ssn.touched_nodes
+            self._vic_dirty |= ssn.touched_nodes
             self._snap_base = (ssn.jobs, ssn.nodes)
             if ssn.device_snapshot is not None:
                 self._dev_state = ssn.device_snapshot
+            vs = getattr(ssn, "_victim_store", None)
+            if vs is not None:
+                self.victim_segments = vs
 
     def device_session(self, ssn):
         """A DeviceSession for this cycle: the previous cycle's device

@@ -363,6 +363,124 @@ class _Victim:
     job_idx: int
 
 
+class _NodeSegment:
+    """Per-node victim-row material persisted across cycles: the RUNNING
+    task subset (insertion order) with its packed resources/criticality,
+    plus the whole-node nonzero-request sum and task count."""
+    __slots__ = ("run_tasks", "run_res", "run_crit", "nz", "n_tasks")
+
+    def __init__(self, node):
+        running = TaskStatus.RUNNING
+        tasks = list(node.tasks.values())
+        run = [t for t in tasks if t.status == running]
+        self.run_tasks = run
+        k = len(run)
+        res = np.empty((k, RESOURCE_DIM), np.float64)
+        if k:
+            pack = load_kb_pack()
+            if pack is not None:
+                pack.extract_f64(run, _RES_PATHS, res)
+            else:
+                for i, t in enumerate(run):
+                    rr = t.resreq
+                    res[i] = (rr.milli_cpu, rr.memory, rr.milli_gpu)
+        self.run_res = (res * VEC_SCALE).astype(np.float32)
+        self.run_crit = np.fromiter(
+            (_pod_critical(t.pod) for t in run), bool, count=k)
+        self.nz = accumulate_nz(tasks, [0] * len(tasks), 1)[0]
+        self.n_tasks = len(tasks)
+
+
+def _build_segments(pairs) -> Dict[str, _NodeSegment]:
+    """Bulk _NodeSegment construction for a large refresh set (cold
+    builds, node-set changes): ONE native extract + ONE nonzero
+    accumulation over every task of the given nodes — the old full-build
+    fast path — sliced back into per-node segments."""
+    running = TaskStatus.RUNNING
+    flat: List[TaskInfo] = []
+    rows: List[int] = []
+    per_node: List[List[TaskInfo]] = []
+    for j, (_, node) in enumerate(pairs):
+        ts = list(node.tasks.values())
+        per_node.append(ts)
+        flat.extend(ts)
+        rows.extend([j] * len(ts))
+    nz = accumulate_nz(flat, rows, max(1, len(pairs)))
+    res_flat = np.empty((len(flat), RESOURCE_DIM), np.float64)
+    if flat:
+        pack = load_kb_pack()
+        if pack is not None:
+            pack.extract_f64(flat, _RES_PATHS, res_flat)
+        else:
+            for i, t in enumerate(flat):
+                rr = t.resreq
+                res_flat[i] = (rr.milli_cpu, rr.memory, rr.milli_gpu)
+    res32 = (res_flat * VEC_SCALE).astype(np.float32)
+    segs: Dict[str, _NodeSegment] = {}
+    base = 0
+    for j, (name, _) in enumerate(pairs):
+        ts = per_node[j]
+        seg = _NodeSegment.__new__(_NodeSegment)
+        run_idx = [base + m for m, t in enumerate(ts)
+                   if t.status == running]
+        seg.run_tasks = [flat[x] for x in run_idx]
+        seg.run_res = (res32[run_idx] if run_idx
+                       else np.empty((0, RESOURCE_DIM), np.float32))
+        seg.run_crit = np.fromiter(
+            (_pod_critical(t.pod) for t in seg.run_tasks), bool,
+            count=len(run_idx))
+        seg.nz = nz[j]
+        seg.n_tasks = len(ts)
+        segs[name] = seg
+        base += len(ts)
+    return segs
+
+
+class SegmentStore:
+    """Cache-owned cross-cycle store of _NodeSegments, keyed by node
+    name; the cache migrates dirty marks into _vic_refresh at snapshot
+    time and folds session-touched nodes in at adoption, exactly like
+    the DeviceSession discipline (cache.py). ``nz_mat``/``cnt`` mirror
+    the segments' whole-node aggregates in node-column order so the
+    per-build assembly copies matrices instead of walking 5k python
+    attribute sets."""
+    __slots__ = ("segs", "col_names", "nz_mat", "cnt")
+
+    def __init__(self):
+        self.segs: Dict[str, _NodeSegment] = {}
+        self.col_names: Optional[List[str]] = None
+        self.nz_mat: Optional[np.ndarray] = None
+        self.cnt: Optional[np.ndarray] = None
+
+
+def _segment_store(ssn):
+    """(SegmentStore, refresh-names) for this build. Incremental caches
+    persist the store with the same consume-at-handout / re-adopt-under-
+    epoch-check discipline as the DeviceSession: the first build of a
+    session takes the store OFF the cache (a mid-session cluster-wide
+    invalidation or a refused adoption must not leave a stale store
+    behind), later builds in the same session reuse it via the session
+    (refresh = the grown touched set), and cache.adopt_snapshot puts it
+    back if the session's epoch still matches. Fake/non-incremental
+    caches get a throwaway store, i.e. a plain fresh build."""
+    store = getattr(ssn, "_victim_store", None)
+    if store is not None:
+        return store, set(ssn.touched_nodes)
+    cache = getattr(ssn, "cache", None)
+    if cache is None or not getattr(cache, "_incremental", False) \
+            or not hasattr(cache, "victim_segments"):
+        return SegmentStore(), set()
+    with cache._lock:
+        store = cache.victim_segments
+        cache.victim_segments = None      # consumed; re-adopted at close
+        refresh = set(cache._vic_refresh)
+        cache._vic_refresh.clear()
+    if store is None:
+        store = SegmentStore()
+    ssn._victim_store = store
+    return store, refresh | ssn.touched_nodes
+
+
 class _VictimRows:
     """Lazy row view over the VictimState's parallel victim arrays —
     indexing materializes a _Victim for just that row."""
@@ -402,38 +520,67 @@ class VictimState:
                  allocatable_cm: np.ndarray):
         self.node_index = node_index
         self.n_pad = n_pad
-        # mutable node mirrors, rebuilt from HOST truth (earlier actions in
-        # the session — allocate — have mutated nodes since the device
-        # snapshot was tensorized). ONE walk collects every node task in
-        # (node-index, insertion) order; resreq extraction goes through the
-        # native packer (native/kb_pack.c) when built — this build runs
-        # every preempt/reclaim action at 10k+ node tasks in the stress
-        # configs, and tuple-list -> np.asarray was its hot spot.
-        self.nz_req = np.zeros((n_pad, 2), np.float32)
-        self.n_tasks = np.zeros(n_pad, np.int32)
-        all_tasks: List[TaskInfo] = []
-        node_of: List[int] = []
-        for name, node in sorted(ssn.nodes.items(),
-                                 key=lambda kv: node_index.get(kv[0], 0)):
+        # mutable node mirrors + victim-row material, assembled from the
+        # cache's persistent per-node segments (SegmentStore): only nodes
+        # the cache dirtied or the session touched recompute their
+        # segment from HOST truth — the full 10k+ node-task walk this
+        # build used to pay every preempt/reclaim action now costs
+        # O(churned nodes) in the steady regime.
+        store, refresh = _segment_store(ssn)
+        segs = store.segs
+        ordered = sorted(ssn.nodes.items(),
+                         key=lambda kv: node_index.get(kv[0], 0))
+        names = [name for name, _ in ordered if name in node_index]
+        if (store.col_names != names or store.nz_mat is None
+                or store.nz_mat.shape[0] != n_pad):
+            # node set / order / padding changed: aggregates restart
+            store.col_names = names
+            store.nz_mat = np.zeros((n_pad, 2), np.float32)
+            store.cnt = np.zeros(n_pad, np.int32)
+            refresh = set(names)
+        vtasks: List[TaskInfo] = []
+        vnode_of: List[int] = []
+        res_blocks: List[np.ndarray] = []
+        crit_blocks: List[np.ndarray] = []
+        nz_mat, cnt = store.nz_mat, store.cnt
+        stale = [(name, node) for name, node in ordered
+                 if name in node_index
+                 and (name in refresh or name not in segs)]
+        if len(stale) > 64:
+            # large refresh (cold build / node-set change): one batched
+            # extract instead of thousands of per-node ones
+            segs.update(_build_segments(stale))
+            for name, _ in stale:
+                seg = segs[name]
+                ni = node_index[name]
+                nz_mat[ni] = seg.nz
+                cnt[ni] = seg.n_tasks
+            stale_names = ()
+        else:
+            stale_names = {name for name, _ in stale}
+        for name, node in ordered:
             ni = node_index.get(name)
             if ni is None:
                 continue
-            self.n_tasks[ni] = len(node.tasks)
-            all_tasks.extend(node.tasks.values())
-            node_of.extend([ni] * len(node.tasks))
-        t_node = (np.asarray(node_of, np.int64) if all_tasks
-                  else np.zeros(0, np.int64))
-        t_res = np.empty((len(all_tasks), RESOURCE_DIM), np.float64)
-        if all_tasks:
-            pack = load_kb_pack()
-            if pack is not None:
-                pack.extract_f64(all_tasks, _RES_PATHS, t_res)
+            if name in stale_names:
+                seg = segs[name] = _NodeSegment(node)
+                nz_mat[ni] = seg.nz
+                cnt[ni] = seg.n_tasks
             else:
-                for i, t in enumerate(all_tasks):
-                    rr = t.resreq
-                    t_res[i] = (rr.milli_cpu, rr.memory, rr.milli_gpu)
-            # shared GetNonzeroRequests accumulation (tensorize.py)
-            self.nz_req = accumulate_nz(all_tasks, node_of, n_pad)
+                seg = segs[name]
+            run = seg.run_tasks
+            if run:
+                vtasks.extend(run)
+                vnode_of.extend([ni] * len(run))
+                res_blocks.append(seg.run_res)
+                crit_blocks.append(seg.run_crit)
+        if len(segs) > len(names):
+            live = set(names)
+            for name in list(segs):
+                if name not in live:
+                    del segs[name]
+        self.nz_req = nz_mat.copy()
+        self.n_tasks = cnt.copy()
         self.node_ok = node_ok
         self.max_task_num = max_task_num
         self.allocatable_cm = allocatable_cm
@@ -482,14 +629,10 @@ class VictimState:
                     self.q_prop_ok[qi] = True
 
         # ---- victim rows: RUNNING tasks in (node, insertion) order ----
-        # (all_tasks above is already in that order). Rows live as
-        # parallel arrays + a task list; _Victim objects materialize only
-        # for the few rows the host replay actually touches (the eager
-        # 10k-object build was a measurable slice of every action).
-        running = TaskStatus.RUNNING
-        run_sel = [i for i, t in enumerate(all_tasks) if t.status == running]
+        # (segment assembly above kept that order). Rows live as parallel
+        # arrays + a task list; _Victim objects materialize only for the
+        # few rows the host replay actually touches.
         j_get = self.j_index.get
-        vtasks = [all_tasks[i] for i in run_sel]
         vjobs = [j_get(t.job, -1) for t in vtasks]
         self.victims = _VictimRows(self, vtasks)
         v = len(vtasks)
@@ -500,12 +643,10 @@ class VictimState:
         self.v_critical = np.zeros(v_pad, bool)
         self.v_live = np.zeros(v_pad, bool)
         if v:
-            sel = np.asarray(run_sel, np.int64)
-            self.v_node[:v] = t_node[sel]
+            self.v_node[:v] = vnode_of
             self.v_job[:v] = vjobs
-            # host units -> device units in one pass (to_vec semantics)
-            self.v_res[:v] = (t_res[sel] * VEC_SCALE).astype(np.float32)
-            self.v_critical[:v] = [_pod_critical(t.pod) for t in vtasks]
+            self.v_res[:v] = np.concatenate(res_blocks)
+            self.v_critical[:v] = np.concatenate(crit_blocks)
             self.v_live[:v] = np.asarray(vjobs, np.int64) >= 0
         # pad rows sort to the last node with live=False — harmless
 

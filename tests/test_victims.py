@@ -437,3 +437,71 @@ def test_wave_dispatch_count_sublinear(monkeypatch):
     # wave mode pays a few escalation singles up front (the low-visit
     # protection), then amortizes: comfortably under 60% of per-visit
     assert wave * 1.67 <= per_visit, (wave, per_visit)
+
+
+def test_segment_store_matches_fresh_build_across_cycles(monkeypatch):
+    """The persistent per-node victim segments must assemble a
+    VictimState identical to a from-scratch build, across churn cycles
+    that run the full action pipeline (evictions, pipelines, binds)."""
+    from kubebatch_tpu.actions.backfill import BackfillAction
+    from kubebatch_tpu.kernels import victims as kv
+    from kubebatch_tpu.objects import PodPhase as PP
+    from kubebatch_tpu.sim import ClusterSpec, build_cluster
+
+    GiB2 = 1024 ** 3
+    sim = build_cluster(ClusterSpec(
+        n_nodes=40, n_groups=20, pods_per_group=8, min_member=4,
+        running_fill=0.9, n_queues=2, queue_weights=(1, 3),
+        priority_classes=(("low", 10), ("high", 1000)),
+        pod_cpu_millis=1000, pod_mem_bytes=2 * GiB2))
+    fresh_binds = []
+
+    class KB(Recorder):
+        def bind(self, pod, hostname):
+            super().bind(pod, hostname)
+            fresh_binds.append(pod)
+
+    rec = KB()
+    cache = SchedulerCache(binder=rec, evictor=rec, async_writeback=False)
+    sim.populate(cache)
+
+    def check_build(ssn):
+        pending = [t for job in ssn.jobs.values()
+                   for t in job.task_status_index.get(TaskStatus.PENDING,
+                                                      {}).values()]
+        if not pending:
+            return
+        solver = kv.build_victim_solver(
+            ssn, pending, "preemptable_fns", "preemptable_disabled",
+            score_nodes=True)
+        if solver is None:
+            return
+        # fresh build: force a throwaway store
+        monkeypatch.setattr(kv, "_segment_store",
+                            lambda s: (kv.SegmentStore(), set()))
+        fresh = kv.build_victim_solver(
+            ssn, pending, "preemptable_fns", "preemptable_disabled",
+            score_nodes=True)
+        monkeypatch.undo()
+        a, b = solver.state, fresh.state
+        assert [t.uid for t in a.victims.tasks] \
+            == [t.uid for t in b.victims.tasks]
+        for fld in ("v_node", "v_job", "v_res", "v_critical", "v_live",
+                    "nz_req", "n_tasks"):
+            np.testing.assert_array_equal(getattr(a, fld),
+                                          getattr(b, fld), err_msg=fld)
+
+    for cycle in range(6):
+        ssn = OpenSession(cache, shipped_tiers())
+        check_build(ssn)
+        for act in (ReclaimAction(), AllocateAction(mode="host"),
+                    PreemptAction()):
+            act.execute(ssn)
+        CloseSession(ssn)
+        # kubelet: bound pods start running (churns node segments)
+        for pod in fresh_binds:
+            if pod.phase == PP.PENDING:
+                pod.phase = PP.RUNNING
+                cache.update_pod(pod, pod)
+        fresh_binds.clear()
+    assert rec.evicted, "scenario must exercise evictions"
