@@ -33,8 +33,6 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional
 
-import numpy as np
-
 from ..api import JobInfo, TaskInfo, TaskStatus
 from ..framework import (Action, Session, VolumeAllocationError,
                          register_action)

@@ -73,7 +73,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..api import NodeInfo, TaskInfo, TaskStatus, ready_statuses
+from ..api import TaskInfo, TaskStatus, ready_statuses
 from ..api.resource import RESOURCE_DIM
 from .solver import dynamic_node_score
 from .tensorize import (VEC_EPS, _intern_paths, accumulate_nz, load_kb_pack,

@@ -12,13 +12,12 @@ from __future__ import annotations
 
 import time
 from concurrent import futures
-from typing import Optional
 
 import grpc
 import jax.numpy as jnp
 import numpy as np
 
-from ..kernels.fused import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
+from ..kernels.fused import (ALLOC, ALLOC_OB, PIPELINE, SKIP,
                              K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
                              K_PROP_SHARE, fused_allocate, unpack_host_block)
 from ..kernels.tensorize import pad_to_bucket
