@@ -44,12 +44,12 @@ class Kubelet:
         pod.deletion_timestamp = 1.0
 
 
-def _mk_cluster(n_nodes=10, pods=16):
+def _mk_cluster(n_nodes=10, pods=16, incremental=True):
     src = StreamingEventSource()
     kubelet = Kubelet(src)
     cache = SchedulerCache(binder=kubelet, evictor=kubelet,
                            async_writeback=False,
-                           incremental_snapshot=True)
+                           incremental_snapshot=incremental)
     src.emit_queue(build_queue("q1", weight=1))
     src.emit_queue(build_queue("q2", weight=3))
     for n in range(n_nodes):
@@ -203,3 +203,25 @@ def test_device_session_row_reuse_matches_fresh_build():
             act.execute(ssn)
         CloseSession(ssn)
     assert kubelet.binds
+
+
+def test_incremental_disabled_still_schedules(monkeypatch):
+    """KUBEBATCH_INCREMENTAL=0 must fall back to full per-cycle clones
+    with identical outcomes (the reference's snapshot semantics)."""
+    results = {}
+    for flag in ("1", "0"):
+        rng = np.random.default_rng(2)   # identical churn both runs
+        monkeypatch.setenv("KUBEBATCH_INCREMENTAL", flag)
+        src, kubelet, cache = _mk_cluster(incremental=(flag == "1"))
+        assert cache._incremental == (flag == "1")
+        next_group = 0
+        for cycle in range(4):
+            next_group = _churn_cycle(src, rng, cycle, next_group)
+            ssn = OpenSession(cache, shipped_tiers())
+            for act in (ReclaimAction(), AllocateAction(),
+                        BackfillAction(), PreemptAction()):
+                act.execute(ssn)
+            CloseSession(ssn)
+            assert not audit_cache(cache)
+        results[flag] = dict(kubelet.binds)
+    assert results["0"] == results["1"]
