@@ -319,6 +319,22 @@ class Session:
                     score += fn(task, node)
         return score
 
+    def total_allocatable(self):
+        """Sum of node allocatable over the snapshot, computed once per
+        session — drf and proportion each summed all nodes at open
+        (drf.go:59-60, proportion.go:52-53); the value is identical, so
+        they share one walk."""
+        total = getattr(self, "_total_allocatable", None)
+        if total is None:
+            from ..api import Resource
+            total = Resource.empty()
+            for node in self.nodes.values():
+                total.add(node.allocatable)
+            self._total_allocatable = total
+        # clone: Resource's chaining API mutates in place — handing out
+        # the cached object would let one caller corrupt every later one
+        return total.clone()
+
     # ------------------------------------------------------------------
     # session mutators (ref: session.go:193-357)
     # ------------------------------------------------------------------

@@ -43,8 +43,7 @@ class DrfPlugin(Plugin):
         attr.share = self._calculate_share(attr.allocated)
 
     def on_session_open(self, ssn: Session) -> None:
-        for node in ssn.nodes.values():
-            self.total_resource.add(node.allocatable)
+        self.total_resource.add(ssn.total_allocatable())
 
         for job in ssn.jobs.values():
             attr = DrfAttr()

@@ -49,8 +49,7 @@ class ProportionPlugin(Plugin):
         attr.share = dominant_share(attr.allocated, attr.deserved)
 
     def on_session_open(self, ssn: Session) -> None:
-        for node in ssn.nodes.values():
-            self.total_resource.add(node.allocatable)
+        self.total_resource.add(ssn.total_allocatable())
 
         # queue attributes only for queues that have jobs
         # (ref: proportion.go:66-98)

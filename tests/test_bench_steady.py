@@ -1,0 +1,27 @@
+"""Driver-facing bench surfaces: the steady-state regime function must
+run end-to-end (bench.py --steady is the evidence path for the
+incremental-cycle work; a regression here silently costs the round's
+measurement)."""
+import sys
+
+import bench
+
+
+def test_run_steady_small_config():
+    latencies, bound = bench.run_steady(2, 2, "auto", 16)
+    assert len(latencies) == 2
+    assert bound == 32          # 16 churn pods per measured cycle
+    assert all(dt > 0 for dt in latencies)
+
+
+def test_bench_main_one_json_line(capsys):
+    rc = bench.main(["--config", "2", "--cycles", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, out
+    import json
+    line = json.loads(out[-1])
+    assert line["metric"] == "sched_cycle_p50_ms_cfg2"
+    # cfg2 is ~2x oversubscribed on cpu (50 nodes x 8000m vs 800 x
+    # 1000m pods): exactly the cluster's capacity binds
+    assert line["pods_bound_per_cycle"] == 400

@@ -44,7 +44,7 @@ class Kubelet:
         pod.deletion_timestamp = 1.0
 
 
-def _mk_cluster(n_nodes=10, pods=16, incremental=True):
+def _mk_cluster(n_nodes=10, pods=16, incremental=None):
     src = StreamingEventSource()
     kubelet = Kubelet(src)
     cache = SchedulerCache(binder=kubelet, evictor=kubelet,
@@ -212,7 +212,9 @@ def test_incremental_disabled_still_schedules(monkeypatch):
     for flag in ("1", "0"):
         rng = np.random.default_rng(2)   # identical churn both runs
         monkeypatch.setenv("KUBEBATCH_INCREMENTAL", flag)
-        src, kubelet, cache = _mk_cluster(incremental=(flag == "1"))
+        # incremental=None -> the constructor reads the env var (the
+        # documented contract this test covers)
+        src, kubelet, cache = _mk_cluster()
         assert cache._incremental == (flag == "1")
         next_group = 0
         for cycle in range(4):
