@@ -528,9 +528,18 @@ class VictimState:
         # O(churned nodes) in the steady regime.
         store, refresh = _segment_store(ssn)
         segs = store.segs
-        ordered = sorted(ssn.nodes.items(),
-                         key=lambda kv: node_index.get(kv[0], 0))
-        names = [name for name, _ in ordered if name in node_index]
+        nodes_map = ssn.nodes
+        if (store.col_names is not None
+                and len(store.col_names) == len(nodes_map)
+                and all(n in nodes_map for n in store.col_names)):
+            # node set unchanged: the store's column order IS the index
+            # order — skip the per-build sort of 5k (name, node) pairs
+            names = store.col_names
+            ordered = [(n, nodes_map[n]) for n in names]
+        else:
+            ordered = sorted(nodes_map.items(),
+                             key=lambda kv: node_index.get(kv[0], 0))
+            names = [name for name, _ in ordered if name in node_index]
         if (store.col_names != names or store.nz_mat is None
                 or store.nz_mat.shape[0] != n_pad):
             # node set / order / padding changed: aggregates restart
@@ -538,6 +547,13 @@ class VictimState:
             store.nz_mat = np.zeros((n_pad, 2), np.float32)
             store.cnt = np.zeros(n_pad, np.int32)
             refresh = set(names)
+            # pin the invariant the fast path above relies on: column
+            # order == node_index order (NodeState.from_nodes sorts by
+            # name; if that ever changes, this catches it at reset time
+            # instead of silently misplacing cached aggregate rows)
+            assert all(node_index.get(nm) == i
+                       for i, nm in enumerate(names)), \
+                "segment column order diverged from the node index"
         vtasks: List[TaskInfo] = []
         vnode_of: List[int] = []
         res_blocks: List[np.ndarray] = []
