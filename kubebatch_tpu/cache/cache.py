@@ -780,6 +780,7 @@ class SchedulerCache:
             dirty_jobs, self._dirty_jobs = self._dirty_jobs, set()
             dirty_nodes, self._dirty_nodes = self._dirty_nodes, set()
             snap = ClusterInfo()
+            snap.refreshed_jobs = set()
             for name, node in self.nodes.items():
                 reuse = None if name in dirty_nodes else base_nodes.get(name)
                 snap.nodes[name] = node.clone() if reuse is None else reuse
@@ -796,6 +797,7 @@ class SchedulerCache:
                     continue
                 self._stamp_priority(job)
                 snap.jobs[uid] = job.clone()
+                snap.refreshed_jobs.add(uid)
             return snap
 
     def snapshot_full(self) -> ClusterInfo:

@@ -53,6 +53,8 @@ class Session:
         self.jobs: Dict[str, JobInfo] = snapshot.jobs
         self.nodes: Dict[str, NodeInfo] = snapshot.nodes
         self.queues: Dict[str, QueueInfo] = snapshot.queues
+        #: job uids freshly re-cloned from cache truth (None = all)
+        self.refreshed_jobs = getattr(snapshot, "refreshed_jobs", None)
         self.backlog: List[JobInfo] = []
         self.tiers: List[Tier] = []
         self.enable_preemption = enable_preemption
@@ -412,6 +414,11 @@ class Session:
     def update_job_condition(self, job_info: JobInfo,
                              cond: PodGroupCondition) -> None:
         """ref: session.go:360-382."""
+        # a condition stamp IS a status mutation: the close-session
+        # write-skip must not bypass this job's PUT/events, and the next
+        # snapshot re-clones it (the shared pod_group makes the re-clone
+        # redundant but harmless)
+        self.touched_jobs.add(job_info.uid)
         job = self.jobs.get(job_info.uid)
         if job is None:
             raise KeyError(f"failed to find job "
@@ -484,14 +491,30 @@ def job_status(ssn: Session, job: JobInfo) -> PodGroupStatus:
 
 
 def close_session(ssn: Session) -> None:
-    """Write job status back through the cache (ref: session.go:124-156)."""
+    """Write job status back through the cache (ref: session.go:124-156).
+
+    Jobs the session never mutated AND whose clone was reused from the
+    previous cycle (truth unchanged) AND that hold no pending/allocated
+    work recompute to an identical status with no events to emit — the
+    write is skipped (a changed-nothing PUT any production updater would
+    coalesce anyway). Full snapshots (refreshed = None) write every job,
+    matching the reference cycle for cycle."""
     scheduled = 0
     unschedulable = 0
-    for job in ssn.jobs.values():
+    refreshed = ssn.refreshed_jobs
+    touched = ssn.touched_jobs
+    for uid, job in ssn.jobs.items():
+        pending = job.count(TaskStatus.PENDING)
         scheduled += job.count(TaskStatus.BINDING)
-        unschedulable += job.count(TaskStatus.PENDING)
+        unschedulable += pending
         if job.pod_group is None:
             ssn.cache.record_job_status_event(job)
+            continue
+        if (refreshed is not None and uid not in refreshed
+                and uid not in touched and pending == 0
+                and TaskStatus.ALLOCATED not in job.task_status_index
+                and TaskStatus.ALLOCATED_OVER_BACKFILL
+                not in job.task_status_index):
             continue
         job.pod_group.status = job_status(ssn, job)
         ssn.cache.update_job_status(job)

@@ -550,10 +550,12 @@ class VictimState:
             # pin the invariant the fast path above relies on: column
             # order == node_index order (NodeState.from_nodes sorts by
             # name; if that ever changes, this catches it at reset time
-            # instead of silently misplacing cached aggregate rows)
-            assert all(node_index.get(nm) == i
-                       for i, nm in enumerate(names)), \
-                "segment column order diverged from the node index"
+            # instead of silently misplacing cached aggregate rows).
+            # A real raise, not assert — it must survive python -O.
+            if any(node_index.get(nm) != i
+                   for i, nm in enumerate(names)):
+                raise RuntimeError(
+                    "segment column order diverged from the node index")
         vtasks: List[TaskInfo] = []
         vnode_of: List[int] = []
         res_blocks: List[np.ndarray] = []
