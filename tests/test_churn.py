@@ -146,7 +146,15 @@ def test_churn_cfg3_scale_soak():
                     creation_timestamp=float(cycle * 1000 + p)))
             g += 1
         assert src.sync(10.0)
-        ssn = OpenSession(cache, shipped_tiers())
+        # the incremental snapshot must stay deep-equal to a full clone
+        # at cfg3 scale with every cross-cycle cache active (adoption,
+        # device rows, terms, victim segments, close write-skip)
+        from kubebatch_tpu.debug import snapshot_diff
+        full = cache.snapshot_full()
+        inc = cache.snapshot()
+        diff = snapshot_diff(inc, full)
+        assert not diff, f"cycle {cycle}: {diff[:5]}"
+        ssn = OpenSession(cache, shipped_tiers(), snapshot=inc)
         for act in acts:
             act.execute(ssn)
         CloseSession(ssn)
