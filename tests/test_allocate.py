@@ -234,3 +234,37 @@ def test_jax_matches_host_oracle_randomized():
             f"trial {trial}: fused throughput"
         assert (len(results["batched"]) >= 0.9 * len(results["host"]) - 1), \
             f"trial {trial}: batched throughput collapsed"
+
+
+def test_auto_mode_threshold_boundary(monkeypatch):
+    """auto mode's engine switch (AUTO_BATCHED_MIN) is a semantics
+    boundary — fused is bind-for-bind exact, batched is round-granular —
+    so the selection at the threshold is pinned: below -> fused,
+    at/above -> batched (sharded only upgrades on multi-device + big
+    node axis, excluded here via the node threshold)."""
+    from kubebatch_tpu.actions import allocate as mod
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from .fixtures import GiB, build_group, build_node, build_pod, \
+        build_queue, rl
+
+    monkeypatch.setattr(mod, "AUTO_BATCHED_MIN", 8)
+
+    def selection(n_pending):
+        cache = SchedulerCache(async_writeback=False)
+        cache.add_queue(build_queue("q1"))
+        for i in range(4):   # < AUTO_SHARDED_MIN_NODES: no sharded upgrade
+            cache.add_node(build_node(f"n{i}", rl(8000, 16 * GiB,
+                                                  pods=110)))
+        cache.add_pod_group(build_group("ns", "g", 1, queue="q1"))
+        for p in range(n_pending):
+            cache.add_pod(build_pod("ns", f"g-{p}", "", "Pending",
+                                    rl(100, GiB // 8), group="g"))
+        ssn = OpenSession(cache, shipped_tiers())
+        mode = mod.AllocateAction._auto_mode(ssn)
+        CloseSession(ssn)
+        return mode
+
+    assert selection(7) == "fused"
+    assert selection(8) == "batched"
