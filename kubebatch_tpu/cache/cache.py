@@ -186,6 +186,10 @@ class SchedulerCache:
         self.victim_segments = None
         self._vic_dirty: set = set()
         self._vic_refresh: set = set()
+        #: job-level marks for the SegmentStore's persistent job-row
+        #: space (ready counts / allocations) — same discipline
+        self._vicjob_dirty: set = set()
+        self._vicjob_refresh: set = set()
         #: persistent static-term encoder state (kernels/terms.TermsCache);
         #: invalidated whenever node labels/taints/shape change
         self.terms_cache = None
@@ -277,6 +281,7 @@ class SchedulerCache:
     def _mark_job(self, uid: str) -> None:
         if self._incremental:
             self._dirty_jobs.add(uid)
+            self._vicjob_dirty.add(uid)
 
     def _mark_node(self, name: str) -> None:
         if self._incremental:
@@ -764,6 +769,15 @@ class SchedulerCache:
             self._dev_dirty = set()
             self._vic_refresh |= self._vic_dirty
             self._vic_dirty = set()
+            self._vicjob_refresh |= self._vicjob_dirty
+            self._vicjob_dirty = set()
+            if self.victim_segments is None:
+                # no store to refresh against (host victim mode, store
+                # dropped, or never built): the next build is a full one
+                # anyway — without this, a scheduler that never runs the
+                # device victim path accumulates job uids forever
+                self._vic_refresh.clear()
+                self._vicjob_refresh.clear()
             base = self._snap_base
             if not self._incremental or base is None:
                 snap = self.snapshot_full()
@@ -847,6 +861,7 @@ class SchedulerCache:
             self._dirty_nodes |= ssn.touched_nodes
             self._dev_dirty |= ssn.touched_nodes
             self._vic_dirty |= ssn.touched_nodes
+            self._vicjob_dirty |= ssn.touched_jobs
             self._snap_base = (ssn.jobs, ssn.nodes)
             if ssn.device_snapshot is not None:
                 self._dev_state = ssn.device_snapshot

@@ -465,6 +465,34 @@ def test_segment_store_matches_fresh_build_across_cycles(monkeypatch):
     cache = SchedulerCache(binder=rec, evictor=rec, async_writeback=False)
     sim.populate(cache)
 
+    def canonical(state):
+        """Store-layout-independent view of a VictimState: live rows in
+        (node, within-node insertion) order with job/queue identity by
+        UID (row NUMBERS are free to differ between a persistent store
+        and a fresh build — they are not semantic), plus the per-job
+        attrs keyed by uid and the node aggregates."""
+        row_uid = {r: uid for uid, r in state.j_index.items()}
+        rows = []
+        for r in range(len(state.v_node)):
+            if not state.v_live[r]:
+                continue
+            rows.append((int(state.v_node[r]), r,
+                         state.victims.tasks[r].uid,
+                         tuple(np.asarray(state.v_res[r]).tolist()),
+                         bool(state.v_critical[r]),
+                         row_uid.get(int(state.v_job[r]))))
+        rows.sort(key=lambda x: (x[0], x[1]))
+        # strip the raw row index: only the (node, order) grouping counts
+        rows = [(n, uid, res, crit, juid)
+                for n, _, uid, res, crit, juid in rows]
+        job_attrs = {}
+        for uid, r in state.j_index.items():
+            job_attrs[uid] = (int(state.ready_cnt[r]),
+                              int(state.min_av[r]),
+                              int(state.job_queue[r]),
+                              tuple(np.asarray(state.j_alloc[r]).tolist()))
+        return rows, job_attrs
+
     def check_build(ssn):
         pending = [t for job in ssn.jobs.values()
                    for t in job.task_status_index.get(TaskStatus.PENDING,
@@ -478,16 +506,20 @@ def test_segment_store_matches_fresh_build_across_cycles(monkeypatch):
             return
         # fresh build: force a throwaway store
         monkeypatch.setattr(kv, "_segment_store",
-                            lambda s: (kv.SegmentStore(), set()))
+                            lambda s: (kv.SegmentStore(), set(), set()))
         fresh = kv.build_victim_solver(
             ssn, pending, "preemptable_fns", "preemptable_disabled",
             score_nodes=True)
         monkeypatch.undo()
         a, b = solver.state, fresh.state
-        assert [t.uid for t in a.victims.tasks] \
-            == [t.uid for t in b.victims.tasks]
-        for fld in ("v_node", "v_job", "v_res", "v_critical", "v_live",
-                    "nz_req", "n_tasks"):
+        rows_a, jobs_a = canonical(a)
+        rows_b, jobs_b = canonical(b)
+        assert rows_a == rows_b
+        # fresh builds only carry session jobs; the persistent store may
+        # additionally hold rows for stored-but-absent jobs
+        for uid, attrs in jobs_b.items():
+            assert jobs_a.get(uid) == attrs, uid
+        for fld in ("nz_req", "n_tasks", "host_rank"):
             np.testing.assert_array_equal(getattr(a, fld),
                                           getattr(b, fld), err_msg=fld)
 
@@ -505,3 +537,65 @@ def test_segment_store_matches_fresh_build_across_cycles(monkeypatch):
                 cache.update_pod(pod, pod)
         fresh_binds.clear()
     assert rec.evicted, "scenario must exercise evictions"
+
+
+def test_orphan_job_rows_repair_on_return(monkeypatch):
+    """A job whose running tasks were stored as v_job=-1 (no row
+    assignment existed when its node slot was written — e.g. the job was
+    validate-dropped at store creation) must become visible to the
+    victim kernels once it re-enters the session, even though its return
+    dirties no node (kernels/victims.py SegmentStore.orphan_uids)."""
+    from kubebatch_tpu.kernels import victims as kv
+
+    rec = Recorder()
+    cache = SchedulerCache(binder=rec, evictor=rec, async_writeback=False)
+    cache.add_queue(build_queue("q1"))
+    cache.add_node(build_node("n1", rl(8000, 16 * GiB, pods=110)))
+    # gang with min=4 but only 2 (running) tasks: validate drops it
+    cache.add_pod_group(build_group("ns", "gappy", 4, queue="q1"))
+    for i in range(2):
+        cache.add_pod(build_pod("ns", f"gappy-{i}", "n1", PodPhase.RUNNING,
+                                rl(1000, 2 * GiB), group="gappy",
+                                priority=1))
+    # a pending claimant so the solver actually builds
+    cache.add_pod_group(build_group("ns", "vip", 1, queue="q1"))
+    cache.add_pod(build_pod("ns", "vip-0", "", PodPhase.PENDING,
+                            rl(8000, 16 * GiB), group="vip", priority=100))
+
+    def build_solver(ssn):
+        pending = [t for job in ssn.jobs.values()
+                   for t in job.task_status_index.get(TaskStatus.PENDING,
+                                                      {}).values()]
+        return kv.build_victim_solver(
+            ssn, pending, "preemptable_fns", "preemptable_disabled",
+            score_nodes=True)
+
+    ssn = OpenSession(cache, shipped_tiers())
+    assert "ns/gappy" not in ssn.jobs          # validate-dropped
+    solver = build_solver(ssn)
+    assert solver is not None
+    st = solver.state
+    gappy_rows = [i for i, t in enumerate(st.victims.tasks)
+                  if t is not None and t.job == "ns/gappy"]
+    assert gappy_rows and not st.v_live[gappy_rows].any()
+    store = ssn._victim_store
+    assert "ns/gappy" in store.orphan_uids
+    CloseSession(ssn)
+
+    # two more (pending) members: countable 4 >= min 4 -> valid again.
+    # The new pods dirty only the JOB, not node n1.
+    for i in (2, 3):
+        cache.add_pod(build_pod("ns", f"gappy-{i}", "", PodPhase.PENDING,
+                                rl(1000, 2 * GiB), group="gappy",
+                                priority=1))
+    ssn = OpenSession(cache, shipped_tiers())
+    assert "ns/gappy" in ssn.jobs
+    solver = build_solver(ssn)
+    st = solver.state
+    jrow = st.j_index["ns/gappy"]
+    gappy_rows = [i for i, t in enumerate(st.victims.tasks)
+                  if t is not None and t.job == "ns/gappy"]
+    assert gappy_rows
+    assert st.v_live[gappy_rows].all(), "returned job's rows must be live"
+    assert (st.v_job[gappy_rows] == jrow).all()
+    CloseSession(ssn)

@@ -1,0 +1,82 @@
+"""Scratch: cProfile the steady-state OpenSession + victim-solver build."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+import cProfile
+import gc
+import pstats
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import PodPhase
+from kubebatch_tpu.sim import baseline_cluster
+
+
+def main(cycles=4, churn=256):
+    tiers = shipped_tiers()
+    sim = baseline_cluster(5)
+    fresh = []
+
+    class _B:
+        def bind(self, pod, hostname):
+            pod.node_name = hostname
+            fresh.append(pod)
+
+        def evict(self, pod):
+            pod.deletion_timestamp = 1.0
+
+    seam = _B()
+    cache = SchedulerCache(binder=seam, evictor=seam, async_writeback=False)
+    sim.populate(cache)
+    from kubebatch_tpu.actions.allocate import AllocateAction
+    from kubebatch_tpu.actions.backfill import BackfillAction
+    from kubebatch_tpu.actions.preempt import PreemptAction
+    from kubebatch_tpu.actions.reclaim import ReclaimAction
+    acts = [ReclaimAction(), AllocateAction(), BackfillAction(),
+            PreemptAction()]
+
+    def kubelet_tick():
+        for pod in fresh:
+            if pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                cache.update_pod(pod, pod)
+        fresh.clear()
+
+    def one_cycle():
+        ssn = OpenSession(cache, tiers)
+        for act in acts:
+            act.execute(ssn)
+        CloseSession(ssn)
+        kubelet_tick()
+
+    gc.disable()
+    for _ in range(3):
+        one_cycle()
+        kubelet_tick()
+        sim.churn_tick(cache, churn)
+    one_cycle()   # churned warmup (victim jit)
+
+    prof = cProfile.Profile()
+    for _ in range(cycles):
+        kubelet_tick()
+        sim.churn_tick(cache, churn)
+        gc.collect()
+        prof.enable()
+        one_cycle()
+        prof.disable()
+    gc.enable()
+    st = pstats.Stats(prof)
+    st.sort_stats("cumulative").print_stats(45)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Scratch profiler: steady-state cfg5 sub-phase breakdown (CPU backend).
+
+Mirrors bench.run_steady but times the open/reclaim/allocate/close
+internals so the SCALING.md latency-budget items can be attributed.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+import gc
+import time
+from collections import defaultdict
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.framework import framework as fw_mod
+from kubebatch_tpu.framework import session as sess_mod
+from kubebatch_tpu.objects import PodPhase
+from kubebatch_tpu.sim import baseline_cluster
+
+T = defaultdict(float)
+N = defaultdict(int)
+
+
+def timed(tag, fn):
+    def wrap(*a, **k):
+        t0 = time.perf_counter()
+        out = fn(*a, **k)
+        T[tag] += time.perf_counter() - t0
+        N[tag] += 1
+        return out
+    return wrap
+
+
+def main(cycles=6, churn=256):
+    tiers = shipped_tiers()
+    sim = baseline_cluster(5)
+    binds = {}
+    fresh = []
+
+    class _B:
+        def bind(self, pod, hostname):
+            binds[pod.uid] = hostname
+            pod.node_name = hostname
+            fresh.append(pod)
+
+        def evict(self, pod):
+            pod.deletion_timestamp = 1.0
+
+    seam = _B()
+    cache = SchedulerCache(binder=seam, evictor=seam, async_writeback=False)
+    sim.populate(cache)
+    from kubebatch_tpu.actions.allocate import AllocateAction
+    from kubebatch_tpu.actions.backfill import BackfillAction
+    from kubebatch_tpu.actions.preempt import PreemptAction
+    from kubebatch_tpu.actions.reclaim import ReclaimAction
+    acts = [("reclaim", ReclaimAction()), ("allocate", AllocateAction()),
+            ("backfill", BackfillAction()), ("preempt", PreemptAction())]
+
+    def kubelet_tick():
+        for pod in fresh:
+            if pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                cache.update_pod(pod, pod)
+        fresh.clear()
+
+    # --- instrument open internals ---
+    orig_snapshot = cache.snapshot
+    cache.snapshot = timed("open.snapshot", orig_snapshot)
+    orig_validate = sess_mod.validate_jobs
+    fw_mod.validate_jobs = timed("open.validate", orig_validate)
+
+    import kubebatch_tpu.plugins.drf as drf_mod
+    import kubebatch_tpu.plugins.proportion as prop_mod
+    import kubebatch_tpu.plugins.gang as gang_mod
+    import kubebatch_tpu.plugins.predicates as pred_mod
+    import kubebatch_tpu.plugins.nodeorder as no_mod
+    for mod, nm in ((drf_mod, "drf"), (prop_mod, "proportion"),
+                    (gang_mod, "gang"), (pred_mod, "predicates"),
+                    (no_mod, "nodeorder")):
+        cls = [v for v in vars(mod).values()
+               if isinstance(v, type) and hasattr(v, "on_session_open")
+               and v.__module__ == mod.__name__]
+        for c in cls:
+            c.on_session_open = timed(f"open.{nm}", c.on_session_open)
+
+    # --- instrument reclaim internals ---
+    from kubebatch_tpu.kernels import victims as V
+    V.build_victim_solver = timed("reclaim.build_solver",
+                                  V.build_victim_solver)
+    if hasattr(V.VictimSolver, "visit"):
+        V.VictimSolver.visit = timed("reclaim.visit", V.VictimSolver.visit)
+
+    # --- instrument allocate internals ---
+    from kubebatch_tpu.actions import cycle_inputs as CI
+    CI.build_cycle_inputs = timed("alloc.cycle_inputs", CI.build_cycle_inputs)
+    CI.replay_decisions = timed("alloc.replay", CI.replay_decisions)
+    import kubebatch_tpu.actions.allocate as AL
+    if hasattr(AL, "cycle_inputs"):
+        AL.cycle_inputs.build_cycle_inputs = CI.build_cycle_inputs
+        AL.cycle_inputs.replay_decisions = CI.replay_decisions
+    from kubebatch_tpu.kernels import batched as BK
+    BK.solve_batched = timed("alloc.kernel", BK.solve_batched)
+    if hasattr(AL, "batched"):
+        AL.batched.solve_batched = BK.solve_batched
+
+    gc.disable()
+    for _ in range(2):
+        ssn = OpenSession(cache, tiers)
+        for _, act in acts:
+            act.execute(ssn)
+        CloseSession(ssn)
+        kubelet_tick()
+    # churn warmup (pays victim-kernel jit outside the measured window)
+    kubelet_tick()
+    sim.churn_tick(cache, churn)
+    ssn = OpenSession(cache, tiers)
+    for _, act in acts:
+        act.execute(ssn)
+    CloseSession(ssn)
+    for k in list(T):
+        del T[k], N[k]
+
+    for cycle in range(cycles):
+        kubelet_tick()
+        sim.churn_tick(cache, churn)
+        gc.collect()
+        t0 = time.perf_counter()
+        ssn = OpenSession(cache, tiers)
+        t1 = time.perf_counter()
+        T["open.TOTAL"] += t1 - t0
+        for name, act in acts:
+            a0 = time.perf_counter()
+            act.execute(ssn)
+            T[f"act.{name}.TOTAL"] += time.perf_counter() - a0
+        t2 = time.perf_counter()
+        CloseSession(ssn)
+        T["close.TOTAL"] += time.perf_counter() - t2
+        T["cycle.TOTAL"] += time.perf_counter() - t0
+    gc.enable()
+
+    print(f"--- per-cycle averages over {cycles} converged cycles ---")
+    for k in sorted(T):
+        print(f"{k:28s} {1e3 * T[k] / cycles:8.2f} ms  (n={N[k]})")
+
+
+if __name__ == "__main__":
+    main()
