@@ -153,13 +153,21 @@ class AllocateAction(Action):
         try:
             client = get_solver_client(addr)
             req, tasks_by_uid = client.snapshot_from_session(ssn)
-            resp = client.solve(req)
         except ValueError:
             # snapshot exceeds the sidecar vocabulary — known, quiet
             return False
         except Exception as e:
             logging.getLogger("kubebatch").warning(
                 "solver sidecar %s unavailable (%s); running in-process",
+                addr, e)
+            return False
+        try:
+            resp = client.solve(req)
+        except Exception as e:
+            # a solve()-side ValueError is a sidecar/response bug, not an
+            # out-of-vocabulary snapshot — fall back, but say so
+            logging.getLogger("kubebatch").warning(
+                "solver sidecar %s solve failed (%s); running in-process",
                 addr, e)
             return False
         client.apply_decisions(ssn, resp, tasks_by_uid)

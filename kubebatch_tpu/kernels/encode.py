@@ -331,9 +331,17 @@ class TermsCache:
             self._stacked = (np.stack(self._pred_rows),
                              np.stack(self._score_rows))
         pred_sp, score_sp = self._stacked
-        return StaticTerms(pred=pred_sp[:, self.profile_of],
-                           score=score_sp[:, self.profile_of],
-                           sig_of=sig_of)
+        terms = StaticTerms(pred=pred_sp[:, self.profile_of],
+                            score=score_sp[:, self.profile_of],
+                            sig_of=sig_of)
+        if len(self.sig_index) > self.MAX_SIGS:
+            # a single cycle with many unique selector shapes can overshoot
+            # the entry check's bound (it runs before this cycle's rows are
+            # added); drop the oversized matrices now rather than carrying
+            # them into the next cycle
+            self.ready = False
+            self._stacked = None
+        return terms
 
 
 # ---------------------------------------------------------------------
