@@ -340,6 +340,9 @@ class TermsCache:
             # added); drop the oversized matrices now rather than carrying
             # them into the next cycle
             self.ready = False
+            self.sig_index = {}
+            self._pred_rows = []
+            self._score_rows = []
             self._stacked = None
         return terms
 
