@@ -30,8 +30,8 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo,
-                   TaskStatus, allocated_status, job_terminated)
+from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, Resource,
+                   TaskInfo, TaskStatus, allocated_status, job_terminated)
 from ..objects import (Node, Pod, PodDisruptionBudget, PodGroup,
                        PodGroupPhase, PodPhase, PriorityClass, Queue,
                        UNSCHEDULABLE_CONDITION)
@@ -193,6 +193,19 @@ class SchedulerCache:
         #: persistent static-term encoder state (kernels/terms.TermsCache);
         #: invalidated whenever node labels/taints/shape change
         self.terms_cache = None
+        #: cross-cycle plugin state (SCALING.md latency item 2). Contract:
+        #: entries keyed by job uid are valid only while the owning job's
+        #: clone is reused by the incremental snapshot — plugins rebuild
+        #: entries for ssn.refreshed_jobs at open and rebuild everything
+        #: when refreshed_jobs is None (full snapshot). Mutations a session
+        #: makes to scratch entries stay consistent because every session
+        #: mutator marks its job touched, and touched jobs are refreshed
+        #: next cycle (adopt_snapshot folds touched into dirty).
+        self.plugin_scratch: Dict[str, object] = {}
+        #: maintained sum of node allocatable over the cluster (drf and
+        #: proportion consume it each open, drf.go:59-60); recomputed
+        #: lazily after any node-shape change instead of walked per open
+        self._alloc_total: Optional[Resource] = None
 
         self._async = async_writeback
         self._pool: Optional[ThreadPoolExecutor] = (
@@ -295,6 +308,7 @@ class SchedulerCache:
         self._mark_node(name)
         self.terms_cache = None
         self._shape_epoch += 1
+        self._alloc_total = None
 
     def offer_terms_cache(self, tc) -> None:
         """Persist a session-built TermsCache for later cycles — refused
@@ -778,6 +792,7 @@ class SchedulerCache:
                 # device victim path accumulates job uids forever
                 self._vic_refresh.clear()
                 self._vicjob_refresh.clear()
+            alloc_total = self._allocatable_total_locked()
             base = self._snap_base
             if not self._incremental or base is None:
                 snap = self.snapshot_full()
@@ -794,6 +809,7 @@ class SchedulerCache:
             dirty_jobs, self._dirty_jobs = self._dirty_jobs, set()
             dirty_nodes, self._dirty_nodes = self._dirty_nodes, set()
             snap = ClusterInfo()
+            snap.allocatable_total = alloc_total
             snap.refreshed_jobs = set()
             for name, node in self.nodes.items():
                 reuse = None if name in dirty_nodes else base_nodes.get(name)
@@ -820,6 +836,7 @@ class SchedulerCache:
         equality-tested against."""
         with self._lock:
             snap = ClusterInfo()
+            snap.allocatable_total = self._allocatable_total_locked()
             for name, node in self.nodes.items():
                 snap.nodes[node.name] = node.clone()
             for uid, q in self.queues.items():
@@ -832,6 +849,17 @@ class SchedulerCache:
                 self._stamp_priority(job)
                 snap.jobs[uid] = job.clone()
             return snap
+
+    def _allocatable_total_locked(self) -> Resource:
+        """Cluster-wide allocatable sum, recomputed only after node-shape
+        changes (SCALING.md item 2: drf/proportion walked all nodes per
+        open, ref drf.go:59-60, proportion.go:52-53)."""
+        if self._alloc_total is None:
+            total = Resource.empty()
+            for ni in self.nodes.values():
+                total.add(ni.allocatable)
+            self._alloc_total = total
+        return self._alloc_total.clone()
 
     def _stamp_priority(self, job: JobInfo) -> None:
         """ref: cache.go:561-576 (PriorityClass -> job priority)."""

@@ -55,6 +55,10 @@ class Session:
         self.queues: Dict[str, QueueInfo] = snapshot.queues
         #: job uids freshly re-cloned from cache truth (None = all)
         self.refreshed_jobs = getattr(snapshot, "refreshed_jobs", None)
+        #: cache-maintained cluster allocatable sum (None on hand-built
+        #: snapshots; total_allocatable then falls back to a node walk)
+        self._snapshot_allocatable_total = getattr(
+            snapshot, "allocatable_total", None)
         self.backlog: List[JobInfo] = []
         self.tiers: List[Tier] = []
         self.enable_preemption = enable_preemption
@@ -328,10 +332,12 @@ class Session:
         they share one walk."""
         total = getattr(self, "_total_allocatable", None)
         if total is None:
-            from ..api import Resource
-            total = Resource.empty()
-            for node in self.nodes.values():
-                total.add(node.allocatable)
+            total = self._snapshot_allocatable_total
+            if total is None:       # snapshot predates the maintained sum
+                from ..api import Resource
+                total = Resource.empty()
+                for node in self.nodes.values():
+                    total.add(node.allocatable)
             self._total_allocatable = total
         # clone: Resource's chaining API mutates in place — handing out
         # the cached object would let one caller corrupt every later one
@@ -454,21 +460,50 @@ def open_session(cache, enable_preemption: bool = False,
 def validate_jobs(ssn: Session) -> None:
     """Apply JobValid and drop failing jobs after stamping an Unschedulable
     condition on their (session-local) PodGroup (ref: session.go:92-111).
-    Called after plugins install their job_valid fns."""
-    for uid in list(ssn.jobs):
-        job = ssn.jobs[uid]
-        vr = ssn.job_valid(job)
-        if vr is not None:
-            if not vr.passed and job.pod_group is not None:
-                cond = PodGroupCondition(
-                    type=UNSCHEDULABLE_CONDITION, status="True",
-                    transition_id=ssn.uid, reason=vr.reason,
-                    message=vr.message)
-                try:
-                    ssn.update_job_condition(job, cond)
-                except KeyError:
-                    pass
-            del ssn.jobs[uid]
+    Called after plugins install their job_valid fns.
+
+    Verdicts are memoized across cycles (SCALING.md item 2; contract at
+    cache.plugin_scratch): validity reads only job truth, so a verdict
+    holds while the job's clone is reused. Failing jobs re-stamp their
+    condition each cycle (the stamp marks them touched, so they are
+    refreshed — and re-validated — next cycle, like the reference's
+    per-cycle pass)."""
+    scratch = getattr(ssn.cache, "plugin_scratch", None)
+    fingerprint = tuple(opt.name for tier in ssn.tiers
+                        for opt in tier.plugins)
+    state = scratch.get("job_valid") if scratch is not None else None
+    refreshed = ssn.refreshed_jobs
+    if (state is None or refreshed is None
+            or state["fingerprint"] != fingerprint):
+        memo: Dict[str, Optional[ValidateResult]] = {}
+        recheck = list(ssn.jobs)
+    else:
+        memo = state["memo"]
+        for uid in list(memo):
+            if uid not in ssn.jobs:
+                del memo[uid]
+        recheck = [uid for uid in ssn.jobs
+                   if uid in refreshed or uid not in memo]
+    for uid in recheck:
+        memo[uid] = ssn.job_valid(ssn.jobs[uid])
+    if scratch is not None:
+        scratch["job_valid"] = {"memo": memo, "fingerprint": fingerprint}
+    for uid, vr in memo.items():
+        if vr is None or vr.passed:
+            continue
+        job = ssn.jobs.get(uid)
+        if job is None:
+            continue
+        if job.pod_group is not None:
+            cond = PodGroupCondition(
+                type=UNSCHEDULABLE_CONDITION, status="True",
+                transition_id=ssn.uid, reason=vr.reason,
+                message=vr.message)
+            try:
+                ssn.update_job_condition(job, cond)
+            except KeyError:
+                pass
+        del ssn.jobs[uid]
 
 
 def job_status(ssn: Session, job: JobInfo) -> PodGroupStatus:
@@ -498,10 +533,17 @@ def close_session(ssn: Session) -> None:
     work recompute to an identical status with no events to emit — the
     write is skipped (a changed-nothing PUT any production updater would
     coalesce anyway). Full snapshots (refreshed = None) write every job,
-    matching the reference cycle for cycle."""
+    matching the reference cycle for cycle. Integrations that treat the
+    per-cycle PodGroup PUT as a liveness heartbeat (session.go:124-156
+    writes every job every cycle) can set KUBEBATCH_FAITHFUL_CLOSE=1 to
+    restore the reference-faithful every-cycle writes."""
+    import os as _os
     scheduled = 0
     unschedulable = 0
     refreshed = ssn.refreshed_jobs
+    if _os.environ.get("KUBEBATCH_FAITHFUL_CLOSE", "") not in ("", "0",
+                                                               "false"):
+        refreshed = None
     touched = ssn.touched_jobs
     for uid, job in ssn.jobs.items():
         pending = job.count(TaskStatus.PENDING)

@@ -173,6 +173,23 @@ class Decision(NamedTuple):
     node_name: str
 
 
+@partial(jax.jit, donate_argnums=tuple(range(8)))
+def _scatter_rows(idle, releasing, backfilled, alloc_cm, nz_req, n_tasks,
+                  max_task_num, node_ok, jidx, r_idle, r_rel, r_back, r_cm,
+                  r_nz, r_nt, r_mt, r_ok):
+    """All eight dirty-row scatters in ONE compiled dispatch (they were
+    eight eager ops; per-op dispatch dominated the steady reclaim phase).
+    Donation reuses the old buffers in place."""
+    return (idle.at[jidx].set(r_idle),
+            releasing.at[jidx].set(r_rel),
+            backfilled.at[jidx].set(r_back),
+            alloc_cm.at[jidx].set(r_cm),
+            nz_req.at[jidx].set(r_nz),
+            n_tasks.at[jidx].set(r_nt),
+            max_task_num.at[jidx].set(r_mt),
+            node_ok.at[jidx].set(r_ok))
+
+
 class DeviceSession:
     """Per-session device state: node arrays uploaded once, carried across
     job visits, and kept in lock-step with the host Session's NodeInfo maps
@@ -253,20 +270,15 @@ class DeviceSession:
             raw32 = np.concatenate(
                 [raw32, np.repeat(raw32[:1], k_pad - k, axis=0)])
             nz = np.concatenate([nz, np.repeat(nz[:1], k_pad - k, axis=0)])
-        jidx = jnp.asarray(idx)
-        self.idle = self.idle.at[jidx].set(jnp.asarray(raw32[:, 0]))
-        self.releasing = self.releasing.at[jidx].set(jnp.asarray(raw32[:, 1]))
-        self.backfilled = self.backfilled.at[jidx].set(
-            jnp.asarray(raw32[:, 2]))
-        self.allocatable_cm = self.allocatable_cm.at[jidx].set(
-            jnp.asarray(raw32[:, 3, :2]))
-        self.nz_req = self.nz_req.at[jidx].set(jnp.asarray(nz))
-        self.n_tasks = self.n_tasks.at[jidx].set(
-            jnp.asarray(state.n_tasks[idx]))
-        self.max_task_num = self.max_task_num.at[jidx].set(
-            jnp.asarray(state.max_task_num[idx]))
-        self.node_ok = self.node_ok.at[jidx].set(
-            jnp.asarray(state.schedulable[idx] & state.valid[idx]))
+        (self.idle, self.releasing, self.backfilled, self.allocatable_cm,
+         self.nz_req, self.n_tasks, self.max_task_num,
+         self.node_ok) = _scatter_rows(
+            self.idle, self.releasing, self.backfilled,
+            self.allocatable_cm, self.nz_req, self.n_tasks,
+            self.max_task_num, self.node_ok, idx,
+            raw32[:, 0], raw32[:, 1], raw32[:, 2], raw32[:, 3, :2],
+            nz, state.n_tasks[idx], state.max_task_num[idx],
+            state.schedulable[idx] & state.valid[idx])
         update_tensorize_duration(time.perf_counter() - start)
         return True
 

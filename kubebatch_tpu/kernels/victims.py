@@ -58,9 +58,13 @@ queue-wide state per eviction, so its waves degrade gracefully to
 per-visit counts).
 
 Device placement: KUBEBATCH_VICTIM_DEVICE selects where the kernels
-run: "cpu" (default — the host-process XLA CPU backend) or "default"
-(the platform default device, i.e. the TPU on real hardware). With wave
-dispatch the accelerator pays per-WAVE round trips, not per-visit ones.
+run: "auto" (default — the platform-default device when an accelerator
+is attached and its MEASURED dispatch+readback round trip is under
+KUBEBATCH_VICTIM_RTT_MAX_MS [4 ms]; the host-process XLA CPU backend
+otherwise), "cpu", or "default" (force the platform default). With
+wave dispatch the accelerator pays per-WAVE round trips, not per-visit
+ones, and wave size auto-tunes to the pending set
+(KUBEBATCH_VICTIM_WAVE_SIZE overrides).
 """
 from __future__ import annotations
 
@@ -118,10 +122,47 @@ def _ready_statuses():
     return _READY
 
 
+#: memoized device->host round-trip time of the default backend (s)
+_LINK_RTT: Optional[float] = None
+
+#: above this RTT the accelerator loses to host XLA for victim analysis:
+#: an action runs ~4-15 wave dispatches with blocking readbacks, so at
+#: 4 ms+ the link alone exceeds the whole host-side analysis (~30-50 ms);
+#: co-located hardware measures sub-ms and rides the accelerator
+_LINK_RTT_MAX = float(os.environ.get("KUBEBATCH_VICTIM_RTT_MAX_MS",
+                                     "4.0")) * 1e-3
+
+
+def _link_rtt() -> float:
+    """One-time probe of the default device's dispatch+readback latency
+    (measured, not assumed: a tunneled chip can sit ~75 ms away while a
+    co-located one answers in microseconds)."""
+    global _LINK_RTT
+    if _LINK_RTT is None:
+        import time as _t
+        dev = jax.devices()[0]
+        x = jax.device_put(np.zeros(8, np.float32), dev)
+        np.asarray(x)                      # warm the path
+        t0 = _t.perf_counter()
+        for _ in range(3):
+            np.asarray(jax.device_put(np.zeros(8, np.float32), dev))
+        _LINK_RTT = (_t.perf_counter() - t0) / 3
+    return _LINK_RTT
+
+
 def _device():
-    """Where the visit kernels run (see module docstring)."""
-    mode = os.environ.get("KUBEBATCH_VICTIM_DEVICE", "cpu")
+    """Where the visit kernels run (see module docstring).
+
+    "auto" (default): the platform-default device when an accelerator is
+    attached AND its measured round trip is fast enough for per-wave
+    readbacks (wave dispatch amortizes round trips per WAVE, but a
+    high-latency link still loses to host XLA); the host-process XLA CPU
+    backend otherwise. "cpu"/"default" force either side."""
+    mode = os.environ.get("KUBEBATCH_VICTIM_DEVICE", "auto")
     if mode == "default":
+        return None
+    if (mode == "auto" and jax.default_backend() != "cpu"
+            and _link_rtt() < _LINK_RTT_MAX):
         return None
     try:
         return jax.local_devices(backend="cpu")[0]
@@ -1084,8 +1125,19 @@ class VictimSolver:
         self._pos = {t.uid: i for i, t in enumerate(self.pending)}
         self._wave_on = os.environ.get(
             "KUBEBATCH_VICTIM_WAVE", "1") not in ("0", "false")
-        self._wave_size = max(1, int(os.environ.get(
-            "KUBEBATCH_VICTIM_WAVE_SIZE", "128")))
+        env_wave = os.environ.get("KUBEBATCH_VICTIM_WAVE_SIZE")
+        if env_wave is not None:
+            self._wave_size = max(1, int(env_wave))
+        elif self._dev is None:
+            # accelerator: each wave pays a link round trip — size waves
+            # to cover the pending set (bucketed) up to a lane budget so
+            # typical actions resolve in ONE dispatch
+            self._wave_size = min(512, max(
+                64, pad_to_bucket(max(1, len(self.pending)), 64)))
+        else:
+            # host XLA: latency ~free; moderate waves keep compile shapes
+            # small and the lazy-escalation path cheap
+            self._wave_size = 128
         self._wave_cache: Dict[tuple, dict] = {}
         self._prop = any("proportion" in t for t in tiers)
         #: dispatch counter (tests assert the wave property)

@@ -10,6 +10,7 @@ in this image; message classes are protoc-generated into solver_pb2.py).
 """
 from __future__ import annotations
 
+import os
 import time
 from concurrent import futures
 
@@ -312,6 +313,16 @@ def serve(address: str = "127.0.0.1:50061") -> None:  # pragma: no cover
     server, port = make_server(address)
     server.start()
     print(f"kubebatch-tpu solver sidecar listening on port {port}")
+    lease_port = os.environ.get("KUBEBATCH_LEASE_PORT")
+    if lease_port:
+        # the sidecar doubles as the cross-host leader-election medium
+        # (runtime/leaderelection.HttpLease points replicas here — the
+        # analogue of the reference's ConfigMap lock on the API server,
+        # cmd/kube-batch/app/server.go:170-193)
+        from ..runtime.leaderelection import HttpLeaseServer
+
+        bound = HttpLeaseServer(port=int(lease_port)).start()
+        print(f"lease service on port {bound}")
     server.wait_for_termination()
 
 

@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lock-object-namespace", default="",
                    help="namespace of the lock object / directory of the "
                         "lease file")
+    p.add_argument("--leader-elect-url", default="",
+                   help="elect through an HTTP lease service instead of "
+                        "the lease file (cross-host replicas; e.g. the "
+                        "rpc sidecar with KUBEBATCH_LEASE_PORT set)")
     p.add_argument("--listen-address", default=":8080",
                    help="address for the /metrics endpoint")
     p.add_argument("--version", action="store_true",
@@ -152,10 +156,15 @@ def main(argv=None) -> int:
             sched.run(merged)
 
     if args.leader_elect:
-        from .leaderelection import FileLease
+        if args.leader_elect_url:
+            from .leaderelection import HttpLease
 
-        lease_dir = args.lock_object_namespace or "/tmp"
-        lease = FileLease(f"{lease_dir}/kube-batch-leader.lock")
+            lease = HttpLease(args.leader_elect_url)
+        else:
+            from .leaderelection import FileLease
+
+            lease_dir = args.lock_object_namespace or "/tmp"
+            lease = FileLease(f"{lease_dir}/kube-batch-leader.lock")
 
         def fatal():
             print("leaderelection lost", file=sys.stderr)

@@ -105,12 +105,70 @@ def test_cli_version_and_cycles():
     assert out.returncode == 0, out.stderr
 
 
-def test_file_lease_single_holder(tmp_path):
-    path = str(tmp_path / "leader.lock")
-    a = FileLease(path, lease_duration=0.5, renew_deadline=0.3,
-                  retry_period=0.1, identity="a")
-    b = FileLease(path, lease_duration=0.5, renew_deadline=0.3,
-                  retry_period=0.1, identity="b")
+# --- leader election: ONE contract, every lock backend ---------------
+# (ref: cmd/kube-batch/app/server.go:170-193 — acquire, renew, fatal on
+# loss; the backend seam is runtime/leaderelection.LeaseLock)
+
+class _FileBackend:
+    """FileLease on a shared filesystem (single-host replicas)."""
+
+    def __init__(self, tmp_path):
+        self.path = str(tmp_path / "leader.lock")
+
+    def make(self, identity, lease=0.5, renew=0.3, retry=0.1):
+        return FileLease(self.path, lease_duration=lease,
+                         renew_deadline=renew, retry_period=retry,
+                         identity=identity)
+
+    def steal(self):
+        with open(self.path, "w") as f:
+            json.dump({"holder": "thief", "renew_time": time.time() + 100,
+                       "lease_duration": 60}, f)
+
+    def close(self):
+        pass
+
+
+class _HttpBackend:
+    """HttpLease against an in-process HttpLeaseServer (cross-host
+    replicas all point at one lease service)."""
+
+    def __init__(self, tmp_path):
+        from kubebatch_tpu.runtime.leaderelection import HttpLeaseServer
+
+        self.server = HttpLeaseServer(host="127.0.0.1", boot_grace=0.0)
+        port = self.server.start()
+        self.url = f"http://127.0.0.1:{port}"
+
+    def make(self, identity, lease=0.5, renew=0.3, retry=0.1):
+        from kubebatch_tpu.runtime.leaderelection import HttpLease
+
+        return HttpLease(self.url, lease_duration=lease,
+                         renew_deadline=renew, retry_period=retry,
+                         identity=identity)
+
+    def steal(self):
+        # force the state from outside CAS, like the file overwrite above
+        with self.server._lock:
+            self.server._state = {"holder": "thief",
+                                  "renew_time": time.time() + 100,
+                                  "lease_duration": 60}
+
+    def close(self):
+        self.server.stop()
+
+
+@pytest.fixture(params=["file", "http"])
+def lease_backend(request, tmp_path):
+    backend = (_FileBackend if request.param == "file"
+               else _HttpBackend)(tmp_path)
+    yield backend
+    backend.close()
+
+
+def test_lease_single_holder(lease_backend):
+    a = lease_backend.make("a")
+    b = lease_backend.make("b")
     assert a.try_acquire_or_renew() is True
     assert b.try_acquire_or_renew() is False
     assert a.try_acquire_or_renew() is True  # renew own lease
@@ -120,19 +178,14 @@ def test_file_lease_single_holder(tmp_path):
     assert a.try_acquire_or_renew() is False
 
 
-def test_file_lease_run_and_loss(tmp_path):
-    path = str(tmp_path / "leader.lock")
-    lease = FileLease(path, lease_duration=0.4, renew_deadline=0.2,
-                      retry_period=0.05, identity="runner")
+def test_lease_run_and_loss(lease_backend):
+    lease = lease_backend.make("runner", lease=0.4, renew=0.2, retry=0.05)
     events = []
     stop = threading.Event()
 
     def work(workload_stop):
         events.append("started")
-        # steal the lease from outside to force loss
-        with open(path, "w") as f:
-            json.dump({"holder": "thief", "renew_time": time.time() + 100,
-                       "lease_duration": 60}, f)
+        lease_backend.steal()    # force loss from outside
         # generous timeout: under full-suite CPU load (jit compiles) the
         # renew loop can be delayed well past its nominal deadline
         assert workload_stop.wait(timeout=30), "loss never detected"
@@ -143,6 +196,35 @@ def test_file_lease_run_and_loss(tmp_path):
 
     lease.run(work, lost, stop)
     assert events == ["started", "workload-stopped", "lost"]
+
+
+def test_http_lease_server_boot_grace_blocks_takeover():
+    """A restarted lease service must NOT hand the lease to a new holder
+    while an incumbent may still be inside its renew deadline — the
+    persistence the file/ConfigMap media give for free becomes a boot
+    grace window here."""
+    from kubebatch_tpu.runtime.leaderelection import (HttpLease,
+                                                      HttpLeaseServer)
+
+    srv = HttpLeaseServer(host="127.0.0.1", boot_grace=0.4)
+    port = srv.start()
+    try:
+        lease = HttpLease(f"http://127.0.0.1:{port}", identity="b")
+        assert lease.try_acquire_or_renew() is False   # inside grace
+        time.sleep(0.5)
+        assert lease.try_acquire_or_renew() is True    # grace elapsed
+    finally:
+        srv.stop()
+
+
+def test_http_lease_unreachable_server_is_not_acquired():
+    """A dead lease service must read as not-renewed (the elector turns
+    persistent failures into loss-of-leadership, like API-server
+    outages in the reference)."""
+    from kubebatch_tpu.runtime.leaderelection import HttpLease
+
+    lease = HttpLease("http://127.0.0.1:1", identity="x", timeout=0.3)
+    assert lease.try_acquire_or_renew() is False
 
 
 def test_solver_trace_annotation_and_capture(tmp_path, monkeypatch):

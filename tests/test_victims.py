@@ -321,6 +321,64 @@ def test_device_path_actually_runs(monkeypatch):
     assert built and all(built), "device solver must be built, not fall back"
 
 
+def test_victim_device_auto_policy(monkeypatch):
+    """The shipped default ("auto") runs victim analysis on the
+    accelerator when one is attached AND the measured link round trip is
+    fast (co-located hardware), and pins the host XLA backend for cpu-
+    only processes or slow links (VERDICT r3 item 3; the tunnel
+    measurement that motivated the RTT gate is in BENCH_NOTES round 4:
+    1.1-1.3 s/cycle on a ~75 ms link vs ~95 ms host-side)."""
+    from kubebatch_tpu.kernels import victims as kv
+
+    monkeypatch.delenv("KUBEBATCH_VICTIM_DEVICE", raising=False)
+    monkeypatch.setattr(kv.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(kv, "_link_rtt", lambda: 0.0005)   # co-located
+    assert kv._device() is None          # default placement = accelerator
+    monkeypatch.setattr(kv, "_link_rtt", lambda: 0.075)    # tunnel
+    dev = kv._device()
+    assert dev is not None and dev.platform == "cpu"
+    monkeypatch.setattr(kv.jax, "default_backend", lambda: "cpu")
+    dev = kv._device()
+    assert dev is not None and dev.platform == "cpu"
+
+
+def test_victim_auto_accelerator_waves_immediate(monkeypatch):
+    """On the accelerator path (auto + non-cpu backend) waves start
+    immediately (no lazy escalation) and wave size covers the pending
+    set; decisions still match the host oracle (the "default" device in
+    this CI process is the CPU backend, so the routing itself is what's
+    under test)."""
+    from kubebatch_tpu.kernels import victims as kv
+
+    monkeypatch.delenv("KUBEBATCH_VICTIM_DEVICE", raising=False)
+    monkeypatch.delenv("KUBEBATCH_VICTIM_WAVE_SIZE", raising=False)
+    monkeypatch.setattr(kv.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(kv, "_link_rtt", lambda: 0.0005)
+
+    solvers = []
+    orig = kv.build_victim_solver
+
+    def probe(*a, **k):
+        s = orig(*a, **k)
+        if s is not None:
+            solvers.append(s)
+        return s
+
+    monkeypatch.setattr(kv, "build_victim_solver", probe)
+    build = _contended_build(11, n_gangs=20)
+    rec = Recorder()
+    cache = SchedulerCache(binder=rec, evictor=rec, async_writeback=False)
+    build(cache)
+    ssn = OpenSession(cache, shipped_tiers())
+    PreemptAction().execute(ssn)
+    CloseSession(ssn)
+    assert solvers, "device solver must be built on the auto path"
+    for s in solvers:
+        assert s._dev is None            # platform-default placement
+        assert s._wave_after == 0        # waves immediately
+        assert s._wave_size >= min(512, max(64, len(s.pending)))
+
+
 def test_device_default_backend_option(monkeypatch):
     """KUBEBATCH_VICTIM_DEVICE=default routes the visit kernels to the
     platform-default device (the accelerator on real hardware); results

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Host-share profiler for the steady cfg5 regime (SCALING items 2-5).
+
+Runs the same persistent-cache churn loop as ``bench.py --steady`` but
+with cProfile around chosen phases, printing per-phase wall times and
+the hottest host functions. CPU backend recommended:
+
+    JAX_PLATFORMS=cpu KUBEBATCH_NO_BACKEND_PROBE=1 \
+        python tools/profile_steady.py [--config 5] [--cycles 6]
+        [--churn 256] [--phase open|reclaim|allocate|close|none]
+"""
+from __future__ import annotations
+
+import argparse
+import cProfile
+import gc
+import io
+import pstats
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=5)
+    ap.add_argument("--cycles", type=int, default=6)
+    ap.add_argument("--churn", type=int, default=256)
+    ap.add_argument("--phase", default="none",
+                    help="phase to cProfile on the LAST cycle")
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args()
+
+    from bench import CONFIG_ACTIONS, build_actions
+    from kubebatch_tpu import actions, plugins  # noqa: F401
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.objects import PodPhase
+    from kubebatch_tpu.sim import baseline_cluster
+
+    tiers = shipped_tiers()
+    sim = baseline_cluster(args.config)
+    fresh_binds = []
+
+    class _B:
+        def bind(self, pod, hostname):
+            pod.node_name = hostname
+            fresh_binds.append(pod)
+
+        def evict(self, pod):
+            pod.deletion_timestamp = 1.0
+
+    seam = _B()
+    cache = SchedulerCache(binder=seam, evictor=seam, async_writeback=False)
+    sim.populate(cache)
+    acts = build_actions(args.config, "auto")
+
+    def kubelet_tick():
+        for pod in fresh_binds:
+            if pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                cache.update_pod(pod, pod)
+        fresh_binds.clear()
+
+    gc.disable()
+    for _ in range(2):
+        ssn = OpenSession(cache, tiers)
+        for _, act in acts:
+            act.execute(ssn)
+        CloseSession(ssn)
+        kubelet_tick()
+
+    prof = cProfile.Profile()
+    for cycle in range(args.cycles):
+        sim.churn_tick(cache, args.churn)
+        gc.collect()
+        last = cycle == args.cycles - 1
+        t0 = time.perf_counter()
+        if last and args.phase == "open":
+            prof.enable()
+        ssn = OpenSession(cache, tiers)
+        if last and args.phase == "open":
+            prof.disable()
+        t1 = time.perf_counter()
+        marks = [("open", t1 - t0)]
+        for name, act in acts:
+            a0 = time.perf_counter()
+            if last and args.phase == name:
+                prof.enable()
+            act.execute(ssn)
+            if last and args.phase == name:
+                prof.disable()
+            marks.append((name, time.perf_counter() - a0))
+        c0 = time.perf_counter()
+        if last and args.phase == "close":
+            prof.enable()
+        CloseSession(ssn)
+        if last and args.phase == "close":
+            prof.disable()
+        marks.append(("close", time.perf_counter() - c0))
+        total = time.perf_counter() - t0
+        per = " ".join(f"{n}={s * 1e3:.1f}ms" for n, s in marks)
+        print(f"cycle {cycle}: {per} total={total * 1e3:.1f}ms",
+              file=sys.stderr)
+        kubelet_tick()
+    gc.enable()
+
+    if args.phase != "none":
+        out = io.StringIO()
+        stats = pstats.Stats(prof, stream=out)
+        stats.sort_stats("cumulative").print_stats(args.top)
+        print(out.getvalue())
+
+
+if __name__ == "__main__":
+    main()
