@@ -528,6 +528,92 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     return new_state, progress
 
 
+def _stranded_jobs(state: RoundState, a: CycleArrays,
+                   include_killed: bool = True):
+    """Jobs holding this-cycle placements but below quorum at a round
+    fixpoint. Gang all-or-nothing means those placements can never
+    dispatch this cycle, so the capacity they hold is dead weight that
+    completable gangs could use. They come in two kinds: KILLED jobs (a
+    task found no eligible node mid-contention — the batch analogue of
+    allocate.go:187-189, but the batch kills more often because admitted
+    competitors transiently consume capacity the sequential oracle would
+    have spent on THIS job) and, rarer, alive jobs whose proposals were
+    perpetually out-ranked."""
+    placed = ((state.task_state == ALLOC) | (state.task_state == ALLOC_OB)
+              | (state.task_state == PIPELINE)) & a.task_valid
+    j_pad = a.job_valid.shape[0]
+    job_placed = jax.ops.segment_max(
+        placed.astype(jnp.int32), jnp.maximum(a.task_job, 0),
+        num_segments=j_pad).astype(bool)
+    # quorum here counts ALLOC_OB: a job at MinAvailable only via
+    # over-backfill placements is the fork's AlmostReady state — its
+    # placements persist undispatched BY DESIGN (types.go:63-80), they
+    # are not stranded
+    ob_cnt = jax.ops.segment_sum(
+        ((state.task_state == ALLOC_OB) & a.task_valid).astype(jnp.int32),
+        jnp.maximum(a.task_job, 0), num_segments=j_pad)
+    ready = state.alloc_cnt + ob_cnt >= a.order_min_available
+    stranded = a.job_valid & job_placed & ~ready
+    if not include_killed:
+        stranded = stranded & state.job_alive
+    return stranded
+
+
+def _rollback_stranded(state: RoundState, a: CycleArrays,
+                       revive: bool = False):
+    """Revert every this-cycle placement of stranded jobs (exact inverse
+    of the round commit arithmetic). With ``revive`` the jobs re-enter
+    the rounds for a clean retry against the freed capacity (their FAIL
+    markers clear; a genuine misfit re-records on the retry) — this is
+    the epilogue emulating the oracle's job-by-job concentration at the
+    contended tail. Without it the jobs retire for the cycle and retry
+    fresh next cycle, like a window-deferred job."""
+    stranded = _stranded_jobs(state, a, include_killed=revive)
+    placed = ((state.task_state == ALLOC) | (state.task_state == ALLOC_OB)
+              | (state.task_state == PIPELINE)) & a.task_valid
+    revert = placed & stranded[jnp.maximum(a.task_job, 0)]
+    is_pipe = revert & (state.task_state == PIPELINE)
+    n_pad = state.idle.shape[0]
+    j_pad = a.job_valid.shape[0]
+    node_seg = jnp.where(revert, state.task_node, 0)
+    give_idle = jnp.where((revert & ~is_pipe)[:, None], a.resreq, 0.0)
+    give_rel = jnp.where(is_pipe[:, None], a.resreq, 0.0)
+    idle = state.idle + jax.ops.segment_sum(give_idle, node_seg,
+                                            num_segments=n_pad)
+    rel = state.releasing + jax.ops.segment_sum(give_rel, node_seg,
+                                                num_segments=n_pad)
+    ntasks = state.n_tasks - jax.ops.segment_sum(
+        revert.astype(jnp.int32), node_seg, num_segments=n_pad)
+    nz = state.nz_req - jax.ops.segment_sum(
+        jnp.where(revert[:, None], a.task_nz, 0.0), node_seg,
+        num_segments=n_pad)
+    job_seg = jnp.where(revert, a.task_job, 0)
+    take = jnp.where(revert[:, None], a.resreq, 0.0)
+    j_alloc = state.j_allocated - jax.ops.segment_sum(
+        take, job_seg, num_segments=j_pad)
+    queue_seg = jnp.where(revert, a.job_queue[jnp.maximum(a.task_job, 0)],
+                          0)
+    q_alloc = state.q_allocated - jax.ops.segment_sum(
+        take, queue_seg, num_segments=a.q_deserved.shape[0])
+    counted = revert & (state.task_state != ALLOC_OB)
+    alloc_cnt = state.alloc_cnt - jax.ops.segment_sum(
+        counted.astype(jnp.int32), job_seg, num_segments=j_pad)
+    if revive:
+        alive = state.job_alive | stranded
+        # clear the FAIL marker too so the retry starts clean (blocked
+        # tasks stayed SKIP); a real misfit re-records on the retry
+        clear = revert | ((state.task_state == FAIL)
+                          & stranded[jnp.maximum(a.task_job, 0)])
+    else:
+        alive = state.job_alive & ~stranded
+        clear = revert
+    return state._replace(
+        idle=idle, releasing=rel, n_tasks=ntasks, nz_req=nz,
+        q_allocated=q_alloc, j_allocated=j_alloc, alloc_cnt=alloc_cnt,
+        job_alive=alive,
+        task_state=jnp.where(clear, SKIP, state.task_state)), stranded
+
+
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys",
                                    "prop_overused", "dyn_enabled",
                                    "pipe_enabled"))
@@ -577,7 +663,7 @@ def batched_allocate(state: RoundState, a: CycleArrays,
     not the contended one."""
     t_pad = a.task_valid.shape[0]
 
-    def loop(st, arrays, start_round):
+    def rounds_loop(st, arrays, start_round):
         def cond(carry):
             _, round_idx, progress = carry
             return progress & (round_idx < max_rounds)
@@ -592,9 +678,37 @@ def batched_allocate(state: RoundState, a: CycleArrays,
         init = (st, jnp.int32(start_round), jnp.asarray(True))
         return jax.lax.while_loop(cond, body, init)
 
+    loop = rounds_loop
+
+    def epilogue(st, rounds):
+        """Stranded-gang epilogue at FULL task width (the compact bucket
+        holds only round-0 leftovers, but a stranded gang's placements
+        can live outside it): roll back partial gangs — killed AND alive
+        (capacity they hold can never dispatch, see _rollback_stranded)
+        — revive them, and re-run rounds so the freed capacity completes
+        whole gangs, up to 3 passes. The final non-reviving rollback
+        retires any alive-partial gang so the cycle emits none (killed
+        gangs keep their pre-kill placements + FitError, exactly like
+        the oracle's drop-on-first-unassignable)."""
+
+        def epi_cond(carry):
+            s, _, k = carry
+            return (k < 3) & jnp.any(_stranded_jobs(s, a))
+
+        def epi_body(carry):
+            s, rounds, k = carry
+            s, _ = _rollback_stranded(s, a, revive=True)
+            s, rounds, _ = rounds_loop(s, a, rounds)
+            return s, rounds, k + 1
+
+        st, rounds, _ = jax.lax.while_loop(epi_cond, epi_body,
+                                           (st, rounds, jnp.int32(0)))
+        st, _ = _rollback_stranded(st, a, revive=False)
+        return st, rounds
+
     if compact_bucket <= 0 or compact_bucket >= t_pad:
         final, rounds, _ = loop(state, a, 0)
-        return final, rounds
+        return epilogue(final, rounds)
 
     state, _ = _round(state, a, jnp.int32(0), job_keys, queue_keys,
                       prop_overused, dyn_enabled, pipe_enabled,
@@ -639,10 +753,13 @@ def batched_allocate(state: RoundState, a: CycleArrays,
         fs, rounds, _ = loop(st, a, 1)
         return fs, rounds
 
-    return jax.lax.cond(
+    merged, rounds = jax.lax.cond(
         cnt > compact_bucket, full_path,
         lambda s: jax.lax.cond(cnt == 0, done_path, compact_path, s),
         state)
+    # the epilogue always runs at full width: a stranded gang's
+    # placements can live outside the compact bucket (round 0)
+    return epilogue(merged, rounds)
 
 
 #: (buffer kind, CycleArrays/RoundState source) for the packed upload; the

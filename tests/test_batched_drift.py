@@ -95,48 +95,67 @@ def _run(mode: str, seed: int, budget=None, base_spec=None):
             "idle_sum": float(idle.sum())}
 
 
-@pytest.mark.parametrize("seed", [0, 11, 23])
-def test_batched_policy_envelope_vs_host_oracle(seed):
-    """Measured drift envelope at ~1x fragmentation-level contention
-    (values as of the demand-window/queue-pacing round engine; tightening
-    them further is a quality improvement, loosening is a regression):
-
-    - pods bound >= 88% of the oracle's (round granularity strands some
-      tail gangs the sequential engine completes),
-    - dispatched-gang symmetric difference <= 15% of the oracle's
-      dispatched set (WHICH tail gangs complete differs),
-    - per-queue fairness within 15% relative (the envelope is dominated
-      by the lowest-weight queue's tail; higher-weight queues measure
-      within ~3%),
-    - every dispatched gang is all-or-nothing in both engines (checked
-      structurally by the bound == 4*dispatched identity)."""
-    host = _run("host", seed)
-    batched = _run("batched", seed)
-
-    assert batched["bound"] == 4 * len(batched["dispatched"])
-    assert host["bound"] == 4 * len(host["dispatched"])
-    assert batched["bound"] >= 0.88 * host["bound"], (
+def _assert_envelope(host, batched, spec, binds_min=0.95, sym_max=0.08,
+                     queue_rel=0.13, drf_max=0.01, idle_frac=0.05):
+    """The measured envelope, shared by the 200-node and cfg5-shaped
+    specs. Values as of the stranded-gang revive epilogue (round-4);
+    tightening them further is a quality improvement, loosening is a
+    regression. Measured r4: binds 0.980-0.995, sym 2.4-6.9%, lowest-
+    weight queue <=11.7% rel (others <=2%), drf <=0.0035, idle-spread
+    delta <=0.9% of node capacity."""
+    per = spec.pods_per_group
+    assert batched["bound"] == per * len(batched["dispatched"])
+    assert host["bound"] == per * len(host["dispatched"])
+    assert batched["bound"] >= binds_min * host["bound"], (
         batched["bound"], host["bound"])
     sym = len(batched["dispatched"] ^ host["dispatched"])
-    assert sym <= 0.15 * len(host["dispatched"]), sym
+    assert sym <= sym_max * len(host["dispatched"]), sym
 
     # proportion fairness: per-queue allocated cpu relative to oracle
+    # (the envelope is dominated by the lowest-weight queue's tail)
     for q, want in host["queue_alloc"].items():
         got = batched["queue_alloc"].get(q, 0.0)
-        assert abs(got - want) / max(want, 1.0) <= 0.15, (q, got, want)
+        assert abs(got - want) / max(want, 1.0) <= queue_rel, (q, got, want)
 
     # DRF job shares of jobs with identical outcomes stay tight
     same = [u for u in host["job_share"]
             if (u in batched["dispatched"]) == (u in host["dispatched"])]
     diffs = [abs(batched["job_share"][u] - host["job_share"][u])
              for u in same]
-    assert max(diffs) <= 0.02, max(diffs)
+    assert max(diffs) <= drf_max, max(diffs)
 
-    # placement quality: utilization spread within 15% of a node's
-    # capacity of the oracle's
+    # placement quality: utilization spread vs the oracle's, as a
+    # fraction of one node's capacity
     assert abs(batched["idle_std"] - host["idle_std"]) \
-        <= 0.15 * SPEC.node_cpu_millis, (batched["idle_std"],
-                                         host["idle_std"])
+        <= idle_frac * spec.node_cpu_millis, (batched["idle_std"],
+                                              host["idle_std"])
+
+
+@pytest.mark.parametrize("seed", [0, 11, 23])
+def test_batched_policy_envelope_vs_host_oracle(seed):
+    """Drift envelope at ~2x oversubscription, 200 nodes (fast spec —
+    all three seeds). Gang all-or-nothing is checked structurally by
+    the bound == pods_per_group * dispatched identity."""
+    host = _run("host", seed)
+    batched = _run("batched", seed)
+    _assert_envelope(host, batched, SPEC)
+
+
+#: cfg5-shaped heterogeneous contention: >=1k nodes / >=4k pods, same
+#: oversubscription and queue weighting as the fast spec (VERDICT r3
+#: item 4 — the envelope must be pinned at stress shapes, not only at
+#: 200 nodes). One seed: the host oracle costs ~2 min of CI here.
+BIG_SPEC = ClusterSpec(n_nodes=1024, n_groups=1100, pods_per_group=4,
+                       min_member=4, n_queues=4, queue_weights=(1, 2, 3, 4),
+                       node_cpu_millis=8000, node_mem_bytes=16 * GiB,
+                       pod_cpu_millis=1800, pod_mem_bytes=3 * GiB,
+                       jitter=0.2, seed=0)
+
+
+def test_batched_policy_envelope_at_stress_shape():
+    host = _run("host", 0, base_spec=BIG_SPEC)
+    batched = _run("batched", 0, base_spec=BIG_SPEC)
+    _assert_envelope(host, batched, BIG_SPEC)
 
 
 def test_batched_matches_oracle_exactly_without_contention():
