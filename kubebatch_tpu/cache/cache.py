@@ -206,6 +206,11 @@ class SchedulerCache:
         #: proportion consume it each open, drf.go:59-60); recomputed
         #: lazily after any node-shape change instead of walked per open
         self._alloc_total: Optional[Resource] = None
+        #: bumped whenever the NODE ITERATION ORDER can change (new node
+        #: appended, node deleted — a delete+re-add reorders the dict
+        #: without changing the set); consumers caching order-derived
+        #: state (victims.py host_rank) key on it
+        self._node_order_epoch = 0
 
         self._async = async_writeback
         self._pool: Optional[ThreadPoolExecutor] = (
@@ -364,6 +369,7 @@ class SchedulerCache:
             if ti.node_name not in self.nodes:
                 # placeholder until the node event arrives
                 self.nodes[ti.node_name] = NodeInfo(None)
+                self._node_order_epoch += 1
             if not _is_terminated(ti.status):
                 self.nodes[ti.node_name].add_task(ti)
             self._mark_node(ti.node_name)
@@ -435,6 +441,7 @@ class SchedulerCache:
                 self.nodes[node.name].set_node(node)
             else:
                 self.nodes[node.name] = NodeInfo(node)
+                self._node_order_epoch += 1
             self._mark_node_shape(node.name)
 
     def update_node(self, old: Node, new: Node) -> None:
@@ -453,6 +460,7 @@ class SchedulerCache:
             if node.name not in self.nodes:
                 raise KeyError(f"node <{node.name}> does not exist")
             del self.nodes[node.name]
+            self._node_order_epoch += 1
             self._mark_node_shape(node.name)
 
     # ------------------------------------------------------------------
@@ -810,7 +818,9 @@ class SchedulerCache:
             dirty_nodes, self._dirty_nodes = self._dirty_nodes, set()
             snap = ClusterInfo()
             snap.allocatable_total = alloc_total
+            snap.node_order_epoch = self._node_order_epoch
             snap.refreshed_jobs = set()
+            snap.jobs_excluded = 0
             for name, node in self.nodes.items():
                 reuse = None if name in dirty_nodes else base_nodes.get(name)
                 snap.nodes[name] = node.clone() if reuse is None else reuse
@@ -818,8 +828,10 @@ class SchedulerCache:
                 snap.queues[uid] = q.clone()
             for uid, job in self.jobs.items():
                 if job.pod_group is None and job.pdb is None:
+                    snap.jobs_excluded += 1
                     continue
                 if job.queue not in snap.queues:
+                    snap.jobs_excluded += 1
                     continue
                 reuse = None if uid in dirty_jobs else base_jobs.get(uid)
                 if reuse is not None:
@@ -837,14 +849,18 @@ class SchedulerCache:
         with self._lock:
             snap = ClusterInfo()
             snap.allocatable_total = self._allocatable_total_locked()
+            snap.node_order_epoch = self._node_order_epoch
+            snap.jobs_excluded = 0
             for name, node in self.nodes.items():
                 snap.nodes[node.name] = node.clone()
             for uid, q in self.queues.items():
                 snap.queues[uid] = q.clone()
             for uid, job in self.jobs.items():
                 if job.pod_group is None and job.pdb is None:
+                    snap.jobs_excluded += 1
                     continue
                 if job.queue not in snap.queues:
+                    snap.jobs_excluded += 1
                     continue
                 self._stamp_priority(job)
                 snap.jobs[uid] = job.clone()

@@ -59,6 +59,13 @@ class Session:
         #: snapshots; total_allocatable then falls back to a node walk)
         self._snapshot_allocatable_total = getattr(
             snapshot, "allocatable_total", None)
+        #: jobs cache truth holds that this snapshot dropped (no
+        #: PodGroup/PDB, or missing queue) — their pods can still occupy
+        #: nodes; None on hand-built snapshots (unknown)
+        self.jobs_excluded = getattr(snapshot, "jobs_excluded", None)
+        #: node-iteration-order version (cache._node_order_epoch); None on
+        #: hand-built snapshots — order-derived caches then rebuild
+        self.node_order_epoch = getattr(snapshot, "node_order_epoch", None)
         self.backlog: List[JobInfo] = []
         self.tiers: List[Tier] = []
         self.enable_preemption = enable_preemption

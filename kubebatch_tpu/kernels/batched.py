@@ -637,7 +637,7 @@ _TASK_FIELDS = ("resreq", "init_resreq", "task_nz", "task_job", "task_rank",
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys",
                                    "prop_overused", "dyn_enabled",
                                    "pipe_enabled", "max_rounds",
-                                   "compact_bucket"))
+                                   "compact_bucket", "gang_enabled"))
 def batched_allocate(state: RoundState, a: CycleArrays,
                      job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY,
                                                   K_DRF_SHARE),
@@ -646,7 +646,8 @@ def batched_allocate(state: RoundState, a: CycleArrays,
                      dyn_enabled: bool = False,
                      pipe_enabled: bool = True,
                      max_rounds: int = 64,
-                     compact_bucket: int = 0):
+                     compact_bucket: int = 0,
+                     gang_enabled: bool = True):
     """The whole allocate cycle: rounds run in a device-side while_loop
     until a round makes no progress — ONE dispatch, one readback.
 
@@ -706,6 +707,11 @@ def batched_allocate(state: RoundState, a: CycleArrays,
         st, _ = _rollback_stranded(st, a, revive=False)
         return st, rounds
 
+    if not gang_enabled:
+        # without a gang quorum every placement dispatches — partial jobs
+        # are legitimate (non-gang reference semantics), nothing strands
+        def epilogue(st, rounds):  # noqa: F811 — identity on purpose
+            return st, rounds
     if compact_bucket <= 0 or compact_bucket >= t_pad:
         final, rounds, _ = loop(state, a, 0)
         return epilogue(final, rounds)
@@ -779,12 +785,13 @@ _PACK_BOOL = ("task_valid", "job_valid", "sig_pred")
 @partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",
                                    "queue_keys", "prop_overused",
                                    "dyn_enabled", "pipe_enabled",
-                                   "max_rounds", "compact_bucket"))
+                                   "max_rounds", "compact_bucket",
+                                   "gang_enabled"))
 def _batched_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
                     backfilled, allocatable_cm, max_task_num, node_ok,
                     lay_f, lay_i, lay_b, job_keys, queue_keys,
                     prop_overused, dyn_enabled, pipe_enabled, max_rounds,
-                    compact_bucket):
+                    compact_bucket, gang_enabled=True):
     f = _unpack(buf_f, lay_f)
     i = _unpack(buf_i, lay_i)
     b = _unpack(buf_b, lay_b)
@@ -800,7 +807,7 @@ def _batched_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
                                       allocatable_cm, max_task_num, node_ok,
                                       job_keys, queue_keys, prop_overused,
                                       dyn_enabled, pipe_enabled, max_rounds,
-                                      compact_bucket))
+                                      compact_bucket, gang_enabled))
 
 
 def _pack_result(final: RoundState, rounds):
@@ -814,7 +821,8 @@ def _pack_result(final: RoundState, rounds):
 
 def _run_batched(state, f, i, b, backfilled, allocatable_cm, max_task_num,
                  node_ok, job_keys, queue_keys, prop_overused, dyn_enabled,
-                 pipe_enabled, max_rounds, compact_bucket):
+                 pipe_enabled, max_rounds, compact_bucket,
+                 gang_enabled=True):
     arrays = CycleArrays(
         backfilled=backfilled, allocatable_cm=allocatable_cm,
         max_task_num=max_task_num, node_ok=node_ok,
@@ -833,7 +841,7 @@ def _run_batched(state, f, i, b, backfilled, allocatable_cm, max_task_num,
         state, arrays, job_keys=job_keys, queue_keys=queue_keys,
         prop_overused=prop_overused, dyn_enabled=dyn_enabled,
         pipe_enabled=pipe_enabled, max_rounds=max_rounds,
-        compact_bucket=compact_bucket)
+        compact_bucket=compact_bucket, gang_enabled=gang_enabled)
 
 
 def solve_batched(device, inputs, max_rounds: int = 0,
@@ -875,7 +883,8 @@ def solve_batched(device, inputs, max_rounds: int = 0,
             pipe_enabled=inputs.pipe_enabled,
             dyn_enabled=inputs.dyn_enabled,
             max_rounds=min(max_rounds, 4096),
-            compact_bucket=compact)
+            compact_bucket=compact,
+            gang_enabled=inputs.gang_enabled)
         # ONE blocking transfer for everything the host needs; it stays
         # inside the trace so a one-shot capture includes the device
         # execution, not just the async dispatch

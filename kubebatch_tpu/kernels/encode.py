@@ -373,11 +373,17 @@ def dynamic_features(ssn, pending: Sequence[TaskInfo]) -> Optional[str]:
             return "pending task with pod (anti-)affinity"
     # the maintained per-entity counters (JobInfo/NodeInfo.affinity_tasks,
     # pinned by debug.audit_cache) replace the per-task cluster walk this
-    # detection used to cost every cycle. Standalone pods sitting on nodes
-    # (outside any job) can still reject others through anti-affinity
-    # symmetry — the node counter covers them; existing pods' host PORTS
-    # only matter to port-requesting pending tasks, screened above.
-    if any(job.affinity_tasks for job in ssn.jobs.values()) \
-            or any(node.affinity_tasks for node in ssn.nodes.values()):
+    # detection used to cost every cycle. Pods of jobs the snapshot
+    # DROPPED (no PodGroup/PDB, missing queue) can still sit on nodes and
+    # reject others through anti-affinity symmetry — the node counters
+    # cover them, but that walk is only needed when such jobs exist
+    # (ssn.jobs_excluded; shadow PodGroups give every pod a job, so the
+    # count is normally 0). Existing pods' host PORTS only matter to
+    # port-requesting pending tasks, screened above.
+    if any(job.affinity_tasks for job in ssn.jobs.values()):
+        return "existing pod with pod (anti-)affinity"
+    excluded = getattr(ssn, "jobs_excluded", None)
+    if (excluded is None or excluded) \
+            and any(node.affinity_tasks for node in ssn.nodes.values()):
         return "existing pod with pod (anti-)affinity"
     return None

@@ -69,6 +69,7 @@ ones, and wave size auto-tunes to the pending set
 from __future__ import annotations
 
 import os
+import time as _time
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -78,6 +79,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..api import TaskInfo, TaskStatus, ready_statuses
+from ..metrics import update_solver_kernel_duration
 from ..api.resource import RESOURCE_DIM
 from .solver import dynamic_node_score
 from .tensorize import (VEC_EPS, _intern_paths, accumulate_nz, load_kb_pack,
@@ -512,7 +514,8 @@ class SegmentStore:
                  "v_crit", "v_live", "rows_used", "dead_cap",
                  "job_rows", "j_present", "ready_cnt",
                  "min_av", "j_alloc", "job_queue", "q_ids",
-                 "present_uids", "job_marks_pending", "orphan_uids")
+                 "present_uids", "job_marks_pending", "orphan_uids",
+                 "host_rank", "host_rank_epoch")
 
     def __init__(self):
         self.segs: Dict[str, _NodeSegment] = {}
@@ -531,6 +534,8 @@ class SegmentStore:
         self.dead_cap = 0
         # job space
         self.job_rows: Dict[str, int] = {}
+        self.host_rank: Optional[np.ndarray] = None
+        self.host_rank_epoch = None
         self.j_present: Optional[np.ndarray] = None
         self.ready_cnt: Optional[np.ndarray] = None
         self.min_av: Optional[np.ndarray] = None
@@ -675,6 +680,11 @@ class VictimState:
                  allocatable_cm: np.ndarray):
         self.node_index = node_index
         self.n_pad = n_pad
+        _t = _time.perf_counter if os.environ.get(
+            "KB_VICTIM_TIMING") else None
+        _m = [] if _t else None
+        if _t:
+            _m.append(("start", _t()))
         # mutable node mirrors + victim-row material, assembled from the
         # cache's persistent SegmentStore: only nodes/jobs the cache
         # dirtied or the session touched recompute from HOST truth, and
@@ -716,6 +726,8 @@ class VictimState:
                     "segment column order diverged from the node index")
         nz_mat, cnt = store.nz_mat, store.cnt
 
+        if _t:
+            _m.append(("jobspace", _t()))
         # ---- job index space (persistent, grow-only) ------------------
         self.queue_ids = sorted(ssn.queues)
         self.q_index = {q: i for i, q in enumerate(self.queue_ids)}
@@ -820,6 +832,8 @@ class VictimState:
         self.cluster_total = (drf.total_resource.to_vec() if drf is not None
                               else np.ones(RESOURCE_DIM, np.float32))
 
+        if _t:
+            _m.append(("segrefresh", _t()))
         # ---- segment refresh ------------------------------------------
         refresh |= repair_nodes
         if rows_reset:
@@ -847,6 +861,8 @@ class VictimState:
                 if name not in live_names:
                     del segs[name]
 
+        if _t:
+            _m.append(("rowspace", _t()))
         # ---- row space: per-node slots, refreshed slots rewritten -----
         if rows_reset or store.dead_cap > max(64, store.rows_used // 3):
             store._clear_rows()
@@ -894,19 +910,34 @@ class VictimState:
             for i in range(off + k, off + cap):
                 tasks_l[i] = None
 
+        if _t:
+            _m.append(("mirrors", _t()))
         # ---- node mirrors ---------------------------------------------
         self.nz_req = nz_mat.copy()
         self.n_tasks = cnt.copy()
         self.node_ok = node_ok
         self.max_task_num = max_task_num
         self.allocatable_cm = allocatable_cm
-        host_rank = np.full(n_pad, np.iinfo(np.int32).max, np.int32)
-        for pos, name in enumerate(ssn.nodes):
-            idx = node_index.get(name)
-            if idx is not None:
-                host_rank[idx] = pos
-        self.host_rank = host_rank
+        # host visit order (ssn.nodes dict order) — stable while the node
+        # set is; persist on the store instead of walking 5k nodes per
+        # action build
+        cached_rank = getattr(store, "host_rank", None)
+        order_epoch = getattr(ssn, "node_order_epoch", None)
+        if rows_reset or cached_rank is None \
+                or len(cached_rank) != n_pad \
+                or order_epoch is None \
+                or store.host_rank_epoch != order_epoch:
+            host_rank = np.full(n_pad, np.iinfo(np.int32).max, np.int32)
+            for pos, name in enumerate(ssn.nodes):
+                idx = node_index.get(name)
+                if idx is not None:
+                    host_rank[idx] = pos
+            store.host_rank = host_rank
+            store.host_rank_epoch = order_epoch
+        self.host_rank = store.host_rank
 
+        if _t:
+            _m.append(("queues", _t()))
         # ---- queue arrays (small; rebuilt per build) ------------------
         q_pad = pad_to_bucket(max(1, len(self.queue_ids)), 4)
         self.q_alloc = np.zeros((q_pad, RESOURCE_DIM), np.float32)
@@ -947,22 +978,34 @@ class VictimState:
         self.job_queue = store.job_queue
 
         # orderings + segment heads (dead rows keep stale keys — they
-        # contribute nothing: every kernel term masks on v_live/cand)
-        self.perm_nj = np.lexsort((np.arange(v_pad), self.v_job,
-                                   self.v_node)).astype(np.int32)
-        nj = np.stack([self.v_node[self.perm_nj],
-                       self.v_job[self.perm_nj]], axis=1)
+        # contribute nothing: every kernel term masks on v_live/cand).
+        # One combined int64 key + stable argsort per ordering instead of
+        # a 3-key lexsort + 2-column stack: same order (stable argsort's
+        # index tiebreak IS the arange key), ~half the build cost at 10k+
+        # rows
+        nj_key = (self.v_node.astype(np.int64) << 32) \
+            + self.v_job.astype(np.int64) + (1 << 31)
+        self.perm_nj = np.argsort(nj_key, kind="stable").astype(np.int32)
+        njs = nj_key[self.perm_nj]
         self.nj_head = np.ones(v_pad, bool)
-        self.nj_head[1:] = np.any(nj[1:] != nj[:-1], axis=1)
+        self.nj_head[1:] = njs[1:] != njs[:-1]
         vq = np.where(self.v_job >= 0,
                       self.job_queue[np.maximum(self.v_job, 0)], -1)
-        self.perm_nq = np.lexsort((np.arange(v_pad), vq,
-                                   self.v_node)).astype(np.int32)
-        nq = np.stack([self.v_node[self.perm_nq], vq[self.perm_nq]], axis=1)
+        nq_key = (self.v_node.astype(np.int64) << 32) \
+            + vq.astype(np.int64) + (1 << 31)
+        self.perm_nq = np.argsort(nq_key, kind="stable").astype(np.int32)
+        nqs = nq_key[self.perm_nq]
         self.nq_head = np.ones(v_pad, bool)
-        self.nq_head[1:] = np.any(nq[1:] != nq[:-1], axis=1)
+        self.nq_head[1:] = nqs[1:] != nqs[:-1]
 
         self._row_of: Optional[Dict[str, int]] = None
+        if _t:
+            _m.append(("end", _t()))
+            import sys as _sys
+            spans = " ".join(
+                f"{lbl}={1e3 * (t1 - t0):.2f}ms"
+                for (lbl, t0), (_, t1) in zip(_m, _m[1:]))
+            print(f"victimstate: {spans}", file=_sys.stderr)
 
         #: mutation event log for the wave cache's fine-grained
         #: invalidation (VictimSolver.visit): ("evict", row, node, job),
@@ -1361,12 +1404,15 @@ class VictimSolver:
                 score_nodes=self.score_nodes, room_check=self.room_check)
 
         self.dispatches += 1
+        k0 = _time.perf_counter()
         if self._dev is not None:
             with jax.default_device(self._dev):
                 out = run()
         else:
             out = run()
         pick, guard, victims = map(np.asarray, out)
+        update_solver_kernel_duration("victim_wave",
+                                      _time.perf_counter() - k0)
         log_pos = len(st.events)
         for i, t in enumerate(chunk):
             self._wave_cache[(filter_kind, t.uid)] = {
@@ -1411,12 +1457,15 @@ class VictimSolver:
                 filter_kind=filter_kind, dyn_enabled=dyn_enabled,
                 score_nodes=self.score_nodes, room_check=self.room_check)
 
+        k0 = _time.perf_counter()
         if self._dev is not None:
             with jax.default_device(self._dev):
                 out = run()
         else:
             out = run()
         found, node, vic_mask, vcount, guard = map(np.asarray, out)
+        update_solver_kernel_duration("victim_visit",
+                                      _time.perf_counter() - k0)
         rows = np.nonzero(vic_mask)[0].tolist() if found else []
         node = int(node)
         return VisitResult(

@@ -358,3 +358,27 @@ def test_bulk_replay_state_matches_ordered():
             np.testing.assert_allclose(
                 np.asarray(b[k], float), np.asarray(o[k], float),
                 rtol=1e-9, atol=1e-3, err_msg=f"{k} accounting diverges")
+
+
+def test_batched_partial_job_dispatches_when_gang_disabled():
+    """Without the gang plugin there is no quorum: a job that can only
+    place SOME of its pods still dispatches them (non-gang reference
+    semantics — session.job_ready defaults Ready, session.py:190-192).
+    The stranded-gang epilogue must not treat such partial placements as
+    stranded (it is gated on gang_enabled)."""
+    no_gang_tiers = [
+        Tier(plugins=[PluginOption(name="priority"),
+                      PluginOption(name="conformance")]),
+        Tier(plugins=[PluginOption(name="drf"),
+                      PluginOption(name="predicates"),
+                      PluginOption(name="proportion"),
+                      PluginOption(name="nodeorder")]),
+    ]
+    # room for exactly 2 of the 4 pods; min_member 4 is irrelevant
+    # without gang
+    nodes = [build_node("n0", rl(2000, 4 * GiB, pods=12))]
+    groups = [build_group("ns", "pg0", 4, queue="q1")]
+    pods = [build_pod("ns", f"p{i}", "", "Pending", rl(1000, GiB),
+                      group="pg0") for i in range(4)]
+    _, binds = run((nodes, groups, pods), "batched", tiers=no_gang_tiers)
+    assert len(binds) == 2, binds

@@ -72,11 +72,31 @@ def main():
         CloseSession(ssn)
         kubelet_tick()
 
+    def device_seconds():
+        """Sum of the solver-kernel + tensorize histograms — the wall
+        time spent dispatching/awaiting device work (it moves off-host
+        on a co-located accelerator); host share = phase - this."""
+        from kubebatch_tpu import metrics as m
+        total = 0.0
+        for hist in (getattr(m, "solver_kernel_latency", None),
+                     getattr(m, "tensorize_latency", None)):
+            if hist is None:
+                continue
+            try:
+                for metric in hist.collect():
+                    for s in metric.samples:
+                        if s.name.endswith("_sum"):
+                            total += s.value
+            except Exception:
+                continue      # keep the split monotone across cycles
+        return total * 1e-6
+
     prof = cProfile.Profile()
     for cycle in range(args.cycles):
         sim.churn_tick(cache, args.churn)
         gc.collect()
         last = cycle == args.cycles - 1
+        dev0 = device_seconds()
         t0 = time.perf_counter()
         if last and args.phase == "open":
             prof.enable()
@@ -101,8 +121,10 @@ def main():
             prof.disable()
         marks.append(("close", time.perf_counter() - c0))
         total = time.perf_counter() - t0
+        dev = device_seconds() - dev0
         per = " ".join(f"{n}={s * 1e3:.1f}ms" for n, s in marks)
-        print(f"cycle {cycle}: {per} total={total * 1e3:.1f}ms",
+        print(f"cycle {cycle}: {per} total={total * 1e3:.1f}ms "
+              f"device={dev * 1e3:.1f}ms host={(total - dev) * 1e3:.1f}ms",
               file=sys.stderr)
         kubelet_tick()
     gc.enable()
