@@ -595,6 +595,7 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
                     backfill_adds.append((node, task.resreq))
                 if task.pod.has_pod_affinity():
                     node.affinity_tasks += 1
+                node._own_tasks()
                 node.tasks[task.key] = task.clone()
 
             # --- dispatch decision + single job index move ---------------

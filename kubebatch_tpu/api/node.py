@@ -37,6 +37,7 @@ class NodeInfo:
             self.allocatable = Resource.empty()
             self.capability = Resource.empty()
         self.tasks: Dict[str, TaskInfo] = {}
+        self._tasks_shared = False
         #: tasks whose pod carries inter-pod (anti-)affinity (see
         #: JobInfo.affinity_tasks)
         self.affinity_tasks: int = 0
@@ -44,7 +45,15 @@ class NodeInfo:
     def clone(self) -> "NodeInfo":
         """Deep copy: the maintained accounting is copied rather than
         re-derived task by task (equivalent, since add_task maintains it
-        incrementally; this runs O(nodes) per snapshot, every cycle)."""
+        incrementally; this runs O(nodes) per snapshot, every cycle).
+
+        The task map is shared COPY-ON-WRITE: no code path mutates a
+        node-held TaskInfo in place (status changes go through
+        remove+add / update_task, which replace the entry), so clones
+        can share the dict — and its task objects — until one side's
+        map changes shape. Mutators call _own_tasks() first; a direct
+        ``node.tasks[k] = ...`` write without it corrupts the other
+        side's snapshot."""
         res = object.__new__(NodeInfo)
         res.name = self.name
         res.node = self.node
@@ -54,9 +63,18 @@ class NodeInfo:
         res.idle = self.idle.clone()
         res.allocatable = self.allocatable.clone()
         res.capability = self.capability.clone()
-        res.tasks = {key: t.clone() for key, t in self.tasks.items()}
+        res.tasks = self.tasks
+        res._tasks_shared = True
+        self._tasks_shared = True
         res.affinity_tasks = self.affinity_tasks
         return res
+
+    def _own_tasks(self) -> None:
+        """Materialize a private task map before the first shape change
+        (shallow copy: the TaskInfo values stay shared, see clone)."""
+        if self._tasks_shared:
+            self.tasks = dict(self.tasks)
+            self._tasks_shared = False
 
     def set_node(self, node: Node) -> None:
         """Recompute accounting from scratch for a (re)seen node
@@ -107,6 +125,7 @@ class NodeInfo:
             self.used.add(ti.resreq)
         if ti.pod.has_pod_affinity():
             self.affinity_tasks += 1
+        self._own_tasks()
         self.tasks[key] = ti
 
     def remove_task(self, ti: TaskInfo) -> None:
@@ -129,6 +148,7 @@ class NodeInfo:
             self.used.sub(task.resreq)
         if task.pod.has_pod_affinity():
             self.affinity_tasks -= 1
+        self._own_tasks()
         del self.tasks[key]
 
     def update_task(self, ti: TaskInfo) -> None:

@@ -685,6 +685,7 @@ class SchedulerCache:
                     acc[2] += rr.milli_gpu
                 if task.pod.has_pod_affinity():
                     node.affinity_tasks += 1
+                node._own_tasks()
                 node.tasks[key] = task.clone()
                 self._mark_job(job.uid)
                 self._mark_node(hostname)
