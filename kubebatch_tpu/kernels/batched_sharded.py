@@ -34,33 +34,55 @@ from .batched import RoundState, CycleArrays, _IMAX, batched_allocate
 from .fused import SKIP
 
 AXIS = "nodes"
+HOST_AXIS = "hosts"
 
 
-def node_mesh(n_devices: Optional[int] = None) -> Mesh:
-    """A 1-D mesh over the local devices, axis name "nodes"."""
+def node_mesh(n_devices: Optional[int] = None,
+              n_hosts: int = 1) -> Mesh:
+    """A mesh over the local devices with the node axis partitioned.
+
+    ``n_hosts > 1`` builds the hierarchical 2-D mesh of the multi-host
+    recipe (docs/SCALING.md "Multi-host (DCN)" step 4): axis ``"hosts"``
+    over host groups (DCN) x ``"nodes"`` within a host (ICI); the node
+    dimension of every sharded array is then split over BOTH axes, so
+    the waterfall's all-gather becomes hierarchical — XLA inserts the
+    ICI-then-DCN pattern from the same annotations."""
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
+    if n_hosts > 1:
+        if len(devs) % n_hosts:
+            raise ValueError(f"{len(devs)} devices do not split over "
+                             f"{n_hosts} hosts")
+        return Mesh(np.array(devs).reshape(n_hosts, -1), (HOST_AXIS, AXIS))
     return Mesh(np.array(devs), (AXIS,))
 
 
-#: CycleArrays fields and their PartitionSpecs (node axis sharded)
-_ARRAY_SPECS = dict(
-    backfilled=P(AXIS, None), allocatable_cm=P(AXIS, None),
-    max_task_num=P(AXIS), node_ok=P(AXIS),
-    resreq=P(), init_resreq=P(), task_nz=P(), task_job=P(),
-    task_rank=P(), task_sig=P(), task_pair=P(), task_valid=P(),
-    sig_scores=P(None, AXIS), sig_pred=P(None, AXIS),
-    pair_sig=P(), pair_nz=P(),
-    order_min_available=P(), job_queue=P(), job_priority=P(),
-    job_create_rank=P(), job_valid=P(),
-    q_deserved=P(), q_create_rank=P(), cluster_total=P(), dyn_weights=P())
+def _specs_for(mesh: Mesh):
+    """(array_specs, state_specs) for the mesh: the node dimension is
+    split over every mesh axis — ``("nodes",)`` on a 1-D mesh,
+    ``("hosts", "nodes")`` hierarchically on the 2-D multi-host mesh."""
+    na = (tuple(mesh.axis_names) if len(mesh.axis_names) > 1
+          else AXIS)
+    array_specs = dict(
+        backfilled=P(na, None), allocatable_cm=P(na, None),
+        max_task_num=P(na), node_ok=P(na),
+        resreq=P(), init_resreq=P(), task_nz=P(), task_job=P(),
+        task_rank=P(), task_sig=P(), task_pair=P(), task_valid=P(),
+        sig_scores=P(None, na), sig_pred=P(None, na),
+        pair_sig=P(), pair_nz=P(),
+        order_min_available=P(), job_queue=P(), job_priority=P(),
+        job_create_rank=P(), job_valid=P(),
+        q_deserved=P(), q_create_rank=P(), cluster_total=P(),
+        dyn_weights=P())
+    state_specs = dict(
+        idle=P(na, None), releasing=P(na, None), n_tasks=P(na),
+        nz_req=P(na, None), q_allocated=P(), j_allocated=P(),
+        alloc_cnt=P(), job_alive=P(), task_state=P(), task_node=P(),
+        task_seq=P())
+    return array_specs, state_specs
 
-_STATE_SPECS = dict(
-    idle=P(AXIS, None), releasing=P(AXIS, None), n_tasks=P(AXIS),
-    nz_req=P(AXIS, None), q_allocated=P(), j_allocated=P(),
-    alloc_cnt=P(), job_alive=P(), task_state=P(), task_node=P(),
-    task_seq=P())
+
 
 
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys", "prop_overused",
@@ -156,10 +178,11 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
             k: jax.device_put(getattr(tree, k), NamedSharding(mesh, s))
             for k, s in specs.items()})
 
+    array_specs, state_specs = _specs_for(mesh)
     start = time.perf_counter()
     with solver_trace("batched_allocate_sharded"):
         final, packed = _sharded_entry(
-            put(state, _STATE_SPECS), put(arrays, _ARRAY_SPECS),
+            put(state, state_specs), put(arrays, array_specs),
             job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
             prop_overused=inputs.prop_overused,
             dyn_enabled=inputs.dyn_enabled,

@@ -93,6 +93,31 @@ def test_sharded_mode_end_to_end():
     assert results["sharded"] == results["batched"]
 
 
+def test_hierarchical_mesh_decisions_match_single_device():
+    """The multi-host recipe (docs/SCALING.md "Multi-host (DCN)" step 4):
+    a 2-D ("hosts", "nodes") mesh splits the node dimension over BOTH
+    axes — hierarchical DCN x ICI partitioning from the same
+    annotations — and decisions stay bit-identical to single-chip."""
+    ssn_a = _open(3)
+    inputs_a = build_cycle_inputs(ssn_a)
+    st_a, nd_a, seq_a, _ = solve_batched(inputs_a.device, inputs_a,
+                                         compact_bucket=0)
+
+    ssn_b = _open(3)
+    inputs_b = build_cycle_inputs(ssn_b)
+    mesh = node_mesh(n_hosts=2)          # 2 "hosts" x 4 "chips" on the
+    assert mesh.devices.shape[0] == 2    # virtual 8-device CPU mesh
+    st_b, nd_b, seq_b, _ = solve_batched_sharded(mesh, inputs_b.device,
+                                                 inputs_b)
+
+    np.testing.assert_array_equal(st_a, st_b)
+    np.testing.assert_array_equal(seq_a, seq_b)
+    placed = np.isin(st_a, [1, 2, 3])
+    np.testing.assert_array_equal(nd_a[placed], nd_b[placed])
+    CloseSession(ssn_a)
+    CloseSession(ssn_b)
+
+
 def test_shard_bucket():
     assert shard_bucket(5000, 8) == 8192
     assert shard_bucket(8, 8) == 8
