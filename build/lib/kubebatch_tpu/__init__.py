@@ -1,0 +1,34 @@
+"""kubebatch_tpu — a TPU-native batch/gang scheduling framework.
+
+A from-scratch re-design of kube-batch's capability set (reference:
+DonghuiZhuo/kube-batch-1) where the O(pods x nodes) predicate / scoring /
+bin-packing hot loops of each scheduling cycle run as dense JAX/XLA kernels
+on TPU, while the session / action / plugin policy architecture remains a
+thin host-side orchestration layer.
+
+Layering (mirrors the reference's *capabilities*, not its class layout —
+see SURVEY.md sect. 7):
+
+- ``objects``   — cluster API objects (Pod/Node/PodGroup/Queue/...), the
+                  equivalent of the reference's CRD + core-v1 types.
+- ``api``       — in-memory domain model (Resource/TaskInfo/JobInfo/
+                  NodeInfo/QueueInfo/ClusterInfo), ref pkg/scheduler/api.
+- ``cache``     — cluster-state mirror + event ingestion + writeback seams,
+                  ref pkg/scheduler/cache.
+- ``framework`` — Session / plugin registry / tiered dispatch / Statement,
+                  ref pkg/scheduler/framework.
+- ``actions``   — allocate, backfill, preempt, reclaim policies,
+                  ref pkg/scheduler/actions.
+- ``plugins``   — gang, drf, proportion, priority, predicates, nodeorder,
+                  conformance, ref pkg/scheduler/plugins.
+- ``kernels``   — the TPU-native part with no reference counterpart: dense
+                  tensorization of snapshots and jitted predicate-mask /
+                  node-score / capacity-carrying assignment solvers
+                  (vmap / lax.scan / shard_map over a device mesh).
+- ``runtime``   — scheduler loop, YAML policy config, metrics, CLI,
+                  ref pkg/scheduler/scheduler.go + cmd/kube-batch.
+- ``sim``       — synthetic cluster generation and simulated e2e harness,
+                  ref test/e2e's role (no real k8s needed).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+"""Scheduling actions (ref: pkg/scheduler/actions).
+
+Importing this package registers all built-in actions, mirroring the
+reference's blank-import self-registration (actions/factory.go:231-236).
+"""
+from . import allocate, backfill, preempt, reclaim
+
+__all__ = ["allocate", "backfill", "preempt", "reclaim"]

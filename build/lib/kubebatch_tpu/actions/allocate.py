@@ -1,0 +1,358 @@
+"""allocate — the primary scheduling action.
+
+Solver modes (KUBEBATCH_SOLVER env or constructor arg):
+- "auto" (default): "batched" when the cycle carries at least
+  AUTO_BATCHED_MIN pending tasks, else "fused" — the big configs get the
+  throughput engine without env vars while small/exact cycles keep the
+  bit-exact one.
+- "batched": the round-based throughput solver (kernels/batched.py) —
+  many placements per device step, fairness refreshed between rounds;
+  the engine the north-star latency target is measured on.
+- "fused": the whole cycle in ONE device dispatch
+  (kernels/fused.py) — queue/job/task selection and fairness state live
+  in-kernel, bit-exact vs the host heap algorithm; host replays the
+  decisions through Session.allocate/pipeline so plugins and the gang
+  barrier observe identical events.
+- "jax": one device scan per job visit (kernels/solver.py) — more
+  dispatches, used when the configured plugins fall outside the fused
+  kernel's key vocabulary.
+- "host": the reference-literal per-pair loops — the semantic oracle.
+- "rpc": the whole action through the gRPC solver sidecar (rpc/), which
+  picks its engine by snapshot size like auto mode; falls back to the
+  in-process auto path when the sidecar is unreachable or the snapshot
+  exceeds its vocabulary.
+
+
+ref: pkg/scheduler/actions/allocate/allocate.go. Control flow is preserved
+exactly (queue PQ with one entry per job, overused queues dropped, one job
+per queue visit, job re-pushed only when it crosses readiness, job dropped
+on first unassignable task, queue re-pushed after every visit).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ..api import JobInfo, TaskInfo, TaskStatus
+from ..framework import (Action, Session, VolumeAllocationError,
+                         register_action)
+from ..kernels.solver import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
+                              DeviceSession)
+from ..kernels.tensorize import TaskBatch
+from ..kernels.terms import (device_supported, pred_and_score_matrices,
+                             solver_terms)
+from ..util import PriorityQueue, select_best_node
+
+#: auto mode switches to the batched engine at this many pending tasks —
+#: below it the fused engine's one-placement-per-step while_loop is cheap
+#: and keeps bind-for-bind ordering exactness
+AUTO_BATCHED_MIN = 512
+
+#: auto mode further upgrades batched -> sharded when more than one
+#: device is visible AND the node axis is at least this large — below it
+#: the per-device shard is too small for the partitioning to pay for its
+#: collectives (on a single chip sharded degenerates to batched anyway)
+AUTO_SHARDED_MIN_NODES = 512
+
+
+def _effective_min_available(ssn: Session, job: JobInfo) -> int:
+    """The readiness threshold the kernel enforces in-scan. With a job-ready
+    fn installed (gang), readiness = allocated-family count reaching
+    MinAvailable; with none, the session defaults to Ready (ref:
+    session_plugins.go:167-186) which the kernel encodes as threshold 0."""
+    for tier in ssn.tiers:
+        for plugin in tier.plugins:
+            if plugin.job_ready_disabled:
+                continue
+            if plugin.name in ssn.job_ready_fns:
+                return int(job.min_available)
+    return 0
+
+
+def _init_allocated(job: JobInfo) -> int:
+    """Initial ready-task count for the kernels' in-scan readiness."""
+    from ..api import ready_statuses
+    return job.count(*ready_statuses())
+
+
+class AllocateAction(Action):
+    def __init__(self, mode: Optional[str] = None):
+        self._mode = mode
+
+    @property
+    def name(self) -> str:
+        return "allocate"
+
+    @property
+    def mode(self) -> str:
+        return self._mode or os.environ.get("KUBEBATCH_SOLVER", "auto")
+
+    @staticmethod
+    def _auto_mode(ssn: Session) -> str:
+        """Size-based engine selection (the shipped default and the
+        rpc-unavailable fallback share it)."""
+        pending = sum(
+            len(j.task_status_index.get(TaskStatus.PENDING, {}))
+            for j in ssn.jobs.values())
+        if pending < AUTO_BATCHED_MIN:
+            return "fused"
+        if len(ssn.nodes) >= AUTO_SHARDED_MIN_NODES:
+            import jax
+            if len(jax.devices()) > 1:
+                # multi-chip host, big node axis: the shipped default
+                # partitions the round engine over the mesh
+                # (SURVEY §2.9 row 43)
+                return "sharded"
+        return "batched"
+
+    def execute(self, ssn: Session) -> None:
+        mode = self.mode
+        if mode == "auto":
+            mode = self._auto_mode(ssn)
+        if mode == "rpc":
+            # route the whole action through the gRPC solver sidecar
+            # (KUBEBATCH_SOLVER=rpc; address from KUBEBATCH_SOLVER_ADDR).
+            # The sidecar picks its engine by snapshot size like auto
+            # mode; on connection failure or an out-of-vocabulary
+            # snapshot the action falls back to the in-process auto path
+            # (the reference's convergence-by-rescheduling spirit: a
+            # degraded cycle beats a skipped one)
+            if self._execute_rpc(ssn):
+                return
+            mode = self._auto_mode(ssn)
+        if mode in ("batched", "sharded"):
+            from .allocate_batched import batched_supported, execute_batched
+            # execute_batched itself returns False (without consuming
+            # state) when the snapshot carries unsupported features
+            if batched_supported(ssn) \
+                    and execute_batched(ssn, sharded=(mode == "sharded")):
+                return
+            mode = "batched"   # device fallback path below
+        elif mode == "fused":
+            from .allocate_fused import execute_fused, fused_supported
+            # execute_fused itself returns False (without consuming state)
+            # when the snapshot carries features the kernel can't model
+            if fused_supported(ssn) and execute_fused(ssn):
+                return
+            # configured plugins exceed the fused vocabulary; fall back to
+            # the per-visit device solver
+        self._execute_queued(ssn, mode)
+
+    def _execute_rpc(self, ssn: Session) -> bool:
+        """One remote solve through the sidecar; False = fall back.
+
+        Fallback is only legal BEFORE any session mutation: snapshot
+        encoding and the remote call can fail over to in-process safely,
+        but replay errors propagate (a partially-replayed session must
+        not be re-solved by another engine on inconsistent state)."""
+        import logging
+
+        from ..rpc.client import get_solver_client
+
+        addr = os.environ.get("KUBEBATCH_SOLVER_ADDR", "127.0.0.1:50061")
+        try:
+            client = get_solver_client(addr)
+            req, tasks_by_uid = client.snapshot_from_session(ssn)
+        except ValueError:
+            # snapshot exceeds the sidecar vocabulary — known, quiet
+            return False
+        except Exception as e:
+            logging.getLogger("kubebatch").warning(
+                "solver sidecar %s unavailable (%s); running in-process",
+                addr, e)
+            return False
+        try:
+            resp = client.solve(req)
+        except Exception as e:
+            # a solve()-side ValueError is a sidecar/response bug, not an
+            # out-of-vocabulary snapshot — fall back, but say so
+            logging.getLogger("kubebatch").warning(
+                "solver sidecar %s solve failed (%s); running in-process",
+                addr, e)
+            return False
+        client.apply_decisions(ssn, resp, tasks_by_uid)
+        return True
+
+    def _execute_queued(self, ssn: Session, mode: Optional[str] = None) -> None:
+        if mode is None:
+            mode = self.mode
+        queues = PriorityQueue(ssn.queue_order_fn)
+        jobs_map: Dict[str, PriorityQueue] = {}
+        pending_all: List[TaskInfo] = []
+        for job in ssn.jobs.values():
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            # one queue entry per job, as the reference does (allocate.go:50)
+            queues.push(queue)
+            jobs_map.setdefault(job.queue, PriorityQueue(ssn.job_order_fn))
+            jobs_map[job.queue].push(job)
+            pending_all.extend(
+                t for t in job.task_status_index.get(TaskStatus.PENDING,
+                                                     {}).values()
+                if not t.resreq.is_empty())
+
+        pending_tasks: Dict[str, PriorityQueue] = {}
+        # registered predicate/node-order callbacks run on device when
+        # kernels/terms can express them (static sig matrices + in-kernel
+        # least-requested/balanced terms); snapshots with features the
+        # kernels can't model (inter-pod affinity, pending host ports,
+        # third-party callbacks) take the reference-literal host path
+        device = None
+        terms = None
+        if mode in ("jax", "fused", "batched") \
+                and device_supported(ssn, pending_all):
+            # the cheap gate above keeps fallback cycles from paying the
+            # full-cluster tensorize + device upload
+            if ssn.device_snapshot is None:
+                mk = getattr(ssn.cache, "device_session", None)
+                ssn.device_snapshot = (mk(ssn) if mk is not None
+                                       else DeviceSession(ssn.nodes))
+            terms = solver_terms(ssn, ssn.device_snapshot, pending_all,
+                                 assume_supported=True)
+            if terms is not None:
+                device = ssn.device_snapshot
+        elif mode == "native" and not (ssn.predicate_fns
+                                       or ssn.node_order_fns):
+            from ..native import NativeSession, native_available
+            if native_available():
+                device = NativeSession(ssn.nodes)
+
+        while not queues.empty():
+            queue = queues.pop()
+            if ssn.overused(queue):
+                continue
+            jobs = jobs_map.get(queue.uid)
+            if jobs is None or jobs.empty():
+                continue
+            job = jobs.pop()
+            if job.uid not in pending_tasks:
+                tasks = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index.get(TaskStatus.PENDING,
+                                                      {}).values():
+                    if task.resreq.is_empty():
+                        continue  # BestEffort handled by backfill
+                    tasks.push(task)
+                pending_tasks[job.uid] = tasks
+            tasks = pending_tasks[job.uid]
+
+            if not tasks.empty():
+                if device is not None:
+                    self._visit_job_device(ssn, device, job, tasks, jobs,
+                                           terms)
+                else:
+                    self._visit_job_host(ssn, job, tasks, jobs)
+
+            queues.push(queue)
+
+    # ------------------------------------------------------------------
+    # device path
+    # ------------------------------------------------------------------
+    def _visit_job_device(self, ssn: Session, device: DeviceSession,
+                          job: JobInfo, tasks: PriorityQueue,
+                          jobs: PriorityQueue, terms=None) -> None:
+        ordered: List[TaskInfo] = []
+        while not tasks.empty():
+            ordered.append(tasks.pop())
+        batch = TaskBatch.from_tasks(ordered)
+        if terms is not None:
+            scores, pred = terms.matrices(batch)
+            dyn = terms.dynamic
+        else:
+            scores, pred = pred_and_score_matrices(ssn, device, batch)
+            dyn = None
+        decisions, _ = device.solve_job(
+            batch, _effective_min_available(ssn, job), _init_allocated(job),
+            scores=scores, pred_mask=pred, dyn=dyn)
+        try:
+            for task, dec in zip(ordered, decisions):
+                if dec.kind == ALLOC:
+                    ssn.allocate(task, dec.node_name, False)
+                elif dec.kind == ALLOC_OB:
+                    ssn.allocate(task, dec.node_name, True)
+                elif dec.kind == PIPELINE:
+                    ssn.pipeline(task, dec.node_name)
+                elif dec.kind == FAIL:
+                    self._record_fit_deltas(ssn, job, task)
+                    return  # job dropped (allocate.go:187-189)
+                elif dec.kind == SKIP:
+                    tasks.push(task)  # not processed; next visit
+            if ssn.job_ready(job):
+                jobs.push(job)
+        except Exception:
+            # host apply diverged (e.g. volume binder failure): device state
+            # no longer matches host truth; rebuild before the next visit
+            device.resync(ssn.nodes)
+            raise
+
+    def _record_fit_deltas(self, ssn: Session, job: JobInfo,
+                           task: TaskInfo) -> None:
+        """NodesFitDelta for the breaking task (ref: allocate.go:124-126 and
+        164-170: the map holds deltas of the last task that failed)."""
+        ssn.touched_jobs.add(job.uid)   # nodes_fit_delta isn't cloned
+        job.nodes_fit_delta = {}
+        for node in ssn.nodes.values():
+            delta = node.idle.clone()
+            delta.fit_delta(task.resreq)
+            job.nodes_fit_delta[node.name] = delta
+
+    # ------------------------------------------------------------------
+    # host path — the reference algorithm verbatim (the oracle)
+    # ------------------------------------------------------------------
+    def _visit_job_host(self, ssn: Session, job: JobInfo,
+                        tasks: PriorityQueue, jobs: PriorityQueue) -> None:
+        while not tasks.empty():
+            task = tasks.pop()
+            assigned = False
+            if job.nodes_fit_delta:
+                job.nodes_fit_delta = {}
+
+            predicate_nodes = []
+            for node in ssn.nodes.values():
+                try:
+                    ssn.predicate_fn(task, node)
+                except Exception:
+                    continue
+                predicate_nodes.append(node)
+
+            node_scores: Dict[float, list] = {}
+            for node in predicate_nodes:
+                score = ssn.node_order_fn(task, node)
+                node_scores.setdefault(score, []).append(node)
+
+            for node in select_best_node(node_scores):
+                if task.init_resreq.less_equal(node.accessible()):
+                    try:
+                        ssn.allocate(task, node.name,
+                                     not task.init_resreq.less_equal(
+                                         node.idle))
+                    except VolumeAllocationError:
+                        # pre-mutation volume failure: try the next node
+                        # (ref: allocate.go:157-161). Post-mutation errors
+                        # propagate — retrying elsewhere would double-place
+                        # the task.
+                        continue
+                    assigned = True
+                    break
+                else:
+                    delta = node.idle.clone()
+                    delta.fit_delta(task.resreq)
+                    job.nodes_fit_delta[node.name] = delta
+                    ssn.touched_jobs.add(job.uid)
+                if task.init_resreq.less_equal(node.releasing):
+                    ssn.pipeline(task, node.name)
+                    assigned = True
+                    break
+
+            if not assigned:
+                break
+            if ssn.job_ready(job):
+                jobs.push(job)
+                break
+
+
+def new() -> AllocateAction:
+    return AllocateAction()
+
+
+register_action(AllocateAction())

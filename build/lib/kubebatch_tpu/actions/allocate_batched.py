@@ -1,0 +1,46 @@
+"""Host wrapper for the batched (round-based) allocate solver.
+
+Same tensorization and replay as the fused path (actions/cycle_inputs.py)
+— only the device algorithm differs: kernels/batched.py places many tasks
+per round instead of one per while-iteration, trading placement-by-
+placement ordering exactness for two orders of magnitude less sequential
+device work (see the faithfulness contract in kernels/batched.py).
+
+``sharded=True`` (KUBEBATCH_SOLVER=sharded) runs the same round loop with
+the node axis partitioned over every visible device
+(kernels/batched_sharded.py); it falls back to the single-chip engine
+when only one device exists.
+"""
+from __future__ import annotations
+
+from ..framework import Session
+from ..kernels.batched import solve_batched
+from .cycle_inputs import (EMPTY_CYCLE, build_cycle_inputs, cycle_supported,
+                           replay_decisions)
+
+batched_supported = cycle_supported
+
+
+def execute_batched(ssn: Session, sharded: bool = False) -> bool:
+    """Run the whole allocate action as a handful of round dispatches.
+    Returns False — without consuming any state — when the snapshot has
+    features the kernels can't express (the caller falls back)."""
+    inputs = build_cycle_inputs(ssn)
+    if inputs is EMPTY_CYCLE:
+        return True
+    if inputs is None:
+        return False
+    if sharded:
+        import jax
+
+        if len(jax.devices()) > 1:
+            from ..kernels.batched_sharded import (node_mesh,
+                                                   solve_batched_sharded)
+            task_state, task_node, task_seq, _ = solve_batched_sharded(
+                node_mesh(), inputs.device, inputs)
+            replay_decisions(ssn, inputs, task_state, task_node, task_seq)
+            return True
+        # single device: the mesh adds nothing — plain engine below
+    task_state, task_node, task_seq, _ = solve_batched(inputs.device, inputs)
+    replay_decisions(ssn, inputs, task_state, task_node, task_seq)
+    return True

@@ -1,0 +1,104 @@
+"""Host wrapper for the fused allocate kernel: session -> tensors ->
+ONE dispatch -> replay decisions through the Session.
+
+The replay (ssn.allocate / ssn.pipeline in the kernel's assignment order)
+keeps host-side plugin state, event handlers, and the gang dispatch
+barrier byte-identical to what the per-visit paths produce — the kernel
+only *decides*, the Session still *applies*.
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import numpy as np
+
+from ..framework import Session
+from ..kernels.fused import fused_allocate, unpack_host_block
+from ..kernels.pack import pack_inputs, unpack
+from ..metrics import solver_trace, update_solver_kernel_duration
+from .cycle_inputs import (EMPTY_CYCLE, build_cycle_inputs, cycle_supported,
+                           replay_decisions)
+
+# compatibility re-exports (tests and older callers import these from here)
+fused_supported = cycle_supported
+
+#: per-cycle inputs shipped as packed buffers (see kernels/pack.py);
+#: node-axis arrays live on the DeviceSession already
+_F32 = ("resreq", "init_resreq", "task_nz", "sig_scores", "job_priority",
+        "q_weight", "q_deserved", "q_alloc0", "j_alloc0", "cluster_total",
+        "dyn_weights")
+_I32 = ("task_job", "task_rank", "task_sig", "min_available",
+        "order_min_available", "init_allocated", "job_queue",
+        "job_create_rank", "q_entries", "q_create_rank")
+_BOOL = ("task_valid", "job_valid", "sig_pred")
+
+
+@partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",
+                                   "queue_keys", "gang_enabled",
+                                   "prop_overused", "dyn_enabled",
+                                   "max_iters"))
+def _fused_packed(buf_f, buf_i, buf_b, idle, releasing, backfilled,
+                  allocatable_cm, nz_req0, max_task_num, n_tasks, node_ok,
+                  lay_f, lay_i, lay_b, job_keys, queue_keys, gang_enabled,
+                  prop_overused, dyn_enabled, max_iters):
+    f = unpack(buf_f, lay_f)
+    i = unpack(buf_i, lay_i)
+    b = unpack(buf_b, lay_b)
+    return fused_allocate(
+        idle, releasing, backfilled, allocatable_cm, nz_req0, max_task_num,
+        n_tasks, node_ok,
+        f["resreq"], f["init_resreq"], f["task_nz"], i["task_job"],
+        i["task_rank"], i["task_sig"], b["task_valid"], f["sig_scores"],
+        b["sig_pred"],
+        i["min_available"], i["order_min_available"], i["init_allocated"],
+        i["job_queue"], f["job_priority"], i["job_create_rank"],
+        b["job_valid"],
+        f["q_weight"], i["q_entries"], i["q_create_rank"], f["q_deserved"],
+        f["q_alloc0"],
+        f["j_alloc0"], f["cluster_total"], f["dyn_weights"],
+        job_keys=job_keys, queue_keys=queue_keys, gang_enabled=gang_enabled,
+        prop_overused=prop_overused, dyn_enabled=dyn_enabled,
+        max_iters=max_iters)
+
+
+def execute_fused(ssn: Session) -> bool:
+    """Run the whole allocate action as one dispatch. Returns False —
+    without consuming any state — when the snapshot has features the
+    kernel can't express (the caller falls back to the host path)."""
+    inputs = build_cycle_inputs(ssn)
+    if inputs is EMPTY_CYCLE:
+        return True
+    if inputs is None:
+        return False
+    device = inputs.device
+    t_pad = inputs.task_valid.shape[0]
+    j_pad = inputs.job_valid.shape[0]
+    q_pad = inputs.q_weight.shape[0]
+    max_iters = int(t_pad + 3 * j_pad + q_pad + 8)
+
+    buf_f, lay_f, buf_i, lay_i, buf_b, lay_b = pack_inputs(
+        lambda n: getattr(inputs, n), _F32, _I32, _BOOL)
+
+    start = time.perf_counter()
+    with solver_trace("fused_allocate"):
+        (host_block, idle_f, rel_f, ntasks_f, nz_f) = _fused_packed(
+            buf_f, buf_i, buf_b,
+            device.idle, device.releasing, device.backfilled,
+            device.allocatable_cm, device.nz_req,
+            device.max_task_num, device.n_tasks, device.node_ok,
+            lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
+            job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+            gang_enabled=inputs.gang_enabled,
+            prop_overused=inputs.prop_overused,
+            dyn_enabled=inputs.dyn_enabled, max_iters=max_iters)
+        host_block = np.asarray(host_block)   # the cycle's ONE blocking read
+    task_state, task_node, task_seq, _ = unpack_host_block(host_block)
+    device.idle, device.releasing, device.n_tasks = idle_f, rel_f, ntasks_f
+    device.nz_req = nz_f
+    update_solver_kernel_duration("fused_allocate",
+                                  time.perf_counter() - start)
+
+    replay_decisions(ssn, inputs, task_state, task_node, task_seq)
+    return True

@@ -1,0 +1,116 @@
+"""backfill — fit small/BestEffort work into holes.
+
+ref: pkg/scheduler/actions/backfill/backfill.go. Two layers:
+
+1. Active reference behavior (backfill.go:45-70): every Pending task with
+   an EMPTY launch request (BestEffort) is allocated to the first
+   predicate-passing node.
+2. The fork's partially-finished "backfill over reserved resources"
+   (backfill.go:72-147, commented out upstream with live helpers): jobs
+   whose tasks are ALL pending (BackFillEligible via gang) are backfilled
+   onto idle resources with IsBackfill=true, after unready "top dog" jobs
+   release their session-reserved Allocated/AllocatedOverBackfill
+   resources. Enabled with KUBEBATCH_RESERVED_BACKFILL=1 or
+   BackfillAction(reserved=True); off by default, matching the shipped
+   binary.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..api import JobInfo, TaskStatus
+from ..framework import (Action, Session, VolumeAllocationError,
+                         register_action)
+
+
+def release_reserved_resources(ssn: Session, job: JobInfo) -> None:
+    """Return a job's session-only reservations to the cluster
+    (ref: backfill.go:98-118)."""
+    for task in list(job.tasks.values()):
+        if task.status in (TaskStatus.ALLOCATED,
+                           TaskStatus.ALLOCATED_OVER_BACKFILL):
+            ssn.touched_jobs.add(job.uid)
+            ssn.touched_nodes.add(task.node_name)
+            job.update_task_status(task, TaskStatus.PENDING)
+            node = ssn.nodes.get(task.node_name)
+            if node is not None:
+                node.remove_task(task)
+            task.node_name = ""
+
+
+def backfill_job(ssn: Session, job: JobInfo) -> None:
+    """Backfill an all-pending job onto idle resources, marking tasks
+    IsBackfill (ref: backfill.go:120-147)."""
+    for task in list(job.task_status_index.get(TaskStatus.PENDING,
+                                               {}).values()):
+        for node in ssn.nodes.values():
+            try:
+                ssn.predicate_fn(task, node)
+            except Exception:
+                continue
+            if task.resreq.less_equal(node.idle):
+                task.is_backfill = True
+                try:
+                    ssn.allocate(task, node.name, False)
+                except Exception:
+                    continue
+                break
+    if not ssn.job_ready(job):
+        release_reserved_resources(ssn, job)
+
+
+class BackfillAction(Action):
+    def __init__(self, reserved: Optional[bool] = None):
+        self._reserved = reserved
+
+    @property
+    def name(self) -> str:
+        return "backfill"
+
+    @property
+    def reserved_enabled(self) -> bool:
+        if self._reserved is not None:
+            return self._reserved
+        return os.environ.get("KUBEBATCH_RESERVED_BACKFILL", "") in (
+            "1", "true", "True")
+
+    def execute(self, ssn: Session) -> None:
+        # active path: BestEffort tasks onto any predicate-passing node
+        for job in ssn.jobs.values():
+            for task in list(job.task_status_index.get(TaskStatus.PENDING,
+                                                       {}).values()):
+                if not task.init_resreq.is_empty():
+                    continue
+                for node in ssn.nodes.values():
+                    try:
+                        ssn.predicate_fn(task, node)
+                    except Exception:
+                        continue
+                    try:
+                        ssn.allocate(task, node.name, False)
+                    except VolumeAllocationError:
+                        # pre-mutation failure only; post-mutation errors
+                        # propagate (see actions/allocate.py host path)
+                        continue
+                    break
+
+        if not self.reserved_enabled:
+            return
+
+        # fork path: collect eligible (all-pending) jobs, release unready
+        # top dogs' reservations, then backfill (backfill.go:74-94)
+        candidates = [job for job in ssn.jobs.values()
+                      if ssn.backfill_eligible(job)]
+        for job in ssn.jobs.values():
+            if not ssn.job_almost_ready(job) and not ssn.job_ready(job):
+                release_reserved_resources(ssn, job)
+        for job in candidates:
+            backfill_job(ssn, job)
+
+
+def new() -> BackfillAction:
+    return BackfillAction()
+
+
+register_action(BackfillAction())

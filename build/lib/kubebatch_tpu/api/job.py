@@ -1,0 +1,319 @@
+"""TaskInfo / JobInfo — per-pod and per-PodGroup aggregates.
+
+ref: pkg/scheduler/api/job_info.go, pod_info.go.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..objects import (Pod, PodDisruptionBudget, PodGroup, PodPhase,
+                       is_backfill_pod)
+from .resource import Resource
+from .types import (JobReadiness, TaskStatus, allocated_status,
+                    allocated_statuses, validate_status_update)
+
+
+def pod_key(pod: Pod) -> str:
+    """'namespace/name' task key (ref: api/helpers.go:27-33)."""
+    return f"{pod.namespace}/{pod.name}"
+
+
+def get_task_status(pod: Pod) -> TaskStatus:
+    """Pod phase -> TaskStatus (ref: api/helpers.go:35-61)."""
+    if pod.phase == PodPhase.RUNNING:
+        return (TaskStatus.RELEASING if pod.deletion_timestamp is not None
+                else TaskStatus.RUNNING)
+    if pod.phase == PodPhase.PENDING:
+        if pod.deletion_timestamp is not None:
+            return TaskStatus.RELEASING
+        return TaskStatus.PENDING if not pod.node_name else TaskStatus.BOUND
+    if pod.phase == PodPhase.SUCCEEDED:
+        return TaskStatus.SUCCEEDED
+    if pod.phase == PodPhase.FAILED:
+        return TaskStatus.FAILED
+    return TaskStatus.UNKNOWN
+
+
+def get_pod_resource_without_init_containers(pod: Pod) -> Resource:
+    """Sum of app-container requests (ref: api/pod_info.go:71-80)."""
+    result = Resource.empty()
+    for c in pod.containers:
+        result.add(Resource.from_resource_list(c.requests))
+    return result
+
+
+def get_pod_resource_request(pod: Pod) -> Resource:
+    """max(sum of containers, each init container) per dimension — init
+    containers run sequentially (ref: api/pod_info.go:33-69)."""
+    result = get_pod_resource_without_init_containers(pod)
+    for c in pod.init_containers:
+        result.set_max(Resource.from_resource_list(c.requests))
+    return result
+
+
+def get_job_id(pod: Pod) -> str:
+    """'namespace/group-name' from the group annotation, else ''
+    (ref: job_info.go:60-70)."""
+    gn = pod.group_name
+    return f"{pod.namespace}/{gn}" if gn else ""
+
+
+class TaskInfo:
+    """Scheduling view of one pod (ref: job_info.go:36-131)."""
+
+    __slots__ = ("uid", "job", "name", "namespace", "resreq", "init_resreq",
+                 "node_name", "status", "priority", "volume_ready", "pod",
+                 "is_backfill", "key")
+
+    def __init__(self, pod: Pod):
+        self.uid: str = pod.uid
+        self.job: str = get_job_id(pod)
+        self.name: str = pod.name
+        self.namespace: str = pod.namespace
+        #: 'namespace/name' node-map key, precomputed — node add/remove and
+        #: the bulk replay build it per placement otherwise
+        self.key: str = pod_key(pod)
+        #: steady-state request (app containers only)
+        self.resreq: Resource = get_pod_resource_without_init_containers(pod)
+        #: launch-time request (max with init containers) — what predicates use
+        self.init_resreq: Resource = get_pod_resource_request(pod)
+        self.node_name: str = pod.node_name
+        self.status: TaskStatus = get_task_status(pod)
+        self.priority: int = pod.priority if pod.priority is not None else 1
+        self.volume_ready: bool = False
+        self.pod: Pod = pod
+        self.is_backfill: bool = is_backfill_pod(pod)
+
+    def clone(self) -> "TaskInfo":
+        t = object.__new__(TaskInfo)
+        t.uid = self.uid
+        t.job = self.job
+        t.name = self.name
+        t.namespace = self.namespace
+        # request vectors are immutable after construction (all arithmetic
+        # happens on node/job aggregates, never on a task's own vectors), so
+        # clones SHARE them — a task clone runs O(tasks) per snapshot and
+        # again per node placement, and the two Resource copies dominated it
+        t.resreq = self.resreq
+        t.init_resreq = self.init_resreq
+        t.node_name = self.node_name
+        t.status = self.status
+        t.priority = self.priority
+        t.volume_ready = self.volume_ready
+        t.pod = self.pod
+        t.is_backfill = self.is_backfill
+        t.key = self.key
+        return t
+
+    def __repr__(self) -> str:
+        return (f"Task({self.namespace}/{self.name}: job={self.job}, "
+                f"status={self.status}, pri={self.priority}, "
+                f"resreq={self.resreq}, backfill={self.is_backfill})")
+
+
+class JobInfo:
+    """PodGroup-level aggregate (ref: job_info.go:140-388)."""
+
+    def __init__(self, uid: str, *tasks: TaskInfo):
+        self.uid: str = uid
+        self.name: str = ""
+        self.namespace: str = ""
+        self.queue: str = ""
+        self.priority: int = 0
+        self.node_selector: Dict[str, str] = {}
+        self.min_available: int = 0
+        #: node -> fit-delta Resource for unschedulable diagnostics
+        self.nodes_fit_delta: Dict[str, Resource] = {}
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        self.allocated: Resource = Resource.empty()
+        self.total_request: Resource = Resource.empty()
+        #: count of tasks whose pod carries inter-pod (anti-)affinity —
+        #: lets dynamic-feature detection skip the per-task walk
+        self.affinity_tasks: int = 0
+        self.creation_timestamp: float = 0.0
+        self.pod_group: Optional[PodGroup] = None
+        self.pdb: Optional[PodDisruptionBudget] = None
+        for t in tasks:
+            self.add_task_info(t)
+
+    # --- PodGroup binding -------------------------------------------------
+    def set_pod_group(self, pg: PodGroup) -> None:
+        self.name = pg.name
+        self.namespace = pg.namespace
+        self.min_available = pg.min_member
+        self.queue = pg.queue
+        self.creation_timestamp = pg.creation_timestamp
+        self.pod_group = pg
+
+    def unset_pod_group(self) -> None:
+        self.pod_group = None
+
+    def set_pdb(self, pdb: PodDisruptionBudget) -> None:
+        """Legacy grouping path (ref: job_info.go:204-211)."""
+        self.name = pdb.name
+        self.namespace = pdb.namespace
+        self.min_available = pdb.min_available
+        self.creation_timestamp = pdb.creation_timestamp
+        self.pdb = pdb
+
+    def unset_pdb(self) -> None:
+        self.pdb = None
+
+    # --- task index maintenance (ref: job_info.go:231-292) ---------------
+    def _add_task_index(self, ti: TaskInfo) -> None:
+        self.task_status_index.setdefault(ti.status, {})[ti.uid] = ti
+
+    def add_task_info(self, ti: TaskInfo) -> None:
+        self.tasks[ti.uid] = ti
+        self._add_task_index(ti)
+        # Only an explicit pod priority overrides the job's priority; the
+        # reference overwrites unconditionally (job_info.go:242) because in
+        # real k8s admission always stamps pod.Spec.Priority — here a None
+        # must not clobber the priority-class value stamped by snapshot().
+        if ti.pod.priority is not None:
+            self.priority = ti.priority
+        self.total_request.add(ti.resreq)
+        if allocated_status(ti.status):
+            self.allocated.add(ti.resreq)
+        if ti.pod.has_pod_affinity():
+            self.affinity_tasks += 1
+
+    def delete_task_info(self, ti: TaskInfo) -> None:
+        task = self.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(
+                f"failed to find task <{ti.namespace}/{ti.name}> in job "
+                f"<{self.namespace}/{self.name}>")
+        self.total_request.sub(task.resreq)
+        if allocated_status(task.status):
+            self.allocated.sub(task.resreq)
+        if task.pod.has_pod_affinity():
+            self.affinity_tasks -= 1
+        del self.tasks[task.uid]
+        index = self.task_status_index.get(task.status)
+        if index is not None:
+            index.pop(task.uid, None)
+            if not index:
+                del self.task_status_index[task.status]
+
+    def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        """Semantically delete_task_info + add_task_info (ref:
+        job_info.go:251-259), flattened: the status flip is the hottest
+        operation of the decision replay (10k+ per cycle at the stress
+        config), so the net-zero total_request sub/add and the task-dict
+        delete/re-insert are skipped when the stored task IS the incoming
+        one (also avoiding float round-trip drift the naive pair has)."""
+        validate_status_update(task.status, status)
+        stored = self.tasks.get(task.uid)
+        if stored is None:
+            raise KeyError(
+                f"failed to find task <{task.namespace}/{task.name}> in job "
+                f"<{self.namespace}/{self.name}>")
+        if allocated_status(stored.status):
+            self.allocated.sub(stored.resreq)
+        if stored is not task:
+            self.total_request.sub(stored.resreq)
+            self.total_request.add(task.resreq)
+        index = self.task_status_index.get(stored.status)
+        if index is not None:
+            index.pop(stored.uid, None)
+            if not index:
+                del self.task_status_index[stored.status]
+        task.status = status
+        self.tasks[task.uid] = task
+        self._add_task_index(task)
+        if task.pod.priority is not None:
+            self.priority = task.priority
+        if allocated_status(status):
+            self.allocated.add(task.resreq)
+
+    def get_tasks(self, *statuses: TaskStatus) -> List[TaskInfo]:
+        """Clones of tasks in the given states (ref: job_info.go:217-229)."""
+        res: List[TaskInfo] = []
+        for status in statuses:
+            for task in self.task_status_index.get(status, {}).values():
+                res.append(task.clone())
+        return res
+
+    def count(self, *statuses: TaskStatus) -> int:
+        # hot at session close (8+ calls per job per cycle): plain loop,
+        # no default-dict allocation, no generator frame
+        idx = self.task_status_index
+        if len(statuses) == 1:
+            bucket = idx.get(statuses[0])
+            return len(bucket) if bucket else 0
+        n = 0
+        for s in statuses:
+            bucket = idx.get(s)
+            if bucket:
+                n += len(bucket)
+        return n
+
+    # --- readiness (fork semantics, ref: job_info.go:374-388) -------------
+    def get_readiness(self) -> JobReadiness:
+        allocated_cnt = self.count(*allocated_statuses())
+        if allocated_cnt >= self.min_available:
+            return JobReadiness.READY
+        over_backfill_cnt = self.count(TaskStatus.ALLOCATED_OVER_BACKFILL)
+        if allocated_cnt + over_backfill_cnt >= self.min_available:
+            return JobReadiness.ALMOST_READY
+        return JobReadiness.NOT_READY
+
+    def fit_error(self) -> str:
+        """Human-readable unschedulable explanation
+        (ref: job_info.go:343-372)."""
+        if not self.nodes_fit_delta:
+            return "0 nodes are available"
+        reasons: Dict[str, int] = {}
+        for delta in self.nodes_fit_delta.values():
+            if delta.milli_cpu < 0:
+                reasons["cpu"] = reasons.get("cpu", 0) + 1
+            if delta.memory < 0:
+                reasons["memory"] = reasons.get("memory", 0) + 1
+            if delta.milli_gpu < 0:
+                reasons["GPU"] = reasons.get("GPU", 0) + 1
+        parts = sorted(f"{v} insufficient {k}" for k, v in reasons.items())
+        return (f"0/{len(self.nodes_fit_delta)} nodes are available, "
+                f"{', '.join(parts)}.")
+
+    def clone(self) -> "JobInfo":
+        """Deep copy (ref: job_info.go:294-326). Copies the maintained
+        aggregates and rebuilds the double-index from cloned tasks directly
+        — equivalent to re-running add_task_info per task (which this
+        method did originally; it runs O(jobs) per snapshot, every cycle),
+        including the reference's quirk that tasks carrying an explicit pod
+        priority re-stamp the job priority in insertion order."""
+        info = JobInfo(self.uid)
+        info.name = self.name
+        info.namespace = self.namespace
+        info.queue = self.queue
+        info.priority = self.priority
+        info.min_available = self.min_available
+        info.node_selector = dict(self.node_selector)
+        info.creation_timestamp = self.creation_timestamp
+        info.pod_group = self.pod_group
+        info.pdb = self.pdb
+        tasks = info.tasks
+        for uid, task in self.tasks.items():
+            t = task.clone()
+            tasks[uid] = t
+            if t.pod.priority is not None:
+                info.priority = t.priority
+        info.task_status_index = {
+            status: {uid: tasks[uid] for uid in bucket}
+            for status, bucket in self.task_status_index.items()}
+        info.allocated = self.allocated.clone()
+        info.total_request = self.total_request.clone()
+        info.affinity_tasks = self.affinity_tasks
+        return info
+
+    def __repr__(self) -> str:
+        return (f"Job({self.uid}): ns={self.namespace} queue={self.queue} "
+                f"name={self.name} minAvailable={self.min_available} "
+                f"tasks={len(self.tasks)}")
+
+
+def job_terminated(job: JobInfo) -> bool:
+    """ref: api/helpers.go:99-104."""
+    return job.pod_group is None and job.pdb is None and not job.tasks

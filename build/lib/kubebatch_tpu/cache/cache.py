@@ -1,0 +1,978 @@
+"""SchedulerCache — the cluster-state mirror between sessions.
+
+ref: pkg/scheduler/cache/cache.go + event_handlers.go + util.go.
+
+Architecture notes (TPU-first redesign, not a Go translation):
+
+- Event ingestion is a plain method surface (``add_pod``/``update_node``/...)
+  fed by any event source — the synthetic ``sim`` cluster, the gRPC
+  front-end, or (out of scope here) a real k8s informer adapter. The
+  reference binds these same handlers to client-go informers
+  (cache.go:217-295).
+- Decision write-back (bind/evict/status) updates local state under the
+  lock, then fires the seam call on a thread pool — the reference uses
+  goroutines (cache.go:377-382, 423-429). Failures enqueue the task on a
+  rate-limited retry queue whose worker re-fetches ground truth and
+  replays the cache update (``sync_task``, ref event_handlers.go:88-106).
+  ``drain()`` gives tests/benchmarks a deterministic barrier.
+- ``snapshot()`` deep-clones into an immutable-by-convention ClusterInfo
+  (ref cache.go:515-583). At 10k x 5k this clone is the second bottleneck
+  after the solve; the tensorization in kernels/ reads from the snapshot,
+  and a native C++ packer can replace this path (see kernels/tensorize).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, Resource,
+                   TaskInfo, TaskStatus, allocated_status, job_terminated)
+from ..objects import (Node, Pod, PodDisruptionBudget, PodGroup,
+                       PodGroupPhase, PodPhase, PriorityClass, Queue,
+                       UNSCHEDULABLE_CONDITION)
+from .interface import (Binder, EventRecorder, Evictor, ListRecorder,
+                        NullBinder, NullEvictor, NullStatusUpdater,
+                        NullVolumeBinder, StatusUpdater, VolumeBinder)
+
+SHADOW_POD_GROUP_KEY = "kube-batch/shadow-pod-group"
+
+
+def shadow_pod_group(pg: Optional[PodGroup]) -> bool:
+    """ref: cache/util.go:104-111 (nil PodGroup counts as shadow)."""
+    return pg is None or SHADOW_POD_GROUP_KEY in pg.annotations
+
+
+def create_shadow_pod_group(pod: Pod) -> PodGroup:
+    """Implicit single-member gang for ownerless/ungrouped pods
+    (ref: cache/util.go:113-136)."""
+    job_id = pod.owner_uid or pod.uid
+    return PodGroup(name=str(job_id), namespace=pod.namespace, min_member=1,
+                    annotations={SHADOW_POD_GROUP_KEY: str(job_id)})
+
+
+def _is_terminated(status: TaskStatus) -> bool:
+    return status in (TaskStatus.SUCCEEDED, TaskStatus.FAILED)
+
+
+class RetryQueue:
+    """Rate-limited retry queue (the workqueue.RateLimiting equivalent).
+
+    Items become due after an exponential backoff (5ms * 2^retries, capped).
+    ``pop_due`` is pumped by the cache's worker loop or ``drain()``.
+    """
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 10.0):
+        self._items: deque = deque()
+        self._retries: Dict[int, int] = {}
+        self._base = base_delay
+        self._max = max_delay
+        self._lock = threading.Lock()
+
+    def add_rate_limited(self, item) -> None:
+        with self._lock:
+            n = self._retries.get(id(item), 0)
+            self._retries[id(item)] = n + 1
+            delay = min(self._base * (2 ** n), self._max)
+            self._items.append((time.monotonic() + delay, item))
+
+    def forget(self, item) -> None:
+        with self._lock:
+            self._retries.pop(id(item), None)
+
+    def pop_due(self) -> List:
+        now = time.monotonic()
+        due, later = [], deque()
+        with self._lock:
+            for ready_at, item in self._items:
+                (due if ready_at <= now else later).append((ready_at, item))
+            self._items = deque(later)
+        return [item for _, item in due]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def next_due_in(self) -> Optional[float]:
+        with self._lock:
+            if not self._items:
+                return None
+            return max(0.0, min(t for t, _ in self._items) - time.monotonic())
+
+
+class SchedulerCache:
+    """ref: cache/cache.go:70-105."""
+
+    def __init__(self,
+                 scheduler_name: str = "kube-batch",
+                 default_queue: str = "default",
+                 binder: Optional[Binder] = None,
+                 evictor: Optional[Evictor] = None,
+                 status_updater: Optional[StatusUpdater] = None,
+                 volume_binder: Optional[VolumeBinder] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 pod_lister: Optional[Callable[[str, str], Optional[Pod]]] = None,
+                 async_writeback: bool = True,
+                 incremental_snapshot: Optional[bool] = None):
+        self._lock = threading.RLock()
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.priority_classes: Dict[str, PriorityClass] = {}
+        self.default_priority_class: Optional[PriorityClass] = None
+        self.default_priority: int = 0
+
+        self.binder = binder if binder is not None else NullBinder()
+        self.evictor = evictor if evictor is not None else NullEvictor()
+        self.status_updater = (status_updater if status_updater is not None
+                               else NullStatusUpdater())
+        self.volume_binder = (volume_binder if volume_binder is not None
+                              else NullVolumeBinder())
+        self.recorder = recorder if recorder is not None else ListRecorder()
+
+        #: ground-truth pod lookup for the resync repair loop; None means
+        #: "replay from the task's own pod" (no external source of truth)
+        self.pod_lister = pod_lister
+
+        self.err_tasks = RetryQueue()
+        self.deleted_jobs = RetryQueue()
+
+        # ------------------------------------------------------------
+        # incremental snapshot state (no reference counterpart — the
+        # reference deep-copies the whole cluster every cycle,
+        # cache.go:515-583, which is exactly the steady-state bottleneck
+        # this removes). Invariant: snapshot() output is always
+        # deep-equal to a from-scratch clone of cache truth; entities
+        # whose previous-session clone may diverge from truth are
+        # re-cloned, everything else is reused from the adopted base.
+        # ------------------------------------------------------------
+        if incremental_snapshot is None:
+            incremental_snapshot = os.environ.get(
+                "KUBEBATCH_INCREMENTAL", "1") not in ("0", "false")
+        self._incremental = incremental_snapshot
+        #: previous session's entity clones (jobs-by-uid, nodes-by-name),
+        #: adopted at session close; None = next snapshot is a full clone
+        self._snap_base: Optional[Tuple[Dict[str, JobInfo],
+                                        Dict[str, NodeInfo]]] = None
+        #: entities whose cache truth changed since their base clone
+        self._dirty_jobs: set = set()
+        self._dirty_nodes: set = set()
+        #: bumped by cluster-wide invalidations; a session snapshot handed
+        #: out under an older epoch is refused at adoption
+        self._snap_epoch = 0
+        self._handout_epoch = 0
+        #: bumped on node shape changes; a TermsCache built by a session
+        #: whose snapshot predates the change is refused persistence
+        self._shape_epoch = 0
+        self._handout_shape_epoch = 0
+        #: persistent device-side node arrays (kernels/solver.DeviceSession).
+        #: _dev_dirty holds marks made since the LAST snapshot; at snapshot
+        #: time they migrate to _dev_refresh, the set device_session may
+        #: safely repack from the session's clones (a mark made AFTER the
+        #: snapshot refers to truth the session cannot see — it must wait
+        #: for the next snapshot, not be consumed against stale clones)
+        self._dev_state = None
+        self._dev_dirty: set = set()
+        self._dev_refresh: set = set()
+        #: persistent per-node victim segments (kernels/victims.py
+        #: SegmentStore) — same dirty/refresh discipline as _dev_state
+        self.victim_segments = None
+        self._vic_dirty: set = set()
+        self._vic_refresh: set = set()
+        #: job-level marks for the SegmentStore's persistent job-row
+        #: space (ready counts / allocations) — same discipline
+        self._vicjob_dirty: set = set()
+        self._vicjob_refresh: set = set()
+        #: persistent static-term encoder state (kernels/terms.TermsCache);
+        #: invalidated whenever node labels/taints/shape change
+        self.terms_cache = None
+        #: cross-cycle plugin state (SCALING.md latency item 2). Contract:
+        #: entries keyed by job uid are valid only while the owning job's
+        #: clone is reused by the incremental snapshot — plugins rebuild
+        #: entries for ssn.refreshed_jobs at open and rebuild everything
+        #: when refreshed_jobs is None (full snapshot). Mutations a session
+        #: makes to scratch entries stay consistent because every session
+        #: mutator marks its job touched, and touched jobs are refreshed
+        #: next cycle (adopt_snapshot folds touched into dirty).
+        self.plugin_scratch: Dict[str, object] = {}
+        #: maintained sum of node allocatable over the cluster (drf and
+        #: proportion consume it each open, drf.go:59-60); recomputed
+        #: lazily after any node-shape change instead of walked per open
+        self._alloc_total: Optional[Resource] = None
+        #: bumped whenever the NODE ITERATION ORDER can change (new node
+        #: appended, node deleted — a delete+re-add reorders the dict
+        #: without changing the set); consumers caching order-derived
+        #: state (victims.py host_rank) key on it
+        self._node_order_epoch = 0
+
+        self._async = async_writeback
+        self._pool: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(max_workers=8,
+                               thread_name_prefix="kb-writeback")
+            if async_writeback else None)
+        self._inflight: set = set()
+        self._inflight_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # lifecycle (ref: cache.go:300-331)
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        """Start the resync/cleanup repair worker."""
+        if self._worker is None:
+            self._worker = threading.Thread(target=self._repair_loop,
+                                            name="kb-cache-repair",
+                                            daemon=True)
+            self._worker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+
+    def wait_for_cache_sync(self) -> bool:
+        """Event sources here are synchronous pushes; always synced."""
+        return True
+
+    def _repair_loop(self) -> None:
+        while not self._stop.is_set():
+            self.process_resync_tasks()
+            self.process_cleanup_jobs()
+            self._stop.wait(0.005)
+
+    # ------------------------------------------------------------------
+    # write-back plumbing
+    # ------------------------------------------------------------------
+    def _submit(self, fn: Callable[[], None]) -> None:
+        if self._pool is not None:
+            fut: Future = self._pool.submit(fn)
+            with self._inflight_lock:
+                self._inflight.add(fut)
+            fut.add_done_callback(self._discard_inflight)
+        else:
+            fn()
+
+    def _discard_inflight(self, fut: Future) -> None:
+        with self._inflight_lock:
+            self._inflight.discard(fut)
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Barrier: wait for in-flight write-backs and due retries. Returns
+        False on timeout. Test/benchmark helper; the reference relies on
+        channel waits in tests instead."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                pending = list(self._inflight)
+            if pending:
+                try:
+                    for fut in pending:
+                        fut.result(
+                            timeout=max(0.0, deadline - time.monotonic()))
+                except FuturesTimeoutError:
+                    return False
+                continue
+            self.process_resync_tasks()
+            self.process_cleanup_jobs()
+            if not self.err_tasks and not self.deleted_jobs:
+                with self._inflight_lock:
+                    if not self._inflight:
+                        return True
+                continue
+            nxt = self.err_tasks.next_due_in()
+            nxt2 = self.deleted_jobs.next_due_in()
+            waits = [w for w in (nxt, nxt2) if w is not None]
+            time.sleep(min(min(waits, default=0.001), 0.01))
+        return False
+
+    # ------------------------------------------------------------------
+    # incremental-snapshot bookkeeping
+    # ------------------------------------------------------------------
+    def _mark_job(self, uid: str) -> None:
+        if self._incremental:
+            self._dirty_jobs.add(uid)
+            self._vicjob_dirty.add(uid)
+
+    def _mark_node(self, name: str) -> None:
+        if self._incremental:
+            self._dirty_nodes.add(name)
+            self._dev_dirty.add(name)
+            self._vic_dirty.add(name)
+
+    def _mark_node_shape(self, name: str) -> None:
+        """A node's static profile (labels/taints/unschedulable/allocatable)
+        or the node set changed — static-term encodings are stale too."""
+        self._mark_node(name)
+        self.terms_cache = None
+        self._shape_epoch += 1
+        self._alloc_total = None
+
+    def offer_terms_cache(self, tc) -> None:
+        """Persist a session-built TermsCache for later cycles — refused
+        when a node shape change landed after the building session's
+        snapshot (its profiles encode pre-change labels; the session may
+        still use it locally for its own consistent snapshot)."""
+        with self._lock:
+            if self._shape_epoch == self._handout_shape_epoch \
+                    and self.terms_cache is None:
+                self.terms_cache = tc
+
+    def _invalidate_snapshot(self) -> None:
+        """Cluster-wide inputs changed (queue set, priority classes):
+        per-entity dirty tracking can't scope the effect — fall back to a
+        full clone next cycle. The epoch bump also voids adoption of any
+        session snapshot handed out BEFORE the change (its clones carry
+        pre-change priorities/inclusion)."""
+        self._snap_base = None
+        self._dev_state = None
+        self.terms_cache = None
+        self.victim_segments = None
+        self._snap_epoch += 1
+
+    # ------------------------------------------------------------------
+    # pod/task ingestion (ref: event_handlers.go:37-247)
+    # ------------------------------------------------------------------
+    def _pod_relevant(self, pod: Pod) -> bool:
+        """Informer filter (ref: cache.go:246-258): pending pods only for
+        our scheduler; non-pending pods always (they occupy nodes)."""
+        if pod.phase == PodPhase.PENDING:
+            return pod.scheduler_name == self.scheduler_name
+        return True
+
+    def _get_or_create_job(self, ti: TaskInfo) -> JobInfo:
+        """ref: event_handlers.go:41-61 (shadow PodGroup for ungrouped)."""
+        if not ti.job:
+            pg = create_shadow_pod_group(ti.pod)
+            ti.job = pg.name
+            if ti.job not in self.jobs:
+                job = JobInfo(ti.job)
+                job.set_pod_group(pg)
+                job.queue = self.default_queue
+                self.jobs[ti.job] = job
+        elif ti.job not in self.jobs:
+            self.jobs[ti.job] = JobInfo(ti.job)
+        return self.jobs[ti.job]
+
+    def _add_task(self, ti: TaskInfo) -> None:
+        job = self._get_or_create_job(ti)
+        job.add_task_info(ti)
+        self._mark_job(job.uid)
+        if ti.node_name:
+            if ti.node_name not in self.nodes:
+                # placeholder until the node event arrives
+                self.nodes[ti.node_name] = NodeInfo(None)
+                self._node_order_epoch += 1
+            if not _is_terminated(ti.status):
+                self.nodes[ti.node_name].add_task(ti)
+            self._mark_node(ti.node_name)
+
+    def _delete_task(self, ti: TaskInfo) -> None:
+        errs = []
+        if ti.job:
+            self._mark_job(ti.job)
+        if ti.node_name:
+            self._mark_node(ti.node_name)
+        if ti.job:
+            job = self.jobs.get(ti.job)
+            if job is not None:
+                try:
+                    job.delete_task_info(ti)
+                except KeyError as e:
+                    errs.append(e)
+            else:
+                errs.append(KeyError(f"failed to find Job <{ti.job}> for "
+                                     f"Task {ti.namespace}/{ti.name}"))
+        if ti.node_name:
+            node = self.nodes.get(ti.node_name)
+            if node is not None:
+                try:
+                    node.remove_task(ti)
+                except KeyError as e:
+                    errs.append(e)
+        if errs:
+            raise KeyError("; ".join(str(e) for e in errs))
+
+    def add_pod(self, pod: Pod) -> None:
+        if not self._pod_relevant(pod):
+            return
+        with self._lock:
+            self._add_task(TaskInfo(pod))
+
+    def update_pod(self, old: Pod, new: Pod) -> None:
+        """Delete + re-add (ref: event_handlers.go:108-122). Relevance is
+        per-side: a pod that was filtered at add time (old irrelevant) is
+        treated as a fresh add, like client-go's filtering handler does."""
+        with self._lock:
+            if self._pod_relevant(old):
+                self._delete_pod_locked(old)
+            if self._pod_relevant(new):
+                self._add_task(TaskInfo(new))
+
+    def delete_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._delete_pod_locked(pod)
+
+    def _delete_pod_locked(self, pod: Pod) -> None:
+        """ref: event_handlers.go:151-171 — prefer the cache's own task (it
+        may be in Binding state with a node the stale event lacks)."""
+        ti = TaskInfo(pod)
+        job = self.jobs.get(ti.job)
+        task = ti
+        if job is not None:
+            task = job.tasks.get(ti.uid, ti)
+        self._delete_task(task)
+        if job is not None and job_terminated(job):
+            self.deleted_jobs.add_rate_limited(job)
+
+    # ------------------------------------------------------------------
+    # node ingestion (ref: event_handlers.go:249-356)
+    # ------------------------------------------------------------------
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            if node.name in self.nodes:
+                self.nodes[node.name].set_node(node)
+            else:
+                self.nodes[node.name] = NodeInfo(node)
+                self._node_order_epoch += 1
+            self._mark_node_shape(node.name)
+
+    def update_node(self, old: Node, new: Node) -> None:
+        with self._lock:
+            ni = self.nodes.get(new.name)
+            if ni is None:
+                raise KeyError(f"node <{new.name}> does not exist")
+            if (old.allocatable != new.allocatable or old.taints != new.taints
+                    or old.labels != new.labels
+                    or old.unschedulable != new.unschedulable):
+                ni.set_node(new)
+                self._mark_node_shape(new.name)
+
+    def delete_node(self, node: Node) -> None:
+        with self._lock:
+            if node.name not in self.nodes:
+                raise KeyError(f"node <{node.name}> does not exist")
+            del self.nodes[node.name]
+            self._node_order_epoch += 1
+            self._mark_node_shape(node.name)
+
+    # ------------------------------------------------------------------
+    # PodGroup / PDB / Queue / PriorityClass (ref: event_handlers.go:358-769)
+    # ------------------------------------------------------------------
+    def add_pod_group(self, pg: PodGroup) -> None:
+        with self._lock:
+            self._set_pod_group(pg)
+
+    def update_pod_group(self, old: PodGroup, new: PodGroup) -> None:
+        with self._lock:
+            self._set_pod_group(new)
+
+    def delete_pod_group(self, pg: PodGroup) -> None:
+        with self._lock:
+            job_id = f"{pg.namespace}/{pg.name}"
+            job = self.jobs.get(job_id)
+            if job is None:
+                raise KeyError(f"can not find job {job_id}")
+            job.unset_pod_group()
+            self._mark_job(job_id)
+            self.deleted_jobs.add_rate_limited(job)
+
+    def _set_pod_group(self, pg: PodGroup) -> None:
+        job_id = f"{pg.namespace}/{pg.name}"
+        if job_id not in self.jobs:
+            self.jobs[job_id] = JobInfo(job_id)
+        self.jobs[job_id].set_pod_group(pg)
+        self._mark_job(job_id)
+        if not pg.queue:
+            self.jobs[job_id].queue = self.default_queue
+
+    def add_pdb(self, pdb: PodDisruptionBudget) -> None:
+        with self._lock:
+            self._set_pdb(pdb)
+
+    def update_pdb(self, old: PodDisruptionBudget,
+                   new: PodDisruptionBudget) -> None:
+        with self._lock:
+            self._set_pdb(new)
+
+    def delete_pdb(self, pdb: PodDisruptionBudget) -> None:
+        with self._lock:
+            job_id = pdb.owner_uid
+            job = self.jobs.get(job_id)
+            if job is None:
+                raise KeyError(f"can not find job {job_id}")
+            job.unset_pdb()
+            self._mark_job(job_id)
+            self.deleted_jobs.add_rate_limited(job)
+
+    def _set_pdb(self, pdb: PodDisruptionBudget) -> None:
+        """PDBs are grouped by their controller owner
+        (ref: event_handlers.go:477-493)."""
+        job_id = pdb.owner_uid
+        if not job_id:
+            raise ValueError("the controller of PodDisruptionBudget is empty")
+        if job_id not in self.jobs:
+            self.jobs[job_id] = JobInfo(job_id)
+        self.jobs[job_id].set_pdb(pdb)
+        self._mark_job(job_id)
+        self.jobs[job_id].queue = self.default_queue
+
+    def add_queue(self, queue: Queue) -> None:
+        with self._lock:
+            qi = QueueInfo(queue)
+            self.queues[qi.uid] = qi
+            # queue membership gates which jobs a snapshot includes
+            # (snapshot() skip rule) — per-entity tracking can't scope it
+            self._invalidate_snapshot()
+
+    def update_queue(self, old: Queue, new: Queue) -> None:
+        with self._lock:
+            self.queues.pop(old.name, None)
+            qi = QueueInfo(new)
+            self.queues[qi.uid] = qi
+            self._invalidate_snapshot()
+
+    def delete_queue(self, queue: Queue) -> None:
+        with self._lock:
+            self.queues.pop(queue.name, None)
+            self._invalidate_snapshot()
+
+    def add_priority_class(self, pc: PriorityClass) -> None:
+        with self._lock:
+            self._add_priority_class(pc)
+
+    def update_priority_class(self, old: PriorityClass,
+                              new: PriorityClass) -> None:
+        with self._lock:
+            self._delete_priority_class(old)
+            self._add_priority_class(new)
+
+    def delete_priority_class(self, pc: PriorityClass) -> None:
+        with self._lock:
+            self._delete_priority_class(pc)
+
+    def _add_priority_class(self, pc: PriorityClass) -> None:
+        if pc.global_default:
+            self.default_priority_class = pc
+            self.default_priority = pc.value
+        self.priority_classes[pc.name] = pc
+        # job.priority is stamped from priority classes at snapshot time
+        # for EVERY job (cache.go:561-576) — scope is cluster-wide
+        self._invalidate_snapshot()
+
+    def _delete_priority_class(self, pc: PriorityClass) -> None:
+        if pc.global_default:
+            self.default_priority_class = None
+            self.default_priority = 0
+        self.priority_classes.pop(pc.name, None)
+        self._invalidate_snapshot()
+
+    # ------------------------------------------------------------------
+    # decisions out (ref: cache.go:349-442)
+    # ------------------------------------------------------------------
+    def _find_job_and_task(self, ti: TaskInfo) -> Tuple[JobInfo, TaskInfo]:
+        job = self.jobs.get(ti.job)
+        if job is None:
+            raise KeyError(f"failed to find Job {ti.job} for Task {ti.uid}")
+        task = job.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(f"failed to find task in status {ti.status} "
+                           f"by id {ti.uid}")
+        return job, task
+
+    def bind(self, ti: TaskInfo, hostname: str) -> None:
+        """Local state flips to Binding under the lock; the API call runs
+        async with resync-on-failure (ref: cache.go:392-432)."""
+        with self._lock:
+            job, task = self._find_job_and_task(ti)
+            node = self.nodes.get(hostname)
+            if node is None:
+                raise KeyError(f"failed to bind Task {task.uid} to host "
+                               f"{hostname}, host does not exist")
+            job.update_task_status(task, TaskStatus.BINDING)
+            task.node_name = hostname
+            node.add_task(task)
+            self._mark_job(job.uid)
+            self._mark_node(hostname)
+            pod = task.pod
+
+        self._submit(lambda: self._bind_one(task, pod, hostname))
+
+    def _bind_one(self, task: TaskInfo, pod, hostname: str) -> None:
+        """The API-side half of a bind: POST through the binder seam, resync
+        the task on failure, emit the Scheduled event on success. Shared by
+        bind() and both bind_many() submission paths."""
+        try:
+            self.binder.bind(pod, hostname)
+        except Exception:
+            self.resync_task(task)
+        else:
+            self.recorder.eventf(
+                pod, "Normal", "Scheduled",
+                f"Successfully assigned {pod.namespace}/{pod.name} "
+                f"to {hostname}")
+
+    def bind_many(self, bindings: List[Tuple[TaskInfo, str]]) -> None:
+        """Batched bind: identical state flips to per-task bind(), but one
+        lock acquisition for the whole decision batch. The reference has no
+        counterpart (it fires one goroutine per bind, cache.go:423-429);
+        whole-cycle device solvers hand back thousands of decisions at once
+        and the per-bind lock/unlock churn dominates replay without this."""
+        submits = []
+        binding = TaskStatus.BINDING
+        #: hostname -> [cpu, mem, gpu] sums for one idle.sub/used.add per
+        #: node instead of per task (10k+ binds per cycle at cfg5; the
+        #: different addition order is float-immaterial vs the epsilons)
+        node_take: dict = {}
+        with self._lock:
+            # resolve every lookup BEFORE mutating: a vanished pod or a
+            # duplicate key must reject the batch while the cache is still
+            # consistent (the deferred arithmetic below never half-applies)
+            resolved = []
+            seen_keys: dict = {}
+            for ti, hostname in bindings:
+                job, task = self._find_job_and_task(ti)
+                node = self.nodes.get(hostname)
+                if node is None:
+                    raise KeyError(f"failed to bind Task {task.uid} to host "
+                                   f"{hostname}, host does not exist")
+                keys = seen_keys.setdefault(hostname, set())
+                if task.key in node.tasks or task.key in keys:
+                    raise KeyError(
+                        f"task <{task.namespace}/{task.name}> already on "
+                        f"node <{node.name}>")
+                keys.add(task.key)
+                resolved.append((job, task, node, hostname))
+
+            for job, task, node, hostname in resolved:
+                # update_task_status(task, BINDING), inlined for the batch:
+                # the stored task IS ti's cache twin, so the net-zero
+                # total_request ops drop out; Pending isn't an allocated
+                # status, Binding is
+                index = job.task_status_index
+                bucket = index.get(task.status)
+                if bucket is not None:
+                    bucket.pop(task.uid, None)
+                    if not bucket:
+                        del index[task.status]
+                if allocated_status(task.status):
+                    job.allocated.sub(task.resreq)
+                task.status = binding
+                index.setdefault(binding, {})[task.uid] = task
+                if task.pod.priority is not None:
+                    job.priority = task.priority
+                job.allocated.add(task.resreq)
+                task.node_name = hostname
+                # NodeInfo.add_task minus the per-task arithmetic (batched
+                # into node_take below); Binding consumes idle
+                key = task.key
+                if node.node is not None:
+                    rr = task.resreq
+                    if task.is_backfill:
+                        node.backfilled.add(rr)
+                    acc = node_take.get(hostname)
+                    if acc is None:
+                        acc = node_take[hostname] = [0.0, 0.0, 0.0]
+                    acc[0] += rr.milli_cpu
+                    acc[1] += rr.memory
+                    acc[2] += rr.milli_gpu
+                if task.pod.has_pod_affinity():
+                    node.affinity_tasks += 1
+                node._own_tasks()
+                node.tasks[key] = task.clone()
+                self._mark_job(job.uid)
+                self._mark_node(hostname)
+                submits.append((task, task.pod, hostname))
+
+            for hostname, take in node_take.items():
+                node = self.nodes[hostname]
+                node.idle.sub_vec(take)
+                node.used.add_vec(take)
+
+        if self._pool is None:
+            # sync mode: run inline without the per-task closure allocation
+            # (10k+ binds per cycle at the stress configs)
+            bind_one = self._bind_one
+            for task, pod, hostname in submits:
+                bind_one(task, pod, hostname)
+            return
+
+        for task, pod, hostname in submits:
+            self._submit(
+                lambda t=task, p=pod, h=hostname: self._bind_one(t, p, h))
+
+    def evict(self, ti: TaskInfo, reason: str) -> None:
+        """ref: cache.go:349-389."""
+        with self._lock:
+            job, task = self._find_job_and_task(ti)
+            node = self.nodes.get(task.node_name)
+            if node is None:
+                raise KeyError(f"failed to evict Task {task.uid} on host "
+                               f"{task.node_name}, host does not exist")
+            job.update_task_status(task, TaskStatus.RELEASING)
+            node.update_task(task)
+            self._mark_job(job.uid)
+            self._mark_node(task.node_name)
+            pod = task.pod
+            pg = job.pod_group
+
+        def do_evict(task=task, pod=pod):
+            try:
+                self.evictor.evict(pod)
+            except Exception:
+                self.resync_task(task)
+
+        self._submit(do_evict)
+        if not shadow_pod_group(pg):
+            self.recorder.eventf(pg, "Normal", "Evict", reason)
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        self.volume_binder.allocate_volumes(task, hostname)
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        self.volume_binder.bind_volumes(task)
+
+    # ------------------------------------------------------------------
+    # repair loops (ref: cache.go:464-513, event_handlers.go:88-106)
+    # ------------------------------------------------------------------
+    def resync_task(self, task: TaskInfo) -> None:
+        self.err_tasks.add_rate_limited(task)
+
+    def process_resync_tasks(self) -> None:
+        for task in self.err_tasks.pop_due():
+            try:
+                self.sync_task(task)
+                self.err_tasks.forget(task)
+            except Exception:
+                self.err_tasks.add_rate_limited(task)
+
+    def sync_task(self, old_task: TaskInfo) -> None:
+        """Re-fetch ground truth and replay (ref: event_handlers.go:88-106)."""
+        with self._lock:
+            if self.pod_lister is None:
+                # no external truth: replay the task's own pod state
+                new_pod: Optional[Pod] = old_task.pod
+            else:
+                new_pod = self.pod_lister(old_task.namespace, old_task.name)
+            if new_pod is None:
+                self._delete_task(old_task)
+                return
+            self._delete_task(old_task)
+            self._add_task(TaskInfo(new_pod))
+
+    def process_cleanup_jobs(self) -> None:
+        for job in self.deleted_jobs.pop_due():
+            with self._lock:
+                if job_terminated(job):
+                    self.jobs.pop(job.uid, None)
+                    self.deleted_jobs.forget(job)
+                else:
+                    self.deleted_jobs.add_rate_limited(job)
+
+    # ------------------------------------------------------------------
+    # snapshot (ref: cache.go:515-583)
+    # ------------------------------------------------------------------
+    def snapshot(self) -> ClusterInfo:
+        """Deep-copied cluster view for one session. With incremental
+        snapshots enabled, entity clones from the previous session are
+        reused when neither the cache (dirty sets) nor that session
+        (touched sets, folded in at adopt_snapshot) invalidated them —
+        output is deep-equal to snapshot_full() by construction."""
+        with self._lock:
+            self._handout_epoch = self._snap_epoch
+            self._handout_shape_epoch = self._shape_epoch
+            self._dev_refresh |= self._dev_dirty
+            self._dev_dirty = set()
+            self._vic_refresh |= self._vic_dirty
+            self._vic_dirty = set()
+            self._vicjob_refresh |= self._vicjob_dirty
+            self._vicjob_dirty = set()
+            if self.victim_segments is None:
+                # no store to refresh against (host victim mode, store
+                # dropped, or never built): the next build is a full one
+                # anyway — without this, a scheduler that never runs the
+                # device victim path accumulates job uids forever
+                self._vic_refresh.clear()
+                self._vicjob_refresh.clear()
+            alloc_total = self._allocatable_total_locked()
+            base = self._snap_base
+            if not self._incremental or base is None:
+                snap = self.snapshot_full()
+                if self._incremental:
+                    # the full clone IS current truth for every entity
+                    self._dirty_jobs.clear()
+                    self._dirty_nodes.clear()
+                return snap
+            base_jobs, base_nodes = base
+            # the base is consumed: the objects are handed to the new
+            # session, which will mutate them. If the session dies before
+            # adoption, the next snapshot is a full clone.
+            self._snap_base = None
+            dirty_jobs, self._dirty_jobs = self._dirty_jobs, set()
+            dirty_nodes, self._dirty_nodes = self._dirty_nodes, set()
+            snap = ClusterInfo()
+            snap.allocatable_total = alloc_total
+            snap.node_order_epoch = self._node_order_epoch
+            snap.refreshed_jobs = set()
+            snap.jobs_excluded = 0
+            for name, node in self.nodes.items():
+                reuse = None if name in dirty_nodes else base_nodes.get(name)
+                snap.nodes[name] = node.clone() if reuse is None else reuse
+            for uid, q in self.queues.items():
+                snap.queues[uid] = q.clone()
+            for uid, job in self.jobs.items():
+                if job.pod_group is None and job.pdb is None:
+                    snap.jobs_excluded += 1
+                    continue
+                if job.queue not in snap.queues:
+                    snap.jobs_excluded += 1
+                    continue
+                reuse = None if uid in dirty_jobs else base_jobs.get(uid)
+                if reuse is not None:
+                    snap.jobs[uid] = reuse
+                    continue
+                self._stamp_priority(job)
+                snap.jobs[uid] = job.clone()
+                snap.refreshed_jobs.add(uid)
+            return snap
+
+    def snapshot_full(self) -> ClusterInfo:
+        """From-scratch deep clone (the reference's snapshot semantics,
+        cache.go:515-583) — also the oracle the incremental path is
+        equality-tested against."""
+        with self._lock:
+            snap = ClusterInfo()
+            snap.allocatable_total = self._allocatable_total_locked()
+            snap.node_order_epoch = self._node_order_epoch
+            snap.jobs_excluded = 0
+            for name, node in self.nodes.items():
+                snap.nodes[node.name] = node.clone()
+            for uid, q in self.queues.items():
+                snap.queues[uid] = q.clone()
+            for uid, job in self.jobs.items():
+                if job.pod_group is None and job.pdb is None:
+                    snap.jobs_excluded += 1
+                    continue
+                if job.queue not in snap.queues:
+                    snap.jobs_excluded += 1
+                    continue
+                self._stamp_priority(job)
+                snap.jobs[uid] = job.clone()
+            return snap
+
+    def _allocatable_total_locked(self) -> Resource:
+        """Cluster-wide allocatable sum, recomputed only after node-shape
+        changes (SCALING.md item 2: drf/proportion walked all nodes per
+        open, ref drf.go:59-60, proportion.go:52-53)."""
+        if self._alloc_total is None:
+            total = Resource.empty()
+            for ni in self.nodes.values():
+                total.add(ni.allocatable)
+            self._alloc_total = total
+        return self._alloc_total.clone()
+
+    def _stamp_priority(self, job: JobInfo) -> None:
+        """ref: cache.go:561-576 (PriorityClass -> job priority)."""
+        if job.pod_group is not None:
+            job.priority = self.default_priority
+            pc = self.priority_classes.get(
+                job.pod_group.priority_class_name)
+            if pc is not None:
+                job.priority = pc.value
+
+    def adopt_snapshot(self, ssn) -> None:
+        """Session close hands its entity clones back as the next cycle's
+        snapshot base. Entities the session mutated (touched sets) may
+        diverge from cache truth — fold them into the dirty sets so the
+        next snapshot re-clones them; everything else is verbatim the
+        state a fresh clone would produce (clones share pod/pod_group/pdb
+        objects with cache truth, so status write-back at close is visible
+        on both sides)."""
+        if not self._incremental:
+            return
+        with self._lock:
+            if self._snap_epoch != self._handout_epoch:
+                # a cluster-wide invalidation landed mid-session: the
+                # session's clones predate it — full clone next cycle
+                return
+            self._dirty_jobs |= ssn.touched_jobs
+            self._dirty_nodes |= ssn.touched_nodes
+            self._dev_dirty |= ssn.touched_nodes
+            self._vic_dirty |= ssn.touched_nodes
+            self._vicjob_dirty |= ssn.touched_jobs
+            self._snap_base = (ssn.jobs, ssn.nodes)
+            if ssn.device_snapshot is not None:
+                self._dev_state = ssn.device_snapshot
+            vs = getattr(ssn, "_victim_store", None)
+            if vs is not None:
+                self.victim_segments = vs
+
+    def device_session(self, ssn):
+        """A DeviceSession for this cycle: the previous cycle's device
+        arrays with dirty/touched node rows re-packed from the session's
+        host truth, or a fresh build when the node set changed (or nothing
+        is adoptable). The refresh set includes nodes the CURRENT session
+        already touched (e.g. reclaim evictions run before allocate)."""
+        from ..kernels.solver import DeviceSession
+
+        with self._lock:
+            ds = self._dev_state
+            self._dev_state = None   # consumed; re-adopted at close
+            if not self._incremental or ds is None:
+                # the fresh build reflects the session snapshot — marks up
+                # to THAT point are satisfied; later marks (_dev_dirty)
+                # must survive to the next snapshot
+                self._dev_refresh.clear()
+                return DeviceSession(ssn.nodes)
+            refresh, self._dev_refresh = self._dev_refresh, set()
+        refresh |= ssn.touched_nodes
+        if not ds.update_rows(ssn.nodes, refresh):
+            return DeviceSession(ssn.nodes)
+        return ds
+
+    # ------------------------------------------------------------------
+    # status write-back (ref: cache.go:615-658)
+    # ------------------------------------------------------------------
+    def task_unschedulable(self, task: TaskInfo, message: str) -> None:
+        """ref: cache.go:445-462."""
+        pod = task.pod
+        self.recorder.eventf(pod, "Warning", "Unschedulable", message)
+        self.status_updater.update_pod_condition(pod, {
+            "type": "PodScheduled",
+            "status": "False",
+            "reason": "Unschedulable",
+            "message": message,
+        })
+
+    def record_job_status_event(self, job: JobInfo) -> None:
+        """ref: cache.go:616-643."""
+        job_err = job.fit_error()
+        if not shadow_pod_group(job.pod_group):
+            pg_unschedulable = job.pod_group is not None and (
+                job.pod_group.status.phase in (PodGroupPhase.PENDING,
+                                               PodGroupPhase.UNKNOWN))
+            pdb_unschedulable = (job.pdb is not None
+                                 and job.count(TaskStatus.PENDING) != 0)
+            if pg_unschedulable or pdb_unschedulable:
+                msg = (f"{job.count(TaskStatus.PENDING)}/{len(job.tasks)} "
+                       f"tasks in gang unschedulable: {job_err}")
+                self.recorder.eventf(job.pod_group, "Warning",
+                                     UNSCHEDULABLE_CONDITION, msg)
+        for status in (TaskStatus.ALLOCATED, TaskStatus.PENDING):
+            for task in list(job.task_status_index.get(status, {}).values()):
+                self.task_unschedulable(task, job_err)
+
+    def update_job_status(self, job: JobInfo) -> JobInfo:
+        """ref: cache.go:646-658."""
+        if not shadow_pod_group(job.pod_group):
+            pg = self.status_updater.update_pod_group(job.pod_group)
+            job.pod_group = pg
+        self.record_job_status_event(job)
+        return job

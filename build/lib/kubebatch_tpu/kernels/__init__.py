@@ -1,0 +1,10 @@
+"""TPU solver kernels: tensorization + jitted scheduling scans.
+
+The layer with no reference counterpart — see SURVEY.md sect. 2.9/7.
+"""
+from .solver import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP, Decision,
+                     DeviceSession)
+from .tensorize import NodeState, TaskBatch, pad_to_bucket
+
+__all__ = ["ALLOC", "ALLOC_OB", "FAIL", "PIPELINE", "SKIP", "Decision",
+           "DeviceSession", "NodeState", "TaskBatch", "pad_to_bucket"]

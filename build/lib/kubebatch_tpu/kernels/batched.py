@@ -1,0 +1,903 @@
+"""Batched allocate solver — many placements per device step.
+
+The fused kernel (kernels/fused.py) replays the reference's heap algorithm
+one placement per ``while_loop`` iteration; at 10k pending tasks that is
+10k+ sequential device steps (~100 us each).  This module is the
+TPU-idiomatic alternative: a **round-based** solver where every round
+places as many tasks as capacity allows, in parallel, and only the few
+capacity *conflicts* spill to the next round.  A 10k-task cycle resolves
+in a handful of rounds, and the whole round loop runs inside ONE device
+dispatch (the axon tunnel charges ~70 ms per device->host transfer, so
+the cycle performs exactly one blocking read).
+
+Round structure (all tensor ops):
+
+1. **Order** — queue shares (proportion water-fill state), DRF job shares
+   and gang readiness are recomputed from the committed state, composed
+   into the configured lexicographic job order (the same key vocabulary as
+   kernels/fused.py), and flattened into a global task rank.
+2. **Eligibility** — the exact per-(task, node) predicate+fit matrix
+   against round-start capacity: sig-indexed static predicates AND
+   task-count room AND (fits idle+backfilled OR fits releasing), mirroring
+   allocate.go:153-184.  A participating task with no eligible node FAILs
+   and (gang semantics) kills its job's later-ranked tasks — the batch
+   equivalent of "job dropped on first unassignable task"
+   (allocate.go:187-189).
+3. **Proposals** — tasks pick target nodes.  Identical tasks must spread
+   (argmax alone would pile every replica of a template onto one node and
+   serialize into per-node rounds), so tasks of one cohort are
+   *waterfalled*: nodes sorted by score, estimated integer capacities
+   cumulated, and the cohort's m-th task proposes the node covering
+   position m.  Tasks whose waterfall slot is infeasible for their exact
+   request fall back to their individual masked argmax.  Cohorts are
+   (signature, nonzero-request) PAIRS — scores, including the dynamic
+   least-requested / balanced-resource terms, are evaluated with the
+   cohort's own request, so same-sig pods of different sizes score
+   per-task (CycleInputs.pair_terms; when a cycle carries more distinct
+   request shapes than the pair budget, requests quantize onto a log2
+   grid and scores deviate by at most the bucket width).
+4. **Acceptance** — per node, proposers are taken in global-rank order
+   while the cumulative exact requests fit the pool (segmented scans keep
+   float error per-node, not global).  The top-ranked proposer on each
+   node always fits (eligibility checked the full pool), so every round
+   makes progress.  Rejected proposers simply retry next round against
+   refreshed state.
+5. **Commit** — accepted placements update capacity, fairness shares,
+   and gang counters via per-node / per-job / per-queue segment sums.
+
+Faithfulness contract (vs the reference allocate action):
+- capacity, predicates, epsilon fit rules, AllocatedOverBackfill and
+  Pipelined decisions are exact (same arithmetic as kernels/fused.py);
+- gang all-or-nothing, job-drop-on-failure, overused-queue exclusion and
+  the pipelined-inclusive readiness count are preserved;
+- *ordering* is round-granular: fairness shares and the derived queue/job
+  order refresh between rounds, not between every single placement, and a
+  queue/job visit sequence is not materialized.  Under contention the
+  task->node map can differ from the sequential heap schedule while
+  satisfying the same policy constraints.  The fused and host modes remain
+  the bit-exact engines; this is the throughput engine the north-star
+  latency target is measured on (BASELINE.md).
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metrics import solver_trace, update_solver_kernel_duration
+from .fused import (ALLOC, ALLOC_OB, FAIL, K_DRF_SHARE, K_GANG_READY,
+                    K_PRIORITY, K_PROP_SHARE, PIPELINE, SKIP, _share)
+from .pack import pack_inputs
+from .pack import unpack as _unpack
+from .solver import dynamic_node_score
+from .tensorize import VEC_EPS
+
+_IMAX = jnp.iinfo(jnp.int32).max
+
+
+class RoundState(NamedTuple):
+    """Device state carried across rounds."""
+    idle: jnp.ndarray         # [N,R]
+    releasing: jnp.ndarray    # [N,R]
+    n_tasks: jnp.ndarray      # [N]
+    nz_req: jnp.ndarray       # [N,2]
+    q_allocated: jnp.ndarray  # [Q,R]
+    j_allocated: jnp.ndarray  # [J,R]
+    alloc_cnt: jnp.ndarray    # [J] allocated-family count (readiness)
+    job_alive: jnp.ndarray    # [J] bool — not yet dropped on failure
+    task_state: jnp.ndarray   # [T] SKIP while pending
+    task_node: jnp.ndarray    # [T]
+    task_seq: jnp.ndarray     # [T] round * T_pad + in-round rank
+
+
+class CycleArrays(NamedTuple):
+    """Arrays static across rounds (uploaded once per cycle)."""
+    backfilled: jnp.ndarray       # [N,R]
+    allocatable_cm: jnp.ndarray   # [N,2]
+    max_task_num: jnp.ndarray     # [N]
+    node_ok: jnp.ndarray          # [N]
+    resreq: jnp.ndarray           # [T,R]
+    init_resreq: jnp.ndarray      # [T,R]
+    task_nz: jnp.ndarray          # [T,2]
+    task_job: jnp.ndarray         # [T]
+    task_rank: jnp.ndarray        # [T]
+    task_sig: jnp.ndarray         # [T]  (predicate rows)
+    task_pair: jnp.ndarray        # [T]  (scoring/waterfall cohorts)
+    task_valid: jnp.ndarray       # [T]
+    sig_scores: jnp.ndarray       # [S,N]
+    sig_pred: jnp.ndarray         # [S,N]
+    pair_sig: jnp.ndarray         # [P] pair -> sig
+    pair_nz: jnp.ndarray          # [P,2] cohort nonzero-request
+    order_min_available: jnp.ndarray  # [J]
+    job_queue: jnp.ndarray        # [J]
+    job_priority: jnp.ndarray     # [J]
+    job_create_rank: jnp.ndarray  # [J]
+    job_valid: jnp.ndarray        # [J]
+    q_deserved: jnp.ndarray       # [Q,R]
+    q_create_rank: jnp.ndarray    # [Q]
+    cluster_total: jnp.ndarray    # [R]
+    dyn_weights: jnp.ndarray      # [2]
+
+
+def _segmented_prefix(values: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive prefix sums within segments of a sorted array.
+
+    ``starts[i]`` is the index where row i's segment begins (rows sorted by
+    segment).  An associative segmented scan keeps rounding error bounded
+    by the segment length (a node's task count), not the global sum —
+    float32 stays well inside the resource epsilons.
+    """
+    flag = jnp.arange(values.shape[0]) == starts          # segment head
+    if values.ndim == 2:
+        flag = flag[:, None]
+
+    def comb(a, b):
+        sa, fa = a
+        sb, fb = b
+        return jnp.where(fb, sb, sa + sb), fa | fb
+
+    sums, _ = jax.lax.associative_scan(comb, (values, flag))
+    return sums - values                                   # exclusive
+
+
+#: demand-window fraction: jobs whose exclusive cumulative demand prefix
+#: stays under this fraction of the round's available capacity join the
+#: round. Below 1.0 because aggregate capacity overstates what placement
+#: can use (bin-packing fragmentation): admitting demand up to raw
+#: capacity lets dozens of gangs start that cannot all finish, stranding
+#: their partial allocations (gang all-or-nothing). The first engaged job
+#: is always admitted (exclusive prefix 0), so rounds always progress.
+_WINDOW_SLACK = 0.85
+
+
+def _round(state: RoundState, a: CycleArrays, round_idx,
+           job_keys: Tuple[str, ...], queue_keys: Tuple[str, ...],
+           prop_overused: bool, dyn_enabled: bool,
+           pipe_enabled: bool = True, seq_stride: int = 0):
+    """One allocation round.  Returns (new_state, progress).
+
+    ``pipe_enabled`` is a static specialization: when the host saw no
+    releasing resources anywhere at cycle start (the common case — and
+    allocate never creates releasing), every pipeline-fit matrix folds to
+    False at trace time, halving the [T,N] fit work per round."""
+    eps = jnp.asarray(VEC_EPS)
+    t_pad = a.task_valid.shape[0]
+    n_pad = a.node_ok.shape[0]
+
+    # ---- 1. ordering ----------------------------------------------------
+    overused = jnp.zeros(a.q_deserved.shape[0], bool)
+    if prop_overused:
+        overused = jnp.all(a.q_deserved < state.q_allocated + eps, axis=-1)
+
+    q_share = jnp.zeros(a.q_deserved.shape[0], jnp.float32)
+    for k in queue_keys:
+        if k == K_PROP_SHARE:
+            q_share = _share(state.q_allocated, a.q_deserved)
+
+    jkeys = []
+    for k in job_keys:
+        if k == K_PRIORITY:
+            jkeys.append(-a.job_priority.astype(jnp.float32))
+        elif k == K_GANG_READY:
+            ready = (state.alloc_cnt >= a.order_min_available)
+            jkeys.append(ready.astype(jnp.float32))
+        elif k == K_DRF_SHARE:
+            jkeys.append(_share(state.j_allocated, a.cluster_total[None, :]))
+    # queue keys lead (the reference pops the best queue first), then the
+    # configured job keys, then creation rank; lexsort's LAST key is primary
+    keys = ([a.job_create_rank.astype(jnp.float32)]
+            + list(reversed(jkeys))
+            + [a.q_create_rank[a.job_queue].astype(jnp.float32),
+               q_share[a.job_queue]])
+    job_order = jnp.lexsort(keys)
+    job_sort_rank = jnp.zeros_like(job_order).at[job_order].set(
+        jnp.arange(job_order.shape[0]))
+
+    engaged = (a.task_valid & (state.task_state == SKIP)
+               & state.job_alive[a.task_job] & a.job_valid[a.task_job]
+               & ~overused[a.job_queue[a.task_job]])
+
+    # ---- demand window --------------------------------------------------
+    # Under contention, unlimited round parallelism fragments capacity
+    # across MANY incomplete gangs (every job places a few tasks, few
+    # reach MinAvailable) — the sequential reference concentrates capacity
+    # job-by-job instead (allocate.go: one job visit at a time). Emulate
+    # that concentration without giving up the single dispatch: only the
+    # best-ranked jobs whose cumulative remaining demand fits inside the
+    # window fraction of the round's available capacity participate;
+    # later jobs wait for a subsequent round, by which point earlier
+    # gangs completed or died. With total demand under the window
+    # fraction of capacity the window admits everyone and behavior is
+    # unchanged; between the fraction and full capacity a small tail is
+    # deferred a round (cheap insurance against stranding).
+    j_pad = a.job_valid.shape[0]
+    avail_pool = jnp.where((a.node_ok
+                            & (state.n_tasks < a.max_task_num))[:, None],
+                           jnp.maximum(state.idle + a.backfilled, 0.0), 0.0
+                           ).sum(axis=0)                      # [R]
+    if pipe_enabled:
+        avail_pool = avail_pool + jnp.maximum(state.releasing, 0.0).sum(
+            axis=0)
+    job_demand = jax.ops.segment_sum(
+        jnp.where(engaged[:, None], a.resreq, 0.0),
+        jnp.maximum(a.task_job, 0), num_segments=j_pad)       # [J,R]
+    eng_job = jnp.any(job_demand > 0, axis=-1)                # [J]
+    # dominant normalized demand (0 when the cluster has no capacity in a
+    # dimension nobody can place anyway)
+    norm = jnp.max(
+        jnp.where(avail_pool[None, :] > 0,
+                  job_demand / jnp.maximum(avail_pool[None, :], 1e-9),
+                  0.0), axis=-1)                              # [J]
+    norm_ord = norm[job_order]
+    cum_excl = jnp.cumsum(norm_ord) - norm_ord
+    in_window = cum_excl <= _WINDOW_SLACK                     # [J] ord
+
+    # per-queue budget: the sequential reference re-checks overuse at
+    # every queue POP, so a queue only ever exceeds its deserved by the
+    # one job in flight; a round that admits a whole queue's backlog at
+    # round-start shares locks an overshoot in before ordering can react.
+    # Admit each queue's jobs (rank order) while their cumulative demand
+    # stays inside the queue's REMAINING deserved; the queue's first
+    # engaged job is always admitted (= the pop in flight).
+    if prop_overused:
+        q_remaining = jnp.maximum(a.q_deserved - state.q_allocated, 0.0)
+        qr_job = q_remaining[a.job_queue]                     # [J,R]
+        # dims with zero remaining are unconstrained for pacing — the
+        # overuse rule itself is all-dims (proportion.go:362-373), and a
+        # queue exhausted in one dim but not others keeps receiving jobs
+        # in the reference until overused actually flips
+        qn = jnp.max(jnp.where(qr_job > 0,
+                               job_demand / jnp.maximum(qr_job, 1e-9),
+                               0.0),
+                     axis=-1)                                 # [J]
+        # group jobs by queue, rank-ordered inside each queue; segment
+        # starts via the same searchsorted idiom as acceptance
+        qperm = jnp.lexsort([job_sort_rank, a.job_queue])
+        qj = a.job_queue[qperm]
+        seg_start = jnp.searchsorted(qj, qj, side="left")
+        q_prefix = _segmented_prefix(qn[qperm], seg_start)
+        eng_cnt = _segmented_prefix(
+            eng_job[qperm].astype(jnp.float32), seg_start)
+        first_engaged = eng_job[qperm] & (eng_cnt == 0.0)
+        q_ok_perm = (q_prefix <= 1.0) | first_engaged
+        q_ok = jnp.zeros(j_pad, bool).at[qperm].set(q_ok_perm)
+        # queue-rejected jobs must not count against the global window —
+        # their demand is NOT consuming capacity this round
+        norm_ord = norm_ord * q_ok[job_order]
+        cum_excl = jnp.cumsum(norm_ord) - norm_ord
+        in_window = cum_excl <= _WINDOW_SLACK
+    else:
+        q_ok = jnp.ones(j_pad, bool)
+
+    admitted = jnp.zeros(j_pad, bool).at[job_order].set(in_window) & q_ok
+    participating = engaged & admitted[a.task_job]
+
+    # global task rank: (job order, task order); non-participants last
+    jr = jnp.where(participating, job_sort_rank[a.task_job], _IMAX)
+    order = jnp.lexsort([a.task_rank, jr])
+    global_rank = jnp.zeros(t_pad, jnp.int32).at[order].set(
+        jnp.arange(t_pad, dtype=jnp.int32))
+
+    # ---- 2. exact eligibility ------------------------------------------
+    accessible = state.idle + a.backfilled
+    room = state.n_tasks < a.max_task_num
+    base = a.node_ok & room
+    fit_alloc = jnp.all(a.init_resreq[:, None, :] <= accessible[None] + eps,
+                        axis=-1)
+    if pipe_enabled:
+        fit_pipe = jnp.all(
+            a.init_resreq[:, None, :] <= state.releasing[None] + eps,
+            axis=-1)
+    else:
+        fit_pipe = jnp.zeros_like(fit_alloc)
+    pred_t = a.sig_pred[a.task_sig]
+    eligible = pred_t & base[None, :] & (fit_alloc | fit_pipe)
+    any_elig = jnp.any(eligible, axis=1)
+
+    fail_now = participating & ~any_elig
+    # first failing rank per job kills the job's later-ranked tasks; only
+    # the breaking task itself is marked FAIL (allocate.go:187-189 — the
+    # rest simply stay Pending once the job leaves the queue)
+    fail_rank = jax.ops.segment_min(
+        jnp.where(fail_now, global_rank, _IMAX),
+        jnp.maximum(a.task_job, 0), num_segments=a.job_valid.shape[0])
+    job_killed = fail_rank < _IMAX
+    fail_first = fail_now & (global_rank == fail_rank[a.task_job])
+    blocked = participating & (global_rank > fail_rank[a.task_job])
+    part2 = participating & ~fail_now & ~blocked
+
+    # ---- 3. proposals ---------------------------------------------------
+    # Scores run per (sig, nonzero-request) PAIR cohort: the dynamic terms
+    # are evaluated with the cohort's own request (exact per-task when the
+    # host built exact pairs), not a sig-wide mean.
+    pair_pred = a.sig_pred[a.pair_sig]                    # [P,N]
+    dyn_term = jnp.zeros_like(pair_pred, jnp.float32)
+    if dyn_enabled:
+        dyn_term = jax.vmap(
+            lambda nz: dynamic_node_score(state.nz_req, nz,
+                                          a.allocatable_cm,
+                                          a.dyn_weights))(a.pair_nz)
+    sc = a.sig_scores[a.pair_sig] + dyn_term              # [P,N]
+
+    # The waterfall is ONE shared mass ledger (independent per-cohort
+    # waterfalls over-propose the globally best nodes and serialize into
+    # hundreds of conflict rounds): nodes in the demand-majority cohort's
+    # score order, capacity cumulated as resource VECTORS, and each task
+    # proposes the first node whose cumulative capacity covers the total
+    # mass of all higher-ranked tasks plus its own request — the parallel
+    # emulation of sequential fill. Placement spread is heuristic; fit,
+    # predicates and acceptance stay exact per task (water_elig / phase
+    # checks), and mismatched tasks fall back to their pair argmax.
+    p_pad = a.pair_sig.shape[0]
+    pair_demand = jax.ops.segment_sum(
+        part2.astype(jnp.int32), a.task_pair, num_segments=p_pad)
+    maj_pair = jnp.argmax(pair_demand)
+    shared_sc = sc[maj_pair]                              # [N]
+    ord_sh = jnp.argsort(-shared_sc, stable=True)         # [N]
+    cap_mass = jnp.where(
+        (pair_pred[maj_pair] & base)[:, None],
+        jnp.maximum(accessible, 0.0), 0.0)                # [N,R]
+    room_cnt = jnp.maximum(
+        (a.max_task_num - state.n_tasks), 0).astype(jnp.float32)
+    cum_mass = jnp.cumsum(cap_mass[ord_sh], axis=0)       # [N,R]
+    cum_cnt = jnp.cumsum(jnp.where(pair_pred[maj_pair] & base,
+                                   room_cnt, 0.0)[ord_sh])
+
+    # exclusive prefix mass over part2 tasks in global-rank order
+    rank_perm = jnp.argsort(global_rank)
+    mass_sorted = jnp.where(part2, 1.0, 0.0)[rank_perm, None] \
+        * a.resreq[rank_perm]
+    prefix_sorted = jnp.cumsum(mass_sorted, axis=0) - mass_sorted
+    cnt_sorted = jnp.where(part2, 1.0, 0.0)[rank_perm]
+    cnt_prefix_sorted = jnp.cumsum(cnt_sorted) - cnt_sorted
+    prefix = jnp.zeros_like(mass_sorted).at[rank_perm].set(prefix_sorted)
+    cnt_prefix = jnp.zeros_like(cnt_sorted).at[rank_perm].set(
+        cnt_prefix_sorted)
+
+    need = prefix + a.resreq                              # [T,R]
+    # per-dim searchsorted, max across dims (+ the task-count ledger)
+    slots = [jnp.searchsorted(cum_mass[:, d], need[:, d], side="left")
+             for d in range(need.shape[1])]
+    slots.append(jnp.searchsorted(cum_cnt, cnt_prefix + 1.0, side="left"))
+    slot = slots[0]
+    for s in slots[1:]:
+        slot = jnp.maximum(slot, s)
+    slot_ok = slot < n_pad
+    slot_c = jnp.minimum(slot, n_pad - 1)
+    p_water = ord_sh[slot_c].astype(jnp.int32)
+    water_elig = jnp.take_along_axis(eligible, p_water[:, None],
+                                     axis=1)[:, 0] & slot_ok
+
+    sc_rows = sc[a.task_pair]                             # [T,N]
+    fb = jnp.argmax(jnp.where(eligible, sc_rows, -jnp.inf), axis=1)
+    proposal1 = jnp.where(water_elig, p_water, fb).astype(jnp.int32)
+
+    # ---- 4. acceptance (two phases) ------------------------------------
+    # Phase 1 accepts waterfall/argmax proposals; rejected tasks get a
+    # SECOND CHANCE in the same round, re-proposing their best node against
+    # phase-1-committed capacity — recovering most of the packing quality
+    # the sequential engine gets from per-placement state refresh, without
+    # another round's ordering pass.
+    def accept_phase(proposal, mask, idle_c, rel_c, ntasks_c):
+        acc_c = idle_c + a.backfilled
+        # fit at each task's PROPOSED node only: gather the [T,R] node rows
+        # instead of materializing the full [T,N,R] fit matrix (identical
+        # values, ~N x less HBM traffic)
+        fit_alloc_c = jnp.all(a.init_resreq <= acc_c[proposal] + eps,
+                              axis=-1)
+        prop_alloc = fit_alloc_c                          # else pipeline
+        node_key = jnp.where(mask, proposal, n_pad)
+        perm2 = jnp.lexsort([global_rank, node_key])
+        nid = node_key[perm2]
+        seg_start = jnp.searchsorted(nid, nid, side="left")
+        nid_c = jnp.minimum(nid, n_pad - 1)
+
+        s_req = a.resreq[perm2]
+        s_init = a.init_resreq[perm2]
+        s_alloc = prop_alloc[perm2]
+        s_part = mask[perm2]
+
+        alloc_vals = jnp.where((s_alloc & s_part)[:, None], s_req, 0.0)
+        pipe_vals = jnp.where((~s_alloc & s_part)[:, None], s_req, 0.0)
+        cnt_vals = s_part.astype(jnp.int32)
+
+        excl_alloc = _segmented_prefix(alloc_vals, seg_start)
+        excl_pipe = _segmented_prefix(pipe_vals, seg_start)
+        excl_cnt = _segmented_prefix(cnt_vals, seg_start)
+
+        pool_acc = acc_c[nid_c]
+        pool_idle = idle_c[nid_c]
+        pool_rel = rel_c[nid_c]
+        room_left = (a.max_task_num[nid_c] - ntasks_c[nid_c]
+                     - excl_cnt) > 0
+
+        ok_alloc = (s_alloc & s_part & room_left
+                    & jnp.all(s_init <= pool_acc - excl_alloc + eps,
+                              axis=-1))
+        if pipe_enabled:
+            ok_pipe = (~s_alloc & s_part & room_left
+                       & jnp.all(s_init <= pool_rel - excl_pipe + eps,
+                                 axis=-1))
+        else:
+            ok_pipe = jnp.zeros_like(ok_alloc)
+        accept_s = ok_alloc | ok_pipe
+        # over-backfill: the accepted launch request no longer fits what's
+        # left of plain idle after earlier-ranked accepted alloc takes
+        ob_s = ok_alloc & ~jnp.all(s_init <= pool_idle - excl_alloc + eps,
+                                   axis=-1)
+
+        inv2 = jnp.zeros(t_pad, jnp.int32).at[perm2].set(
+            jnp.arange(t_pad, dtype=jnp.int32))
+        return accept_s[inv2], ob_s[inv2], prop_alloc
+
+    def commit_node(accept, is_alloc, is_pipe, proposal, idle_c, rel_c,
+                    ntasks_c, nz_c):
+        node_seg = jnp.where(accept, proposal, 0)
+        take_alloc = jnp.where(is_alloc[:, None], a.resreq, 0.0)
+        take_pipe = jnp.where(is_pipe[:, None], a.resreq, 0.0)
+        idle_n = idle_c - jax.ops.segment_sum(take_alloc, node_seg,
+                                              num_segments=n_pad)
+        rel_n = rel_c - jax.ops.segment_sum(take_pipe, node_seg,
+                                            num_segments=n_pad)
+        ntasks_n = ntasks_c + jax.ops.segment_sum(
+            accept.astype(jnp.int32), node_seg, num_segments=n_pad)
+        nz_n = nz_c + jax.ops.segment_sum(
+            jnp.where(accept[:, None], a.task_nz, 0.0), node_seg,
+            num_segments=n_pad)
+        return idle_n, rel_n, ntasks_n, nz_n
+
+    accept1, ob1, prop_alloc1 = accept_phase(
+        proposal1, part2, state.idle, state.releasing, state.n_tasks)
+    idle1, rel1, ntasks1, nz1 = commit_node(
+        accept1, prop_alloc1 & accept1, ~prop_alloc1 & accept1, proposal1,
+        state.idle, state.releasing, state.n_tasks, state.nz_req)
+
+    # retry phase: rejected tasks re-propose their argmax against the
+    # committed mid-round state. ONE retry measures best: it recovers most
+    # of the packing the sequential engine gets from per-placement state
+    # refresh, while further same-round eagerness starts to lock in
+    # placements the next round's refreshed fairness order would improve.
+    accept, ob, proposal, prop_alloc = accept1, ob1, proposal1, prop_alloc1
+    idle_c, rel_c, ntasks_c, nz_c = idle1, rel1, ntasks1, nz1
+    for _ in range(1):
+        retry = part2 & ~accept
+        acc_c = idle_c + a.backfilled
+        fit_r = jnp.all(a.init_resreq[:, None, :] <= acc_c[None] + eps,
+                        axis=-1)
+        if pipe_enabled:
+            fit_r = fit_r | jnp.all(
+                a.init_resreq[:, None, :] <= rel_c[None] + eps, axis=-1)
+        room_r = ntasks_c < a.max_task_num
+        eligible_r = pred_t & (a.node_ok & room_r)[None, :] & fit_r
+        fb_r = jnp.argmax(jnp.where(eligible_r, sc_rows, -jnp.inf),
+                          axis=1).astype(jnp.int32)
+        retry = retry & jnp.any(eligible_r, axis=1)
+        accept_r, ob_r, prop_alloc_r = accept_phase(fb_r, retry, idle_c,
+                                                    rel_c, ntasks_c)
+        idle_c, rel_c, ntasks_c, nz_c = commit_node(
+            accept_r, prop_alloc_r & accept_r, ~prop_alloc_r & accept_r,
+            fb_r, idle_c, rel_c, ntasks_c, nz_c)
+        accept = accept | accept_r
+        ob = jnp.where(accept_r, ob_r, ob)
+        proposal = jnp.where(accept_r, fb_r, proposal)
+        prop_alloc = jnp.where(accept_r, prop_alloc_r, prop_alloc)
+    new_idle, new_rel, new_ntasks, new_nz = idle_c, rel_c, ntasks_c, nz_c
+    is_alloc = prop_alloc & accept
+    is_pipe = ~prop_alloc & accept
+
+    # ---- 5. commit (job / queue aggregates) -----------------------------
+
+    job_seg = jnp.where(accept, a.task_job, 0)
+    take_any = jnp.where(accept[:, None], a.resreq, 0.0)
+    n_jobs = a.job_valid.shape[0]
+    new_j_alloc = state.j_allocated + jax.ops.segment_sum(
+        take_any, job_seg, num_segments=n_jobs)
+    queue_seg = jnp.where(accept, a.job_queue[jnp.maximum(a.task_job, 0)], 0)
+    new_q_alloc = state.q_allocated + jax.ops.segment_sum(
+        take_any, queue_seg, num_segments=a.q_deserved.shape[0])
+    # pipelined-inclusive readiness; over-backfill stays outside the quorum
+    counted = accept & ~ob
+    new_alloc_cnt = state.alloc_cnt + jax.ops.segment_sum(
+        counted.astype(jnp.int32), job_seg, num_segments=n_jobs)
+
+    decision = jnp.where(
+        fail_first, FAIL,
+        jnp.where(is_pipe, PIPELINE,
+                  jnp.where(is_alloc & ob, ALLOC_OB,
+                            jnp.where(is_alloc, ALLOC, SKIP))))
+    changed = accept | fail_first
+    new_task_state = jnp.where(changed, decision, state.task_state)
+    new_task_node = jnp.where(accept, proposal, state.task_node)
+    stride = seq_stride if seq_stride else t_pad
+    new_task_seq = jnp.where(changed, round_idx * stride + global_rank,
+                             state.task_seq)
+
+    new_alive = state.job_alive & ~job_killed
+    progress = jnp.any(changed)
+
+    new_state = RoundState(
+        idle=new_idle, releasing=new_rel, n_tasks=new_ntasks, nz_req=new_nz,
+        q_allocated=new_q_alloc, j_allocated=new_j_alloc,
+        alloc_cnt=new_alloc_cnt, job_alive=new_alive,
+        task_state=new_task_state, task_node=new_task_node,
+        task_seq=new_task_seq)
+    return new_state, progress
+
+
+def _stranded_jobs(state: RoundState, a: CycleArrays,
+                   include_killed: bool = True):
+    """Jobs holding this-cycle placements but below quorum at a round
+    fixpoint. Gang all-or-nothing means those placements can never
+    dispatch this cycle, so the capacity they hold is dead weight that
+    completable gangs could use. They come in two kinds: KILLED jobs (a
+    task found no eligible node mid-contention — the batch analogue of
+    allocate.go:187-189, but the batch kills more often because admitted
+    competitors transiently consume capacity the sequential oracle would
+    have spent on THIS job) and, rarer, alive jobs whose proposals were
+    perpetually out-ranked."""
+    placed = ((state.task_state == ALLOC) | (state.task_state == ALLOC_OB)
+              | (state.task_state == PIPELINE)) & a.task_valid
+    j_pad = a.job_valid.shape[0]
+    job_placed = jax.ops.segment_max(
+        placed.astype(jnp.int32), jnp.maximum(a.task_job, 0),
+        num_segments=j_pad).astype(bool)
+    # quorum here counts ALLOC_OB: a job at MinAvailable only via
+    # over-backfill placements is the fork's AlmostReady state — its
+    # placements persist undispatched BY DESIGN (types.go:63-80), they
+    # are not stranded
+    ob_cnt = jax.ops.segment_sum(
+        ((state.task_state == ALLOC_OB) & a.task_valid).astype(jnp.int32),
+        jnp.maximum(a.task_job, 0), num_segments=j_pad)
+    ready = state.alloc_cnt + ob_cnt >= a.order_min_available
+    stranded = a.job_valid & job_placed & ~ready
+    if not include_killed:
+        stranded = stranded & state.job_alive
+    return stranded
+
+
+def _rollback_stranded(state: RoundState, a: CycleArrays,
+                       revive: bool = False):
+    """Revert every this-cycle placement of stranded jobs (exact inverse
+    of the round commit arithmetic). With ``revive`` the jobs re-enter
+    the rounds for a clean retry against the freed capacity (their FAIL
+    markers clear; a genuine misfit re-records on the retry) — this is
+    the epilogue emulating the oracle's job-by-job concentration at the
+    contended tail. Without it the jobs retire for the cycle and retry
+    fresh next cycle, like a window-deferred job."""
+    stranded = _stranded_jobs(state, a, include_killed=revive)
+    placed = ((state.task_state == ALLOC) | (state.task_state == ALLOC_OB)
+              | (state.task_state == PIPELINE)) & a.task_valid
+    revert = placed & stranded[jnp.maximum(a.task_job, 0)]
+    is_pipe = revert & (state.task_state == PIPELINE)
+    n_pad = state.idle.shape[0]
+    j_pad = a.job_valid.shape[0]
+    node_seg = jnp.where(revert, state.task_node, 0)
+    give_idle = jnp.where((revert & ~is_pipe)[:, None], a.resreq, 0.0)
+    give_rel = jnp.where(is_pipe[:, None], a.resreq, 0.0)
+    idle = state.idle + jax.ops.segment_sum(give_idle, node_seg,
+                                            num_segments=n_pad)
+    rel = state.releasing + jax.ops.segment_sum(give_rel, node_seg,
+                                                num_segments=n_pad)
+    ntasks = state.n_tasks - jax.ops.segment_sum(
+        revert.astype(jnp.int32), node_seg, num_segments=n_pad)
+    nz = state.nz_req - jax.ops.segment_sum(
+        jnp.where(revert[:, None], a.task_nz, 0.0), node_seg,
+        num_segments=n_pad)
+    job_seg = jnp.where(revert, a.task_job, 0)
+    take = jnp.where(revert[:, None], a.resreq, 0.0)
+    j_alloc = state.j_allocated - jax.ops.segment_sum(
+        take, job_seg, num_segments=j_pad)
+    queue_seg = jnp.where(revert, a.job_queue[jnp.maximum(a.task_job, 0)],
+                          0)
+    q_alloc = state.q_allocated - jax.ops.segment_sum(
+        take, queue_seg, num_segments=a.q_deserved.shape[0])
+    counted = revert & (state.task_state != ALLOC_OB)
+    alloc_cnt = state.alloc_cnt - jax.ops.segment_sum(
+        counted.astype(jnp.int32), job_seg, num_segments=j_pad)
+    if revive:
+        alive = state.job_alive | stranded
+        # clear the FAIL marker too so the retry starts clean (blocked
+        # tasks stayed SKIP); a real misfit re-records on the retry
+        clear = revert | ((state.task_state == FAIL)
+                          & stranded[jnp.maximum(a.task_job, 0)])
+    else:
+        alive = state.job_alive & ~stranded
+        clear = revert
+    return state._replace(
+        idle=idle, releasing=rel, n_tasks=ntasks, nz_req=nz,
+        q_allocated=q_alloc, j_allocated=j_alloc, alloc_cnt=alloc_cnt,
+        job_alive=alive,
+        task_state=jnp.where(clear, SKIP, state.task_state)), stranded
+
+
+@partial(jax.jit, static_argnames=("job_keys", "queue_keys",
+                                   "prop_overused", "dyn_enabled",
+                                   "pipe_enabled"))
+def batched_round(state: RoundState, a: CycleArrays, round_idx,
+                  job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY,
+                                               K_DRF_SHARE),
+                  queue_keys: Tuple[str, ...] = (K_PROP_SHARE,),
+                  prop_overused: bool = True,
+                  dyn_enabled: bool = False,
+                  pipe_enabled: bool = True):
+    """Single-round entry point (tests / diagnostics)."""
+    return _round(state, a, round_idx, job_keys, queue_keys, prop_overused,
+                  dyn_enabled, pipe_enabled)
+
+
+#: task-axis fields of CycleArrays (compacted for the post-round-0 loop)
+_TASK_FIELDS = ("resreq", "init_resreq", "task_nz", "task_job", "task_rank",
+                "task_sig", "task_pair", "task_valid")
+
+
+@partial(jax.jit, static_argnames=("job_keys", "queue_keys",
+                                   "prop_overused", "dyn_enabled",
+                                   "pipe_enabled", "max_rounds",
+                                   "compact_bucket", "gang_enabled"))
+def batched_allocate(state: RoundState, a: CycleArrays,
+                     job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY,
+                                                  K_DRF_SHARE),
+                     queue_keys: Tuple[str, ...] = (K_PROP_SHARE,),
+                     prop_overused: bool = True,
+                     dyn_enabled: bool = False,
+                     pipe_enabled: bool = True,
+                     max_rounds: int = 64,
+                     compact_bucket: int = 0,
+                     gang_enabled: bool = True):
+    """The whole allocate cycle: rounds run in a device-side while_loop
+    until a round makes no progress — ONE dispatch, one readback.
+
+    ``compact_bucket``: in the common low-contention cycle round 0
+    resolves ~90%% of tasks; the leftovers are gathered into a bucket of
+    this size and the remaining rounds run at [bucket, N] instead of
+    [T, N] cost (1/8th the fit/score HBM traffic). If more than
+    ``compact_bucket`` tasks survive round 0, a lax.cond falls back to
+    the full-width loop — same results either way, task seqs stay
+    globally ordered via the shared seq stride. NB: under contention the
+    demand window intentionally defers whole jobs past round 0, so
+    contended cycles routinely exceed the bucket and run full-width —
+    the compaction is an optimization for the uncontended steady regime,
+    not the contended one."""
+    t_pad = a.task_valid.shape[0]
+
+    def rounds_loop(st, arrays, start_round):
+        def cond(carry):
+            _, round_idx, progress = carry
+            return progress & (round_idx < max_rounds)
+
+        def body(carry):
+            s, round_idx, _ = carry
+            ns, progress = _round(s, arrays, round_idx, job_keys,
+                                  queue_keys, prop_overused, dyn_enabled,
+                                  pipe_enabled, seq_stride=t_pad)
+            return ns, round_idx + 1, progress
+
+        init = (st, jnp.int32(start_round), jnp.asarray(True))
+        return jax.lax.while_loop(cond, body, init)
+
+    loop = rounds_loop
+
+    def epilogue(st, rounds):
+        """Stranded-gang epilogue at FULL task width (the compact bucket
+        holds only round-0 leftovers, but a stranded gang's placements
+        can live outside it): roll back partial gangs — killed AND alive
+        (capacity they hold can never dispatch, see _rollback_stranded)
+        — revive them, and re-run rounds so the freed capacity completes
+        whole gangs, up to 3 passes. The final non-reviving rollback
+        retires any alive-partial gang so the cycle emits none (killed
+        gangs keep their pre-kill placements + FitError, exactly like
+        the oracle's drop-on-first-unassignable)."""
+
+        def epi_cond(carry):
+            s, _, k = carry
+            return (k < 3) & jnp.any(_stranded_jobs(s, a))
+
+        def epi_body(carry):
+            s, rounds, k = carry
+            s, _ = _rollback_stranded(s, a, revive=True)
+            s, rounds, _ = rounds_loop(s, a, rounds)
+            return s, rounds, k + 1
+
+        st, rounds, _ = jax.lax.while_loop(epi_cond, epi_body,
+                                           (st, rounds, jnp.int32(0)))
+        st, _ = _rollback_stranded(st, a, revive=False)
+        return st, rounds
+
+    if not gang_enabled:
+        # without a gang quorum every placement dispatches — partial jobs
+        # are legitimate (non-gang reference semantics), nothing strands
+        def epilogue(st, rounds):  # noqa: F811 — identity on purpose
+            return st, rounds
+    if compact_bucket <= 0 or compact_bucket >= t_pad:
+        final, rounds, _ = loop(state, a, 0)
+        return epilogue(final, rounds)
+
+    state, _ = _round(state, a, jnp.int32(0), job_keys, queue_keys,
+                      prop_overused, dyn_enabled, pipe_enabled,
+                      seq_stride=t_pad)
+    unresolved = (a.task_valid & (state.task_state == SKIP)
+                  & state.job_alive[jnp.maximum(a.task_job, 0)])
+    if prop_overused:
+        # queue overuse is monotone in-cycle (q_allocated only grows), so
+        # tasks of queues overused after round 0 can never resolve — keep
+        # them out of the bucket (and out of the overflow count)
+        eps = jnp.asarray(VEC_EPS)
+        overused0 = jnp.all(a.q_deserved < state.q_allocated + eps, axis=-1)
+        unresolved = unresolved & ~overused0[
+            a.job_queue[jnp.maximum(a.task_job, 0)]]
+    cnt = unresolved.sum()
+    idx = jnp.nonzero(unresolved, size=compact_bucket, fill_value=t_pad)[0]
+    valid_k = idx < t_pad
+    idx_c = jnp.minimum(idx, t_pad - 1)
+
+    def done_path(st):
+        return st, jnp.int32(1)
+
+    def compact_path(st):
+        ca = a._replace(**{f: getattr(a, f)[idx_c] for f in _TASK_FIELDS})
+        ca = ca._replace(task_valid=ca.task_valid & valid_k)
+        cs = st._replace(task_state=st.task_state[idx_c],
+                         task_node=st.task_node[idx_c],
+                         task_seq=st.task_seq[idx_c])
+        fs, rounds, _ = loop(cs, ca, 1)
+
+        def put(full, comp):
+            # unclipped indices + drop: fill slots (idx == t_pad) scatter
+            # nowhere, so they can't collide with row t_pad-1
+            return full.at[idx].set(comp, mode="drop")
+
+        return fs._replace(
+            task_state=put(st.task_state, fs.task_state),
+            task_node=put(st.task_node, fs.task_node),
+            task_seq=put(st.task_seq, fs.task_seq)), rounds
+
+    def full_path(st):
+        fs, rounds, _ = loop(st, a, 1)
+        return fs, rounds
+
+    merged, rounds = jax.lax.cond(
+        cnt > compact_bucket, full_path,
+        lambda s: jax.lax.cond(cnt == 0, done_path, compact_path, s),
+        state)
+    # the epilogue always runs at full width: a stranded gang's
+    # placements can live outside the compact bucket (round 0)
+    return epilogue(merged, rounds)
+
+
+#: (buffer kind, CycleArrays/RoundState source) for the packed upload; the
+#: order defines buffer layout.  Node-axis arrays live on the DeviceSession
+#: (uploaded once per session), everything per-cycle ships as THREE host
+#: buffers instead of ~20 individual transfers — each device_put through
+#: the axon tunnel pays latency, so transfer count dominates, not bytes.
+_PACK_F32 = ("resreq", "init_resreq", "task_nz", "sig_scores",
+             "job_priority", "q_deserved", "cluster_total", "dyn_weights",
+             "pair_nz", "q_alloc0", "j_alloc0")
+_PACK_I32 = ("task_job", "task_rank", "task_sig", "task_pair",
+             "order_min_available", "job_queue", "job_create_rank",
+             "q_create_rank", "init_allocated", "pair_sig")
+_PACK_BOOL = ("task_valid", "job_valid", "sig_pred")
+
+
+@partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",
+                                   "queue_keys", "prop_overused",
+                                   "dyn_enabled", "pipe_enabled",
+                                   "max_rounds", "compact_bucket",
+                                   "gang_enabled"))
+def _batched_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
+                    backfilled, allocatable_cm, max_task_num, node_ok,
+                    lay_f, lay_i, lay_b, job_keys, queue_keys,
+                    prop_overused, dyn_enabled, pipe_enabled, max_rounds,
+                    compact_bucket, gang_enabled=True):
+    f = _unpack(buf_f, lay_f)
+    i = _unpack(buf_i, lay_i)
+    b = _unpack(buf_b, lay_b)
+    t_pad = i["task_job"].shape[0]
+    state = RoundState(
+        idle=idle, releasing=releasing, n_tasks=n_tasks, nz_req=nz_req,
+        q_allocated=f["q_alloc0"], j_allocated=f["j_alloc0"],
+        alloc_cnt=i["init_allocated"], job_alive=b["job_valid"],
+        task_state=jnp.full(t_pad, SKIP, jnp.int32),
+        task_node=jnp.full(t_pad, -1, jnp.int32),
+        task_seq=jnp.full(t_pad, _IMAX, jnp.int32))
+    return _pack_result(*_run_batched(state, f, i, b, backfilled,
+                                      allocatable_cm, max_task_num, node_ok,
+                                      job_keys, queue_keys, prop_overused,
+                                      dyn_enabled, pipe_enabled, max_rounds,
+                                      compact_bucket, gang_enabled))
+
+
+def _pack_result(final: RoundState, rounds):
+    """Decisions + round count as ONE int32 buffer: every blocking
+    device->host read pays full tunnel latency (~70 ms on axon), so the
+    host reads back a single [3*T+1] array instead of four."""
+    return final, jnp.concatenate(
+        [final.task_state, final.task_node, final.task_seq,
+         rounds.astype(jnp.int32)[None]])
+
+
+def _run_batched(state, f, i, b, backfilled, allocatable_cm, max_task_num,
+                 node_ok, job_keys, queue_keys, prop_overused, dyn_enabled,
+                 pipe_enabled, max_rounds, compact_bucket,
+                 gang_enabled=True):
+    arrays = CycleArrays(
+        backfilled=backfilled, allocatable_cm=allocatable_cm,
+        max_task_num=max_task_num, node_ok=node_ok,
+        resreq=f["resreq"], init_resreq=f["init_resreq"],
+        task_nz=f["task_nz"], task_job=i["task_job"],
+        task_rank=i["task_rank"], task_sig=i["task_sig"],
+        task_pair=i["task_pair"], task_valid=b["task_valid"],
+        sig_scores=f["sig_scores"], sig_pred=b["sig_pred"],
+        pair_sig=i["pair_sig"], pair_nz=f["pair_nz"],
+        order_min_available=i["order_min_available"],
+        job_queue=i["job_queue"], job_priority=f["job_priority"],
+        job_create_rank=i["job_create_rank"], job_valid=b["job_valid"],
+        q_deserved=f["q_deserved"], q_create_rank=i["q_create_rank"],
+        cluster_total=f["cluster_total"], dyn_weights=f["dyn_weights"])
+    return batched_allocate(
+        state, arrays, job_keys=job_keys, queue_keys=queue_keys,
+        prop_overused=prop_overused, dyn_enabled=dyn_enabled,
+        pipe_enabled=pipe_enabled, max_rounds=max_rounds,
+        compact_bucket=compact_bucket, gang_enabled=gang_enabled)
+
+
+def solve_batched(device, inputs, max_rounds: int = 0,
+                  compact_bucket=None):
+    """Drive the round loop.  ``device`` is a solver.DeviceSession (its
+    capacity arrays are committed on return); ``inputs`` a CycleInputs
+    (actions/cycle_inputs.py).  Returns (task_state, task_node, task_seq)
+    as numpy plus the round count.  ``compact_bucket``: None = auto-size
+    the post-round-0 compaction (tests pass 0 to force the full-width
+    loop for equivalence checks)."""
+    t_pad = inputs.task_valid.shape[0]
+    if max_rounds <= 0:
+        # every productive round places >= 1 task or fails >= 1 job; the
+        # bound is a safety net, not the expected round count
+        max_rounds = int(t_pad) + 8
+    task_pair, pair_sig, pair_nz, _ = inputs.pair_terms()
+    extra = {"task_pair": task_pair, "pair_sig": pair_sig,
+             "pair_nz": pair_nz}
+    buf_f, lay_f, buf_i, lay_i, buf_b, lay_b = pack_inputs(
+        lambda n: extra[n] if n in extra else getattr(inputs, n),
+        _PACK_F32, _PACK_I32, _PACK_BOOL)
+
+    start = time.perf_counter()
+    # compact continuation pays off once the [T,N] matrices dwarf the
+    # straggler count; below ~2k tasks the full-width rounds are cheap
+    if compact_bucket is None:
+        compact = max(256, t_pad // 8) if t_pad >= 2048 else 0
+    else:
+        compact = compact_bucket
+    with solver_trace("batched_allocate"):
+        final, packed = _batched_packed(
+            buf_f, buf_i, buf_b,
+            device.idle, device.releasing, device.n_tasks, device.nz_req,
+            device.backfilled, device.allocatable_cm, device.max_task_num,
+            device.node_ok,
+            lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
+            job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+            prop_overused=inputs.prop_overused,
+            pipe_enabled=inputs.pipe_enabled,
+            dyn_enabled=inputs.dyn_enabled,
+            max_rounds=min(max_rounds, 4096),
+            compact_bucket=compact,
+            gang_enabled=inputs.gang_enabled)
+        # ONE blocking transfer for everything the host needs; it stays
+        # inside the trace so a one-shot capture includes the device
+        # execution, not just the async dispatch
+        out = np.asarray(packed)
+        task_state = out[:t_pad]
+        task_node = out[t_pad:2 * t_pad]
+        task_seq = out[2 * t_pad:3 * t_pad]
+        rounds = out[3 * t_pad]
+
+    device.idle = final.idle
+    device.releasing = final.releasing
+    device.n_tasks = final.n_tasks
+    device.nz_req = final.nz_req
+    update_solver_kernel_duration("batched_allocate",
+                                  time.perf_counter() - start)
+    return task_state, task_node, task_seq, int(rounds)

@@ -1,0 +1,205 @@
+"""Multi-chip batched allocate — the round solver's node axis over a mesh.
+
+The production throughput engine (kernels/batched.py) is already pure
+tensor ops with a node axis everywhere the data is big: the [T, N] fit
+and score matrices, the [N, R] capacity carry, the sig-indexed [S, N]
+predicate rows. This module runs THE SAME round loop partitioned over a
+``jax.sharding.Mesh`` axis ``"nodes"`` via GSPMD: node-axis arrays are
+placed with ``NamedSharding(P(..., "nodes"))``, task/job/queue arrays are
+replicated, and XLA's SPMD partitioner inserts the collectives (psum for
+the per-task any-eligible and acceptance reductions, all-gathers for the
+global waterfall order) — the scaling-book recipe: pick a mesh, annotate
+shardings, let the compiler place the communication on ICI.
+
+Numerics: identical operations to the single-chip engine; the only
+tolerated divergence is floating-point reduction order inside segment
+sums, which sits far below the resource epsilons. The equivalence test
+(tests/test_sharded_batched.py) pins decisions, not carry bits.
+
+Reached from the action layer via KUBEBATCH_SOLVER=sharded (or
+AllocateAction(mode="sharded")) when more than one device is visible;
+single-device processes fall back to the plain batched engine.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .batched import RoundState, CycleArrays, _IMAX, batched_allocate
+from .fused import SKIP
+
+AXIS = "nodes"
+HOST_AXIS = "hosts"
+
+
+def node_mesh(n_devices: Optional[int] = None,
+              n_hosts: int = 1) -> Mesh:
+    """A mesh over the local devices with the node axis partitioned.
+
+    ``n_hosts > 1`` builds the hierarchical 2-D mesh of the multi-host
+    recipe (docs/SCALING.md "Multi-host (DCN)" step 4): axis ``"hosts"``
+    over host groups (DCN) x ``"nodes"`` within a host (ICI); the node
+    dimension of every sharded array is then split over BOTH axes, so
+    the waterfall's all-gather becomes hierarchical — XLA inserts the
+    ICI-then-DCN pattern from the same annotations."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    if n_hosts > 1:
+        if len(devs) % n_hosts:
+            raise ValueError(f"{len(devs)} devices do not split over "
+                             f"{n_hosts} hosts")
+        return Mesh(np.array(devs).reshape(n_hosts, -1), (HOST_AXIS, AXIS))
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def _specs_for(mesh: Mesh):
+    """(array_specs, state_specs) for the mesh: the node dimension is
+    split over every mesh axis — ``("nodes",)`` on a 1-D mesh,
+    ``("hosts", "nodes")`` hierarchically on the 2-D multi-host mesh."""
+    na = (tuple(mesh.axis_names) if len(mesh.axis_names) > 1
+          else AXIS)
+    array_specs = dict(
+        backfilled=P(na, None), allocatable_cm=P(na, None),
+        max_task_num=P(na), node_ok=P(na),
+        resreq=P(), init_resreq=P(), task_nz=P(), task_job=P(),
+        task_rank=P(), task_sig=P(), task_pair=P(), task_valid=P(),
+        sig_scores=P(None, na), sig_pred=P(None, na),
+        pair_sig=P(), pair_nz=P(),
+        order_min_available=P(), job_queue=P(), job_priority=P(),
+        job_create_rank=P(), job_valid=P(),
+        q_deserved=P(), q_create_rank=P(), cluster_total=P(),
+        dyn_weights=P())
+    state_specs = dict(
+        idle=P(na, None), releasing=P(na, None), n_tasks=P(na),
+        nz_req=P(na, None), q_allocated=P(), j_allocated=P(),
+        alloc_cnt=P(), job_alive=P(), task_state=P(), task_node=P(),
+        task_seq=P())
+    return array_specs, state_specs
+
+
+
+
+@partial(jax.jit, static_argnames=("job_keys", "queue_keys", "prop_overused",
+                                   "dyn_enabled", "pipe_enabled",
+                                   "max_rounds"))
+def _sharded_entry(state: RoundState, arrays: CycleArrays, job_keys,
+                   queue_keys, prop_overused, dyn_enabled, pipe_enabled,
+                   max_rounds):
+    final, rounds = batched_allocate(
+        state, arrays, job_keys=job_keys, queue_keys=queue_keys,
+        prop_overused=prop_overused, dyn_enabled=dyn_enabled,
+        pipe_enabled=pipe_enabled, max_rounds=max_rounds,
+        compact_bucket=0)   # compaction gathers are counterproductive SPMD
+    return final, jnp.concatenate(
+        [final.task_state, final.task_node, final.task_seq,
+         rounds.astype(jnp.int32)[None]])
+
+
+def _pad_nodes(a: np.ndarray, n_pad: int) -> np.ndarray:
+    if a.shape[0] == n_pad:
+        return a
+    pad = [(0, n_pad - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad)
+
+
+def shard_bucket(n: int, n_devices: int, minimum: int = 8) -> int:
+    """Node bucket: pow2 like tensorize.pad_to_bucket, then rounded up to
+    the next multiple of the mesh size so every shard gets equal rows
+    (a 6- or 12-device mesh is not a power of two)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    if b % n_devices:
+        b = -(-b // n_devices) * n_devices
+    return b
+
+
+def solve_batched_sharded(mesh: Mesh, device, inputs,
+                          max_rounds: int = 0) -> Tuple[np.ndarray, ...]:
+    """Sharded twin of kernels/batched.solve_batched: same CycleInputs in,
+    same (task_state, task_node, task_seq, rounds) out, with the node axis
+    of every big array partitioned over ``mesh``.
+
+    ``device`` is the session's DeviceSession — its committed numpy-backed
+    state provides the capacity carry; the updated carry is written back
+    so later actions observe the same node accounting as the single-chip
+    path.
+    """
+    import time
+
+    from ..metrics import solver_trace, update_solver_kernel_duration
+
+    n_dev = mesh.devices.size
+    n_pad = device.n_padded
+    n_sh = shard_bucket(n_pad, n_dev)
+    t_pad = inputs.task_valid.shape[0]
+    if max_rounds <= 0:
+        max_rounds = int(t_pad) + 8
+
+    task_pair, pair_sig, pair_nz, _ = inputs.pair_terms()
+
+    def nodes_np(x):
+        return _pad_nodes(np.asarray(x), n_sh)
+
+    arrays = CycleArrays(
+        backfilled=nodes_np(device.backfilled),
+        allocatable_cm=nodes_np(device.allocatable_cm),
+        max_task_num=nodes_np(device.max_task_num),
+        node_ok=nodes_np(device.node_ok),
+        resreq=inputs.resreq, init_resreq=inputs.init_resreq,
+        task_nz=inputs.task_nz, task_job=inputs.task_job,
+        task_rank=inputs.task_rank, task_sig=inputs.task_sig,
+        task_pair=task_pair, task_valid=inputs.task_valid,
+        sig_scores=_pad_nodes(inputs.sig_scores.T, n_sh).T,
+        sig_pred=_pad_nodes(inputs.sig_pred.T, n_sh).T,
+        pair_sig=pair_sig, pair_nz=pair_nz,
+        order_min_available=inputs.order_min_available,
+        job_queue=inputs.job_queue, job_priority=inputs.job_priority,
+        job_create_rank=inputs.job_create_rank, job_valid=inputs.job_valid,
+        q_deserved=inputs.q_deserved, q_create_rank=inputs.q_create_rank,
+        cluster_total=inputs.cluster_total, dyn_weights=inputs.dyn_weights)
+    state = RoundState(
+        idle=nodes_np(device.idle), releasing=nodes_np(device.releasing),
+        n_tasks=nodes_np(device.n_tasks), nz_req=nodes_np(device.nz_req),
+        q_allocated=inputs.q_alloc0, j_allocated=inputs.j_alloc0,
+        alloc_cnt=inputs.init_allocated, job_alive=inputs.job_valid,
+        task_state=np.full(t_pad, SKIP, np.int32),
+        task_node=np.full(t_pad, -1, np.int32),
+        task_seq=np.full(t_pad, _IMAX, np.int32))
+
+    def put(tree, specs):
+        return type(tree)(**{
+            k: jax.device_put(getattr(tree, k), NamedSharding(mesh, s))
+            for k, s in specs.items()})
+
+    array_specs, state_specs = _specs_for(mesh)
+    start = time.perf_counter()
+    with solver_trace("batched_allocate_sharded"):
+        final, packed = _sharded_entry(
+            put(state, state_specs), put(arrays, array_specs),
+            job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+            prop_overused=inputs.prop_overused,
+            dyn_enabled=inputs.dyn_enabled,
+            pipe_enabled=inputs.pipe_enabled,
+            max_rounds=min(max_rounds, 4096))
+        out = np.asarray(packed)
+    task_state = out[:t_pad]
+    task_node = out[t_pad:2 * t_pad]
+    task_seq = out[2 * t_pad:3 * t_pad]
+    rounds = out[3 * t_pad]
+
+    # commit the carry back to the session's device state (trimmed to the
+    # single-chip bucket) so later actions see the updated accounting
+    device.idle = jnp.asarray(np.asarray(final.idle)[:n_pad])
+    device.releasing = jnp.asarray(np.asarray(final.releasing)[:n_pad])
+    device.n_tasks = jnp.asarray(np.asarray(final.n_tasks)[:n_pad])
+    device.nz_req = jnp.asarray(np.asarray(final.nz_req)[:n_pad])
+    update_solver_kernel_duration("batched_allocate_sharded",
+                                  time.perf_counter() - start)
+    return task_state, task_node, task_seq, int(rounds)

@@ -1,0 +1,297 @@
+"""Fused allocate cycle — the whole action as ONE device dispatch.
+
+Motivation (measured): each host<->device transfer through the axon tunnel
+costs ~7 ms, so the per-job-visit solver (solver.py) pays ~20 ms of
+transfer per visit — 100 visits = seconds. This kernel runs the ENTIRE
+allocate control flow of actions/allocate/allocate.go inside a single
+lax.while_loop: queue selection (proportion shares + overused drops), job
+selection (priority / gang ready-last / DRF dominant share, lexicographic
+per the configured tier order), task order, the node predicate/score/fit
+solve, and all fairness-state updates — one upload of the cycle's tensors,
+one download of the decisions.
+
+Known deliberate divergence: queue and job order keys are recomputed from
+LIVE fairness shares at every pop. The reference's container/heap (and the
+host PriorityQueue) evaluate the comparison at sift time, so a stale root
+can be popped after shares changed — an implementation artifact, not a
+policy; under contention the two can visit equal-share queues in different
+orders. The kernel's fresh evaluation is the stricter reading of
+proportional fairness.
+
+Faithfulness contract (equivalence-tested against the host oracle):
+- queue entries: one per job; an overused or job-less queue pop consumes
+  an entry (allocate.go:69-87); visits re-push implicitly.
+- one job per visit; tasks in task-order until a task fails (job dropped),
+  tasks exhaust (job dropped), or the job crosses gang readiness (job
+  stays queued; one task per visit thereafter) — allocate.go:110-196.
+- every assignment kind (Allocated / AllocatedOverBackfill / Pipelined)
+  fires the fairness updates (proportion + DRF add Resreq on AllocateFunc,
+  session.go:278-284) but only plain Allocated advances gang readiness
+  (api/types.go:82-84).
+- shares: proportion share = max_r allocated/deserved; DRF share =
+  max_r allocated/total; 0/0 -> 0, x/0 -> 1 (api/helpers/helpers.go).
+
+Job/queue order-key composition is baked per config (static argnums):
+``job_keys`` / ``queue_keys`` are tuples naming the comparison terms in
+dispatch order; the final tie-break (creation rank) is always appended.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .tensorize import VEC_EPS
+
+SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
+
+# job-order key ids
+K_PRIORITY = "priority"        # static job priority, desc
+K_GANG_READY = "gang_ready"    # not-ready before ready
+K_DRF_SHARE = "drf_share"      # lower dominant share first
+# queue-order key ids
+K_PROP_SHARE = "prop_share"    # lower proportion share first
+
+_BIG = jnp.float32(3.0e38)
+
+
+def _share(alloc, denom):
+    """max over the resource axis of alloc/denom with 0/0->0, x/0->1."""
+    frac = jnp.where(denom == 0,
+                     jnp.where(alloc == 0, 0.0, 1.0),
+                     alloc / jnp.maximum(denom, 1e-30))
+    return jnp.max(frac, axis=-1)
+
+
+def _lex_argmin(keys, valid):
+    """Index of the lexicographically-smallest row among valid ones; -1 if
+    none. keys: list of [M] float arrays, most-significant first."""
+    mask = valid
+    for k in keys:
+        kmin = jnp.min(jnp.where(mask, k, _BIG))
+        mask = mask & (k == kmin)
+    idx = jnp.argmax(mask)
+    return jnp.where(jnp.any(mask), idx, -1)
+
+
+def unpack_host_block(host_block):
+    """Decode fused_allocate's packed host block into
+    (task_state, task_node, task_seq, iters). Counterpart of the encoding
+    at the bottom of fused_allocate — keep the two in sync."""
+    task_state, task_node, task_seq = host_block[:, :-1]
+    return task_state, task_node, task_seq, host_block[0, -1]
+
+
+class FusedState(NamedTuple):
+    idle: jnp.ndarray          # [N,R]
+    releasing: jnp.ndarray     # [N,R]
+    n_tasks: jnp.ndarray       # [N]
+    nz_req: jnp.ndarray        # [N,2] nonzero (cpu,mem) request sums
+    entries: jnp.ndarray       # [Q] remaining queue entries
+    q_allocated: jnp.ndarray   # [Q,R] proportion allocated
+    j_allocated: jnp.ndarray   # [J,R] drf allocated
+    alloc_cnt: jnp.ndarray     # [J] allocated-family count (readiness)
+    job_in_pq: jnp.ndarray     # [J] bool
+    task_state: jnp.ndarray    # [T] decision codes (SKIP=still pending)
+    task_node: jnp.ndarray     # [T]
+    task_seq: jnp.ndarray      # [T] global application order
+    current_job: jnp.ndarray   # scalar i32, -1 = none
+    seq: jnp.ndarray           # scalar i32
+    it: jnp.ndarray            # scalar i32
+
+
+@partial(jax.jit, static_argnames=("job_keys", "queue_keys", "gang_enabled",
+                                   "prop_overused", "dyn_enabled",
+                                   "max_iters"))
+def fused_allocate(
+        # nodes
+        idle, releasing, backfilled, allocatable_cm, nz_req0, max_task_num,
+        n_tasks, node_ok,
+        # tasks; sig_scores/sig_pred are [S,N] rows indexed by task_sig[T]
+        # (pods sharing a template share a row — the upload stays small at
+        # 10k x 5k scale)
+        resreq, init_resreq, task_nz, task_job, task_rank, task_sig,
+        task_valid, sig_scores, sig_pred,
+        # jobs; min_available gates readiness/dispatch (zeroed when the
+        # configured job-ready fn is disabled), order_min_available feeds
+        # the gang ready-last ORDER key (always the true MinAvailable)
+        min_available, order_min_available, init_allocated, job_queue,
+        job_priority, job_create_rank, job_valid,
+        # queues
+        q_weight, q_entries, q_create_rank, q_deserved, q_alloc0,
+        # drf
+        j_alloc0, cluster_total,
+        # dynamic nodeorder terms: [least_requested_w, balanced_resource_w]
+        dyn_weights=None,
+        # static config
+        job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY, K_DRF_SHARE),
+        queue_keys: Tuple[str, ...] = (K_PROP_SHARE,),
+        gang_enabled: bool = True,
+        prop_overused: bool = True,
+        dyn_enabled: bool = False,
+        max_iters: int = 0):
+    from .solver import dynamic_node_score
+    if dyn_weights is None:
+        dyn_weights = jnp.zeros(2, jnp.float32)
+    eps = jnp.asarray(VEC_EPS)
+    n_nodes = idle.shape[0]
+    n_jobs = min_available.shape[0]
+    n_queues = q_weight.shape[0]
+
+    def body(s: FusedState) -> FusedState:
+        # ---- queue + job selection (only when no active visit) ----------
+        qkeys = []
+        for k in queue_keys:
+            if k == K_PROP_SHARE:
+                qkeys.append(_share(s.q_allocated, q_deserved))
+        qkeys.append(q_create_rank.astype(jnp.float32))
+        q_star = _lex_argmin(qkeys, s.entries > 0)
+        have_q = q_star >= 0
+        qi = jnp.maximum(q_star, 0)
+
+        if prop_overused:
+            overused = jnp.all(q_deserved[qi] < s.q_allocated[qi] + eps)
+        else:
+            overused = jnp.asarray(False)
+
+        job_sel_valid = (job_valid & s.job_in_pq & (job_queue == qi)
+                         & have_q & ~overused)
+        jkeys = []
+        for k in job_keys:
+            if k == K_PRIORITY:
+                jkeys.append(-job_priority.astype(jnp.float32))
+            elif k == K_GANG_READY:
+                ready = (s.alloc_cnt >= order_min_available).astype(
+                    jnp.float32)
+                jkeys.append(ready)  # not-ready (0) before ready (1)
+            elif k == K_DRF_SHARE:
+                jkeys.append(_share(s.j_allocated, cluster_total[None, :]))
+        jkeys.append(job_create_rank.astype(jnp.float32))
+        j_sel = _lex_argmin(jkeys, job_sel_valid)
+
+        resuming = s.current_job >= 0
+        j_star = jnp.where(resuming, s.current_job, j_sel)
+        have_job = j_star >= 0
+        ji = jnp.maximum(j_star, 0)
+
+        # an entry is consumed when the popped queue is overused or has no
+        # job to offer (and no visit is being resumed)
+        drop_entry = have_q & ~resuming & (overused | (j_sel < 0))
+        new_entries = jnp.where(
+            drop_entry,
+            s.entries.at[qi].add(-1),
+            s.entries)
+
+        # ---- task selection ---------------------------------------------
+        task_sel_valid = (task_valid & (s.task_state == SKIP)
+                          & (task_job == ji) & have_job)
+        t_star = _lex_argmin([task_rank.astype(jnp.float32)], task_sel_valid)
+        have_task = t_star >= 0
+        ti = jnp.maximum(t_star, 0)
+        # job with no pending tasks left: dropped from its PQ
+        exhausted = have_job & ~have_task
+
+        # ---- node solve for t* ------------------------------------------
+        t_req = resreq[ti]
+        t_init = init_resreq[ti]
+        accessible = s.idle + backfilled
+        room = s.n_tasks < max_task_num
+        pred = node_ok & room & sig_pred[task_sig[ti]]
+        fit_alloc = jnp.all(t_init <= accessible + eps, axis=-1)
+        fit_idle = jnp.all(t_init <= s.idle + eps, axis=-1)
+        fit_pipe = jnp.all(t_init <= s.releasing + eps, axis=-1)
+        eligible = pred & (fit_alloc | fit_pipe)
+        score = sig_scores[task_sig[ti]]
+        if dyn_enabled:
+            score = score + dynamic_node_score(s.nz_req, task_nz[ti],
+                                               allocatable_cm, dyn_weights)
+        masked = jnp.where(eligible, score, -jnp.inf)
+        best = jnp.argmax(masked)
+        feasible = eligible[best] & have_task
+        is_alloc = fit_alloc[best]
+        over_backfill = is_alloc & ~fit_idle[best]
+
+        do = have_task & feasible
+        fail = have_task & ~feasible
+
+        decision = jnp.where(
+            ~is_alloc, PIPELINE,
+            jnp.where(over_backfill, ALLOC_OB, ALLOC))
+        new_task_state = jnp.where(
+            do, s.task_state.at[ti].set(decision),
+            jnp.where(fail, s.task_state.at[ti].set(FAIL), s.task_state))
+        new_task_node = jnp.where(do, s.task_node.at[ti].set(best),
+                                  s.task_node)
+        new_task_seq = jnp.where(do | fail, s.task_seq.at[ti].set(s.seq),
+                                 s.task_seq)
+
+        one_hot = (jnp.arange(n_nodes) == best) & do
+        take = jnp.where(one_hot[:, None], t_req[None, :], 0.0)
+        new_idle = s.idle - jnp.where(is_alloc, 1.0, 0.0) * take
+        new_releasing = s.releasing - jnp.where(is_alloc, 0.0, 1.0) * take
+        new_ntasks = s.n_tasks + one_hot.astype(jnp.int32)
+        new_nz = s.nz_req + jnp.where(one_hot[:, None],
+                                      task_nz[ti][None, :], 0.0)
+
+        # fairness updates fire for EVERY assignment kind; use the job's
+        # own queue (during a resumed visit qi is this iteration's argmin
+        # queue, not necessarily the visited job's)
+        jqi = job_queue[ji]
+        new_q_alloc = jnp.where(
+            do, s.q_allocated.at[jqi].add(t_req), s.q_allocated)
+        new_j_alloc = jnp.where(do, s.j_allocated.at[ji].add(t_req),
+                                s.j_allocated)
+        # pipelined-inclusive readiness (see kernels/solver.py)
+        counted = do & ~over_backfill
+        new_alloc_cnt = s.alloc_cnt.at[ji].add(jnp.where(counted, 1, 0))
+
+        # ---- visit lifecycle --------------------------------------------
+        if gang_enabled:
+            ready_after = new_alloc_cnt[ji] >= min_available[ji]
+        else:
+            ready_after = jnp.asarray(True)
+        visit_ends = fail | exhausted | (do & ready_after)
+        job_dropped = fail | exhausted
+        new_job_in_pq = jnp.where(
+            job_dropped & have_job,
+            s.job_in_pq.at[ji].set(False), s.job_in_pq)
+        new_current = jnp.where(
+            have_job & ~visit_ends, j_star, jnp.int32(-1))
+
+        return FusedState(
+            idle=new_idle, releasing=new_releasing, n_tasks=new_ntasks,
+            nz_req=new_nz, entries=new_entries, q_allocated=new_q_alloc,
+            j_allocated=new_j_alloc, alloc_cnt=new_alloc_cnt,
+            job_in_pq=new_job_in_pq, task_state=new_task_state,
+            task_node=new_task_node, task_seq=new_task_seq,
+            current_job=new_current.astype(jnp.int32),
+            seq=s.seq + jnp.where(do | fail, 1, 0), it=s.it + 1)
+
+    def cond(s: FusedState) -> jnp.ndarray:
+        return ((s.it < max_iters)
+                & (jnp.any(s.entries > 0) | (s.current_job >= 0)))
+
+    t = task_valid.shape[0]
+    init = FusedState(
+        idle=idle, releasing=releasing, n_tasks=n_tasks, nz_req=nz_req0,
+        entries=q_entries.astype(jnp.int32),
+        q_allocated=q_alloc0, j_allocated=j_alloc0,
+        alloc_cnt=init_allocated.astype(jnp.int32),
+        job_in_pq=job_valid,
+        task_state=jnp.full(t, SKIP, jnp.int32),
+        task_node=jnp.full(t, -1, jnp.int32),
+        task_seq=jnp.full(t, jnp.iinfo(jnp.int32).max, jnp.int32),
+        current_job=jnp.int32(-1), seq=jnp.int32(0), it=jnp.int32(0))
+    final = jax.lax.while_loop(cond, body, init)
+    # everything the host must read back travels in ONE int32 block —
+    # row 0 task_state, row 1 task_node, row 2 task_seq, and the iteration
+    # count in the extra trailing column — so applying the cycle's
+    # decisions costs a single device->host transfer (the axon tunnel
+    # charges a full round trip per blocking read)
+    host_block = jnp.concatenate(
+        [jnp.stack([final.task_state, final.task_node, final.task_seq]),
+         jnp.broadcast_to(final.it, (3, 1))], axis=1)
+    return (host_block, final.idle, final.releasing, final.n_tasks,
+            final.nz_req)

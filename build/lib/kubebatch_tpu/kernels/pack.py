@@ -1,0 +1,49 @@
+"""Host->device input packing.
+
+Through a high-latency link (the axon tunnel charges ~70 ms per
+transfer), per-cycle upload cost is dominated by TRANSFER COUNT, not
+bytes: ~20 individual device_puts cost more than one concatenated
+buffer. Solvers pack their per-cycle inputs into one flat buffer per
+dtype class plus a static layout tuple; the jitted entry slices the
+buffers back into arrays at trace time (free for XLA — static offsets).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["pack", "unpack", "pack_inputs"]
+
+
+def pack_inputs(get, f32_names, i32_names, bool_names):
+    """Pack one buffer per dtype class. ``get(name)`` resolves an array;
+    returns (buf_f, lay_f, buf_i, lay_i, buf_b, lay_b)."""
+    buf_f, lay_f = pack([(n, get(n)) for n in f32_names], np.float32)
+    buf_i, lay_i = pack([(n, get(n)) for n in i32_names], np.int32)
+    buf_b, lay_b = pack([(n, get(n)) for n in bool_names], np.bool_)
+    return buf_f, lay_f, buf_i, lay_i, buf_b, lay_b
+
+
+def pack(values, dtype):
+    """Concatenate (name, array) pairs into one flat buffer + a static
+    (hashable) layout tuple of (name, offset, shape)."""
+    layout = []
+    flats = []
+    off = 0
+    for name, arr in values:
+        arr = np.asarray(arr)
+        layout.append((name, off, tuple(arr.shape)))
+        flats.append(arr.ravel().astype(dtype, copy=False))
+        off += arr.size
+    buf = np.concatenate(flats) if flats else np.zeros(0, dtype)
+    return buf, tuple(layout)
+
+
+def unpack(buf, layout):
+    """Slice a packed buffer back into named arrays (inside jit; offsets
+    and shapes are static)."""
+    out = {}
+    for name, off, shape in layout:
+        size = int(np.prod(shape)) if shape else 1
+        arr = buf[off:off + size]
+        out[name] = arr.reshape(shape) if shape else arr[0]
+    return out

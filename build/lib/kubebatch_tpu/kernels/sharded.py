@@ -1,0 +1,120 @@
+"""Multi-chip allocate solver — the node axis sharded over a device mesh.
+
+Cluster size is this framework's scale axis (SURVEY.md sect. 5 "long
+context"): when nodes x resources no longer fits one chip's working set —
+or one chip's compute budget — the capacity carry (idle/releasing/
+backfilled, [N,R]) is sharded over the ``nodes`` mesh axis with
+``shard_map``. Each scan step computes predicate/score/fit for its local
+node block, all-gathers one packed [N_local, 5] row per device (score +
+fit bits) over ICI, makes the identical argmax selection on every device,
+and only the winning shard updates its local carry. One all-gather per
+task step is the only collective — it rides ICI, never DCN, and XLA
+overlaps it with the local elementwise work.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .tensorize import VEC_EPS
+
+SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
+AXIS = "nodes"
+
+
+def _sharded_scan_body(backfilled, max_task_num, node_ok, min_available):
+    """Returns the per-task scan step closed over static-per-visit arrays
+    (all already sharded on the node axis by shard_map)."""
+    eps = jnp.asarray(VEC_EPS)
+    n_local = node_ok.shape[0]
+    shard = jax.lax.axis_index(AXIS)
+
+    def step(carry, t):
+        idle, releasing, n_tasks, allocated, done = carry
+        resreq, init_resreq, valid, score, pred = t
+        accessible = idle + backfilled
+        room = n_tasks < max_task_num
+        p = node_ok & room & pred
+        fit_alloc = jnp.all(init_resreq <= accessible + eps, axis=-1)
+        fit_idle = jnp.all(init_resreq <= idle + eps, axis=-1)
+        fit_pipe = jnp.all(init_resreq <= releasing + eps, axis=-1)
+        eligible = p & (fit_alloc | fit_pipe)
+        masked = jnp.where(eligible, score, -jnp.inf)
+        # pack score + fit bits, gather the full node axis over ICI
+        packed_local = jnp.stack(
+            [masked, fit_alloc.astype(jnp.float32),
+             fit_idle.astype(jnp.float32), fit_pipe.astype(jnp.float32),
+             eligible.astype(jnp.float32)], axis=-1)            # [Nl, 5]
+        packed = jax.lax.all_gather(packed_local, AXIS, tiled=True)  # [N, 5]
+        best = jnp.argmax(packed[:, 0])
+        feasible = packed[best, 4] > 0
+        is_alloc = packed[best, 1] > 0
+        over_backfill = is_alloc & ~(packed[best, 2] > 0)
+
+        active = valid & ~done
+        do = active & feasible
+        decision = jnp.where(
+            ~active, SKIP,
+            jnp.where(~feasible, FAIL,
+                      jnp.where(~is_alloc, PIPELINE,
+                                jnp.where(over_backfill, ALLOC_OB, ALLOC))))
+
+        # only the shard owning `best` updates its carry
+        local_best = best - shard * n_local
+        mine = (local_best >= 0) & (local_best < n_local)
+        one_hot = ((jnp.arange(n_local) == local_best) & mine & do)
+        take = jnp.where(one_hot[:, None], resreq[None, :], 0.0)
+        idle = idle - jnp.where(is_alloc, 1.0, 0.0) * take
+        releasing = releasing - jnp.where(is_alloc, 0.0, 1.0) * take
+        n_tasks = n_tasks + one_hot.astype(jnp.int32)
+
+        # pipelined-inclusive readiness (see kernels/solver.py)
+        allocated = allocated + jnp.where(do & ~over_backfill, 1, 0)
+        done = done | (active & ~feasible) | (do & (allocated >= min_available))
+        return ((idle, releasing, n_tasks, allocated, done),
+                (decision.astype(jnp.int32), best.astype(jnp.int32)))
+
+    return step
+
+
+def build_sharded_allocate(mesh: Mesh):
+    """Compile the allocate scan with the node axis sharded over `mesh`.
+
+    Array placement: node-axis arrays P('nodes', ...), task arrays and
+    scalars replicated, scores/pred [T, N] sharded on the node column.
+    """
+    node2 = P(AXIS, None)
+    node1 = P(AXIS)
+    rep = P()
+    tn = P(None, AXIS)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(node2, node2, node2, node1, node1, node1,
+                       rep, rep, rep, tn, tn, rep, rep),
+             out_specs=(rep, rep, node2, node2, node1, rep),
+             check_vma=False)
+    def run(idle, releasing, backfilled, max_task_num, n_tasks, node_ok,
+            resreq, init_resreq, task_valid, scores, pred_mask,
+            min_available, init_allocated):
+        step = _sharded_scan_body(backfilled, max_task_num, node_ok,
+                                  min_available)
+        init = (idle, releasing, n_tasks,
+                jnp.asarray(init_allocated, jnp.int32), jnp.asarray(False))
+        # scores/pred arrive [T, N_local]; transpose per-step rows
+        (idle_f, rel_f, ntasks_f, allocated_f, _), (decisions, node_idx) = \
+            jax.lax.scan(step, init, (resreq, init_resreq, task_valid,
+                                      scores, pred_mask))
+        became_ready = allocated_f >= min_available
+        return decisions, node_idx, idle_f, rel_f, ntasks_f, became_ready
+
+    return jax.jit(run)
+
+
+def demo_mesh(n_devices: int) -> Mesh:
+    devs = np.array(jax.devices()[:n_devices])
+    return Mesh(devs, (AXIS,))

@@ -1,0 +1,340 @@
+"""The allocate solver — a capacity-carrying assignment scan on TPU.
+
+Replaces the reference's O(tasks x nodes x plugins) per-pair loops
+(actions/allocate/allocate.go:128-186) with ONE jitted lax.scan per job
+visit: for each task (in task-order) the scan computes the predicate mask
+and score over ALL nodes at once, selects the best feasible node, and
+updates the idle/releasing capacity carry before the next task — preserving
+the reference's sequential-greedy semantics while amortizing device
+dispatch over the whole job.
+
+Decision codes (host applies them through Session.allocate/pipeline so all
+plugin event handlers and the gang dispatch barrier still fire):
+
+  0 SKIP      task not processed (job became ready first — reference
+              re-pushes the job and handles the rest next visit)
+  1 ALLOC     init_resreq fits node idle -> Allocated
+  2 ALLOC_OB  fits idle+backfilled but not idle -> AllocatedOverBackfill
+              (fork feature, allocate.go:157)
+  3 PIPELINE  fits releasing -> Pipelined onto releasing resources
+  4 FAIL      no feasible node -> job dropped this cycle (allocate.go:187)
+
+Fit rules mirror allocate.go:153-184: a node is feasible if the launch
+request fits accessible (idle+backfilled) OR releasing; the highest-scoring
+feasible node wins (ties -> lowest node index; the reference's tie order is
+Go map iteration, i.e. unspecified); the fit kind is then read off that
+node. Readiness crossing counts only ALLOC decisions — AllocatedOverBackfill
+and Pipelined don't advance gang readiness (api/types.go:82-84).
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import NodeInfo
+from ..metrics import update_solver_kernel_duration, update_tensorize_duration
+from .tensorize import VEC_EPS, NodeState, TaskBatch, pad_to_bucket
+
+SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
+
+
+class _Carry(NamedTuple):
+    idle: jnp.ndarray        # [N,R]
+    releasing: jnp.ndarray   # [N,R]
+    n_tasks: jnp.ndarray     # [N]
+    nz_req: jnp.ndarray      # [N,2] nonzero (cpu,mem) request sums
+    allocated: jnp.ndarray   # scalar i32: ALLOC count so far (incl. initial)
+    done: jnp.ndarray        # scalar bool
+
+
+class _TaskIn(NamedTuple):
+    resreq: jnp.ndarray       # [R]
+    init_resreq: jnp.ndarray  # [R]
+    nz: jnp.ndarray           # [2] nonzero (cpu,mem) request
+    valid: jnp.ndarray        # scalar bool
+    score: jnp.ndarray        # [N]
+    pred: jnp.ndarray         # [N] per-task predicate mask
+
+
+def dynamic_node_score(nz_req, t_nz, allocatable_cm, dyn_weights, xp=jnp):
+    """nodeorder's allocation-dependent terms, from the capacity carry.
+
+    Mirrors plugins/nodeorder.py least_requested_score /
+    balanced_resource_score (upstream k8s-1.13 arithmetic) over all nodes
+    at once. The Go integer division ``((cap - req) * 10) // cap`` is
+    evaluated as a threshold count (how many d in 1..10 satisfy
+    (cap-req)*10 >= d*cap) — division-free, so float32 rounding can only
+    bite when a product pair is genuinely within f32 ulp of equal.
+    dyn_weights: [least_requested_w, balanced_resource_w] float32.
+
+    ``xp`` selects the array module: jnp inside the jitted kernels, np
+    for the wave chooser's host-side fresh-score recompute
+    (kernels/victims.py) — ONE implementation so the two can never
+    drift; every scalar is pinned to float32 so numpy matches the
+    kernel's weak-typed float32 arithmetic bit for bit.
+    """
+    f32 = xp.float32
+    ten = f32(10.0)
+    req = nz_req + t_nz[None, :]                      # [N,2]
+    cap = allocatable_cm                              # [N,2]
+    d = xp.arange(1.0, 11.0, dtype=f32)               # [10]
+    ge = ((cap - req)[None] * ten >= d[:, None, None] * cap[None])
+    dim = xp.where((cap > 0) & (req <= cap),
+                   ge.sum(axis=0).astype(f32), f32(0.0))   # [N,2]
+    least = xp.floor((dim[:, 0] + dim[:, 1]) / f32(2.0))
+
+    frac = xp.where(cap > 0, req / xp.where(cap > 0, cap, f32(1.0)),
+                    f32(1.0))
+    diff = xp.abs(frac[:, 0] - frac[:, 1])
+    balanced = xp.where((frac[:, 0] >= 1.0) | (frac[:, 1] >= 1.0),
+                        f32(0.0), xp.trunc(ten - diff * ten))
+    return least * dyn_weights[0] + balanced * dyn_weights[1]
+
+
+@partial(jax.jit, static_argnames=("dyn_enabled",))
+def _allocate_scan(idle, releasing, backfilled, allocatable_cm, nz_req,
+                   max_task_num, n_tasks, node_ok, resreq, init_resreq,
+                   task_nz, task_valid, scores, pred_mask, min_available,
+                   init_allocated, dyn_weights, dyn_enabled: bool = False):
+    """One job visit. Shapes: nodes [N,R]/[N,2]/[N]; tasks [T,R]/[T,2]/[T];
+    scores and pred_mask [T,N]. Returns (decisions[T], node_idx[T],
+    new_idle, new_releasing, new_n_tasks, new_nz_req, became_ready)."""
+    eps = jnp.asarray(VEC_EPS)
+
+    def step(carry: _Carry, t: _TaskIn):
+        accessible = carry.idle + backfilled
+        room = carry.n_tasks < max_task_num
+        pred = node_ok & room & t.pred
+        fit_alloc = jnp.all(t.init_resreq <= accessible + eps, axis=-1)
+        fit_idle = jnp.all(t.init_resreq <= carry.idle + eps, axis=-1)
+        fit_pipe = jnp.all(t.init_resreq <= carry.releasing + eps, axis=-1)
+        eligible = pred & (fit_alloc | fit_pipe)
+        score = t.score
+        if dyn_enabled:
+            score = score + dynamic_node_score(carry.nz_req, t.nz,
+                                               allocatable_cm, dyn_weights)
+        masked_score = jnp.where(eligible, score, -jnp.inf)
+        best = jnp.argmax(masked_score)
+        feasible = eligible[best]
+
+        is_alloc = fit_alloc[best]
+        over_backfill = is_alloc & ~fit_idle[best]
+        active = t.valid & ~carry.done
+        do = active & feasible
+
+        decision = jnp.where(
+            ~active, SKIP,
+            jnp.where(~feasible, FAIL,
+                      jnp.where(~is_alloc, PIPELINE,
+                                jnp.where(over_backfill, ALLOC_OB, ALLOC))))
+
+        take = jnp.where(do, t.resreq, jnp.zeros_like(t.resreq))
+        one_hot = (jnp.arange(carry.idle.shape[0]) == best)
+        alloc_take = jnp.where(is_alloc, 1.0, 0.0) * take
+        pipe_take = jnp.where(is_alloc, 0.0, 1.0) * take
+        new_idle = carry.idle - one_hot[:, None] * alloc_take[None, :]
+        new_rel = carry.releasing - one_hot[:, None] * pipe_take[None, :]
+        new_ntasks = carry.n_tasks + (one_hot & do).astype(jnp.int32)
+        # every assignment kind lands in node.tasks host-side, so each one
+        # feeds the nonzero-request sums the dynamic scores read
+        new_nz = carry.nz_req + jnp.where(
+            do, one_hot[:, None] * t.nz[None, :], 0.0)
+
+        # readiness counts plain Allocated AND Pipelined (gang's
+        # pipelined-inclusive ready_task_num); only AllocatedOverBackfill
+        # stays outside the quorum
+        new_allocated = carry.allocated + jnp.where(do & ~over_backfill, 1, 0)
+        ready_now = new_allocated >= min_available
+        # stop after the assignment that crossed readiness, or on failure
+        new_done = carry.done | (active & ~feasible) | (do & ready_now)
+
+        out = (decision.astype(jnp.int32), best.astype(jnp.int32))
+        return _Carry(new_idle, new_rel, new_ntasks, new_nz, new_allocated,
+                      new_done), out
+
+    init = _Carry(idle, releasing, n_tasks, nz_req,
+                  jnp.asarray(init_allocated, jnp.int32),
+                  jnp.asarray(False))
+    tasks = _TaskIn(resreq, init_resreq, task_nz, task_valid, scores,
+                    pred_mask)
+    final, (decisions, node_idx) = jax.lax.scan(step, init, tasks)
+    became_ready = final.allocated >= min_available
+    return (decisions, node_idx, final.idle, final.releasing, final.n_tasks,
+            final.nz_req, became_ready)
+
+
+class Decision(NamedTuple):
+    kind: int
+    node_name: str
+
+
+@partial(jax.jit, donate_argnums=tuple(range(8)))
+def _scatter_rows(idle, releasing, backfilled, alloc_cm, nz_req, n_tasks,
+                  max_task_num, node_ok, jidx, r_idle, r_rel, r_back, r_cm,
+                  r_nz, r_nt, r_mt, r_ok):
+    """All eight dirty-row scatters in ONE compiled dispatch (they were
+    eight eager ops; per-op dispatch dominated the steady reclaim phase).
+    Donation reuses the old buffers in place."""
+    return (idle.at[jidx].set(r_idle),
+            releasing.at[jidx].set(r_rel),
+            backfilled.at[jidx].set(r_back),
+            alloc_cm.at[jidx].set(r_cm),
+            nz_req.at[jidx].set(r_nz),
+            n_tasks.at[jidx].set(r_nt),
+            max_task_num.at[jidx].set(r_mt),
+            node_ok.at[jidx].set(r_ok))
+
+
+class DeviceSession:
+    """Per-session device state: node arrays uploaded once, carried across
+    job visits, and kept in lock-step with the host Session's NodeInfo maps
+    (the host applies exactly the decisions the kernel produced)."""
+
+    def __init__(self, nodes: Dict[str, NodeInfo], min_bucket: int = 8):
+        start = time.perf_counter()
+        self.state = NodeState.from_nodes(nodes, min_bucket)
+        self.idle = jnp.asarray(self.state.idle)
+        self.releasing = jnp.asarray(self.state.releasing)
+        self.backfilled = jnp.asarray(self.state.backfilled)
+        self.allocatable_cm = jnp.asarray(self.state.allocatable[:, :2])
+        self.nz_req = jnp.asarray(self.state.nz_requested)
+        self.n_tasks = jnp.asarray(self.state.n_tasks)
+        self.max_task_num = jnp.asarray(self.state.max_task_num)
+        self.node_ok = jnp.asarray(self.state.schedulable & self.state.valid)
+        update_tensorize_duration(time.perf_counter() - start)
+
+    @property
+    def n_padded(self) -> int:
+        return self.state.n_padded
+
+    def node_name(self, idx: int) -> str:
+        return self.state.names[idx]
+
+    def node_index(self, name: str) -> Optional[int]:
+        return self.state.index.get(name)
+
+    def update_rows(self, nodes: Dict[str, NodeInfo], names) -> bool:
+        """Re-pack the given nodes' rows from host truth (numpy mirror and
+        device arrays both), reusing everything else from the previous
+        cycle — the steady-state complement of the full per-cycle build.
+        Returns False when the node set changed (caller rebuilds fresh).
+
+        Soundness: rows NOT in ``names`` were neither event-mutated
+        (cache dirty set) nor session-mutated (touched set folded in by
+        the caller) since they were last packed, so both mirrors still
+        hold their host-truth values."""
+        from ..api.resource import VEC_SCALE
+
+        state = self.state
+        if len(nodes) != len(state.names) \
+                or any(n not in state.index for n in nodes):
+            return False
+        rows = sorted(state.index[n] for n in names if n in state.index)
+        if not rows:
+            return True
+        start = time.perf_counter()
+        from .tensorize import accumulate_nz, pack_node_raw
+        k = len(rows)
+        dirty_nodes = [nodes[state.names[r]] for r in rows]
+        raw = pack_node_raw(dirty_nodes)
+        t_row: List[int] = []
+        t_tasks: List = []
+        for j, (r, ni) in enumerate(zip(rows, dirty_nodes)):
+            t_tasks.extend(ni.tasks.values())
+            t_row.extend([j] * len(ni.tasks))
+            state.max_task_num[r] = ni.allocatable.max_task_num
+            state.n_tasks[r] = len(ni.tasks)
+            state.schedulable[r] = not (bool(ni.node.unschedulable)
+                                        if ni.node else True)
+        nz = accumulate_nz(t_tasks, t_row, k)
+        raw *= VEC_SCALE
+        raw32 = raw.astype(np.float32)
+        idx = np.asarray(rows, np.int32)
+        state.idle[idx] = raw32[:, 0]
+        state.releasing[idx] = raw32[:, 1]
+        state.backfilled[idx] = raw32[:, 2]
+        state.allocatable[idx] = raw32[:, 3]
+        state.nz_requested[idx] = nz
+        # pad the scatter block to a pow2 bucket by REPEATING the first row
+        # (identical values -> idempotent), so the jitted scatter shape is
+        # stable across cycles instead of recompiling per dirty-row count
+        k_pad = pad_to_bucket(k, 8)
+        if k_pad != k:
+            pad = np.full(k_pad - k, idx[0], np.int32)
+            idx = np.concatenate([idx, pad])
+            raw32 = np.concatenate(
+                [raw32, np.repeat(raw32[:1], k_pad - k, axis=0)])
+            nz = np.concatenate([nz, np.repeat(nz[:1], k_pad - k, axis=0)])
+        (self.idle, self.releasing, self.backfilled, self.allocatable_cm,
+         self.nz_req, self.n_tasks, self.max_task_num,
+         self.node_ok) = _scatter_rows(
+            self.idle, self.releasing, self.backfilled,
+            self.allocatable_cm, self.nz_req, self.n_tasks,
+            self.max_task_num, self.node_ok, idx,
+            raw32[:, 0], raw32[:, 1], raw32[:, 2], raw32[:, 3, :2],
+            nz, state.n_tasks[idx], state.max_task_num[idx],
+            state.schedulable[idx] & state.valid[idx])
+        update_tensorize_duration(time.perf_counter() - start)
+        return True
+
+    def resync(self, nodes: Dict[str, NodeInfo]) -> None:
+        """Rebuild device arrays from host truth (used if a host-side apply
+        failed halfway, or after actions that mutated nodes host-side)."""
+        fresh = DeviceSession(nodes, min_bucket=self.n_padded)
+        self.state = fresh.state
+        self.idle = fresh.idle
+        self.releasing = fresh.releasing
+        self.backfilled = fresh.backfilled
+        self.allocatable_cm = fresh.allocatable_cm
+        self.nz_req = fresh.nz_req
+        self.n_tasks = fresh.n_tasks
+        self.max_task_num = fresh.max_task_num
+        self.node_ok = fresh.node_ok
+
+    def solve_job(self, batch: TaskBatch, min_available: int,
+                  init_allocated: int,
+                  scores: Optional[np.ndarray] = None,
+                  pred_mask: Optional[np.ndarray] = None,
+                  dyn=None) -> Tuple[List[Decision], bool]:
+        """Run the allocate scan for one job's pending tasks and commit the
+        updated capacity carry to device state. Returns per-real-task
+        decisions plus whether the job crossed readiness. ``dyn`` is a
+        terms.DynamicScoreSpec enabling the in-kernel nodeorder terms."""
+        t_pad, n_pad = batch.t_padded, self.n_padded
+        if scores is None:
+            scores = np.zeros((t_pad, n_pad), np.float32)
+        if pred_mask is None:
+            pred_mask = np.ones((t_pad, n_pad), bool)
+        dyn_enabled = bool(dyn is not None and dyn.enabled)
+        dyn_weights = np.asarray(
+            [dyn.least_requested, dyn.balanced_resource] if dyn_enabled
+            else [0.0, 0.0], np.float32)
+        start = time.perf_counter()
+        (decisions, node_idx, idle, releasing, n_tasks, nz_req,
+         became_ready) = _allocate_scan(
+            self.idle, self.releasing, self.backfilled, self.allocatable_cm,
+            self.nz_req, self.max_task_num, self.n_tasks, self.node_ok,
+            jnp.asarray(batch.resreq), jnp.asarray(batch.init_resreq),
+            jnp.asarray(batch.nz_req), jnp.asarray(batch.valid),
+            jnp.asarray(scores), jnp.asarray(pred_mask),
+            jnp.asarray(min_available, jnp.int32),
+            jnp.asarray(init_allocated, jnp.int32),
+            jnp.asarray(dyn_weights), dyn_enabled=dyn_enabled)
+        decisions = np.asarray(decisions)
+        node_idx = np.asarray(node_idx)
+        self.idle, self.releasing, self.n_tasks = idle, releasing, n_tasks
+        self.nz_req = nz_req
+        update_solver_kernel_duration("allocate_scan",
+                                      time.perf_counter() - start)
+        out: List[Decision] = []
+        for i in range(len(batch.tasks)):
+            kind = int(decisions[i])
+            name = (self.state.names[int(node_idx[i])]
+                    if kind in (ALLOC, ALLOC_OB, PIPELINE) else "")
+            out.append(Decision(kind, name))
+        return out, bool(became_ready)

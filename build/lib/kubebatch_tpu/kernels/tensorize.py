@@ -1,0 +1,319 @@
+"""Snapshot tensorization — ClusterInfo becomes dense device arrays.
+
+This is the layer with no reference counterpart: the per-entity structs of
+pkg/scheduler/api (Resource rows, NodeInfo accounting, TaskInfo requests)
+are projected onto fixed-shape float32/int32 arrays so the scheduling inner
+loops run as XLA programs on TPU. Axis conventions:
+
+- node axis: order of ``NodeState.names`` (padded to a pow2 bucket so jit
+  traces are reused across cycles; padded rows are masked invalid)
+- resource axis: [cpu_milli, mem_MiB, gpu_milli] (api.resource.RESOURCE_NAMES)
+
+The epsilon-fit rule on device is elementwise ``req <= avail + VEC_EPS``
+(strictly mirroring Resource.less_equal: ``r < R or |R - r| < eps`` equals
+``r < R + eps`` for the operands we produce, since requests and availability
+are finite floats).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..api import NodeInfo, TaskInfo
+from ..api.resource import RESOURCE_DIM, VEC_EPS, VEC_SCALE
+
+__all__ = ["NodeState", "TaskBatch", "pad_to_bucket", "VEC_EPS",
+           "NONZERO_MILLI_CPU", "NONZERO_MEM_MIB", "nz_request_vec"]
+
+#: upstream DefaultNonZeroRequest (priorityutil.GetNonzeroRequests) in
+#: device units: 100m CPU, 200MB memory (= 200 MiB exactly)
+NONZERO_MILLI_CPU = 100.0
+NONZERO_MEM_MIB = 200.0
+
+
+def nz_request_vec(resreq_vec: np.ndarray) -> np.ndarray:
+    """[cpu_milli, mem_MiB] with upstream NonZero defaults applied."""
+    cpu = resreq_vec[0] if resreq_vec[0] != 0 else NONZERO_MILLI_CPU
+    mem = resreq_vec[1] if resreq_vec[1] != 0 else NONZERO_MEM_MIB
+    return np.array([cpu, mem], np.float32)
+
+
+def pack_node_raw(nodes_seq) -> np.ndarray:
+    """[k, 4, RESOURCE_DIM] float64 HOST-unit idle/releasing/backfilled/
+    allocatable rows for a list of NodeInfo — THE node extraction, shared
+    by the fresh build (NodeState.from_nodes) and the incremental repack
+    (DeviceSession.update_rows) so the two can never drift. Uses the
+    native packer when built."""
+    k = len(nodes_seq)
+    pack = load_kb_pack()
+    if pack is not None:
+        raw = np.empty((k, len(_NODE_PATHS)), np.float64)
+        pack.extract_f64(nodes_seq, _NODE_PATHS, raw)
+        return raw.reshape(k, 4, RESOURCE_DIM)
+    return np.array(
+        [(ni.idle.milli_cpu, ni.idle.memory, ni.idle.milli_gpu,
+          ni.releasing.milli_cpu, ni.releasing.memory,
+          ni.releasing.milli_gpu,
+          ni.backfilled.milli_cpu, ni.backfilled.memory,
+          ni.backfilled.milli_gpu,
+          ni.allocatable.milli_cpu, ni.allocatable.memory,
+          ni.allocatable.milli_gpu) for ni in nodes_seq],
+        np.float64).reshape(k, 4, RESOURCE_DIM)
+
+
+def accumulate_nz(tasks, rows, n_rows: int) -> np.ndarray:
+    """[n_rows, 2] float32 per-row sums of nonzero (cpu_milli, mem_MiB)
+    requests — upstream GetNonzeroRequests semantics, accumulated in
+    float64 and cast ONCE. Shared by NodeState.from_nodes,
+    DeviceSession.update_rows, and VictimState so refreshed rows stay
+    bit-identical to fresh builds."""
+    out = np.zeros((n_rows, 2), np.float64)
+    if tasks:
+        pack = load_kb_pack()
+        res = np.empty((len(tasks), 2), np.float64)
+        if pack is not None:
+            pack.extract_f64(tasks, _NZ_PATHS, res)
+        else:
+            for i, t in enumerate(tasks):
+                res[i] = (t.resreq.milli_cpu, t.resreq.memory)
+        nz = np.empty((len(tasks), 2), np.float64)
+        nz[:, 0] = np.where(res[:, 0] != 0, res[:, 0], NONZERO_MILLI_CPU)
+        mem_mib = res[:, 1] / (1024.0 * 1024.0)
+        nz[:, 1] = np.where(mem_mib != 0, mem_mib, NONZERO_MEM_MIB)
+        np.add.at(out, np.asarray(rows, np.int64), nz)
+    return out.astype(np.float32)
+
+
+def pad_to_bucket(n: int, minimum: int = 8) -> int:
+    """Next power-of-two bucket >= max(n, minimum) — keeps jit cache hits
+    across cycles while cluster size drifts."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------
+# optional native attribute packer (native/kb_pack.c)
+# ---------------------------------------------------------------------
+
+_kb_pack = None
+_kb_pack_failed = False
+_kb_pack_lock = None
+
+
+def load_kb_pack():
+    """The C attribute packer, or None (pure-Python fallback). Built on
+    first use via native/Makefile; KUBEBATCH_NATIVE=0 disables. Lives
+    here (not kubebatch_tpu.native) because native.py imports this
+    module."""
+    global _kb_pack, _kb_pack_failed, _kb_pack_lock
+    if _kb_pack is not None or _kb_pack_failed:
+        return _kb_pack
+    import importlib.util
+    import os
+    import subprocess
+    import sys
+    import sysconfig
+    import threading
+
+    if os.environ.get("KUBEBATCH_NATIVE", "1") in ("0", "false"):
+        _kb_pack_failed = True
+        return None
+    if _kb_pack_lock is None:
+        _kb_pack_lock = threading.Lock()
+    with _kb_pack_lock:
+        if _kb_pack is not None or _kb_pack_failed:
+            return _kb_pack
+        return _load_kb_pack_locked(importlib, os, subprocess, sys,
+                                    sysconfig)
+
+
+def _load_kb_pack_locked(importlib, os, subprocess, sys, sysconfig):
+    global _kb_pack, _kb_pack_failed
+    try:
+        native_dir = os.path.join(os.path.dirname(__file__), os.pardir,
+                                  os.pardir, "native")
+        suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+        path = os.path.join(native_dir, f"kb_pack{suffix}")
+        if not os.path.exists(path):
+            # build with THIS interpreter's headers/suffix, not whatever
+            # python3 is on make's PATH
+            subprocess.run(["make", "-C", native_dir, "-s",
+                            f"PYTHON={sys.executable}"], check=True,
+                           capture_output=True, timeout=120)
+        spec = importlib.util.spec_from_file_location("kb_pack", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # smoke the contract once before trusting it for every snapshot
+        probe = np.zeros((1, 1), np.float64)
+
+        class _P:
+            x = 1.5
+        mod.extract_f64([_P()], (("x", None),), probe)
+        if probe[0, 0] != 1.5:
+            raise RuntimeError("kb_pack probe mismatch")
+        _kb_pack = mod
+    except Exception:
+        _kb_pack_failed = True
+    return _kb_pack
+
+
+def _intern_paths(*paths):
+    import sys
+
+    return tuple(tuple(sys.intern(a) if isinstance(a, str) else a
+                       for a in p) for p in paths)
+
+
+_TASK_PATHS = _intern_paths(
+    ("resreq", "milli_cpu"), ("resreq", "memory"), ("resreq", "milli_gpu"),
+    ("init_resreq", "milli_cpu"), ("init_resreq", "memory"),
+    ("init_resreq", "milli_gpu"))
+
+_NODE_PATHS = _intern_paths(
+    ("idle", "milli_cpu"), ("idle", "memory"), ("idle", "milli_gpu"),
+    ("releasing", "milli_cpu"), ("releasing", "memory"),
+    ("releasing", "milli_gpu"),
+    ("backfilled", "milli_cpu"), ("backfilled", "memory"),
+    ("backfilled", "milli_gpu"),
+    ("allocatable", "milli_cpu"), ("allocatable", "memory"),
+    ("allocatable", "milli_gpu"))
+
+_NZ_PATHS = _intern_paths(("resreq", "milli_cpu"), ("resreq", "memory"))
+
+
+@dataclass
+class NodeState:
+    """Device-side mirror of the mutable node accounting.
+
+    Carried through assignment scans and updated functionally; the host
+    NodeInfo structs remain the source of truth between actions
+    (see kernels/solver.py sync discipline).
+    """
+    names: List[str]
+    #: [N,R] float32 arrays (MiB-scaled memory)
+    idle: np.ndarray
+    releasing: np.ndarray
+    backfilled: np.ndarray
+    allocatable: np.ndarray
+    #: [N,2] float32 — nonzero-request (cpu_milli, mem_MiB) sums over the
+    #: node's tasks, upstream GetNonzeroRequests semantics (feeds the
+    #: in-kernel least-requested / balanced-resource scores)
+    nz_requested: np.ndarray
+    #: [N] int32 / bool
+    max_task_num: np.ndarray
+    n_tasks: np.ndarray
+    schedulable: np.ndarray   # NOT unschedulable and real (non-padded) node
+    valid: np.ndarray         # non-padded row
+    index: Dict[str, int] = field(default_factory=dict)
+
+    @classmethod
+    def from_nodes(cls, nodes: Dict[str, NodeInfo],
+                   min_bucket: int = 8) -> "NodeState":
+        ordered = sorted(nodes.values(), key=lambda ni: ni.name)
+        n = len(ordered)
+        n_pad = pad_to_bucket(n, min_bucket)
+        shape = (n_pad, RESOURCE_DIM)
+        idle = np.zeros(shape, np.float32)
+        releasing = np.zeros(shape, np.float32)
+        backfilled = np.zeros(shape, np.float32)
+        allocatable = np.zeros(shape, np.float32)
+        nz_requested = np.zeros((n_pad, 2), np.float32)
+        max_task_num = np.zeros(n_pad, np.int32)
+        n_tasks = np.zeros(n_pad, np.int32)
+        schedulable = np.zeros(n_pad, bool)
+        valid = np.zeros(n_pad, bool)
+        index: Dict[str, int] = {}
+        if n:
+            # one packed pass instead of per-Resource to_vec array
+            # allocations — this runs over every node each snapshot; the
+            # shared pack_node_raw/accumulate_nz helpers keep this path
+            # bit-identical to DeviceSession.update_rows' repack
+            raw = pack_node_raw(ordered)
+            raw *= VEC_SCALE
+            raw32 = raw.astype(np.float32)
+            idle[:n] = raw32[:, 0]
+            releasing[:n] = raw32[:, 1]
+            backfilled[:n] = raw32[:, 2]
+            allocatable[:n] = raw32[:, 3]
+            max_task_num[:n] = [ni.allocatable.max_task_num for ni in ordered]
+            n_tasks[:n] = [len(ni.tasks) for ni in ordered]
+            schedulable[:n] = [not (bool(ni.node.unschedulable) if ni.node
+                                    else True) for ni in ordered]
+            valid[:n] = True
+            all_tasks = []
+            t_row = []
+            for i, ni in enumerate(ordered):
+                all_tasks.extend(ni.tasks.values())
+                t_row.extend([i] * len(ni.tasks))
+            nz_requested[:n] = accumulate_nz(all_tasks, t_row, n)
+        for i, ni in enumerate(ordered):
+            index[ni.name] = i
+        return cls(names=[ni.name for ni in ordered], idle=idle,
+                   releasing=releasing, backfilled=backfilled,
+                   allocatable=allocatable, nz_requested=nz_requested,
+                   max_task_num=max_task_num, n_tasks=n_tasks,
+                   schedulable=schedulable, valid=valid, index=index)
+
+    @property
+    def n_padded(self) -> int:
+        return self.idle.shape[0]
+
+
+@dataclass
+class TaskBatch:
+    """A job's pending tasks, in task-order, padded to a pow2 bucket."""
+    tasks: List[TaskInfo]
+    resreq: np.ndarray        # [T,R] steady-state request (node accounting)
+    init_resreq: np.ndarray   # [T,R] launch request (fit checks)
+    nz_req: np.ndarray        # [T,2] nonzero (cpu,mem) for dynamic scoring
+    valid: np.ndarray         # [T] non-padded row
+    #: [T,R] float64 HOST units (memory in bytes) — the exact values the
+    #: Resource arithmetic uses; the bulk decision replay sums these per
+    #: node/job instead of calling per-task Resource methods
+    resreq_raw: np.ndarray = None
+
+    @classmethod
+    def from_tasks(cls, tasks: Sequence[TaskInfo],
+                   min_bucket: int = 8) -> "TaskBatch":
+        t = len(tasks)
+        t_pad = pad_to_bucket(t, min_bucket)
+        resreq = np.zeros((t_pad, RESOURCE_DIM), np.float32)
+        init_resreq = np.zeros((t_pad, RESOURCE_DIM), np.float32)
+        nz_req = np.zeros((t_pad, 2), np.float32)
+        valid = np.zeros(t_pad, bool)
+        resreq_raw = np.zeros((t_pad, RESOURCE_DIM), np.float64)
+        if t:
+            # one packed pass (see NodeState.from_nodes)
+            pack = load_kb_pack()
+            if pack is not None:
+                raw = np.empty((t, len(_TASK_PATHS)), np.float64)
+                pack.extract_f64(tasks, _TASK_PATHS, raw)
+                raw = raw.reshape(t, 2, RESOURCE_DIM)
+            else:
+                raw = np.array(
+                    [(tk.resreq.milli_cpu, tk.resreq.memory,
+                      tk.resreq.milli_gpu,
+                      tk.init_resreq.milli_cpu, tk.init_resreq.memory,
+                      tk.init_resreq.milli_gpu) for tk in tasks],
+                    np.float64).reshape(t, 2, RESOURCE_DIM)
+            resreq_raw[:t] = raw[:, 0]
+            raw *= VEC_SCALE
+            raw32 = raw.astype(np.float32)
+            resreq[:t] = raw32[:, 0]
+            init_resreq[:t] = raw32[:, 1]
+            nz_req[:t, 0] = np.where(resreq[:t, 0] != 0, resreq[:t, 0],
+                                     NONZERO_MILLI_CPU)
+            nz_req[:t, 1] = np.where(resreq[:t, 1] != 0, resreq[:t, 1],
+                                     NONZERO_MEM_MIB)
+            valid[:t] = True
+        return cls(tasks=list(tasks), resreq=resreq,
+                   init_resreq=init_resreq, nz_req=nz_req, valid=valid,
+                   resreq_raw=resreq_raw)
+
+    @property
+    def t_padded(self) -> int:
+        return self.resreq.shape[0]

@@ -1,0 +1,1561 @@
+"""Victim-selection kernel — preempt/reclaim node visits as tensor ops.
+
+The reference's preempt hot loop evaluates, per preemptor task, a
+predicate+score pass over ALL nodes and then a per-node victim scan
+calling every evictability plugin per (victim) pair
+(ref: actions/preempt/preempt.go:266-334, reclaim/reclaim.go:128-173).
+This module evaluates ONE ENTIRE NODE VISIT — all nodes' predicate mask,
+scores, tiered-intersection victim masks, resource-sufficiency validation
+and the cumulative eviction stop-scan — as one jitted dispatch over dense
+[V] (cluster-wide running tasks) and [N] (nodes) arrays.
+
+Semantics preserved exactly (vs framework/session.py + plugins):
+- tier dispatch: per tier, victims = INTERSECTION of enabled plugin
+  verdicts; the first tier with a non-empty set per node wins
+  (session.py:_evictable); the conformance veto then re-applies.
+- gang: victim's job stays >= MinAvailable after losing ONE task, or the
+  MinAvailable==1 fork quirk (plugins/gang.py preemptable_fn). The check
+  reads the job's CURRENT ready count — victims of one call don't see
+  each other (the reference computes the list wholesale, then evicts).
+- drf: preemptor's post-share vs victim-job's post-eviction share within
+  1e-6, with the reference's CUMULATIVE per-job allocation decrements in
+  candidate-list order within one call (plugins/drf.py:58-78).
+- proportion (reclaim): victim's queue stays >= deserved after the
+  cumulative eviction, with the allocated.less(resreq) skip guard; the
+  guard is sequential-by-nature, so the kernel detects any guard trip per
+  node and the action falls back to an exact host scan for that node
+  (plugins/proportion.py:105-124) — exactness over speed on that path.
+- validation: victims' total NOT strictly-less than the request in every
+  dimension (preempt.go:355-370 — note: Less, not LessEqual).
+- eviction order and the cumulative early-stop rule
+  (`resreq.less_equal(victim.resreq)`, preempt.go:317-334) replay ON THE
+  HOST in float64, through the real Statement/session mutators — the
+  kernel picks the first validating node and hands back its victim mask;
+  the host walks it in candidate order, stopping exactly where the
+  reference would (and handling reclaim's per-evict failure `continue`).
+  Evictions on a validating-but-not-covering node PERSIST and the walk
+  continues (preempt.go:340-350) — the action re-dispatches with a
+  `visited` mask, since the partial evictions changed the very state the
+  victim masks derive from.
+
+Wave dispatch (default; KUBEBATCH_VICTIM_WAVE=0 for per-visit): the
+analysis — NOT the node choice — runs vmapped over a whole chunk of
+pending preemptors in ONE dispatch, returning per-lane (pickable-node
+mask, guard mask, victims over ALL nodes). The host then chooses nodes
+in fresh score order per visit, consuming cached lanes directly;
+mutation events (replayed evictions/pipelines) are folded into per-node
+shrink/grow dirty sets, and only a visit whose best candidate node is
+dirty pays a single-lane re-dispatch. The monotonicity that makes this
+exact: evictions/pipelines only shrink a node's analysis unless the
+touched job/queue has running tasks there (the grow sets), and node
+scores change only on pipelined nodes (downward for least-requested;
+the chooser recomputes fresh scores host-side with the same float32
+math either way). Dispatches therefore scale with replay CONFLICTS, not
+preemptor or visit count — preempt at many pending preemptors runs in a
+handful of kernel calls, which is what lets the analysis ride a
+high-latency accelerator link (reclaim's proportion math moves
+queue-wide state per eviction, so its waves degrade gracefully to
+per-visit counts).
+
+Device placement: KUBEBATCH_VICTIM_DEVICE selects where the kernels
+run: "auto" (default — the platform-default device when an accelerator
+is attached and its MEASURED dispatch+readback round trip is under
+KUBEBATCH_VICTIM_RTT_MAX_MS [4 ms]; the host-process XLA CPU backend
+otherwise), "cpu", or "default" (force the platform default). With
+wave dispatch the accelerator pays per-WAVE round trips, not per-visit
+ones, and wave size auto-tunes to the pending set
+(KUBEBATCH_VICTIM_WAVE_SIZE overrides).
+"""
+from __future__ import annotations
+
+import os
+import time as _time
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import TaskInfo, TaskStatus, ready_statuses
+from ..metrics import update_solver_kernel_duration
+from ..api.resource import RESOURCE_DIM
+from .solver import dynamic_node_score
+from .tensorize import (VEC_EPS, _intern_paths, accumulate_nz, load_kb_pack,
+                        nz_request_vec, pad_to_bucket)
+from ..api.resource import VEC_SCALE
+
+_IMAX = jnp.iinfo(jnp.int32).max
+_READY = None
+
+#: extraction paths for the native packer (VictimState's node-task walk)
+_RES_PATHS = _intern_paths(
+    ("resreq", "milli_cpu"), ("resreq", "memory"), ("resreq", "milli_gpu"))
+
+
+_CRIT_CONSTS = None
+
+
+def _pod_critical(pod) -> bool:
+    """conformance's never-evict rule, memoized on the pod (spec fields
+    are immutable for the pod's lifetime; runs per victim row per
+    action)."""
+    global _CRIT_CONSTS
+    crit = getattr(pod, "_kb_crit", None)
+    if crit is None:
+        if _CRIT_CONSTS is None:
+            from ..plugins.conformance import (NAMESPACE_SYSTEM,
+                                               SYSTEM_CLUSTER_CRITICAL,
+                                               SYSTEM_NODE_CRITICAL)
+            _CRIT_CONSTS = ((SYSTEM_CLUSTER_CRITICAL, SYSTEM_NODE_CRITICAL),
+                            NAMESPACE_SYSTEM)
+        classes, ns_system = _CRIT_CONSTS
+        crit = (pod.priority_class_name in classes
+                or pod.namespace == ns_system)
+        pod._kb_crit = crit
+    return crit
+
+
+def _ready_statuses():
+    global _READY
+    if _READY is None:
+        _READY = tuple(ready_statuses())
+    return _READY
+
+
+#: memoized device->host round-trip time of the default backend (s)
+_LINK_RTT: Optional[float] = None
+
+#: above this RTT the accelerator loses to host XLA for victim analysis:
+#: an action runs ~4-15 wave dispatches with blocking readbacks, so at
+#: 4 ms+ the link alone exceeds the whole host-side analysis (~30-50 ms);
+#: co-located hardware measures sub-ms and rides the accelerator
+_LINK_RTT_MAX = float(os.environ.get("KUBEBATCH_VICTIM_RTT_MAX_MS",
+                                     "4.0")) * 1e-3
+
+
+def _link_rtt() -> float:
+    """One-time probe of the default device's dispatch+readback latency
+    (measured, not assumed: a tunneled chip can sit ~75 ms away while a
+    co-located one answers in microseconds)."""
+    global _LINK_RTT
+    if _LINK_RTT is None:
+        import time as _t
+        dev = jax.devices()[0]
+        x = jax.device_put(np.zeros(8, np.float32), dev)
+        np.asarray(x)                      # warm the path
+        t0 = _t.perf_counter()
+        for _ in range(3):
+            np.asarray(jax.device_put(np.zeros(8, np.float32), dev))
+        _LINK_RTT = (_t.perf_counter() - t0) / 3
+    return _LINK_RTT
+
+
+def _device():
+    """Where the visit kernels run (see module docstring).
+
+    "auto" (default): the platform-default device when an accelerator is
+    attached AND its measured round trip is fast enough for per-wave
+    readbacks (wave dispatch amortizes round trips per WAVE, but a
+    high-latency link still loses to host XLA); the host-process XLA CPU
+    backend otherwise. "cpu"/"default" force either side."""
+    mode = os.environ.get("KUBEBATCH_VICTIM_DEVICE", "auto")
+    if mode == "default":
+        return None
+    if (mode == "auto" and jax.default_backend() != "cpu"
+            and _link_rtt() < _LINK_RTT_MAX):
+        return None
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except RuntimeError:  # pragma: no cover — cpu backend always exists
+        return None
+
+
+# ---------------------------------------------------------------------
+# in-kernel helpers
+# ---------------------------------------------------------------------
+
+def _le_eps(a, b, eps):
+    """Resource.less_equal elementwise: (a < b) | (|b - a| < eps)."""
+    return (a < b) | (jnp.abs(b - a) < eps)
+
+
+def _share3(vec, total):
+    """share() per dimension: x/0 -> 1, 0/0 -> 0; returns max over dims."""
+    s = jnp.where(total == 0.0,
+                  jnp.where(vec == 0.0, 0.0, 1.0),
+                  vec / jnp.where(total == 0.0, 1.0, total))
+    return jnp.max(s, axis=-1)
+
+
+def _seg_excl_cumsum(values, head):
+    """Exclusive cumulative sum within segments. ``head[i]`` flags the
+    first row of row i's segment; rows of one segment are contiguous."""
+    flag = head
+    if values.ndim == 2:
+        flag = head[:, None]
+
+    def comb(a, b):
+        sa, fa = a
+        sb, fb = b
+        return jnp.where(fb, sb, sa + sb), fa | fb
+
+    sums, _ = jax.lax.associative_scan(comb, (values, flag))
+    return sums - values
+
+
+def _seg_any(mask, seg, num):
+    return jax.ops.segment_max(mask.astype(jnp.int32), seg,
+                               num_segments=num) > 0
+
+
+# ---------------------------------------------------------------------
+# the visit kernel
+# ---------------------------------------------------------------------
+
+def _analysis_core(
+        # preemptor
+        p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
+        # node state
+        node_ok, n_tasks, max_task_num, nz_req, allocatable_cm, host_rank,
+        # victim arrays (rows sorted by (node, candidate order))
+        v_node, v_job, v_res, v_critical, v_live,
+        perm_nj, nj_head, perm_nq, nq_head,
+        # job / queue state
+        ready_cnt, min_av, j_alloc, job_queue, q_alloc, q_deserved,
+        q_prop_ok, cluster_total, dyn_weights,
+        # static config
+        tiers: Tuple[Tuple[str, ...], ...], veto_critical: bool,
+        filter_kind: str, dyn_enabled: bool, score_nodes: bool,
+        room_check: bool):
+    """The node-visit ANALYSIS for one preemptor/reclaimer task, without
+    the node choice: (pick0[N], guard_n[N], victims[V]) — pick0 flags
+    nodes where the tiered victim set validates (or the proportion guard
+    tripped), before the caller's visited mask; victims holds the chosen
+    victim rows for EVERY node at once (rows are node-segmented)."""
+    eps = jnp.asarray(VEC_EPS)
+    n_pad = node_ok.shape[0]
+    v_pad = v_node.shape[0]
+    known_job = v_job >= 0
+
+    # ---- candidate filter (host task_filter semantics) ----------------
+    if filter_kind == "inter_queue":       # preempt phase 1
+        cand = (v_live & known_job
+                & (job_queue[jnp.maximum(v_job, 0)] == p_queue)
+                & (v_job != p_job))
+    elif filter_kind == "intra_job":       # preempt phase 2
+        cand = v_live & known_job & (v_job == p_job)
+    else:                                  # reclaim: other queues only
+        cand = (v_live & known_job
+                & (job_queue[jnp.maximum(v_job, 0)] != p_queue))
+
+    # ---- plugin verdict masks -----------------------------------------
+    vj = jnp.maximum(v_job, 0)
+    gang_ok = ((ready_cnt[vj] - 1 >= min_av[vj]) | (min_av[vj] == 1)) \
+        & known_job
+    conf_ok = ~v_critical
+
+    drf_ok = jnp.zeros(v_pad, bool)
+    if any("drf" in t for t in tiers):
+        # cumulative per (node, job) in candidate order: drf decrements its
+        # working allocation for EVERY candidate of the job, accepted or not
+        vals = jnp.where(cand[:, None], v_res, 0.0)[perm_nj]
+        excl = _seg_excl_cumsum(vals, nj_head)
+        cum_incl = jnp.zeros_like(vals).at[perm_nj].set(
+            excl + jnp.where(cand[:, None], v_res, 0.0)[perm_nj])
+        rs = _share3(j_alloc[vj] - cum_incl, cluster_total[None, :])
+        ls = _share3((j_alloc[jnp.maximum(p_job, 0)] + p_resreq)[None, :],
+                     cluster_total[None, :])[0]
+        drf_ok = ((ls < rs) | (jnp.abs(ls - rs) <= 1e-6)) & known_job
+
+    prop_ok = jnp.zeros(v_pad, bool)
+    prop_guard_v = jnp.zeros(v_pad, bool)
+    if any("proportion" in t for t in tiers):
+        vq = job_queue[vj]
+        p_elig = cand & q_prop_ok[jnp.maximum(vq, 0)] & (vq >= 0)
+        vals = jnp.where(p_elig[:, None], v_res, 0.0)[perm_nq]
+        excl_s = _seg_excl_cumsum(vals, nq_head)
+        excl = jnp.zeros_like(vals).at[perm_nq].set(excl_s)
+        before = q_alloc[jnp.maximum(vq, 0)] - excl
+        after = before - v_res
+        prop_ok = p_elig & jnp.all(_le_eps(q_deserved[jnp.maximum(vq, 0)],
+                                           after, eps), axis=-1)
+        # the reference SKIPS (without decrementing) a candidate whose
+        # queue allocation is strictly below its request in every dim —
+        # sequential semantics the cumsum can't express; flag per node
+        prop_guard_v = p_elig & jnp.all(before < v_res, axis=-1)
+
+    masks = {"gang": gang_ok, "conformance": conf_ok, "drf": drf_ok,
+             "proportion": prop_ok}
+
+    # ---- tier selection: first tier with a non-empty set per node -----
+    chosen = jnp.zeros(v_pad, bool)
+    taken_n = jnp.zeros(n_pad, bool)
+    for tier in tiers:
+        tier_mask = cand
+        for name in tier:
+            tier_mask = tier_mask & masks[name]
+        any_n = _seg_any(tier_mask, v_node, n_pad)
+        use_n = any_n & ~taken_n
+        chosen = chosen | (tier_mask & use_n[v_node])
+        taken_n = taken_n | any_n
+    victims = chosen & conf_ok if veto_critical else chosen
+
+    # ---- validation: total not strictly-less in every dim -------------
+    vic_res = jnp.where(victims[:, None], v_res, 0.0)
+    tot_n = jax.ops.segment_sum(vic_res, v_node, num_segments=n_pad)
+    any_v_n = _seg_any(victims, v_node, n_pad)
+    valid_n = any_v_n & ~jnp.all(tot_n < p_res[None, :], axis=-1)
+
+    # ---- node pickability ---------------------------------------------
+    base0 = node_ok & p_pred
+    if room_check:
+        base0 = base0 & (n_tasks < max_task_num)
+    # a node where the proportion skip-guard tripped has an UNKNOWN victim
+    # set (the guard is sequential); it must be offered to the host for
+    # exact evaluation, never silently skipped
+    guard_n = _seg_any(prop_guard_v, v_node, n_pad)
+    pick0 = base0 & (valid_n | guard_n)
+    return pick0, guard_n, victims
+
+
+def _visit_core(p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
+                visited,
+                node_ok, n_tasks, max_task_num, nz_req, allocatable_cm,
+                host_rank, v_node, v_job, v_res, v_critical, v_live,
+                perm_nj, nj_head, perm_nq, nq_head,
+                ready_cnt, min_av, j_alloc, job_queue, q_alloc, q_deserved,
+                q_prop_ok, cluster_total, dyn_weights,
+                tiers: Tuple[Tuple[str, ...], ...], veto_critical: bool,
+                filter_kind: str, dyn_enabled: bool, score_nodes: bool,
+                room_check: bool):
+    """Analysis + in-kernel node choice (the per-visit dispatch mode).
+
+    Returns (found, node_idx, victims_mask[V], victims_count, prop_guard).
+    """
+    pick0, guard_n, victims = _analysis_core(
+        p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
+        node_ok, n_tasks, max_task_num, nz_req, allocatable_cm, host_rank,
+        v_node, v_job, v_res, v_critical, v_live,
+        perm_nj, nj_head, perm_nq, nq_head,
+        ready_cnt, min_av, j_alloc, job_queue, q_alloc, q_deserved,
+        q_prop_ok, cluster_total, dyn_weights,
+        tiers=tiers, veto_critical=veto_critical, filter_kind=filter_kind,
+        dyn_enabled=dyn_enabled, score_nodes=score_nodes,
+        room_check=room_check)
+    pick_n = pick0 & ~visited
+    if score_nodes:
+        score = p_score
+        if dyn_enabled:
+            score = score + dynamic_node_score(nz_req, p_nz,
+                                               allocatable_cm, dyn_weights)
+        perm = jnp.lexsort([host_rank, -score])
+    else:
+        perm = jnp.lexsort([host_rank])
+    m = pick_n[perm]
+    found = jnp.any(m)
+    node = perm[jnp.argmax(m)].astype(jnp.int32)
+
+    return (found, node,
+            victims & (v_node == node),
+            jnp.sum(victims & (v_node == node)).astype(jnp.int32),
+            guard_n[node])
+
+
+_visit_kernel = partial(jax.jit, static_argnames=(
+    "tiers", "veto_critical", "filter_kind", "dyn_enabled", "score_nodes",
+    "room_check"))(_visit_core)
+
+
+@partial(jax.jit, static_argnames=("tiers", "veto_critical", "filter_kind",
+                                   "dyn_enabled", "score_nodes",
+                                   "room_check"))
+def _wave_kernel(p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
+                 *shared,
+                 tiers: Tuple[Tuple[str, ...], ...], veto_critical: bool,
+                 filter_kind: str, dyn_enabled: bool, score_nodes: bool,
+                 room_check: bool):
+    """A WAVE of node-visit ANALYSES — _analysis_core vmapped over the
+    preemptor axis, one dispatch (and one readback) for a whole chunk of
+    pending preemptors. Node CHOICE happens host-side per consumption
+    (VictimSolver._choose), so consuming a node, growing the visited
+    mask, or another preemptor touching an unrelated node costs no
+    re-dispatch."""
+
+    def one(a, b, c, d, e, f, g):
+        return _analysis_core(a, b, c, d, e, f, g, *shared,
+                              tiers=tiers, veto_critical=veto_critical,
+                              filter_kind=filter_kind,
+                              dyn_enabled=dyn_enabled,
+                              score_nodes=score_nodes,
+                              room_check=room_check)
+
+    return jax.vmap(one)(p_res, p_resreq, p_nz, p_score, p_pred, p_job,
+                         p_queue)
+
+
+# ---------------------------------------------------------------------
+# host-side state
+# ---------------------------------------------------------------------
+
+@dataclass
+class _Victim:
+    task: TaskInfo          # the node's copy (clone at evict time)
+    node_idx: int
+    job_idx: int
+
+
+class _NodeSegment:
+    """Per-node victim-row material persisted across cycles: the RUNNING
+    task subset (insertion order) with its packed resources/criticality,
+    plus the whole-node nonzero-request sum and task count."""
+    __slots__ = ("run_tasks", "run_res", "run_crit", "nz", "n_tasks")
+
+    def __init__(self, node):
+        running = TaskStatus.RUNNING
+        tasks = list(node.tasks.values())
+        run = [t for t in tasks if t.status == running]
+        self.run_tasks = run
+        k = len(run)
+        res = np.empty((k, RESOURCE_DIM), np.float64)
+        if k:
+            pack = load_kb_pack()
+            if pack is not None:
+                pack.extract_f64(run, _RES_PATHS, res)
+            else:
+                for i, t in enumerate(run):
+                    rr = t.resreq
+                    res[i] = (rr.milli_cpu, rr.memory, rr.milli_gpu)
+        self.run_res = (res * VEC_SCALE).astype(np.float32)
+        self.run_crit = np.fromiter(
+            (_pod_critical(t.pod) for t in run), bool, count=k)
+        self.nz = accumulate_nz(tasks, [0] * len(tasks), 1)[0]
+        self.n_tasks = len(tasks)
+
+
+def _build_segments(pairs) -> Dict[str, _NodeSegment]:
+    """Bulk _NodeSegment construction for a large refresh set (cold
+    builds, node-set changes): ONE native extract + ONE nonzero
+    accumulation over every task of the given nodes — the old full-build
+    fast path — sliced back into per-node segments."""
+    running = TaskStatus.RUNNING
+    flat: List[TaskInfo] = []
+    rows: List[int] = []
+    per_node: List[List[TaskInfo]] = []
+    for j, (_, node) in enumerate(pairs):
+        ts = list(node.tasks.values())
+        per_node.append(ts)
+        flat.extend(ts)
+        rows.extend([j] * len(ts))
+    nz = accumulate_nz(flat, rows, max(1, len(pairs)))
+    res_flat = np.empty((len(flat), RESOURCE_DIM), np.float64)
+    if flat:
+        pack = load_kb_pack()
+        if pack is not None:
+            pack.extract_f64(flat, _RES_PATHS, res_flat)
+        else:
+            for i, t in enumerate(flat):
+                rr = t.resreq
+                res_flat[i] = (rr.milli_cpu, rr.memory, rr.milli_gpu)
+    res32 = (res_flat * VEC_SCALE).astype(np.float32)
+    segs: Dict[str, _NodeSegment] = {}
+    base = 0
+    for j, (name, _) in enumerate(pairs):
+        ts = per_node[j]
+        seg = _NodeSegment.__new__(_NodeSegment)
+        run_idx = [base + m for m, t in enumerate(ts)
+                   if t.status == running]
+        seg.run_tasks = [flat[x] for x in run_idx]
+        seg.run_res = (res32[run_idx] if run_idx
+                       else np.empty((0, RESOURCE_DIM), np.float32))
+        seg.run_crit = np.fromiter(
+            (_pod_critical(t.pod) for t in seg.run_tasks), bool,
+            count=len(run_idx))
+        seg.nz = nz[j]
+        seg.n_tasks = len(ts)
+        segs[name] = seg
+        base += len(ts)
+    return segs
+
+
+class SegmentStore:
+    """Cache-owned cross-cycle store of victim-row material, keyed by
+    node name; the cache migrates dirty marks into _vic_refresh /
+    _vicjob_refresh at snapshot time and folds session-touched entities
+    in at adoption, exactly like the DeviceSession discipline (cache.py).
+
+    Beyond the per-node ``_NodeSegment``s (``nz_mat``/``cnt`` mirror
+    their whole-node aggregates in node-column order), the store
+    persists the ASSEMBLED index spaces so a steady-state VictimState
+    build is O(churn) instead of O(cluster):
+
+    - **row space**: big parallel victim arrays (v_node/v_job/v_res/
+      v_crit/v_live + the aligned ``row_tasks`` list) where each node
+      owns a fixed slot ``[off, off+cap)`` holding its RUNNING tasks in
+      insertion order (dead tail rows have live=False, so within-node
+      eviction order matches a fresh build exactly). Refreshing a node
+      rewrites only its slot; a slot that outgrows its capacity
+      relocates to the tail, and the space compacts when dead capacity
+      dominates. Row position across nodes is NOT semantic: the kernels
+      order by (node, job) lexsort and consume masks per node.
+    - **job space**: a grow-only uid -> row assignment with parallel
+      ready_cnt/min_av/j_alloc/job_queue arrays refreshed only for
+      dirty jobs. Rows of jobs absent from the current session keep
+      their assignment (presence is the ``j_present`` mask, folded into
+      the session's effective v_live) so validate-dropped jobs can
+      return; the space compacts — rows densely reassigned and v_job
+      remapped — when the assignment outgrows the live set. Dirty
+      marks for absent jobs are carried in ``job_marks_pending`` until
+      the job is seen again.
+    """
+    __slots__ = ("segs", "col_names", "nz_mat", "cnt",
+                 "slot_of", "row_tasks", "v_node", "v_job", "v_res",
+                 "v_crit", "v_live", "rows_used", "dead_cap",
+                 "job_rows", "j_present", "ready_cnt",
+                 "min_av", "j_alloc", "job_queue", "q_ids",
+                 "present_uids", "job_marks_pending", "orphan_uids",
+                 "host_rank", "host_rank_epoch")
+
+    def __init__(self):
+        self.segs: Dict[str, _NodeSegment] = {}
+        self.col_names: Optional[List[str]] = None
+        self.nz_mat: Optional[np.ndarray] = None
+        self.cnt: Optional[np.ndarray] = None
+        # row space
+        self.slot_of: Dict[str, tuple] = {}
+        self.row_tasks: List[Optional[TaskInfo]] = []
+        self.v_node = np.zeros(0, np.int32)
+        self.v_job = np.zeros(0, np.int32)
+        self.v_res = np.zeros((0, RESOURCE_DIM), np.float32)
+        self.v_crit = np.zeros(0, bool)
+        self.v_live = np.zeros(0, bool)
+        self.rows_used = 0
+        self.dead_cap = 0
+        # job space
+        self.job_rows: Dict[str, int] = {}
+        self.host_rank: Optional[np.ndarray] = None
+        self.host_rank_epoch = None
+        self.j_present: Optional[np.ndarray] = None
+        self.ready_cnt: Optional[np.ndarray] = None
+        self.min_av: Optional[np.ndarray] = None
+        self.j_alloc: Optional[np.ndarray] = None
+        self.job_queue: Optional[np.ndarray] = None
+        self.q_ids: Optional[List[str]] = None
+        self.present_uids: set = set()
+        self.job_marks_pending: set = set()
+        #: job uids some stored row references as v_job=-1 (no assignment
+        #: existed at slot-write time). When such a uid finally gets a
+        #: row, its tasks' nodes are forced into the refresh set so the
+        #: stale -1 references repair — a job's return to the session
+        #: dirties no node by itself.
+        self.orphan_uids: set = set()
+
+    def _ensure_row_cap(self, need: int) -> None:
+        cap = len(self.v_node)
+        if need <= cap:
+            return
+        new = pad_to_bucket(max(need, cap + (cap >> 1)), 64)
+        grow = new - cap
+        self.v_node = np.concatenate([self.v_node,
+                                      np.zeros(grow, np.int32)])
+        self.v_job = np.concatenate([self.v_job,
+                                     np.full(grow, -1, np.int32)])
+        self.v_res = np.concatenate(
+            [self.v_res, np.zeros((grow, RESOURCE_DIM), np.float32)])
+        self.v_crit = np.concatenate([self.v_crit, np.zeros(grow, bool)])
+        self.v_live = np.concatenate([self.v_live, np.zeros(grow, bool)])
+        self.row_tasks.extend([None] * grow)
+
+    def _clear_rows(self) -> None:
+        self.slot_of = {}
+        self.rows_used = 0
+        self.dead_cap = 0
+        self.v_live[:] = False
+        tasks = self.row_tasks
+        for i in range(len(tasks)):
+            tasks[i] = None
+
+    def _ensure_job_cap(self, need: int) -> None:
+        if self.ready_cnt is None:
+            cap = pad_to_bucket(max(1, need), 4)
+            self.ready_cnt = np.zeros(cap, np.int32)
+            self.min_av = np.zeros(cap, np.int32)
+            self.j_alloc = np.zeros((cap, RESOURCE_DIM), np.float32)
+            self.job_queue = np.full(cap, -1, np.int32)
+            self.j_present = np.zeros(cap, bool)
+            return
+        cap = len(self.ready_cnt)
+        if need <= cap:
+            return
+        new = pad_to_bucket(max(need, cap * 2), 4)
+        grow = new - cap
+        self.ready_cnt = np.concatenate([self.ready_cnt,
+                                         np.zeros(grow, np.int32)])
+        self.min_av = np.concatenate([self.min_av,
+                                      np.zeros(grow, np.int32)])
+        self.j_alloc = np.concatenate(
+            [self.j_alloc, np.zeros((grow, RESOURCE_DIM), np.float32)])
+        self.job_queue = np.concatenate([self.job_queue,
+                                         np.full(grow, -1, np.int32)])
+        self.j_present = np.concatenate([self.j_present,
+                                         np.zeros(grow, bool)])
+
+
+def _segment_store(ssn):
+    """(SegmentStore, node-refresh, job-refresh) for this build.
+    Incremental caches persist the store with the same consume-at-
+    handout / re-adopt-under-epoch-check discipline as the
+    DeviceSession: the first build of a session takes the store OFF the
+    cache (a mid-session cluster-wide invalidation or a refused
+    adoption must not leave a stale store behind), later builds in the
+    same session reuse it via the session (refresh = the grown touched
+    sets), and cache.adopt_snapshot puts it back if the session's epoch
+    still matches. Fake/non-incremental caches get a throwaway store,
+    i.e. a plain fresh build."""
+    store = getattr(ssn, "_victim_store", None)
+    if store is not None:
+        return store, set(ssn.touched_nodes), set(ssn.touched_jobs)
+    cache = getattr(ssn, "cache", None)
+    if cache is None or not getattr(cache, "_incremental", False) \
+            or not hasattr(cache, "victim_segments"):
+        return SegmentStore(), set(), set()
+    with cache._lock:
+        store = cache.victim_segments
+        cache.victim_segments = None      # consumed; re-adopted at close
+        refresh = set(cache._vic_refresh)
+        cache._vic_refresh.clear()
+        job_refresh = set(cache._vicjob_refresh)
+        cache._vicjob_refresh.clear()
+    if store is None:
+        store = SegmentStore()
+    ssn._victim_store = store
+    return (store, refresh | ssn.touched_nodes,
+            job_refresh | ssn.touched_jobs)
+
+
+class _VictimRows:
+    """Lazy row view over the VictimState's parallel victim arrays —
+    indexing materializes a _Victim for just that row. ``tasks`` is the
+    store's slot-aligned list (dead slots hold None); ``live`` is the
+    session's live-row count, which drives truthiness (the SKIP_ACTION
+    check: no live victim row means no victim can exist)."""
+    __slots__ = ("_state", "tasks", "live")
+
+    def __init__(self, state, tasks, live: int):
+        self._state = state
+        self.tasks = tasks
+        self.live = live
+
+    def __len__(self):
+        return self.live
+
+    def __bool__(self):
+        return self.live > 0
+
+    def __getitem__(self, row: int) -> _Victim:
+        # v_node/v_job are PADDED arrays — plain indexing would pair a
+        # real task with pad-row data on negative indices; dead slots
+        # hold no task
+        st = self._state
+        if not 0 <= row < len(st.v_node):
+            raise IndexError(row)
+        task = self.tasks[row]
+        if task is None:
+            raise IndexError(row)
+        return _Victim(task, int(st.v_node[row]), int(st.v_job[row]))
+
+
+class VictimState:
+    """Host mirror of the mutable state the visit kernel reads, plus the
+    static victim/job/queue index spaces for one preempt/reclaim action.
+
+    The action applies every session mutation (stmt.evict / stmt.pipeline
+    / direct ssn.evict+pipeline) through apply_* so the mirrors track the
+    host truth; Statement.discard is mirrored by the inverse methods.
+    """
+
+    def __init__(self, ssn, node_index: Dict[str, int], n_pad: int,
+                 node_ok: np.ndarray, max_task_num: np.ndarray,
+                 allocatable_cm: np.ndarray):
+        self.node_index = node_index
+        self.n_pad = n_pad
+        _t = _time.perf_counter if os.environ.get(
+            "KB_VICTIM_TIMING") else None
+        _m = [] if _t else None
+        if _t:
+            _m.append(("start", _t()))
+        # mutable node mirrors + victim-row material, assembled from the
+        # cache's persistent SegmentStore: only nodes/jobs the cache
+        # dirtied or the session touched recompute from HOST truth, and
+        # the assembled row/job index spaces persist too — the full
+        # 10k-row re-assembly this build used to pay every
+        # preempt/reclaim action now costs O(churn) in the steady
+        # regime.
+        store, refresh, job_refresh = _segment_store(ssn)
+        segs = store.segs
+        nodes_map = ssn.nodes
+        if (store.col_names is not None
+                and len(store.col_names) == len(nodes_map)
+                and all(n in nodes_map for n in store.col_names)):
+            # node set unchanged: the store's column order IS the index
+            # order — skip the per-build sort of 5k (name, node) pairs
+            names = store.col_names
+        else:
+            ordered = sorted(nodes_map.items(),
+                             key=lambda kv: node_index.get(kv[0], 0))
+            names = [name for name, _ in ordered if name in node_index]
+        rows_reset = False
+        if (store.col_names != names or store.nz_mat is None
+                or store.nz_mat.shape[0] != n_pad
+                or len(segs) < len(names)):
+            # node set / order / padding changed: aggregates restart
+            store.col_names = names
+            store.nz_mat = np.zeros((n_pad, 2), np.float32)
+            store.cnt = np.zeros(n_pad, np.int32)
+            refresh = set(names)
+            rows_reset = True
+            # pin the invariant the fast path above relies on: column
+            # order == node_index order (NodeState.from_nodes sorts by
+            # name; if that ever changes, this catches it at reset time
+            # instead of silently misplacing cached aggregate rows).
+            # A real raise, not assert — it must survive python -O.
+            if any(node_index.get(nm) != i
+                   for i, nm in enumerate(names)):
+                raise RuntimeError(
+                    "segment column order diverged from the node index")
+        nz_mat, cnt = store.nz_mat, store.cnt
+
+        if _t:
+            _m.append(("jobspace", _t()))
+        # ---- job index space (persistent, grow-only) ------------------
+        self.queue_ids = sorted(ssn.queues)
+        self.q_index = {q: i for i, q in enumerate(self.queue_ids)}
+        jobs_map = ssn.jobs
+        job_refresh |= store.job_marks_pending
+        update_all = False
+        if (store.ready_cnt is None or store.q_ids != self.queue_ids
+                or len(store.job_rows) > 2 * len(jobs_map) + 64):
+            # fresh store / queue-set change / assignment outgrew the
+            # live set: rebuild the job space densely and remap the row
+            # arrays' job references (job-row NUMBERS are not semantic —
+            # kernels only group by them)
+            old_rows = store.job_rows
+            old_cap = (len(store.ready_cnt)
+                       if store.ready_cnt is not None else 0)
+            store.job_rows = {uid: i for i, uid in enumerate(jobs_map)}
+            store.ready_cnt = None
+            store._ensure_job_cap(len(jobs_map))
+            store.q_ids = list(self.queue_ids)
+            store.present_uids = set()
+            store.job_marks_pending = set()
+            if old_cap and len(store.v_job):
+                remap = np.full(old_cap + 1, -1, np.int32)
+                for uid, r in old_rows.items():
+                    nr = store.job_rows.get(uid)
+                    if nr is not None:
+                        remap[r] = nr
+                vj = store.v_job
+                safe = np.where((vj >= 0) & (vj < old_cap), vj, old_cap)
+                store.v_job = remap[safe]
+            # exact orphan recompute: live rows whose job reference is
+            # now unknown (dropped assignments) need repair if the job
+            # ever returns — this also prunes uids that never will
+            vj = store.v_job
+            orphan_rows = np.flatnonzero(store.v_live[:len(vj)]
+                                         & (vj < 0))
+            store.orphan_uids = {
+                store.row_tasks[i].job for i in orphan_rows
+                if store.row_tasks[i] is not None}
+            update_all = True
+        job_rows = store.job_rows
+        ready = _ready_statuses()
+        drf = ssn.plugins.get("drf")
+        q_get = self.q_index.get
+
+        repair_nodes: set = set()
+
+        def _update_job(uid, job):
+            r = job_rows[uid]
+            store.ready_cnt[r] = job.count(*ready)
+            store.min_av[r] = job.min_available
+            store.job_queue[r] = q_get(job.queue, -1)
+            attr = drf.job_opts.get(uid) if drf is not None else None
+            if attr is not None:
+                store.j_alloc[r] = attr.allocated.to_vec()
+            else:
+                store.j_alloc[r] = 0.0
+            if uid in store.orphan_uids:
+                # stored rows reference this job as v_job=-1; refresh its
+                # tasks' nodes so the slots repair with the new row
+                store.orphan_uids.discard(uid)
+                for t in job.tasks.values():
+                    if t.node_name:
+                        repair_nodes.add(t.node_name)
+
+        cur = set(jobs_map)
+        if update_all:
+            for uid, job in jobs_map.items():
+                store.j_present[job_rows[uid]] = True
+                _update_job(uid, job)
+        else:
+            for uid in store.present_uids - cur:
+                store.j_present[job_rows[uid]] = False
+            updated = set()
+            for uid in cur - store.present_uids:
+                # new or returning job; values of a returning row are
+                # still valid unless a dirty mark is pending (handled
+                # by the job_refresh pass below)
+                r = job_rows.get(uid)
+                if r is None:
+                    r = len(job_rows)
+                    store._ensure_job_cap(r + 1)
+                    job_rows[uid] = r
+                    _update_job(uid, jobs_map[uid])
+                    updated.add(uid)
+                store.j_present[r] = True
+            for uid in job_refresh:
+                job = jobs_map.get(uid)
+                if job is not None and uid not in updated:
+                    if uid not in job_rows:
+                        r = len(job_rows)
+                        store._ensure_job_cap(r + 1)
+                        job_rows[uid] = r
+                        store.j_present[r] = True
+                    _update_job(uid, job)
+                    updated.add(uid)
+            # carry marks of stored-but-absent jobs until they return
+            store.job_marks_pending = {
+                u for u in job_refresh - updated if u in job_rows}
+        store.present_uids = cur
+        self.j_index = job_rows
+        self.cluster_total = (drf.total_resource.to_vec() if drf is not None
+                              else np.ones(RESOURCE_DIM, np.float32))
+
+        if _t:
+            _m.append(("segrefresh", _t()))
+        # ---- segment refresh ------------------------------------------
+        refresh |= repair_nodes
+        if rows_reset:
+            stale_names = names           # already in node-index order
+        else:
+            stale_names = sorted(
+                (n for n in refresh if n in node_index and n in nodes_map),
+                key=node_index.get)
+        stale = [(n, nodes_map[n]) for n in stale_names]
+        if len(stale) > 64:
+            # large refresh (cold build / node-set change): one batched
+            # extract instead of thousands of per-node ones
+            segs.update(_build_segments(stale))
+        else:
+            for name, node in stale:
+                segs[name] = _NodeSegment(node)
+        for name, _ in stale:
+            seg = segs[name]
+            ni = node_index[name]
+            nz_mat[ni] = seg.nz
+            cnt[ni] = seg.n_tasks
+        if len(segs) > len(names):
+            live_names = set(names)
+            for name in list(segs):
+                if name not in live_names:
+                    del segs[name]
+
+        if _t:
+            _m.append(("rowspace", _t()))
+        # ---- row space: per-node slots, refreshed slots rewritten -----
+        if rows_reset or store.dead_cap > max(64, store.rows_used // 3):
+            store._clear_rows()
+            row_stale = names
+        else:
+            row_stale = stale_names
+        jr_get = job_rows.get
+        tasks_l = store.row_tasks
+        for name in row_stale:
+            seg = segs[name]
+            run = seg.run_tasks
+            k = len(run)
+            slot = store.slot_of.get(name)
+            if slot is None or k > slot[1]:
+                if slot is not None:
+                    off0, cap0 = slot
+                    store.v_live[off0:off0 + cap0] = False
+                    for i in range(off0, off0 + cap0):
+                        tasks_l[i] = None
+                    store.dead_cap += cap0
+                cap = k + max(2, k >> 2)
+                off = store.rows_used
+                store._ensure_row_cap(off + cap)
+                tasks_l = store.row_tasks
+                store.rows_used = off + cap
+                store.slot_of[name] = (off, cap)
+            else:
+                off, cap = slot
+            ni = node_index[name]
+            store.v_node[off:off + cap] = ni
+            store.v_live[off:off + cap] = False
+            if k:
+                store.v_res[off:off + k] = seg.run_res
+                store.v_crit[off:off + k] = seg.run_crit
+                vjs = []
+                for t in run:
+                    jr = jr_get(t.job, -1)
+                    if jr < 0:
+                        store.orphan_uids.add(t.job)
+                    vjs.append(jr)
+                store.v_job[off:off + k] = vjs
+                store.v_live[off:off + k] = True
+                for i, t in enumerate(run):
+                    tasks_l[off + i] = t
+            for i in range(off + k, off + cap):
+                tasks_l[i] = None
+
+        if _t:
+            _m.append(("mirrors", _t()))
+        # ---- node mirrors ---------------------------------------------
+        self.nz_req = nz_mat.copy()
+        self.n_tasks = cnt.copy()
+        self.node_ok = node_ok
+        self.max_task_num = max_task_num
+        self.allocatable_cm = allocatable_cm
+        # host visit order (ssn.nodes dict order) — stable while the node
+        # set is; persist on the store instead of walking 5k nodes per
+        # action build
+        cached_rank = getattr(store, "host_rank", None)
+        order_epoch = getattr(ssn, "node_order_epoch", None)
+        if rows_reset or cached_rank is None \
+                or len(cached_rank) != n_pad \
+                or order_epoch is None \
+                or store.host_rank_epoch != order_epoch:
+            host_rank = np.full(n_pad, np.iinfo(np.int32).max, np.int32)
+            for pos, name in enumerate(ssn.nodes):
+                idx = node_index.get(name)
+                if idx is not None:
+                    host_rank[idx] = pos
+            store.host_rank = host_rank
+            store.host_rank_epoch = order_epoch
+        self.host_rank = store.host_rank
+
+        if _t:
+            _m.append(("queues", _t()))
+        # ---- queue arrays (small; rebuilt per build) ------------------
+        q_pad = pad_to_bucket(max(1, len(self.queue_ids)), 4)
+        self.q_alloc = np.zeros((q_pad, RESOURCE_DIM), np.float32)
+        self.q_deserved = np.zeros((q_pad, RESOURCE_DIM), np.float32)
+        self.q_prop_ok = np.zeros(q_pad, bool)
+        prop = ssn.plugins.get("proportion")
+        if prop is not None:
+            for q, attr in prop.queue_opts.items():
+                qi = self.q_index.get(q)
+                if qi is not None:
+                    self.q_alloc[qi] = attr.allocated.to_vec()
+                    self.q_deserved[qi] = attr.deserved.to_vec()
+                    self.q_prop_ok[qi] = True
+
+        # ---- session views over the persistent spaces -----------------
+        # Rows: read-only aliases of the store's arrays (apply_* only
+        # mutates the per-session copies below); within-node insertion
+        # order is preserved by the slot discipline, so eviction order
+        # matches a fresh build. Effective liveness folds job presence:
+        # rows of session-absent jobs are dead this cycle.
+        used = store.rows_used
+        v_pad = pad_to_bucket(max(1, used), 8)
+        store._ensure_row_cap(v_pad)
+        self.v_node = store.v_node[:v_pad]
+        self.v_job = store.v_job[:v_pad]
+        self.v_res = store.v_res[:v_pad]
+        self.v_critical = store.v_crit[:v_pad]
+        vj = self.v_job
+        live = store.v_live[:v_pad] & (vj >= 0)
+        np.logical_and(live, store.j_present[np.maximum(vj, 0)], out=live)
+        self.v_live = live
+        self.victims = _VictimRows(self, store.row_tasks,
+                                   int(live.sum()))
+        # per-session copies of the arrays apply_* mutates
+        self.ready_cnt = store.ready_cnt.copy()
+        self.min_av = store.min_av
+        self.j_alloc = store.j_alloc.copy()
+        self.job_queue = store.job_queue
+
+        # orderings + segment heads (dead rows keep stale keys — they
+        # contribute nothing: every kernel term masks on v_live/cand).
+        # One combined int64 key + stable argsort per ordering instead of
+        # a 3-key lexsort + 2-column stack: same order (stable argsort's
+        # index tiebreak IS the arange key), ~half the build cost at 10k+
+        # rows
+        nj_key = (self.v_node.astype(np.int64) << 32) \
+            + self.v_job.astype(np.int64) + (1 << 31)
+        self.perm_nj = np.argsort(nj_key, kind="stable").astype(np.int32)
+        njs = nj_key[self.perm_nj]
+        self.nj_head = np.ones(v_pad, bool)
+        self.nj_head[1:] = njs[1:] != njs[:-1]
+        vq = np.where(self.v_job >= 0,
+                      self.job_queue[np.maximum(self.v_job, 0)], -1)
+        nq_key = (self.v_node.astype(np.int64) << 32) \
+            + vq.astype(np.int64) + (1 << 31)
+        self.perm_nq = np.argsort(nq_key, kind="stable").astype(np.int32)
+        nqs = nq_key[self.perm_nq]
+        self.nq_head = np.ones(v_pad, bool)
+        self.nq_head[1:] = nqs[1:] != nqs[:-1]
+
+        self._row_of: Optional[Dict[str, int]] = None
+        if _t:
+            _m.append(("end", _t()))
+            import sys as _sys
+            spans = " ".join(
+                f"{lbl}={1e3 * (t1 - t0):.2f}ms"
+                for (lbl, t0), (_, t1) in zip(_m, _m[1:]))
+            print(f"victimstate: {spans}", file=_sys.stderr)
+
+        #: mutation event log for the wave cache's fine-grained
+        #: invalidation (VictimSolver.visit): ("evict", row, node, job),
+        #: ("pipeline", node, job, queue), ("rollback",)
+        self.events: List[tuple] = []
+        self._job_nodes_memo: Dict[int, frozenset] = {}
+        self._queue_nodes_memo: Dict[int, frozenset] = {}
+
+    @property
+    def row_of(self) -> Dict[str, int]:
+        """task.uid -> victim row (host replay bookkeeping), built on
+        first use — most actions never consult it."""
+        if self._row_of is None:
+            self._row_of = {t.uid: i
+                            for i, t in enumerate(self.victims.tasks)
+                            if t is not None}
+        return self._row_of
+
+    def job_nodes(self, ji: int) -> frozenset:
+        """Node columns hosting running tasks of job row ji (victim rows
+        are static for the action, so memoized)."""
+        got = self._job_nodes_memo.get(ji)
+        if got is None:
+            got = self._job_nodes_memo[ji] = frozenset(
+                int(n) for n in self.v_node[self.v_job == ji])
+        return got
+
+    def queue_nodes(self, qi: int) -> frozenset:
+        got = self._queue_nodes_memo.get(qi)
+        if got is None:
+            jq = self.job_queue[np.maximum(self.v_job, 0)]
+            sel = (self.v_job >= 0) & (jq == qi)
+            got = self._queue_nodes_memo[qi] = frozenset(
+                int(n) for n in self.v_node[sel])
+        return got
+
+    # ---- mutation mirrors (called alongside session mutations) --------
+    #: bumped by every apply_*; VictimSolver re-uploads mutable arrays only
+    #: when it changed (most visits mutate nothing). Set in __init__ via
+    #: the class default.
+    version = 0
+
+    def _job_row(self, job_uid: str) -> Optional[int]:
+        return self.j_index.get(job_uid)
+
+    def _queue_row(self, job_uid: str) -> Optional[int]:
+        ji = self.j_index.get(job_uid)
+        if ji is None:
+            return None
+        qi = int(self.job_queue[ji])
+        return qi if qi >= 0 else None
+
+    def apply_evict(self, row: int) -> None:
+        self.version += 1
+        self.v_live[row] = False
+        res = self.v_res[row]
+        ji = int(self.v_job[row])
+        if ji >= 0:
+            self.ready_cnt[ji] -= 1
+            self.j_alloc[ji] -= res
+            qi = int(self.job_queue[ji])
+            if qi >= 0:
+                self.q_alloc[qi] -= res
+        # releasing grows; nz/n_tasks unchanged (the task stays on-node)
+        self.events.append(("evict", row, int(self.v_node[row]), ji))
+
+    def apply_unevict(self, row: int) -> None:
+        self.version += 1
+        self.v_live[row] = True
+        res = self.v_res[row]
+        ji = int(self.v_job[row])
+        if ji >= 0:
+            self.ready_cnt[ji] += 1
+            self.j_alloc[ji] += res
+            qi = int(self.job_queue[ji])
+            if qi >= 0:
+                self.q_alloc[qi] += res
+        # rollback resurrects a row — every cached wave lane is suspect
+        self.events.append(("rollback",))
+
+    def apply_pipeline(self, task: TaskInfo, node_idx: int) -> None:
+        self.version += 1
+        res = task.resreq.to_vec()
+        nz = nz_request_vec(task.resreq.to_vec())
+        self.n_tasks[node_idx] += 1
+        self.nz_req[node_idx] += nz
+        ji = self._job_row(task.job)
+        qi = -1
+        if ji is not None:
+            self.ready_cnt[ji] += 1
+            self.j_alloc[ji] += res
+            qi = int(self.job_queue[ji])
+            if qi >= 0:
+                self.q_alloc[qi] += res
+        self.events.append(("pipeline", node_idx,
+                            ji if ji is not None else -1, qi))
+
+    def apply_unpipeline(self, task: TaskInfo, node_idx: int) -> None:
+        self.version += 1
+        res = task.resreq.to_vec()
+        nz = nz_request_vec(task.resreq.to_vec())
+        self.n_tasks[node_idx] -= 1
+        self.nz_req[node_idx] -= nz
+        ji = self._job_row(task.job)
+        if ji is not None:
+            self.ready_cnt[ji] -= 1
+            self.j_alloc[ji] -= res
+            qi = int(self.job_queue[ji])
+            if qi >= 0:
+                self.q_alloc[qi] -= res
+        self.events.append(("rollback",))
+
+
+@dataclass
+class VisitResult:
+    found: bool
+    node_idx: int
+    node_name: str
+    victim_rows: List[int]          # victim rows in candidate order
+    victims_count: int
+    prop_guard: bool                # proportion skip-guard tripped on node
+
+
+class VictimSolver:
+    """Drives the visit kernels for a sequence of preemptor/reclaimer
+    visits. Built per action execution from the session + the sig-term
+    encoder (kernels/terms.solver_terms over the action's pending tasks).
+
+    Two dispatch strategies:
+    - wave (default): ONE _wave_kernel dispatch analyses a whole chunk of
+      pending preemptors; the host consumes lanes in the actions' rank
+      order, invalidating cached lanes whose inputs later replays touched
+      (see _advance_entry/_choose — the rules are conservative, so wave
+      results equal per-visit results exactly). Dispatches scale with the
+      number of REPLAY CONFLICTS, not with the preemptor count — the
+      property that lets preempt/reclaim ride a high-latency accelerator
+      link.
+    - per-visit (KUBEBATCH_VICTIM_WAVE=0): one dispatch per node visit,
+      the round-2 behavior.
+    """
+
+    def __init__(self, state: VictimState, terms, names: List[str],
+                 tiers: Tuple[Tuple[str, ...], ...], veto_critical: bool,
+                 score_nodes: bool, room_check: bool,
+                 pending: Sequence[TaskInfo] = ()):
+        self.state = state
+        self.terms = terms
+        self.names = names              # node column -> name
+        self.tiers = tiers
+        self.veto_critical = veto_critical
+        self.score_nodes = score_nodes
+        self.room_check = room_check
+        self.dyn = terms.dynamic if terms is not None else None
+        self._dev = _device()
+        self._static_dev = None
+        self._mut_dev = None
+        self._mut_version = -1
+        #: wave state
+        self.pending = list(pending)
+        self._pos = {t.uid: i for i, t in enumerate(self.pending)}
+        self._wave_on = os.environ.get(
+            "KUBEBATCH_VICTIM_WAVE", "1") not in ("0", "false")
+        env_wave = os.environ.get("KUBEBATCH_VICTIM_WAVE_SIZE")
+        if env_wave is not None:
+            self._wave_size = max(1, int(env_wave))
+        elif self._dev is None:
+            # accelerator: each wave pays a link round trip — size waves
+            # to cover the pending set (bucketed) up to a lane budget so
+            # typical actions resolve in ONE dispatch
+            self._wave_size = min(512, max(
+                64, pad_to_bucket(max(1, len(self.pending)), 64)))
+        else:
+            # host XLA: latency ~free; moderate waves keep compile shapes
+            # small and the lazy-escalation path cheap
+            self._wave_size = 128
+        self._wave_cache: Dict[tuple, dict] = {}
+        self._prop = any("proportion" in t for t in tiers)
+        #: dispatch counter (tests assert the wave property)
+        self.dispatches = 0
+        #: lazy escalation: a wave lane costs real compute, so on the
+        #: host-process CPU backend (self._dev set, latency ~free) the
+        #: solver starts with cheap per-visit dispatches and only
+        #: escalates to wave caching once the visit count shows a wave
+        #: will amortize; on the platform-default device (accelerator —
+        #: dispatch LATENCY dominates) waves start immediately
+        self._wave_after = 4 if self._dev is not None else 0
+
+    def _upload(self):
+        """Device copies of the state arrays: the immutable set once per
+        action, the mutable mirrors only when a mutation bumped the state
+        version — most visits change nothing, and ~30 per-visit host->
+        device conversions dominated the visit otherwise."""
+        st = self.state
+        put = jax.device_put
+        if self._static_dev is None:
+            dyn_enabled = bool(self.dyn is not None and self.dyn.enabled)
+            dyn_w = np.asarray(
+                [self.dyn.least_requested, self.dyn.balanced_resource]
+                if dyn_enabled else [0.0, 0.0], np.float32)
+            self._static_dev = tuple(put(a) for a in (
+                st.node_ok, st.max_task_num, st.allocatable_cm,
+                st.host_rank, st.v_node, st.v_job, st.v_res, st.v_critical,
+                st.perm_nj, st.nj_head, st.perm_nq, st.nq_head, st.min_av,
+                st.job_queue, st.q_deserved, st.q_prop_ok,
+                st.cluster_total, dyn_w))
+        if self._mut_version != st.version:
+            self._mut_dev = tuple(put(a) for a in (
+                st.n_tasks, st.nz_req, st.v_live, st.ready_cnt,
+                st.j_alloc, st.q_alloc))
+            self._mut_version = st.version
+        return self._static_dev, self._mut_dev
+
+    # ------------------------------------------------------------------
+    # wave dispatch: analyses for a chunk of preemptors in ONE kernel
+    # call; node choice + staleness handling happen host-side per visit
+    # ------------------------------------------------------------------
+    def visit(self, task: TaskInfo, filter_kind: str,
+              visited: np.ndarray) -> VisitResult:
+        if not self._wave_on or task.uid not in self._pos \
+                or self.dispatches < self._wave_after:
+            self.dispatches += 1
+            return self._visit_single(task, filter_kind, visited)
+        key = (filter_kind, task.uid)
+        entry = self._wave_cache.get(key)
+        if entry is None:
+            self._dispatch_wave(filter_kind, task)
+            entry = self._wave_cache[key]
+        return self._choose(key, task, filter_kind, visited)
+
+    def _dyn_scores(self, p_nz: np.ndarray) -> np.ndarray:
+        """Fresh dynamic scores over ALL node columns against the CURRENT
+        mirrors — the SAME dynamic_node_score the kernels run, with
+        xp=np, so the host chooser orders nodes exactly as the in-kernel
+        choice would."""
+        st = self.state
+        w = self.dyn
+        weights = np.asarray([w.least_requested, w.balanced_resource],
+                             np.float32)
+        return np.asarray(dynamic_node_score(
+            st.nz_req.astype(np.float32), p_nz.astype(np.float32),
+            st.allocatable_cm.astype(np.float32), weights, xp=np))
+
+    def _advance_entry(self, entry: dict) -> bool:
+        """Fold the mutation events since the entry's wave into its
+        per-node dirty sets. False = the entry as a whole is stale (its
+        preemptor's own job was touched, or a rollback happened) and must
+        be refreshed. Every rule is conservative; the monotonicity that
+        makes caching productive: evictions/pipelines only SHRINK a
+        node's analysis unless the touched job/queue has running tasks
+        there (the grow sets)."""
+        st = self.state
+        events = st.events
+        pos = entry["log_pos"]
+        if pos == len(events):
+            return True
+        p_job = entry["p_job"]
+        shrink: set = entry["shrink"]
+        grow: set = entry["grow"]
+        for e in events[pos:]:
+            kind = e[0]
+            if kind == "rollback":
+                return False
+            if kind == "evict":
+                _, row, enode, ejob = e
+                if ejob == p_job:
+                    return False     # preemptor's own drf share moved
+                shrink.add(enode)
+                if ejob >= 0:
+                    shrink |= st.job_nodes(ejob)
+                    if self._prop:
+                        # lowering q_alloc can newly TRIP the proportion
+                        # skip-guard (before < v_res), which makes a node
+                        # pickable — a GROW effect, not just shrink
+                        q = int(st.job_queue[ejob])
+                        if q >= 0:
+                            grow |= st.queue_nodes(q)
+            else:  # pipeline
+                _, pnode, pjob, pqueue = e
+                if pjob == p_job:
+                    return False
+                shrink.add(pnode)    # load/room changed (scores re-done
+                                     # fresh by the chooser anyway)
+                if pjob >= 0:
+                    grow |= st.job_nodes(pjob)
+                if self._prop and pqueue >= 0:
+                    grow |= st.queue_nodes(pqueue)
+        entry["log_pos"] = len(events)
+        return True
+
+    def _choose(self, key: tuple, task: TaskInfo, filter_kind: str,
+                visited: np.ndarray) -> VisitResult:
+        """Pick the entry's best usable node in FRESH score order: clean
+        pickable nodes are consumed straight from the cached analysis;
+        hitting a grow-dirty (possibly newly pickable) or a dirty
+        pickable node first forces a single-lane refresh."""
+        st = self.state
+        for _ in range(2):
+            entry = self._wave_cache[key]
+            ok = self._advance_entry(entry)
+            if ok:
+                if self.score_nodes:
+                    score = entry["static_score"].astype(np.float32)
+                    if self.dyn is not None and self.dyn.enabled:
+                        score = score + self._dyn_scores(entry["p_nz"])
+                    order_rank = np.lexsort((st.host_rank, -score))
+                else:
+                    order_rank = np.lexsort((st.host_rank,))
+                rank = np.empty(st.n_pad, np.int64)
+                rank[order_rank] = np.arange(st.n_pad)
+                live = ~visited
+                pick = entry["pick"] & live
+                shrink = entry["shrink"]
+                grow = entry["grow"]
+                inf = st.n_pad + 1
+
+                def first(mask):
+                    sel = rank[mask]
+                    return int(sel.min()) if sel.size else inf
+
+                dirty_mask = np.zeros(st.n_pad, bool)
+                if shrink:
+                    dirty_mask[list(shrink)] = True
+                grow_mask = np.zeros(st.n_pad, bool)
+                if grow:
+                    grow_mask[list(grow)] = True
+                f_clean = first(pick & ~dirty_mask & ~grow_mask)
+                f_suspect = min(first(pick & dirty_mask),
+                                first(grow_mask & live))
+                if f_clean <= f_suspect:
+                    if f_clean >= inf:
+                        return VisitResult(False, 0, "", [], 0, False)
+                    col = int(order_rank[f_clean])
+                    vic = entry["victims"] & (st.v_node == col)
+                    rows = np.nonzero(vic)[0].tolist()
+                    return VisitResult(
+                        found=True, node_idx=col,
+                        node_name=self.names[col], victim_rows=rows,
+                        victims_count=len(rows),
+                        prop_guard=bool(entry["guard"][col]))
+            # stale where it matters: refresh this lane alone
+            self._dispatch_wave(filter_kind, task, single=True)
+        raise AssertionError(
+            "victim wave refresh did not converge")  # pragma: no cover
+
+    def _dispatch_wave(self, filter_kind: str, anchor: TaskInfo,
+                       single: bool = False) -> None:
+        st = self.state
+        if single:
+            chunk = [anchor]
+        else:
+            # BLOCK-aligned chunks: consumption order (the actions'
+            # fairness heaps) jumps around the pending list, so pos-based
+            # slices would re-wave on nearly every visit; fixed blocks
+            # keep any consumption order within ceil(len/W) waves
+            block = self._pos[anchor.uid] // self._wave_size
+            start = block * self._wave_size
+            chunk = self.pending[start:start + self._wave_size]
+        p = len(chunk)
+        p_pad = pad_to_bucket(p, 1 if single else 8)
+        n_pad_score = self.terms.static.score.shape[1]
+        p_res = np.zeros((p_pad, RESOURCE_DIM), np.float32)
+        p_resreq = np.zeros((p_pad, RESOURCE_DIM), np.float32)
+        p_nz = np.zeros((p_pad, 2), np.float32)
+        p_score = np.zeros((p_pad, n_pad_score), np.float32)
+        p_pred = np.zeros((p_pad, n_pad_score), bool)
+        p_job = np.full(p_pad, -1, np.int32)
+        p_queue = np.full(p_pad, -1, np.int32)
+        sig_of = self.terms.static.sig_of
+        for i, t in enumerate(chunk):
+            p_res[i] = t.init_resreq.to_vec()
+            p_resreq[i] = t.resreq.to_vec()
+            p_nz[i] = nz_request_vec(t.resreq.to_vec())
+            sig = sig_of.get(t.uid, 0)
+            p_score[i] = self.terms.static.score[sig]
+            p_pred[i] = self.terms.static.pred[sig]
+            ji = st.j_index.get(t.job, -1)
+            p_job[i] = ji
+            p_queue[i] = int(st.job_queue[ji]) if ji >= 0 else -1
+        dyn_enabled = bool(self.dyn is not None and self.dyn.enabled)
+
+        def run():
+            static_dev, mut_dev = self._upload()
+            return _wave_kernel(
+                p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
+                static_dev[0], mut_dev[0], static_dev[1], mut_dev[1],
+                static_dev[2], static_dev[3],
+                static_dev[4], static_dev[5], static_dev[6], static_dev[7],
+                mut_dev[2],
+                static_dev[8], static_dev[9], static_dev[10],
+                static_dev[11],
+                mut_dev[3], static_dev[12], mut_dev[4], static_dev[13],
+                mut_dev[5], static_dev[14], static_dev[15],
+                static_dev[16], static_dev[17],
+                tiers=self.tiers, veto_critical=self.veto_critical,
+                filter_kind=filter_kind, dyn_enabled=dyn_enabled,
+                score_nodes=self.score_nodes, room_check=self.room_check)
+
+        self.dispatches += 1
+        k0 = _time.perf_counter()
+        if self._dev is not None:
+            with jax.default_device(self._dev):
+                out = run()
+        else:
+            out = run()
+        pick, guard, victims = map(np.asarray, out)
+        update_solver_kernel_duration("victim_wave",
+                                      _time.perf_counter() - k0)
+        log_pos = len(st.events)
+        for i, t in enumerate(chunk):
+            self._wave_cache[(filter_kind, t.uid)] = {
+                "pick": pick[i], "guard": guard[i], "victims": victims[i],
+                "log_pos": log_pos,
+                "p_job": int(p_job[i]), "p_queue": int(p_queue[i]),
+                "p_nz": p_nz[i], "static_score": p_score[i],
+                "shrink": set(), "grow": set()}
+
+    def _visit_single(self, task: TaskInfo, filter_kind: str,
+                      visited: np.ndarray) -> VisitResult:
+        st = self.state
+        sig = self.terms.static.sig_of.get(task.uid, 0)
+        p_score = self.terms.static.score[sig]
+        p_pred = self.terms.static.pred[sig]
+        dyn_enabled = bool(self.dyn is not None and self.dyn.enabled)
+        p_job = st.j_index.get(task.job, -1)
+        ji = p_job if p_job >= 0 else 0
+        p_queue = int(st.job_queue[ji]) if p_job >= 0 else -1
+
+        def run():
+            ((node_ok, max_task_num, allocatable_cm, host_rank, v_node,
+              v_job, v_res, v_critical, perm_nj, nj_head, perm_nq, nq_head,
+              min_av, job_queue, q_deserved, q_prop_ok, cluster_total,
+              dyn_w),
+             (n_tasks, nz_req, v_live, ready_cnt, j_alloc, q_alloc)) = \
+                self._upload()
+            return _visit_kernel(
+                np.asarray(task.init_resreq.to_vec()),
+                np.asarray(task.resreq.to_vec()),
+                nz_request_vec(task.resreq.to_vec()),
+                p_score, p_pred,
+                np.int32(p_job), np.int32(p_queue), visited,
+                node_ok, n_tasks, max_task_num, nz_req,
+                allocatable_cm, host_rank,
+                v_node, v_job, v_res, v_critical, v_live,
+                perm_nj, nj_head, perm_nq, nq_head,
+                ready_cnt, min_av, j_alloc, job_queue,
+                q_alloc, q_deserved, q_prop_ok, cluster_total,
+                dyn_w,
+                tiers=self.tiers, veto_critical=self.veto_critical,
+                filter_kind=filter_kind, dyn_enabled=dyn_enabled,
+                score_nodes=self.score_nodes, room_check=self.room_check)
+
+        k0 = _time.perf_counter()
+        if self._dev is not None:
+            with jax.default_device(self._dev):
+                out = run()
+        else:
+            out = run()
+        found, node, vic_mask, vcount, guard = map(np.asarray, out)
+        update_solver_kernel_duration("victim_visit",
+                                      _time.perf_counter() - k0)
+        rows = np.nonzero(vic_mask)[0].tolist() if found else []
+        node = int(node)
+        return VisitResult(
+            found=bool(found), node_idx=node,
+            node_name=self.names[node] if bool(found) else "",
+            victim_rows=rows,
+            victims_count=int(vcount), prop_guard=bool(guard))
+
+
+#: build_action_solver sentinel: the action can observably do nothing
+#: (no RUNNING task exists anywhere) — skip its loops entirely. ONE
+#: decision point for both actions, host-oracle mode exempted.
+SKIP_ACTION = object()
+
+
+def build_action_solver(ssn, fns_attr: str, disabled_attr: str,
+                        score_nodes: bool):
+    """The env-gated entry the preempt/reclaim actions share: collects the
+    session's pending tasks and builds the kernel solver; returns None
+    for the host path (KUBEBATCH_VICTIM_SOLVER=host, nothing pending, or
+    an unsupported snapshot), or SKIP_ACTION when no victim can exist —
+    with no RUNNING task in any job, every visit would scan to an empty
+    set, so the action skips the solver build AND its loops (the
+    task_status_index check is exact: empty buckets are deleted)."""
+    if os.environ.get("KUBEBATCH_VICTIM_SOLVER", "device") == "host":
+        return None
+    if not any(TaskStatus.RUNNING in j.task_status_index
+               for j in ssn.jobs.values()):
+        return SKIP_ACTION
+    pending = [t for job in ssn.jobs.values()
+               for t in job.task_status_index.get(TaskStatus.PENDING,
+                                                  {}).values()]
+    if not pending:
+        return None
+    solver = build_victim_solver(ssn, pending, fns_attr, disabled_attr,
+                                 score_nodes)
+    if solver is not None and not solver.state.victims:
+        # running tasks exist but none materialized as victim rows
+        # (e.g. all on placeholder nodes)
+        return SKIP_ACTION
+    return solver
+
+
+def build_victim_solver(ssn, pending: Sequence[TaskInfo],
+                        fns_attr: str, disabled_attr: str,
+                        score_nodes: bool):
+    """Construct (VictimSolver, VictimState) for an action, or None when
+    the snapshot/plugin configuration falls outside the kernel vocabulary
+    (the action then runs its reference-literal host path).
+
+    ``fns_attr``: "preemptable_fns" or "reclaimable_fns"; ``disabled_attr``
+    the matching per-plugin disable flag name.
+    """
+    from .solver import DeviceSession
+    from .terms import device_supported, solver_terms
+
+    KNOWN = {"gang", "conformance", "drf", "proportion"}
+    fns = getattr(ssn, fns_attr)
+    tiers: List[Tuple[str, ...]] = []
+    for tier in ssn.tiers:
+        members = tuple(
+            opt.name for opt in tier.plugins
+            if not getattr(opt, disabled_attr) and opt.name in fns)
+        if members:
+            if any(m not in KNOWN for m in members):
+                return None
+            tiers.append(members)
+    if any(name not in KNOWN for name in ssn.victim_veto_fns):
+        return None
+    if not device_supported(ssn, pending):
+        return None
+    if ssn.device_snapshot is None:
+        mk = getattr(ssn.cache, "device_session", None)
+        ssn.device_snapshot = (mk(ssn) if mk is not None
+                               else DeviceSession(ssn.nodes))
+    device = ssn.device_snapshot
+    terms = solver_terms(ssn, device, pending, assume_supported=True)
+    if terms is None:
+        return None
+
+    ns = device.state
+    state = VictimState(
+        ssn, node_index=ns.index, n_pad=ns.n_padded,
+        node_ok=ns.schedulable & ns.valid, max_task_num=ns.max_task_num,
+        allocatable_cm=ns.allocatable[:, :2])
+    pred_active = any(
+        not opt.predicate_disabled and opt.name in ssn.predicate_fns
+        for tier in ssn.tiers for opt in tier.plugins)
+    solver = VictimSolver(
+        state, terms, names=ns.names, tiers=tuple(tiers),
+        veto_critical="conformance" in ssn.victim_veto_fns,
+        score_nodes=score_nodes, room_check=pred_active, pending=pending)
+    return solver

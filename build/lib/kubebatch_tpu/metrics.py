@@ -1,0 +1,210 @@
+"""Prometheus metrics — same taxonomy as the reference's kube_batch
+namespace (ref: pkg/scheduler/metrics/metrics.go:38-121), plus solver-kernel
+timings the reference has no counterpart for.
+
+All durations passed to the update functions are SECONDS (Python
+convention); conversion to the reference's ms/us units happens here.
+"""
+from __future__ import annotations
+
+try:
+    from prometheus_client import Counter, Gauge, Histogram
+    _PROM = True
+except Exception:  # pragma: no cover - prometheus is baked in
+    _PROM = False
+
+NAMESPACE = "kube_batch"
+ON_SESSION_OPEN = "OnSessionOpen"
+ON_SESSION_CLOSE = "OnSessionClose"
+
+
+def _buckets(start: float, factor: float, count: int):
+    out, v = [], start
+    for _ in range(count):
+        out.append(v)
+        v *= factor
+    return out
+
+
+if _PROM:
+    e2e_scheduling_latency = Histogram(
+        "e2e_scheduling_latency_milliseconds",
+        "E2e scheduling latency in milliseconds "
+        "(scheduling algorithm + binding)",
+        namespace=NAMESPACE, buckets=_buckets(5, 2, 10))
+    plugin_scheduling_latency = Histogram(
+        "plugin_scheduling_latency_microseconds",
+        "Plugin scheduling latency in microseconds",
+        ["plugin", "OnSession"],
+        namespace=NAMESPACE, buckets=_buckets(5, 2, 10))
+    action_scheduling_latency = Histogram(
+        "action_scheduling_latency_microseconds",
+        "Action scheduling latency in microseconds",
+        ["action"], namespace=NAMESPACE, buckets=_buckets(5, 2, 10))
+    task_scheduling_latency = Histogram(
+        "task_scheduling_latency_microseconds",
+        "Task scheduling latency in microseconds",
+        namespace=NAMESPACE, buckets=_buckets(5, 2, 10))
+    schedule_attempts = Counter(
+        "schedule_attempts_total",
+        "Number of attempts to schedule pods, by the result.",
+        ["result"], namespace=NAMESPACE)
+    preemption_victims = Gauge(
+        "pod_preemption_victims", "Number of selected preemption victims",
+        namespace=NAMESPACE)
+    preemption_attempts = Counter(
+        "total_preemption_attempts",
+        "Total preemption attempts in the cluster till now",
+        namespace=NAMESPACE)
+    unschedule_task_count = Gauge(
+        "unschedule_task_count", "Number of tasks could not be scheduled",
+        ["job_id"], namespace=NAMESPACE)
+    unschedule_job_count = Gauge(
+        "unschedule_job_count", "Number of jobs could not be scheduled",
+        namespace=NAMESPACE)
+    job_retry_counts = Counter(
+        "job_retry_counts", "Number of retry counts for one job",
+        ["job_id"], namespace=NAMESPACE)
+    # TPU-native extras (no reference counterpart)
+    solver_kernel_latency = Histogram(
+        "solver_kernel_latency_microseconds",
+        "JAX solver kernel wall time in microseconds",
+        ["kernel"], namespace=NAMESPACE, buckets=_buckets(5, 2, 14))
+    tensorize_latency = Histogram(
+        "tensorize_latency_microseconds",
+        "Snapshot tensorization wall time in microseconds",
+        namespace=NAMESPACE, buckets=_buckets(5, 2, 14))
+
+
+def update_plugin_duration(plugin: str, phase: str, seconds: float) -> None:
+    if _PROM:
+        plugin_scheduling_latency.labels(plugin, phase).observe(seconds * 1e6)
+
+
+def update_action_duration(action: str, seconds: float) -> None:
+    if _PROM:
+        action_scheduling_latency.labels(action).observe(seconds * 1e6)
+
+
+def update_e2e_duration(seconds: float) -> None:
+    if _PROM:
+        e2e_scheduling_latency.observe(seconds * 1e3)
+
+
+def update_task_schedule_duration(seconds: float) -> None:
+    """Task creation -> bind latency, observed at dispatch
+    (ref: framework/session.go:319)."""
+    if _PROM:
+        task_scheduling_latency.observe(seconds * 1e6)
+
+
+def update_task_schedule_durations(seconds_list) -> None:
+    """Batched form for the bulk decision replay: one histogram update per
+    bucket instead of one observe() per task (10k+ dispatches per cycle at
+    the stress configs). Falls back to per-task observe if the
+    prometheus_client internals ever change shape."""
+    if not _PROM or not len(seconds_list):
+        return
+    try:
+        import numpy as _np
+
+        us = _np.asarray(seconds_list, dtype=_np.float64) * 1e6
+        bounds = [float(b) for b in task_scheduling_latency._upper_bounds]
+        counts, _ = _np.histogram(us, bins=[-_np.inf] + bounds[:-1]
+                                  + [_np.inf])
+        for bucket, n in zip(task_scheduling_latency._buckets, counts):
+            if n:
+                bucket.inc(int(n))
+        task_scheduling_latency._sum.inc(float(us.sum()))
+    except Exception:  # pragma: no cover — internals moved; stay correct
+        for s in seconds_list:
+            task_scheduling_latency.observe(s * 1e6)
+
+
+def update_pod_schedule_status(result: str, count: int) -> None:
+    if _PROM and count:
+        schedule_attempts.labels(result).inc(count)
+
+
+def update_preemption_victims_count(count: int) -> None:
+    if _PROM:
+        preemption_victims.set(count)
+
+
+def register_preemption_attempts() -> None:
+    if _PROM:
+        preemption_attempts.inc()
+
+
+def update_unschedule_task_count(job_id: str, count: int) -> None:
+    if _PROM:
+        unschedule_task_count.labels(job_id).set(count)
+
+
+def update_unschedule_job_count(count: int) -> None:
+    if _PROM:
+        unschedule_job_count.set(count)
+
+
+def register_job_retries(job_id: str) -> None:
+    if _PROM:
+        job_retry_counts.labels(job_id).inc()
+
+
+def update_solver_kernel_duration(kernel: str, seconds: float) -> None:
+    if _PROM:
+        solver_kernel_latency.labels(kernel).observe(seconds * 1e6)
+
+
+def update_tensorize_duration(seconds: float) -> None:
+    if _PROM:
+        tensorize_latency.observe(seconds * 1e6)
+
+
+# ---------------------------------------------------------------------------
+# device-side tracing (SURVEY.md sect. 5: keep the reference's histogram
+# taxonomy, add jax.profiler traces around the kernels)
+# ---------------------------------------------------------------------------
+import contextlib
+import os
+
+#: set when the one-shot KUBEBATCH_PROFILE_DIR capture has fired
+_profile_captured = False
+
+
+def solver_trace(name: str):
+    """Context manager annotating a solver dispatch for the jax profiler.
+
+    Always emits a TraceAnnotation (visible in any surrounding profiler
+    session); when KUBEBATCH_PROFILE_DIR is set, the FIRST annotated
+    dispatch of the process also captures a standalone trace of itself
+    into that directory.
+    """
+    try:
+        import jax.profiler as _prof
+    except Exception:  # pragma: no cover - jax always present in this env
+        return contextlib.nullcontext()
+    global _profile_captured
+    target = os.environ.get("KUBEBATCH_PROFILE_DIR", "")
+    if target and not _profile_captured:
+        _profile_captured = True
+
+        @contextlib.contextmanager
+        def _capture():
+            try:
+                _prof.start_trace(target)
+            except Exception:
+                # a surrounding profiler session is already active — the
+                # annotation below still lands in it; a profiling env var
+                # must never abort a scheduling cycle
+                with _prof.TraceAnnotation(name):
+                    yield
+                return
+            try:
+                with _prof.TraceAnnotation(name):
+                    yield
+            finally:
+                _prof.stop_trace()
+
+        return _capture()
+    return _prof.TraceAnnotation(name)

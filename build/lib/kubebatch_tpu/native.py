@@ -1,0 +1,132 @@
+"""ctypes bindings for the native runtime library (native/kb_native.cpp).
+
+Builds the shared library on first use (g++ via native/Makefile) and
+exposes the per-visit allocate solver over packed numpy arrays — the
+native HOST backend (allocate mode "native") and the large-scale
+differential oracle for the JAX kernels. Falls back gracefully when no
+compiler is available (KUBEBATCH_NATIVE=0 disables explicitly).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .api import NodeInfo
+from .kernels.solver import ALLOC, ALLOC_OB, FAIL, PIPELINE, Decision
+from .kernels.tensorize import NodeState, TaskBatch
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "kb_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    if os.environ.get("KUBEBATCH_NATIVE", "1") in ("0", "false"):
+        _load_failed = True
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH):
+                subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
+                               check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.kb_abi_version.restype = ctypes.c_int32
+            if lib.kb_abi_version() != 1:
+                raise OSError("kb_native ABI mismatch")
+            lib.kb_pack_resources.argtypes = [_f64p, ctypes.c_int64, _f32p]
+            lib.kb_solve_job.restype = ctypes.c_int32
+            lib.kb_solve_job.argtypes = [
+                _f32p, _f32p, _f32p, _i32p, _i32p, _u8p, ctypes.c_int64,
+                _f32p, _f32p, _u8p, ctypes.c_int64, _f32p, _u8p,
+                ctypes.c_int32, ctypes.c_int32, _i32p, _i32p]
+            _lib = lib
+        except Exception:
+            _load_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+class NativeSession:
+    """Per-session native node state — the host-backend twin of
+    kernels.solver.DeviceSession (same solve_job contract)."""
+
+    def __init__(self, nodes: Dict[str, NodeInfo], min_bucket: int = 8):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("kb_native library unavailable")
+        self._lib = lib
+        self.state = NodeState.from_nodes(nodes, min_bucket)
+        self.idle = np.ascontiguousarray(self.state.idle)
+        self.releasing = np.ascontiguousarray(self.state.releasing)
+        self.backfilled = np.ascontiguousarray(self.state.backfilled)
+        self.max_task_num = np.ascontiguousarray(self.state.max_task_num)
+        self.n_tasks = np.ascontiguousarray(self.state.n_tasks)
+        self.node_ok = np.ascontiguousarray(
+            (self.state.schedulable & self.state.valid).astype(np.uint8))
+
+    @property
+    def n_padded(self) -> int:
+        return self.state.n_padded
+
+    def node_name(self, idx: int) -> str:
+        return self.state.names[idx]
+
+    def node_index(self, name: str) -> Optional[int]:
+        return self.state.index.get(name)
+
+    def resync(self, nodes: Dict[str, NodeInfo]) -> None:
+        fresh = NativeSession(nodes, min_bucket=self.n_padded)
+        self.__dict__.update(fresh.__dict__)
+
+    def solve_job(self, batch: TaskBatch, min_available: int,
+                  init_allocated: int,
+                  scores: Optional[np.ndarray] = None,
+                  pred_mask: Optional[np.ndarray] = None,
+                  dyn=None) -> Tuple[List[Decision], bool]:
+        # the native solver has no dynamic-score support; the action only
+        # routes here when no node-order callback is registered (dyn None)
+        t_pad, n_pad = batch.t_padded, self.n_padded
+        if scores is None:
+            scores = np.zeros((t_pad, n_pad), np.float32)
+        if pred_mask is None:
+            pred_mask = np.ones((t_pad, n_pad), bool)
+        decisions = np.zeros(t_pad, np.int32)
+        node_idx = np.zeros(t_pad, np.int32)
+        ready = self._lib.kb_solve_job(
+            self.idle, self.releasing, self.backfilled, self.max_task_num,
+            self.n_tasks, self.node_ok, n_pad,
+            np.ascontiguousarray(batch.resreq),
+            np.ascontiguousarray(batch.init_resreq),
+            np.ascontiguousarray(batch.valid.astype(np.uint8)), t_pad,
+            np.ascontiguousarray(scores.astype(np.float32)),
+            np.ascontiguousarray(pred_mask.astype(np.uint8)),
+            np.int32(min_available), np.int32(init_allocated),
+            decisions, node_idx)
+        out: List[Decision] = []
+        for i in range(len(batch.tasks)):
+            kind = int(decisions[i])
+            name = (self.state.names[int(node_idx[i])]
+                    if kind in (ALLOC, ALLOC_OB, PIPELINE) else "")
+            out.append(Decision(kind, name))
+        return out, bool(ready)

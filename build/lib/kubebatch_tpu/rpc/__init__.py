@@ -1,0 +1,6 @@
+"""gRPC snapshot/decision boundary for the TPU solver sidecar."""
+from . import solver_pb2
+from .client import SolverClient
+from .server import make_server, solve_snapshot
+
+__all__ = ["solver_pb2", "SolverClient", "make_server", "solve_snapshot"]

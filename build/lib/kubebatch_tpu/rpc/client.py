@@ -1,0 +1,219 @@
+"""Client for the solver sidecar: builds a SnapshotRequest from a Session
+and applies the returned decisions — the front-end half of the gRPC
+boundary (SURVEY.md sect. 2.9). The wire carries the FULL policy-term
+payload the in-process engines consume: sig-indexed predicate/score
+matrices, dynamic nodeorder weights with their per-task / per-node
+nonzero-request inputs, and the drf/proportion fairness seeds."""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import grpc
+import numpy as np
+
+from ..actions.cycle_inputs import (cycle_supported, gang_enabled,
+                                    job_order_spec)
+from ..api import TaskStatus, ready_statuses
+from ..framework import Session
+from ..kernels.fused import (ALLOC, ALLOC_OB, K_DRF_SHARE, K_PRIORITY,
+                             PIPELINE)
+from ..kernels.tensorize import NodeState, nz_request_vec
+from ..kernels.terms import solver_terms
+from . import solver_pb2
+from .server import SERVICE
+
+
+class _StateShim:
+    """Adapter: solver_terms reads only ``.state`` off its device arg, so
+    the client can encode terms from a host-side NodeState without a
+    device upload."""
+
+    def __init__(self, state: NodeState):
+        self.state = state
+
+
+#: process-wide client per sidecar address (KUBEBATCH_SOLVER=rpc mode —
+#: one channel per daemon, not one per cycle)
+_CLIENTS: Dict[str, "SolverClient"] = {}
+
+
+def get_solver_client(target: str) -> "SolverClient":
+    client = _CLIENTS.get(target)
+    if client is None:
+        client = _CLIENTS[target] = SolverClient(target)
+    return client
+
+
+class SolverClient:
+    def __init__(self, target: str):
+        self._channel = grpc.insecure_channel(target)
+        self._solve = self._channel.unary_unary(
+            f"/{SERVICE}/Solve",
+            request_serializer=solver_pb2.SnapshotRequest.SerializeToString,
+            response_deserializer=solver_pb2.DecisionsResponse.FromString)
+
+    def close(self):
+        self._channel.close()
+
+    # ------------------------------------------------------------------
+    def snapshot_from_session(self, ssn: Session):
+        """Returns (SnapshotRequest, {task_uid: TaskInfo}). Raises
+        ValueError for configurations the sidecar kernel cannot express
+        (custom order fns, predicate/node-order plugins) — silent
+        divergence from the in-process path is worse than an error."""
+        if not cycle_supported(ssn):
+            raise ValueError(
+                "session plugins exceed the sidecar solver's vocabulary; "
+                "run allocate in-process for this configuration")
+        req = solver_pb2.SnapshotRequest()
+        node_names = sorted(ssn.nodes)
+        node_index = {n: i for i, n in enumerate(node_names)}
+        for name in node_names:
+            ni = ssn.nodes[name]
+            req.nodes.names.append(name)
+            req.nodes.idle.extend(ni.idle.to_vec().tolist())
+            req.nodes.releasing.extend(ni.releasing.to_vec().tolist())
+            req.nodes.backfilled.extend(ni.backfilled.to_vec().tolist())
+            req.nodes.max_task_num.append(ni.allocatable.max_task_num)
+            req.nodes.n_tasks.append(len(ni.tasks))
+            req.nodes.schedulable.append(
+                ni.node is not None and not ni.node.unschedulable)
+
+        queue_names = sorted(ssn.queues)
+        q_index = {q: i for i, q in enumerate(queue_names)}
+        prop = ssn.plugins.get("proportion")
+        for qn in queue_names:
+            req.queues.names.append(qn)
+            req.queues.weight.append(ssn.queues[qn].weight)
+            attr = getattr(prop, "queue_opts", {}).get(qn) if prop else None
+            if attr is not None:
+                req.queues.deserved.extend(attr.deserved.to_vec().tolist())
+                req.queues.allocated.extend(attr.allocated.to_vec().tolist())
+            else:
+                req.queues.deserved.extend([0.0, 0.0, 0.0])
+                req.queues.allocated.extend([0.0, 0.0, 0.0])
+
+        jobs = [jb for jb in ssn.jobs.values() if jb.queue in q_index]
+        rank = {jb.uid: r for r, jb in enumerate(
+            sorted(jobs, key=lambda x: (x.creation_timestamp, x.uid)))}
+        tasks_by_uid: Dict[str, object] = {}
+        for ji, jb in enumerate(jobs):
+            req.jobs.uids.append(jb.uid)
+            req.jobs.min_available.append(jb.min_available)
+            req.jobs.init_ready.append(jb.count(*ready_statuses()))
+            req.jobs.queue_index.append(q_index[jb.queue])
+            req.jobs.priority.append(jb.priority)
+            req.jobs.create_rank.append(rank[jb.uid])
+            pend = [t for t in jb.task_status_index.get(TaskStatus.PENDING,
+                                                        {}).values()
+                    if not t.resreq.is_empty()]
+            pend.sort(key=functools.cmp_to_key(
+                lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
+            for r, t in enumerate(pend):
+                req.tasks.uids.append(t.uid)
+                req.tasks.resreq.extend(t.resreq.to_vec().tolist())
+                req.tasks.init_resreq.extend(t.init_resreq.to_vec().tolist())
+                req.tasks.job_index.append(ji)
+                req.tasks.rank.append(r)
+                tasks_by_uid[t.uid] = t
+
+        # derive flags the same way the in-process fused path does, so
+        # per-tier disable flags are honored identically
+        job_keys, _ = job_order_spec(ssn)
+        req.gang_enabled = gang_enabled(ssn)
+        req.proportion_enabled = (
+            "proportion" in ssn.overused_fns
+            and any(opt.name == "proportion" for tier in ssn.tiers
+                    for opt in tier.plugins))
+        req.drf_enabled = K_DRF_SHARE in job_keys
+        req.priority_enabled = K_PRIORITY in job_keys
+        req.job_order_keys.extend(job_keys)  # exact tier-dispatch order
+        drf = ssn.plugins.get("drf")
+        if drf is not None:
+            req.cluster_total.extend(
+                drf.total_resource.to_vec().tolist())
+            for jb in jobs:
+                attr = drf.job_opts.get(jb.uid)
+                vec = (attr.allocated.to_vec() if attr is not None
+                       else np.zeros(3, np.float32))
+                req.jobs.allocated.extend(vec.tolist())
+
+        self._attach_terms(ssn, req, node_names, tasks_by_uid)
+        return req, tasks_by_uid
+
+    @staticmethod
+    def _attach_terms(ssn: Session, req, node_names: List[str],
+                      tasks_by_uid: Dict[str, object]) -> None:
+        """Encode the predicate/score terms (kernels/terms) into the wire
+        payload. Raises ValueError for snapshots whose callbacks the
+        kernels cannot express (inter-pod affinity, host ports, custom
+        plugins) — silent divergence is worse than an error."""
+        pending = list(tasks_by_uid.values())
+        state = NodeState.from_nodes(ssn.nodes)
+        terms = solver_terms(ssn, _StateShim(state), pending)
+        if terms is None:
+            raise ValueError(
+                "session predicates/score callbacks exceed the sidecar "
+                "solver's vocabulary; run allocate in-process")
+        n = len(node_names)
+        t = req.terms
+        static = terms.static
+        t.n_sigs = static.n_sigs
+        t.sig_pred.extend(
+            np.asarray(static.pred[:, :n], bool).reshape(-1).tolist())
+        t.sig_scores.extend(
+            np.asarray(static.score[:, :n], np.float32).reshape(-1).tolist())
+        t.task_sig.extend(static.sig_of[uid] for uid in tasks_by_uid)
+        # task_nz always travels: the batched engine's waterfall cohorts
+        # are (sig, nonzero-request) pairs even with dynamic scoring off
+        for task in pending:
+            t.task_nz.extend(
+                nz_request_vec(task.resreq.to_vec()).tolist())
+        if terms.dynamic.enabled:
+            t.least_requested_weight = terms.dynamic.least_requested
+            t.balanced_resource_weight = terms.dynamic.balanced_resource
+            t.node_nz.extend(
+                state.nz_requested[:n].reshape(-1).tolist())
+            t.allocatable_cm.extend(
+                state.allocatable[:n, :2].reshape(-1).tolist())
+
+    def solve(self, req, timeout: float = 60.0
+              ) -> solver_pb2.DecisionsResponse:
+        """The remote call alone — no session mutation. Callers that want
+        a fallback path must fall back BEFORE apply_decisions runs;
+        after the replay starts the session is committed to the remote
+        decisions."""
+        return self._solve(req, timeout=timeout)
+
+    @staticmethod
+    def apply_decisions(ssn: Session, resp, tasks_by_uid) -> None:
+        """Replay the remote decisions through the Session. A pre-mutation
+        volume-allocation failure skips that task (it stays Pending and
+        reschedules next cycle — the remote solver cannot offer the
+        ordered path's try-next-node, ref allocate.go:157-161); any other
+        error propagates, it must NOT be treated as sidecar
+        unavailability."""
+        from ..framework import VolumeAllocationError
+
+        decisions = [d for d in resp.decisions if d.order >= 0]
+        decisions.sort(key=lambda d: d.order)
+        for d in decisions:
+            task = tasks_by_uid.get(d.task_uid)
+            if task is None:
+                continue
+            try:
+                if d.kind in (ALLOC, ALLOC_OB):
+                    ssn.allocate(task, d.node_name, d.kind == ALLOC_OB)
+                elif d.kind == PIPELINE:
+                    ssn.pipeline(task, d.node_name)
+            except VolumeAllocationError:
+                continue
+
+    def solve_and_apply(self, ssn: Session,
+                        timeout: float = 60.0) -> solver_pb2.DecisionsResponse:
+        """One remote solve; decisions replayed through the Session."""
+        req, tasks_by_uid = self.snapshot_from_session(ssn)
+        resp = self.solve(req, timeout=timeout)
+        self.apply_decisions(ssn, resp, tasks_by_uid)
+        return resp

@@ -1,0 +1,330 @@
+"""gRPC solver sidecar — dense snapshots in, assignment decisions out.
+
+Serves the allocate kernels behind the Solver service defined in
+solver.proto, selecting the engine by snapshot size exactly like the
+in-process auto mode (actions/allocate.py): snapshots at or above
+AUTO_BATCHED_MIN pending tasks run the round-based batched engine,
+smaller ones the bind-for-bind fused engine. The service wiring is
+hand-written over grpc generic handlers (grpcio-tools is not available
+in this image; message classes are protoc-generated into solver_pb2.py).
+"""
+from __future__ import annotations
+
+import os
+import time
+from concurrent import futures
+
+import grpc
+import jax.numpy as jnp
+import numpy as np
+
+from ..kernels.fused import (ALLOC, ALLOC_OB, PIPELINE, SKIP,
+                             K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
+                             K_PROP_SHARE, fused_allocate, unpack_host_block)
+from ..kernels.tensorize import pad_to_bucket
+from . import solver_pb2
+
+SERVICE = "kubebatch_tpu.Solver"
+
+
+def _mat(values, n, r=3) -> np.ndarray:
+    out = np.zeros((n, r), np.float32)
+    flat = np.asarray(values, np.float32)
+    out.flat[:flat.size] = flat
+    return out
+
+
+def solve_snapshot(req: solver_pb2.SnapshotRequest
+                   ) -> solver_pb2.DecisionsResponse:
+    nodes, tasks, jobs, queues = req.nodes, req.tasks, req.jobs, req.queues
+    n = len(nodes.names)
+    t = len(tasks.uids)
+    j = len(jobs.uids)
+    q = max(1, len(queues.names))
+    n_pad = pad_to_bucket(n)
+    t_pad = pad_to_bucket(t)
+    j_pad = pad_to_bucket(j, 4)
+    q_pad = pad_to_bucket(q, 4)
+
+    idle = np.zeros((n_pad, 3), np.float32)
+    idle[:n] = _mat(nodes.idle, n)
+    releasing = np.zeros((n_pad, 3), np.float32)
+    releasing[:n] = _mat(nodes.releasing, n)
+    backfilled = np.zeros((n_pad, 3), np.float32)
+    backfilled[:n] = _mat(nodes.backfilled, n)
+    mtn = np.zeros(n_pad, np.int32)
+    mtn[:n] = nodes.max_task_num
+    ntasks = np.zeros(n_pad, np.int32)
+    ntasks[:n] = nodes.n_tasks
+    node_ok = np.zeros(n_pad, bool)
+    node_ok[:n] = nodes.schedulable
+
+    resreq = np.zeros((t_pad, 3), np.float32)
+    resreq[:t] = _mat(tasks.resreq, t)
+    init_resreq = np.zeros((t_pad, 3), np.float32)
+    init_resreq[:t] = _mat(tasks.init_resreq, t)
+    task_job = np.full(t_pad, -1, np.int32)
+    task_job[:t] = tasks.job_index
+    task_rank = np.zeros(t_pad, np.int32)
+    task_rank[:t] = tasks.rank
+    task_valid = np.zeros(t_pad, bool)
+    task_valid[:t] = True
+
+    min_av = np.zeros(j_pad, np.int32)
+    min_av[:j] = jobs.min_available if req.gang_enabled else [0] * j
+    order_min_av = np.zeros(j_pad, np.int32)
+    order_min_av[:j] = jobs.min_available
+    init_ready = np.zeros(j_pad, np.int32)
+    init_ready[:j] = jobs.init_ready
+    job_queue = np.zeros(j_pad, np.int32)
+    job_queue[:j] = jobs.queue_index
+    job_priority = np.zeros(j_pad, np.float32)
+    job_priority[:j] = jobs.priority
+    job_create_rank = np.zeros(j_pad, np.int32)
+    job_create_rank[:j] = jobs.create_rank
+    job_valid = np.zeros(j_pad, bool)
+    job_valid[:j] = True
+
+    q_weight = np.zeros(q_pad, np.float32)
+    q_weight[:len(queues.weight)] = queues.weight
+    q_entries = np.zeros(q_pad, np.int32)
+    for ji_ in range(j):
+        q_entries[jobs.queue_index[ji_]] += 1
+    q_create_rank = np.arange(q_pad, dtype=np.int32)
+    q_deserved = np.zeros((q_pad, 3), np.float32)
+    if len(queues.deserved):
+        q_deserved[:len(queues.names)] = _mat(queues.deserved,
+                                              len(queues.names))
+    q_alloc0 = np.zeros((q_pad, 3), np.float32)
+    if len(queues.allocated):
+        q_alloc0[:len(queues.names)] = _mat(queues.allocated,
+                                            len(queues.names))
+
+    cluster_total = np.ones(3, np.float32)
+    if len(req.cluster_total):
+        cluster_total = np.asarray(req.cluster_total, np.float32)
+
+    if req.job_order_keys:
+        job_keys = [k for k in req.job_order_keys
+                    if k in (K_PRIORITY, K_GANG_READY, K_DRF_SHARE)]
+    else:
+        job_keys = []
+        if req.priority_enabled:
+            job_keys.append(K_PRIORITY)
+        if req.gang_enabled:
+            job_keys.append(K_GANG_READY)
+        if req.drf_enabled:
+            job_keys.append(K_DRF_SHARE)
+    queue_keys = (K_PROP_SHARE,) if req.proportion_enabled else ()
+
+    # policy terms from the wire: sig-indexed predicate/score matrices +
+    # dynamic nodeorder config (PolicyTerms); absent fields fall back to
+    # the trivial space (all nodes allowed, zero scores, dynamics off)
+    terms = req.terms
+    n_sigs = max(1, terms.n_sigs)
+    s_pad = pad_to_bucket(n_sigs, 4)
+    sig_scores = np.zeros((s_pad, n_pad), np.float32)
+    sig_pred = np.zeros((s_pad, n_pad), bool)
+    if terms.n_sigs and len(terms.sig_pred):
+        sig_pred[:n_sigs, :n] = np.asarray(
+            terms.sig_pred, bool).reshape(n_sigs, n)
+        sig_scores[:n_sigs, :n] = np.asarray(
+            terms.sig_scores, np.float32).reshape(n_sigs, n)
+    else:
+        sig_pred[:1, :n] = True
+    task_sig = np.zeros(t_pad, np.int32)
+    if len(terms.task_sig):
+        task_sig[:t] = terms.task_sig
+
+    dyn_weights = np.asarray([terms.least_requested_weight,
+                              terms.balanced_resource_weight], np.float32)
+    dyn_enabled = bool(dyn_weights.any())
+    # task_nz travels regardless of the dynamic flags: the batched
+    # engine's waterfall cohorts are (sig, nonzero-request) pairs even
+    # when dynamic scoring is off
+    task_nz = np.zeros((t_pad, 2), np.float32)
+    allocatable_cm = np.zeros((n_pad, 2), np.float32)
+    nz_req0 = np.zeros((n_pad, 2), np.float32)
+    if len(terms.task_nz):
+        task_nz[:t] = np.asarray(terms.task_nz, np.float32).reshape(t, 2)
+    if len(terms.node_nz):
+        nz_req0[:n] = np.asarray(terms.node_nz, np.float32).reshape(n, 2)
+    if len(terms.allocatable_cm):
+        allocatable_cm[:n] = np.asarray(
+            terms.allocatable_cm, np.float32).reshape(n, 2)
+
+    j_alloc0 = np.zeros((j_pad, 3), np.float32)
+    if len(jobs.allocated):
+        j_alloc0[:j] = _mat(jobs.allocated, j)
+
+    # ---- engine selection by snapshot size (in-process auto parity) ----
+    from ..actions.allocate import AUTO_BATCHED_MIN
+    if t >= AUTO_BATCHED_MIN:
+        return _solve_batched_wire(
+            req, nodes, tasks, n, t,
+            idle=idle, releasing=releasing, backfilled=backfilled,
+            mtn=mtn, ntasks=ntasks, node_ok=node_ok,
+            resreq=resreq, init_resreq=init_resreq, task_job=task_job,
+            task_rank=task_rank, task_valid=task_valid, task_sig=task_sig,
+            sig_scores=sig_scores, sig_pred=sig_pred, task_nz=task_nz,
+            allocatable_cm=allocatable_cm, nz_req0=nz_req0,
+            min_av=min_av, order_min_av=order_min_av,
+            init_ready=init_ready, job_queue=job_queue,
+            job_priority=job_priority, job_create_rank=job_create_rank,
+            job_valid=job_valid, q_weight=q_weight, q_entries=q_entries,
+            q_create_rank=q_create_rank, q_deserved=q_deserved,
+            q_alloc0=q_alloc0, j_alloc0=j_alloc0,
+            cluster_total=cluster_total, dyn_weights=dyn_weights,
+            dyn_enabled=dyn_enabled, job_keys=tuple(job_keys),
+            queue_keys=queue_keys)
+
+    start = time.perf_counter()
+    (host_block, *_device_state) = fused_allocate(
+        idle, releasing, backfilled, jnp.asarray(allocatable_cm),
+        jnp.asarray(nz_req0), mtn, ntasks, node_ok,
+        jnp.asarray(resreq), jnp.asarray(init_resreq),
+        jnp.asarray(task_nz), jnp.asarray(task_job),
+        jnp.asarray(task_rank), jnp.asarray(task_sig),
+        jnp.asarray(task_valid), jnp.asarray(sig_scores),
+        jnp.asarray(sig_pred),
+        jnp.asarray(min_av), jnp.asarray(order_min_av),
+        jnp.asarray(init_ready), jnp.asarray(job_queue),
+        jnp.asarray(job_priority), jnp.asarray(job_create_rank),
+        jnp.asarray(job_valid), jnp.asarray(q_weight),
+        jnp.asarray(q_entries), jnp.asarray(q_create_rank),
+        jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
+        jnp.asarray(j_alloc0), jnp.asarray(cluster_total),
+        jnp.asarray(dyn_weights),
+        job_keys=tuple(job_keys), queue_keys=queue_keys,
+        gang_enabled=req.gang_enabled,
+        prop_overused=req.proportion_enabled,
+        dyn_enabled=dyn_enabled,
+        max_iters=int(t_pad + 3 * j_pad + q_pad + 8))
+    solve_ms = (time.perf_counter() - start) * 1e3
+    host_block = np.asarray(host_block)   # one device->host transfer
+    task_state, task_node, task_seq, iters = unpack_host_block(host_block)
+
+    resp = solver_pb2.DecisionsResponse(solve_ms=solve_ms,
+                                        iterations=int(iters))
+    for i in range(t):
+        kind = int(task_state[i])
+        resp.decisions.append(solver_pb2.Decision(
+            task_uid=tasks.uids[i], kind=kind,
+            node_name=(nodes.names[int(task_node[i])]
+                       if kind in (ALLOC, ALLOC_OB, PIPELINE) else ""),
+            order=int(task_seq[i]) if kind != SKIP else -1))
+    return resp
+
+
+class _WireDevice:
+    """DeviceSession stand-in for the sidecar: just the capacity arrays
+    solve_batched reads and commits (no cross-cycle reuse server-side —
+    every request carries its own snapshot)."""
+
+    def __init__(self, idle, releasing, backfilled, allocatable_cm, nz_req,
+                 n_tasks, max_task_num, node_ok):
+        self.idle = jnp.asarray(idle)
+        self.releasing = jnp.asarray(releasing)
+        self.backfilled = jnp.asarray(backfilled)
+        self.allocatable_cm = jnp.asarray(allocatable_cm)
+        self.nz_req = jnp.asarray(nz_req)
+        self.n_tasks = jnp.asarray(n_tasks)
+        self.max_task_num = jnp.asarray(max_task_num)
+        self.node_ok = jnp.asarray(node_ok)
+
+
+def _solve_batched_wire(req, nodes, tasks, n, t, *, idle, releasing,
+                        backfilled, mtn, ntasks, node_ok, resreq,
+                        init_resreq, task_job, task_rank, task_valid,
+                        task_sig, sig_scores, sig_pred, task_nz,
+                        allocatable_cm, nz_req0, min_av, order_min_av,
+                        init_ready, job_queue, job_priority,
+                        job_create_rank, job_valid, q_weight, q_entries,
+                        q_create_rank, q_deserved, q_alloc0, j_alloc0,
+                        cluster_total, dyn_weights, dyn_enabled, job_keys,
+                        queue_keys) -> solver_pb2.DecisionsResponse:
+    """Round-engine path: rebuild CycleInputs from the wire arrays and
+    run the same solve_batched the in-process batched mode uses."""
+    from ..actions.cycle_inputs import CycleInputs
+    from ..kernels.batched import solve_batched
+
+    inputs = CycleInputs(
+        queue_ids=list(req.queues.names), jobs=[], tasks=[None] * t,
+        device=None,
+        resreq=resreq, init_resreq=init_resreq, resreq_raw=None,
+        task_nz=task_nz, task_job=task_job, task_rank=task_rank,
+        task_sig=task_sig, task_valid=task_valid,
+        sig_scores=sig_scores, sig_pred=sig_pred,
+        min_available=min_av, order_min_available=order_min_av,
+        init_allocated=init_ready, job_queue=job_queue,
+        job_priority=job_priority, job_create_rank=job_create_rank,
+        job_valid=job_valid,
+        q_weight=q_weight, q_entries=q_entries,
+        q_create_rank=q_create_rank, q_deserved=q_deserved,
+        q_alloc0=q_alloc0, j_alloc0=j_alloc0,
+        cluster_total=cluster_total,
+        dyn_weights=dyn_weights, dyn_enabled=dyn_enabled,
+        job_keys=job_keys, queue_keys=queue_keys,
+        gang_enabled=req.gang_enabled,
+        prop_overused=req.proportion_enabled,
+        # strictly-positive like the in-process derivation
+        # (cycle_inputs.py pipe_enabled) — negative releasing rows
+        # (pipelined reuse) must not enable the pipeline path
+        pipe_enabled=bool((np.asarray(releasing)[:n] > 0).any()))
+    device = _WireDevice(idle, releasing, backfilled, allocatable_cm,
+                         nz_req0, ntasks, mtn, node_ok)
+    start = time.perf_counter()
+    task_state, task_node, task_seq, rounds = solve_batched(device, inputs)
+    solve_ms = (time.perf_counter() - start) * 1e3
+
+    resp = solver_pb2.DecisionsResponse(solve_ms=solve_ms,
+                                        iterations=int(rounds))
+    for i in range(t):
+        kind = int(task_state[i])
+        resp.decisions.append(solver_pb2.Decision(
+            task_uid=tasks.uids[i], kind=kind,
+            node_name=(nodes.names[int(task_node[i])]
+                       if kind in (ALLOC, ALLOC_OB, PIPELINE) else ""),
+            order=int(task_seq[i]) if kind != SKIP else -1))
+    return resp
+
+
+def _solve_handler(request: bytes, context) -> bytes:
+    req = solver_pb2.SnapshotRequest.FromString(request)
+    return solve_snapshot(req).SerializeToString()
+
+
+def make_server(address: str = "127.0.0.1:0",
+                max_workers: int = 4) -> tuple:
+    """Returns (grpc.Server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    handler = grpc.method_handlers_generic_handler(SERVICE, {
+        "Solve": grpc.unary_unary_rpc_method_handler(
+            _solve_handler,
+            request_deserializer=None,   # raw bytes in
+            response_serializer=None),   # raw bytes out
+    })
+    server.add_generic_rpc_handlers((handler,))
+    port = server.add_insecure_port(address)
+    return server, port
+
+
+def serve(address: str = "127.0.0.1:50061") -> None:  # pragma: no cover
+    server, port = make_server(address)
+    server.start()
+    print(f"kubebatch-tpu solver sidecar listening on port {port}")
+    lease_port = os.environ.get("KUBEBATCH_LEASE_PORT")
+    if lease_port:
+        # the sidecar doubles as the cross-host leader-election medium
+        # (runtime/leaderelection.HttpLease points replicas here — the
+        # analogue of the reference's ConfigMap lock on the API server,
+        # cmd/kube-batch/app/server.go:170-193)
+        from ..runtime.leaderelection import HttpLeaseServer
+
+        bound = HttpLeaseServer(port=int(lease_port)).start()
+        print(f"lease service on port {bound}")
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    serve()

@@ -1,0 +1,6 @@
+"""Scheduler loop, policy config, CLI, leader election
+(ref: pkg/scheduler + cmd/kube-batch)."""
+from .scheduler import (DEFAULT_SCHEDULER_CONF, Scheduler,
+                        load_scheduler_conf)
+
+__all__ = ["DEFAULT_SCHEDULER_CONF", "Scheduler", "load_scheduler_conf"]

@@ -1,0 +1,180 @@
+"""CLI entry — flags mirror the reference's ServerOption
+(ref: cmd/kube-batch/app/options/options.go:222-268,
+cmd/kube-batch/app/server.go).
+
+Without a Kubernetes API server, the cluster source is the synthetic sim
+(--sim-config N picks a BASELINE config); a real informer-backed source
+would plug in through the same SchedulerCache handler surface. The
+/metrics endpoint serves the kube_batch Prometheus taxonomy.
+
+Run:  python -m kubebatch_tpu --sim-config 2 --schedule-period 1
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubebatch-tpu",
+        description="TPU-native batch/gang scheduler (kube-batch capability"
+                    " set)")
+    # reference flags (options.go:243-258)
+    p.add_argument("--master", default="",
+                   help="the address of the Kubernetes API server (unused "
+                        "in sim mode)")
+    p.add_argument("--kubeconfig", default="",
+                   help="path to kubeconfig file (unused in sim mode)")
+    p.add_argument("--scheduler-name", default="kube-batch",
+                   help="vc-scheduler name in pod spec")
+    p.add_argument("--scheduler-conf", default="",
+                   help="path to the YAML policy configuration")
+    p.add_argument("--schedule-period", type=float, default=1.0,
+                   help="the period between each scheduling cycle (s)")
+    p.add_argument("--default-queue", default="default",
+                   help="the default queue name of the job")
+    p.add_argument("--enable-preemption", action="store_true",
+                   help="whether to enable preemption")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="HA leader election among replicas")
+    p.add_argument("--lock-object-namespace", default="",
+                   help="namespace of the lock object / directory of the "
+                        "lease file")
+    p.add_argument("--leader-elect-url", default="",
+                   help="elect through an HTTP lease service instead of "
+                        "the lease file (cross-host replicas; e.g. the "
+                        "rpc sidecar with KUBEBATCH_LEASE_PORT set)")
+    p.add_argument("--listen-address", default=":8080",
+                   help="address for the /metrics endpoint")
+    p.add_argument("--version", action="store_true",
+                   help="show version and quit")
+    p.add_argument("--v", type=int, default=0, dest="verbosity",
+                   help="log level verbosity (glog-style: 0 = warnings, "
+                        "1+ = per-cycle lines, 3+ = per-action detail)")
+    # sim-mode extensions
+    p.add_argument("--sim-config", type=int, default=0,
+                   choices=[0, 1, 2, 3, 4, 5],
+                   help="populate from a BASELINE sim config (0 = empty "
+                        "cluster)")
+    p.add_argument("--cycles", type=int, default=0,
+                   help="stop after N cycles (0 = run forever)")
+    p.add_argument("--solver", default="",
+                   choices=["", "auto", "host", "jax", "fused", "batched",
+                            "sharded", "native"],
+                   help="override the allocate solver mode")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        from .. import __version__
+        print(f"kubebatch-tpu {__version__}")
+        return 0
+
+    import logging
+
+    level = (logging.WARNING if args.verbosity <= 0
+             else logging.INFO if args.verbosity < 3 else logging.DEBUG)
+    logging.basicConfig(
+        level=level,
+        format="%(levelname).1s%(asctime)s %(name)s] %(message)s",
+        datefmt="%m%d %H:%M:%S")
+
+    import os
+
+    if args.solver:
+        os.environ["KUBEBATCH_SOLVER"] = args.solver
+
+    # accelerator wedge watchdog: a hung transport must degrade the daemon
+    # to host scheduling, not hang its first kernel dispatch forever
+    from .watchdog import ensure_responsive_backend
+    ensure_responsive_backend()
+
+    from ..cache import SchedulerCache
+    from ..sim import baseline_cluster
+    from .scheduler import Scheduler
+
+    # /metrics endpoint (ref: server.go:138-141)
+    if args.listen_address:
+        try:
+            from prometheus_client import start_http_server
+            host, _, port = args.listen_address.rpartition(":")
+            start_http_server(int(port), addr=host or "0.0.0.0")
+        except Exception as e:  # pragma: no cover
+            print(f"metrics endpoint disabled: {e}", file=sys.stderr)
+
+    cache = SchedulerCache(scheduler_name=args.scheduler_name,
+                           default_queue=args.default_queue)
+    if args.sim_config:
+        sim = baseline_cluster(args.sim_config)
+        sim.populate(cache)
+        cache.pod_lister = sim.pod_lister
+
+    conf_str = ""
+    if args.scheduler_conf:
+        # unreadable conf falls back to the compiled-in default, like the
+        # reference (scheduler.go:71-77)
+        try:
+            with open(args.scheduler_conf) as f:
+                conf_str = f.read()
+        except OSError as e:
+            print(f"failed to read scheduler conf, using default: {e}",
+                  file=sys.stderr)
+
+    sched = Scheduler(cache, scheduler_conf=conf_str,
+                      schedule_period=args.schedule_period,
+                      enable_preemption=args.enable_preemption)
+
+    stop = threading.Event()
+
+    def handle_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, handle_signal)
+    signal.signal(signal.SIGTERM, handle_signal)
+
+    def run_workload(workload_stop: threading.Event) -> None:
+        if args.cycles:
+            cache.run()
+            for _ in range(args.cycles):
+                if stop.is_set() or workload_stop.is_set():
+                    break
+                sched.run_once()
+        else:
+            merged = threading.Event()
+
+            def bridge():
+                while not (stop.is_set() or workload_stop.is_set()):
+                    stop.wait(0.2)
+                merged.set()
+
+            threading.Thread(target=bridge, daemon=True).start()
+            sched.run(merged)
+
+    if args.leader_elect:
+        if args.leader_elect_url:
+            from .leaderelection import HttpLease
+
+            lease = HttpLease(args.leader_elect_url)
+        else:
+            from .leaderelection import FileLease
+
+            lease_dir = args.lock_object_namespace or "/tmp"
+            lease = FileLease(f"{lease_dir}/kube-batch-leader.lock")
+
+        def fatal():
+            print("leaderelection lost", file=sys.stderr)
+            sys.exit(1)
+
+        lease.run(run_workload, fatal, stop)
+    else:
+        run_workload(threading.Event())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,338 @@
+"""Leader election — HA for multiple scheduler replicas.
+
+The reference elects through a ConfigMap resource lock with a
+15s lease / 10s renew / 5s retry (cmd/kube-batch/app/server.go:103-106,
+170-193) and kills the process on lost leadership. That design separates
+cleanly into:
+
+- a **lock backend** (the shared compare-and-swap medium — the reference
+  uses the API server's resourcelock), here the ``LeaseLock`` seam:
+  `try_acquire_or_renew()` must atomically grant the lease iff it is
+  free, expired, or already ours;
+- the **elector loop** (acquire, renew on a deadline, fatal on loss),
+  here ``LeaderElector`` — backend-independent, semantics preserved.
+
+Two backends ship:
+
+- ``FileLease`` — a lock file on a shared filesystem (single-host /
+  shared-volume replicas), CAS via an flock guard;
+- ``HttpLease`` — a lease endpoint over HTTP for replicas on DIFFERENT
+  hosts; ``HttpLeaseServer`` is the matching stdlib server (embed it in
+  the rpc sidecar or run it standalone — the analogue of the reference
+  pointing every replica at the API server). A documented k8s Lease
+  implementation would slot behind the same seam via the adapter's
+  `CustomObjectsApi` (cache/k8s_source.py) — not shipped, no API server
+  in scope.
+
+Both backends pass the same acquire/renew/loss/fatal contract tests
+(tests/test_runtime.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Optional, Protocol, runtime_checkable
+
+
+def _default_identity() -> str:
+    return f"{socket.gethostname()}_{uuid.uuid4()}"
+
+
+@runtime_checkable
+class LeaseLock(Protocol):
+    """The shared-medium seam (ref: client-go resourcelock.Interface as
+    used at server.go:170-181)."""
+
+    identity: str
+
+    def try_acquire_or_renew(self) -> bool:
+        """Atomically: grant the lease to ``identity`` iff it is unheld,
+        expired, or already held by ``identity``; refresh the renew time
+        on success."""
+        ...
+
+
+class LeaderElector:
+    """Backend-independent elector (ref: leaderelection.RunOrDie at
+    server.go:182-193): block until acquired, renew within the deadline,
+    signal the workload and call ``on_stopped_leading`` on loss —
+    callers treat loss as fatal, like the reference's glog.Fatalf."""
+
+    def __init__(self, lock: LeaseLock, lease_duration: float = 15.0,
+                 renew_deadline: float = 10.0, retry_period: float = 5.0):
+        self.lock = lock
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+
+    def run(self, on_started_leading: Callable[[threading.Event], None],
+            on_stopped_leading: Callable[[], None],
+            stop: Optional[threading.Event] = None) -> None:
+        stop = stop or threading.Event()
+        while not stop.is_set():
+            if self.lock.try_acquire_or_renew():
+                break
+            stop.wait(self.retry_period)
+        if stop.is_set():
+            return
+
+        lost = threading.Event()
+
+        def renew_loop():
+            while not stop.is_set() and not lost.is_set():
+                deadline = time.time() + self.renew_deadline
+                ok = False
+                while time.time() < deadline:
+                    if self.lock.try_acquire_or_renew():
+                        ok = True
+                        break
+                    stop.wait(min(1.0, self.retry_period))
+                if not ok:
+                    lost.set()
+                    return
+                stop.wait(self.retry_period)
+
+        renewer = threading.Thread(target=renew_loop, daemon=True,
+                                   name="kb-lease-renew")
+        renewer.start()
+
+        workload_stop = threading.Event()
+
+        def watchdog():
+            while not stop.is_set() and not lost.is_set():
+                lost.wait(0.2)
+            workload_stop.set()
+
+        threading.Thread(target=watchdog, daemon=True,
+                         name="kb-lease-watchdog").start()
+        try:
+            on_started_leading(workload_stop)
+        finally:
+            if lost.is_set():
+                on_stopped_leading()
+
+
+class FileLease:
+    """Lock-file backend: the shared medium is a file on a common
+    filesystem carrying the holder's identity and lease expiry; the
+    read-check-write runs under an flock guard so two replicas racing an
+    empty/expired lease cannot both win (the reference gets this
+    atomicity from the API server's compare-and-swap)."""
+
+    def __init__(self, path: str, lease_duration: float = 15.0,
+                 renew_deadline: float = 10.0, retry_period: float = 5.0,
+                 identity: Optional[str] = None):
+        self.path = path
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.identity = identity or _default_identity()
+
+    def _read(self):
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write(self) -> bool:
+        record = {"holder": self.identity,
+                  "renew_time": time.time(),
+                  "lease_duration": self.lease_duration}
+        tmp = f"{self.path}.{self.identity}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, self.path)
+            return True
+        except OSError:
+            return False
+
+    def try_acquire_or_renew(self) -> bool:
+        import fcntl
+
+        guard_path = f"{self.path}.guard"
+        try:
+            guard = open(guard_path, "a+")
+        except OSError:
+            return False
+        try:
+            fcntl.flock(guard, fcntl.LOCK_EX)
+            rec = self._read()
+            now = time.time()
+            if rec is not None and rec.get("holder") != self.identity:
+                expires = rec.get("renew_time", 0) + rec.get(
+                    "lease_duration", self.lease_duration)
+                if now < expires:
+                    return False  # someone else holds a live lease
+            return self._write()
+        finally:
+            fcntl.flock(guard, fcntl.LOCK_UN)
+            guard.close()
+
+    def run(self, on_started_leading: Callable[[threading.Event], None],
+            on_stopped_leading: Callable[[], None],
+            stop: Optional[threading.Event] = None) -> None:
+        """Back-compat wrapper: elect with this file as the lock."""
+        LeaderElector(self, self.lease_duration, self.renew_deadline,
+                      self.retry_period).run(on_started_leading,
+                                             on_stopped_leading, stop)
+
+
+# ---------------------------------------------------------------------
+# cross-host backend: lease over HTTP
+# ---------------------------------------------------------------------
+
+class HttpLease:
+    """Cross-host lock backend: the CAS lives in one ``HttpLeaseServer``
+    (e.g. embedded in the rpc solver sidecar) that every replica points
+    at — the structural analogue of the reference's replicas all talking
+    to the API server's ConfigMap lock."""
+
+    def __init__(self, url: str, lease_duration: float = 15.0,
+                 renew_deadline: float = 10.0, retry_period: float = 5.0,
+                 identity: Optional[str] = None, timeout: float = 3.0):
+        base = url.rstrip("/")
+        self.url = base if base.endswith("/lease") else base + "/lease"
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.identity = identity or _default_identity()
+        self.timeout = timeout
+        self._err_logged = False
+
+    def try_acquire_or_renew(self) -> bool:
+        import urllib.request
+
+        body = json.dumps({"holder": self.identity,
+                           "lease_duration": self.lease_duration}).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                out = json.loads(resp.read().decode())
+        except Exception as e:
+            # unreachable server = cannot prove the lease — treat as not
+            # renewed (the elector's deadline turns persistent failures
+            # into loss-of-leadership, exactly like API-server outages).
+            # Log the transition once so a misconfigured URL/port is
+            # distinguishable from legitimate contention.
+            if not self._err_logged:
+                self._err_logged = True
+                import logging
+                logging.getLogger("kubebatch").warning(
+                    "lease service %s unreachable (%s: %s); reading as "
+                    "not-acquired", self.url, type(e).__name__, e)
+            return False
+        self._err_logged = False
+        return bool(out.get("acquired"))
+
+    def run(self, on_started_leading: Callable[[threading.Event], None],
+            on_stopped_leading: Callable[[], None],
+            stop: Optional[threading.Event] = None) -> None:
+        LeaderElector(self, self.lease_duration, self.renew_deadline,
+                      self.retry_period).run(on_started_leading,
+                                             on_stopped_leading, stop)
+
+
+class HttpLeaseServer:
+    """The lease CAS as a tiny stdlib HTTP service.
+
+    POST /lease {holder, lease_duration} -> {acquired, holder}
+    GET  /lease -> current record (introspection)
+
+    State is in-memory under one mutex; expiry semantics identical to
+    FileLease, plus a **boot grace**: for ``boot_grace`` seconds after a
+    (re)start with no state, every acquisition by a NEW holder is
+    refused — a restart of the lock medium must not hand the lease to a
+    second replica while the incumbent is still inside its renew
+    deadline (the file/ConfigMap media get this from persistence).
+
+    Binds loopback by default. The endpoint trusts the peer network and
+    the holder string exactly as far as the reference trusts anything
+    that can write its ConfigMap — expose it beyond localhost only on a
+    network where every peer may legitimately contend for (or break)
+    leadership, or behind an authenticating proxy.
+
+    ``start()`` binds and serves on a daemon thread and returns the
+    bound port (0 = ephemeral, for tests)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 boot_grace: float = 15.0):
+        self.host = host
+        self.port = port
+        self.boot_grace = boot_grace
+        self._boot = time.time()
+        self._state: Optional[dict] = None
+        self._lock = threading.Lock()
+        self._httpd = None
+        self._thread = None
+
+    def _try_acquire(self, holder: str, lease_duration: float) -> dict:
+        with self._lock:
+            now = time.time()
+            rec = self._state
+            if rec is None and now < self._boot + self.boot_grace:
+                # restart window: an incumbent may still believe it
+                # leads; make claimants wait out one lease duration
+                return {"acquired": False, "holder": ""}
+            if rec is not None and rec["holder"] != holder:
+                if now < rec["renew_time"] + rec["lease_duration"]:
+                    return {"acquired": False, "holder": rec["holder"]}
+            self._state = {"holder": holder, "renew_time": now,
+                           "lease_duration": lease_duration}
+            return {"acquired": True, "holder": holder}
+
+    def start(self) -> int:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        owner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):       # quiet
+                pass
+
+            def _reply(self, code: int, obj: dict):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/lease":
+                    return self._reply(404, {"error": "not found"})
+                with owner._lock:
+                    rec = dict(owner._state) if owner._state else {}
+                self._reply(200, rec)
+
+            def do_POST(self):
+                if self.path != "/lease":
+                    return self._reply(404, {"error": "not found"})
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n).decode())
+                    holder = str(req["holder"])
+                    dur = float(req.get("lease_duration", 15.0))
+                except (ValueError, KeyError):
+                    return self._reply(400, {"error": "bad request"})
+                self._reply(200, owner._try_acquire(holder, dur))
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="kb-lease-http")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

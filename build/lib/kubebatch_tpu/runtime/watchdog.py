@@ -1,0 +1,82 @@
+"""Accelerator wedge watchdog — shared by bench.py and the CLI daemon.
+
+A hung accelerator transport can block the FIRST device query forever
+(backend init never returns), which would wedge a scheduler daemon at
+its first kernel dispatch with no error and no cycles. The probe runs
+the device query in a SUBPROCESS so the parent can abandon it: a child
+stuck in an uninterruptible driver call cannot be reaped, so on timeout
+it is killed best-effort and left un-waited (start_new_session keeps it
+out of our process group; the zombie is collected when this process
+exits).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+PROBE_SRC = ("import jax; jax.numpy.zeros(()).block_until_ready(); "
+             "print(jax.default_backend())")
+
+
+def probe_backend(timeout: float = 60.0,
+                  probe_src: str = PROBE_SRC) -> Tuple[str, str]:
+    """Run the device probe in an abandonable subprocess.
+
+    Returns (status, detail): status is "ok" | "timeout" | "error";
+    detail is the backend name for "ok", or the tail of the child's
+    stderr for "error" (so a broken install is reported as what it is,
+    not as an unresponsive device). Child output goes to temp files, not
+    pipes — a chatty failing child must not block in write() and turn an
+    "error" into a 60 s "timeout". ``probe_src`` is swappable for tests.
+    """
+    import tempfile
+
+    with tempfile.TemporaryFile(mode="w+") as out_f, \
+            tempfile.TemporaryFile(mode="w+") as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", probe_src],
+            stdout=out_f, stderr=err_f, start_new_session=True)
+        try:
+            # wait(timeout) polls with WNOHANG — it cannot block on a
+            # D-state child
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()   # pends if the child is in D state; do NOT reap
+            return "timeout", ""
+        out_f.seek(0)
+        err_f.seek(0)
+        if proc.returncode == 0:
+            return "ok", out_f.read().strip() or "unknown"
+        return "error", err_f.read().strip()[-400:]
+
+
+def ensure_responsive_backend(timeout: float = 60.0,
+                              skip_env: Optional[str] =
+                              "KUBEBATCH_NO_BACKEND_PROBE",
+                              probe_src: str = PROBE_SRC) -> str:
+    """Probe the default backend; on timeout/failure flip THIS process to
+    the host platform before any device query happens (jax may be
+    imported but must be uninitialized).
+
+    Returns the probed backend name, or "cpu-fallback" (flipped),
+    "pinned" (flip impossible — running would hang), or "skipped"
+    (``skip_env`` set; tests and CPU-only runs).
+    """
+    if skip_env and os.environ.get(skip_env):
+        return "skipped"
+    status, detail = probe_backend(timeout, probe_src)
+    if status == "ok":
+        return detail
+    if status == "error" and detail:
+        print(f"backend probe failed:\n{detail}", file=sys.stderr)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        return "pinned"
+    print("accelerator backend unresponsive; continuing on the host "
+          "platform", file=sys.stderr)
+    return "cpu-fallback"

@@ -1,0 +1,11 @@
+"""Synthetic cluster generation + simulated e2e harness."""
+from .cluster import (BASELINE_SPECS, ClusterSpec, SimCluster,
+                      baseline_cluster, build_cluster)
+from .source import (FlakyBinder, FlakyEvictor, PersistentVolume,
+                     PersistentVolumeClaim, PVVolumeBinder, StorageClass,
+                     StreamingEventSource)
+
+__all__ = ["BASELINE_SPECS", "ClusterSpec", "SimCluster", "baseline_cluster",
+           "build_cluster", "FlakyBinder", "FlakyEvictor",
+           "PersistentVolume", "PersistentVolumeClaim", "PVVolumeBinder",
+           "StorageClass", "StreamingEventSource"]

@@ -1,0 +1,464 @@
+"""Streaming event source + PV/PVC volume world — the sim's informer layer.
+
+The reference ingests cluster state through 9 client-go informers (watch
+streams for pods, nodes, PodGroups, Queues, PDBs, PriorityClasses, PVs,
+PVCs, StorageClasses — ref: pkg/scheduler/cache/cache.go:217-295). This
+module provides the simulated equivalent with the same shape:
+
+- ``StreamingEventSource``: LIST+WATCH semantics over the cache's handler
+  surface. ``start(cache)`` replays the current world as adds (LIST),
+  then a pump thread drains queued watch events into the same handlers
+  the push surface exposes — the cache code path is identical whether
+  events arrive by direct call (unit tests) or by stream (e2e). Producers
+  (``emit_*``) are thread-safe and can run while scheduling cycles are
+  open, like real informers do.
+- ``PVVolumeBinder``: a PV/PVC-aware implementation of the VolumeBinder
+  seam (ref: cache.go:164-184 wrapping the upstream volumebinder).
+  ``allocate_volumes`` ASSUMES a matching PersistentVolume per claim of
+  the pod (class + capacity + optional node pinning for local volumes)
+  and fails when none fits; ``bind_volumes`` COMMITS the assumed
+  bindings, enforcing the reference's bind timeout (30 s default,
+  cache.go:228): an assumption older than the timeout has expired and
+  raises — the bind error lands the task on the cache's err_tasks queue
+  and the resync repair loop re-drives it, exactly the reference's
+  failure path.
+- failure injection: ``FlakyBinder``/``FlakyEvictor`` wrap real seams and
+  fail the first N attempts per pod — the e2e suite uses them to prove
+  injected API failures heal through the rate-limited resync loop while
+  cycles keep running (ref: cache.go:377-382,423-429,494-513).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import TaskInfo
+from ..objects import (Node, Pod, PodDisruptionBudget, PodGroup,
+                       PriorityClass, Queue)
+
+GiB = 1024 ** 3
+
+
+# ---------------------------------------------------------------------
+# volume world
+# ---------------------------------------------------------------------
+
+@dataclass
+class StorageClass:
+    name: str
+    provisioner: str = "sim"
+
+
+@dataclass
+class PersistentVolume:
+    name: str
+    capacity_bytes: float = GiB
+    storage_class: str = "standard"
+    #: local volumes: only usable from this node ("" = any node)
+    node_name: str = ""
+    claim_ref: str = ""       # bound claim uid ("" = available)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    name: str
+    namespace: str = "default"
+    storage_class: str = "standard"
+    request_bytes: float = GiB
+    volume_name: str = ""     # bound PV ("" = unbound)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class PVVolumeBinder:
+    """VolumeBinder seam over the PV/PVC world (see module docstring)."""
+
+    def __init__(self, bind_timeout: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.bind_timeout = bind_timeout
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.volumes: Dict[str, PersistentVolume] = {}
+        self.claims: Dict[str, PersistentVolumeClaim] = {}
+        self.classes: Dict[str, StorageClass] = {}
+        #: task uid -> (assumed (claim_key, pv_name) pairs, assume stamp)
+        self._assumed: Dict[str, Tuple[List[Tuple[str, str]], float]] = {}
+
+    # ---- informer handlers (PV / PVC / StorageClass events) -----------
+    def add_volume(self, pv: PersistentVolume) -> None:
+        with self._lock:
+            self.volumes[pv.name] = pv
+
+    def delete_volume(self, pv: PersistentVolume) -> None:
+        with self._lock:
+            self.volumes.pop(pv.name, None)
+
+    def add_claim(self, pvc: PersistentVolumeClaim) -> None:
+        with self._lock:
+            self.claims[pvc.key] = pvc
+
+    def delete_claim(self, pvc: PersistentVolumeClaim) -> None:
+        with self._lock:
+            self.claims.pop(pvc.key, None)
+
+    def add_storage_class(self, sc: StorageClass) -> None:
+        with self._lock:
+            self.classes[sc.name] = sc
+
+    # ---- the VolumeBinder seam ----------------------------------------
+    def _claims_of(self, task: TaskInfo) -> List[PersistentVolumeClaim]:
+        out = []
+        for name in task.pod.pvc_names:
+            pvc = self.claims.get(f"{task.namespace}/{name}")
+            if pvc is None:
+                raise RuntimeError(
+                    f"claim {task.namespace}/{name} not found for pod "
+                    f"{task.namespace}/{task.name}")
+            out.append(pvc)
+        return out
+
+    def _prune_expired(self) -> None:
+        """Assumptions older than the bind timeout no longer reserve their
+        PVs — a gang that never reached readiness must not leak the
+        cluster's volumes forever (the upstream assume cache expires the
+        same way). Callers hold the lock."""
+        now = self._clock()
+        for uid in [u for u, (_, stamp) in self._assumed.items()
+                    if now - stamp > self.bind_timeout]:
+            del self._assumed[uid]
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        """AssumePodVolumes: reserve a fitting PV per unbound claim; all
+        or nothing. No-op (volume_ready) for pods without claims. A task
+        re-allocating replaces its own previous assumption."""
+        with self._lock:
+            self._prune_expired()
+            picks: List[Tuple[str, str]] = []
+            taken = set()
+            for pvc in self._claims_of(task):
+                if pvc.volume_name:      # already bound (static binding)
+                    continue
+                pv = self._find_pv(pvc, hostname, taken, task.uid)
+                if pv is None:
+                    raise RuntimeError(
+                        f"no PersistentVolume fits claim {pvc.key} "
+                        f"(class={pvc.storage_class}, "
+                        f"req={pvc.request_bytes:.0f}B) on {hostname}")
+                taken.add(pv.name)
+                picks.append((pvc.key, pv.name))
+            self._assumed.pop(task.uid, None)
+            if picks:
+                self._assumed[task.uid] = (picks, self._clock())
+            task.volume_ready = True
+
+    def _find_pv(self, pvc: PersistentVolumeClaim, hostname: str,
+                 taken: set, own_uid: str) -> Optional[PersistentVolume]:
+        assumed_pvs = {pv for uid, (picks, _) in self._assumed.items()
+                       if uid != own_uid for _, pv in picks}
+        best = None
+        for pv in self.volumes.values():
+            if pv.name in taken or pv.name in assumed_pvs or pv.claim_ref:
+                continue
+            if pv.storage_class != pvc.storage_class:
+                continue
+            if pv.capacity_bytes < pvc.request_bytes:
+                continue
+            if pv.node_name and pv.node_name != hostname:
+                continue
+            # smallest fitting volume wins (upstream's size-based order)
+            if best is None or pv.capacity_bytes < best.capacity_bytes:
+                best = pv
+        return best
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        """BindPodVolumes: commit assumptions. An expired assumption (older
+        than the bind timeout) raises AND resets volume_ready, so the
+        resync re-drive must re-allocate — it cannot silently bind a
+        claim-carrying pod with no PV committed."""
+        if not task.volume_ready:
+            raise RuntimeError(
+                f"volumes for {task.namespace}/{task.name} were never "
+                f"allocated")
+        with self._lock:
+            entry = self._assumed.get(task.uid)
+            if entry is None:
+                # nothing to commit is only legitimate when every claim is
+                # already bound (or the pod has none)
+                unbound = [pvc.key for pvc in self._claims_of(task)
+                           if not pvc.volume_name]
+                if unbound:
+                    task.volume_ready = False
+                    raise RuntimeError(
+                        f"no volume assumption for {task.namespace}/"
+                        f"{task.name} (claims {unbound}); re-allocate")
+                return
+            pairs, stamp = entry
+            if self._clock() - stamp > self.bind_timeout:
+                del self._assumed[task.uid]
+                task.volume_ready = False
+                raise RuntimeError(
+                    f"volume binding for {task.namespace}/{task.name} "
+                    f"timed out after {self.bind_timeout:.0f}s")
+            for claim_key, pv_name in pairs:
+                pv = self.volumes.get(pv_name)
+                pvc = self.claims.get(claim_key)
+                if pv is None or pvc is None:
+                    del self._assumed[task.uid]
+                    task.volume_ready = False
+                    raise RuntimeError(
+                        f"assumed volume {pv_name} / claim {claim_key} "
+                        f"vanished before bind")
+                pv.claim_ref = claim_key
+                pvc.volume_name = pv_name
+            del self._assumed[task.uid]
+
+    def unassume(self, task: TaskInfo) -> None:
+        """Drop assumptions for a task whose placement was rolled back."""
+        with self._lock:
+            self._assumed.pop(task.uid, None)
+
+
+# ---------------------------------------------------------------------
+# failure-injecting seams
+# ---------------------------------------------------------------------
+
+class FlakyBinder:
+    """Fails the first ``failures`` bind attempts per pod, then delegates.
+    The sim stand-in for transient API-server write failures."""
+
+    def __init__(self, inner, failures: int = 1):
+        self.inner = inner
+        self.failures = failures
+        self.attempts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def bind(self, pod: Pod, hostname: str) -> None:
+        with self._lock:
+            n = self.attempts.get(pod.uid, 0)
+            self.attempts[pod.uid] = n + 1
+        if n < self.failures:
+            raise RuntimeError(f"injected bind failure #{n + 1} for "
+                               f"{pod.namespace}/{pod.name}")
+        self.inner.bind(pod, hostname)
+
+
+class FlakyEvictor:
+    def __init__(self, inner, failures: int = 1):
+        self.inner = inner
+        self.failures = failures
+        self.attempts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def evict(self, pod: Pod) -> None:
+        with self._lock:
+            n = self.attempts.get(pod.uid, 0)
+            self.attempts[pod.uid] = n + 1
+        if n < self.failures:
+            raise RuntimeError(f"injected evict failure #{n + 1} for "
+                               f"{pod.namespace}/{pod.name}")
+        self.inner.evict(pod)
+
+
+# ---------------------------------------------------------------------
+# the streaming source
+# ---------------------------------------------------------------------
+
+@dataclass
+class _Event:
+    kind: str            # "pod" | "node" | "group" | "queue" | "pdb" |
+    #                      "priority_class" | "pv" | "pvc" | "storage_class"
+    verb: str            # "add" | "update" | "delete"
+    obj: object
+    old: object = None
+
+
+class StreamingEventSource:
+    """Informer-style LIST+WATCH adapter over the cache handler surface.
+
+    The world (pods/nodes/groups/queues/...) lives here, keyed like the
+    API server would key it; ``start(cache)`` LISTs it into the cache and
+    then pumps watch events from a queue in a background thread. The
+    ``emit_*`` producers mutate the world AND enqueue the event, so a
+    restarted scheduler can re-LIST the same source and rebuild — the
+    statelessness contract the reference gets from informer replay.
+    """
+
+    def __init__(self, volume_binder: Optional[PVVolumeBinder] = None):
+        self._lock = threading.Lock()
+        self._queue: List[_Event] = []
+        self._wake = threading.Condition(self._lock)
+        self._cache = None
+        self._pump: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.volume_binder = volume_binder
+
+        self.pods: Dict[str, Pod] = {}
+        self.nodes: Dict[str, Node] = {}
+        self.groups: Dict[str, PodGroup] = {}
+        self.queues: Dict[str, Queue] = {}
+        self.pdbs: Dict[str, PodDisruptionBudget] = {}
+        self.priority_classes: Dict[str, PriorityClass] = {}
+
+    # ---- ground truth (the resync loop's GET) -------------------------
+    def pod_lister(self, ns: str, name: str) -> Optional[Pod]:
+        with self._lock:
+            return self.pods.get(f"{ns}/{name}")
+
+    # ---- lifecycle ----------------------------------------------------
+    def start(self, cache) -> None:
+        """LIST the world into the cache, then start the watch pump."""
+        self._cache = cache
+        cache.pod_lister = self.pod_lister
+        with self._lock:
+            for q in self.queues.values():
+                cache.add_queue(q)
+            for pc in self.priority_classes.values():
+                cache.add_priority_class(pc)
+            for n in self.nodes.values():
+                cache.add_node(n)
+            for g in self.groups.values():
+                cache.add_pod_group(g)
+            for pdb in self.pdbs.values():
+                cache.add_pdb(pdb)
+            for p in self.pods.values():
+                cache.add_pod(p)
+        self._stop.clear()
+        self._pump = threading.Thread(target=self._pump_loop,
+                                      name="kb-sim-informer", daemon=True)
+        self._pump.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._wake:
+            self._wake.notify_all()
+        if self._pump is not None:
+            self._pump.join(timeout=5.0)
+
+    def sync(self, timeout: float = 5.0) -> bool:
+        """Barrier: wait for the watch queue to drain (tests)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._queue:
+                    return True
+            time.sleep(0.002)
+        return False
+
+    def _pump_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._wake:
+                while not self._queue and not self._stop.is_set():
+                    self._wake.wait(timeout=0.05)
+                events, self._queue = self._queue, []
+            for ev in events:
+                try:
+                    self._deliver(ev)
+                except Exception:   # a bad event must not kill the stream
+                    import traceback
+                    traceback.print_exc()
+
+    def _deliver(self, ev: _Event) -> None:
+        cache = self._cache
+        vb = self.volume_binder
+        route = {
+            ("pod", "add"): lambda: cache.add_pod(ev.obj),
+            ("pod", "update"): lambda: cache.update_pod(ev.old, ev.obj),
+            ("pod", "delete"): lambda: cache.delete_pod(ev.obj),
+            ("node", "add"): lambda: cache.add_node(ev.obj),
+            ("node", "update"): lambda: cache.update_node(ev.old, ev.obj),
+            ("node", "delete"): lambda: cache.delete_node(ev.obj),
+            ("group", "add"): lambda: cache.add_pod_group(ev.obj),
+            ("group", "update"): lambda: cache.update_pod_group(ev.old,
+                                                                ev.obj),
+            ("group", "delete"): lambda: cache.delete_pod_group(ev.obj),
+            ("queue", "add"): lambda: cache.add_queue(ev.obj),
+            ("queue", "update"): lambda: cache.update_queue(ev.old, ev.obj),
+            ("queue", "delete"): lambda: cache.delete_queue(ev.obj),
+            ("pdb", "add"): lambda: cache.add_pdb(ev.obj),
+            ("pdb", "delete"): lambda: cache.delete_pdb(ev.obj),
+            ("priority_class", "add"):
+                lambda: cache.add_priority_class(ev.obj),
+            ("priority_class", "delete"):
+                lambda: cache.delete_priority_class(ev.obj),
+        }
+        if vb is not None:
+            route.update({
+                ("pv", "add"): lambda: vb.add_volume(ev.obj),
+                ("pv", "delete"): lambda: vb.delete_volume(ev.obj),
+                ("pvc", "add"): lambda: vb.add_claim(ev.obj),
+                ("pvc", "delete"): lambda: vb.delete_claim(ev.obj),
+                ("storage_class", "add"):
+                    lambda: vb.add_storage_class(ev.obj),
+            })
+        fn = route.get((ev.kind, ev.verb))
+        if fn is not None:
+            fn()
+
+    # ---- producers ----------------------------------------------------
+    def _emit(self, kind: str, verb: str, obj, old=None) -> None:
+        with self._wake:
+            self._queue.append(_Event(kind, verb, obj, old))
+            self._wake.notify_all()
+
+    def emit_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self.pods[f"{pod.namespace}/{pod.name}"] = pod
+        self._emit("pod", "add", pod)
+
+    def emit_pod_update(self, old: Pod, new: Pod) -> None:
+        with self._lock:
+            self.pods[f"{new.namespace}/{new.name}"] = new
+        self._emit("pod", "update", new, old)
+
+    def emit_pod_delete(self, pod: Pod) -> None:
+        with self._lock:
+            self.pods.pop(f"{pod.namespace}/{pod.name}", None)
+        self._emit("pod", "delete", pod)
+
+    def emit_node(self, node: Node) -> None:
+        with self._lock:
+            self.nodes[node.name] = node
+        self._emit("node", "add", node)
+
+    def emit_node_update(self, old: Node, new: Node) -> None:
+        with self._lock:
+            self.nodes[new.name] = new
+        self._emit("node", "update", new, old)
+
+    def emit_node_delete(self, node: Node) -> None:
+        with self._lock:
+            self.nodes.pop(node.name, None)
+        self._emit("node", "delete", node)
+
+    def emit_group(self, pg: PodGroup) -> None:
+        with self._lock:
+            self.groups[f"{pg.namespace}/{pg.name}"] = pg
+        self._emit("group", "add", pg)
+
+    def emit_queue(self, q: Queue) -> None:
+        with self._lock:
+            self.queues[q.name] = q
+        self._emit("queue", "add", q)
+
+    def emit_priority_class(self, pc: PriorityClass) -> None:
+        with self._lock:
+            self.priority_classes[pc.name] = pc
+        self._emit("priority_class", "add", pc)
+
+    def emit_pdb(self, pdb: PodDisruptionBudget) -> None:
+        with self._lock:
+            self.pdbs[f"{pdb.namespace}/{pdb.name}"] = pdb
+        self._emit("pdb", "add", pdb)
+
+    def emit_volume(self, pv: PersistentVolume) -> None:
+        self._emit("pv", "add", pv)
+
+    def emit_claim(self, pvc: PersistentVolumeClaim) -> None:
+        self._emit("pvc", "add", pvc)
+
+    def emit_storage_class(self, sc: StorageClass) -> None:
+        self._emit("storage_class", "add", sc)
