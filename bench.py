@@ -265,6 +265,8 @@ def main(argv=None):
                          "scan engine")
     args = ap.parse_args(argv)
 
+    from kubebatch_tpu import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
     backend = ensure_responsive_backend()
     if backend == "cpu-fallback":
         # run the REQUESTED config on the host XLA backend so the degraded

@@ -74,6 +74,8 @@ def main(argv=None) -> int:
         from .. import __version__
         print(f"kubebatch-tpu {__version__}")
         return 0
+    from .. import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
 
     import logging
 
