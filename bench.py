@@ -210,6 +210,7 @@ def run_steady(config: int, cycles: int, mode: str, churn_pods: int):
             CloseSession(ssn)
         latencies = []
         bound = 0
+        action_seconds = {name: 0.0 for name in CONFIG_ACTIONS[config]}
         for cycle in range(cycles):
             before = len(binds)
             kubelet_tick()
@@ -233,9 +234,13 @@ def run_steady(config: int, cycles: int, mode: str, churn_pods: int):
                       f"bound={len(binds) - before}", file=sys.stderr)
             latencies.append(dt)
             bound += len(binds) - before
+            for name, secs in act_times:
+                action_seconds[name] += secs
     finally:
         gc.enable()
-    return latencies, bound
+    action_ms = {name: round(1e3 * secs / max(1, len(latencies)), 3)
+                 for name, secs in action_seconds.items()}
+    return latencies, bound, action_ms
 
 
 def main(argv=None):
@@ -276,8 +281,8 @@ def main(argv=None):
         args.cycles = min(args.cycles, 3)
 
     if args.steady > 0:
-        latencies, bound = run_steady(args.config, args.cycles, args.mode,
-                                      args.steady)
+        latencies, bound, action_ms = run_steady(args.config, args.cycles,
+                                                 args.mode, args.steady)
         p50_ms = float(np.percentile(latencies, 50) * 1e3)
         seconds = sum(latencies)
         out = {
@@ -290,6 +295,7 @@ def main(argv=None):
             else 0.0,
             "churn_pods": args.steady,
             "measured_cycles": len(latencies),
+            "action_ms": action_ms,
             "mode": args.mode,
             "backend": backend,
         }
@@ -327,13 +333,15 @@ def main(argv=None):
             and backend != "cpu-fallback":
         try:
             churn = 256
-            s_lat, s_bound = run_steady(args.config, 5, args.mode, churn)
+            s_lat, s_bound, s_act = run_steady(args.config, 5, args.mode,
+                                               churn)
             out["steady_p50_ms"] = round(
                 float(np.percentile(s_lat, 50) * 1e3), 3)
             out["steady_p95_ms"] = round(
                 float(np.percentile(s_lat, 95) * 1e3), 3)
             out["steady_churn_pods"] = churn
             out["steady_measured_cycles"] = len(s_lat)
+            out["steady_action_ms"] = s_act
         except Exception as e:   # pragma: no cover — diagnostics only
             out["steady_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out))

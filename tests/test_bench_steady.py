@@ -8,10 +8,11 @@ import bench
 
 
 def test_run_steady_small_config():
-    latencies, bound = bench.run_steady(2, 2, "auto", 16)
+    latencies, bound, action_ms = bench.run_steady(2, 2, "auto", 16)
     assert len(latencies) == 2
     assert bound == 32          # 16 churn pods per measured cycle
     assert all(dt > 0 for dt in latencies)
+    assert "allocate" in action_ms and action_ms["allocate"] >= 0
 
 
 def test_bench_main_one_json_line(capsys):
