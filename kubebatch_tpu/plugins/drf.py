@@ -10,8 +10,7 @@ from __future__ import annotations
 
 from typing import Dict, List
 
-from ..api import (JobInfo, Resource, TaskInfo, dominant_share,
-                   resource_names, share)
+from ..api import JobInfo, Resource, TaskInfo, dominant_share
 from ..framework import EventHandler, Plugin, Session
 
 NAME = "drf"

@@ -11,8 +11,8 @@ from __future__ import annotations
 
 from typing import Dict, List
 
-from ..api import (QueueInfo, Resource, TaskInfo,
-                   dominant_share, res_min, share)
+from ..api import (QueueInfo, Resource, TaskInfo, dominant_share,
+                   res_min)
 from ..api.types import TaskStatus
 from ..framework import EventHandler, Plugin, Session
 

@@ -14,8 +14,7 @@ from kubebatch_tpu.cache.k8s_source import (K8sEventSource, ResourceExpired,
                                             podgroup_from_manifest,
                                             queue_from_manifest)
 from kubebatch_tpu.api import TaskStatus
-from kubebatch_tpu.objects import (CPU, GROUP_NAME_ANNOTATION, MEMORY,
-                                   PodPhase)
+from kubebatch_tpu.objects import CPU, GROUP_NAME_ANNOTATION, MEMORY
 
 
 class RecordingBinder:

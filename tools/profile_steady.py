@@ -32,7 +32,7 @@ def main():
     ap.add_argument("--top", type=int, default=25)
     args = ap.parse_args()
 
-    from bench import CONFIG_ACTIONS, build_actions
+    from bench import build_actions
     from kubebatch_tpu import actions, plugins  # noqa: F401
     from kubebatch_tpu.cache import SchedulerCache
     from kubebatch_tpu.conf import shipped_tiers
