@@ -286,3 +286,105 @@ def test_replayed_world_schedules_end_to_end():
              if task.status == TaskStatus.BINDING]
     assert len(bound) == 2
     src.stop()
+
+
+def test_kubectl_shaped_manifest_robustness():
+    """A pod manifest with the full field load an API server actually
+    serializes (managedFields, limits, env, probes, volumes, statuses)
+    converts cleanly — unknown fields ignored, the scheduler-relevant
+    subset extracted."""
+    m = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": "worker-0", "namespace": "train",
+            "uid": "8f7f8c2d-1111-2222-3333-444455556666",
+            "resourceVersion": "812345",
+            "generateName": "worker-",
+            "labels": {"app": "trainer", "pod-template-hash": "abc"},
+            "annotations": {
+                GROUP_NAME_ANNOTATION: "trainer-pg",
+                "kubernetes.io/psp": "restricted",
+            },
+            "creationTimestamp": "2026-07-30T09:12:44Z",
+            "ownerReferences": [
+                {"apiVersion": "apps/v1", "kind": "ReplicaSet",
+                 "name": "trainer-abc", "uid": "rs-uid-1",
+                 "controller": True, "blockOwnerDeletion": True}],
+            "managedFields": [{"manager": "kube-controller-manager",
+                               "operation": "Update",
+                               "fieldsType": "FieldsV1",
+                               "fieldsV1": {"f:metadata": {}}}],
+        },
+        "spec": {
+            "schedulerName": "kube-batch",
+            "restartPolicy": "Always",
+            "terminationGracePeriodSeconds": 30,
+            "dnsPolicy": "ClusterFirst",
+            "serviceAccountName": "default",
+            "priority": 1000,
+            "priorityClassName": "high",
+            "nodeSelector": {"cloud.google.com/gke-tpu": "v5e"},
+            "tolerations": [
+                {"key": "node.kubernetes.io/not-ready",
+                 "operator": "Exists", "effect": "NoExecute",
+                 "tolerationSeconds": 300}],
+            "volumes": [
+                {"name": "cfg", "configMap": {"name": "trainer-cfg"}},
+                {"name": "data",
+                 "persistentVolumeClaim": {"claimName": "data-pvc"}},
+                {"name": "kube-api-access-x",
+                 "projected": {"sources": []}}],
+            "containers": [{
+                "name": "trainer",
+                "image": "gcr.io/x/trainer:1",
+                "command": ["python", "train.py"],
+                "env": [{"name": "FOO", "value": "1"}],
+                "resources": {
+                    "requests": {"cpu": "3500m", "memory": "12Gi",
+                                 "nvidia.com/gpu": "4",
+                                 "ephemeral-storage": "10Gi"},
+                    "limits": {"cpu": "4", "memory": "16Gi",
+                               "nvidia.com/gpu": "4"}},
+                "ports": [{"containerPort": 6006},
+                          {"containerPort": 2222, "hostPort": 2222,
+                           "protocol": "TCP"}],
+                "livenessProbe": {"httpGet": {"path": "/healthz",
+                                              "port": 6006}},
+                "volumeMounts": [{"name": "data",
+                                  "mountPath": "/data"}]}],
+            "initContainers": [{
+                "name": "init-data",
+                "image": "busybox",
+                "resources": {"requests": {"cpu": "6", "memory": "1Gi"}}}],
+        },
+        "status": {
+            "phase": "Pending",
+            "qosClass": "Burstable",
+            "conditions": [{"type": "PodScheduled", "status": "False",
+                            "reason": "Unschedulable"}],
+        },
+    }
+    pod = pod_from_manifest(m)
+    assert pod.uid == "8f7f8c2d-1111-2222-3333-444455556666"
+    assert pod.priority == 1000 and pod.priority_class_name == "high"
+    # requests: cpu/gpu in millis, memory bytes; unknown resource kinds
+    # (ephemeral-storage) carried through untouched
+    req = pod.containers[0].requests
+    assert req[CPU] == 3500.0
+    assert req[MEMORY] == 12 * 1024.0 ** 3
+    assert req["nvidia.com/gpu"] == 4000.0
+    assert req["ephemeral-storage"] == 10 * 1024.0 ** 3
+    # init-container max-vs-sum semantics get their input
+    assert pod.init_containers[0].requests[CPU] == 6000.0
+    # only the hostPort lands in the scheduler's port set
+    assert pod.host_ports() == [2222]
+    assert pod.pvc_names == ["data-pvc"]    # configMap/projected skipped
+    assert pod.tolerations[0].operator == "Exists"
+    assert pod.owner_uid == "rs-uid-1"
+    assert pod.status_conditions[0]["type"] == "PodScheduled"
+
+    # a task built from it carries the init-resreq max (pod_info.go:262)
+    from kubebatch_tpu.api import TaskInfo
+    task = TaskInfo(pod)
+    assert task.resreq.milli_cpu == 3500.0
+    assert task.init_resreq.milli_cpu == 6000.0
