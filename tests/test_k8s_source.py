@@ -388,3 +388,50 @@ def test_kubectl_shaped_manifest_robustness():
     task = TaskInfo(pod)
     assert task.resreq.milli_cpu == 3500.0
     assert task.init_resreq.milli_cpu == 6000.0
+
+
+def test_volume_kinds_route_to_sink():
+    """PV/PVC/StorageClass rows carry no cache handlers (they feed the
+    volume binder world, cache.go:222-230) — the adapter routes their
+    manifests to the volume sink, untouched."""
+    sunk = []
+    lists = {
+        "queues": [queue_manifest("default", 1)],
+        "nodes": [node_manifest("n1")],
+        "persistentvolumes": [
+            {"metadata": {"name": "pv0", "uid": "pv-0"},
+             "spec": {"capacity": {"storage": "100Gi"}}}],
+        "persistentvolumeclaims": [
+            {"metadata": {"name": "data-pvc", "namespace": "ns",
+                          "uid": "pvc-0"},
+             "spec": {"volumeName": "pv0"}}],
+    }
+    t = ReplayTransport(lists, {})
+    cache = SchedulerCache(binder=RecordingBinder(), async_writeback=False)
+    src = K8sEventSource(
+        kinds=["queues", "nodes", "persistentvolumes",
+               "persistentvolumeclaims"],
+        transport=(t.list_fn, t.watch_fn),
+        volume_sink=sunk.append)
+    src.start(cache)
+    assert src.sync(5.0)
+    kinds = sorted(ev.kind for ev in sunk)
+    assert kinds == ["persistentvolumeclaims", "persistentvolumes"]
+    # manifests pass through verbatim (the binder world parses its own)
+    assert all(isinstance(ev.obj, dict) for ev in sunk)
+    assert len(cache.nodes) == 1       # cache rows unaffected
+    src.stop()
+
+
+def test_default_kinds_include_volumes_only_with_sink():
+    """Without a volume sink the adapter subscribes only handler-backed
+    kinds; with one, the PV/PVC/SC rows join — mirroring how the
+    reference wires those informers into the volume binder."""
+    src = K8sEventSource(transport=(lambda k: ([], ""),
+                                    lambda k, rv: iter(())))
+    assert "persistentvolumes" not in src.kinds
+    src2 = K8sEventSource(transport=(lambda k: ([], ""),
+                                     lambda k, rv: iter(())),
+                          volume_sink=lambda ev: None)
+    assert "persistentvolumes" in src2.kinds
+    assert "storageclasses" in src2.kinds
