@@ -320,7 +320,8 @@ def _analysis_core(
     return pick0, guard_n, victims
 
 
-def _visit_core(p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
+def _visit_core(p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
+                p_job, p_queue,
                 visited,
                 node_ok, n_tasks, max_task_num, nz_req, allocatable_cm,
                 host_rank, v_node, v_job, v_res, v_critical, v_live,
@@ -332,8 +333,16 @@ def _visit_core(p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
                 room_check: bool):
     """Analysis + in-kernel node choice (the per-visit dispatch mode).
 
+    ``sig_scores``/``sig_pred`` are the whole [S, N] static-term
+    matrices (device-resident across the action); the visit's rows are
+    gathered in-kernel from ``p_sig`` — shipping an index per dispatch
+    instead of two [N] rows was worth ~1 ms/visit of host->device
+    conversion on the steady path.
+
     Returns (found, node_idx, victims_mask[V], victims_count, prop_guard).
     """
+    p_score = sig_scores[p_sig]
+    p_pred = sig_pred[p_sig]
     pick0, guard_n, victims = _analysis_core(
         p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
         node_ok, n_tasks, max_task_num, nz_req, allocatable_cm, host_rank,
@@ -371,7 +380,8 @@ _visit_kernel = partial(jax.jit, static_argnames=(
 @partial(jax.jit, static_argnames=("tiers", "veto_critical", "filter_kind",
                                    "dyn_enabled", "score_nodes",
                                    "room_check"))
-def _wave_kernel(p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
+def _wave_kernel(p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
+                 p_job, p_queue,
                  *shared,
                  tiers: Tuple[Tuple[str, ...], ...], veto_critical: bool,
                  filter_kind: str, dyn_enabled: bool, score_nodes: bool,
@@ -381,18 +391,19 @@ def _wave_kernel(p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
     pending preemptors. Node CHOICE happens host-side per consumption
     (VictimSolver._choose), so consuming a node, growing the visited
     mask, or another preemptor touching an unrelated node costs no
-    re-dispatch."""
+    re-dispatch. Lanes carry sig INDICES; the [S, N] matrices stay
+    device-resident (see _visit_core)."""
 
-    def one(a, b, c, d, e, f, g):
-        return _analysis_core(a, b, c, d, e, f, g, *shared,
+    def one(a, b, c, sig, f, g):
+        return _analysis_core(a, b, c, sig_scores[sig], sig_pred[sig],
+                              f, g, *shared,
                               tiers=tiers, veto_critical=veto_critical,
                               filter_kind=filter_kind,
                               dyn_enabled=dyn_enabled,
                               score_nodes=score_nodes,
                               room_check=room_check)
 
-    return jax.vmap(one)(p_res, p_resreq, p_nz, p_score, p_pred, p_job,
-                         p_queue)
+    return jax.vmap(one)(p_res, p_resreq, p_nz, p_sig, p_job, p_queue)
 
 
 # ---------------------------------------------------------------------
@@ -1161,6 +1172,7 @@ class VictimSolver:
         self.dyn = terms.dynamic if terms is not None else None
         self._dev = _device()
         self._static_dev = None
+        self._sig_dev = None
         self._mut_dev = None
         self._mut_version = -1
         #: wave state
@@ -1211,6 +1223,19 @@ class VictimSolver:
                 st.perm_nj, st.nj_head, st.perm_nq, st.nq_head, st.min_av,
                 st.job_queue, st.q_deserved, st.q_prop_ok,
                 st.cluster_total, dyn_w))
+            # the [S, N] static-term matrices ride along once per action;
+            # visits/waves then ship sig indices, not rows. S is padded
+            # to a bucket so a cycle introducing a new unique signature
+            # shape doesn't recompile the kernels (same discipline as
+            # cycle_inputs' sig arrays)
+            score = self.terms.static.score
+            pred = self.terms.static.pred
+            s_pad = pad_to_bucket(score.shape[0], 4)
+            if s_pad != score.shape[0]:
+                pad = s_pad - score.shape[0]
+                score = np.pad(score, ((0, pad), (0, 0)))
+                pred = np.pad(pred, ((0, pad), (0, 0)))
+            self._sig_dev = (put(score), put(pred))
         if self._mut_version != st.version:
             self._mut_dev = tuple(put(a) for a in (
                 st.n_tasks, st.nz_req, st.v_live, st.ready_cnt,
@@ -1365,12 +1390,10 @@ class VictimSolver:
             chunk = self.pending[start:start + self._wave_size]
         p = len(chunk)
         p_pad = pad_to_bucket(p, 1 if single else 8)
-        n_pad_score = self.terms.static.score.shape[1]
         p_res = np.zeros((p_pad, RESOURCE_DIM), np.float32)
         p_resreq = np.zeros((p_pad, RESOURCE_DIM), np.float32)
         p_nz = np.zeros((p_pad, 2), np.float32)
-        p_score = np.zeros((p_pad, n_pad_score), np.float32)
-        p_pred = np.zeros((p_pad, n_pad_score), bool)
+        p_sig = np.zeros(p_pad, np.int32)
         p_job = np.full(p_pad, -1, np.int32)
         p_queue = np.full(p_pad, -1, np.int32)
         sig_of = self.terms.static.sig_of
@@ -1378,9 +1401,7 @@ class VictimSolver:
             p_res[i] = t.init_resreq.to_vec()
             p_resreq[i] = t.resreq.to_vec()
             p_nz[i] = nz_request_vec(t.resreq.to_vec())
-            sig = sig_of.get(t.uid, 0)
-            p_score[i] = self.terms.static.score[sig]
-            p_pred[i] = self.terms.static.pred[sig]
+            p_sig[i] = sig_of.get(t.uid, 0)
             ji = st.j_index.get(t.job, -1)
             p_job[i] = ji
             p_queue[i] = int(st.job_queue[ji]) if ji >= 0 else -1
@@ -1388,8 +1409,10 @@ class VictimSolver:
 
         def run():
             static_dev, mut_dev = self._upload()
+            sig_scores, sig_pred = self._sig_dev
             return _wave_kernel(
-                p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
+                p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
+                p_job, p_queue,
                 static_dev[0], mut_dev[0], static_dev[1], mut_dev[1],
                 static_dev[2], static_dev[3],
                 static_dev[4], static_dev[5], static_dev[6], static_dev[7],
@@ -1419,15 +1442,14 @@ class VictimSolver:
                 "pick": pick[i], "guard": guard[i], "victims": victims[i],
                 "log_pos": log_pos,
                 "p_job": int(p_job[i]), "p_queue": int(p_queue[i]),
-                "p_nz": p_nz[i], "static_score": p_score[i],
+                "p_nz": p_nz[i],
+                "static_score": self.terms.static.score[p_sig[i]],
                 "shrink": set(), "grow": set()}
 
     def _visit_single(self, task: TaskInfo, filter_kind: str,
                       visited: np.ndarray) -> VisitResult:
         st = self.state
         sig = self.terms.static.sig_of.get(task.uid, 0)
-        p_score = self.terms.static.score[sig]
-        p_pred = self.terms.static.pred[sig]
         dyn_enabled = bool(self.dyn is not None and self.dyn.enabled)
         p_job = st.j_index.get(task.job, -1)
         ji = p_job if p_job >= 0 else 0
@@ -1440,11 +1462,12 @@ class VictimSolver:
               dyn_w),
              (n_tasks, nz_req, v_live, ready_cnt, j_alloc, q_alloc)) = \
                 self._upload()
+            sig_scores, sig_pred = self._sig_dev
             return _visit_kernel(
                 np.asarray(task.init_resreq.to_vec()),
                 np.asarray(task.resreq.to_vec()),
                 nz_request_vec(task.resreq.to_vec()),
-                p_score, p_pred,
+                np.int32(sig), sig_scores, sig_pred,
                 np.int32(p_job), np.int32(p_queue), visited,
                 node_ok, n_tasks, max_task_num, nz_req,
                 allocatable_cm, host_rank,
