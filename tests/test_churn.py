@@ -146,6 +146,17 @@ def test_churn_cfg3_scale_soak():
                     creation_timestamp=float(cycle * 1000 + p)))
             g += 1
         assert src.sync(10.0)
+        if cycle % 3 == 2:
+            # node churn: drop an empty node, add a fresh one — the
+            # shape/order epochs, allocatable total, TermsCache and
+            # SegmentStore resets must all keep the invariants below
+            empty = next((ni for ni in cache.nodes.values()
+                          if ni.node is not None and not ni.tasks), None)
+            if empty is not None:
+                cache.delete_node(empty.node)
+            src.emit_node(build_node(f"fresh{cycle:02d}",
+                                     rl(8000, 16 * GiB, pods=32)))
+            assert src.sync(10.0)
         # the incremental snapshot must stay deep-equal to a full clone
         # at cfg3 scale with every cross-cycle cache active (adoption,
         # device rows, terms, victim segments, close write-skip)
