@@ -206,6 +206,10 @@ class SchedulerCache:
         #: proportion consume it each open, drf.go:59-60); recomputed
         #: lazily after any node-shape change instead of walked per open
         self._alloc_total: Optional[Resource] = None
+        #: uids cache truth holds that snapshots exclude (no PodGroup/
+        #: PDB, or missing queue) — rebuilt by the full snapshot paths,
+        #: patched at dirty jobs by the incremental path
+        self._excluded_uids: set = set()
         #: bumped whenever the NODE ITERATION ORDER can change (new node
         #: appended, node deleted — a delete+re-add reorders the dict
         #: without changing the set); consumers caching order-derived
@@ -772,6 +776,10 @@ class SchedulerCache:
             with self._lock:
                 if job_terminated(job):
                     self.jobs.pop(job.uid, None)
+                    # the incremental snapshot patches deletions only at
+                    # dirty keys — an unmarked pop would leave a ghost
+                    # job in every later snapshot's bulk-copied base
+                    self._mark_job(job.uid)
                     self.deleted_jobs.forget(job)
                 else:
                     self.deleted_jobs.add_rate_limited(job)
@@ -821,26 +829,42 @@ class SchedulerCache:
             snap.allocatable_total = alloc_total
             snap.node_order_epoch = self._node_order_epoch
             snap.refreshed_jobs = set()
-            snap.jobs_excluded = 0
-            for name, node in self.nodes.items():
-                reuse = None if name in dirty_nodes else base_nodes.get(name)
-                snap.nodes[name] = node.clone() if reuse is None else reuse
+            # O(churn) assembly: bulk dict copies of the adopted base
+            # (C-speed) patched only at dirty keys — the per-entity
+            # Python walk over 5k nodes + 1k jobs was the steady open
+            # phase's floor. Soundness: every way an entity can appear,
+            # vanish, or change marks it dirty (cache handlers, session
+            # touched sets folded at adoption, validate-dropped jobs),
+            # and cluster-wide inputs (queues, priority classes) bump the
+            # snapshot epoch, which forces the full path instead.
+            nodes_map = dict(base_nodes)
+            for name in dirty_nodes:
+                ni = self.nodes.get(name)
+                if ni is None:
+                    nodes_map.pop(name, None)
+                else:
+                    nodes_map[name] = ni.clone()
+            snap.nodes = nodes_map
             for uid, q in self.queues.items():
                 snap.queues[uid] = q.clone()
-            for uid, job in self.jobs.items():
-                if job.pod_group is None and job.pdb is None:
-                    snap.jobs_excluded += 1
+            jobs_map = dict(base_jobs)
+            excluded = self._excluded_uids
+            for uid in dirty_jobs:
+                job = self.jobs.get(uid)
+                if job is None:
+                    jobs_map.pop(uid, None)
+                    excluded.discard(uid)
                     continue
-                if job.queue not in snap.queues:
-                    snap.jobs_excluded += 1
+                if self._job_excluded(job, snap.queues):
+                    jobs_map.pop(uid, None)
+                    excluded.add(uid)
                     continue
-                reuse = None if uid in dirty_jobs else base_jobs.get(uid)
-                if reuse is not None:
-                    snap.jobs[uid] = reuse
-                    continue
+                excluded.discard(uid)
                 self._stamp_priority(job)
-                snap.jobs[uid] = job.clone()
+                jobs_map[uid] = job.clone()
                 snap.refreshed_jobs.add(uid)
+            snap.jobs = jobs_map
+            snap.jobs_excluded = len(excluded)
             return snap
 
     def snapshot_full(self) -> ClusterInfo:
@@ -851,21 +875,28 @@ class SchedulerCache:
             snap = ClusterInfo()
             snap.allocatable_total = self._allocatable_total_locked()
             snap.node_order_epoch = self._node_order_epoch
-            snap.jobs_excluded = 0
+            self._excluded_uids = set()
             for name, node in self.nodes.items():
                 snap.nodes[node.name] = node.clone()
             for uid, q in self.queues.items():
                 snap.queues[uid] = q.clone()
             for uid, job in self.jobs.items():
-                if job.pod_group is None and job.pdb is None:
-                    snap.jobs_excluded += 1
-                    continue
-                if job.queue not in snap.queues:
-                    snap.jobs_excluded += 1
+                if self._job_excluded(job, snap.queues):
+                    self._excluded_uids.add(uid)
                     continue
                 self._stamp_priority(job)
                 snap.jobs[uid] = job.clone()
+            snap.jobs_excluded = len(self._excluded_uids)
             return snap
+
+    @staticmethod
+    def _job_excluded(job: JobInfo, queues: Dict[str, QueueInfo]) -> bool:
+        """The snapshot's job-exclusion rule (ref: cache.go:528-551 —
+        jobs without a PodGroup/PDB or with a missing queue are skipped).
+        ONE predicate for both snapshot paths: the incremental path's
+        _excluded_uids bookkeeping relies on it matching snapshot_full."""
+        return (job.pod_group is None and job.pdb is None) \
+            or job.queue not in queues
 
     def _allocatable_total_locked(self) -> Resource:
         """Cluster-wide allocatable sum, recomputed only after node-shape

@@ -501,6 +501,10 @@ def validate_jobs(ssn: Session) -> None:
         job = ssn.jobs.get(uid)
         if job is None:
             continue
+        # a dropped job leaves ssn.jobs, and adoption stores ssn.jobs as
+        # the next snapshot base — mark it touched so the next cycle
+        # re-clones it from truth regardless of the condition-stamp path
+        ssn.touched_jobs.add(uid)
         if job.pod_group is not None:
             cond = PodGroupCondition(
                 type=UNSCHEDULABLE_CONDITION, status="True",

@@ -227,3 +227,41 @@ def test_incremental_disabled_still_schedules(monkeypatch):
             assert not audit_cache(cache)
         results[flag] = dict(kubelet.binds)
     assert results["0"] == results["1"]
+
+
+def test_gc_deleted_job_vanishes_from_incremental_snapshot():
+    """The deleted-jobs GC pops from cache truth OUTSIDE the handler
+    surface (process_cleanup_jobs); the incremental snapshot's
+    bulk-copied base must still patch the deletion out — a miss here
+    leaves a ghost job in every later snapshot (regression: the pop now
+    marks the job dirty)."""
+    from kubebatch_tpu.debug import snapshot_diff
+
+    from .fixtures import build_group, build_pod, build_queue, rl
+
+    cache = SchedulerCache(async_writeback=False)
+    cache.add_queue(build_queue("default"))
+    cache.add_pod_group(build_group("ns", "keep", 1, queue="default"))
+    cache.add_pod(build_pod("ns", "keep-0", "", PodPhase.PENDING,
+                            rl(100, 0), group="keep"))
+    cache.add_pod_group(build_group("ns", "gone", 1, queue="default"))
+    pod = build_pod("ns", "gone-0", "", PodPhase.PENDING, rl(100, 0),
+                    group="gone")
+    cache.add_pod(pod)
+
+    # cycle 1: snapshot + adopt so a base exists
+    ssn = OpenSession(cache, shipped_tiers())
+    CloseSession(ssn)
+
+    # the job terminates and the GC pops it from truth
+    cache.delete_pod(pod)
+    cache.delete_pod_group(cache.jobs["ns/gone"].pod_group)
+    assert cache.drain(timeout=5.0)
+    assert "ns/gone" not in cache.jobs
+
+    # cycle 2: the incremental snapshot must match a full clone —
+    # in particular, no ghost "ns/gone"
+    inc = cache.snapshot()
+    full = cache.snapshot_full()
+    assert "ns/gone" not in inc.jobs
+    assert not snapshot_diff(inc, full)
