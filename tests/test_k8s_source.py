@@ -435,3 +435,45 @@ def test_default_kinds_include_volumes_only_with_sink():
                           volume_sink=lambda ev: None)
     assert "persistentvolumes" in src2.kinds
     assert "storageclasses" in src2.kinds
+
+
+def test_manifest_converter_fuzz():
+    """Random structural noise around valid cores: converters must not
+    crash and must keep extracting the scheduler-relevant subset (an
+    API server's serialization carries arbitrary extra fields)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+
+    def junk(depth=0):
+        r = rng.integers(0, 6)
+        if depth > 2 or r == 0:
+            return rng.choice(["x", "", "42", "true"])
+        if r == 1:
+            return int(rng.integers(-5, 5))
+        if r == 2:
+            return [junk(depth + 1) for _ in range(rng.integers(0, 3))]
+        return {f"k{i}": junk(depth + 1)
+                for i in range(rng.integers(0, 3))}
+
+    for trial in range(50):
+        m = pod_manifest("ns", f"fz{trial}", "g")
+        # sprinkle junk keys at several levels
+        m[f"x{trial}"] = junk()
+        m["metadata"][f"j{trial}"] = junk()
+        m["spec"][f"j{trial}"] = junk()
+        m["spec"]["containers"][0][f"j{trial}"] = junk()
+        m["status"][f"j{trial}"] = junk()
+        pod = pod_from_manifest(m)
+        assert pod.name == f"fz{trial}"
+        assert pod.containers[0].requests[CPU] == 500.0
+
+        n = node_manifest(f"n{trial}")
+        n[f"x{trial}"] = junk()
+        n["status"][f"j{trial}"] = junk()
+        node = node_from_manifest(n)
+        assert node.allocatable[CPU] == 4000.0
+
+        g = podgroup_manifest("ns", f"pg{trial}", 2)
+        g["spec"][f"j{trial}"] = junk()
+        assert podgroup_from_manifest(g).min_member == 2
