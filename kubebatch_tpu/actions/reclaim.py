@@ -66,6 +66,24 @@ class ReclaimAction(Action):
                     tasks.push(task)
                 preemptor_tasks[job.uid] = tasks
 
+        if solver is not None:
+            # the first visit per queue is knowable up front (top task of
+            # the top job); one prefetch wave answers the whole steady
+            # cycle's reclaim visits in a single kernel dispatch
+            tops = []
+            for quid, jobs_pq in preemptors_map.items():
+                q = queue_map.get(quid)
+                if q is None or ssn.overused(q):
+                    continue
+                top_job = jobs_pq.peek()
+                if top_job is None:
+                    continue
+                tq = preemptor_tasks.get(top_job.uid)
+                top_task = tq.peek() if tq is not None else None
+                if top_task is not None:
+                    tops.append(top_task)
+            solver.prefetch(tops, "other_queue")
+
         while not queues.empty():
             queue = queues.pop()
             if ssn.overused(queue):

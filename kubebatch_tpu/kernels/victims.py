@@ -1249,16 +1249,33 @@ class VictimSolver:
     # ------------------------------------------------------------------
     def visit(self, task: TaskInfo, filter_kind: str,
               visited: np.ndarray) -> VisitResult:
+        key = (filter_kind, task.uid)
+        # a prefetched lane answers regardless of the escalation gate —
+        # it was dispatched precisely so this visit needn't pay a kernel
+        if self._wave_on and key in self._wave_cache:
+            return self._choose(key, task, filter_kind, visited)
         if not self._wave_on or task.uid not in self._pos \
                 or self.dispatches < self._wave_after:
             self.dispatches += 1
             return self._visit_single(task, filter_kind, visited)
-        key = (filter_kind, task.uid)
-        entry = self._wave_cache.get(key)
-        if entry is None:
-            self._dispatch_wave(filter_kind, task)
-            entry = self._wave_cache[key]
+        self._dispatch_wave(filter_kind, task)
         return self._choose(key, task, filter_kind, visited)
+
+    def prefetch(self, tasks: Sequence[TaskInfo], filter_kind: str) -> None:
+        """One wave over an explicitly KNOWN upcoming visit set (the
+        actions' first-iteration queue/job tops): a steady cycle's
+        handful of visits then resolves from ONE kernel dispatch instead
+        of N per-visit ones, without waiting for the lazy-escalation
+        threshold. Lanes land in the same event-folded cache the block
+        waves use, so staleness handling (and exactness vs per-visit
+        dispatch) is unchanged."""
+        if not self._wave_on:
+            return
+        chunk = [t for t in tasks
+                 if t.uid in self._pos
+                 and (filter_kind, t.uid) not in self._wave_cache]
+        if chunk:
+            self._dispatch_wave(filter_kind, chunk[0], chunk=chunk)
 
     def _dyn_scores(self, p_nz: np.ndarray) -> np.ndarray:
         """Fresh dynamic scores over ALL node columns against the CURRENT
@@ -1376,11 +1393,12 @@ class VictimSolver:
             "victim wave refresh did not converge")  # pragma: no cover
 
     def _dispatch_wave(self, filter_kind: str, anchor: TaskInfo,
-                       single: bool = False) -> None:
+                       single: bool = False, chunk=None) -> None:
         st = self.state
         if single:
             chunk = [anchor]
-        else:
+            p_bucket = 1
+        elif chunk is None:
             # BLOCK-aligned chunks: consumption order (the actions'
             # fairness heaps) jumps around the pending list, so pos-based
             # slices would re-wave on nearly every visit; fixed blocks
@@ -1388,8 +1406,14 @@ class VictimSolver:
             block = self._pos[anchor.uid] // self._wave_size
             start = block * self._wave_size
             chunk = self.pending[start:start + self._wave_size]
+            p_bucket = 8
+        else:
+            # explicit prefetch chunk: lanes are pure compute on the
+            # host-XLA path, so pad as tightly as the compile-shape
+            # budget allows
+            p_bucket = 4
         p = len(chunk)
-        p_pad = pad_to_bucket(p, 1 if single else 8)
+        p_pad = pad_to_bucket(p, p_bucket)
         p_res = np.zeros((p_pad, RESOURCE_DIM), np.float32)
         p_resreq = np.zeros((p_pad, RESOURCE_DIM), np.float32)
         p_nz = np.zeros((p_pad, 2), np.float32)

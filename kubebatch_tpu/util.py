@@ -43,6 +43,11 @@ class PriorityQueue:
             return None
         return heapq.heappop(self._heap).item
 
+    def peek(self):
+        if not self._heap:
+            return None
+        return self._heap[0].item
+
     def empty(self) -> bool:
         return not self._heap
 

@@ -456,6 +456,62 @@ def test_wave_equals_per_visit_dispatch(monkeypatch, seed):
     assert r_w.binds == r_v.binds
 
 
+def test_reclaim_prefetch_single_dispatch(monkeypatch):
+    """The steady-regime property behind the prefetch wave: reclaim's
+    first visit per queue is knowable up front, so a cycle whose visits
+    all fail (balanced queues — nothing reclaimable) must resolve from
+    EXACTLY ONE kernel dispatch, with results identical to per-visit
+    dispatch (here: no evictions either way)."""
+    from kubebatch_tpu.kernels import victims as kv
+
+    def build(cache):
+        # 3 queues, each filled by a 2-pod gang at its own min quorum
+        # (losing either pod breaks minMember, so gang's tier-1
+        # intersection yields NO victims anywhere) plus one pending
+        # claimant per queue — every reclaim visit fails
+        for q in range(3):
+            cache.add_queue(build_queue(f"q{q}", weight=1))
+            cache.add_node(build_node(f"n{q}", rl(4000, 8 * GiB,
+                                                  pods=20)))
+            fill = f"fill-{q}"
+            cache.add_pod_group(build_group("ns", fill, 2,
+                                            queue=f"q{q}"))
+            for i in range(2):
+                cache.add_pod(build_pod("ns", f"{fill}-{i}", f"n{q}",
+                                        PodPhase.RUNNING,
+                                        rl(1750, 3 * GiB + 512 * 1024 ** 2),
+                                        group=fill, priority=5))
+            want = f"want-{q}"
+            cache.add_pod_group(build_group("ns", want, 1,
+                                            queue=f"q{q}"))
+            cache.add_pod(build_pod("ns", f"{want}-0", "",
+                                    PodPhase.PENDING, rl(2000, 4 * GiB),
+                                    group=want, priority=50))
+
+    solvers = []
+    orig = kv.build_victim_solver
+
+    def probe(*a, **k):
+        s = orig(*a, **k)
+        if s is not None:
+            solvers.append(s)
+        return s
+
+    monkeypatch.setattr(kv, "build_victim_solver", probe)
+    monkeypatch.setenv("KUBEBATCH_VICTIM_SOLVER", "device")
+    monkeypatch.setenv("KUBEBATCH_VICTIM_WAVE", "1")
+    rec = Recorder()
+    cache = SchedulerCache(binder=rec, evictor=rec, async_writeback=False)
+    build(cache)
+    ssn = OpenSession(cache, shipped_tiers())
+    ReclaimAction().execute(ssn)
+    CloseSession(ssn)
+    assert not rec.evicted
+    assert solvers, "device solver must be built"
+    assert sum(s.dispatches for s in solvers) == 1, \
+        [s.dispatches for s in solvers]
+
+
 def test_wave_dispatch_count_sublinear(monkeypatch):
     """The wave property itself: preempt dispatches scale with replay
     conflicts, not preemptor/visit count — on a many-preemptor world the
