@@ -21,7 +21,7 @@ from ..framework import Session
 from ..kernels.fused import (K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
                              K_PROP_SHARE)
 from ..kernels.solver import DeviceSession
-from ..kernels.tensorize import TaskBatch, pad_to_bucket
+from ..kernels.tensorize import TaskBatch, pad_to_bucket, sticky_bucket
 from ..kernels.terms import device_supported, solver_terms
 
 #: job-order plugins the kernels can express, in any tier order
@@ -234,7 +234,11 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
     # creation-rank tie-break (creation_timestamp, uid)
     jobs_sorted = sorted(jobs, key=lambda j: (j.creation_timestamp, j.uid))
     j_rank = {j.uid: r for r, j in enumerate(jobs_sorted)}
-    j_pad = pad_to_bucket(len(jobs), 4)
+    # per-cache sticky store (SchedulerCache.pad_sticky): interleaved
+    # schedulers in one process must not fight over a shared shape hold;
+    # cache fakes without the field fall back to the process-global map
+    pad_store = getattr(ssn.cache, "pad_sticky", None)
+    j_pad = sticky_bucket("cycle_jobs", len(jobs), 4, store=pad_store)
     j_index = {j.uid: i for i, j in enumerate(jobs)}
 
     # ---- tasks (pending, non-BestEffort, in task-order per job) ----------
@@ -269,7 +273,12 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
     terms = solver_terms(ssn, device, tasks, assume_supported=True)
     if terms is None:
         return None
-    batch = TaskBatch.from_tasks(tasks)
+    # sticky task-axis bucket: steady churn oscillating across a pow2
+    # boundary must not recompile the whole-cycle kernels every few
+    # cycles (the 1 s p95 tail in the steady benches)
+    batch = TaskBatch.from_tasks(
+        tasks, min_bucket=sticky_bucket("cycle_tasks", len(tasks), 8,
+                                        store=pad_store))
     t_pad = batch.t_padded
 
     # ---- job arrays ------------------------------------------------------

@@ -202,6 +202,10 @@ class SchedulerCache:
         #: mutator marks its job touched, and touched jobs are refreshed
         #: next cycle (adopt_snapshot folds touched into dirty).
         self.plugin_scratch: Dict[str, object] = {}
+        #: per-cache sticky jit-shape holds (kernels/tensorize.py
+        #: sticky_bucket): interleaved schedulers in one process must not
+        #: fight over a shared shape hold
+        self.pad_sticky: Dict[str, list] = {}
         #: maintained sum of node allocatable over the cluster (drf and
         #: proportion consume it each open, drf.go:59-60); recomputed
         #: lazily after any node-shape change instead of walked per open

@@ -173,6 +173,13 @@ class Decision(NamedTuple):
     node_name: str
 
 
+#: cap on the per-session dirty-row scatter high-water (see update_rows):
+#: a single transient cluster-wide dirty set must not make every later
+#: steady-cycle update pay its host-side pad construction; updates above
+#: the cap fall back to plain pow2 buckets (rare, one compile each)
+_SCATTER_HW_CAP = 4096
+
+
 @partial(jax.jit, donate_argnums=tuple(range(8)))
 def _scatter_rows(idle, releasing, backfilled, alloc_cm, nz_req, n_tasks,
                   max_task_num, node_ok, jidx, r_idle, r_rel, r_back, r_cm,
@@ -206,6 +213,11 @@ class DeviceSession:
         self.n_tasks = jnp.asarray(self.state.n_tasks)
         self.max_task_num = jnp.asarray(self.state.max_task_num)
         self.node_ok = jnp.asarray(self.state.schedulable & self.state.valid)
+        #: grow-only high-water bucket for this session's dirty-row
+        #: scatter shape: one shape per session lifetime -> one compile
+        #: per shape, without a big session's mark leaking onto smaller
+        #: sessions in the same process
+        self._scatter_hw = 8
         update_tensorize_duration(time.perf_counter() - start)
 
     @property
@@ -262,8 +274,16 @@ class DeviceSession:
         state.nz_requested[idx] = nz
         # pad the scatter block to a pow2 bucket by REPEATING the first row
         # (identical values -> idempotent), so the jitted scatter shape is
-        # stable across cycles instead of recompiling per dirty-row count
+        # stable across cycles instead of recompiling per dirty-row count.
+        # The bucket is this session's grow-only high-water mark: a
+        # scatter is equally trivial at any size, and a single shape means
+        # a single compile — per-bucket first occurrences were the ~1 s
+        # p95 tail cycles in the steady benches
         k_pad = pad_to_bucket(k, 8)
+        if k_pad < self._scatter_hw:
+            k_pad = self._scatter_hw
+        elif k_pad <= _SCATTER_HW_CAP:
+            self._scatter_hw = k_pad
         if k_pad != k:
             pad = np.full(k_pad - k, idx[0], np.int32)
             idx = np.concatenate([idx, pad])

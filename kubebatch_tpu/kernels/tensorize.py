@@ -17,14 +17,15 @@ are finite floats).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..api import NodeInfo, TaskInfo
 from ..api.resource import RESOURCE_DIM, VEC_EPS, VEC_SCALE
 
-__all__ = ["NodeState", "TaskBatch", "pad_to_bucket", "VEC_EPS",
+__all__ = ["NodeState", "TaskBatch", "pad_to_bucket", "sticky_bucket",
+           "VEC_EPS",
            "NONZERO_MILLI_CPU", "NONZERO_MEM_MIB", "nz_request_vec"]
 
 #: upstream DefaultNonZeroRequest (priorityutil.GetNonzeroRequests) in
@@ -92,6 +93,44 @@ def pad_to_bucket(n: int, minimum: int = 8) -> int:
     b = minimum
     while b < n:
         b *= 2
+    return b
+
+
+#: sticky_bucket state: key -> [held bucket, consecutive one-below calls]
+_STICKY: Dict[str, list] = {}
+
+
+def sticky_bucket(key: str, n: int, minimum: int = 8,
+                  decay: int = 12, store: Optional[dict] = None) -> int:
+    """pad_to_bucket with one-bucket hysteresis per call-site ``key``.
+
+    A steady churn regime whose entity count oscillates across a pow2
+    boundary (e.g. 250..260 pending around 256) would otherwise flip the
+    jit shape every few cycles — each flip a fresh XLA compile, which is
+    exactly the 1 s p95 tail the steady benches showed. Holding the
+    larger bucket while the count sits ONE bucket below pins the shape;
+    after ``decay`` consecutive one-below cycles the hold steps down. A
+    drop of two or more buckets (a genuinely different workload, e.g. a
+    small scenario after a stress test in the same process) snaps down
+    immediately so big shapes never leak onto small runs.
+
+    ``store``: optional per-stream state dict (e.g. one per
+    SchedulerCache) so interleaved streams of different sizes in one
+    process don't fight over a shared hold; defaults to the
+    process-global map."""
+    st = _STICKY if store is None else store
+    b = pad_to_bucket(n, minimum)
+    ent = st.get(key)
+    if ent is None or b >= ent[0]:
+        st[key] = [b, 0]
+        return b
+    if b * 2 == ent[0]:
+        ent[1] += 1
+        if ent[1] >= decay:
+            ent[0], ent[1] = b, 0
+            return b
+        return ent[0]
+    st[key] = [b, 0]
     return b
 
 

@@ -234,3 +234,43 @@ def test_terms_cache_matches_fresh_build_across_cycles():
     cache.update_node(old, new)
     assert cache.terms_cache is None
     check_cycle()
+
+
+def test_sticky_bucket_hysteresis():
+    """Steady-churn pad stability: one-bucket oscillation holds the
+    larger shape (no per-flap recompile), a multi-bucket drop snaps down
+    immediately (big shapes must not leak onto small runs), and decay
+    steps the hold down after enough one-below cycles."""
+    from kubebatch_tpu.kernels.tensorize import _STICKY, sticky_bucket
+
+    _STICKY.pop("t", None)
+    assert sticky_bucket("t", 250, 8) == 256
+    assert sticky_bucket("t", 260, 8) == 512      # crossed: grow
+    assert sticky_bucket("t", 250, 8) == 512      # one below: hold
+    assert sticky_bucket("t", 260, 8) == 512
+    assert sticky_bucket("t", 10, 8) == 16        # far below: snap down
+    assert sticky_bucket("t", 260, 8) == 512      # grow again
+    for _ in range(11):
+        assert sticky_bucket("t", 250, 8) == 512  # held through decay-1
+    assert sticky_bucket("t", 250, 8) == 256      # 12th: stepped down
+    _STICKY.pop("t", None)
+
+
+def test_sticky_bucket_store_isolation():
+    """Per-cache stores (SchedulerCache.pad_sticky) hold independently:
+    a big stream's hold must not inflate a small stream's shapes, and
+    the big stream's grow-resets must not starve the small stream's
+    decay (the interleaved-schedulers case the store parameter exists
+    for)."""
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.kernels.tensorize import sticky_bucket
+
+    big, small = {}, {}
+    assert sticky_bucket("cycle_tasks", 500, 8, store=big) == 512
+    assert sticky_bucket("cycle_tasks", 250, 8, store=small) == 256
+    for _ in range(20):    # interleaved: big grows/resets its own entry
+        assert sticky_bucket("cycle_tasks", 500, 8, store=big) == 512
+        assert sticky_bucket("cycle_tasks", 250, 8, store=small) == 256
+    # the cache ships the store as a first-class field
+    cache = SchedulerCache(async_writeback=False)
+    assert cache.pad_sticky == {}
