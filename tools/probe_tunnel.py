@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""One-shot accelerator-tunnel probe: initialize the platform backend in
+THIS process with a hard alarm, print one status line, exit 0 (alive) /
+1 (dead). Run it under `timeout` from a watchdog loop; a wedged tunnel
+blocks inside PJRT client creation, which no Python-level timeout can
+interrupt — hence the subprocess discipline (same pattern as bench.py's
+watchdog, ref VERDICT r3 item 2 / BENCH_NOTES round-3 probes)."""
+import json
+import os
+import signal
+import sys
+import time
+
+
+def main() -> int:
+    budget = float(os.environ.get("KB_PROBE_BUDGET_S", "75"))
+    t0 = time.time()
+
+    def boom(signum, frame):
+        print(json.dumps({"ts": round(t0, 1), "alive": False,
+                          "error": f"backend init exceeded {budget}s"}))
+        sys.stdout.flush()
+        os._exit(1)
+
+    signal.signal(signal.SIGALRM, boom)
+    signal.alarm(max(1, int(budget)))
+    try:
+        import jax
+        devs = jax.devices()
+        backend = jax.default_backend()
+        # one tiny round trip proves the data path, not just the handshake
+        x = jax.numpy.ones((8, 8))
+        val = float(x.sum())
+        signal.alarm(0)
+        print(json.dumps({
+            "ts": round(t0, 1), "alive": backend not in ("cpu",),
+            "backend": backend, "n_devices": len(devs),
+            "roundtrip_ok": val == 64.0,
+            "init_s": round(time.time() - t0, 1)}))
+        return 0 if backend not in ("cpu",) else 1
+    except Exception as e:  # noqa: BLE001 — report any init failure
+        signal.alarm(0)
+        print(json.dumps({"ts": round(t0, 1), "alive": False,
+                          "error": repr(e)[:200]}))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,11 +15,20 @@ import argparse
 import cProfile
 import gc
 import io
+import os
 import pstats
 import sys
 import time
 
 sys.path.insert(0, ".")
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # honor the documented usage even when a sitecustomize preloaded
+    # jax with an accelerator platform pinned (env vars are read only
+    # at first import, so the variable alone is silently ignored there
+    # — and a wedged accelerator would hang the first dispatch)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
 
 
 def main():
@@ -30,6 +39,10 @@ def main():
     ap.add_argument("--phase", default="none",
                     help="phase to cProfile on the LAST cycle")
     ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--diag", action="store_true",
+                    help="per-cycle reclaim diagnostics (read at session "
+                         "close): overused queues, sub-quorum running "
+                         "gangs, tasks currently in RELEASING")
     args = ap.parse_args()
 
     from bench import build_actions
@@ -113,6 +126,27 @@ def main():
             if last and args.phase == name:
                 prof.disable()
             marks.append((name, time.perf_counter() - a0))
+        diag = None
+        if args.diag:
+            # read BEFORE CloseSession — it clears ssn.jobs/plugins
+            from kubebatch_tpu.api.types import TaskStatus
+            prop = ssn.plugins.get("proportion")
+            over = sum(
+                1 for attr in prop.queue_opts.values()
+                if (attr.allocated.to_vec()
+                    > attr.deserved.to_vec() + 1e-6).any()
+            ) if prop is not None else -1
+            broken = sum(
+                1 for j in ssn.jobs.values()
+                if TaskStatus.RUNNING in j.task_status_index
+                and j.count(TaskStatus.RUNNING, TaskStatus.BINDING,
+                            TaskStatus.BOUND) < j.min_available)
+            rel = sum(1 for j in ssn.jobs.values()
+                      for t in j.tasks.values()
+                      if t.status == TaskStatus.RELEASING)
+            diag = (f"  diag: overused_queues={over} "
+                    f"sub_quorum_running_gangs={broken} "
+                    f"releasing_now={rel}")
         c0 = time.perf_counter()
         if last and args.phase == "close":
             prof.enable()
@@ -126,6 +160,8 @@ def main():
         print(f"cycle {cycle}: {per} total={total * 1e3:.1f}ms "
               f"device={dev * 1e3:.1f}ms host={(total - dev) * 1e3:.1f}ms",
               file=sys.stderr)
+        if diag is not None:
+            print(diag, file=sys.stderr)
         kubelet_tick()
     gc.enable()
 
