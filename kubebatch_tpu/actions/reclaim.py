@@ -22,6 +22,63 @@ from ..framework import Action, Session, register_action
 from ..util import PriorityQueue
 from .preempt import validate_victims
 
+#: reclaimable fns whose "could any victim pass?" question has a cheap
+#: whole-session over-approximation below; an unknown owner in a tier
+#: makes that tier unprovable and disables the skip
+_PROVABLE_RECLAIM_FNS = frozenset({"gang", "conformance", "proportion"})
+
+
+def _no_possible_reclaim_victim(ssn: Session) -> bool:
+    """True when the tiered Reclaimable evaluation provably yields no
+    victim for ANY (reclaimer, reclaimees) call this session — the
+    saturated steady regime, where every gang is exactly at quorum and
+    every queue at/below its deserved share.
+
+    Soundness: a tier's intersection is non-empty only if SOME victim is
+    allowed by EVERY member fn (session_plugins.go:67-106). Each member
+    check below over-approximates "this fn could allow at least one
+    victim" (conformance, which can only subtract critical pods, is
+    taken as always-possible), so `not possible` for every tier implies
+    the real evaluation returns nil everywhere and the action's node
+    loop can never evict or pipeline. Member semantics matched:
+    gang.go:108-129 (stays >= MinAvailable after losing one, or the
+    MinAvailable==1 quirk), proportion.go:159-184 (queue stays at/above
+    deserved after losing the victim — impossible when allocated is
+    already below deserved, victim resreq >= 0)."""
+    possible_memo: Dict[str, bool] = {}
+
+    def member_possible(name: str) -> bool:
+        got = possible_memo.get(name)
+        if got is not None:
+            return got
+        if name == "gang":
+            from ..plugins.gang import can_lose_one
+            ok = any(can_lose_one(job) for job in ssn.jobs.values()
+                     if TaskStatus.RUNNING in job.task_status_index)
+        elif name == "proportion":
+            prop = ssn.plugins.get("proportion")
+            # plugin state missing while its fn is registered: can't
+            # reason about it — treat as possible (no skip)
+            ok = prop is None or any(
+                attr.deserved.less_equal(attr.allocated)
+                for attr in prop.queue_opts.values())
+        else:           # conformance: only ever subtracts critical pods
+            ok = True
+        possible_memo[name] = ok
+        return ok
+
+    fns = ssn.reclaimable_fns
+    for tier in ssn.tiers:
+        members = [opt.name for opt in tier.plugins
+                   if not opt.reclaimable_disabled and opt.name in fns]
+        if not members:
+            continue
+        if any(m not in _PROVABLE_RECLAIM_FNS for m in members):
+            return False
+        if all(member_possible(m) for m in members):
+            return False
+    return True
+
 
 class ReclaimAction(Action):
     @property
@@ -36,6 +93,38 @@ class ReclaimAction(Action):
         # ssn.queues (the snapshot drops jobs with missing queues,
         # cache.py snapshot), so the queue map alone decides.
         if len(ssn.queues) <= 1:
+            return
+
+        # Provably-idle fast path: the reference loop pops each queue and
+        # skips it when ssn.Overused(queue) (reclaim.go:95-99) — if EVERY
+        # queue holding pending work is overused up front, the loop ends
+        # without a single visit or mutation, because skipped queues are
+        # never re-pushed and nothing else in the loop body runs. In the
+        # saturated steady regime proportion marks every queue overused
+        # (allocated == deserved, proportion.go:186-200), so this O(jobs)
+        # membership walk replaces the full solver build + wave analysis
+        # the cycle would spend proving the no-op. Evaluating before the
+        # loop is exact: overused_fns are pure reads of plugin state, and
+        # the all-overused case performs no mutation that could change a
+        # later answer. Queues absent from the session can't reclaim
+        # (their jobs never enter preemptorsMap) and don't count.
+        pending_queues = {job.queue for job in ssn.jobs.values()
+                          if TaskStatus.PENDING in job.task_status_index}
+        reclaimer_queues = [q for quid in pending_queues
+                            if (q := ssn.queues.get(quid)) is not None]
+        if all(ssn.overused(q) for q in reclaimer_queues):
+            return
+
+        # Second provably-idle gate, one level deeper: even with eligible
+        # reclaimer queues, the node loop can only act if SOME victim
+        # passes the tiered Reclaimable evaluation. In the steady regime
+        # every gang sits exactly at quorum (tier 1 nil by gang's
+        # stays-at-MinAvailable rule) and pending demand holds deserved
+        # above allocated for the reclaimer queues while victims' queues
+        # sit below (tier 2 nil by proportion's floor) — the whole action
+        # is a no-op that used to cost the full solver build + a wave
+        # dispatch per cycle to discover.
+        if _no_possible_reclaim_victim(ssn):
             return
 
         from ..kernels.victims import SKIP_ACTION, build_action_solver

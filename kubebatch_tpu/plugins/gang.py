@@ -45,6 +45,15 @@ def ready_task_num(job: JobInfo) -> int:
     return job.count(*_READY_STATUSES)
 
 
+def can_lose_one(job: JobInfo) -> bool:
+    """gang's per-victim evictability rule (ref: gang.go:108-129): the job
+    stays at/above MinAvailable after losing one task, or MinAvailable==1
+    (the fork quirk kept verbatim). Shared by preemptable_fn and reclaim's
+    provably-idle gate so the two can never desync."""
+    return (job.min_available <= ready_task_num(job) - 1
+            or job.min_available == 1)
+
+
 def backfill_eligible(job: JobInfo) -> bool:
     """A job whose tasks are ALL pending may be backfilled
     (ref: gang.go:68-80)."""
@@ -81,9 +90,7 @@ class GangPlugin(Plugin):
                 job = ssn.jobs.get(preemptee.job)
                 if job is None:
                     continue
-                preemptable = (job.min_available <= ready_task_num(job) - 1
-                               or job.min_available == 1)
-                if preemptable:
+                if can_lose_one(job):
                     victims.append(preemptee)
             return victims
 

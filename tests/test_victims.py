@@ -467,8 +467,15 @@ def test_reclaim_prefetch_single_dispatch(monkeypatch):
     def build(cache):
         # 3 queues, each filled by a 2-pod gang at its own min quorum
         # (losing either pod breaks minMember, so gang's tier-1
-        # intersection yields NO victims anywhere) plus one pending
-        # claimant per queue — every reclaim visit fails
+        # intersection yields NO victims anywhere); q1/q2 also hold one
+        # pending claimant each. q0 has NO pending work, so its deserved
+        # share caps at its request and the queue saturates at
+        # deserved == allocated — which keeps proportion's tier-2
+        # victim-possibility open (a zero-request victim would pass),
+        # so reclaim's provably-idle gates must NOT fire and the action
+        # still builds the solver, yet every visit fails: proportion
+        # refuses q0's non-negligible victims (allocated - resreq drops
+        # below deserved) and q1/q2 sit under deserved
         for q in range(3):
             cache.add_queue(build_queue(f"q{q}", weight=1))
             cache.add_node(build_node(f"n{q}", rl(4000, 8 * GiB,
@@ -481,6 +488,8 @@ def test_reclaim_prefetch_single_dispatch(monkeypatch):
                                         PodPhase.RUNNING,
                                         rl(1750, 3 * GiB + 512 * 1024 ** 2),
                                         group=fill, priority=5))
+            if q == 0:
+                continue
             want = f"want-{q}"
             cache.add_pod_group(build_group("ns", want, 1,
                                             queue=f"q{q}"))

@@ -37,7 +37,7 @@ def main() -> int:
             "backend": backend, "n_devices": len(devs),
             "roundtrip_ok": val == 64.0,
             "init_s": round(time.time() - t0, 1)}))
-        return 0 if backend not in ("cpu",) else 1
+        return 0 if (backend not in ("cpu",) and val == 64.0) else 1
     except Exception as e:  # noqa: BLE001 — report any init failure
         signal.alarm(0)
         print(json.dumps({"ts": round(t0, 1), "alive": False,

@@ -128,19 +128,21 @@ def main():
             marks.append((name, time.perf_counter() - a0))
         diag = None
         if args.diag:
-            # read BEFORE CloseSession — it clears ssn.jobs/plugins
+            # read BEFORE CloseSession — it clears ssn.jobs/plugins.
+            # Uses the scheduler's own predicates (epsilon less_equal,
+            # gang's ready set) so the numbers agree with what the
+            # reclaim gates actually evaluated.
             from kubebatch_tpu.api.types import TaskStatus
+            from kubebatch_tpu.plugins.gang import ready_task_num
             prop = ssn.plugins.get("proportion")
             over = sum(
                 1 for attr in prop.queue_opts.values()
-                if (attr.allocated.to_vec()
-                    > attr.deserved.to_vec() + 1e-6).any()
+                if attr.deserved.less_equal(attr.allocated)
             ) if prop is not None else -1
             broken = sum(
                 1 for j in ssn.jobs.values()
                 if TaskStatus.RUNNING in j.task_status_index
-                and j.count(TaskStatus.RUNNING, TaskStatus.BINDING,
-                            TaskStatus.BOUND) < j.min_available)
+                and ready_task_num(j) < j.min_available)
             rel = sum(1 for j in ssn.jobs.values()
                       for t in j.tasks.values()
                       if t.status == TaskStatus.RELEASING)
