@@ -12,6 +12,9 @@ in one kernel dispatch (kernels/victims.py) and replays the chosen node's
 eviction walk through ssn.evict in float64; nodes where proportion's
 sequential skip-guard trips are handed to the exact host block.
 KUBEBATCH_VICTIM_SOLVER=host forces the reference-literal loops.
+KUBEBATCH_RECLAIM_FASTPATH=0 disables the provably-idle gates (both
+engines then always pay the full evaluation — the debug/equivalence
+mode the fastpath fuzz test runs against).
 """
 from __future__ import annotations
 
@@ -19,7 +22,7 @@ from typing import Dict
 
 from ..api import Resource, TaskStatus
 from ..framework import Action, Session, register_action
-from ..util import PriorityQueue
+from ..util import PriorityQueue, env_on
 from .preempt import validate_victims
 
 #: reclaimable fns whose "could any victim pass?" question has a cheap
@@ -108,24 +111,26 @@ class ReclaimAction(Action):
         # the all-overused case performs no mutation that could change a
         # later answer. Queues absent from the session can't reclaim
         # (their jobs never enter preemptorsMap) and don't count.
-        pending_queues = {job.queue for job in ssn.jobs.values()
-                          if TaskStatus.PENDING in job.task_status_index}
-        reclaimer_queues = [q for quid in pending_queues
-                            if (q := ssn.queues.get(quid)) is not None]
-        if all(ssn.overused(q) for q in reclaimer_queues):
-            return
+        if env_on("KUBEBATCH_RECLAIM_FASTPATH"):
+            pending_queues = {job.queue for job in ssn.jobs.values()
+                              if TaskStatus.PENDING in job.task_status_index}
+            reclaimer_queues = [q for quid in pending_queues
+                                if (q := ssn.queues.get(quid)) is not None]
+            if all(ssn.overused(q) for q in reclaimer_queues):
+                return
 
-        # Second provably-idle gate, one level deeper: even with eligible
-        # reclaimer queues, the node loop can only act if SOME victim
-        # passes the tiered Reclaimable evaluation. In the steady regime
-        # every gang sits exactly at quorum (tier 1 nil by gang's
-        # stays-at-MinAvailable rule) and pending demand holds deserved
-        # above allocated for the reclaimer queues while victims' queues
-        # sit below (tier 2 nil by proportion's floor) — the whole action
-        # is a no-op that used to cost the full solver build + a wave
-        # dispatch per cycle to discover.
-        if _no_possible_reclaim_victim(ssn):
-            return
+            # Second provably-idle gate, one level deeper: even with
+            # eligible reclaimer queues, the node loop can only act if
+            # SOME victim passes the tiered Reclaimable evaluation. In
+            # the steady regime every gang sits exactly at quorum (tier
+            # 1 nil by gang's stays-at-MinAvailable rule) and pending
+            # demand holds deserved above allocated for the reclaimer
+            # queues while victims' queues sit below (tier 2 nil by
+            # proportion's floor) — the whole action is a no-op that
+            # used to cost the full solver build + a wave dispatch per
+            # cycle to discover.
+            if _no_possible_reclaim_victim(ssn):
+                return
 
         from ..kernels.victims import SKIP_ACTION, build_action_solver
         solver = build_action_solver(ssn, "reclaimable_fns",

@@ -35,6 +35,7 @@ from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, Resource,
 from ..objects import (Node, Pod, PodDisruptionBudget, PodGroup,
                        PodGroupPhase, PodPhase, PriorityClass, Queue,
                        UNSCHEDULABLE_CONDITION)
+from ..util import env_on
 from .interface import (Binder, EventRecorder, Evictor, ListRecorder,
                         NullBinder, NullEvictor, NullStatusUpdater,
                         NullVolumeBinder, StatusUpdater, VolumeBinder)
@@ -154,8 +155,7 @@ class SchedulerCache:
         # re-cloned, everything else is reused from the adopted base.
         # ------------------------------------------------------------
         if incremental_snapshot is None:
-            incremental_snapshot = os.environ.get(
-                "KUBEBATCH_INCREMENTAL", "1") not in ("0", "false")
+            incremental_snapshot = env_on("KUBEBATCH_INCREMENTAL")
         self._incremental = incremental_snapshot
         #: previous session's entity clones (jobs-by-uid, nodes-by-name),
         #: adopted at session close; None = next snapshot is a full clone

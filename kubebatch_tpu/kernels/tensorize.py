@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..api import NodeInfo, TaskInfo
+from ..util import env_on
 from ..api.resource import RESOURCE_DIM, VEC_EPS, VEC_SCALE
 
 __all__ = ["NodeState", "TaskBatch", "pad_to_bucket", "sticky_bucket",
@@ -158,7 +159,7 @@ def load_kb_pack():
     import sysconfig
     import threading
 
-    if os.environ.get("KUBEBATCH_NATIVE", "1") in ("0", "false"):
+    if not env_on("KUBEBATCH_NATIVE"):
         _kb_pack_failed = True
         return None
     if _kb_pack_lock is None:

@@ -79,6 +79,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..api import TaskInfo, TaskStatus, ready_statuses
+from ..util import env_on
 from ..metrics import update_solver_kernel_duration
 from ..api.resource import RESOURCE_DIM
 from .solver import dynamic_node_score
@@ -1178,8 +1179,7 @@ class VictimSolver:
         #: wave state
         self.pending = list(pending)
         self._pos = {t.uid: i for i, t in enumerate(self.pending)}
-        self._wave_on = os.environ.get(
-            "KUBEBATCH_VICTIM_WAVE", "1") not in ("0", "false")
+        self._wave_on = env_on("KUBEBATCH_VICTIM_WAVE")
         env_wave = os.environ.get("KUBEBATCH_VICTIM_WAVE_SIZE")
         if env_wave is not None:
             self._wave_size = max(1, int(env_wave))

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .api import NodeInfo
+from .util import env_on
 from .kernels.solver import ALLOC, ALLOC_OB, FAIL, PIPELINE, Decision
 from .kernels.tensorize import NodeState, TaskBatch
 
@@ -37,7 +38,7 @@ def load_native() -> Optional[ctypes.CDLL]:
     global _lib, _load_failed
     if _lib is not None or _load_failed:
         return _lib
-    if os.environ.get("KUBEBATCH_NATIVE", "1") in ("0", "false"):
+    if not env_on("KUBEBATCH_NATIVE"):
         _load_failed = True
         return None
     with _lock:

@@ -4,11 +4,18 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import os
 from typing import Callable, Dict, List
 
 from .api import NodeInfo
 
 LessFn = Callable[[object, object], bool]
+
+
+def env_on(name: str, default: str = "1") -> bool:
+    """Shared parser for the package's on-by-default feature flags:
+    anything except "0"/"false" counts as enabled."""
+    return os.environ.get(name, default) not in ("0", "false")
 
 
 class _Entry:

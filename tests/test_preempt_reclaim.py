@@ -291,3 +291,38 @@ def test_reclaim_runs_solver_when_a_pending_queue_is_under_deserved():
                               rl(2000, 4 * GiB), group="newb"))
     h.cycle(ReclaimAction())
     assert h.evicted == ["ns/hog-0"]
+
+
+@pytest.mark.parametrize("seed", [2, 7, 11, 23, 31])
+def test_reclaim_fastpath_equivalence_fuzz(seed, monkeypatch):
+    # Soundness net for the provably-idle gates: on random clusters
+    # (mixed fills, gang sizes, queue counts) reclaim with the gates
+    # enabled must make EXACTLY the decisions it makes with them
+    # disabled — the gates may only skip work, never change outcomes.
+    import numpy as np
+
+    from kubebatch_tpu.sim import ClusterSpec, build_cluster
+
+    GiB = 1024 ** 3
+    rng = np.random.default_rng(seed)
+    spec = ClusterSpec(
+        n_nodes=int(rng.integers(10, 40)),
+        n_groups=int(rng.integers(10, 30)),
+        pods_per_group=int(rng.integers(1, 6)),
+        n_queues=int(rng.integers(2, 5)),
+        running_fill=float(rng.uniform(0.3, 0.95)),
+        pod_cpu_millis=int(rng.integers(2, 12)) * 250,
+        pod_mem_bytes=int(rng.integers(1, 4)) * GiB,
+        jitter=float(rng.choice([0.0, 0.2])),
+        seed=seed)
+
+    def run(fastpath: str):
+        monkeypatch.setenv("KUBEBATCH_RECLAIM_FASTPATH", fastpath)
+        h = Harness()
+        build_cluster(spec).populate(h.cache)
+        statuses = h.cycle(ReclaimAction())
+        pipelined = sorted(k for k, s in statuses.items()
+                           if s == TaskStatus.PIPELINED)
+        return sorted(h.evicted), pipelined
+
+    assert run("1") == run("0")
