@@ -26,3 +26,34 @@ def test_bench_main_one_json_line(capsys):
     # cfg2 is ~2x oversubscribed on cpu (50 nodes x 8000m vs 800 x
     # 1000m pods): exactly the cluster's capacity binds
     assert line["pods_bound_per_cycle"] == 400
+
+
+def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
+                                                          monkeypatch):
+    # Kill-safety contract of the cpu-fallback path: the primary JSON
+    # line must be on stdout BEFORE the steady extra runs (a driver
+    # timeout mid-extra then still captures the primary), and when the
+    # extra lands the LAST line carries the steady fields. Runners are
+    # stubbed so this tests the printing contract, not the measurement.
+    import json
+
+    monkeypatch.setattr(bench, "ensure_responsive_backend",
+                        lambda *a, **k: "cpu-fallback")
+    monkeypatch.setattr(bench, "run_config",
+                        lambda *a: ([0.1, 0.1], 200, 0.2, 0, {}))
+    steady_ran = {}
+
+    def fake_steady(*a):
+        # the primary line must already be visible at this point
+        steady_ran["primary_first"] = capsys.readouterr().out.strip()
+        return [0.05] * 5, 1280, {"allocate": 40.0}
+
+    monkeypatch.setattr(bench, "run_steady", fake_steady)
+    rc = bench.main(["--config", "5", "--cycles", "2"])
+    assert rc == 0
+    first = json.loads(steady_ran["primary_first"].splitlines()[-1])
+    assert first["metric"] == "sched_cycle_p50_ms_cfg5"
+    assert "steady_p50_ms" not in first
+    last = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert last["steady_p50_ms"] == 50.0
+    assert last["backend"] == "cpu-fallback"
