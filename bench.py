@@ -1,6 +1,12 @@
 #!/usr/bin/env python
 """Benchmark driver — measures scheduling-cycle latency on the BASELINE.md
-configs and prints ONE JSON line.
+configs and prints the result as a JSON line.
+
+Output contract: the LAST stdout line is the result. A cpu-fallback cfg5
+run may print TWO JSON lines (kill-safe primary first, enriched last) —
+consumers must take the last line. Process-level runs also append every
+emitted line (with timestamp + git SHA) to BENCH_DEVICE.jsonl, the
+committed evidence record; programmatic main(argv) calls (tests) do not.
 
 The reference publishes no numbers (BASELINE.md: "measured, not copied");
 `vs_baseline` is therefore reported against the north-star target of 15 ms
@@ -21,6 +27,49 @@ import time
 import numpy as np
 
 
+#: argv to stamp evidence lines with; None = recording disabled (the
+#: default for programmatic main(argv) calls, so tests with stubbed
+#: timings can never pollute the committed evidence file)
+RECORD_ARGV = None
+
+
+def record_line(out: dict, partial: bool = False) -> None:
+    """Append the emitted JSON line to the committed, append-only
+    BENCH_DEVICE.jsonl evidence file — stamped with wall-clock time and
+    git SHA at measurement time, whatever the backend. This is the
+    artifact of record for device numbers: prose transcription of
+    transient tunnel windows is not (round-4 verdict, weakness 2).
+    Best-effort: a broken stamp must never cost the stdout line."""
+    if RECORD_ARGV is None:
+        return
+    try:
+        import subprocess
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"], cwd=here,
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or "unknown"
+        except Exception:
+            sha = "unknown"
+        stamped = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                   "git_sha": sha, "argv": list(RECORD_ARGV), **out}
+        if partial:
+            # kill-safe primary row of a run whose enriched row follows;
+            # evidence-file aggregators must not double-count the run
+            stamped["partial"] = True
+        with open(os.path.join(here, "BENCH_DEVICE.jsonl"), "a") as f:
+            f.write(json.dumps(stamped) + "\n")
+    except Exception:
+        pass
+
+
+def emit(out: dict, flush: bool = False, partial: bool = False) -> None:
+    """Print a bench JSON line AND record it to BENCH_DEVICE.jsonl."""
+    print(json.dumps(out), flush=flush)
+    record_line(out, partial=partial)
+
+
 def ensure_responsive_backend(timeout: float = 120.0) -> str:
     """The TPU tunnel can wedge so hard that backend init blocks forever
     (a bare device query hangs). The shared watchdog probes in an
@@ -34,11 +83,11 @@ def ensure_responsive_backend(timeout: float = 120.0) -> str:
     if backend == "pinned":
         # backend already initialized on the wedged platform — running
         # would hang forever; fail loudly with a parseable line
-        print(json.dumps({"metric": "sched_cycle_p50_ms",
-                          "value": -1.0, "unit": "ms",
-                          "vs_baseline": 0.0,
-                          "error": "accelerator backend unresponsive "
-                                   "and platform pinned"}))
+        emit({"metric": "sched_cycle_p50_ms",
+              "value": -1.0, "unit": "ms",
+              "vs_baseline": 0.0,
+              "error": "accelerator backend unresponsive "
+                       "and platform pinned"})
         sys.exit(1)
     return backend
 
@@ -244,7 +293,18 @@ def run_steady(config: int, cycles: int, mode: str, churn_pods: int):
 
 
 def main(argv=None):
-    ap = argparse.ArgumentParser()
+    # evidence recording only for process-level runs (argv is None →
+    # parsing the real command line, i.e. the driver or an operator);
+    # programmatic calls pass argv and stay out of the evidence file
+    global RECORD_ARGV
+    RECORD_ARGV = sys.argv[1:] if argv is None else None
+    ap = argparse.ArgumentParser(
+        epilog="Output contract: the LAST stdout line is the JSON result. "
+               "On a cpu-fallback cfg5 run stdout may carry two JSON lines "
+               "(kill-safe primary first, enriched last) — consumers must "
+               "take the last line, never json.loads(whole_stdout). Every "
+               "emitted line is also appended (with timestamp + git SHA) "
+               "to BENCH_DEVICE.jsonl, the committed evidence file.")
     ap.add_argument("--config", type=int, default=5, choices=[1, 2, 3, 4, 5],
                     help="BASELINE config number (default: the 10k pods x "
                          "5k nodes stress config — BASELINE.md's primary "
@@ -301,7 +361,7 @@ def main(argv=None):
             "mode": args.mode,
             "backend": backend,
         }
-        print(json.dumps(out))
+        emit(out)
         return 0
 
     latencies, bound, seconds, evicted, action_ms = run_config(
@@ -338,8 +398,11 @@ def main(argv=None):
             # the extra's warmup re-schedules a fresh cluster at full
             # CPU rate (~10-20 s); if a driver timeout kills us mid-way
             # the primary number must already be on stdout — consumers
-            # taking the LAST line get the enriched one when it lands
-            print(json.dumps(out), flush=True)
+            # taking the LAST line get the enriched one when it lands.
+            # NOTE stdout may then carry TWO JSON lines (primary first,
+            # enriched last): consumers must parse the LAST line (see
+            # --help epilog and README "Benchmarks").
+            emit(out, flush=True, partial=True)
         try:
             churn = 256
             s_lat, s_bound, s_act = run_steady(args.config, 5, args.mode,
@@ -353,7 +416,7 @@ def main(argv=None):
             out["steady_action_ms"] = s_act
         except Exception as e:   # pragma: no cover — diagnostics only
             out["steady_error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(out))
+    emit(out)
     return 0
 
 

@@ -61,10 +61,13 @@ def _no_possible_reclaim_victim(ssn: Session) -> bool:
         elif name == "proportion":
             prop = ssn.plugins.get("proportion")
             # plugin state missing while its fn is registered: can't
-            # reason about it — treat as possible (no skip)
-            ok = prop is None or any(
-                attr.deserved.less_equal(attr.allocated)
-                for attr in prop.queue_opts.values())
+            # reason about it — treat as possible (no skip). The floor
+            # itself lives WITH the plugin (could_allow_any_victim is
+            # documented against reclaimable_fn in proportion.py) so the
+            # two evolve together.
+            ok = (prop is None
+                  or not hasattr(prop, "could_allow_any_victim")
+                  or prop.could_allow_any_victim())
         else:           # conformance: only ever subtracts critical pods
             ok = True
         possible_memo[name] = ok

@@ -66,6 +66,24 @@ class ProportionPlugin(Plugin):
         (ref: proportion.go:229-241)."""
         attr.share = dominant_share(attr.allocated, attr.deserved)
 
+    def could_allow_any_victim(self) -> bool:
+        """Over-approximation of "reclaimable_fn could return a non-empty
+        victim list for SOME (reclaimer, reclaimees) call this session" —
+        consumed by reclaim's provably-idle gate
+        (actions/reclaim.py:_no_possible_reclaim_victim).
+
+        Coupled to reclaimable_fn below: that fn admits a victim only when
+        its queue's allocated stays >= deserved after subtracting the
+        victim's resreq. Since resreq >= 0, a queue whose allocated is
+        already strictly below deserved can never pass; so victims are
+        possible only if some queue has deserved <= allocated. If
+        reclaimable_fn's floor ever changes (e.g. adopting a newer
+        reference's releasing-aware skip), THIS method must be revisited
+        in the same change — the 5-seed fuzz in
+        tests/test_preempt_reclaim.py is the backstop, not the contract."""
+        return any(attr.deserved.less_equal(attr.allocated)
+                   for attr in self.queue_opts.values())
+
     def _job_contribution(self, job):
         """(allocated, request) the job adds to its queue's rollup —
         allocated-family sum = the maintained JobInfo.allocated aggregate
@@ -177,7 +195,10 @@ class ProportionPlugin(Plugin):
         def reclaimable_fn(reclaimer: TaskInfo,
                            reclaimees: List[TaskInfo]) -> List[TaskInfo]:
             """Victim allowed iff its queue stays at/above deserved after
-            losing it (ref: proportion.go:159-184)."""
+            losing it (ref: proportion.go:159-184).
+
+            NB: could_allow_any_victim() above encodes this fn's floor for
+            reclaim's provably-idle gate — change them together."""
             victims = []
             allocations: Dict[str, Resource] = {}
             for reclaimee in reclaimees:

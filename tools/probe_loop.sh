@@ -1,0 +1,15 @@
+#!/bin/sh
+# Recurring tunnel probe, appending one JSON line per attempt to
+# PROBE_LOG_r05.jsonl — the evidence trail for VERDICT r4 directive 6
+# ("or the probe log proving no window existed").
+cd /root/repo || exit 1
+TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+RAW=$(timeout 100 python tools/probe_tunnel.py 2>/dev/null)
+RC=$?
+OUT=$(printf %s "$RAW" | tail -1)
+# embed only valid JSON; a truncated/non-JSON fragment (probe killed
+# mid-print) becomes a structured error object instead
+if ! printf %s "$OUT" | python -c 'import json,sys; json.loads(sys.stdin.read())' 2>/dev/null; then
+  OUT="{\"alive\": false, \"error\": \"probe produced no parseable line (rc=$RC; outer-timeout wedge or mid-print kill)\"}"
+fi
+echo "{\"probe_ts\": \"$TS\", \"rc\": $RC, \"result\": $OUT}" >> PROBE_LOG_r05.jsonl
