@@ -93,13 +93,17 @@ def ensure_responsive_backend(timeout: float = 120.0) -> str:
 
 
 #: per-config action order (BASELINE.md scenarios; cfg4/cfg5 use the
-#: shipped config/kube-batch-conf.yaml order)
+#: shipped config/kube-batch-conf.yaml order). "2p"/"5p" are the
+#: predicate-rich variants (labels/taints/selectors/affinity/ports at
+#: workload-ish fractions — sim/cluster.py BASELINE_SPECS).
 CONFIG_ACTIONS = {
     1: ("allocate",),
     2: ("allocate",),
     3: ("allocate", "backfill"),
     4: ("reclaim", "allocate", "backfill", "preempt"),
     5: ("reclaim", "allocate", "backfill", "preempt"),
+    "2p": ("allocate",),
+    "5p": ("reclaim", "allocate", "backfill", "preempt"),
 }
 
 
@@ -129,12 +133,15 @@ def run_config(config: int, cycles: int, mode: str):
 
     import gc
 
+    from kubebatch_tpu.actions import allocate as _alloc_mod
+
     latencies = []
     bound_total = 0
     bind_seconds = 0.0
     evicted_total = 0
     action_seconds = {name: 0.0 for name in CONFIG_ACTIONS[config]}
     measured_cycles = 0
+    engines = set()
     # GC discipline mirrors runtime/scheduler.py: automatic collection off
     # during the timed cycle (a gen2 pass scans the whole 100k+ object
     # cluster graph mid-cycle otherwise), explicit collection between
@@ -184,11 +191,13 @@ def run_config(config: int, cycles: int, mode: str):
                 for name, s in act_times:
                     action_seconds[name] += s
                 measured_cycles += 1
+                engines.add(_alloc_mod.last_cycle_engine)
     finally:
         gc.enable()
     action_ms = {name: round(1e3 * s / max(1, measured_cycles), 3)
                  for name, s in action_seconds.items()}
-    return latencies, bound_total, bind_seconds, evicted_total, action_ms
+    return (latencies, bound_total, bind_seconds, evicted_total, action_ms,
+            sorted(engines))
 
 
 def run_steady(config: int, cycles: int, mode: str, churn_pods: int):
@@ -305,10 +314,11 @@ def main(argv=None):
                "take the last line, never json.loads(whole_stdout). Every "
                "emitted line is also appended (with timestamp + git SHA) "
                "to BENCH_DEVICE.jsonl, the committed evidence file.")
-    ap.add_argument("--config", type=int, default=5, choices=[1, 2, 3, 4, 5],
+    ap.add_argument("--config", default="5",
+                    choices=["1", "2", "3", "4", "5", "2p", "5p"],
                     help="BASELINE config number (default: the 10k pods x "
                          "5k nodes stress config — BASELINE.md's primary "
-                         "metric)")
+                         "metric); 2p/5p = predicate-rich variants")
     # default sized so the primary metric carries >= 5 measured cycles
     # (the first cycle pays jit and is excluded)
     ap.add_argument("--cycles", type=int, default=6)
@@ -329,6 +339,8 @@ def main(argv=None):
                          "approximate); fused = bind-for-bind faithful "
                          "scan engine")
     args = ap.parse_args(argv)
+    args.config = (int(args.config) if args.config.isdigit()
+                   else args.config)
 
     from kubebatch_tpu import enable_persistent_compile_cache
     enable_persistent_compile_cache()
@@ -364,7 +376,7 @@ def main(argv=None):
         emit(out)
         return 0
 
-    latencies, bound, seconds, evicted, action_ms = run_config(
+    latencies, bound, seconds, evicted, action_ms, engines = run_config(
         args.config, args.cycles, args.mode)
     p50_ms = float(np.percentile(latencies, 50) * 1e3)
     p95_ms = float(np.percentile(latencies, 95) * 1e3)
@@ -381,6 +393,7 @@ def main(argv=None):
         "measured_cycles": len(latencies),
         "action_ms": action_ms,
         "mode": args.mode,
+        "engines": engines,
         "backend": backend,
     }
     if evicted:

@@ -54,6 +54,12 @@ AUTO_BATCHED_MIN = 512
 #: collectives (on a single chip sharded degenerates to batched anyway)
 AUTO_SHARDED_MIN_NODES = 512
 
+#: engine that actually consumed the last allocate cycle in this process
+#: ("batched" / "sharded" / "fused" / "jax-visit" / "host-visit" /
+#: "rpc") — observability for bench.py, so a silent fallback off the
+#: device engines is visible in the recorded JSON, not just slower
+last_cycle_engine: str = ""
+
 
 def _effective_min_available(ssn: Session, job: JobInfo) -> int:
     """The readiness threshold the kernel enforces in-scan. With a job-ready
@@ -106,6 +112,7 @@ class AllocateAction(Action):
         return "batched"
 
     def execute(self, ssn: Session) -> None:
+        global last_cycle_engine
         mode = self.mode
         if mode == "auto":
             mode = self._auto_mode(ssn)
@@ -118,14 +125,19 @@ class AllocateAction(Action):
             # (the reference's convergence-by-rescheduling spirit: a
             # degraded cycle beats a skipped one)
             if self._execute_rpc(ssn):
+                last_cycle_engine = "rpc"
                 return
             mode = self._auto_mode(ssn)
         if mode in ("batched", "sharded"):
             from .allocate_batched import batched_supported, execute_batched
-            # execute_batched itself returns False (without consuming
-            # state) when the snapshot carries unsupported features
-            if batched_supported(ssn) \
-                    and execute_batched(ssn, sharded=(mode == "sharded")):
+            # execute_batched returns the engine that actually ran (it
+            # demotes sharded->batched for affinity cycles and on single-
+            # device hosts) or False — without consuming state — when the
+            # snapshot carries unsupported features
+            ran = batched_supported(ssn) \
+                and execute_batched(ssn, sharded=(mode == "sharded"))
+            if ran:
+                last_cycle_engine = ran
                 return
             mode = "batched"   # device fallback path below
         elif mode == "fused":
@@ -133,6 +145,7 @@ class AllocateAction(Action):
             # execute_fused itself returns False (without consuming state)
             # when the snapshot carries features the kernel can't model
             if fused_supported(ssn) and execute_fused(ssn):
+                last_cycle_engine = "fused"
                 return
             # configured plugins exceed the fused vocabulary; fall back to
             # the per-visit device solver
@@ -217,6 +230,10 @@ class AllocateAction(Action):
             from ..native import NativeSession, native_available
             if native_available():
                 device = NativeSession(ssn.nodes)
+
+        global last_cycle_engine
+        last_cycle_engine = (f"{mode}-visit" if device is not None
+                             else "host-visit")
 
         while not queues.empty():
             queue = queues.pop()

@@ -21,15 +21,21 @@ from .cycle_inputs import (EMPTY_CYCLE, build_cycle_inputs, cycle_supported,
 batched_supported = cycle_supported
 
 
-def execute_batched(ssn: Session, sharded: bool = False) -> bool:
+def execute_batched(ssn: Session, sharded: bool = False):
     """Run the whole allocate action as a handful of round dispatches.
-    Returns False — without consuming any state — when the snapshot has
-    features the kernels can't express (the caller falls back)."""
-    inputs = build_cycle_inputs(ssn)
+    Returns the engine that actually ran ("batched" / "sharded" — truthy,
+    and honest about the silent sharded->batched demotions below), or
+    False — without consuming any state — when the snapshot has features
+    the kernels can't express (the caller falls back)."""
+    inputs = build_cycle_inputs(ssn, allow_affinity=True)
     if inputs is EMPTY_CYCLE:
-        return True
+        return "sharded" if sharded else "batched"
     if inputs is None:
         return False
+    if inputs.affinity is not None:
+        # the sharded twin has no affinity carry partitioning yet — run
+        # the single-chip engine for affinity cycles
+        sharded = False
     if sharded:
         import jax
 
@@ -39,8 +45,8 @@ def execute_batched(ssn: Session, sharded: bool = False) -> bool:
             task_state, task_node, task_seq, _ = solve_batched_sharded(
                 node_mesh(), inputs.device, inputs)
             replay_decisions(ssn, inputs, task_state, task_node, task_seq)
-            return True
+            return "sharded"
         # single device: the mesh adds nothing — plain engine below
     task_state, task_node, task_seq, _ = solve_batched(inputs.device, inputs)
     replay_decisions(ssn, inputs, task_state, task_node, task_seq)
-    return True
+    return "batched"

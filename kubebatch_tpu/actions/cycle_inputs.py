@@ -146,6 +146,10 @@ class CycleInputs:
     #: False when no node carries releasing resources at cycle start —
     #: lets the batched kernel fold away all pipeline-fit work statically
     pipe_enabled: bool = True
+    #: inter-pod affinity / host-port vocabulary (kernels/affinity.py);
+    #: None when the snapshot has none (or the builder was told not to
+    #: encode them — only the batched engine consumes these)
+    affinity: Optional[object] = None
     # lazy cache for pair_terms(): (max_pairs budget, result)
     _pair_terms: Optional[tuple] = None
 
@@ -211,10 +215,16 @@ class CycleInputs:
         return result
 
 
-def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
+def build_cycle_inputs(ssn: Session,
+                       allow_affinity: bool = False) -> Optional[CycleInputs]:
     """Tensorize the session for a whole-cycle solve, or None when some
     registered callback / snapshot feature can't run on device (callers
-    then fall back without having paid the device upload)."""
+    then fall back without having paid the device upload).
+
+    ``allow_affinity``: encode inter-pod affinity / host ports into the
+    batched engine's vocabulary (kernels/affinity.py) instead of falling
+    back on them; the fused engine passes False — its one-placement scan
+    has no affinity carry."""
     # ---- queues ----------------------------------------------------------
     queue_ids = sorted(ssn.queues)          # uid order = order fallback
     q_index = {q: i for i, q in enumerate(queue_ids)}
@@ -261,10 +271,18 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
             task_ranks.append(rank)
     if not tasks:
         return EMPTY_CYCLE
-    # cheap feature gate BEFORE tensorizing/uploading the cluster — a
+    # cheap feature gates BEFORE tensorizing/uploading the cluster — a
     # fallback cycle must not pay the device transfer
-    if not device_supported(ssn, tasks):
+    if not device_supported(ssn, tasks, allow_affinity=allow_affinity):
         return None
+    aff_wanted = False
+    if allow_affinity:
+        from ..kernels.affinity import (affinity_features_present,
+                                        affinity_within_vocabulary)
+        if affinity_features_present(ssn, tasks):
+            if not affinity_within_vocabulary(ssn, tasks):
+                return None   # over the caps — reference-literal host path
+            aff_wanted = True
     if ssn.device_snapshot is None:
         mk = getattr(ssn.cache, "device_session", None)
         ssn.device_snapshot = (mk(ssn) if mk is not None
@@ -280,6 +298,14 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
         tasks, min_bucket=sticky_bucket("cycle_tasks", len(tasks), 8,
                                         store=pad_store))
     t_pad = batch.t_padded
+
+    # ---- inter-pod affinity / host ports (batched engine only) -----------
+    aff_inputs = None
+    if aff_wanted:
+        from ..kernels.affinity import build_affinity_inputs
+        aff_inputs = build_affinity_inputs(ssn, tasks, device, t_pad)
+        if aff_inputs is None:   # pragma: no cover — pre-checked above
+            return None
 
     # ---- job arrays ------------------------------------------------------
     gang = gang_enabled(ssn)
@@ -368,7 +394,7 @@ def build_cycle_inputs(ssn: Session) -> Optional[CycleInputs]:
         j_alloc0=j_alloc0, cluster_total=cluster_total,
         dyn_weights=dyn_weights, dyn_enabled=dyn_enabled,
         job_keys=job_keys, queue_keys=queue_keys, gang_enabled=gang,
-        prop_overused=prop_overused,
+        prop_overused=prop_overused, affinity=aff_inputs,
         # the DeviceSession's numpy mirror holds every node's releasing
         # vector in lock-step with host truth — one vectorized check
         # instead of a 5k-node attribute walk per cycle

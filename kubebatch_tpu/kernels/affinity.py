@@ -1,0 +1,422 @@
+"""Inter-pod affinity + host-port vocabulary for the batched engine.
+
+The last SURVEY §7 hard part: the reference evaluates inter-pod
+(anti-)affinity and host-port conflicts per (task, node) call inside its
+hot loop (ref: pkg/scheduler/plugins/predicates/predicates.go:47-104,146,188
+and plugins/nodeorder/nodeorder.go:305-313), against *current assignments*
+— which made any snapshot carrying those features fall off the device
+engines onto O(pods x nodes) host callbacks.
+
+This module encodes the features as tensors the round solver can carry:
+
+- **pairs**: every (label-selector group, topology key) referenced by a
+  required / preferred (anti-)affinity term — of pending tasks AND of
+  existing pods (whose required anti terms reject candidates through the
+  symmetry rule, and whose preferred terms feed the interpod score).
+  A "group" is (match_labels, namespace set); membership of any pod is
+  static. Topology domains are the distinct values of the key's node
+  label; a node lacking the key belongs to NO domain (-1).
+- **carry** (kernels/batched.py RoundState): per-pair domain counts of
+  group members, of required-anti *carriers*, and a signed weighted count
+  of preferred-term carriers (incl. the hard-affinity symmetric weight),
+  plus cluster-wide group totals and a per-node port-claim matrix. The
+  round commit scatter-adds accepted placements into them; the
+  stranded-gang rollback subtracts them exactly.
+- **predicate** inside the round: three [T,P] x [P,N] boolean matmuls
+  (required-positive, required-anti, symmetry) + one port matmul — the
+  MXU-shaped equivalent of predicates.go's per-pair walk.
+
+Semantics matched against the host oracle (plugins/predicates.py):
+required-positive terms pass where the group has a member in the node's
+domain, with the upstream first-pod bootstrap (a self-matching pod may
+start a group that has no cluster-wide match); anti terms and the
+symmetry rule reject domains holding members / carriers. In-round
+parallelism hazards (two pods racing into one domain whose coexistence
+sequential placement would have rejected) are removed by per-(pair,
+domain) serialization at acceptance — see kernels/batched.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import TaskInfo, allocated_status
+from ..objects import Pod, PodAffinityTerm
+
+#: vocabulary caps — snapshots beyond them fall back to the host path
+#: (the same contract as TermsCache.MAX_SIGS: degenerate shapes must not
+#: grow device state unboundedly)
+MAX_PAIRS = 128
+MAX_PORTS = 64
+
+#: mirror of plugins/nodeorder.HARD_POD_AFFINITY_SYMMETRIC_WEIGHT
+#: (imported lazily in build to avoid a plugins<->kernels import cycle)
+
+
+@dataclass
+class AffinityInputs:
+    """Everything the batched kernel needs for affinity/ports, numpy."""
+    # --- static per-pair / per-node -----------------------------------
+    node_dom: np.ndarray       # [P, N_pad] int32, -1 = node has no domain
+    # --- static per-task ----------------------------------------------
+    task_grp: np.ndarray       # [T_pad, P] bool — pod in pair's group
+    task_req_aff: np.ndarray   # [T_pad, P] bool — carries required affinity
+    task_req_anti: np.ndarray  # [T_pad, P] bool — carries required anti
+    task_self_ok: np.ndarray   # [T_pad, P] bool — bootstrap-eligible
+    task_carry_w: np.ndarray   # [T_pad, P] f32 — carried preferred weight
+    task_pref_w: np.ndarray    # [T_pad, P] f32 — own preferred weight
+    task_ports: np.ndarray     # [T_pad, PT] bool
+    port_base: np.ndarray      # [N_pad, PT] bool — ports used pre-cycle
+    # --- initial carry (from existing candidates) ---------------------
+    grp_cnt0: np.ndarray       # [P, D] f32
+    anti_cnt0: np.ndarray      # [P, D] f32
+    pref_w0: np.ndarray        # [P, D] f32
+    grp_total0: np.ndarray     # [P] f32
+    # --- score term ---------------------------------------------------
+    ip_weight: float           # nodeorder pod_aff weight
+    ip_enabled: bool
+
+    @property
+    def n_pairs(self) -> int:
+        return self.node_dom.shape[0]
+
+
+def affinity_features_present(ssn, pending: Sequence[TaskInfo]) -> bool:
+    """True when the snapshot carries any feature this module encodes AND
+    a plugin that enforces it is active — with predicates and nodeorder
+    both disabled, affinity/ports are semantically inert (the host path
+    would not check them either) and the plain batched graph runs.
+    Feature detection mirrors encode.dynamic_features exactly."""
+    from .encode import dynamic_features
+
+    def active(fns, disable_attr):
+        return any(not getattr(opt, disable_attr) and opt.name in fns
+                   for tier in ssn.tiers for opt in tier.plugins)
+
+    if not (active(ssn.predicate_fns, "predicate_disabled")
+            or active(ssn.node_order_fns, "node_order_disabled")):
+        return False
+    return dynamic_features(ssn, pending) is not None
+
+
+def affinity_within_vocabulary(ssn, pending: Sequence[TaskInfo]) -> bool:
+    """Cheap host-side cap check (no tensorization, no device work): do
+    the snapshot's pair/port counts fit the vocabulary? Lets the builder
+    refuse BEFORE the full-cluster device upload — a fallback cycle must
+    not pay the transfer (same contract as terms.device_supported)."""
+    pairs = _PairSpace()
+    ports = set()
+    for t in pending:
+        pod = t.pod
+        for port in pod.host_ports():
+            ports.add(port)
+        aff = pod.affinity
+        if aff is None:
+            continue
+        for term in aff.pod_affinity_required:
+            pairs.add(term, pod)
+        for term in aff.pod_anti_affinity_required:
+            pairs.add(term, pod)
+        for _w, term in aff.pod_affinity_preferred:
+            pairs.add(term, pod)
+        for _w, term in aff.pod_anti_affinity_preferred:
+            pairs.add(term, pod)
+    if len(ports) > MAX_PORTS:
+        return False
+    if len(pairs) > MAX_PAIRS:
+        return False
+    for t in _candidates(ssn):
+        pod = t.pod
+        if not pod.has_pod_affinity():
+            continue
+        aff = pod.affinity
+        for term in aff.pod_anti_affinity_required:
+            pairs.add(term, pod)
+        for _w, term in aff.pod_affinity_preferred:
+            pairs.add(term, pod)
+        for _w, term in aff.pod_anti_affinity_preferred:
+            pairs.add(term, pod)
+        for term in aff.pod_affinity_required:
+            pairs.add(term, pod)
+        if len(pairs) > MAX_PAIRS:
+            return False
+    return True
+
+
+def _ns_key(term: PodAffinityTerm, owner: Pod) -> Tuple[str, ...]:
+    """The term's namespace set, resolved at encode time (empty list =
+    the owner pod's own namespace, predicates.go semantics)."""
+    if term.namespaces:
+        return tuple(sorted(set(term.namespaces)))
+    return (owner.namespace,)
+
+
+def _pair_key(term: PodAffinityTerm, owner: Pod) -> Tuple:
+    return (tuple(sorted(term.match_labels.items())),
+            _ns_key(term, owner), term.topology_key)
+
+
+class _PairSpace:
+    """Collects (group, topology-key) pairs and memoizes membership."""
+
+    def __init__(self):
+        self.index: Dict[Tuple, int] = {}
+        self.keys: List[Tuple] = []
+
+    def add(self, term: PodAffinityTerm, owner: Pod) -> int:
+        key = _pair_key(term, owner)
+        p = self.index.get(key)
+        if p is None:
+            p = len(self.keys)
+            self.index[key] = p
+            self.keys.append(key)
+        return p
+
+    def __len__(self):
+        return len(self.keys)
+
+
+def _member(pair_key: Tuple, pod: Pod) -> bool:
+    labels_kv, ns_set, _ = pair_key
+    if pod.namespace not in ns_set:
+        return False
+    labels = pod.labels
+    return all(labels.get(k) == v for k, v in labels_kv)
+
+
+def _candidates(ssn) -> List[TaskInfo]:
+    """The session-backed candidate set, identical to
+    plugins/predicates.candidate_tasks (and nodeorder's `existing`):
+    allocated-family session tasks with a node + on-node tasks."""
+    seen = set()
+    out = []
+    for job in ssn.jobs.values():
+        for status, tasks in job.task_status_index.items():
+            if allocated_status(status):
+                for t in tasks.values():
+                    if t.node_name and t.key not in seen:
+                        seen.add(t.key)
+                        out.append(t)
+    for n in ssn.nodes.values():
+        for t in n.tasks.values():
+            if t.key not in seen:
+                seen.add(t.key)
+                out.append(t)
+    return out
+
+
+def build_affinity_inputs(ssn, tasks: Sequence[TaskInfo], device,
+                          t_pad: int) -> Optional[AffinityInputs]:
+    """Encode the snapshot's affinity/port features, or None when they
+    exceed the vocabulary caps (callers fall back to the host path).
+
+    ``tasks`` is the cycle's pending task list (cycle_inputs order);
+    ``device`` the DeviceSession whose NodeState fixes the node axis.
+    """
+    from ..plugins.nodeorder import HARD_POD_AFFINITY_SYMMETRIC_WEIGHT
+
+    state = device.state
+    n_pad = state.n_padded
+    names = state.names
+
+    # ---- which halves apply (disabled plugins must not enforce) -------
+    pred_active = any(
+        not opt.predicate_disabled and opt.name in ssn.predicate_fns
+        for tier in ssn.tiers for opt in tier.plugins)
+    ip_weight = 0.0
+    order_active = any(
+        not opt.node_order_disabled and opt.name in ssn.node_order_fns
+        for tier in ssn.tiers for opt in tier.plugins)
+    if order_active:
+        no_plugin = ssn.plugins.get("nodeorder")
+        weights = getattr(no_plugin, "weights", None) or {"pod_aff": 1}
+        ip_weight = float(weights.get("pod_aff", 1))
+
+    # ---- collect pairs ------------------------------------------------
+    pairs = _PairSpace()
+    # pending tasks' terms, keyed by cycle task index
+    pend_terms: List[Tuple[int, Pod, list, list, list]] = []
+    for i, t in enumerate(tasks):
+        pod = t.pod
+        aff = pod.affinity
+        if aff is None:
+            continue
+        req = anti = []
+        if pred_active:
+            req = [(pairs.add(term, pod), term)
+                   for term in aff.pod_affinity_required]
+            anti = [(pairs.add(term, pod), term)
+                    for term in aff.pod_anti_affinity_required]
+        pref = []
+        if ip_weight != 0.0:
+            pref = [(pairs.add(term, pod), float(w))
+                    for w, term in aff.pod_affinity_preferred]
+            pref += [(pairs.add(term, pod), -float(w))
+                     for w, term in aff.pod_anti_affinity_preferred]
+        if req or anti or pref:
+            pend_terms.append((i, pod, req, anti, pref))
+    # existing candidates' anti terms (symmetry) + preferred (score)
+    cands = _candidates(ssn)
+    cand_terms: List[Tuple[TaskInfo, list, list]] = []
+    for t in cands:
+        pod = t.pod
+        if not pod.has_pod_affinity():
+            continue
+        aff = pod.affinity
+        anti = []
+        if pred_active:
+            anti = [(pairs.add(term, pod), term)
+                    for term in aff.pod_anti_affinity_required]
+        carry: List[Tuple[int, float]] = []
+        if ip_weight != 0.0:
+            carry = [(pairs.add(term, pod), float(w))
+                     for w, term in aff.pod_affinity_preferred]
+            carry += [(pairs.add(term, pod), -float(w))
+                      for w, term in aff.pod_anti_affinity_preferred]
+            if HARD_POD_AFFINITY_SYMMETRIC_WEIGHT:
+                carry += [(pairs.add(term, pod),
+                           float(HARD_POD_AFFINITY_SYMMETRIC_WEIGHT))
+                          for term in aff.pod_affinity_required]
+        if anti or carry:
+            cand_terms.append((t, anti, carry))
+
+    if len(pairs) > MAX_PAIRS:
+        return None
+
+    # ---- ports (a predicate: enforced only when predicates run) -------
+    port_ids: Dict[int, int] = {}
+    if pred_active:
+        for t in tasks:
+            for port in t.pod.host_ports():
+                if port not in port_ids:
+                    port_ids[port] = len(port_ids)
+    if len(port_ids) > MAX_PORTS:
+        return None
+    pt = max(1, len(port_ids))
+
+    p_cnt = max(1, len(pairs))
+    d_pad = n_pad  # distinct domain values per key <= real node count
+
+    # ---- node domains -------------------------------------------------
+    key_dom: Dict[str, np.ndarray] = {}   # topology key -> [N_pad] ids
+    node_dom = np.full((p_cnt, n_pad), -1, np.int32)
+    nodes = ssn.nodes
+    for p, key in enumerate(pairs.keys):
+        topo = key[2]
+        col = key_dom.get(topo)
+        if col is None:
+            col = np.full(n_pad, -1, np.int32)
+            values: Dict[str, int] = {}
+            for col_i, name in enumerate(names):
+                ni = nodes.get(name)
+                if ni is None or ni.node is None:
+                    continue
+                v = ni.node.labels.get(topo)
+                if v is None:
+                    continue
+                d = values.setdefault(v, len(values))
+                col[col_i] = d
+            key_dom[topo] = col
+        node_dom[p] = col
+
+    # ---- membership memo (per label-shape x namespace) ----------------
+    member_memo: Dict[Tuple, np.ndarray] = {}
+
+    def membership(pod: Pod) -> np.ndarray:
+        sig = getattr(pod, "_kb_aff_lsig", None)
+        if sig is None:
+            sig = (tuple(sorted(pod.labels.items())), pod.namespace)
+            pod._kb_aff_lsig = sig
+        row = member_memo.get(sig)
+        if row is None:
+            row = np.fromiter(
+                (_member(k, pod) for k in pairs.keys), bool,
+                count=len(pairs))
+            if len(pairs) < p_cnt:      # p_cnt >= 1 floor
+                row = np.pad(row, (0, p_cnt - len(pairs)))
+            member_memo[sig] = row
+        return row
+
+    # ---- initial carry from candidates --------------------------------
+    grp_cnt0 = np.zeros((p_cnt, d_pad), np.float32)
+    anti_cnt0 = np.zeros((p_cnt, d_pad), np.float32)
+    pref_w0 = np.zeros((p_cnt, d_pad), np.float32)
+    grp_total0 = np.zeros(p_cnt, np.float32)
+    node_index = state.index
+    for t in cands:
+        row = membership(t.pod)
+        if not row.any():
+            continue
+        grp_total0 += row
+        col = node_index.get(t.node_name)
+        if col is None:
+            continue
+        doms = node_dom[:, col]
+        ok = row & (doms >= 0)
+        grp_cnt0[ok, doms[ok]] += 1.0
+    for t, anti, carry in cand_terms:
+        col = node_index.get(t.node_name)
+        if col is None:
+            continue
+        for p, _term in anti:
+            d = node_dom[p, col]
+            if d >= 0:
+                anti_cnt0[p, d] += 1.0
+        for p, w in carry:
+            d = node_dom[p, col]
+            if d >= 0:
+                pref_w0[p, d] += w
+
+    # ---- per-task arrays ----------------------------------------------
+    task_grp = np.zeros((t_pad, p_cnt), bool)
+    task_req_aff = np.zeros((t_pad, p_cnt), bool)
+    task_req_anti = np.zeros((t_pad, p_cnt), bool)
+    task_self_ok = np.zeros((t_pad, p_cnt), bool)
+    task_carry_w = np.zeros((t_pad, p_cnt), np.float32)
+    task_pref_w = np.zeros((t_pad, p_cnt), np.float32)
+    task_ports = np.zeros((t_pad, pt), bool)
+    for i, t in enumerate(tasks):
+        task_grp[i] = membership(t.pod)
+        for port in t.pod.host_ports():
+            task_ports[i, port_ids[port]] = True
+    hard_w = float(HARD_POD_AFFINITY_SYMMETRIC_WEIGHT) if ip_weight else 0.0
+    for i, pod, req, anti, pref in pend_terms:
+        for p, term in req:
+            task_req_aff[i, p] = True
+            # bootstrap: the pod's own labels/ns satisfy the term
+            # (upstream anySchedulable first-pod semantics)
+            if term.selects(pod) and pod.namespace in _ns_key(term, pod):
+                task_self_ok[i, p] = True
+            if hard_w:
+                task_carry_w[i, p] += hard_w
+        for p, term in anti:
+            task_req_anti[i, p] = True
+        for p, w in pref:
+            task_pref_w[i, p] += w
+            task_carry_w[i, p] += w
+
+    # ---- port base from on-node pods ----------------------------------
+    port_base = np.zeros((n_pad, pt), bool)
+    if port_ids:
+        for name, ni in nodes.items():
+            col = node_index.get(name)
+            if col is None:
+                continue
+            for t in ni.tasks.values():
+                for port in t.pod.host_ports():
+                    slot = port_ids.get(port)
+                    if slot is not None:
+                        port_base[col, slot] = True
+
+    ip_enabled = bool(ip_weight != 0.0
+                      and (np.any(task_pref_w) or np.any(pref_w0)
+                           or np.any(task_carry_w)))
+    return AffinityInputs(
+        node_dom=node_dom, task_grp=task_grp, task_req_aff=task_req_aff,
+        task_req_anti=task_req_anti, task_self_ok=task_self_ok,
+        task_carry_w=task_carry_w, task_pref_w=task_pref_w,
+        task_ports=task_ports, port_base=port_base,
+        grp_cnt0=grp_cnt0, anti_cnt0=anti_cnt0, pref_w0=pref_w0,
+        grp_total0=grp_total0, ip_weight=ip_weight, ip_enabled=ip_enabled)

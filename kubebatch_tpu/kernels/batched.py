@@ -62,7 +62,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -92,6 +92,15 @@ class RoundState(NamedTuple):
     task_state: jnp.ndarray   # [T] SKIP while pending
     task_node: jnp.ndarray    # [T]
     task_seq: jnp.ndarray     # [T] round * T_pad + in-round rank
+    # --- inter-pod affinity / host-port carry (kernels/affinity.py);
+    # None when the cycle has no such features (the pytree structure is
+    # part of the trace signature, so affinity-free cycles compile the
+    # exact pre-affinity graphs) ---------------------------------------
+    aff_grp_cnt: Optional[jnp.ndarray] = None    # [P,D] group members
+    aff_anti_cnt: Optional[jnp.ndarray] = None   # [P,D] req-anti carriers
+    aff_pref_w: Optional[jnp.ndarray] = None     # [P,D] preferred weight
+    aff_grp_total: Optional[jnp.ndarray] = None  # [P] cluster-wide members
+    port_claim: Optional[jnp.ndarray] = None     # [N,PT] bool (this cycle)
 
 
 class CycleArrays(NamedTuple):
@@ -121,6 +130,18 @@ class CycleArrays(NamedTuple):
     q_create_rank: jnp.ndarray    # [Q]
     cluster_total: jnp.ndarray    # [R]
     dyn_weights: jnp.ndarray      # [2]
+    # --- static affinity/port vocabulary (kernels/affinity.py docs);
+    # None on affinity-free cycles -------------------------------------
+    node_dom: Optional[jnp.ndarray] = None       # [P,N] int32, -1 = none
+    task_grp: Optional[jnp.ndarray] = None       # [T,P] bool
+    task_req_aff: Optional[jnp.ndarray] = None   # [T,P] bool
+    task_req_anti: Optional[jnp.ndarray] = None  # [T,P] bool
+    task_self_ok: Optional[jnp.ndarray] = None   # [T,P] bool
+    task_carry_w: Optional[jnp.ndarray] = None   # [T,P] f32
+    task_pref_w: Optional[jnp.ndarray] = None    # [T,P] f32
+    task_ports: Optional[jnp.ndarray] = None     # [T,PT] bool
+    port_base: Optional[jnp.ndarray] = None      # [N,PT] bool
+    ip_weight: Optional[jnp.ndarray] = None      # [] f32 (pod_aff weight)
 
 
 def _segmented_prefix(values: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
@@ -142,6 +163,240 @@ def _segmented_prefix(values: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
 
     sums, _ = jax.lax.associative_scan(comb, (values, flag))
     return sums - values                                   # exclusive
+
+
+# ---------------------------------------------------------------------
+# inter-pod affinity / host ports (vocabulary: kernels/affinity.py)
+# ---------------------------------------------------------------------
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+def _aff_gather(state: RoundState, a: CycleArrays):
+    """Per-(pair, node) views of the domain-count carry: group-member
+    count, anti-carrier count, and the domain validity mask."""
+    d_cap = state.aff_grp_cnt.shape[1]
+    has_dom = a.node_dom >= 0
+    domc = jnp.clip(a.node_dom, 0, d_cap - 1)
+    gcnt = jnp.take_along_axis(state.aff_grp_cnt, domc, axis=1)   # [P,N]
+    acnt = jnp.take_along_axis(state.aff_anti_cnt, domc, axis=1)  # [P,N]
+    return has_dom, domc, gcnt, acnt
+
+
+def _aff_eligibility(state: RoundState, a: CycleArrays):
+    """[T,N] mask of the affinity + host-port predicates against the
+    committed (round-start) carry, plus the wait mask for positive terms
+    that a same-cycle placement could still satisfy.
+
+    Three boolean matmuls mirror predicates.go's per-pair walk:
+    - required-positive: fail where the group has no member in the node's
+      domain, unless the first-pod bootstrap applies (empty group +
+      self-matching term — upstream anySchedulable);
+    - required-anti: fail where the group HAS a member in the domain;
+    - symmetry: a group member fails where a required-anti *carrier* for
+      its group sits in the domain (predicates.go:47-104's check of
+      existing pods' anti terms against the incoming pod).
+    """
+    has_dom, _, gcnt, acnt = _aff_gather(state, a)
+    present = has_dom & (gcnt > 0)                       # [P,N]
+    boot = ((state.aff_grp_total <= 0)[None, :]
+            & a.task_self_ok)                            # [T,P]
+    need = _f32(a.task_req_aff & ~boot)                  # [T,P]
+    pos_fail = need @ _f32(~present)                     # [T,N]
+    anti_fail = _f32(a.task_req_anti) @ _f32(present)
+    sym_fail = _f32(a.task_grp) @ _f32(has_dom & (acnt > 0))
+    ok = (pos_fail < 0.5) & (anti_fail < 0.5) & (sym_fail < 0.5)
+    if a.task_ports is not None:
+        used = a.port_base | state.port_claim            # [N,PT]
+        port_fail = _f32(a.task_ports) @ _f32(used).T    # [T,N]
+        ok = ok & (port_fail < 0.5)
+
+    # positive terms currently unsatisfiable ANYWHERE but whose group has
+    # other still-pending members: the task WAITS (stays SKIP) instead of
+    # failing its job — the sequential oracle may visit the member first
+    # (cross-job ordering the batch cannot replicate). A task whose group
+    # potential is only itself fails exactly like the oracle.
+    pending_members = (a.task_valid & (state.task_state == SKIP))[:, None] \
+        & a.task_grp                                     # [T,P]
+    grp_pending = pending_members.sum(axis=0)            # [P]
+    others_pending = (grp_pending[None, :]
+                      - _f32(pending_members)) > 0.5     # [T,P]
+    pair_unsat = ~jnp.any(present, axis=1)               # [P] nowhere
+    could_wait = jnp.any(a.task_req_aff & ~boot & others_pending
+                         & pair_unsat[None, :], axis=1)  # [T]
+    return ok, could_wait
+
+
+def _aff_serialize(state: RoundState, a: CycleArrays, accept, proposal,
+                   global_rank):
+    """In-round hazard removal: returns the accepted subset whose
+    co-placement is sequentially legal (see kernels/affinity.py docs).
+
+    Per (pair, domain): if a required-anti carrier is accepted, either it
+    placed first (keep it alone — later members would be rejected by its
+    anti/symmetry) or a member placed first (keep the members, reject
+    the carriers — their anti term already matches). Per boot-active
+    pair: the best-ranked bootstrapper fixes the group's domain; only
+    co-located bootstrappers join it this round. Members without an
+    accepted carrier in their domain are untouched — plain replicas
+    never serialize."""
+    d_cap = state.aff_grp_cnt.shape[1]
+    dom_prop = jnp.take(a.node_dom, proposal, axis=1)    # [P,T]
+    rank = global_rank.astype(jnp.int32)
+
+    def per_pair(dom_p, carrier_p, member_p, req_p, boot_active_p):
+        seg = jnp.where(dom_p >= 0, dom_p, d_cap)        # [T]
+        acc_car = accept & carrier_p & (dom_p >= 0)
+        # plain members only: a carrier that is also a member (the spread
+        # pattern) must count once, as a carrier, or the one-per-domain
+        # winner would block itself (cmin == mmin)
+        acc_mem = accept & member_p & ~carrier_p & (dom_p >= 0)
+        cmin = jax.ops.segment_min(
+            jnp.where(acc_car, rank, _IMAX), seg, num_segments=d_cap + 1)
+        mmin = jax.ops.segment_min(
+            jnp.where(acc_mem, rank, _IMAX), seg, num_segments=d_cap + 1)
+        cmin_t = cmin[seg]
+        mmin_t = mmin[seg]
+        has_car = cmin_t < _IMAX
+        # carrier keeps iff it is the domain's best AND no member beat it;
+        # member keeps unless a better-ranked carrier landed in the domain
+        keep_car = (rank == cmin_t) & (cmin_t < mmin_t)
+        keep_mem = ~has_car | (mmin_t < cmin_t)
+        keep = jnp.where(carrier_p, keep_car,
+                         jnp.where(member_p, keep_mem, True))
+        # bootstrap: group empty cluster-wide — the best-ranked accepted
+        # req-aff task fixes the domain; others join only co-located
+        acc_req = accept & req_p
+        bmin = jnp.min(jnp.where(acc_req, rank, _IMAX))
+        bdom = jnp.max(jnp.where(acc_req & (rank == bmin), seg, -1))
+        # co-location join requires a REAL domain: two bootstrappers on
+        # domain-less nodes are not co-located (the host oracle places at
+        # most one there — the second sees a cluster match it cannot
+        # reach on any node)
+        keep_boot = jnp.where(boot_active_p & req_p,
+                              (rank == bmin)
+                              | ((seg == bdom) & (bdom < d_cap)), True)
+        return keep & keep_boot
+
+    boot_active = state.aff_grp_total <= 0               # [P]
+    keep_pt = jax.vmap(per_pair, in_axes=(0, 1, 1, 1, 0))(
+        dom_prop, a.task_req_anti, a.task_grp, a.task_req_aff,
+        boot_active)                                     # [P,T]
+    keep = jnp.all(keep_pt, axis=0)
+
+    if a.task_ports is not None:
+        # one port-carrying accept per node per round (conflicts only
+        # among overlapping ports; per-node is the cheap sound bound)
+        any_port = jnp.any(a.task_ports, axis=1)
+        node_seg = jnp.where(accept & any_port, proposal,
+                             a.node_ok.shape[0])
+        pmin = jax.ops.segment_min(
+            jnp.where(accept & any_port, rank, _IMAX), node_seg,
+            num_segments=a.node_ok.shape[0] + 1)
+        keep = keep & (~any_port | (rank == pmin[node_seg]))
+    return accept & keep
+
+
+def _aff_involved(state: RoundState, a: CycleArrays):
+    """[T] tasks excluded from the same-round retry phase: their
+    acceptance could race a phase-1 winner in ways the between-round
+    counts would have forbidden. Anti carriers, members of pairs where a
+    carrier exists (pending or placed), bootstrap-reliant tasks, and
+    port claimers; plain members of carrier-free pairs retry freely."""
+    pair_has_carrier = (jnp.any(a.task_req_anti & a.task_valid[:, None],
+                                axis=0)
+                        | jnp.any(state.aff_anti_cnt > 0, axis=1))  # [P]
+    boot_active = state.aff_grp_total <= 0
+    inv = (jnp.any(a.task_req_anti, axis=1)
+           | jnp.any(a.task_grp & pair_has_carrier[None, :], axis=1)
+           | jnp.any(a.task_req_aff & boot_active[None, :], axis=1))
+    if a.task_ports is not None:
+        inv = inv | jnp.any(a.task_ports, axis=1)
+    return inv
+
+
+def _aff_delta(a: CycleArrays, mask, nodes, d_cap: int):
+    """Scatter this round's placements (or reversals) into per-(pair,
+    domain) deltas. ``mask`` selects tasks, ``nodes`` their node rows."""
+    dom = jnp.take(a.node_dom, nodes, axis=1)            # [P,T]
+    seg = jnp.where(mask[None, :] & (dom >= 0), dom, d_cap)
+
+    def scat(vals):                                      # [T,P] -> [P,D]
+        return jax.vmap(
+            lambda s, v: jax.ops.segment_sum(v, s,
+                                             num_segments=d_cap + 1)[:d_cap]
+        )(seg, vals.T)
+
+    mf = _f32(mask)
+    d_grp = scat(_f32(a.task_grp) * mf[:, None])
+    d_anti = scat(_f32(a.task_req_anti) * mf[:, None])
+    d_pref = scat(a.task_carry_w * mf[:, None])
+    d_total = (_f32(a.task_grp) * mf[:, None]).sum(axis=0)
+    return d_grp, d_anti, d_pref, d_total
+
+
+def _aff_commit(state: RoundState, a: CycleArrays, accept, proposal):
+    d_cap = state.aff_grp_cnt.shape[1]
+    d_grp, d_anti, d_pref, d_total = _aff_delta(a, accept, proposal, d_cap)
+    upd = dict(aff_grp_cnt=state.aff_grp_cnt + d_grp,
+               aff_anti_cnt=state.aff_anti_cnt + d_anti,
+               aff_pref_w=state.aff_pref_w + d_pref,
+               aff_grp_total=state.aff_grp_total + d_total)
+    if a.task_ports is not None:
+        n_pad = a.node_ok.shape[0]
+        claims = jnp.zeros((n_pad, a.task_ports.shape[1]), bool)
+        claims = claims.at[jnp.where(accept, proposal, n_pad - 1)].max(
+            a.task_ports & accept[:, None], mode="drop")
+        upd["port_claim"] = state.port_claim | claims
+    return upd
+
+
+def _aff_rollback(state: RoundState, a: CycleArrays, revert):
+    """Exact inverse of _aff_commit for the stranded-gang rollback (task
+    nodes come from the carried task_node). Port claims are exclusive
+    among this cycle's placements (the predicate forbids double claims),
+    so clearing the reverted tasks' bits is exact."""
+    d_cap = state.aff_grp_cnt.shape[1]
+    nodes = jnp.maximum(state.task_node, 0)
+    d_grp, d_anti, d_pref, d_total = _aff_delta(a, revert, nodes, d_cap)
+    upd = dict(aff_grp_cnt=state.aff_grp_cnt - d_grp,
+               aff_anti_cnt=state.aff_anti_cnt - d_anti,
+               aff_pref_w=state.aff_pref_w - d_pref,
+               aff_grp_total=state.aff_grp_total - d_total)
+    if a.task_ports is not None:
+        n_pad = a.node_ok.shape[0]
+        cleared = jnp.zeros((n_pad, a.task_ports.shape[1]), bool)
+        cleared = cleared.at[jnp.where(revert, nodes, n_pad - 1)].max(
+            a.task_ports & revert[:, None], mode="drop")
+        upd["port_claim"] = state.port_claim & ~cleared
+    return upd
+
+
+def _ip_score(state: RoundState, a: CycleArrays):
+    """The interpod-affinity node-order term against round-start counts
+    (ref: nodeorder.go:305-313 / plugins/nodeorder.interpod_affinity_counts):
+    own preferred terms weigh the group's domain counts; the symmetric
+    half weighs the carried-preferred ledger the committed placements
+    maintain. Normalized per task over the real nodes exactly like the
+    host (10 * (c - cmin) / (cmax - cmin), floored, times the pod_aff
+    weight). Tasks carrying a nonzero term leave the shared waterfall
+    (their score rows are task-specific)."""
+    has_dom, domc, gcnt, _ = _aff_gather(state, a)
+    prefw = jnp.take_along_axis(state.aff_pref_w, domc, axis=1)  # [P,N]
+    own = a.task_pref_w @ jnp.where(has_dom, gcnt, 0.0)          # [T,N]
+    sym = _f32(a.task_grp) @ jnp.where(has_dom, prefw, 0.0)
+    counts = own + sym
+    valid = a.node_ok[None, :]
+    cmin = jnp.min(jnp.where(valid, counts, jnp.inf), axis=1, keepdims=True)
+    cmax = jnp.max(jnp.where(valid, counts, -jnp.inf), axis=1, keepdims=True)
+    span = cmax - cmin
+    term = jnp.where(span > 0,
+                     jnp.floor(10.0 * (counts - cmin)
+                               / jnp.where(span > 0, span, 1.0)),
+                     0.0) * a.ip_weight
+    scored = jnp.any(term != 0.0, axis=1)                        # [T]
+    return jnp.where(valid, term, 0.0), scored
 
 
 #: demand-window fraction: jobs whose exclusive cumulative demand prefix
@@ -296,9 +551,17 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
         fit_pipe = jnp.zeros_like(fit_alloc)
     pred_t = a.sig_pred[a.task_sig]
     eligible = pred_t & base[None, :] & (fit_alloc | fit_pipe)
+    aff = a.node_dom is not None   # static: pytree structure
+    if aff:
+        aff_ok, could_wait = _aff_eligibility(state, a)
+        eligible = eligible & aff_ok
     any_elig = jnp.any(eligible, axis=1)
 
     fail_now = participating & ~any_elig
+    if aff:
+        # a positive-affinity task whose group a same-cycle placement can
+        # still populate waits (stays SKIP) instead of killing its job
+        fail_now = fail_now & ~could_wait
     # first failing rank per job kills the job's later-ranked tasks; only
     # the breaking task itself is marked FAIL (allocate.go:187-189 — the
     # rest simply stay Pending once the job leaves the queue)
@@ -308,7 +571,9 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     job_killed = fail_rank < _IMAX
     fail_first = fail_now & (global_rank == fail_rank[a.task_job])
     blocked = participating & (global_rank > fail_rank[a.task_job])
-    part2 = participating & ~fail_now & ~blocked
+    # any_elig keeps affinity-waiting tasks (no eligible node, not
+    # failed) out of the proposal/acceptance phases entirely
+    part2 = participating & ~fail_now & ~blocked & any_elig
 
     # ---- 3. proposals ---------------------------------------------------
     # Scores run per (sig, nonzero-request) PAIR cohort: the dynamic terms
@@ -373,6 +638,13 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
                                      axis=1)[:, 0] & slot_ok
 
     sc_rows = sc[a.task_pair]                             # [T,N]
+    if aff and a.ip_weight is not None:
+        # interpod-affinity score term (nodeorder.go:305-313) against
+        # round-start counts; scored tasks leave the shared waterfall —
+        # their rows are task-specific, not cohort-wide
+        ip_term, ip_scored = _ip_score(state, a)
+        sc_rows = sc_rows + ip_term
+        water_elig = water_elig & ~ip_scored
     fb = jnp.argmax(jnp.where(eligible, sc_rows, -jnp.inf), axis=1)
     proposal1 = jnp.where(water_elig, p_water, fb).astype(jnp.int32)
 
@@ -452,6 +724,11 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
 
     accept1, ob1, prop_alloc1 = accept_phase(
         proposal1, part2, state.idle, state.releasing, state.n_tasks)
+    if aff:
+        # remove in-round affinity/port races BEFORE capacity commits
+        # (rejected tasks simply retry next round against refreshed
+        # counts; freeing their capacity here is conservative-exact)
+        accept1 = _aff_serialize(state, a, accept1, proposal1, global_rank)
     idle1, rel1, ntasks1, nz1 = commit_node(
         accept1, prop_alloc1 & accept1, ~prop_alloc1 & accept1, proposal1,
         state.idle, state.releasing, state.n_tasks, state.nz_req)
@@ -465,6 +742,11 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     idle_c, rel_c, ntasks_c, nz_c = idle1, rel1, ntasks1, nz1
     for _ in range(1):
         retry = part2 & ~accept
+        if aff:
+            # affinity-involved tasks sit the retry out: their acceptance
+            # could race a phase-1 winner in ways only the next round's
+            # refreshed counts can adjudicate
+            retry = retry & ~_aff_involved(state, a)
         acc_c = idle_c + a.backfilled
         fit_r = jnp.all(a.init_resreq[:, None, :] <= acc_c[None] + eps,
                         axis=-1)
@@ -473,6 +755,8 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
                 a.init_resreq[:, None, :] <= rel_c[None] + eps, axis=-1)
         room_r = ntasks_c < a.max_task_num
         eligible_r = pred_t & (a.node_ok & room_r)[None, :] & fit_r
+        if aff:
+            eligible_r = eligible_r & aff_ok
         fb_r = jnp.argmax(jnp.where(eligible_r, sc_rows, -jnp.inf),
                           axis=1).astype(jnp.int32)
         retry = retry & jnp.any(eligible_r, axis=1)
@@ -519,12 +803,13 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     new_alive = state.job_alive & ~job_killed
     progress = jnp.any(changed)
 
+    aff_upd = _aff_commit(state, a, accept, proposal) if aff else {}
     new_state = RoundState(
         idle=new_idle, releasing=new_rel, n_tasks=new_ntasks, nz_req=new_nz,
         q_allocated=new_q_alloc, j_allocated=new_j_alloc,
         alloc_cnt=new_alloc_cnt, job_alive=new_alive,
         task_state=new_task_state, task_node=new_task_node,
-        task_seq=new_task_seq)
+        task_seq=new_task_seq, **aff_upd)
     return new_state, progress
 
 
@@ -607,11 +892,14 @@ def _rollback_stranded(state: RoundState, a: CycleArrays,
     else:
         alive = state.job_alive & ~stranded
         clear = revert
+    aff_upd = (_aff_rollback(state, a, revert)
+               if a.node_dom is not None else {})
     return state._replace(
         idle=idle, releasing=rel, n_tasks=ntasks, nz_req=nz,
         q_allocated=q_alloc, j_allocated=j_alloc, alloc_cnt=alloc_cnt,
         job_alive=alive,
-        task_state=jnp.where(clear, SKIP, state.task_state)), stranded
+        task_state=jnp.where(clear, SKIP, state.task_state),
+        **aff_upd), stranded
 
 
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys",
@@ -632,6 +920,10 @@ def batched_round(state: RoundState, a: CycleArrays, round_idx,
 #: task-axis fields of CycleArrays (compacted for the post-round-0 loop)
 _TASK_FIELDS = ("resreq", "init_resreq", "task_nz", "task_job", "task_rank",
                 "task_sig", "task_pair", "task_valid")
+#: affinity task-axis fields, compacted only when the cycle carries them
+_AFF_TASK_FIELDS = ("task_grp", "task_req_aff", "task_req_anti",
+                    "task_self_ok", "task_carry_w", "task_pref_w",
+                    "task_ports")
 
 
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys",
@@ -738,7 +1030,9 @@ def batched_allocate(state: RoundState, a: CycleArrays,
         return st, jnp.int32(1)
 
     def compact_path(st):
-        ca = a._replace(**{f: getattr(a, f)[idx_c] for f in _TASK_FIELDS})
+        fields = _TASK_FIELDS + tuple(
+            f for f in _AFF_TASK_FIELDS if getattr(a, f) is not None)
+        ca = a._replace(**{f: getattr(a, f)[idx_c] for f in fields})
         ca = ca._replace(task_valid=ca.task_valid & valid_k)
         cs = st._replace(task_state=st.task_state[idx_c],
                          task_node=st.task_node[idx_c],
@@ -781,6 +1075,15 @@ _PACK_I32 = ("task_job", "task_rank", "task_sig", "task_pair",
              "q_create_rank", "init_allocated", "pair_sig")
 _PACK_BOOL = ("task_valid", "job_valid", "sig_pred")
 
+#: affinity extensions (joined only when the cycle carries the features;
+#: the packed layouts are static jit args, so affinity-free cycles keep
+#: their pre-affinity compiled graphs)
+_AFF_F32 = ("task_carry_w", "task_pref_w", "aff_grp_cnt0", "aff_anti_cnt0",
+            "aff_pref_w0", "aff_grp_total0")
+_AFF_I32 = ("node_dom",)
+_AFF_BOOL = ("task_grp", "task_req_aff", "task_req_anti", "task_self_ok")
+_PORT_BOOL = ("task_ports", "port_base")
+
 
 @partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",
                                    "queue_keys", "prop_overused",
@@ -802,7 +1105,13 @@ def _batched_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
         alloc_cnt=i["init_allocated"], job_alive=b["job_valid"],
         task_state=jnp.full(t_pad, SKIP, jnp.int32),
         task_node=jnp.full(t_pad, -1, jnp.int32),
-        task_seq=jnp.full(t_pad, _IMAX, jnp.int32))
+        task_seq=jnp.full(t_pad, _IMAX, jnp.int32),
+        aff_grp_cnt=f.get("aff_grp_cnt0"),
+        aff_anti_cnt=f.get("aff_anti_cnt0"),
+        aff_pref_w=f.get("aff_pref_w0"),
+        aff_grp_total=f.get("aff_grp_total0"),
+        port_claim=(jnp.zeros_like(b["port_base"])
+                    if "port_base" in b else None))
     return _pack_result(*_run_batched(state, f, i, b, backfilled,
                                       allocatable_cm, max_task_num, node_ok,
                                       job_keys, queue_keys, prop_overused,
@@ -836,7 +1145,15 @@ def _run_batched(state, f, i, b, backfilled, allocatable_cm, max_task_num,
         job_queue=i["job_queue"], job_priority=f["job_priority"],
         job_create_rank=i["job_create_rank"], job_valid=b["job_valid"],
         q_deserved=f["q_deserved"], q_create_rank=i["q_create_rank"],
-        cluster_total=f["cluster_total"], dyn_weights=f["dyn_weights"])
+        cluster_total=f["cluster_total"], dyn_weights=f["dyn_weights"],
+        node_dom=i.get("node_dom"), task_grp=b.get("task_grp"),
+        task_req_aff=b.get("task_req_aff"),
+        task_req_anti=b.get("task_req_anti"),
+        task_self_ok=b.get("task_self_ok"),
+        task_carry_w=f.get("task_carry_w"),
+        task_pref_w=f.get("task_pref_w"),
+        task_ports=b.get("task_ports"), port_base=b.get("port_base"),
+        ip_weight=f.get("aff_ip_weight"))
     return batched_allocate(
         state, arrays, job_keys=job_keys, queue_keys=queue_keys,
         prop_overused=prop_overused, dyn_enabled=dyn_enabled,
@@ -860,9 +1177,29 @@ def solve_batched(device, inputs, max_rounds: int = 0,
     task_pair, pair_sig, pair_nz, _ = inputs.pair_terms()
     extra = {"task_pair": task_pair, "pair_sig": pair_sig,
              "pair_nz": pair_nz}
+    f32_names, i32_names, bool_names = _PACK_F32, _PACK_I32, _PACK_BOOL
+    aff = getattr(inputs, "affinity", None)
+    if aff is not None:
+        extra.update(
+            task_carry_w=aff.task_carry_w, task_pref_w=aff.task_pref_w,
+            aff_grp_cnt0=aff.grp_cnt0, aff_anti_cnt0=aff.anti_cnt0,
+            aff_pref_w0=aff.pref_w0, aff_grp_total0=aff.grp_total0,
+            node_dom=aff.node_dom, task_grp=aff.task_grp,
+            task_req_aff=aff.task_req_aff, task_req_anti=aff.task_req_anti,
+            task_self_ok=aff.task_self_ok)
+        f32_names = f32_names + _AFF_F32
+        i32_names = i32_names + _AFF_I32
+        bool_names = bool_names + _AFF_BOOL
+        if np.any(aff.task_ports):
+            extra.update(task_ports=aff.task_ports,
+                         port_base=aff.port_base)
+            bool_names = bool_names + _PORT_BOOL
+        if aff.ip_enabled:
+            extra["aff_ip_weight"] = np.float32(aff.ip_weight)
+            f32_names = f32_names + ("aff_ip_weight",)
     buf_f, lay_f, buf_i, lay_i, buf_b, lay_b = pack_inputs(
         lambda n: extra[n] if n in extra else getattr(inputs, n),
-        _PACK_F32, _PACK_I32, _PACK_BOOL)
+        f32_names, i32_names, bool_names)
 
     start = time.perf_counter()
     # compact continuation pays off once the [T,N] matrices dwarf the

@@ -20,9 +20,10 @@ Dynamic terms are NOT encoded here:
   kernels compute them from the capacity carry (kernels/solver.py,
   kernels/fused.py), mirroring nodeorder.go's per-call recompute.
 - inter-pod (anti-)affinity and host-port conflicts depend on in-cycle
-  assignments in ways the kernels don't model yet; `dynamic_features`
-  detects them and the allocate action falls back to the host path
-  (actions/allocate.py).
+  assignments; `dynamic_features` detects them. The BATCHED engine
+  carries them as domain-count tensors in its round state
+  (kernels/affinity.py); the per-visit/fused/victim solvers fall back
+  to the host path on them (actions/allocate.py, kernels/victims.py).
 """
 from __future__ import annotations
 

@@ -12,9 +12,11 @@ when every registered callback is expressible:
   node-affinity weights -> score matrix) and a dynamic part
   (least-requested + balanced-resource, computed in-kernel from the
   capacity carry; see DynamicScoreSpec);
-- anything else — a third-party plugin callback, inter-pod affinity, host
-  ports — returns None and the allocate action keeps the reference-literal
-  host path for the cycle.
+- inter-pod affinity and host ports are the BATCHED engine's own
+  vocabulary (kernels/affinity.py, via device_supported's
+  allow_affinity) — other engines fall back to the host path on them;
+- anything else (a third-party plugin callback) returns None and the
+  allocate action keeps the reference-literal host path for the cycle.
 """
 from __future__ import annotations
 
@@ -69,16 +71,23 @@ def _active(ssn, fns: dict, disable_attr: str):
     return names
 
 
-def device_supported(ssn, pending: Sequence[TaskInfo]) -> bool:
+def device_supported(ssn, pending: Sequence[TaskInfo],
+                     allow_affinity: bool = False) -> bool:
     """Cheap pre-check (no tensorization, no device work): can this cycle's
     registered callbacks run on device at all? Lets the action skip
     DeviceSession construction — a full-cluster upload — on snapshots that
-    will take the host path anyway."""
+    will take the host path anyway.
+
+    ``allow_affinity``: the batched engine carries inter-pod affinity and
+    host ports in its round state (kernels/affinity.py) — its builder
+    passes True and the dynamic-feature check is skipped (the affinity
+    encoder still falls back past its own vocabulary caps). The per-visit
+    and victim solvers keep the strict default."""
     from ..cache.interface import NullVolumeBinder
 
     # a real volume binder makes placement feasibility depend on per-node
-    # volume state the kernels don't model (same category as inter-pod
-    # affinity); the host path handles its try-next-node semantics
+    # volume state the kernels don't model; the host path handles its
+    # try-next-node semantics
     if type(getattr(ssn.cache, "volume_binder", None)) \
             is not NullVolumeBinder:
         return False
@@ -88,7 +97,7 @@ def device_supported(ssn, pending: Sequence[TaskInfo]) -> bool:
         return False
     if any(p not in _DEVICE_NODE_ORDER_PLUGINS for p in order_plugins):
         return False
-    if (pred_plugins or order_plugins) \
+    if not allow_affinity and (pred_plugins or order_plugins) \
             and dynamic_features(ssn, pending) is not None:
         return False
     return True

@@ -1,0 +1,407 @@
+"""Inter-pod affinity + host ports THROUGH the batched device engine.
+
+VERDICT r4 directive 1: any pending pod with pod (anti-)affinity or host
+ports used to drop the whole cycle onto the host per-pair loops; the
+batched engine now carries those features in its round state
+(kernels/affinity.py). Every test here asserts the ENGINE RAN
+(execute_batched returns the engine name — False means host fallback)
+checks the reference predicate semantics on the outcome
+(ref: pkg/scheduler/plugins/predicates/predicates.go:47-104,146,188;
+nodeorder.go:305-313).
+"""
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401 — registries
+from kubebatch_tpu.actions.allocate_batched import execute_batched
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import parse_scheduler_conf
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import (Affinity, PodAffinityTerm, PodPhase)
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def make_cache():
+    binds = {}
+
+    class Seam:
+        def bind(self, pod, hostname):
+            binds[f"{pod.namespace}/{pod.name}"] = hostname
+            pod.node_name = hostname
+
+        def evict(self, pod):
+            pod.deletion_timestamp = 1.0
+
+    cache = SchedulerCache(binder=Seam(), evictor=Seam(),
+                           async_writeback=False)
+    cache.add_queue(build_queue("default"))
+    return cache, binds
+
+
+def tiers():
+    return parse_scheduler_conf(CONF).tiers
+
+
+def run_batched(cache):
+    """One allocate cycle through the batched engine; asserts the engine
+    actually consumed the cycle (no host fallback)."""
+    ssn = OpenSession(cache, tiers())
+    ran = execute_batched(ssn)
+    CloseSession(ssn)
+    assert ran, "snapshot fell back to the host path"
+    return ssn
+
+
+def run_host(cache):
+    from kubebatch_tpu.actions.allocate import AllocateAction
+
+    ssn = OpenSession(cache, tiers())
+    AllocateAction(mode="host").execute(ssn)
+    CloseSession(ssn)
+    return ssn
+
+
+def settle(cache, binds, rounds=3, engine=run_batched):
+    """Bind -> Running ticks until no new binds (multi-cycle settling for
+    count-dependent placements)."""
+    total = -1
+    while rounds and len(binds) != total:
+        total = len(binds)
+        engine(cache)
+        for job in list(cache.jobs.values()):
+            for t in list(job.tasks.values()):
+                if t.node_name and t.pod.phase == PodPhase.PENDING:
+                    t.pod.phase = PodPhase.RUNNING
+                    cache.update_pod(t.pod, t.pod)
+        rounds -= 1
+    return binds
+
+
+def anti_self(label_kv, topo="kubernetes.io/hostname"):
+    k, v = label_kv
+    return Affinity(pod_anti_affinity_required=[
+        PodAffinityTerm(match_labels={k: v}, topology_key=topo)])
+
+
+def aff_to(label_kv, topo="kubernetes.io/hostname"):
+    k, v = label_kv
+    return Affinity(pod_affinity_required=[
+        PodAffinityTerm(match_labels={k: v}, topology_key=topo)])
+
+
+def hostname_nodes(cache, n, cpu=8000, zone_of=None):
+    for i in range(n):
+        labels = {"kubernetes.io/hostname": f"n{i}"}
+        if zone_of:
+            labels["zone"] = zone_of(i)
+        cache.add_node(build_node(f"n{i}", rl(cpu, 16 * GiB, pods=110),
+                                  labels=labels))
+
+
+# ---------------------------------------------------------------------
+# predicate semantics through the engine
+# ---------------------------------------------------------------------
+
+def test_anti_affinity_spreads_through_batched_engine():
+    cache, binds = make_cache()
+    hostname_nodes(cache, 6)
+    cache.add_pod_group(build_group("e2e", "web", 4))
+    for p in range(4):
+        pod = build_pod("e2e", f"web-{p}", "", "Pending", rl(500, GiB),
+                        group="web", labels={"app": "web"})
+        pod.affinity = anti_self(("app", "web"))
+        cache.add_pod(pod)
+    run_batched(cache)
+    assert len(binds) == 4
+    assert len(set(binds.values())) == 4, \
+        f"anti-affinity must spread: {binds}"
+
+
+def test_anti_affinity_excess_replica_stays_pending():
+    """More anti-affine replicas than nodes: exactly node-count bind
+    (min_member kept reachable), the rest stay Pending — same outcome as
+    the host oracle."""
+    cache, binds = make_cache()
+    hostname_nodes(cache, 3)
+    cache.add_pod_group(build_group("e2e", "web", 2))
+    for p in range(5):
+        pod = build_pod("e2e", f"web-{p}", "", "Pending", rl(100, GiB // 4),
+                        group="web", labels={"app": "web"})
+        pod.affinity = anti_self(("app", "web"))
+        cache.add_pod(pod)
+    run_batched(cache)
+    assert len(binds) == 3, binds
+    assert len(set(binds.values())) == 3
+
+
+def test_positive_affinity_colocates_with_existing():
+    cache, binds = make_cache()
+    hostname_nodes(cache, 4)
+    cache.add_pod_group(build_group("e2e", "db", 1))
+    cache.add_pod(build_pod("e2e", "db-0", "n2", "Running", rl(500, GiB),
+                            group="db", labels={"app": "db"}))
+    cache.add_pod_group(build_group("e2e", "web", 2))
+    for p in range(2):
+        pod = build_pod("e2e", f"web-{p}", "", "Pending", rl(500, GiB),
+                        group="web")
+        pod.affinity = aff_to(("app", "db"))
+        cache.add_pod(pod)
+    run_batched(cache)
+    assert binds == {"e2e/web-0": "n2", "e2e/web-1": "n2"}, binds
+
+
+def test_bootstrap_gang_lands_in_one_zone():
+    """First-pod bootstrap + co-location: a self-affine gang on the zone
+    topology must land entirely inside ONE zone (upstream anySchedulable:
+    the first pod starts the group, the rest must join its domain)."""
+    cache, binds = make_cache()
+    hostname_nodes(cache, 6, cpu=2000,
+                   zone_of=lambda i: "east" if i < 3 else "west")
+    cache.add_pod_group(build_group("e2e", "ring", 4))
+    for p in range(4):
+        pod = build_pod("e2e", f"ring-{p}", "", "Pending", rl(900, GiB),
+                        group="ring", labels={"app": "ring"})
+        pod.affinity = aff_to(("app", "ring"), topo="zone")
+        cache.add_pod(pod)
+    run_batched(cache)
+    assert len(binds) == 4, binds
+    zones = {"east" if int(h[1:]) < 3 else "west" for h in binds.values()}
+    assert len(zones) == 1, f"gang must stay in one zone: {binds}"
+
+
+def test_symmetry_existing_anti_rejects_incoming():
+    """predicates.go:47-104 symmetry: an EXISTING pod carrying required
+    anti-affinity against app=web keeps web pods off its node even though
+    the web pods themselves carry no affinity."""
+    cache, binds = make_cache()
+    hostname_nodes(cache, 2)
+    cache.add_pod_group(build_group("e2e", "lonely", 1))
+    lonely = build_pod("e2e", "lonely-0", "n0", "Running", rl(100, GiB),
+                       group="lonely", labels={"app": "lonely"})
+    lonely.affinity = anti_self(("app", "web"))
+    cache.add_pod(lonely)
+    cache.add_pod_group(build_group("e2e", "web", 2))
+    for p in range(2):
+        cache.add_pod(build_pod("e2e", f"web-{p}", "", "Pending",
+                                rl(500, GiB), group="web",
+                                labels={"app": "web"}))
+    run_batched(cache)
+    assert len(binds) == 2
+    assert set(binds.values()) == {"n1"}, \
+        f"symmetry must keep web off n0: {binds}"
+
+
+def test_host_ports_conflict_through_batched_engine():
+    cache, binds = make_cache()
+    hostname_nodes(cache, 2)
+    for p in range(3):
+        cache.add_pod_group(build_group("e2e", f"hp{p}", 1))
+        cache.add_pod(build_pod("e2e", f"hp{p}-0", "", "Pending",
+                                rl(500, GiB), group=f"hp{p}",
+                                ports=[8080]))
+    run_batched(cache)
+    assert len(binds) == 2, binds
+    assert len(set(binds.values())) == 2, "port claimants must spread"
+
+
+def test_host_ports_respect_existing_pod():
+    cache, binds = make_cache()
+    hostname_nodes(cache, 2)
+    cache.add_pod_group(build_group("e2e", "old", 1))
+    cache.add_pod(build_pod("e2e", "old-0", "n0", "Running", rl(100, GiB),
+                            group="old", ports=[443]))
+    cache.add_pod_group(build_group("e2e", "new", 1))
+    cache.add_pod(build_pod("e2e", "new-0", "", "Pending", rl(100, GiB),
+                            group="new", ports=[443]))
+    run_batched(cache)
+    assert binds == {"e2e/new-0": "n1"}, binds
+
+
+def test_cross_job_affinity_waits_for_same_cycle_placement():
+    """A pod whose required affinity targets another PENDING job's label
+    must not kill its job: it waits for the target's placement (possibly
+    within the same cycle's rounds) and then co-locates."""
+    cache, binds = make_cache()
+    hostname_nodes(cache, 4)
+    cache.add_pod_group(build_group("e2e", "a", 1))
+    follower = build_pod("e2e", "a-0", "", "Pending", rl(300, GiB),
+                         group="a")
+    follower.affinity = aff_to(("app", "b"))
+    cache.add_pod(follower)
+    cache.add_pod_group(build_group("e2e", "b", 1))
+    cache.add_pod(build_pod("e2e", "b-0", "", "Pending", rl(300, GiB),
+                            group="b", labels={"app": "b"}))
+    settle(cache, binds)
+    assert len(binds) == 2, binds
+    assert binds["e2e/a-0"] == binds["e2e/b-0"], binds
+
+
+def test_preferred_affinity_steers_score():
+    """nodeorder.go:305-313 interpod score: PREFERRED co-location is not
+    a constraint, but with equal fit everywhere the weighted score must
+    steer the pod onto the target's node."""
+    cache, binds = make_cache()
+    hostname_nodes(cache, 4)
+    cache.add_pod_group(build_group("e2e", "db", 1))
+    cache.add_pod(build_pod("e2e", "db-0", "n3", "Running", rl(100, GiB),
+                            group="db", labels={"app": "db"}))
+    cache.add_pod_group(build_group("e2e", "web", 1))
+    pod = build_pod("e2e", "web-0", "", "Pending", rl(100, GiB),
+                    group="web")
+    pod.affinity = Affinity(pod_affinity_preferred=[
+        (100, PodAffinityTerm(match_labels={"app": "db"}))])
+    cache.add_pod(pod)
+    run_batched(cache)
+    assert binds.get("e2e/web-0") == "n3", binds
+
+
+def test_gang_all_or_nothing_with_anti_affinity():
+    """Gang semantics survive the affinity path: a 4-gang of anti-affine
+    replicas over 3 nodes cannot reach quorum — nothing may dispatch."""
+    cache, binds = make_cache()
+    hostname_nodes(cache, 3)
+    cache.add_pod_group(build_group("e2e", "web", 4))
+    for p in range(4):
+        pod = build_pod("e2e", f"web-{p}", "", "Pending", rl(100, GiB),
+                        group="web", labels={"app": "web"})
+        pod.affinity = anti_self(("app", "web"))
+        cache.add_pod(pod)
+    run_batched(cache)
+    assert binds == {}, f"4-gang on 3 anti-affine slots must not bind: {binds}"
+
+
+def test_over_vocabulary_falls_back_to_host():
+    """More selector/topology pairs than MAX_PAIRS: the builder refuses
+    and the action takes the reference-literal host path (returns False,
+    no state consumed)."""
+    from kubebatch_tpu.kernels.affinity import MAX_PAIRS
+
+    cache, binds = make_cache()
+    hostname_nodes(cache, 2)
+    cache.add_pod_group(build_group("e2e", "many", 1))
+    pod = build_pod("e2e", "many-0", "", "Pending", rl(100, GiB),
+                    group="many")
+    pod.affinity = Affinity(pod_anti_affinity_required=[
+        PodAffinityTerm(match_labels={f"k{i}": "v"})
+        for i in range(MAX_PAIRS + 1)])
+    cache.add_pod(pod)
+    ssn = OpenSession(cache, tiers())
+    assert execute_batched(ssn) is False
+    CloseSession(ssn)
+
+
+# ---------------------------------------------------------------------
+# randomized final-state validity + host-oracle comparison
+# ---------------------------------------------------------------------
+
+def _validate_final_state(cache, binds):
+    """Every binding must satisfy the reference predicate semantics in
+    the final state: required affinity has a co-domain companion (or the
+    pod legitimately started its group), anti terms see no companion,
+    ports are exclusive per node."""
+    node_labels = {n.name: dict(n.node.labels)
+                   for n in cache.nodes.values() if n.node}
+    placed = []   # (pod, node_name)
+    for job in cache.jobs.values():
+        for t in job.tasks.values():
+            if t.node_name:
+                placed.append((t.pod, t.node_name))
+
+    def domain(node, topo):
+        return node_labels.get(node, {}).get(topo)
+
+    def matches(term, anchor, other):
+        if term.namespaces:
+            if other.namespace not in term.namespaces:
+                return False
+        elif other.namespace != anchor.namespace:
+            return False
+        return term.selects(other)
+
+    for pod, node in placed:
+        aff = pod.affinity
+        if aff is None:
+            continue
+        for term in aff.pod_affinity_required:
+            dom = domain(node, term.topology_key)
+            companions = [o for o, on in placed
+                          if o is not pod and matches(term, pod, o)
+                          and domain(on, term.topology_key) == dom
+                          and dom is not None]
+            cluster_members = [o for o, _ in placed
+                               if o is not pod and matches(term, pod, o)]
+            started_group = not cluster_members and term.selects(pod)
+            assert companions or started_group, \
+                f"{pod.name} on {node}: required affinity unsatisfied"
+        for term in aff.pod_anti_affinity_required:
+            dom = domain(node, term.topology_key)
+            if dom is None:
+                continue
+            for o, on in placed:
+                if o is not pod and matches(term, pod, o) \
+                        and domain(on, term.topology_key) == dom:
+                    raise AssertionError(
+                        f"{pod.name} on {node}: anti-affinity violated "
+                        f"by {o.name} on {on}")
+    per_node_ports = {}
+    for pod, node in placed:
+        for port in pod.host_ports():
+            key = (node, port)
+            assert key not in per_node_ports, \
+                f"port {port} double-claimed on {node}"
+            per_node_ports[key] = pod.name
+
+
+def _random_cluster(cache, seed, n_nodes=8, n_jobs=10):
+    rng = np.random.RandomState(seed)
+    hostname_nodes(cache, n_nodes, cpu=16000,
+                   zone_of=lambda i: f"z{i % 3}")
+    apps = ["red", "blue", "green"]
+    for j in range(n_jobs):
+        app = apps[int(rng.randint(len(apps)))]
+        size = int(rng.randint(1, 4))
+        cache.add_pod_group(build_group("e2e", f"j{j}", size))
+        for p in range(size):
+            pod = build_pod("e2e", f"j{j}-{p}", "", "Pending",
+                            rl(400, GiB // 2), group=f"j{j}",
+                            labels={"app": app})
+            roll = rng.rand()
+            if roll < 0.25:
+                pod.affinity = anti_self(("app", app))
+            elif roll < 0.45:
+                target = apps[int(rng.randint(len(apps)))]
+                pod.affinity = aff_to(("app", target), topo="zone")
+            elif roll < 0.55:
+                pod.containers[0].ports = [int(rng.choice([80, 443, 8080]))]
+            cache.add_pod(pod)
+
+
+@pytest.mark.parametrize("seed", [3, 11, 42])
+def test_randomized_affinity_final_state_valid(seed):
+    cache, binds = make_cache()
+    _random_cluster(cache, seed)
+    settle(cache, binds, rounds=4)
+    _validate_final_state(cache, binds)
+
+    host_cache, host_binds = make_cache()
+    _random_cluster(host_cache, seed)
+    settle(host_cache, host_binds, rounds=4, engine=run_host)
+    _validate_final_state(host_cache, host_binds)
+    # low contention: both engines must schedule the same pods (placement
+    # may differ — the batched engine is order-approximate)
+    assert set(binds) == set(host_binds), (
+        sorted(set(binds) ^ set(host_binds)))

@@ -54,8 +54,8 @@ def _run(mode: str, seed: int, budget=None, base_spec=None):
 
         orig_build = allocate_batched.build_cycle_inputs
 
-        def build_with_budget(s):
-            inputs = orig_build(s)
+        def build_with_budget(s, **kw):
+            inputs = orig_build(s, **kw)
             if isinstance(inputs, CycleInputs):
                 bound = CycleInputs.pair_terms.__get__(inputs)
                 inputs.pair_terms = lambda max_pairs=2048: bound(budget)
@@ -185,6 +185,55 @@ def test_batched_quantized_pairs_keep_envelope():
         assert abs(got - want) / max(want, 1.0) <= 0.15, (q, got, want)
     assert abs(quant["idle_std"] - host["idle_std"]) \
         <= 0.20 * SPEC.node_cpu_millis
+
+
+#: predicate-rich drift spec (VERDICT r4 directives 1+3): the fast spec
+#: plus zones, selectors, taints/tolerations, 15% (anti-)affinity
+#: groups, preferred co-location scores, and host ports — the envelope
+#: must hold WITH the affinity device vocabulary engaged, not only on
+#: resource-fit-only clusters.
+RICH_SPEC = ClusterSpec(n_nodes=200, n_groups=220, pods_per_group=4,
+                        min_member=4, n_queues=4, queue_weights=(1, 2, 3, 4),
+                        node_cpu_millis=8000, node_mem_bytes=16 * GiB,
+                        pod_cpu_millis=1800, pod_mem_bytes=3 * GiB,
+                        jitter=0.2, seed=0,
+                        n_zones=4, selector_frac=0.1, taint_frac=0.08,
+                        toleration_frac=0.12, anti_affinity_frac=0.10,
+                        zone_affinity_frac=0.05, pref_affinity_frac=0.05,
+                        hostport_frac=0.04)
+
+
+def test_batched_policy_envelope_predicate_rich():
+    """Affinity/ports cycles run THROUGH the batched engine (no host
+    fallback) and stay inside the drift envelope. Slightly wider sym
+    bound than the plain spec: affinity waits/serialization shift which
+    marginal gangs win under 2x oversubscription."""
+    from kubebatch_tpu.actions import allocate_batched
+
+    ran = []
+    orig = allocate_batched.execute_batched
+
+    def spy(ssn, sharded=False):
+        out = orig(ssn, sharded=sharded)
+        ran.append(out)
+        return out
+
+    allocate_batched.execute_batched = spy
+    try:
+        host = _run("host", 0, base_spec=RICH_SPEC)
+        batched = _run("batched", 0, base_spec=RICH_SPEC)
+    finally:
+        allocate_batched.execute_batched = orig
+    assert ran == ["batched"], "predicate-rich cycle fell back off the engine"
+    # measured at r5 introduction: binds 0.96, sym 14% (28/200 — half of
+    # the swapped gangs are plain; affinity serialization defers some
+    # anti/port gangs past the single allocate pass, shifting which
+    # marginal gangs win at 2x oversubscription), queue_rel and drf well
+    # inside the plain-spec bounds
+    # idle-spread delta 5.1% of node capacity — dominated by the 32
+    # fewer bound pods, not placement quality of the bound ones
+    _assert_envelope(host, batched, RICH_SPEC, binds_min=0.95,
+                     sym_max=0.16, queue_rel=0.15, idle_frac=0.08)
 
 
 # NB: the per-queue pacing threshold (batched.py q_prefix <= 1.0) was

@@ -40,7 +40,7 @@ def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
     monkeypatch.setattr(bench, "ensure_responsive_backend",
                         lambda *a, **k: "cpu-fallback")
     monkeypatch.setattr(bench, "run_config",
-                        lambda *a: ([0.1, 0.1], 200, 0.2, 0, {}))
+                        lambda *a: ([0.1, 0.1], 200, 0.2, 0, {}, ["batched"]))
     steady_ran = {}
 
     def fake_steady(*a):
