@@ -134,6 +134,8 @@ def run_config(config: int, cycles: int, mode: str):
     import gc
 
     from kubebatch_tpu.actions import allocate as _alloc_mod
+    from kubebatch_tpu.metrics import (blocking_readbacks,
+                                       solver_kernel_seconds)
 
     latencies = []
     bound_total = 0
@@ -142,6 +144,8 @@ def run_config(config: int, cycles: int, mode: str):
     action_seconds = {name: 0.0 for name in CONFIG_ACTIONS[config]}
     measured_cycles = 0
     engines = set()
+    readbacks = []
+    kernel_s = []
     # GC discipline mirrors runtime/scheduler.py: automatic collection off
     # during the timed cycle (a gen2 pass scans the whole 100k+ object
     # cluster graph mid-cycle otherwise), explicit collection between
@@ -168,6 +172,8 @@ def run_config(config: int, cycles: int, mode: str):
             sim.populate(cache)
             acts = build_actions(config, mode)
             gc.collect()
+            rb0 = blocking_readbacks()
+            ks0 = solver_kernel_seconds()
             t0 = time.perf_counter()
             ssn = OpenSession(cache, tiers)
             t1 = time.perf_counter()
@@ -192,20 +198,28 @@ def run_config(config: int, cycles: int, mode: str):
                     action_seconds[name] += s
                 measured_cycles += 1
                 engines.add(_alloc_mod.last_cycle_engine)
+                readbacks.append(blocking_readbacks() - rb0)
+                kernel_s.append(solver_kernel_seconds() - ks0)
     finally:
         gc.enable()
     action_ms = {name: round(1e3 * s / max(1, measured_cycles), 3)
                  for name, s in action_seconds.items()}
     return (latencies, bound_total, bind_seconds, evicted_total, action_ms,
-            sorted(engines))
+            sorted(engines), readbacks, kernel_s)
 
 
-def run_steady(config: int, cycles: int, mode: str, churn_pods: int):
+def run_steady(config, cycles: int, mode: str, churn_pods: int,
+               skew: bool = False):
     """Steady-state regime: ONE persistent cache, fully scheduled in a
     warmup cycle, then a churn trickle per measured cycle (whole gangs
     finish, equal fresh gangs arrive). This is where the incremental
     snapshot/device-state reuse pays: the measured cycle re-clones and
-    re-packs only the churned entities."""
+    re-packs only the churned entities.
+
+    ``skew``: every tick's fresh gangs land on ONE queue, alternating
+    between the two extreme-weight queues — sustained cross-queue
+    imbalance, so the reclaim gates correctly stay open and the victim
+    wave path is measured hot (VERDICT r4 directive 4)."""
     import gc
 
     from kubebatch_tpu import actions, plugins  # noqa: F401
@@ -245,6 +259,18 @@ def run_steady(config: int, cycles: int, mode: str, churn_pods: int):
                 cache.update_pod(pod, pod)
         fresh_binds.clear()
 
+    tick_no = [0]
+
+    def churn():
+        """Per-cycle arrivals; under --steady-skew they alternate between
+        the two extreme-weight queues so cross-queue imbalance persists."""
+        arrival = None
+        if skew:
+            nq = max(1, len(sim.queues))
+            arrival = 0 if tick_no[0] % 2 == 0 else nq - 1
+            tick_no[0] += 1
+        sim.churn_tick(cache, churn_pods, arrival_queue=arrival)
+
     gc.disable()
     try:
         # warmup: schedule the whole cluster (plus one cheap settle cycle
@@ -261,19 +287,23 @@ def run_steady(config: int, cycles: int, mode: str, churn_pods: int):
         # the measured cycles describe scheduling, not jit compiles
         for _ in range(2):
             kubelet_tick()
-            sim.churn_tick(cache, churn_pods)
+            churn()
             ssn = OpenSession(cache, tiers)
             for _, act in acts:
                 act.execute(ssn)
             CloseSession(ssn)
+        from kubebatch_tpu.metrics import blocking_readbacks
+
         latencies = []
         bound = 0
         action_seconds = {name: 0.0 for name in CONFIG_ACTIONS[config]}
+        readbacks = []
         for cycle in range(cycles):
             before = len(binds)
             kubelet_tick()
-            sim.churn_tick(cache, churn_pods)
+            churn()
             gc.collect()
+            rb0 = blocking_readbacks()
             t0 = time.perf_counter()
             ssn = OpenSession(cache, tiers)
             t1 = time.perf_counter()
@@ -294,11 +324,12 @@ def run_steady(config: int, cycles: int, mode: str, churn_pods: int):
             bound += len(binds) - before
             for name, secs in act_times:
                 action_seconds[name] += secs
+            readbacks.append(blocking_readbacks() - rb0)
     finally:
         gc.enable()
     action_ms = {name: round(1e3 * secs / max(1, len(latencies)), 3)
                  for name, secs in action_seconds.items()}
-    return latencies, bound, action_ms
+    return latencies, bound, action_ms, readbacks
 
 
 def main(argv=None):
@@ -327,6 +358,12 @@ def main(argv=None):
                          "fully, then churn CHURN_PODS pods per measured "
                          "cycle (whole gangs finish + arrive). Reports "
                          "metric sched_cycle_p50_ms_cfgN_steady.")
+    ap.add_argument("--steady-skew", action="store_true",
+                    help="with --steady: pin each tick's fresh gangs to "
+                         "ONE queue, alternating between the extreme-"
+                         "weight queues — sustained cross-queue imbalance "
+                         "keeps the reclaim victim path hot (gates "
+                         "correctly open). Metric suffix _skew.")
     ap.add_argument("--no-steady-extra", action="store_true",
                     help="skip the steady-state extra measurement the "
                          "default cfg5 run appends to its JSON line")
@@ -355,12 +392,14 @@ def main(argv=None):
         args.cycles = min(args.cycles, 6)
 
     if args.steady > 0:
-        latencies, bound, action_ms = run_steady(args.config, args.cycles,
-                                                 args.mode, args.steady)
+        latencies, bound, action_ms, readbacks = run_steady(
+            args.config, args.cycles, args.mode, args.steady,
+            skew=args.steady_skew)
         p50_ms = float(np.percentile(latencies, 50) * 1e3)
         seconds = sum(latencies)
+        suffix = "_steady_skew" if args.steady_skew else "_steady"
         out = {
-            "metric": f"sched_cycle_p50_ms_cfg{args.config}_steady",
+            "metric": f"sched_cycle_p50_ms_cfg{args.config}{suffix}",
             "value": round(p50_ms, 3),
             "unit": "ms",
             "vs_baseline": round(15.0 / p50_ms, 4) if p50_ms else 0.0,
@@ -371,13 +410,15 @@ def main(argv=None):
             "measured_cycles": len(latencies),
             "action_ms": action_ms,
             "mode": args.mode,
+            "readbacks_per_cycle": round(float(np.mean(readbacks)), 1)
+            if readbacks else 0.0,
             "backend": backend,
         }
         emit(out)
         return 0
 
-    latencies, bound, seconds, evicted, action_ms, engines = run_config(
-        args.config, args.cycles, args.mode)
+    (latencies, bound, seconds, evicted, action_ms, engines,
+     readbacks, kernel_s) = run_config(args.config, args.cycles, args.mode)
     p50_ms = float(np.percentile(latencies, 50) * 1e3)
     p95_ms = float(np.percentile(latencies, 95) * 1e3)
     pods_per_sec = bound / seconds if seconds > 0 else 0.0
@@ -394,6 +435,16 @@ def main(argv=None):
         "action_ms": action_ms,
         "mode": args.mode,
         "engines": engines,
+        # blocking device->host transfers per measured cycle — the
+        # environment-sensitive cost driver (each one pays the tunnel
+        # RTT); budget pinned by tests/test_readbacks.py
+        "readbacks_per_cycle": round(float(np.mean(readbacks)), 1)
+        if readbacks else 0.0,
+        "readbacks_max": max(readbacks) if readbacks else 0,
+        # solver dispatch wall (incl. the blocking-read RTTs): the cold
+        # split is kernel ~= this - readbacks x link RTT
+        "solver_dispatch_ms_per_cycle": round(
+            1e3 * float(np.mean(kernel_s)), 1) if kernel_s else 0.0,
         "backend": backend,
     }
     if evicted:
@@ -418,8 +469,8 @@ def main(argv=None):
             emit(out, flush=True, partial=True)
         try:
             churn = 256
-            s_lat, s_bound, s_act = run_steady(args.config, 5, args.mode,
-                                               churn)
+            s_lat, s_bound, s_act, s_rb = run_steady(args.config, 5,
+                                                     args.mode, churn)
             out["steady_p50_ms"] = round(
                 float(np.percentile(s_lat, 50) * 1e3), 3)
             out["steady_p95_ms"] = round(
@@ -427,6 +478,8 @@ def main(argv=None):
             out["steady_churn_pods"] = churn
             out["steady_measured_cycles"] = len(s_lat)
             out["steady_action_ms"] = s_act
+            out["steady_readbacks_per_cycle"] = round(
+                float(np.mean(s_rb)), 1) if s_rb else 0.0
         except Exception as e:   # pragma: no cover — diagnostics only
             out["steady_error"] = f"{type(e).__name__}: {e}"
     emit(out)

@@ -17,7 +17,8 @@ import numpy as np
 from ..framework import Session
 from ..kernels.fused import fused_allocate, unpack_host_block
 from ..kernels.pack import pack_inputs, unpack
-from ..metrics import solver_trace, update_solver_kernel_duration
+from ..metrics import (count_blocking_readback, solver_trace,
+                       update_solver_kernel_duration)
 from .cycle_inputs import (EMPTY_CYCLE, build_cycle_inputs, cycle_supported,
                            replay_decisions)
 
@@ -93,6 +94,7 @@ def execute_fused(ssn: Session) -> bool:
             gang_enabled=inputs.gang_enabled,
             prop_overused=inputs.prop_overused,
             dyn_enabled=inputs.dyn_enabled, max_iters=max_iters)
+        count_blocking_readback()
         host_block = np.asarray(host_block)   # the cycle's ONE blocking read
     task_state, task_node, task_seq, _ = unpack_host_block(host_block)
     device.idle, device.releasing, device.n_tasks = idle_f, rel_f, ntasks_f

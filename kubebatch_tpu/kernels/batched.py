@@ -68,7 +68,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..metrics import solver_trace, update_solver_kernel_duration
+from ..metrics import (count_blocking_readback, solver_trace,
+                       update_solver_kernel_duration)
 from .fused import (ALLOC, ALLOC_OB, FAIL, K_DRF_SHARE, K_GANG_READY,
                     K_PRIORITY, K_PROP_SHARE, PIPELINE, SKIP, _share)
 from .pack import pack_inputs
@@ -1225,6 +1226,7 @@ def solve_batched(device, inputs, max_rounds: int = 0,
         # ONE blocking transfer for everything the host needs; it stays
         # inside the trace so a one-shot capture includes the device
         # execution, not just the async dispatch
+        count_blocking_readback()
         out = np.asarray(packed)
         task_state = out[:t_pad]
         task_node = out[t_pad:2 * t_pad]

@@ -133,7 +133,8 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
     """
     import time
 
-    from ..metrics import solver_trace, update_solver_kernel_duration
+    from ..metrics import (count_blocking_readback, solver_trace,
+                           update_solver_kernel_duration)
 
     n_dev = mesh.devices.size
     n_pad = device.n_padded
@@ -188,6 +189,7 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
             dyn_enabled=inputs.dyn_enabled,
             pipe_enabled=inputs.pipe_enabled,
             max_rounds=min(max_rounds, 4096))
+        count_blocking_readback()
         out = np.asarray(packed)
     task_state = out[:t_pad]
     task_node = out[t_pad:2 * t_pad]
@@ -196,6 +198,7 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
 
     # commit the carry back to the session's device state (trimmed to the
     # single-chip bucket) so later actions see the updated accounting
+    count_blocking_readback(4)
     device.idle = jnp.asarray(np.asarray(final.idle)[:n_pad])
     device.releasing = jnp.asarray(np.asarray(final.releasing)[:n_pad])
     device.n_tasks = jnp.asarray(np.asarray(final.n_tasks)[:n_pad])

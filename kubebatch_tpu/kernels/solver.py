@@ -37,7 +37,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..api import NodeInfo
-from ..metrics import update_solver_kernel_duration, update_tensorize_duration
+from ..metrics import (count_blocking_readback,
+                       update_solver_kernel_duration,
+                       update_tensorize_duration)
 from .tensorize import VEC_EPS, NodeState, TaskBatch, pad_to_bucket
 
 SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
@@ -102,8 +104,9 @@ def _allocate_scan(idle, releasing, backfilled, allocatable_cm, nz_req,
                    task_nz, task_valid, scores, pred_mask, min_available,
                    init_allocated, dyn_weights, dyn_enabled: bool = False):
     """One job visit. Shapes: nodes [N,R]/[N,2]/[N]; tasks [T,R]/[T,2]/[T];
-    scores and pred_mask [T,N]. Returns (decisions[T], node_idx[T],
-    new_idle, new_releasing, new_n_tasks, new_nz_req, became_ready)."""
+    scores and pred_mask [T,N]. Returns (packed[2T+1] int32 — decisions,
+    node indices, became_ready flag, read back in ONE transfer — plus
+    new_idle, new_releasing, new_n_tasks, new_nz_req)."""
     eps = jnp.asarray(VEC_EPS)
 
     def step(carry: _Carry, t: _TaskIn):
@@ -164,8 +167,13 @@ def _allocate_scan(idle, releasing, backfilled, allocatable_cm, nz_req,
                     pred_mask)
     final, (decisions, node_idx) = jax.lax.scan(step, init, tasks)
     became_ready = final.allocated >= min_available
-    return (decisions, node_idx, final.idle, final.releasing, final.n_tasks,
-            final.nz_req, became_ready)
+    # ONE packed int32 host result [2T+1]: decisions, node indices, and
+    # the readiness flag ship as a single blocking transfer (each
+    # device->host read pays the full tunnel RTT)
+    packed = jnp.concatenate([decisions, node_idx,
+                              became_ready.astype(jnp.int32)[None]])
+    return (packed, final.idle, final.releasing, final.n_tasks,
+            final.nz_req)
 
 
 class Decision(NamedTuple):
@@ -335,8 +343,7 @@ class DeviceSession:
             [dyn.least_requested, dyn.balanced_resource] if dyn_enabled
             else [0.0, 0.0], np.float32)
         start = time.perf_counter()
-        (decisions, node_idx, idle, releasing, n_tasks, nz_req,
-         became_ready) = _allocate_scan(
+        (packed, idle, releasing, n_tasks, nz_req) = _allocate_scan(
             self.idle, self.releasing, self.backfilled, self.allocatable_cm,
             self.nz_req, self.max_task_num, self.n_tasks, self.node_ok,
             jnp.asarray(batch.resreq), jnp.asarray(batch.init_resreq),
@@ -345,8 +352,11 @@ class DeviceSession:
             jnp.asarray(min_available, jnp.int32),
             jnp.asarray(init_allocated, jnp.int32),
             jnp.asarray(dyn_weights), dyn_enabled=dyn_enabled)
-        decisions = np.asarray(decisions)
-        node_idx = np.asarray(node_idx)
+        count_blocking_readback()
+        host = np.asarray(packed)      # ONE blocking read per job visit
+        decisions = host[:t_pad]
+        node_idx = host[t_pad:2 * t_pad]
+        became_ready = bool(host[2 * t_pad])
         self.idle, self.releasing, self.n_tasks = idle, releasing, n_tasks
         self.nz_req = nz_req
         update_solver_kernel_duration("allocate_scan",
@@ -357,4 +367,4 @@ class DeviceSession:
             name = (self.state.names[int(node_idx[i])]
                     if kind in (ALLOC, ALLOC_OB, PIPELINE) else "")
             out.append(Decision(kind, name))
-        return out, bool(became_ready)
+        return out, became_ready

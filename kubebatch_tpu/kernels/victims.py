@@ -80,7 +80,7 @@ import numpy as np
 
 from ..api import TaskInfo, TaskStatus, ready_statuses
 from ..util import env_on
-from ..metrics import update_solver_kernel_duration
+from ..metrics import count_blocking_readback, update_solver_kernel_duration
 from ..api.resource import RESOURCE_DIM
 from .solver import dynamic_node_score
 from .tensorize import (VEC_EPS, _intern_paths, accumulate_nz, load_kb_pack,
@@ -340,7 +340,10 @@ def _visit_core(p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
     instead of two [N] rows was worth ~1 ms/visit of host->device
     conversion on the steady path.
 
-    Returns (found, node_idx, victims_mask[V], victims_count, prop_guard).
+    Returns ONE packed int32 buffer [4+V]:
+    [found, node_idx, victims_count, prop_guard, victims_mask[V]...] —
+    a single blocking readback per visit (each device->host transfer
+    pays the full tunnel RTT).
     """
     p_score = sig_scores[p_sig]
     p_pred = sig_pred[p_sig]
@@ -367,10 +370,15 @@ def _visit_core(p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
     found = jnp.any(m)
     node = perm[jnp.argmax(m)].astype(jnp.int32)
 
-    return (found, node,
-            victims & (v_node == node),
-            jnp.sum(victims & (v_node == node)).astype(jnp.int32),
-            guard_n[node])
+    # ONE packed int32 result buffer — every blocking device->host read
+    # pays the full tunnel RTT, so the five logical outputs ship as one
+    # transfer (same discipline as batched._pack_result):
+    # [found, node, count, guard, mask[V]...]
+    mask = victims & (v_node == node)
+    head = jnp.stack([found.astype(jnp.int32), node,
+                      jnp.sum(mask).astype(jnp.int32),
+                      guard_n[node].astype(jnp.int32)])
+    return jnp.concatenate([head, mask.astype(jnp.int32)])
 
 
 _visit_kernel = partial(jax.jit, static_argnames=(
@@ -404,7 +412,11 @@ def _wave_kernel(p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
                               score_nodes=score_nodes,
                               room_check=room_check)
 
-    return jax.vmap(one)(p_res, p_resreq, p_nz, p_sig, p_job, p_queue)
+    pick, guard, victims = jax.vmap(one)(p_res, p_resreq, p_nz, p_sig,
+                                         p_job, p_queue)
+    # one packed bool buffer per wave (columns [pick | guard | victims]);
+    # the host slices it — one readback instead of three
+    return jnp.concatenate([pick, guard, victims], axis=1)
 
 
 # ---------------------------------------------------------------------
@@ -1460,7 +1472,12 @@ class VictimSolver:
                 out = run()
         else:
             out = run()
-        pick, guard, victims = map(np.asarray, out)
+        count_blocking_readback()
+        packed = np.asarray(out)       # [W, N+N+V] — ONE blocking read
+        n_pad = self.state.n_pad
+        pick = packed[:, :n_pad]
+        guard = packed[:, n_pad:2 * n_pad]
+        victims = packed[:, 2 * n_pad:]
         update_solver_kernel_duration("victim_wave",
                                       _time.perf_counter() - k0)
         log_pos = len(st.events)
@@ -1513,16 +1530,18 @@ class VictimSolver:
                 out = run()
         else:
             out = run()
-        found, node, vic_mask, vcount, guard = map(np.asarray, out)
+        count_blocking_readback()
+        packed = np.asarray(out)       # [4+V] — ONE blocking read
         update_solver_kernel_duration("victim_visit",
                                       _time.perf_counter() - k0)
-        rows = np.nonzero(vic_mask)[0].tolist() if found else []
-        node = int(node)
+        found, node, vcount, guard = (bool(packed[0]), int(packed[1]),
+                                      int(packed[2]), bool(packed[3]))
+        rows = np.nonzero(packed[4:])[0].tolist() if found else []
         return VisitResult(
-            found=bool(found), node_idx=node,
-            node_name=self.names[node] if bool(found) else "",
+            found=found, node_idx=node,
+            node_name=self.names[node] if found else "",
             victim_rows=rows,
-            victims_count=int(vcount), prop_guard=bool(guard))
+            victims_count=vcount, prop_guard=guard)
 
 
 #: build_action_solver sentinel: the action can observably do nothing

@@ -151,14 +151,54 @@ def register_job_retries(job_id: str) -> None:
         job_retry_counts.labels(job_id).inc()
 
 
+_solver_kernel_seconds = 0.0
+
+
 def update_solver_kernel_duration(kernel: str, seconds: float) -> None:
+    global _solver_kernel_seconds
+    _solver_kernel_seconds += seconds
     if _PROM:
         solver_kernel_latency.labels(kernel).observe(seconds * 1e6)
+
+
+def solver_kernel_seconds() -> float:
+    """Process-lifetime sum of solver dispatch wall time (dispatch to
+    readback, so on a tunnel it includes the blocking-read RTTs — pair
+    with blocking_readbacks() to split kernel from wire: kernel ~=
+    this - readbacks x RTT). Consumers diff across a window."""
+    return _solver_kernel_seconds
 
 
 def update_tensorize_duration(seconds: float) -> None:
     if _PROM:
         tensorize_latency.observe(seconds * 1e6)
+
+
+# ---------------------------------------------------------------------------
+# blocking device->host readback accounting (VERDICT r4 directive 2)
+# ---------------------------------------------------------------------------
+# Through the axon tunnel every blocking device->host transfer pays the
+# full link RTT (~75 ms measured), so transfer COUNT — not bytes — is the
+# single most environment-sensitive cost driver of a cycle. Every kernel
+# readback site increments this counter; bench.py reports the per-cycle
+# delta and tests/test_readbacks.py pins the budget (<=1 per steady
+# allocate solve, a fixed small bound cold) so a regression shows up as
+# a failed assertion instead of unexplained wire variance.
+
+_blocking_readbacks = 0
+
+
+def count_blocking_readback(n: int = 1) -> None:
+    """Record n blocking device->host transfers (call at the np.asarray /
+    .item() site, BEFORE the transfer, so an interrupted cycle still
+    counts the attempt)."""
+    global _blocking_readbacks
+    _blocking_readbacks += n
+
+
+def blocking_readbacks() -> int:
+    """Process-lifetime count; consumers diff across a window."""
+    return _blocking_readbacks
 
 
 # ---------------------------------------------------------------------------

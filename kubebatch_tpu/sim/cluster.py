@@ -149,14 +149,23 @@ class SimCluster:
     _pod_index: Optional[Dict[Tuple[str, str], Pod]] = None
     _churn_seq: int = 0
 
-    def churn_tick(self, cache: SchedulerCache, n_pods: int) -> int:
+    def churn_tick(self, cache: SchedulerCache, n_pods: int,
+                   arrival_queue: Optional[int] = None) -> int:
         """Steady-state churn trickle: the oldest fully-bound gangs finish
         (pod + PodGroup delete events) and the same number of fresh gangs
         arrives pending — the regime the 1 s schedule-period loop lives in
         once the cluster is mostly scheduled (the kubemark plan's
         density/latency scenario, ref
         doc/design/Benchmark/kubemark/kubemark-benchmarking.md:40-42).
-        Returns the number of pods actually recycled."""
+        Returns the number of pods actually recycled.
+
+        ``arrival_queue`` pins ALL of this tick's fresh gangs onto one
+        queue index instead of the round-robin default — alternating it
+        between ticks sustains cross-queue imbalance (the arriving
+        queue's allocated sits below its deserved while others sit at or
+        above), the regime where reclaim's provably-idle gates correctly
+        do NOT fire and the victim wave path stays hot every cycle
+        (bench.py --steady-skew; VERDICT r4 directive 4)."""
         spec = self.spec
         per = max(1, spec.pods_per_group)
         n_groups = max(1, n_pods // per)
@@ -197,7 +206,9 @@ class SimCluster:
         for k in range(done):
             gid = self._churn_seq
             self._churn_seq += 1
-            queue = self.queues[gid % len(self.queues)].name
+            qi = (arrival_queue if arrival_queue is not None
+                  else gid) % len(self.queues)
+            queue = self.queues[qi].name
             # named job-* so the next tick can recycle churn gangs too
             pg = PodGroup(name=f"job-churn-{gid:06d}", namespace="sim",
                           min_member=per, queue=queue,

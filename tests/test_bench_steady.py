@@ -8,7 +8,7 @@ import bench
 
 
 def test_run_steady_small_config():
-    latencies, bound, action_ms = bench.run_steady(2, 2, "auto", 16)
+    latencies, bound, action_ms, readbacks = bench.run_steady(2, 2, "auto", 16)
     assert len(latencies) == 2
     assert bound == 32          # 16 churn pods per measured cycle
     assert all(dt > 0 for dt in latencies)
@@ -40,13 +40,13 @@ def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
     monkeypatch.setattr(bench, "ensure_responsive_backend",
                         lambda *a, **k: "cpu-fallback")
     monkeypatch.setattr(bench, "run_config",
-                        lambda *a: ([0.1, 0.1], 200, 0.2, 0, {}, ["batched"]))
+                        lambda *a: ([0.1, 0.1], 200, 0.2, 0, {}, ["batched"], [1, 1], [0.01, 0.01]))
     steady_ran = {}
 
     def fake_steady(*a):
         # the primary line must already be visible at this point
         steady_ran["primary_first"] = capsys.readouterr().out.strip()
-        return [0.05] * 5, 1280, {"allocate": 40.0}
+        return [0.05] * 5, 1280, {"allocate": 40.0}, [1, 1, 1, 1, 1]
 
     monkeypatch.setattr(bench, "run_steady", fake_steady)
     rc = bench.main(["--config", "5", "--cycles", "2"])

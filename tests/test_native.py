@@ -48,13 +48,14 @@ def test_native_solve_matches_jax_scan():
         allocatable_cm = (idle[:, :2] * 2.0).astype(np.float32)
         nz0 = np.zeros((n, 2), np.float32)
         task_nz = np.maximum(resreq[:, :2], 1.0).astype(np.float32)
-        jd, jn_, jidle, jrel, jnt, _jnz, jready = [
+        jpacked, jidle, jrel, jnt, _jnz = [
             np.asarray(x) for x in _allocate_scan(
                 idle, releasing, backfilled, allocatable_cm, nz0, mtn,
                 ntasks, ok, resreq, init_resreq, task_nz, tvalid, scores,
                 pred, jnp.asarray(min_av, jnp.int32),
                 jnp.asarray(init_alloc, jnp.int32),
                 jnp.zeros(2, jnp.float32))]
+        jd, jn_, jready = jpacked[:t], jpacked[t:2 * t], jpacked[2 * t]
 
         c_idle = idle.copy()
         c_rel = releasing.copy()

@@ -35,15 +35,19 @@ def test_sharded_matches_single_device():
         (idle, releasing, backfilled, mtn, ntasks, ok, resreq,
          init_resreq, tvalid, scores, pred) = args
         n = idle.shape[0]
-        single_full = _allocate_scan(
+        packed, s_idle, s_rel, s_nt, _s_nz = _allocate_scan(
             idle, releasing, backfilled,
             (idle[:, :2] * 2.0).astype(np.float32),
             np.zeros((n, 2), np.float32), mtn, ntasks, ok, resreq,
             init_resreq, np.maximum(resreq[:, :2], 1.0).astype(np.float32),
             tvalid, scores, pred, min_av, init_alloc,
             jnp.zeros(2, jnp.float32))
-        # drop the nz_req output; the sharded kernel doesn't carry it
-        single = single_full[:5] + single_full[6:]
+        packed = np.asarray(packed)
+        t = resreq.shape[0]
+        # unpack the single-read result; the sharded kernel doesn't carry
+        # nz_req and returns its outputs unpacked
+        single = (packed[:t], packed[t:2 * t], s_idle, s_rel, s_nt,
+                  packed[2 * t])
         sharded = run(*args, min_av, init_alloc)
         for name, a, b in zip(
                 ["decisions", "node_idx", "idle", "releasing", "n_tasks",
