@@ -74,6 +74,13 @@ def _no_possible_reclaim_victim(ssn: Session) -> bool:
         return ok
 
     fns = ssn.reclaimable_fns
+    # cost-ordered evaluation: a tier fires (-> return False) only when
+    # ALL its members are possible, and ANY firing tier decides — so
+    # check cheap members (conformance: constant; proportion: O(queues))
+    # before gang's O(jobs) scan, and cheap tiers before expensive ones.
+    # Pure reordering of short-circuit evaluation, same result.
+    cost = {"conformance": 0, "proportion": 1, "gang": 2}
+    tiers = []
     for tier in ssn.tiers:
         members = [opt.name for opt in tier.plugins
                    if not opt.reclaimable_disabled and opt.name in fns]
@@ -81,6 +88,10 @@ def _no_possible_reclaim_victim(ssn: Session) -> bool:
             continue
         if any(m not in _PROVABLE_RECLAIM_FNS for m in members):
             return False
+        members.sort(key=lambda m: cost[m])
+        tiers.append(members)
+    tiers.sort(key=lambda ms: cost[ms[-1]])
+    for members in tiers:
         if all(member_possible(m) for m in members):
             return False
     return True
@@ -101,22 +112,28 @@ class ReclaimAction(Action):
         if len(ssn.queues) <= 1:
             return
 
+        # ONE walk over the job map feeds everything below (the gate's
+        # queue membership, the solver's pending set, the preemptor PQs)
+        # — this setup used to walk 10k jobs four separate times per
+        # cycle in the victim-hot steady regime
+        jobs_pending = [job for job in ssn.jobs.values()
+                        if TaskStatus.PENDING in job.task_status_index]
+
         # Provably-idle fast path: the reference loop pops each queue and
         # skips it when ssn.Overused(queue) (reclaim.go:95-99) — if EVERY
         # queue holding pending work is overused up front, the loop ends
         # without a single visit or mutation, because skipped queues are
         # never re-pushed and nothing else in the loop body runs. In the
         # saturated steady regime proportion marks every queue overused
-        # (allocated == deserved, proportion.go:186-200), so this O(jobs)
-        # membership walk replaces the full solver build + wave analysis
+        # (allocated == deserved, proportion.go:186-200), so this cheap
+        # membership check replaces the full solver build + wave analysis
         # the cycle would spend proving the no-op. Evaluating before the
         # loop is exact: overused_fns are pure reads of plugin state, and
         # the all-overused case performs no mutation that could change a
         # later answer. Queues absent from the session can't reclaim
         # (their jobs never enter preemptorsMap) and don't count.
         if env_on("KUBEBATCH_RECLAIM_FASTPATH"):
-            pending_queues = {job.queue for job in ssn.jobs.values()
-                              if TaskStatus.PENDING in job.task_status_index}
+            pending_queues = {job.queue for job in jobs_pending}
             reclaimer_queues = [q for quid in pending_queues
                                 if (q := ssn.queues.get(quid)) is not None]
             if all(ssn.overused(q) for q in reclaimer_queues):
@@ -136,9 +153,13 @@ class ReclaimAction(Action):
                 return
 
         from ..kernels.victims import SKIP_ACTION, build_action_solver
+        pending_tasks = [t for job in jobs_pending
+                         for t in job.task_status_index[
+                             TaskStatus.PENDING].values()]
         solver = build_action_solver(ssn, "reclaimable_fns",
                                      "reclaimable_disabled",
-                                     score_nodes=False)
+                                     score_nodes=False,
+                                     pending=pending_tasks)
         if solver is SKIP_ACTION:
             return
 
@@ -147,21 +168,27 @@ class ReclaimAction(Action):
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, PriorityQueue] = {}
 
-        for job in ssn.jobs.values():
+        # only queues holding PENDING jobs enter the PQ: the reference
+        # builds its PQ from all jobs' queues (reclaim.go:88-99), but a
+        # pop without preemptors mutates nothing, so restricting to the
+        # pending set is outcome-identical without the O(jobs) walk.
+        # Queues of jobless/pending-less sessions must NOT be pushed —
+        # proportion's queue_order_fn indexes queue_opts, which only
+        # holds queues that have jobs.
+        for job in jobs_pending:
             queue = ssn.queues.get(job.queue)
             if queue is None:
                 continue
             if queue.uid not in queue_map:
                 queue_map[queue.uid] = queue
                 queues.push(queue)
-            if job.count(TaskStatus.PENDING) != 0:
-                preemptors_map.setdefault(
-                    job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
-                tasks = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index.get(TaskStatus.PENDING,
-                                                      {}).values():
-                    tasks.push(task)
-                preemptor_tasks[job.uid] = tasks
+            preemptors_map.setdefault(
+                job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
+            tasks = PriorityQueue(ssn.task_order_fn)
+            for task in job.task_status_index.get(TaskStatus.PENDING,
+                                                  {}).values():
+                tasks.push(task)
+            preemptor_tasks[job.uid] = tasks
 
         if solver is not None:
             # the first visit per queue is knowable up front (top task of

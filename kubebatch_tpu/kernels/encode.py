@@ -356,6 +356,9 @@ def _has_pod_affinity(pod: Pod) -> bool:
     return pod.has_pod_affinity()
 
 
+_DYN_MISS = object()
+
+
 def dynamic_features(ssn, pending: Sequence[TaskInfo]) -> Optional[str]:
     """Why this snapshot can't use the static encoder, or None if it can.
 
@@ -365,6 +368,13 @@ def dynamic_features(ssn, pending: Sequence[TaskInfo]) -> Optional[str]:
     - any pod with inter-pod (anti-)affinity makes both the affinity
       predicate and nodeorder's interpod score allocation-dependent
       (including the symmetry checks that affect OTHER pods).
+
+    The pending-dependent scans run fresh per call (callers pass
+    differently-filtered pending lists — allocate drops BestEffort
+    tasks, the victim solvers don't). Only the SESSION-WIDE walk over
+    jobs/nodes is memoized: existing pods' affinity counters can only
+    decrease in-session (no pod is added mid-session), so a cached
+    positive is at worst over-conservative.
     """
     for t in pending:
         if t.pod.host_ports():
@@ -372,6 +382,18 @@ def dynamic_features(ssn, pending: Sequence[TaskInfo]) -> Optional[str]:
     for t in pending:
         if _has_pod_affinity(t.pod):
             return "pending task with pod (anti-)affinity"
+    memo = getattr(ssn, "_dyn_session_aff_memo", _DYN_MISS)
+    if memo is not _DYN_MISS:
+        return memo
+    result = _session_affinity_present(ssn)
+    try:
+        ssn._dyn_session_aff_memo = result
+    except Exception:       # slots-only fake sessions in tests
+        pass
+    return result
+
+
+def _session_affinity_present(ssn) -> Optional[str]:
     # the maintained per-entity counters (JobInfo/NodeInfo.affinity_tasks,
     # pinned by debug.audit_cache) replace the per-task cluster walk this
     # detection used to cost every cycle. Pods of jobs the snapshot

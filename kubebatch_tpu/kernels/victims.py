@@ -473,7 +473,8 @@ def _build_segments(pairs) -> Dict[str, _NodeSegment]:
         flat.extend(ts)
         rows.extend([j] * len(ts))
     nz = accumulate_nz(flat, rows, max(1, len(pairs)))
-    res_flat = np.empty((len(flat), RESOURCE_DIM), np.float64)
+    n_flat = len(flat)
+    res_flat = np.empty((n_flat, RESOURCE_DIM), np.float64)
     if flat:
         pack = load_kb_pack()
         if pack is not None:
@@ -483,23 +484,34 @@ def _build_segments(pairs) -> Dict[str, _NodeSegment]:
                 rr = t.resreq
                 res_flat[i] = (rr.milli_cpu, rr.memory, rr.milli_gpu)
     res32 = (res_flat * VEC_SCALE).astype(np.float32)
+    # single flat passes + per-node array splits instead of per-node
+    # comprehensions (this runs for ~500 dirty nodes per steady-skew
+    # cycle; the per-node Python overhead WAS the segrefresh phase)
+    run_mask = np.fromiter((t.status == running for t in flat), bool,
+                           count=n_flat)
+    run_pos = np.flatnonzero(run_mask)
+    run_tasks_flat = [flat[x] for x in run_pos]
+    crit_flat = np.fromiter(
+        (_pod_critical(t.pod) for t in run_tasks_flat), bool,
+        count=len(run_tasks_flat))
+    res_run = res32[run_pos]
+    run_counts = np.bincount(np.asarray(rows, np.int64)[run_pos],
+                             minlength=len(pairs))
+    bounds = np.cumsum(run_counts)[:-1]
+    res_split = np.split(res_run, bounds)
+    crit_split = np.split(crit_flat, bounds)
     segs: Dict[str, _NodeSegment] = {}
     base = 0
     for j, (name, _) in enumerate(pairs):
-        ts = per_node[j]
         seg = _NodeSegment.__new__(_NodeSegment)
-        run_idx = [base + m for m, t in enumerate(ts)
-                   if t.status == running]
-        seg.run_tasks = [flat[x] for x in run_idx]
-        seg.run_res = (res32[run_idx] if run_idx
-                       else np.empty((0, RESOURCE_DIM), np.float32))
-        seg.run_crit = np.fromiter(
-            (_pod_critical(t.pod) for t in seg.run_tasks), bool,
-            count=len(run_idx))
+        k = int(run_counts[j])
+        seg.run_tasks = run_tasks_flat[base:base + k]
+        seg.run_res = res_split[j]
+        seg.run_crit = crit_split[j]
         seg.nz = nz[j]
-        seg.n_tasks = len(ts)
+        seg.n_tasks = len(per_node[j])
         segs[name] = seg
-        base += len(ts)
+        base += k
     return segs
 
 
@@ -907,7 +919,13 @@ class VictimState:
                     for i in range(off0, off0 + cap0):
                         tasks_l[i] = None
                     store.dead_cap += cap0
-                cap = k + max(2, k >> 2)
+                # +12.5% slack (min 1): every idle slot row is dead
+                # weight EVERY kernel dispatch scans — at cfg5 shapes the
+                # old 25%+2 slack pushed ~10k live rows to a 32k pow2
+                # pad, 3.4x the wave kernel's row axis for nothing. A
+                # node outgrowing the tighter cap just re-slots (dead_cap
+                # accounting below bounds the leak)
+                cap = k + max(1, k >> 3)
                 off = store.rows_used
                 store._ensure_row_cap(off + cap)
                 tasks_l = store.row_tasks
@@ -983,7 +1001,15 @@ class VictimState:
         # matches a fresh build. Effective liveness folds job presence:
         # rows of session-absent jobs are dead this cycle.
         used = store.rows_used
-        v_pad = pad_to_bucket(max(1, used), 8)
+        # pow2 padding doubles the kernel's row axis right past each
+        # boundary (20k used -> 32k pad); above 4096 pad to the next
+        # 4096 multiple instead — still a handful of compile shapes per
+        # store lifetime (rows_used is slot-stable between clears), at
+        # <= 1/8th the padding waste
+        if used <= 4096:
+            v_pad = pad_to_bucket(max(1, used), 8)
+        else:
+            v_pad = -(-used // 4096) * 4096
         store._ensure_row_cap(v_pad)
         self.v_node = store.v_node[:v_pad]
         self.v_job = store.v_job[:v_pad]
@@ -1423,10 +1449,11 @@ class VictimSolver:
             chunk = self.pending[start:start + self._wave_size]
             p_bucket = 8
         else:
-            # explicit prefetch chunk: lanes are pure compute on the
-            # host-XLA path, so pad as tightly as the compile-shape
-            # budget allows
-            p_bucket = 4
+            # explicit prefetch chunk: pad to the next pow2 of the REAL
+            # lane count (1/2/4/...) — the steady-skew regime prefetches
+            # a single queue's top task, and every padded lane is a full
+            # [V]+[N] analysis the CPU backend computes for nothing
+            p_bucket = 1
         p = len(chunk)
         p_pad = pad_to_bucket(p, p_bucket)
         p_res = np.zeros((p_pad, RESOURCE_DIM), np.float32)
@@ -1551,22 +1578,25 @@ SKIP_ACTION = object()
 
 
 def build_action_solver(ssn, fns_attr: str, disabled_attr: str,
-                        score_nodes: bool):
+                        score_nodes: bool, pending=None):
     """The env-gated entry the preempt/reclaim actions share: collects the
     session's pending tasks and builds the kernel solver; returns None
     for the host path (KUBEBATCH_VICTIM_SOLVER=host, nothing pending, or
     an unsupported snapshot), or SKIP_ACTION when no victim can exist —
     with no RUNNING task in any job, every visit would scan to an empty
     set, so the action skips the solver build AND its loops (the
-    task_status_index check is exact: empty buckets are deleted)."""
+    task_status_index check is exact: empty buckets are deleted).
+    ``pending``: the caller's precollected pending-task list (the action
+    walks the job map anyway; passing it avoids a second 10k-job walk)."""
     if os.environ.get("KUBEBATCH_VICTIM_SOLVER", "device") == "host":
         return None
     if not any(TaskStatus.RUNNING in j.task_status_index
                for j in ssn.jobs.values()):
         return SKIP_ACTION
-    pending = [t for job in ssn.jobs.values()
-               for t in job.task_status_index.get(TaskStatus.PENDING,
-                                                  {}).values()]
+    if pending is None:
+        pending = [t for job in ssn.jobs.values()
+                   for t in job.task_status_index.get(TaskStatus.PENDING,
+                                                      {}).values()]
     if not pending:
         return None
     solver = build_victim_solver(ssn, pending, fns_attr, disabled_attr,

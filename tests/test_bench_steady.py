@@ -57,3 +57,61 @@ def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
     last = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert last["steady_p50_ms"] == 50.0
     assert last["backend"] == "cpu-fallback"
+
+
+def test_steady_skew_keeps_reclaim_gates_open():
+    """--steady-skew regime (VERDICT r4 directive 4): alternating one-
+    queue arrivals sustain cross-queue imbalance, so reclaim's
+    provably-idle gates must NOT short-circuit — the victim wave
+    actually dispatches (blocking-readback delta over the reclaim
+    action >= 1) in every skewed cycle."""
+    from kubebatch_tpu import actions, plugins  # noqa: F401
+    from kubebatch_tpu.actions.allocate import AllocateAction
+    from kubebatch_tpu.actions.reclaim import ReclaimAction
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.metrics import blocking_readbacks
+    from kubebatch_tpu.objects import PodPhase
+    from kubebatch_tpu.sim import ClusterSpec, build_cluster
+
+    GiB = 1024 ** 3
+    spec = ClusterSpec(n_nodes=24, n_groups=24, pods_per_group=4,
+                       min_member=4, n_queues=2, queue_weights=(1, 4),
+                       node_cpu_millis=8000, node_mem_bytes=16 * GiB,
+                       pod_cpu_millis=1800, pod_mem_bytes=2 * GiB, seed=5)
+    sim = build_cluster(spec)
+    fresh = []
+
+    class _B:
+        def bind(self, pod, h):
+            pod.node_name = h
+            fresh.append(pod)
+
+        def evict(self, pod):
+            pod.deletion_timestamp = 1.0
+
+    cache = SchedulerCache(binder=_B(), evictor=_B(),
+                           async_writeback=False)
+    sim.populate(cache)
+    tiers = shipped_tiers()
+    acts = [ReclaimAction(), AllocateAction(mode="auto")]
+    wave_cycles = 0
+    for i in range(6):
+        for pod in fresh:
+            if pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                cache.update_pod(pod, pod)
+        fresh.clear()
+        if i >= 1:
+            sim.churn_tick(cache, 8, arrival_queue=(0 if i % 2 else 1))
+        ssn = OpenSession(cache, tiers)
+        rb0 = blocking_readbacks()
+        acts[0].execute(ssn)
+        if i >= 2 and blocking_readbacks() - rb0 >= 1:
+            wave_cycles += 1
+        acts[1].execute(ssn)
+        CloseSession(ssn)
+    # sustained imbalance: the gates stay open and the wave dispatches
+    # in (at least most of) the skewed cycles
+    assert wave_cycles >= 3, f"victim wave ran in only {wave_cycles} cycles"

@@ -326,3 +326,43 @@ def test_reclaim_fastpath_equivalence_fuzz(seed, monkeypatch):
         return sorted(h.evicted), pipelined
 
     assert run("1") == run("0")
+
+
+def test_reclaim_tolerates_jobless_queue():
+    """A session queue with NO jobs must not break reclaim: proportion's
+    queue_order_fn indexes queue_opts, which only holds queues that have
+    jobs — reclaim's PQ must therefore never contain a jobless queue
+    (regression: r5 queue-PQ rework briefly pushed every session queue)."""
+    from kubebatch_tpu import actions, plugins  # noqa: F401
+    from kubebatch_tpu.actions.reclaim import ReclaimAction
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from .fixtures import GiB, build_group, build_node, build_pod, \
+        build_queue, rl
+
+    ev = []
+
+    class _S:
+        def bind(self, pod, h):
+            pod.node_name = h
+
+        def evict(self, pod):
+            ev.append(pod.name)
+            pod.deletion_timestamp = 1.0
+
+    cache = SchedulerCache(binder=_S(), evictor=_S(), async_writeback=False)
+    for q in ("q1", "q2", "q-empty"):
+        cache.add_queue(build_queue(q))
+    cache.add_node(build_node("n0", rl(4000, 8 * GiB, pods=110)))
+    cache.add_pod_group(build_group("ns", "hog", 1, queue="q1"))
+    for i in range(4):
+        cache.add_pod(build_pod("ns", f"hog-{i}", "n0", "Running",
+                                rl(1000, 2 * GiB), group="hog"))
+    cache.add_pod_group(build_group("ns", "want", 1, queue="q2"))
+    cache.add_pod(build_pod("ns", "want-0", "", "Pending",
+                            rl(1000, 2 * GiB), group="want"))
+    ssn = OpenSession(cache, shipped_tiers())
+    ReclaimAction().execute(ssn)     # must not raise on q-empty
+    CloseSession(ssn)
+    assert ev, "imbalanced two-queue cluster must reclaim a victim"
