@@ -136,9 +136,17 @@ class GangPlugin(Plugin):
         (ref: gang.go:166-210)."""
         unschedulable_jobs = 0
         for job in ssn.jobs.values():
-            if ready_task_num(job) >= job.min_available:
+            # fast screen for the dominant steady shape — every task
+            # Running: ready_task_num == len(tasks), no status-bucket
+            # walk needed (exact, Running is a ready status)
+            idx = job.task_status_index
+            if len(idx) == 1 and TaskStatus.RUNNING in idx \
+                    and len(job.tasks) >= job.min_available:
                 continue
-            unready = job.min_available - ready_task_num(job)
+            ready = ready_task_num(job)
+            if ready >= job.min_available:
+                continue
+            unready = job.min_available - ready
             msg = (f"{unready}/{len(job.tasks)} tasks in gang unschedulable: "
                    f"{job.fit_error()}")
             unschedulable_jobs += 1
