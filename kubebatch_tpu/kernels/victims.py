@@ -419,6 +419,49 @@ def _wave_kernel(p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
     return jnp.concatenate([pick, guard, victims], axis=1)
 
 
+def run_wave_kernel(static, mut, sig, p_res, p_resreq, p_nz, p_sig,
+                    p_job, p_queue, *, tiers, veto_critical, filter_kind,
+                    dyn_enabled, score_nodes, room_check):
+    """Invoke the wave kernel from the (static, mutable, sig) tuples of
+    VictimSolver._upload — the ONE place the interleaved shared-arg order
+    is written down, shared by the local dispatch and the rpc sidecar's
+    server-side execution (rpc/victims_wire.py)."""
+    sig_scores, sig_pred = sig
+    return _wave_kernel(
+        p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
+        p_job, p_queue,
+        static[0], mut[0], static[1], mut[1],
+        static[2], static[3],
+        static[4], static[5], static[6], static[7],
+        mut[2],
+        static[8], static[9], static[10], static[11],
+        mut[3], static[12], mut[4], static[13],
+        mut[5], static[14], static[15], static[16], static[17],
+        tiers=tiers, veto_critical=veto_critical,
+        filter_kind=filter_kind, dyn_enabled=dyn_enabled,
+        score_nodes=score_nodes, room_check=room_check)
+
+
+def run_visit_kernel(static, mut, sig, p_res, p_resreq, p_nz, p_sig,
+                     p_job, p_queue, visited, *, tiers, veto_critical,
+                     filter_kind, dyn_enabled, score_nodes, room_check):
+    """Single-lane twin of run_wave_kernel (kernels' _visit_kernel)."""
+    sig_scores, sig_pred = sig
+    return _visit_kernel(
+        p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
+        p_job, p_queue, visited,
+        static[0], mut[0], static[1], mut[1],
+        static[2], static[3],
+        static[4], static[5], static[6], static[7],
+        mut[2],
+        static[8], static[9], static[10], static[11],
+        mut[3], static[12], mut[4], static[13],
+        mut[5], static[14], static[15], static[16], static[17],
+        tiers=tiers, veto_critical=veto_critical,
+        filter_kind=filter_kind, dyn_enabled=dyn_enabled,
+        score_nodes=score_nodes, room_check=room_check)
+
+
 # ---------------------------------------------------------------------
 # host-side state
 # ---------------------------------------------------------------------
@@ -1214,6 +1257,11 @@ class VictimSolver:
         self._sig_dev = None
         self._mut_dev = None
         self._mut_version = -1
+        #: rpc sidecar backend (rpc/victims_wire.RemoteVictimBackend) —
+        #: attached by build_action_solver under KUBEBATCH_SOLVER=rpc;
+        #: None = local kernels. Remote calls fall back to local per
+        #: dispatch (the analysis is pure)
+        self.remote = None
         #: wave state
         self.pending = list(pending)
         self._pos = {t.uid: i for i, t in enumerate(self.pending)}
@@ -1243,6 +1291,37 @@ class VictimSolver:
         #: dispatch LATENCY dominates) waves start immediately
         self._wave_after = 4 if self._dev is not None else 0
 
+    def host_static_arrays(self):
+        """The 18 immutable state arrays in _upload/run_*_kernel order,
+        as host numpy (shared with the rpc backend's one-time upload)."""
+        st = self.state
+        dyn_enabled = bool(self.dyn is not None and self.dyn.enabled)
+        dyn_w = np.asarray(
+            [self.dyn.least_requested, self.dyn.balanced_resource]
+            if dyn_enabled else [0.0, 0.0], np.float32)
+        return (st.node_ok, st.max_task_num, st.allocatable_cm,
+                st.host_rank, st.v_node, st.v_job, st.v_res, st.v_critical,
+                st.perm_nj, st.nj_head, st.perm_nq, st.nq_head, st.min_av,
+                st.job_queue, st.q_deserved, st.q_prop_ok,
+                st.cluster_total, dyn_w)
+
+    def host_sig_arrays(self):
+        """The bucket-padded [S, N] static-term matrices (score, pred)."""
+        score = self.terms.static.score
+        pred = self.terms.static.pred
+        s_pad = pad_to_bucket(score.shape[0], 4)
+        if s_pad != score.shape[0]:
+            pad = s_pad - score.shape[0]
+            score = np.pad(score, ((0, pad), (0, 0)))
+            pred = np.pad(pred, ((0, pad), (0, 0)))
+        return score, pred
+
+    def host_mutable_arrays(self):
+        """The 6 mutable mirrors in _upload order (numpy views)."""
+        st = self.state
+        return (st.n_tasks, st.nz_req, st.v_live, st.ready_cnt,
+                st.j_alloc, st.q_alloc)
+
     def _upload(self):
         """Device copies of the state arrays: the immutable set once per
         action, the mutable mirrors only when a mutation bumped the state
@@ -1251,36 +1330,18 @@ class VictimSolver:
         st = self.state
         put = jax.device_put
         if self._static_dev is None:
-            dyn_enabled = bool(self.dyn is not None and self.dyn.enabled)
-            dyn_w = np.asarray(
-                [self.dyn.least_requested, self.dyn.balanced_resource]
-                if dyn_enabled else [0.0, 0.0], np.float32)
             # ONE batched transfer for the whole immutable set — 18
             # per-array device_put calls paid ~0.5 ms of dispatch
             # overhead each on the steady path
-            self._static_dev = put((
-                st.node_ok, st.max_task_num, st.allocatable_cm,
-                st.host_rank, st.v_node, st.v_job, st.v_res, st.v_critical,
-                st.perm_nj, st.nj_head, st.perm_nq, st.nq_head, st.min_av,
-                st.job_queue, st.q_deserved, st.q_prop_ok,
-                st.cluster_total, dyn_w))
+            self._static_dev = put(self.host_static_arrays())
             # the [S, N] static-term matrices ride along once per action;
             # visits/waves then ship sig indices, not rows. S is padded
             # to a bucket so a cycle introducing a new unique signature
             # shape doesn't recompile the kernels (same discipline as
             # cycle_inputs' sig arrays)
-            score = self.terms.static.score
-            pred = self.terms.static.pred
-            s_pad = pad_to_bucket(score.shape[0], 4)
-            if s_pad != score.shape[0]:
-                pad = s_pad - score.shape[0]
-                score = np.pad(score, ((0, pad), (0, 0)))
-                pred = np.pad(pred, ((0, pad), (0, 0)))
-            self._sig_dev = put((score, pred))
+            self._sig_dev = put(self.host_sig_arrays())
         if self._mut_version != st.version:
-            self._mut_dev = put((
-                st.n_tasks, st.nz_req, st.v_live, st.ready_cnt,
-                st.j_alloc, st.q_alloc))
+            self._mut_dev = put(self.host_mutable_arrays())
             self._mut_version = st.version
         return self._static_dev, self._mut_dev
 
@@ -1475,32 +1536,32 @@ class VictimSolver:
 
         def run():
             static_dev, mut_dev = self._upload()
-            sig_scores, sig_pred = self._sig_dev
-            return _wave_kernel(
-                p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
-                p_job, p_queue,
-                static_dev[0], mut_dev[0], static_dev[1], mut_dev[1],
-                static_dev[2], static_dev[3],
-                static_dev[4], static_dev[5], static_dev[6], static_dev[7],
-                mut_dev[2],
-                static_dev[8], static_dev[9], static_dev[10],
-                static_dev[11],
-                mut_dev[3], static_dev[12], mut_dev[4], static_dev[13],
-                mut_dev[5], static_dev[14], static_dev[15],
-                static_dev[16], static_dev[17],
+            return run_wave_kernel(
+                static_dev, mut_dev, self._sig_dev,
+                p_res, p_resreq, p_nz, p_sig, p_job, p_queue,
                 tiers=self.tiers, veto_critical=self.veto_critical,
                 filter_kind=filter_kind, dyn_enabled=dyn_enabled,
                 score_nodes=self.score_nodes, room_check=self.room_check)
 
         self.dispatches += 1
         k0 = _time.perf_counter()
-        if self._dev is not None:
-            with jax.default_device(self._dev):
+        packed = None
+        if self.remote is not None:
+            # sidecar analysis (KUBEBATCH_SOLVER=rpc): statics were
+            # uploaded once; a failed call falls back to the local
+            # kernels for THIS dispatch (analysis is pure — retrying
+            # locally cannot double-apply anything)
+            packed = self.remote.wave(
+                self, p_res, p_resreq, p_nz, p_sig, p_job, p_queue,
+                filter_kind=filter_kind, dyn_enabled=dyn_enabled)
+        if packed is None:
+            if self._dev is not None:
+                with jax.default_device(self._dev):
+                    out = run()
+            else:
                 out = run()
-        else:
-            out = run()
-        count_blocking_readback()
-        packed = np.asarray(out)       # [W, N+N+V] — ONE blocking read
+            count_blocking_readback()
+            packed = np.asarray(out)   # [W, N+N+V] — ONE blocking read
         n_pad = self.state.n_pad
         pick = packed[:, :n_pad]
         guard = packed[:, n_pad:2 * n_pad]
@@ -1526,39 +1587,35 @@ class VictimSolver:
         ji = p_job if p_job >= 0 else 0
         p_queue = int(st.job_queue[ji]) if p_job >= 0 else -1
 
+        p_res = np.asarray(task.init_resreq.to_vec())
+        p_resreq = np.asarray(task.resreq.to_vec())
+        p_nz = nz_request_vec(task.resreq.to_vec())
+
         def run():
-            ((node_ok, max_task_num, allocatable_cm, host_rank, v_node,
-              v_job, v_res, v_critical, perm_nj, nj_head, perm_nq, nq_head,
-              min_av, job_queue, q_deserved, q_prop_ok, cluster_total,
-              dyn_w),
-             (n_tasks, nz_req, v_live, ready_cnt, j_alloc, q_alloc)) = \
-                self._upload()
-            sig_scores, sig_pred = self._sig_dev
-            return _visit_kernel(
-                np.asarray(task.init_resreq.to_vec()),
-                np.asarray(task.resreq.to_vec()),
-                nz_request_vec(task.resreq.to_vec()),
-                np.int32(sig), sig_scores, sig_pred,
+            static_dev, mut_dev = self._upload()
+            return run_visit_kernel(
+                static_dev, mut_dev, self._sig_dev,
+                p_res, p_resreq, p_nz, np.int32(sig),
                 np.int32(p_job), np.int32(p_queue), visited,
-                node_ok, n_tasks, max_task_num, nz_req,
-                allocatable_cm, host_rank,
-                v_node, v_job, v_res, v_critical, v_live,
-                perm_nj, nj_head, perm_nq, nq_head,
-                ready_cnt, min_av, j_alloc, job_queue,
-                q_alloc, q_deserved, q_prop_ok, cluster_total,
-                dyn_w,
                 tiers=self.tiers, veto_critical=self.veto_critical,
                 filter_kind=filter_kind, dyn_enabled=dyn_enabled,
                 score_nodes=self.score_nodes, room_check=self.room_check)
 
         k0 = _time.perf_counter()
-        if self._dev is not None:
-            with jax.default_device(self._dev):
+        packed = None
+        if self.remote is not None:
+            packed = self.remote.visit(
+                self, p_res, p_resreq, p_nz, int(sig), int(p_job),
+                int(p_queue), visited, filter_kind=filter_kind,
+                dyn_enabled=dyn_enabled)
+        if packed is None:
+            if self._dev is not None:
+                with jax.default_device(self._dev):
+                    out = run()
+            else:
                 out = run()
-        else:
-            out = run()
-        count_blocking_readback()
-        packed = np.asarray(out)       # [4+V] — ONE blocking read
+            count_blocking_readback()
+            packed = np.asarray(out)   # [4+V] — ONE blocking read
         update_solver_kernel_duration("victim_visit",
                                       _time.perf_counter() - k0)
         found, node, vcount, guard = (bool(packed[0]), int(packed[1]),
@@ -1657,4 +1714,12 @@ def build_victim_solver(ssn, pending: Sequence[TaskInfo],
         state, terms, names=ns.names, tiers=tuple(tiers),
         veto_critical="conformance" in ssn.victim_veto_fns,
         score_nodes=score_nodes, room_check=pred_active, pending=pending)
+    if os.environ.get("KUBEBATCH_SOLVER", "") == "rpc":
+        # route the victim analysis through the solver sidecar — the
+        # full 4-action remote cycle (scheduler.go:88-105 runs every
+        # action against its backend). Channel failure or any later RPC
+        # error falls back to the local kernels per dispatch.
+        from ..rpc.victims_wire import attach_remote
+        attach_remote(solver, os.environ.get("KUBEBATCH_SOLVER_ADDR",
+                                             "127.0.0.1:50061"))
     return solver

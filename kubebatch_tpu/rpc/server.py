@@ -297,12 +297,31 @@ def _solve_handler(request: bytes, context) -> bytes:
 def make_server(address: str = "127.0.0.1:0",
                 max_workers: int = 4) -> tuple:
     """Returns (grpc.Server, bound_port)."""
+    from .victims_wire import VictimRegistry
+
+    registry = VictimRegistry()
+
+    def _victim_upload(request: bytes, context) -> bytes:
+        req = solver_pb2.VictimUploadRequest.FromString(request)
+        return solver_pb2.VictimUploadResponse(
+            state_id=registry.upload(req)).SerializeToString()
+
+    def _victim_visit(request: bytes, context) -> bytes:
+        req = solver_pb2.VictimVisitRequest.FromString(request)
+        return registry.visit(req).SerializeToString()
+
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     handler = grpc.method_handlers_generic_handler(SERVICE, {
         "Solve": grpc.unary_unary_rpc_method_handler(
             _solve_handler,
             request_deserializer=None,   # raw bytes in
             response_serializer=None),   # raw bytes out
+        "VictimUpload": grpc.unary_unary_rpc_method_handler(
+            _victim_upload, request_deserializer=None,
+            response_serializer=None),
+        "VictimVisit": grpc.unary_unary_rpc_method_handler(
+            _victim_visit, request_deserializer=None,
+            response_serializer=None),
     })
     server.add_generic_rpc_handlers((handler,))
     port = server.add_insecure_port(address)
