@@ -161,8 +161,15 @@ class AllocateAction(Action):
         import logging
 
         from ..rpc.client import get_solver_client
+        from ..rpc.victims_wire import breaker_open, trip_breaker
 
         addr = os.environ.get("KUBEBATCH_SOLVER_ADDR", "127.0.0.1:50061")
+        if breaker_open(addr):
+            # the sidecar failed recently (process-wide breaker shared
+            # with the victim path): go straight in-process, re-probe
+            # after the cooldown — a wedged sidecar must not stall every
+            # cycle on the rpc deadline
+            return False
         try:
             client = get_solver_client(addr)
             req, tasks_by_uid = client.snapshot_from_session(ssn)
@@ -173,6 +180,7 @@ class AllocateAction(Action):
             logging.getLogger("kubebatch").warning(
                 "solver sidecar %s unavailable (%s); running in-process",
                 addr, e)
+            trip_breaker(addr)
             return False
         try:
             resp = client.solve(req)
@@ -182,6 +190,7 @@ class AllocateAction(Action):
             logging.getLogger("kubebatch").warning(
                 "solver sidecar %s solve failed (%s); running in-process",
                 addr, e)
+            trip_breaker(addr)
             return False
         client.apply_decisions(ssn, resp, tasks_by_uid)
         return True

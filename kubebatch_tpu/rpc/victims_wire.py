@@ -54,16 +54,18 @@ def from_tensor(t: solver_pb2.Tensor) -> np.ndarray:
 
 class VictimRegistry:
     """Server-side store of uploaded victim states, keyed by state id.
-    Bounded: entries are per ACTION EXECUTION, so a small LRU covers the
-    live set; a stale id errors and the client re-uploads (the backend
-    retries once with a fresh upload before going local). Mutations are
+    Bounded LRU (a visit refreshes its entry's recency): entries are per
+    ACTION EXECUTION, so a small cap covers the live set; a stale id
+    errors and the client re-uploads (the backend retries once with a
+    fresh upload before going local). Registry AND entry mutations are
     lock-guarded — the gRPC server runs a thread pool."""
 
     MAX_STATES = 16
 
     def __init__(self):
+        import collections
         import threading
-        self._states: Dict[str, dict] = {}
+        self._states = collections.OrderedDict()
         self._lock = threading.Lock()
 
     def upload(self, req: solver_pb2.VictimUploadRequest) -> str:
@@ -87,7 +89,7 @@ class VictimRegistry:
         }
         with self._lock:
             while len(self._states) >= self.MAX_STATES:
-                self._states.pop(next(iter(self._states)), None)
+                self._states.popitem(last=False)
             self._states[state_id] = entry
         return state_id
 
@@ -97,16 +99,21 @@ class VictimRegistry:
 
         from ..kernels.victims import run_visit_kernel, run_wave_kernel
 
+        mut_in = (jax.device_put(tuple(from_tensor(t)
+                                       for t in req.mutable))
+                  if req.mutable else None)
         with self._lock:
             entry = self._states.get(req.state_id)
-        if entry is None:
-            raise KeyError(f"unknown victim state {req.state_id!r}")
-        if req.mutable:
-            entry["mut"] = jax.device_put(
-                tuple(from_tensor(t) for t in req.mutable))
-            entry["mut_version"] = req.mut_version
-        elif entry["mut"] is None or entry["mut_version"] != req.mut_version:
-            raise ValueError("mutable state out of sync; resend mirrors")
+            if entry is None:
+                raise KeyError(f"unknown victim state {req.state_id!r}")
+            self._states.move_to_end(req.state_id)    # LRU touch
+            if mut_in is not None:
+                entry["mut"] = mut_in
+                entry["mut_version"] = req.mut_version
+            elif entry["mut"] is None \
+                    or entry["mut_version"] != req.mut_version:
+                raise ValueError("mutable state out of sync; resend mirrors")
+            mut = entry["mut"]
         lanes = [from_tensor(t) for t in req.lanes]
         p_res, p_resreq, p_nz, p_sig, p_job, p_queue = lanes
         kw = dict(tiers=entry["tiers"],
@@ -117,11 +124,11 @@ class VictimRegistry:
                   room_check=entry["room_check"])
         start = time.perf_counter()
         if req.wave:
-            out = run_wave_kernel(entry["static"], entry["mut"],
+            out = run_wave_kernel(entry["static"], mut,
                                   entry["sig"], p_res, p_resreq, p_nz,
                                   p_sig, p_job, p_queue, **kw)
         else:
-            out = run_visit_kernel(entry["static"], entry["mut"],
+            out = run_visit_kernel(entry["static"], mut,
                                    entry["sig"], p_res, p_resreq, p_nz,
                                    p_sig.reshape(()), p_job.reshape(()),
                                    p_queue.reshape(()),
@@ -137,12 +144,29 @@ class VictimRegistry:
 # ---------------------------------------------------------------------
 
 #: process-wide circuit breaker: address -> monotonic deadline until
-#: which attach_remote refuses to re-attach (a wedged sidecar must not
+#: which rpc-mode callers (the victim attach AND allocate's Solve leg,
+#: actions/allocate.py) skip the sidecar (a wedged sidecar must not
 #: stall EVERY cycle for its timeouts — one failed action trips the
-#: breaker, later cycles go straight to the local kernels and re-probe
-#: after the cooldown)
+#: breaker, later cycles go straight to the in-process path and
+#: re-probe after the cooldown)
 _BROKEN: Dict[str, float] = {}
 _BREAKER_COOLDOWN_S = 60.0
+
+
+def breaker_open(address: str) -> bool:
+    """True while the address is inside its failure cooldown."""
+    until = _BROKEN.get(address)
+    if until is None:
+        return False
+    if time.monotonic() >= until:
+        del _BROKEN[address]
+        return False
+    return True
+
+
+def trip_breaker(address: str) -> None:
+    if address:
+        _BROKEN[address] = time.monotonic() + _BREAKER_COOLDOWN_S
 
 #: rpc deadlines: the sidecar is co-located — seconds mean it is wedged
 _UPLOAD_TIMEOUT_S = 10.0
@@ -240,9 +264,7 @@ class RemoteVictimBackend:
                     "victim sidecar call failed (%s); using local kernels",
                     e)
                 self._dead = True
-                if self.address:
-                    _BROKEN[self.address] = (time.monotonic()
-                                             + _BREAKER_COOLDOWN_S)
+                trip_breaker(self.address)
                 return None
         return None   # pragma: no cover — loop always returns
 
@@ -269,11 +291,8 @@ def attach_remote(solver, address: str) -> bool:
     can't be created or the address recently failed (process-wide
     breaker — a wedged sidecar must not stall every cycle on rpc
     timeouts; the breaker re-probes after the cooldown)."""
-    until = _BROKEN.get(address)
-    if until is not None:
-        if time.monotonic() < until:
-            return False
-        del _BROKEN[address]
+    if breaker_open(address):
+        return False
     try:
         from .client import get_solver_client
 
@@ -282,5 +301,5 @@ def attach_remote(solver, address: str) -> bool:
                                             address=address)
         return True
     except Exception:
-        _BROKEN[address] = time.monotonic() + _BREAKER_COOLDOWN_S
+        trip_breaker(address)
         return False

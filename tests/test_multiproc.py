@@ -1,0 +1,39 @@
+"""Dryrun stage 4: the multi-PROCESS mesh (VERDICT r4 directive 8).
+
+2 OS processes x 4 virtual CPU devices joined via
+``jax.distributed.initialize`` into one 8-device mesh; the sharded
+allocate kernel runs SPMD multi-controller and must produce decisions
+identical to the single-device reference — pinning the DCN recipe's
+process topology, not just its single-process GSPMD emulation.
+
+Runs the real launcher (tools/dryrun_multiproc.py) in subprocesses; a
+coordinator-init failure is an environment blocker, reported as a skip
+with the exact error (the documented-blocker path the directive allows).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_multiprocess_mesh_matches_single_device():
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "dryrun_multiproc.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)       # workers set their own device count
+    try:
+        # outer deadline ABOVE the launcher's own 300s worker deadline,
+        # so a wedge surfaces as the launcher's structured TIMEOUT exit,
+        # not an opaque TimeoutExpired here
+        proc = subprocess.run([sys.executable, tool], env=env,
+                              capture_output=True, text=True, timeout=390)
+    except subprocess.TimeoutExpired:
+        pytest.skip("jax.distributed wedged in this environment "
+                    "(launcher did not return) — documented blocker")
+    if proc.returncode != 0 and ("initialize" in proc.stderr
+                                 or "TIMEOUT" in proc.stderr):
+        pytest.skip(f"jax.distributed blocked in this environment: "
+                    f"{proc.stderr[-400:]}")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multiproc OK" in proc.stdout, proc.stdout

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Multi-PROCESS mesh dryrun — dryrun stage 4 (VERDICT r4 directive 8).
+
+The hierarchical 2-D hosts x nodes mesh (docs/SCALING.md "Multi-host
+(DCN)") was pinned single-process in round 4; this tool pins the PROCESS
+topology of the same recipe: 2 OS processes x 4 virtual CPU devices
+each, joined through ``jax.distributed.initialize`` into one 8-device
+global mesh, running the round engine's kernel SPMD multi-controller —
+the collectives that would ride DCN between hosts cross the process
+boundary here.
+
+    python tools/dryrun_multiproc.py             # launcher: spawns 2 workers
+    python tools/dryrun_multiproc.py --process-id N --coordinator H:P
+                                                 # worker (internal)
+
+The launcher compares both workers' (replicated) decision vectors to a
+single-process reference and exits non-zero on any divergence. The test
+suite runs this via tests/test_multiproc.py.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+N_PROCESSES = 2
+DEVICES_PER_PROCESS = 4
+
+
+def worker(process_id: int, coordinator: str) -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    # force the host platform with the per-process virtual device count
+    # BEFORE any jax import side effects (the environment's sitecustomize
+    # preloads jax pinned to the accelerator platform — a fresh process
+    # launched with PYTHONPATH cleared gets plain jax)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={DEVICES_PER_PROCESS}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=N_PROCESSES,
+                               process_id=process_id)
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert len(jax.devices()) == N_PROCESSES * DEVICES_PER_PROCESS, \
+        f"global device count {len(jax.devices())}"
+    assert len(jax.local_devices()) == DEVICES_PER_PROCESS
+
+    # hosts axis spans the PROCESSES (DCN), nodes axis the local devices
+    # (ICI) — the exact topology batched_sharded.node_mesh(n_hosts=2)
+    # models single-process
+    devs = np.array(jax.devices()).reshape(N_PROCESSES, DEVICES_PER_PROCESS)
+    mesh = Mesh(devs, ("hosts", "nodes"))
+
+    from kubebatch_tpu.kernels.sharded import build_sharded_allocate
+
+    # the explicit shard_map engine runs over the flattened device axis;
+    # node rows split across processes, so its per-step all-gather
+    # crosses the process boundary (the DCN leg)
+    flat_mesh = Mesh(devs.reshape(-1), ("nodes",))
+    run = build_sharded_allocate(flat_mesh)
+
+    n, t = 16, 8
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import __graft_entry__ as ge
+
+    args = ge._example_problem(n=n, t=t, seed=11)
+    specs = [P("nodes", None), P("nodes", None), P("nodes", None),
+             P("nodes"), P("nodes"), P("nodes"),
+             P(), P(), P(), P(None, "nodes"), P(None, "nodes"), P(), P()]
+
+    def put_global(host_arr, spec):
+        sharding = NamedSharding(flat_mesh, spec)
+        host_arr = np.asarray(host_arr)
+        return jax.make_array_from_callback(
+            host_arr.shape, sharding,
+            lambda idx: host_arr[idx])
+
+    placed = [put_global(a, s) for a, s in zip(args, specs)]
+    out = run(*placed)
+    # decisions are replicated (out_spec P()) — every process holds the
+    # full vector; the launcher cross-checks the two processes' copies
+    assert out[0].is_fully_replicated, out[0].sharding
+    decisions = np.asarray(out[0])
+    print(f"WORKER{process_id} DECISIONS {decisions.tolist()}",
+          flush=True)
+    jax.distributed.shutdown()
+    return 0
+
+
+def reference(seed=11, n=16, t=8):
+    """Single-process single-device decisions for the same problem."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import os, sys; sys.path.insert(0, %r)\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import __graft_entry__ as ge\n"
+        "from kubebatch_tpu.kernels.solver import _allocate_scan\n"
+        "args = ge._example_scan_args(n=%d, t=%d, seed=%d)\n"
+        "packed, *_ = _allocate_scan(*args)\n"
+        "packed = np.asarray(packed)\n"
+        "print('REF', packed[:%d].tolist())\n"
+        % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+           n, t, seed, t))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    if out.returncode != 0:
+        raise RuntimeError(f"reference failed: {out.stderr[-500:]}")
+    for line in out.stdout.splitlines():
+        if line.startswith("REF "):
+            return eval(line[4:])   # list literal from our own subprocess
+    raise RuntimeError(f"no REF line in: {out.stdout!r}")
+
+
+def launch() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+    here = os.path.abspath(__file__)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""          # skip the sitecustomize axon pin
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, here, "--process-id", str(i),
+             "--coordinator", coordinator],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for i in range(N_PROCESSES)
+    ]
+    deadline = time.time() + 300
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=max(10, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            print("TIMEOUT waiting for workers", file=sys.stderr)
+            return 2
+        outs.append((p.returncode, out, err))
+    decisions = []
+    for rc, out, err in outs:
+        if rc != 0:
+            print(f"worker failed rc={rc}\n{err[-2000:]}", file=sys.stderr)
+            return 1
+        for line in out.splitlines():
+            if " DECISIONS " in line:
+                decisions.append(eval(line.split(" DECISIONS ", 1)[1]))
+    if len(decisions) != N_PROCESSES:
+        print(f"expected {N_PROCESSES} decision vectors, got "
+              f"{len(decisions)}", file=sys.stderr)
+        return 1
+    if decisions[0] != decisions[1]:
+        print(f"process decision mismatch: {decisions}", file=sys.stderr)
+        return 1
+    ref = reference()
+    if decisions[0] != ref:
+        print(f"multi-process decisions {decisions[0]} != single-device "
+              f"reference {ref}", file=sys.stderr)
+        return 1
+    print(f"dryrun_multiproc OK: {N_PROCESSES} processes x "
+          f"{DEVICES_PER_PROCESS} devices, decisions == single-device "
+          f"reference {ref}")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--coordinator", default=None)
+    args = ap.parse_args()
+    if args.process_id is None:
+        return launch()
+    return worker(args.process_id, args.coordinator)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
