@@ -93,7 +93,7 @@ def ensure_responsive_backend(timeout: float = 120.0) -> str:
 
 
 #: per-config action order (BASELINE.md scenarios; cfg4/cfg5 use the
-#: shipped config/kube-batch-conf.yaml order). "2p"/"5p" are the
+#: shipped config/kube-batch-conf.yaml order). "2p"/"3p"/"5p" are the
 #: predicate-rich variants (labels/taints/selectors/affinity/ports at
 #: workload-ish fractions — sim/cluster.py BASELINE_SPECS).
 CONFIG_ACTIONS = {
@@ -103,6 +103,7 @@ CONFIG_ACTIONS = {
     4: ("reclaim", "allocate", "backfill", "preempt"),
     5: ("reclaim", "allocate", "backfill", "preempt"),
     "2p": ("allocate",),
+    "3p": ("allocate", "backfill"),
     "5p": ("reclaim", "allocate", "backfill", "preempt"),
 }
 
@@ -346,10 +347,10 @@ def main(argv=None):
                "emitted line is also appended (with timestamp + git SHA) "
                "to BENCH_DEVICE.jsonl, the committed evidence file.")
     ap.add_argument("--config", default="5",
-                    choices=["1", "2", "3", "4", "5", "2p", "5p"],
+                    choices=["1", "2", "3", "4", "5", "2p", "3p", "5p"],
                     help="BASELINE config number (default: the 10k pods x "
                          "5k nodes stress config — BASELINE.md's primary "
-                         "metric); 2p/5p = predicate-rich variants")
+                         "metric); 2p/3p/5p = predicate-rich variants")
     # default sized so the primary metric carries >= 5 measured cycles
     # (the first cycle pays jit and is excluded)
     ap.add_argument("--cycles", type=int, default=6)

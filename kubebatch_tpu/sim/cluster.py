@@ -371,12 +371,19 @@ BASELINE_SPECS: Dict[int, ClusterSpec] = {
 #: predicate-rich variants (VERDICT r4 directive 3): same scale as the
 #: base configs, with node labels/taints, selectors, tolerations, both
 #: affinity kinds, preferred co-location scores, and host ports at
-#: real-workload-ish fractions. "2p"/"5p" on the bench CLI.
+#: real-workload-ish fractions. "2p"/"3p"/"5p" on the bench CLI.
 BASELINE_SPECS["2p"] = ClusterSpec(
     n_nodes=50, n_groups=100, pods_per_group=8,
     n_zones=4, selector_frac=0.15, taint_frac=0.1, toleration_frac=0.15,
     anti_affinity_frac=0.08, zone_affinity_frac=0.06,
     pref_affinity_frac=0.08, hostport_frac=0.05)
+BASELINE_SPECS["3p"] = ClusterSpec(
+    n_nodes=500, n_groups=1000, pods_per_group=4,
+    n_queues=4, queue_weights=(1, 2, 3, 4),
+    pod_cpu_millis=800, pod_mem_bytes=GiB,
+    n_zones=8, selector_frac=0.15, taint_frac=0.1, toleration_frac=0.15,
+    anti_affinity_frac=0.08, zone_affinity_frac=0.05,
+    pref_affinity_frac=0.08, hostport_frac=0.04)
 BASELINE_SPECS["5p"] = ClusterSpec(
     n_nodes=5000, n_groups=1250, pods_per_group=8,
     n_queues=4, queue_weights=(1, 2, 3, 4),
