@@ -207,6 +207,220 @@ def _candidates(ssn) -> List[TaskInfo]:
     return out
 
 
+class SessionAffinityMasks:
+    """Exact per-preemptor affinity + host-port node masks for the
+    VICTIM path (preempt/reclaim) — evaluated against the session's
+    CURRENT assignments with the same pair/domain-count machinery the
+    batched engine carries on device, but host-side numpy: affinity
+    never filters VICTIMS (no tier fn reads it — session_plugins.go
+    victim dispatch), it only gates the preemptor's node choice
+    (predicates.go:47-104,146,188 inside preempt/reclaim's per-node
+    predicate), so a [N] mask per (task, epoch) is the whole cost.
+
+    Epoch discipline: counts rebuild lazily whenever the session fires
+    an allocate/deallocate event (same invalidation the predicates
+    plugin's candidate memo uses) — evictions move candidates to
+    RELEASING but keep them on the node, so the rebuilt counts match
+    what the host predicate would see mid-action.
+
+    ``supported`` is False when the pending set exceeds the pair/port
+    caps — callers fall back to the host path exactly as before."""
+
+    def __init__(self, ssn, pending: Sequence[TaskInfo]):
+        from ..framework import EventHandler
+
+        self._ssn = ssn
+        self._epoch = 0
+        self._built_epoch = -1
+        self._mask_memo: Dict[Tuple[str, int], np.ndarray] = {}
+        self.supported = affinity_within_vocabulary(ssn, pending)
+        if not self.supported:
+            return
+
+        def _bump(event):
+            self._epoch += 1
+
+        ssn.add_event_handler(EventHandler(allocate_func=_bump,
+                                           deallocate_func=_bump,
+                                           owner="predicates"))
+        # pair space over the PENDING tasks' own terms + existing
+        # carriers' anti terms (scores don't gate nodes — skip them)
+        self._pairs = _PairSpace()
+        #: (label-sig, ns) -> membership row; valid while the pair space
+        #: hasn't grown (pipelined preemptors carrying new terms grow it)
+        self._member_memo: Dict[Tuple, np.ndarray] = {}
+        self._memo_pairs = 0
+        self._task_terms: Dict[str, tuple] = {}
+        for t in pending:
+            aff = t.pod.affinity
+            if aff is None and not t.pod.host_ports():
+                continue
+            req = anti = ()
+            if aff is not None:
+                req = tuple(
+                    (self._pairs.add(term, t.pod), term, t.pod)
+                    for term in aff.pod_affinity_required)
+                anti = tuple(self._pairs.add(term, t.pod)
+                             for term in aff.pod_anti_affinity_required)
+            self._task_terms[t.uid] = (req, anti,
+                                       tuple(t.pod.host_ports()))
+        self._cand_anti: list = []      # filled per rebuild
+
+    def _node_axis(self):
+        ssn = self._ssn
+        names = list(ssn.nodes)
+        index = {n: i for i, n in enumerate(names)}
+        return names, index
+
+    def _rebuild(self) -> None:
+        ssn = self._ssn
+        self._mask_memo.clear()
+        names, index = self._node_axis()
+        self._names = names
+        n = len(names)
+        cands = _candidates(ssn)
+        # existing carriers' required anti terms join the pair space
+        # (symmetry); new label shapes can add pairs — the space is
+        # grow-only within the action
+        cand_anti = []
+        for t in cands:
+            pod = t.pod
+            if pod.has_pod_affinity() and pod.affinity is not None:
+                for term in pod.affinity.pod_anti_affinity_required:
+                    cand_anti.append((self._pairs.add(term, pod), t))
+        p_cnt = max(1, len(self._pairs))
+        node_dom = np.full((p_cnt, n), -1, np.int32)
+        key_dom: Dict[str, np.ndarray] = {}
+        for p, key in enumerate(self._pairs.keys):
+            topo = key[2]
+            col = key_dom.get(topo)
+            if col is None:
+                col = np.full(n, -1, np.int32)
+                values: Dict[str, int] = {}
+                for i, name in enumerate(names):
+                    ni = ssn.nodes.get(name)
+                    if ni is None or ni.node is None:
+                        continue
+                    v = ni.node.labels.get(topo)
+                    if v is not None:
+                        col[i] = values.setdefault(v, len(values))
+                key_dom[topo] = col
+            node_dom[p] = col
+        d_cap = n + 1
+        grp_cnt = np.zeros((p_cnt, d_cap), np.int32)
+        grp_total = np.zeros(p_cnt, np.int64)
+        anti_cnt = np.zeros((p_cnt, d_cap), np.int32)
+        if self._memo_pairs != len(self._pairs):
+            self._member_memo.clear()
+            self._memo_pairs = len(self._pairs)
+
+        def membership(pod):
+            sig = (tuple(sorted(pod.labels.items())), pod.namespace)
+            row = self._member_memo.get(sig)
+            if row is None:
+                row = np.fromiter(
+                    (_member(k, pod) for k in self._pairs.keys), bool,
+                    count=len(self._pairs))
+                self._member_memo[sig] = row
+            return row
+
+        for t in cands:
+            row = membership(t.pod)
+            if row.any():
+                grp_total[:len(row)] += row
+                col = index.get(t.node_name)
+                if col is not None:
+                    doms = node_dom[:len(row), col]
+                    ok = row & (doms >= 0)
+                    grp_cnt[np.flatnonzero(ok), doms[ok]] += 1
+        for p, t in cand_anti:
+            col = index.get(t.node_name)
+            if col is not None:
+                d = node_dom[p, col]
+                if d >= 0:
+                    anti_cnt[p, d] += 1
+        # ports actually used per node (only referenced ports matter,
+        # but the per-node walk is over candidate tasks anyway)
+        used_ports: Dict[int, set] = {}
+        for name, ni in ssn.nodes.items():
+            col = index[name]
+            ports = set()
+            for t in ni.tasks.values():
+                ports.update(t.pod.host_ports())
+            if ports:
+                used_ports[col] = ports
+        self._node_dom = node_dom
+        self._grp_cnt = grp_cnt
+        self._grp_total = grp_total
+        self._anti_cnt = anti_cnt
+        self._used_ports = used_ports
+        self._cand_anti = cand_anti
+        self._built_epoch = self._epoch
+
+    def node_mask(self, task: TaskInfo, device) -> Optional[np.ndarray]:
+        """[N_pad] bool over the DEVICE node columns: True = the
+        affinity/port predicates allow the node. None = no constraint
+        for this task (all-true)."""
+        if not self.supported:
+            return None
+        if self._built_epoch != self._epoch:
+            self._rebuild()
+        terms = self._task_terms.get(task.uid)
+        pod = task.pod
+        # symmetry applies to EVERY task (even without own terms) when
+        # anti carriers exist
+        if terms is None and not self._cand_anti:
+            return None
+        key = (task.uid, self._built_epoch)
+        got = self._mask_memo.get(key)
+        if got is not None:
+            return got
+        n = len(self._names)
+        ok = np.ones(n, bool)
+        node_dom = self._node_dom
+        req, anti, ports = terms if terms is not None else ((), (), ())
+        for p, term, owner in req:
+            doms = node_dom[p]
+            cnt = np.where(doms >= 0,
+                           self._grp_cnt[p][np.maximum(doms, 0)], 0)
+            present = cnt > 0
+            if not self._grp_total[p]:
+                # first-pod bootstrap: self-matching term passes anywhere
+                if term.selects(pod) and pod.namespace in _ns_key(term,
+                                                                  owner):
+                    continue
+            ok &= present
+        for p in anti:
+            doms = node_dom[p]
+            cnt = np.where(doms >= 0,
+                           self._grp_cnt[p][np.maximum(doms, 0)], 0)
+            ok &= ~(cnt > 0)
+        # symmetry: existing carriers' anti terms that select THIS pod —
+        # per unique PAIR (the mask depends only on p; many carriers of
+        # one term would repeat identical full-array work otherwise)
+        for p in {p for p, _t in self._cand_anti}:
+            pkey = self._pairs.keys[p]
+            if _member(pkey, pod):
+                doms = node_dom[p]
+                acnt = np.where(doms >= 0,
+                                self._anti_cnt[p][np.maximum(doms, 0)], 0)
+                ok &= ~(acnt > 0)
+        if ports:
+            want = set(ports)
+            for col, used in self._used_ports.items():
+                if want & used:
+                    ok[col] = False
+        # map session-node order onto the device's padded columns
+        n_pad = device.n_padded
+        out = np.zeros(n_pad, bool)
+        for i, name in enumerate(self._names):
+            col = device.node_index(name)
+            if col is not None:
+                out[col] = ok[i]
+        self._mask_memo[key] = out
+        return out
+
+
 def build_affinity_inputs(ssn, tasks: Sequence[TaskInfo], device,
                           t_pad: int) -> Optional[AffinityInputs]:
     """Encode the snapshot's affinity/port features, or None when they

@@ -22,8 +22,11 @@ Dynamic terms are NOT encoded here:
 - inter-pod (anti-)affinity and host-port conflicts depend on in-cycle
   assignments; `dynamic_features` detects them. The BATCHED engine
   carries them as domain-count tensors in its round state
-  (kernels/affinity.py); the per-visit/fused/victim solvers fall back
-  to the host path on them (actions/allocate.py, kernels/victims.py).
+  (kernels/affinity.py); the VICTIM solvers keep their device kernels
+  and apply an exact host-side node mask at choice time
+  (affinity.SessionAffinityMasks — the features only gate the
+  preemptor's node, never the victims); the per-visit/fused allocate
+  engines fall back to the host path on them (actions/allocate.py).
 """
 from __future__ import annotations
 

@@ -81,8 +81,12 @@ def device_supported(ssn, pending: Sequence[TaskInfo],
     ``allow_affinity``: the batched engine carries inter-pod affinity and
     host ports in its round state (kernels/affinity.py) — its builder
     passes True and the dynamic-feature check is skipped (the affinity
-    encoder still falls back past its own vocabulary caps). The per-visit
-    and victim solvers keep the strict default."""
+    encoder still falls back past its own vocabulary caps). The victim
+    solvers also pass True and apply an exact host-side node mask at
+    choice time (affinity.SessionAffinityMasks; scoring actions with
+    nodeorder active still fall back — the interpod score term is
+    allocation-dependent). The per-visit/fused allocate paths keep the
+    strict default."""
     from ..cache.interface import NullVolumeBinder
 
     # a real volume binder makes placement feasibility depend on per-node

@@ -1262,6 +1262,12 @@ class VictimSolver:
         #: None = local kernels. Remote calls fall back to local per
         #: dispatch (the analysis is pure)
         self.remote = None
+        #: exact affinity/port node masks for snapshots carrying those
+        #: features (kernels/affinity.SessionAffinityMasks) — folded
+        #: into the visited mask per visit, so the kernels stay
+        #: affinity-blind while the node CHOICE honors the predicate
+        self.aff_masks = None
+        self._aff_device = None
         #: wave state
         self.pending = list(pending)
         self._pos = {t.uid: i for i, t in enumerate(self.pending)}
@@ -1351,6 +1357,14 @@ class VictimSolver:
     # ------------------------------------------------------------------
     def visit(self, task: TaskInfo, filter_kind: str,
               visited: np.ndarray) -> VisitResult:
+        if self.aff_masks is not None:
+            # fold the exact affinity/port node mask into the visited
+            # set: the analysis kernels stay affinity-blind, the CHOICE
+            # excludes predicate-failing nodes — same node the host
+            # oracle's predicate_fn walk would reach
+            mask = self.aff_masks.node_mask(task, self._aff_device)
+            if mask is not None:
+                visited = visited | ~mask
         key = (filter_kind, task.uid)
         # a prefetched lane answers regardless of the escalation gate —
         # it was dispatched precisely so this visit needn't pay a kernel
@@ -1691,8 +1705,38 @@ def build_victim_solver(ssn, pending: Sequence[TaskInfo],
             tiers.append(members)
     if any(name not in KNOWN for name in ssn.victim_veto_fns):
         return None
-    if not device_supported(ssn, pending):
+    # affinity/host ports only gate the PREEMPTOR's node choice in the
+    # victim actions (no tier fn reads them) — the device analysis stays
+    # valid with an exact host-side node mask applied at choice time
+    # (kernels/affinity.SessionAffinityMasks); other dynamic features
+    # (a real volume binder, custom plugins) still take the host path
+    if not device_supported(ssn, pending, allow_affinity=True):
         return None
+    from .terms import _active
+    pred_active = bool(_active(ssn, ssn.predicate_fns,
+                               "predicate_disabled"))
+    order_active = bool(_active(ssn, ssn.node_order_fns,
+                                "node_order_disabled"))
+    aff_masks = None
+    if pred_active or order_active:
+        from .encode import dynamic_features
+        if dynamic_features(ssn, pending) is not None:
+            if score_nodes and order_active:
+                # a SCORING action (preempt) with nodeorder active:
+                # the interpod score term is allocation-dependent and
+                # the kernels don't model it — node choice would
+                # diverge from the host oracle's node_order_fn sum
+                # (nodeorder.go:305-313). Host path.
+                return None
+            if not pred_active:
+                # only the score side referenced affinity and this
+                # action doesn't score: nothing to mask
+                pass
+            else:
+                from .affinity import SessionAffinityMasks
+                aff_masks = SessionAffinityMasks(ssn, pending)
+                if not aff_masks.supported:
+                    return None
     if ssn.device_snapshot is None:
         mk = getattr(ssn.cache, "device_session", None)
         ssn.device_snapshot = (mk(ssn) if mk is not None
@@ -1707,13 +1751,13 @@ def build_victim_solver(ssn, pending: Sequence[TaskInfo],
         ssn, node_index=ns.index, n_pad=ns.n_padded,
         node_ok=ns.schedulable & ns.valid, max_task_num=ns.max_task_num,
         allocatable_cm=ns.allocatable[:, :2])
-    pred_active = any(
-        not opt.predicate_disabled and opt.name in ssn.predicate_fns
-        for tier in ssn.tiers for opt in tier.plugins)
     solver = VictimSolver(
         state, terms, names=ns.names, tiers=tuple(tiers),
         veto_critical="conformance" in ssn.victim_veto_fns,
         score_nodes=score_nodes, room_check=pred_active, pending=pending)
+    if aff_masks is not None:
+        solver.aff_masks = aff_masks
+        solver._aff_device = device
     if os.environ.get("KUBEBATCH_SOLVER", "") == "rpc":
         # route the victim analysis through the solver sidecar — the
         # full 4-action remote cycle (scheduler.go:88-105 runs every
