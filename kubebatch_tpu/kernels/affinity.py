@@ -55,6 +55,16 @@ MAX_PORTS = 64
 #: (imported lazily in build to avoid a plugins<->kernels import cycle)
 
 
+#: AffinityInputs array-field order on the rpc wire (solver.proto
+#: SnapshotRequest.affinity) — ONE definition imported by both the
+#: client encoder and the server decoder; several fields share shape and
+#: dtype, so a skew would pass every structural check and misplace pods
+WIRE_FIELDS = ("node_dom", "task_grp", "task_req_aff", "task_req_anti",
+               "task_self_ok", "task_carry_w", "task_pref_w",
+               "task_ports", "port_base", "grp_cnt0", "anti_cnt0",
+               "pref_w0", "grp_total0")
+
+
 @dataclass
 class AffinityInputs:
     """Everything the batched kernel needs for affinity/ports, numpy."""

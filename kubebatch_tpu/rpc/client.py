@@ -147,12 +147,45 @@ class SolverClient:
                       tasks_by_uid: Dict[str, object]) -> None:
         """Encode the predicate/score terms (kernels/terms) into the wire
         payload. Raises ValueError for snapshots whose callbacks the
-        kernels cannot express (inter-pod affinity, host ports, custom
-        plugins) — silent divergence is worse than an error."""
+        kernels cannot express (custom plugins, a real volume binder,
+        over-cap affinity vocabularies, small affinity snapshots the
+        in-process host path should keep) — silent divergence is worse
+        than an error."""
+        from ..kernels.affinity import (affinity_features_present,
+                                        affinity_within_vocabulary,
+                                        build_affinity_inputs)
+        from ..kernels.terms import device_supported
+
         pending = list(tasks_by_uid.values())
         state = NodeState.from_nodes(ssn.nodes)
-        terms = solver_terms(ssn, _StateShim(state), pending)
-        if terms is None:
+        if not device_supported(ssn, pending, allow_affinity=True):
+            raise ValueError(
+                "session predicates/score callbacks exceed the sidecar "
+                "solver's vocabulary; run allocate in-process")
+        if affinity_features_present(ssn, pending):
+            # only the batched engine carries the affinity vocabulary;
+            # below the batched threshold the in-process path (fused ->
+            # host fallback, bind-exact) should keep the cycle
+            from ..actions.allocate import AUTO_BATCHED_MIN
+            if len(pending) < AUTO_BATCHED_MIN:
+                raise ValueError(
+                    "affinity snapshot below the batched threshold; "
+                    "run allocate in-process")
+            if not affinity_within_vocabulary(ssn, pending):
+                raise ValueError(
+                    "affinity vocabulary exceeds the caps; run allocate "
+                    "in-process")
+            aff = build_affinity_inputs(ssn, pending, _StateShim(state),
+                                        t_pad=len(pending))
+            from ..kernels.affinity import WIRE_FIELDS
+            from .victims_wire import to_tensor
+            for name in WIRE_FIELDS:
+                req.affinity.append(to_tensor(getattr(aff, name)))
+            req.affinity_ip_weight = aff.ip_weight
+            req.affinity_ip_enabled = aff.ip_enabled
+        terms = solver_terms(ssn, _StateShim(state), pending,
+                             assume_supported=True)
+        if terms is None:   # pragma: no cover — gated above
             raise ValueError(
                 "session predicates/score callbacks exceed the sidecar "
                 "solver's vocabulary; run allocate in-process")

@@ -157,11 +157,18 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
     if len(jobs.allocated):
         j_alloc0[:j] = _mat(jobs.allocated, j)
 
-    # ---- engine selection by snapshot size (in-process auto parity) ----
+    # ---- affinity payload (batched engine only) ------------------------
+    affinity = None
+    if len(req.affinity):
+        affinity = _affinity_from_wire(req, n_pad, t_pad)
+
+    # ---- engine selection by snapshot size (in-process auto parity);
+    # affinity snapshots always take the round engine — it alone carries
+    # the vocabulary (the client refuses small affinity snapshots) ------
     from ..actions.allocate import AUTO_BATCHED_MIN
-    if t >= AUTO_BATCHED_MIN:
+    if t >= AUTO_BATCHED_MIN or affinity is not None:
         return _solve_batched_wire(
-            req, nodes, tasks, n, t,
+            req, nodes, tasks, n, t, affinity=affinity,
             idle=idle, releasing=releasing, backfilled=backfilled,
             mtn=mtn, ntasks=ntasks, node_ok=node_ok,
             resreq=resreq, init_resreq=init_resreq, task_job=task_job,
@@ -216,6 +223,59 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
     return resp
 
 
+def _affinity_from_wire(req, n_pad: int, t_pad: int):
+    """Rebuild kernels/affinity.AffinityInputs from the wire tensors,
+    padding the node/task axes to the server's buckets. Field order is
+    the shared kernels/affinity.WIRE_FIELDS constant — the client
+    encodes with the same one."""
+    from ..kernels.affinity import WIRE_FIELDS, AffinityInputs
+    from .victims_wire import from_tensor
+
+    if len(req.affinity) != len(WIRE_FIELDS):
+        raise ValueError(
+            f"affinity payload carries {len(req.affinity)} tensors, "
+            f"expected {len(WIRE_FIELDS)}")
+    by_name = dict(zip(WIRE_FIELDS, (from_tensor(x)
+                                     for x in req.affinity)))
+    (node_dom, task_grp, task_req_aff, task_req_anti, task_self_ok,
+     task_carry_w, task_pref_w, task_ports, port_base,
+     grp_cnt0, anti_cnt0, pref_w0, grp_total0) = (
+        by_name[f] for f in WIRE_FIELDS)
+
+    def pad_rows(a, rows, fill=0):
+        if a.shape[0] == rows:
+            return a
+        out = np.full((rows,) + a.shape[1:], fill, a.dtype)
+        out[:a.shape[0]] = a
+        return out
+
+    def pad_cols(a, cols, fill=0):
+        if a.shape[1] == cols:
+            return a
+        out = np.full((a.shape[0], cols), fill, a.dtype)
+        out[:, :a.shape[1]] = a
+        return out
+
+    # D axis (domain counts) must match the padded node axis the kernels
+    # use (build_affinity_inputs sets D = n_pad)
+    return AffinityInputs(
+        node_dom=pad_cols(node_dom, n_pad, fill=-1),
+        task_grp=pad_rows(task_grp, t_pad),
+        task_req_aff=pad_rows(task_req_aff, t_pad),
+        task_req_anti=pad_rows(task_req_anti, t_pad),
+        task_self_ok=pad_rows(task_self_ok, t_pad),
+        task_carry_w=pad_rows(task_carry_w, t_pad),
+        task_pref_w=pad_rows(task_pref_w, t_pad),
+        task_ports=pad_rows(task_ports, t_pad),
+        port_base=pad_rows(port_base, n_pad),
+        grp_cnt0=pad_cols(grp_cnt0, n_pad),
+        anti_cnt0=pad_cols(anti_cnt0, n_pad),
+        pref_w0=pad_cols(pref_w0, n_pad),
+        grp_total0=grp_total0.astype(np.float32),
+        ip_weight=float(req.affinity_ip_weight),
+        ip_enabled=bool(req.affinity_ip_enabled))
+
+
 class _WireDevice:
     """DeviceSession stand-in for the sidecar: just the capacity arrays
     solve_batched reads and commits (no cross-cycle reuse server-side —
@@ -242,7 +302,8 @@ def _solve_batched_wire(req, nodes, tasks, n, t, *, idle, releasing,
                         job_create_rank, job_valid, q_weight, q_entries,
                         q_create_rank, q_deserved, q_alloc0, j_alloc0,
                         cluster_total, dyn_weights, dyn_enabled, job_keys,
-                        queue_keys) -> solver_pb2.DecisionsResponse:
+                        queue_keys,
+                        affinity=None) -> solver_pb2.DecisionsResponse:
     """Round-engine path: rebuild CycleInputs from the wire arrays and
     run the same solve_batched the in-process batched mode uses."""
     from ..actions.cycle_inputs import CycleInputs
@@ -267,6 +328,7 @@ def _solve_batched_wire(req, nodes, tasks, n, t, *, idle, releasing,
         job_keys=job_keys, queue_keys=queue_keys,
         gang_enabled=req.gang_enabled,
         prop_overused=req.proportion_enabled,
+        affinity=affinity,
         # strictly-positive like the in-process derivation
         # (cycle_inputs.py pipe_enabled) — negative releasing rows
         # (pipelined reuse) must not enable the pipeline path

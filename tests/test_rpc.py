@@ -354,3 +354,63 @@ def test_victim_remote_falls_back_on_dead_sidecar(monkeypatch):
     monkeypatch.delenv("KUBEBATCH_SOLVER_ADDR")
     baseline = _full_cycle(cache_b)
     assert local == baseline
+
+
+def mk_big_affinity_cluster():
+    """mk_big_cluster plus anti-affinity / zone-affinity / host-port
+    groups — the snapshot must ship the affinity vocabulary over the
+    wire and solve through the sidecar's round engine."""
+    from kubebatch_tpu.objects import Affinity, PodAffinityTerm
+
+    binder = RecordingBinder()
+    cache = SchedulerCache(binder=binder, async_writeback=False)
+    cache.add_queue(build_queue("q1", 1))
+    cache.add_queue(build_queue("q2", 3))
+    for i in range(120):
+        cache.add_node(build_node(
+            f"n{i:03d}", rl(8000, 16 * GiB, pods=110),
+            labels={"zone": f"z{i % 4}"}))
+    for g in range(250):
+        q = "q1" if g % 2 == 0 else "q2"
+        cache.add_pod_group(build_group("ns", f"pg{g:03d}", 3, queue=q,
+                                        creation_timestamp=float(g)))
+        app = f"app-{g % 12}"
+        for p in range(4):
+            pod = build_pod(
+                "ns", f"g{g:03d}-p{p}", "", PodPhase.PENDING,
+                rl(500 + (g % 5) * 100, GiB), group=f"pg{g:03d}",
+                priority=(g % 3) + 1, labels={"app": app},
+                creation_timestamp=float(g * 10 + p))
+            if g % 10 == 0:
+                pod.affinity = Affinity(pod_anti_affinity_required=[
+                    PodAffinityTerm(match_labels={"app": app})])
+            elif g % 10 == 1:
+                pod.affinity = Affinity(pod_affinity_required=[
+                    PodAffinityTerm(match_labels={"app": app},
+                                    topology_key="zone")])
+            elif g % 10 == 2:
+                pod.containers[0].ports = [31000 + g % 8]
+            cache.add_pod(pod)
+    return cache, binder
+
+
+def test_sidecar_solves_affinity_snapshot(sidecar):
+    """The Solve leg carries the affinity vocabulary (r5): a 1000-task
+    predicate-rich snapshot solves remotely through the round engine
+    with the same session end state as the in-process batched mode."""
+    results = {}
+    for path in ("rpc", "batched"):
+        cache, binder = mk_big_affinity_cluster()
+        ssn = OpenSession(cache, full_tiers())
+        if path == "rpc":
+            resp = sidecar.solve_and_apply(ssn)
+            assert resp.iterations < 128, resp.iterations
+        else:
+            AllocateAction(mode="batched").execute(ssn)
+        state = {t.key: (str(t.status), t.node_name)
+                 for job in ssn.jobs.values() for t in job.tasks.values()}
+        CloseSession(ssn)
+        results[path] = (state, dict(binder.binds))
+    assert len(results["batched"][1]) > 500
+    assert results["rpc"][0] == results["batched"][0]
+    assert results["rpc"][1] == results["batched"][1]
