@@ -61,8 +61,12 @@ class NodeInfo:
         res.used = self.used.clone()
         res.backfilled = self.backfilled.clone()
         res.idle = self.idle.clone()
-        res.allocatable = self.allocatable.clone()
-        res.capability = self.capability.clone()
+        # allocatable/capability are REPLACE-ONLY (set_node assigns fresh
+        # Resource objects; no code path calls add/sub on them — grep
+        # before changing that), so clones share the objects: two fewer
+        # Resource allocations per node per snapshot
+        res.allocatable = self.allocatable
+        res.capability = self.capability
         res.tasks = self.tasks
         res._tasks_shared = True
         self._tasks_shared = True
