@@ -1,0 +1,84 @@
+"""Multi-cycle churn over a predicate-rich cluster THROUGH the batched
+engine — the affinity carry's commit/rollback arithmetic must stay
+consistent across cycles (counts are rebuilt per cycle from the cache,
+so corruption shows up as invalid placements, not drift), and every
+cycle's final state must satisfy the reference predicate semantics
+(tests/test_affinity_device._validate_final_state).
+
+This is the affinity analogue of tests/test_churn.py: the churn deletes
+bound gangs and arrives fresh predicate-carrying gangs (the sim rolls
+the same group templates), the engine runs every cycle (asserted — no
+silent host fallback), and debug.audit_cache pins the cache identities
+at every cycle boundary.
+"""
+import dataclasses
+
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate_batched import execute_batched
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.debug import audit_cache
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import PodPhase
+from kubebatch_tpu.sim import ClusterSpec, build_cluster
+
+from .test_affinity_device import _validate_final_state
+
+GiB = 1024 ** 3
+
+SPEC = ClusterSpec(n_nodes=48, n_groups=40, pods_per_group=4,
+                   min_member=4, n_queues=2, queue_weights=(1, 2),
+                   node_cpu_millis=8000, node_mem_bytes=16 * GiB,
+                   pod_cpu_millis=900, pod_mem_bytes=GiB, seed=9,
+                   n_zones=4, selector_frac=0.1, taint_frac=0.08,
+                   toleration_frac=0.12, anti_affinity_frac=0.15,
+                   zone_affinity_frac=0.08, pref_affinity_frac=0.08,
+                   hostport_frac=0.08)
+
+
+@pytest.mark.parametrize("seed", [9, 21])
+def test_affinity_churn_cycles_stay_valid(seed):
+    spec = dataclasses.replace(SPEC, seed=seed)
+    sim = build_cluster(spec)
+    binds = {}
+    fresh = []
+
+    class _B:
+        def bind(self, pod, hostname):
+            binds[f"{pod.namespace}/{pod.name}"] = hostname
+            pod.node_name = hostname
+            fresh.append(pod)
+
+        def evict(self, pod):
+            pod.deletion_timestamp = 1.0
+
+    cache = SchedulerCache(binder=_B(), evictor=_B(),
+                           async_writeback=False)
+    sim.populate(cache)
+    tiers = shipped_tiers()
+
+    churn_bound = 0
+    for cycle in range(6):
+        for pod in fresh:
+            if pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                cache.update_pod(pod, pod)
+        fresh.clear()
+        if cycle >= 1:
+            sim.churn_tick(cache, 16)
+        before = len(binds)
+        ssn = OpenSession(cache, tiers)
+        ran = execute_batched(ssn)
+        CloseSession(ssn)
+        assert ran == "batched", f"cycle {cycle} fell off the engine"
+        problems = audit_cache(cache)
+        assert not problems, f"cycle {cycle} cache audit: {problems}"
+        _validate_final_state(cache, binds)
+        if cycle >= 1:
+            # only the CHURN cycles count — cycle 0's full-cluster
+            # placement alone must not satisfy the progress guard
+            churn_bound += len(binds) - before
+    assert churn_bound >= 40, \
+        f"churn cycles must keep scheduling: {churn_bound}"
