@@ -358,8 +358,40 @@ def _solve_handler(request: bytes, context) -> bytes:
 
 def make_server(address: str = "127.0.0.1:0",
                 max_workers: int = 4) -> tuple:
-    """Returns (grpc.Server, bound_port)."""
+    """Returns (grpc.Server, bound_port).
+
+    Handler threads get a 64 MB stack: XLA/LLVM compilation of the big
+    round-engine graphs recurses deeply, and on the default 8 MB pool
+    thread stack a first-compile inside a handler segfaulted (observed
+    on the affinity-variant graph mid-suite, r5). threading.stack_size
+    is process-global for threads started AFTERWARDS, so the pool's
+    workers are pre-spawned deterministically under the raised value
+    and the previous setting is restored before returning — threads the
+    embedding process creates later are unaffected."""
+    import threading
+
     from .victims_wire import VictimRegistry
+
+    executor = futures.ThreadPoolExecutor(max_workers=max_workers)
+    try:
+        prev_stack = threading.stack_size(64 * 1024 * 1024)
+    except (ValueError, RuntimeError):   # platform minimum/denied: keep
+        prev_stack = None
+    try:
+        # force the executor to create every worker NOW (it spawns
+        # lazily per submit): park them all on a barrier
+        barrier = threading.Barrier(max_workers + 1)
+        waiters = [executor.submit(barrier.wait)
+                   for _ in range(max_workers)]
+        barrier.wait(timeout=30)
+        for w in waiters:
+            w.result(timeout=30)
+    finally:
+        if prev_stack is not None:
+            try:
+                threading.stack_size(prev_stack)
+            except (ValueError, RuntimeError):  # pragma: no cover
+                pass
 
     registry = VictimRegistry()
 
@@ -372,7 +404,7 @@ def make_server(address: str = "127.0.0.1:0",
         req = solver_pb2.VictimVisitRequest.FromString(request)
         return registry.visit(req).SerializeToString()
 
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(executor)
     handler = grpc.method_handlers_generic_handler(SERVICE, {
         "Solve": grpc.unary_unary_rpc_method_handler(
             _solve_handler,

@@ -28,3 +28,31 @@ assert len(jax.devices()) >= 8, "expected an 8-device virtual CPU mesh"
 # the CLI's accelerator-wedge watchdog probes a subprocess; pointless (and
 # slow) under the pinned-CPU test environment
 os.environ.setdefault("KUBEBATCH_NO_BACKEND_PROBE", "1")
+
+# tests must be hermetic: the persistent XLA compile cache is for
+# process entry points (bench/CLI). Tests that call bench.main() would
+# otherwise flip it on for the WHOLE pytest process, and deserializing
+# entries written by differently-shaped processes segfaulted a full
+# suite run inside jax's cache read (grpc-thread compile in test_rpc,
+# r5) — a crash class tests must not be exposed to at all.
+os.environ["KUBEBATCH_COMPILE_CACHE"] = "0"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bounded_jax_native_state():
+    """Clear jax's executable caches between test MODULES.
+
+    After ~290 tests' worth of compiled programs in one process, the
+    FIRST large compile issued from a secondary thread (the rpc
+    sidecar's handler pool) segfaulted inside XLA's CPU backend —
+    reproducibly at the same test in three full-suite runs, while the
+    same tests pass standalone and in any short slice. Process-
+    cumulative native compiler state is the trigger; per-module cache
+    clearing bounds it (modules rarely share jit signatures, so the
+    recompile cost is small)."""
+    yield
+    import jax
+
+    jax.clear_caches()
