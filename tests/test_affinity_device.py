@@ -9,6 +9,8 @@ checks the reference predicate semantics on the outcome
 (ref: pkg/scheduler/plugins/predicates/predicates.go:47-104,146,188;
 nodeorder.go:305-313).
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -405,3 +407,29 @@ def test_randomized_affinity_final_state_valid(seed):
     # may differ — the batched engine is order-approximate)
     assert set(binds) == set(host_binds), (
         sorted(set(binds) ^ set(host_binds)))
+
+
+@pytest.mark.skipif(not os.environ.get("KB_BIG_SMOKE"),
+                    reason="set KB_BIG_SMOKE=1 for the cfg5p-shape smoke")
+def test_big_affinity_smoke():
+    """Opt-in (KB_BIG_SMOKE=1): the affinity graphs at cfg5p stress
+    shapes — 5k nodes / 10k pods / full predicate mix — trace, compile
+    and execute through the batched engine on the host backend with
+    exactly ONE blocking read. ~5+ min of XLA CPU work; the driver-shape
+    TPU run is bench.py --config 5p."""
+    from kubebatch_tpu.metrics import blocking_readbacks
+    from kubebatch_tpu.sim import baseline_cluster
+
+    sim = baseline_cluster("5p")
+    cache, binds = make_cache()
+    sim.populate(cache)
+    from kubebatch_tpu.conf import shipped_tiers
+
+    ssn = OpenSession(cache, shipped_tiers())
+    rb0 = blocking_readbacks()
+    ran = execute_batched(ssn)
+    used = blocking_readbacks() - rb0
+    CloseSession(ssn)
+    assert ran == "batched"
+    assert used == 1, used
+    assert len(binds) > 5000, len(binds)
