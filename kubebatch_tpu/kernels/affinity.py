@@ -168,6 +168,15 @@ def _pair_key(term: PodAffinityTerm, owner: Pod) -> Tuple:
             _ns_key(term, owner), term.topology_key)
 
 
+def _interpod_weight(ssn) -> float:
+    """nodeorder's pod_aff weight when the plugin is registered (the ONE
+    lookup shared by the batched encoder and the victim-path masks — a
+    default-weight change must hit both)."""
+    no_plugin = ssn.plugins.get("nodeorder")
+    weights = getattr(no_plugin, "weights", None) or {"pod_aff": 1}
+    return float(weights.get("pod_aff", 1))
+
+
 class _PairSpace:
     """Collects (group, topology-key) pairs and memoizes membership."""
 
@@ -234,15 +243,28 @@ class SessionAffinityMasks:
     what the host predicate would see mid-action.
 
     ``supported`` is False when the pending set exceeds the pair/port
-    caps — callers fall back to the host path exactly as before."""
+    caps — callers fall back to the host path exactly as before.
 
-    def __init__(self, ssn, pending: Sequence[TaskInfo]):
+    ``with_scores``: also maintain the interpod-affinity SCORE counts
+    (nodeorder.go:305-313 / plugins/nodeorder.interpod_affinity_counts)
+    so a scoring action's host-side node chooser can reproduce the
+    oracle's node_order_fn sum exactly (kernels/victims.py _choose)."""
+
+    def __init__(self, ssn, pending: Sequence[TaskInfo],
+                 with_scores: bool = False, with_predicates: bool = True):
         from ..framework import EventHandler
 
         self._ssn = ssn
         self._epoch = 0
         self._built_epoch = -1
         self._mask_memo: Dict[Tuple[str, int], np.ndarray] = {}
+        self._score_memo: Dict[Tuple[str, int], np.ndarray] = {}
+        self.with_scores = with_scores
+        #: False when the predicates plugin is disabled — the masks must
+        #: then enforce NOTHING (the host oracle would not run the
+        #: affinity/port predicate either); only the score side applies
+        self.with_predicates = with_predicates
+        self.ip_weight = _interpod_weight(ssn) if with_scores else 0.0
         self.supported = affinity_within_vocabulary(ssn, pending)
         if not self.supported:
             return
@@ -254,26 +276,39 @@ class SessionAffinityMasks:
                                            deallocate_func=_bump,
                                            owner="predicates"))
         # pair space over the PENDING tasks' own terms + existing
-        # carriers' anti terms (scores don't gate nodes — skip them)
+        # carriers' anti terms (+ preferred terms when scoring)
         self._pairs = _PairSpace()
         #: (label-sig, ns) -> membership row; valid while the pair space
         #: hasn't grown (pipelined preemptors carrying new terms grow it)
         self._member_memo: Dict[Tuple, np.ndarray] = {}
         self._memo_pairs = 0
         self._task_terms: Dict[str, tuple] = {}
+        #: uid -> tuple of (pair, weight) own preferred terms (signed)
+        self._task_pref: Dict[str, tuple] = {}
         for t in pending:
             aff = t.pod.affinity
             if aff is None and not t.pod.host_ports():
                 continue
             req = anti = ()
-            if aff is not None:
+            if aff is not None and with_predicates:
                 req = tuple(
                     (self._pairs.add(term, t.pod), term, t.pod)
                     for term in aff.pod_affinity_required)
                 anti = tuple(self._pairs.add(term, t.pod)
                              for term in aff.pod_anti_affinity_required)
-            self._task_terms[t.uid] = (req, anti,
-                                       tuple(t.pod.host_ports()))
+            if aff is not None:
+                if with_scores:
+                    pref = tuple(
+                        (self._pairs.add(term, t.pod), float(w))
+                        for w, term in aff.pod_affinity_preferred
+                    ) + tuple(
+                        (self._pairs.add(term, t.pod), -float(w))
+                        for w, term in aff.pod_anti_affinity_preferred)
+                    if pref:
+                        self._task_pref[t.uid] = pref
+            self._task_terms[t.uid] = (
+                req, anti,
+                tuple(t.pod.host_ports()) if with_predicates else ())
         self._cand_anti: list = []      # filled per rebuild
 
     def _node_axis(self):
@@ -283,21 +318,41 @@ class SessionAffinityMasks:
         return names, index
 
     def _rebuild(self) -> None:
+        from ..plugins.nodeorder import HARD_POD_AFFINITY_SYMMETRIC_WEIGHT
+
         ssn = self._ssn
         self._mask_memo.clear()
+        self._score_memo.clear()
         names, index = self._node_axis()
         self._names = names
         n = len(names)
         cands = _candidates(ssn)
         # existing carriers' required anti terms join the pair space
-        # (symmetry); new label shapes can add pairs — the space is
+        # (symmetry); with scores, their preferred + hard-sym required
+        # terms too; new label shapes can add pairs — the space is
         # grow-only within the action
         cand_anti = []
+        cand_pref = []           # (pair, weight, carrier task)
+        hard_w = (float(HARD_POD_AFFINITY_SYMMETRIC_WEIGHT)
+                  if self.with_scores and self.ip_weight else 0.0)
         for t in cands:
             pod = t.pod
             if pod.has_pod_affinity() and pod.affinity is not None:
-                for term in pod.affinity.pod_anti_affinity_required:
-                    cand_anti.append((self._pairs.add(term, pod), t))
+                aff = pod.affinity
+                if self.with_predicates:
+                    for term in aff.pod_anti_affinity_required:
+                        cand_anti.append((self._pairs.add(term, pod), t))
+                if self.with_scores and self.ip_weight:
+                    for w, term in aff.pod_affinity_preferred:
+                        cand_pref.append(
+                            (self._pairs.add(term, pod), float(w), t))
+                    for w, term in aff.pod_anti_affinity_preferred:
+                        cand_pref.append(
+                            (self._pairs.add(term, pod), -float(w), t))
+                    if hard_w:
+                        for term in aff.pod_affinity_required:
+                            cand_pref.append(
+                                (self._pairs.add(term, pod), hard_w, t))
         p_cnt = max(1, len(self._pairs))
         node_dom = np.full((p_cnt, n), -1, np.int32)
         key_dom: Dict[str, np.ndarray] = {}
@@ -349,6 +404,13 @@ class SessionAffinityMasks:
                 d = node_dom[p, col]
                 if d >= 0:
                     anti_cnt[p, d] += 1
+        pref_w = np.zeros((p_cnt, d_cap), np.float32)
+        for p, w, t in cand_pref:
+            col = index.get(t.node_name)
+            if col is not None:
+                d = node_dom[p, col]
+                if d >= 0:
+                    pref_w[p, d] += w
         # ports actually used per node (only referenced ports matter,
         # but the per-node walk is over candidate tasks anyway)
         used_ports: Dict[int, set] = {}
@@ -363,8 +425,10 @@ class SessionAffinityMasks:
         self._grp_cnt = grp_cnt
         self._grp_total = grp_total
         self._anti_cnt = anti_cnt
+        self._pref_w = pref_w
         self._used_ports = used_ports
         self._cand_anti = cand_anti
+        self._cand_pref = cand_pref
         self._built_epoch = self._epoch
 
     def node_mask(self, task: TaskInfo, device) -> Optional[np.ndarray]:
@@ -430,6 +494,59 @@ class SessionAffinityMasks:
         self._mask_memo[key] = out
         return out
 
+    def score_norm(self, task: TaskInfo, device) -> Optional[np.ndarray]:
+        """The interpod-affinity node-order TERM for ``task`` over the
+        device's padded node columns — counts from the CURRENT
+        assignments, normalized exactly like the host
+        (int(10 * (c - cmin) / (cmax - cmin)) * pod_aff weight, min/max
+        over the session's real nodes; None when the term is zero
+        everywhere). Mirrors plugins/nodeorder.interpod_affinity_counts
+        + its per-(task, epoch) memoized normalization."""
+        if not (self.with_scores and self.ip_weight and self.supported):
+            return None
+        if self._built_epoch != self._epoch:
+            self._rebuild()
+        pref = self._task_pref.get(task.uid, ())
+        if not pref and not self._cand_pref:
+            return None
+        key = (task.uid, self._built_epoch)
+        if key in self._score_memo:
+            return self._score_memo[key]
+        pod = task.pod
+        n = len(self._names)
+        counts = np.zeros(n, np.float64)
+        node_dom = self._node_dom
+        # own preferred terms: w x (#matching candidates in the node's
+        # domain)
+        for p, w in pref:
+            doms = node_dom[p]
+            cnt = np.where(doms >= 0,
+                           self._grp_cnt[p][np.maximum(doms, 0)], 0)
+            counts += w * cnt
+        # symmetric: candidates' preferred (+ hard-sym required) terms
+        # whose selector matches THIS pod weigh their carriers' domains
+        for p in {p for p, _w, _t in self._cand_pref}:
+            if _member(self._pairs.keys[p], pod):
+                doms = node_dom[p]
+                pw = np.where(doms >= 0,
+                              self._pref_w[p][np.maximum(doms, 0)], 0.0)
+                counts += pw
+        cmin = counts.min() if n else 0.0
+        cmax = counts.max() if n else 0.0
+        if cmax == cmin:
+            self._score_memo[key] = None
+            return None
+        norm = np.floor(10.0 * (counts - cmin)
+                        / (cmax - cmin)) * self.ip_weight
+        n_pad = device.n_padded
+        out = np.zeros(n_pad, np.float32)
+        for i, name in enumerate(self._names):
+            col = device.node_index(name)
+            if col is not None:
+                out[col] = norm[i]
+        self._score_memo[key] = out
+        return out
+
 
 def build_affinity_inputs(ssn, tasks: Sequence[TaskInfo], device,
                           t_pad: int) -> Optional[AffinityInputs]:
@@ -454,9 +571,7 @@ def build_affinity_inputs(ssn, tasks: Sequence[TaskInfo], device,
         not opt.node_order_disabled and opt.name in ssn.node_order_fns
         for tier in ssn.tiers for opt in tier.plugins)
     if order_active:
-        no_plugin = ssn.plugins.get("nodeorder")
-        weights = getattr(no_plugin, "weights", None) or {"pod_aff": 1}
-        ip_weight = float(weights.get("pod_aff", 1))
+        ip_weight = _interpod_weight(ssn)
 
     # ---- collect pairs ------------------------------------------------
     pairs = _PairSpace()

@@ -83,10 +83,11 @@ def device_supported(ssn, pending: Sequence[TaskInfo],
     passes True and the dynamic-feature check is skipped (the affinity
     encoder still falls back past its own vocabulary caps). The victim
     solvers also pass True and apply an exact host-side node mask at
-    choice time (affinity.SessionAffinityMasks; scoring actions with
-    nodeorder active still fall back — the interpod score term is
-    allocation-dependent). The per-visit/fused allocate paths keep the
-    strict default."""
+    choice time (affinity.SessionAffinityMasks); scoring actions
+    (preempt) additionally reproduce nodeorder's allocation-dependent
+    interpod term in the wave chooser's host-side ordering, falling
+    back only when waves are disabled (KUBEBATCH_VICTIM_WAVE=0). The
+    per-visit/fused allocate paths keep the strict default."""
     from ..cache.interface import NullVolumeBinder
 
     # a real volume binder makes placement feasibility depend on per-node

@@ -1468,6 +1468,12 @@ class VictimSolver:
                     score = entry["static_score"].astype(np.float32)
                     if self.dyn is not None and self.dyn.enabled:
                         score = score + self._dyn_scores(entry["p_nz"])
+                    if self.aff_masks is not None \
+                            and self.aff_masks.with_scores:
+                        ip = self.aff_masks.score_norm(task,
+                                                       self._aff_device)
+                        if ip is not None:
+                            score = score + ip
                     order_rank = np.lexsort((st.host_rank, -score))
                 else:
                     order_rank = np.lexsort((st.host_rank,))
@@ -1718,23 +1724,27 @@ def build_victim_solver(ssn, pending: Sequence[TaskInfo],
     order_active = bool(_active(ssn, ssn.node_order_fns,
                                 "node_order_disabled"))
     aff_masks = None
+    aff_scored = False
     if pred_active or order_active:
         from .encode import dynamic_features
         if dynamic_features(ssn, pending) is not None:
-            if score_nodes and order_active:
-                # a SCORING action (preempt) with nodeorder active:
-                # the interpod score term is allocation-dependent and
-                # the kernels don't model it — node choice would
-                # diverge from the host oracle's node_order_fn sum
-                # (nodeorder.go:305-313). Host path.
+            aff_scored = bool(score_nodes and order_active)
+            if aff_scored and not env_on("KUBEBATCH_VICTIM_WAVE"):
+                # the interpod score term (nodeorder.go:305-313) is
+                # allocation-dependent; the exact reproduction lives in
+                # the WAVE chooser's host-side node ordering — with
+                # waves disabled the in-kernel choice would diverge
+                # from the oracle's node_order_fn sum. Host path.
                 return None
-            if not pred_active:
-                # only the score side referenced affinity and this
-                # action doesn't score: nothing to mask
-                pass
-            else:
+            if pred_active or aff_scored:
                 from .affinity import SessionAffinityMasks
-                aff_masks = SessionAffinityMasks(ssn, pending)
+                # with_predicates gates the MASK half: a disabled
+                # predicates plugin must not have affinity/ports
+                # enforced at choice time (the host oracle would not
+                # run that predicate either)
+                aff_masks = SessionAffinityMasks(
+                    ssn, pending, with_scores=aff_scored,
+                    with_predicates=pred_active)
                 if not aff_masks.supported:
                     return None
     if ssn.device_snapshot is None:
@@ -1758,6 +1768,13 @@ def build_victim_solver(ssn, pending: Sequence[TaskInfo],
     if aff_masks is not None:
         solver.aff_masks = aff_masks
         solver._aff_device = device
+        if aff_scored:
+            # every node CHOICE must flow through the wave chooser's
+            # host-side score ordering (where the interpod term is
+            # reproduced exactly); per-visit in-kernel choice would
+            # ignore it
+            solver._wave_on = True
+            solver._wave_after = 0
     if os.environ.get("KUBEBATCH_SOLVER", "") == "rpc":
         # route the victim analysis through the solver sidecar — the
         # full 4-action remote cycle (scheduler.go:88-105 runs every

@@ -557,3 +557,91 @@ def test_affinity_snapshot_builds_device_victim_solver():
     assert mask is not None, "anti-affine task must have a mask"
     col = solver._aff_device.node_index("n0")
     assert not mask[col], "anti-affinity must exclude n0 from the mask"
+
+
+def test_preempt_device_path_honors_interpod_score():
+    """Scoring victim action (preempt) + nodeorder + affinity: the wave
+    chooser reproduces the interpod score term exactly, so the device
+    path picks the SAME victim node as the host oracle when preferred
+    co-location is the tiebreaker (nodeorder.go:305-313)."""
+    import os
+
+    from kubebatch_tpu import actions, plugins  # noqa: F401
+    from kubebatch_tpu.actions.preempt import PreemptAction
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.kernels.victims import (SKIP_ACTION,
+                                               build_action_solver)
+    from kubebatch_tpu.objects import Affinity, PodAffinityTerm
+    from .fixtures import GiB, build_group, build_node, build_pod, \
+        build_queue, rl
+
+    def run(victim_solver):
+        ev = []
+
+        class _S:
+            def bind(self, pod, h):
+                pod.node_name = h
+
+            def evict(self, pod):
+                ev.append(pod.name)
+                pod.deletion_timestamp = 1.0
+
+        cache = SchedulerCache(binder=_S(), evictor=_S(),
+                               async_writeback=False)
+        cache.add_queue(build_queue("default"))
+        for i in range(2):
+            cache.add_node(build_node(f"n{i}", rl(4000, 8 * GiB,
+                                                  pods=110)))
+        # symmetric low-priority load on both nodes
+        for i, node in enumerate(["n0", "n0", "n1", "n1"]):
+            g = f"low{i}"
+            cache.add_pod_group(build_group("ns", g, 1))
+            cache.add_pod(build_pod("ns", f"{g}-0", node, "Running",
+                                    rl(1800, 3 * GiB), group=g,
+                                    priority=1))
+        # the co-location target lives on n1
+        cache.add_pod_group(build_group("ns", "db", 1))
+        cache.add_pod(build_pod("ns", "db-0", "n1", "Running",
+                                rl(100, GiB // 4), group="db",
+                                priority=1, labels={"app": "db"}))
+        # high-priority preemptor PREFERS db's node
+        cache.add_pod_group(build_group("ns", "want", 1))
+        pod = build_pod("ns", "want-0", "", "Pending", rl(1800, 3 * GiB),
+                        group="want", priority=100)
+        pod.affinity = Affinity(pod_affinity_preferred=[
+            (100, PodAffinityTerm(match_labels={"app": "db"}))])
+        cache.add_pod(pod)
+        os.environ["KUBEBATCH_VICTIM_SOLVER"] = victim_solver
+        try:
+            ssn = OpenSession(cache, shipped_tiers())
+            if victim_solver == "device":
+                solver = build_action_solver(
+                    ssn, "preemptable_fns", "preemptable_disabled",
+                    score_nodes=True)
+                assert solver is not None and solver is not SKIP_ACTION
+                assert getattr(solver, "aff_masks", None) is not None \
+                    and solver.aff_masks.with_scores, \
+                    "scored affinity preempt must engage WITH score masks"
+                from kubebatch_tpu.api import TaskStatus
+                want = next(
+                    t for j in ssn.jobs.values()
+                    for t in j.task_status_index.get(TaskStatus.PENDING,
+                                                     {}).values()
+                    if t.name == "want-0")
+                ip = solver.aff_masks.score_norm(want, solver._aff_device)
+                assert ip is not None and ip.max() > ip.min(), \
+                    "the interpod term must be load-bearing here"
+            PreemptAction().execute(ssn)
+            CloseSession(ssn)
+        finally:
+            os.environ.pop("KUBEBATCH_VICTIM_SOLVER", None)
+        return sorted(ev)
+
+    host = run("host")
+    dev = run("device")
+    assert host and all(v.startswith("low2") or v.startswith("low3")
+                        for v in host), \
+        f"oracle must evict on n1 (preferred co-location): {host}"
+    assert dev == host, (dev, host)
