@@ -37,7 +37,7 @@ from ..api import JobInfo, TaskInfo, TaskStatus
 from ..framework import (Action, Session, VolumeAllocationError,
                          register_action)
 from ..kernels.solver import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
-                              DeviceSession)
+                              DeviceSession, ensure_device_snapshot)
 from ..kernels.tensorize import TaskBatch
 from ..kernels.terms import (device_supported, pred_and_score_matrices,
                              solver_terms)
@@ -226,14 +226,11 @@ class AllocateAction(Action):
                 and device_supported(ssn, pending_all):
             # the cheap gate above keeps fallback cycles from paying the
             # full-cluster tensorize + device upload
-            if ssn.device_snapshot is None:
-                mk = getattr(ssn.cache, "device_session", None)
-                ssn.device_snapshot = (mk(ssn) if mk is not None
-                                       else DeviceSession(ssn.nodes))
-            terms = solver_terms(ssn, ssn.device_snapshot, pending_all,
+            device_snap = ensure_device_snapshot(ssn)
+            terms = solver_terms(ssn, device_snap, pending_all,
                                  assume_supported=True)
             if terms is not None:
-                device = ssn.device_snapshot
+                device = device_snap
         elif mode == "native" and not (ssn.predicate_fns
                                        or ssn.node_order_fns):
             from ..native import NativeSession, native_available

@@ -20,7 +20,7 @@ from ..api import JobInfo, TaskInfo, TaskStatus, ready_statuses
 from ..framework import Session
 from ..kernels.fused import (K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
                              K_PROP_SHARE)
-from ..kernels.solver import DeviceSession
+from ..kernels.solver import DeviceSession, ensure_device_snapshot
 from ..kernels.tensorize import TaskBatch, pad_to_bucket, sticky_bucket
 from ..kernels.terms import device_supported, solver_terms
 
@@ -283,11 +283,7 @@ def build_cycle_inputs(ssn: Session,
             if not affinity_within_vocabulary(ssn, tasks):
                 return None   # over the caps — reference-literal host path
             aff_wanted = True
-    if ssn.device_snapshot is None:
-        mk = getattr(ssn.cache, "device_session", None)
-        ssn.device_snapshot = (mk(ssn) if mk is not None
-                               else DeviceSession(ssn.nodes))
-    device: DeviceSession = ssn.device_snapshot
+    device = ensure_device_snapshot(ssn)
     terms = solver_terms(ssn, device, tasks, assume_supported=True)
     if terms is None:
         return None

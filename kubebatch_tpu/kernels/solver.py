@@ -181,6 +181,35 @@ class Decision(NamedTuple):
     node_name: str
 
 
+def ensure_device_snapshot(ssn) -> "DeviceSession":
+    """The session's shared DeviceSession, with every node row the
+    CURRENT session has touched re-packed from host truth on each call.
+
+    Actions run in sequence against one session; the first device
+    consumer builds the snapshot (cache.device_session folds the dirty
+    AND already-touched sets), but a LATER action must not consume rows
+    an earlier action's host-side mutations made stale — reclaim's
+    evictions land on host NodeInfo between the victim build and
+    allocate's solve, and backfill's host-only placements can re-touch
+    nodes a previous sync already covered. Re-packing the full touched
+    set is idempotent (host truth is authoritative after each action's
+    replay) and O(touched), so no delta bookkeeping can go stale.
+    Caught by tests/test_rpc.py's remote-cycle fuzz: post-reclaim fused
+    placements diverged from the host oracle while the wire path, which
+    reads fresh host truth, matched it."""
+    device = ssn.device_snapshot
+    if device is None:
+        mk = getattr(ssn.cache, "device_session", None)
+        device = mk(ssn) if mk is not None else DeviceSession(ssn.nodes)
+        ssn.device_snapshot = device
+        return device
+    touched = ssn.touched_nodes
+    if touched and not device.update_rows(ssn.nodes, touched):
+        device = DeviceSession(ssn.nodes)   # node set changed: rebuild
+        ssn.device_snapshot = device
+    return device
+
+
 #: cap on the per-session dirty-row scatter high-water (see update_rows):
 #: a single transient cluster-wide dirty set must not make every later
 #: steady-cycle update pay its host-side pad construction; updates above

@@ -1695,7 +1695,7 @@ def build_victim_solver(ssn, pending: Sequence[TaskInfo],
     ``fns_attr``: "preemptable_fns" or "reclaimable_fns"; ``disabled_attr``
     the matching per-plugin disable flag name.
     """
-    from .solver import DeviceSession
+    from .solver import ensure_device_snapshot
     from .terms import device_supported, solver_terms
 
     KNOWN = {"gang", "conformance", "drf", "proportion"}
@@ -1747,11 +1747,7 @@ def build_victim_solver(ssn, pending: Sequence[TaskInfo],
                     with_predicates=pred_active)
                 if not aff_masks.supported:
                     return None
-    if ssn.device_snapshot is None:
-        mk = getattr(ssn.cache, "device_session", None)
-        ssn.device_snapshot = (mk(ssn) if mk is not None
-                               else DeviceSession(ssn.nodes))
-    device = ssn.device_snapshot
+    device = ensure_device_snapshot(ssn)
     terms = solver_terms(ssn, device, pending, assume_supported=True)
     if terms is None:
         return None

@@ -414,3 +414,48 @@ def test_sidecar_solves_affinity_snapshot(sidecar):
     assert len(results["batched"][1]) > 500
     assert results["rpc"][0] == results["batched"][0]
     assert results["rpc"][1] == results["batched"][1]
+
+
+@pytest.mark.parametrize("seed", [2, 13, 31])
+def test_full_cycle_remote_fuzz(monkeypatch, seed):
+    """Seeded cfg4-shaped clusters (running fill, 2 weighted queues,
+    priority classes): the full 4-action KUBEBATCH_SOLVER=rpc cycle must
+    end bit-equal to the in-process cycle — the victim wire (upload +
+    per-visit mutable resync) across varied victim/queue shapes."""
+    from kubebatch_tpu.sim import ClusterSpec, build_cluster
+
+    def mk(seed):
+        spec = ClusterSpec(n_nodes=20, n_groups=10, pods_per_group=4,
+                           min_member=2, n_queues=2, queue_weights=(1, 3),
+                           running_fill=0.65, pod_cpu_millis=1100,
+                           pod_mem_bytes=GiB,
+                           priority_classes=(("low", 10), ("high", 1000)),
+                           seed=seed)
+        sim = build_cluster(spec)
+        ev = []
+
+        class Seam(RecordingBinder):
+            def evict(self, pod):
+                ev.append(f"{pod.namespace}/{pod.name}")
+                pod.deletion_timestamp = 1.0
+
+        seam = Seam()
+        cache = SchedulerCache(binder=seam, evictor=seam,
+                               async_writeback=False)
+        sim.populate(cache)
+        return cache, ev
+
+    cache_a, ev_a = mk(seed)
+    local = _full_cycle(cache_a)
+
+    server, port = make_server("127.0.0.1:0")
+    server.start()
+    monkeypatch.setenv("KUBEBATCH_SOLVER", "rpc")
+    monkeypatch.setenv("KUBEBATCH_SOLVER_ADDR", f"127.0.0.1:{port}")
+    cache_b, ev_b = mk(seed)
+    remote = _full_cycle(cache_b)
+    server.stop(grace=None)
+
+    assert ev_a, f"seed {seed}: the fuzz must actually reclaim victims"
+    assert remote == local, f"seed {seed}: remote cycle diverged"
+    assert sorted(ev_b) == sorted(ev_a)
