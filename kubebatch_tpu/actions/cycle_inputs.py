@@ -81,20 +81,145 @@ def gang_enabled(ssn: Session) -> bool:
     return False
 
 
+def fast_task_sort_spec(ssn: Session) -> Optional[bool]:
+    """Whether the session's task order is expressible as a tuple key:
+    True = (-priority, creation_timestamp, uid), False = (creation, uid),
+    None = a custom task-order fn is registered (per-item cmp path)."""
+    names = [opt.name for tier in ssn.tiers for opt in tier.plugins
+             if not opt.task_order_disabled
+             and opt.name in ssn.task_order_fns]
+    if any(n != "priority" for n in names):
+        return None
+    return bool(names)
+
+
 def fast_task_sort_key(ssn: Session):
     """A tuple sort key equivalent to ``ssn.task_order_fn`` when the only
     enabled task-order callback is the built-in priority plugin's
     (descending priority, then the session's creation-timestamp/uid
     tie-break) — a key sort is ~10x a cmp_to_key sort over 10k tasks.
     Returns None when a custom task-order fn is registered."""
-    names = [opt.name for tier in ssn.tiers for opt in tier.plugins
-             if not opt.task_order_disabled
-             and opt.name in ssn.task_order_fns]
-    if any(n != "priority" for n in names):
+    spec = fast_task_sort_spec(ssn)
+    if spec is None:
         return None
-    if names:
+    if spec:
         return lambda t: (-t.priority, t.pod.creation_timestamp, t.uid)
     return lambda t: (t.pod.creation_timestamp, t.uid)
+
+
+from ..kernels.tensorize import _intern_paths
+
+#: one native pass per cycle pulls every float the task gather + sort +
+#: TaskBatch need: resreq (host units), init_resreq, priority, creation
+_GATHER_PATHS = _intern_paths(
+    ("resreq", "milli_cpu"), ("resreq", "memory"), ("resreq", "milli_gpu"),
+    ("init_resreq", "milli_cpu"), ("init_resreq", "memory"),
+    ("init_resreq", "milli_gpu"),
+    ("priority", None), ("pod", "creation_timestamp"))
+
+_CREATION_PATH = _intern_paths(("pod", "creation_timestamp"))
+
+def _gather_pending_bulk(jobs: List[JobInfo], use_priority: bool):
+    """Columnar pending-task gather: one native attribute pass over the
+    whole backlog, empty-request filter and (job, task-order) sort as
+    array ops — the per-job Python filter+sort loop is O(tasks)
+    interpreter work, the single largest tensorize term at 10k pods.
+
+    Returns (tasks, task_job_idx, task_ranks, raw6) where raw6 is the
+    [T, 6] float64 (resreq, init_resreq) host-unit matrix in final task
+    order (TaskBatch.from_raw consumes it — no second extraction), or
+    None when the native packer is unavailable / the bulk path is
+    disabled (callers fall back to the per-item gather)."""
+    from ..api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_GPU
+    from ..kernels.tensorize import load_kb_pack
+    from ..util import env_on
+
+    pack = load_kb_pack()
+    if pack is None or not env_on("KB_BULK_TENSORIZE"):
+        return None
+    raw_tasks: List[TaskInfo] = []
+    counts = np.empty(len(jobs), np.int64)
+    pending = TaskStatus.PENDING
+    for k, j in enumerate(jobs):
+        n0 = len(raw_tasks)
+        raw_tasks.extend(j.task_status_index[pending].values())
+        counts[k] = len(raw_tasks) - n0
+    t0 = len(raw_tasks)
+    if not t0:
+        return [], [], [], None
+    raw = np.empty((t0, 8), np.float64)
+    pack.extract_f64(raw_tasks, _GATHER_PATHS, raw)
+    job_col = np.repeat(np.arange(len(jobs), dtype=np.int64), counts)
+    # not resreq.is_empty(), the exact epsilon rule
+    nonempty = ~((raw[:, 0] < MIN_MILLI_CPU) & (raw[:, 1] < MIN_MEMORY)
+                 & (raw[:, 2] < MIN_MILLI_GPU))
+    sel = np.nonzero(nonempty)[0]
+    if sel.size == 0:
+        return [], [], [], None
+    # per-job task order as ONE lexsort over the NUMERIC keys (primary
+    # key last). The uid tie-break is applied lazily: building a 10k-row
+    # fixed-width numpy string column costs more than the whole numeric
+    # sort, and creation timestamps disambiguate almost every real pair
+    # — so only runs whose numeric keys collide pay a (tiny) Python sort
+    # by uid, which compares by code point exactly as numpy would
+    if use_priority:
+        keys = (raw[sel, 7], -raw[sel, 6], job_col[sel])
+    else:
+        keys = (raw[sel, 7], job_col[sel])
+    order = np.lexsort(keys)
+    tie = np.ones(order.size, bool)
+    tie[0] = False
+    for k in keys:
+        ks = k[order]
+        tie[1:] &= ks[1:] == ks[:-1]
+    tied_rows = np.nonzero(tie)[0]
+    if tied_rows.size:
+        # each run of consecutive tied rows (plus the row before it) is
+        # one numeric-key collision group; uid-sort those groups only
+        order_l = order.tolist()
+        sel_l = sel.tolist()
+        breaks = np.nonzero(np.diff(tied_rows) > 1)[0] + 1
+        for grp in np.split(tied_rows, breaks):
+            s, e = int(grp[0]) - 1, int(grp[-1]) + 1
+            run = order_l[s:e]
+            run.sort(key=lambda i: raw_tasks[sel_l[i]].uid)
+            order_l[s:e] = run
+        order = np.asarray(order_l, dtype=order.dtype)
+    sel = sel[order]
+    job_sorted = job_col[sel]
+    counts_f = np.bincount(job_sorted, minlength=len(jobs))
+    starts = np.concatenate(([0], np.cumsum(counts_f)[:-1]))
+    ranks = np.arange(sel.size, dtype=np.int64) - np.repeat(starts, counts_f)
+    tasks = [raw_tasks[i] for i in sel.tolist()]
+    return (tasks, job_sorted.astype(np.int32), ranks.astype(np.int32),
+            raw[sel, :6])
+
+
+def _gather_pending_per_item(ssn: Session, jobs: List[JobInfo]):
+    """Reference-shaped per-job gather+sort (the fallback the bulk path
+    is pinned equivalent to; also the only path that can run a custom
+    task-order fn)."""
+    from ..metrics import count_slow_path_items
+
+    tasks: List[TaskInfo] = []
+    task_job_idx: List[int] = []
+    task_ranks: List[int] = []
+    fast_key = fast_task_sort_key(ssn)
+    for ji, j in enumerate(jobs):
+        pend = [t for t in j.task_status_index.get(TaskStatus.PENDING,
+                                                   {}).values()
+                if not t.resreq.is_empty()]
+        if fast_key is not None:
+            pend.sort(key=fast_key)
+        else:
+            pend.sort(key=functools.cmp_to_key(
+                lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
+        for rank, t in enumerate(pend):
+            tasks.append(t)
+            task_job_idx.append(ji)
+            task_ranks.append(rank)
+    count_slow_path_items("tensorize", len(tasks))
+    return tasks, task_job_idx, task_ranks, None
 
 
 @dataclass
@@ -225,6 +350,19 @@ def build_cycle_inputs(ssn: Session,
     batched engine's vocabulary (kernels/affinity.py) instead of falling
     back on them; the fused engine passes False — its one-placement scan
     has no affinity carry."""
+    import time as _time
+
+    from ..metrics import update_host_phase
+
+    start = _time.perf_counter()
+    try:
+        return _build_cycle_inputs(ssn, allow_affinity)
+    finally:
+        update_host_phase("tensorize", _time.perf_counter() - start)
+
+
+def _build_cycle_inputs(ssn: Session,
+                        allow_affinity: bool) -> Optional[CycleInputs]:
     # ---- queues ----------------------------------------------------------
     queue_ids = sorted(ssn.queues)          # uid order = order fallback
     q_index = {q: i for i, q in enumerate(queue_ids)}
@@ -252,23 +390,13 @@ def build_cycle_inputs(ssn: Session,
     j_index = {j.uid: i for i, j in enumerate(jobs)}
 
     # ---- tasks (pending, non-BestEffort, in task-order per job) ----------
-    tasks: List[TaskInfo] = []
-    task_job_idx: List[int] = []
-    task_ranks: List[int] = []
-    fast_key = fast_task_sort_key(ssn)
-    for j in jobs:
-        pend = [t for t in j.task_status_index.get(TaskStatus.PENDING,
-                                                   {}).values()
-                if not t.resreq.is_empty()]
-        if fast_key is not None:
-            pend.sort(key=fast_key)
-        else:
-            pend.sort(key=functools.cmp_to_key(
-                lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
-        for rank, t in enumerate(pend):
-            tasks.append(t)
-            task_job_idx.append(j_index[j.uid])
-            task_ranks.append(rank)
+    gathered = None
+    sort_spec = fast_task_sort_spec(ssn)
+    if sort_spec is not None:
+        gathered = _gather_pending_bulk(jobs, sort_spec)
+    if gathered is None:
+        gathered = _gather_pending_per_item(ssn, jobs)
+    tasks, task_job_idx, task_ranks, task_raw = gathered
     if not tasks:
         return EMPTY_CYCLE
     # cheap feature gates BEFORE tensorizing/uploading the cluster — a
@@ -290,9 +418,11 @@ def build_cycle_inputs(ssn: Session,
     # sticky task-axis bucket: steady churn oscillating across a pow2
     # boundary must not recompile the whole-cycle kernels every few
     # cycles (the 1 s p95 tail in the steady benches)
-    batch = TaskBatch.from_tasks(
-        tasks, min_bucket=sticky_bucket("cycle_tasks", len(tasks), 8,
-                                        store=pad_store))
+    t_bucket = sticky_bucket("cycle_tasks", len(tasks), 8, store=pad_store)
+    if task_raw is not None:
+        batch = TaskBatch.from_raw(tasks, task_raw, min_bucket=t_bucket)
+    else:
+        batch = TaskBatch.from_tasks(tasks, min_bucket=t_bucket)
     t_pad = batch.t_padded
 
     # ---- inter-pod affinity / host ports (batched engine only) -----------
@@ -397,6 +527,24 @@ def build_cycle_inputs(ssn: Session,
         pipe_enabled=bool(np.any(device.state.releasing > 0.0)))
 
 
+def _segment_lists(cols: np.ndarray):
+    """Group array positions by value: [(value, [positions...]), ...] with
+    positions ascending within each group. One argsort + one tolist +
+    list slicing — building a numpy array per group (np.split) costs more
+    than the whole grouped pass at a few thousand groups."""
+    n = len(cols)
+    if not n:
+        return []
+    order = np.argsort(cols, kind="stable")
+    sorted_cols = cols[order]
+    cuts = (np.nonzero(np.diff(sorted_cols))[0] + 1).tolist()
+    order_l = order.tolist()
+    starts = [0] + cuts
+    ends = cuts + [n]
+    vals = sorted_cols[starts].tolist()
+    return [(v, order_l[a:b]) for v, a, b in zip(vals, starts, ends)]
+
+
 #: event-handler owners the bulk replay can apply as aggregates (drf /
 #: proportion: share sums) or collapse to one call (nodeorder / predicates:
 #: idempotent memo invalidation)
@@ -418,10 +566,18 @@ def replay_decisions(ssn: Session, inputs: CycleInputs,
     registered event handler is a recognized built-in and the volume
     binder is the no-op default — anything custom gets the per-event
     ordering it may depend on."""
-    if _bulk_replay_supported(ssn):
-        _replay_bulk(ssn, inputs, task_state, task_node, task_seq)
-    else:
-        _replay_ordered(ssn, inputs, task_state, task_node, task_seq)
+    import time as _time
+
+    from ..metrics import update_host_phase
+
+    start = _time.perf_counter()
+    try:
+        if _bulk_replay_supported(ssn):
+            _replay_bulk(ssn, inputs, task_state, task_node, task_seq)
+        else:
+            _replay_ordered(ssn, inputs, task_state, task_node, task_seq)
+    finally:
+        update_host_phase("replay", _time.perf_counter() - start)
 
 
 def _bulk_replay_supported(ssn: Session) -> bool:
@@ -438,11 +594,13 @@ def _replay_ordered(ssn: Session, inputs: CycleInputs,
                     task_state: np.ndarray, task_node: np.ndarray,
                     task_seq: np.ndarray) -> None:
     from ..kernels.fused import ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP
+    from ..metrics import count_slow_path_items
 
     device = inputs.device
     tasks = inputs.tasks
     order = [i for i in range(len(tasks)) if task_state[i] != SKIP]
     order.sort(key=lambda i: task_seq[i])
+    count_slow_path_items("replay", len(order))
     try:
         for i in order:
             task = tasks[i]
@@ -535,14 +693,10 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
         # no enabled ready fn: every job is Ready (session.py:190-192)
         job_ready = np.ones(j_pad, bool)
 
-    alloc_status = TaskStatus.ALLOCATED
     binding = TaskStatus.BINDING
-    status_of = {int(ALLOC): alloc_status,
+    status_of = {int(ALLOC): TaskStatus.ALLOCATED,
                  int(ALLOC_OB): TaskStatus.ALLOCATED_OVER_BACKFILL,
                  int(PIPELINE): TaskStatus.PIPELINED}
-    int_pipeline = int(PIPELINE)
-    int_alloc = int(ALLOC)
-    jobs = ssn.jobs
     nodes = ssn.nodes
     pending = TaskStatus.PENDING
 
@@ -582,78 +736,126 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
     backfill_adds: List[tuple] = []
 
     try:
-        # --- pre-validation: resolve every lookup BEFORE any mutation so
-        #     a bad decision (vanished job/node, duplicate key) cannot
-        #     leave the batch half-applied with the arithmetic sums never
-        #     landing; inside the try so the failure path still resyncs
-        #     the device snapshot (it holds the kernel's placements) ------
-        resolved = []
-        seen_keys: Dict[str, set] = {}
+        from ..kernels.tensorize import batch_clone_tasks, batch_set_attr
+
+        placed_tasks = [tasks[i] for i in placed_list]
         placed_kinds_l = placed_states.tolist()
-        placed_jobs_l = placed_job_idx.tolist()
-        job_ready_l = job_ready.tolist()
-        for k, i in enumerate(placed_list):
-            task = tasks[i]
-            kind = placed_kinds_l[k]
-            node_name = names[placed_nodes_l[k]]
-            node = nodes.get(node_name)
-            job = jobs.get(task.job)
-            if kind != int_pipeline:
-                if job is None:
-                    raise KeyError(f"failed to find job {task.job}")
-                if node is None:
-                    raise KeyError(f"failed to find node {node_name}")
-            if node is not None:
-                keys = seen_keys.setdefault(node_name, set())
-                if task.key in node.tasks or task.key in keys:
-                    raise KeyError(f"task <{task.namespace}/{task.name}> "
-                                   f"already on node <{node.name}>")
-                keys.add(task.key)
-            resolved.append((task, kind, node_name, node, job,
-                             placed_jobs_l[k]))
+        is_pipe_l = is_pipe.tolist()
+        node_names_l = [names[c] for c in placed_nodes_l]
+        placed_keys = [t.key for t in placed_tasks]
+        placed_uids = [t.uid for t in placed_tasks]
 
-        for task, kind, node_name, node, job, job_idx in resolved:
-            new_status = status_of[kind]
-            if kind != int_pipeline:
-                # allocate_volumes: the bulk gate guarantees the Null
-                # volume binder, whose only effect is this flag
-                task.volume_ready = True
-                alloc_jobs.setdefault(job.uid, (job, job_idx))
+        # --- pre-validation: resolve every lookup BEFORE any mutation so
+        #     a bad decision (vanished node, duplicate key) cannot leave
+        #     the batch half-applied with the arithmetic sums never
+        #     landing; inside the try so the failure path still resyncs
+        #     the device snapshot (it holds the kernel's placements).
+        #     Tasks come from the jobs the tensorizer indexed, so the job
+        #     objects resolve by construction (inputs.jobs) -------------
+        node_by_col = {c: nodes.get(names[c])
+                       for c in np.unique(p_nodes).tolist()}
+        for k, col in enumerate(placed_nodes_l):
+            if node_by_col[col] is None and not is_pipe_l[k]:
+                raise KeyError(f"failed to find node {node_names_l[k]}")
+        # duplicate-key check as set ops per node (in-batch + vs the
+        # existing map); only a detected conflict pays a per-item walk to
+        # reproduce the ordered path's error message. Segment index lists
+        # come from ONE tolist + slicing — a numpy array per segment
+        # costs more than the whole grouped pass
+        segments = _segment_lists(p_nodes)
+        for col, seg_l in segments:
+            node = node_by_col[col]
+            if node is None:
+                continue
+            key_set = {placed_keys[i] for i in seg_l}
+            if len(key_set) != len(seg_l) or (key_set & node.tasks.keys()):
+                seen: set = set()
+                for i in seg_l:
+                    t = placed_tasks[i]
+                    if t.key in node.tasks or t.key in seen:
+                        raise KeyError(f"task <{t.namespace}/{t.name}> "
+                                       f"already on node <{node.name}>")
+                    seen.add(t.key)
 
-            task.status = new_status
-            task.node_name = node_name
+        # --- batch mutation: per-placement attribute flips and clones as
+        #     native column ops (kernels/tensorize batch helpers); dict
+        #     index moves grouped per node / per job --------------------
+        pre_status = [status_of[k] for k in placed_kinds_l]
+        disp = (placed_states == ALLOC) & job_ready[placed_job_idx]
+        disp_l = disp.tolist()
+        final_status = [binding if d else s
+                        for s, d in zip(pre_status, disp_l)]
+        nonpipe_tasks = (placed_tasks if not is_pipe.any()
+                         else [t for t, p in zip(placed_tasks, is_pipe_l)
+                               if not p])
+        if nonpipe_tasks:
+            # allocate_volumes: the bulk gate guarantees the Null volume
+            # binder, whose only effect is this flag
+            batch_set_attr(nonpipe_tasks, "volume_ready", True)
+        for ji in np.unique(p_jobs_idx[~is_pipe]).tolist():
+            job = inputs.jobs[int(ji)]
+            alloc_jobs[job.uid] = (job, int(ji))
 
-            # --- node task map (NodeInfo.add_task minus the arithmetic,
-            #     which the vectorized sums above cover; the node clone
-            #     carries allocation-time status, like the ordered path
-            #     where dispatch happens after add_task) -----------------
-            if node is not None:
-                if task.is_backfill and node.node is not None:
-                    backfill_adds.append((node, task.resreq))
-                if task.pod.has_pod_affinity():
-                    node.affinity_tasks += 1
-                node._own_tasks()
-                node.tasks[task.key] = task.clone()
+        # the node clones carry allocation-time status, like the ordered
+        # path where dispatch happens after add_task; the session tasks
+        # then flip to their final (possibly dispatched) status
+        clones = batch_clone_tasks(placed_tasks, pre_status, node_names_l)
+        batch_set_attr(placed_tasks, "node_name", node_names_l)
+        batch_set_attr(placed_tasks, "status", final_status)
+        # bind_volumes is a no-op on the Null volume binder
+        bindings.extend((placed_tasks[i], node_names_l[i])
+                        for i, d in enumerate(disp_l) if d)
 
-            # --- dispatch decision + single job index move ---------------
-            if (kind == int_alloc
-                    and job_ready_l[job_idx]):
-                # bind_volumes is a no-op on the Null volume binder
-                bindings.append((task, node_name))
-                task.status = binding
-            if job is not None:
-                index = job.task_status_index
-                pend = index.get(pending)
-                if pend is not None:
-                    pend.pop(task.uid, None)
-                    if not pend:
-                        del index[pending]
-                bucket = index.get(task.status)
+        # --- node task maps (NodeInfo.add_task minus the arithmetic,
+        #     which the vectorized sums above cover) --------------------
+        backfill_l = [t.is_backfill for t in placed_tasks]
+        has_backfill = True in backfill_l
+        # the per-pod affinity walk runs only when a placed pod CAN carry
+        # a term: inputs.affinity is None alone does not prove that (with
+        # the predicates AND nodeorder plugins disabled the affinity
+        # build is skipped regardless of pod specs), so screen with the
+        # maintained per-job counters, like bind_many does
+        aff_l = None
+        if inputs.affinity is not None or any(
+                inputs.jobs[int(ji)].affinity_tasks
+                for ji in np.unique(p_jobs_idx).tolist()):
+            aff_l = [t.pod.has_pod_affinity() for t in placed_tasks]
+        for col, seg_l in segments:
+            node = node_by_col[col]
+            if node is None:
+                continue
+            if has_backfill and node.node is not None:
+                for i in seg_l:
+                    if backfill_l[i]:
+                        backfill_adds.append((node, placed_tasks[i].resreq))
+            if aff_l is not None:
+                node.affinity_tasks += sum(aff_l[i] for i in seg_l)
+            node._own_tasks()
+            node.tasks.update((placed_keys[i], clones[i]) for i in seg_l)
+
+        # --- job status index moves + priority restamp, grouped --------
+        for jcol, seg_l in _segment_lists(p_jobs_idx):
+            job = inputs.jobs[jcol]
+            index = job.task_status_index
+            pend = index.get(pending)
+            if pend is not None:
+                for i in seg_l:
+                    pend.pop(placed_uids[i], None)
+                if not pend:
+                    del index[pending]
+            for i in seg_l:
+                st = final_status[i]
+                bucket = index.get(st)
                 if bucket is None:
-                    bucket = index[task.status] = {}
-                bucket[task.uid] = task
-                if task.pod.priority is not None:
-                    job.priority = task.priority
+                    bucket = index[st] = {}
+                bucket[placed_uids[i]] = placed_tasks[i]
+            # the ordered path restamps job.priority at every placement
+            # whose pod carries an explicit priority — the last one (in
+            # kernel seq order) wins
+            for i in reversed(seg_l):
+                if placed_tasks[i].pod.priority is not None:
+                    job.priority = placed_tasks[i].priority
+                    break
 
         # --- apply the vectorized sums --------------------------------
         for col in np.nonzero(add_used.any(axis=1))[0]:
@@ -691,11 +893,19 @@ def _observe_dispatch_latency(bindings) -> None:
     ref session.go:319)."""
     import time as _time
 
+    from ..kernels.tensorize import load_kb_pack
     from ..metrics import update_task_schedule_durations
 
     now = _time.time()
-    update_task_schedule_durations(
-        [max(0.0, now - t.pod.creation_timestamp) for t, _ in bindings])
+    pack = load_kb_pack()
+    if pack is not None:
+        ages = np.empty((len(bindings), 1), np.float64)
+        pack.extract_f64([t for t, _ in bindings], _CREATION_PATH, ages)
+        durations = np.maximum(0.0, now - ages[:, 0]).tolist()
+    else:
+        durations = [max(0.0, now - t.pod.creation_timestamp)
+                     for t, _ in bindings]
+    update_task_schedule_durations(durations)
 
 
 def _apply_event_aggregates(ssn: Session,
@@ -715,11 +925,26 @@ def _apply_event_aggregates(ssn: Session,
             from ..framework.event import Event
             eh.allocate_func(Event(None))
     if drf is not None:
+        touched_attrs = []
         for job_uid, total in job_event_sum.items():
             attr = drf.job_opts.get(job_uid)
             if attr is not None:
                 attr.allocated.add(total)
-                drf._update_share(attr)
+                touched_attrs.append(attr)
+        if touched_attrs:
+            # dominant_share over all touched jobs as one array op; the
+            # f64 divisions/max are bitwise the per-attr Python values
+            # (share semantics: 0/0 -> 0, x/0 -> 1)
+            alloc = np.array(
+                [(a.allocated.milli_cpu, a.allocated.memory,
+                  a.allocated.milli_gpu) for a in touched_attrs])
+            tot = drf.total_resource
+            denom = np.array([tot.milli_cpu, tot.memory, tot.milli_gpu])
+            zero_d = denom == 0.0
+            sh = np.where(zero_d, np.where(alloc == 0.0, 0.0, 1.0),
+                          alloc / np.where(zero_d, 1.0, denom))
+            for a, s in zip(touched_attrs, sh.max(axis=1).tolist()):
+                a.share = s
     if prop is not None:
         touched = {}
         for job_uid, total in job_event_sum.items():

@@ -629,80 +629,162 @@ class SchedulerCache:
 
     def bind_many(self, bindings: List[Tuple[TaskInfo, str]]) -> None:
         """Batched bind: identical state flips to per-task bind(), but one
-        lock acquisition for the whole decision batch. The reference has no
-        counterpart (it fires one goroutine per bind, cache.go:423-429);
-        whole-cycle device solvers hand back thousands of decisions at once
-        and the per-bind lock/unlock churn dominates replay without this."""
+        lock acquisition for the whole decision batch, with the per-task
+        interpreter work collapsed into grouped/native column ops. The
+        reference has no counterpart (it fires one goroutine per bind,
+        cache.go:423-429); whole-cycle device solvers hand back thousands
+        of decisions at once and per-bind Python dominates replay without
+        this. Arithmetic lands as per-job / per-node float64 sums — same
+        values in a different addition order, far below the fit epsilons
+        (the discipline the bulk session replay already established)."""
+        from ..kernels.tensorize import (batch_clone_tasks, batch_set_attr,
+                                         extract_resreq)
+
         submits = []
         binding = TaskStatus.BINDING
-        #: hostname -> [cpu, mem, gpu] sums for one idle.sub/used.add per
-        #: node instead of per task (10k+ binds per cycle at cfg5; the
-        #: different addition order is float-immaterial vs the epsilons)
-        node_take: dict = {}
         with self._lock:
             # resolve every lookup BEFORE mutating: a vanished pod or a
             # duplicate key must reject the batch while the cache is still
-            # consistent (the deferred arithmetic below never half-applies)
+            # consistent (the deferred arithmetic below never half-applies).
+            # _find_job_and_task is inlined for the batch (10k+ calls);
+            # the miss path delegates to it for the exact error
             resolved = []
-            seen_keys: dict = {}
+            jobs_d = self.jobs
+            nodes_d = self.nodes
             for ti, hostname in bindings:
-                job, task = self._find_job_and_task(ti)
-                node = self.nodes.get(hostname)
+                job = jobs_d.get(ti.job)
+                task = job.tasks.get(ti.uid) if job is not None else None
+                if task is None:
+                    job, task = self._find_job_and_task(ti)
+                node = nodes_d.get(hostname)
                 if node is None:
                     raise KeyError(f"failed to bind Task {task.uid} to host "
                                    f"{hostname}, host does not exist")
-                keys = seen_keys.setdefault(hostname, set())
-                if task.key in node.tasks or task.key in keys:
-                    raise KeyError(
-                        f"task <{task.namespace}/{task.name}> already on "
-                        f"node <{node.name}>")
-                keys.add(task.key)
                 resolved.append((job, task, node, hostname))
-
-            for job, task, node, hostname in resolved:
-                # update_task_status(task, BINDING), inlined for the batch:
-                # the stored task IS ti's cache twin, so the net-zero
-                # total_request ops drop out; Pending isn't an allocated
-                # status, Binding is
-                index = job.task_status_index
-                bucket = index.get(task.status)
-                if bucket is not None:
-                    bucket.pop(task.uid, None)
-                    if not bucket:
-                        del index[task.status]
-                if allocated_status(task.status):
-                    job.allocated.sub(task.resreq)
-                task.status = binding
-                index.setdefault(binding, {})[task.uid] = task
-                if task.pod.priority is not None:
-                    job.priority = task.priority
-                job.allocated.add(task.resreq)
-                task.node_name = hostname
-                # NodeInfo.add_task minus the per-task arithmetic (batched
-                # into node_take below); Binding consumes idle
-                key = task.key
-                if node.node is not None:
-                    rr = task.resreq
-                    if task.is_backfill:
-                        node.backfilled.add(rr)
-                    acc = node_take.get(hostname)
-                    if acc is None:
-                        acc = node_take[hostname] = [0.0, 0.0, 0.0]
-                    acc[0] += rr.milli_cpu
-                    acc[1] += rr.memory
-                    acc[2] += rr.milli_gpu
-                if task.pod.has_pod_affinity():
-                    node.affinity_tasks += 1
-                node._own_tasks()
-                node.tasks[key] = task.clone()
-                self._mark_job(job.uid)
-                self._mark_node(hostname)
-                submits.append((task, task.pod, hostname))
-
-            for hostname, take in node_take.items():
+            # a batch naming one task twice is malformed (the per-host
+            # key check below only sees SAME-host duplicates): reject it
+            # whole while the cache is untouched — the deferred status
+            # flip would otherwise double-count job.allocated where the
+            # per-task loop's inline flip netted the repeat to zero
+            if len({t.uid for _, t, _, _ in resolved}) != len(resolved):
+                seen_uids: set = set()
+                for _, task, _, _ in resolved:
+                    if task.uid in seen_uids:
+                        raise KeyError(
+                            f"task {task.uid} appears twice in one "
+                            f"bind_many batch")
+                    seen_uids.add(task.uid)
+            #: hostname -> indices into resolved, in bindings order
+            by_host: Dict[str, list] = {}
+            for k, (_, task, _, hostname) in enumerate(resolved):
+                by_host.setdefault(hostname, []).append(k)
+            for hostname, idxs in by_host.items():
                 node = self.nodes[hostname]
-                node.idle.sub_vec(take)
-                node.used.add_vec(take)
+                key_set = {resolved[k][1].key for k in idxs}
+                if len(key_set) != len(idxs) or key_set & node.tasks.keys():
+                    seen: set = set()
+                    for k in idxs:      # error path: first conflict wins
+                        task = resolved[k][1]
+                        if task.key in node.tasks or task.key in seen:
+                            raise KeyError(
+                                f"task <{task.namespace}/{task.name}> "
+                                f"already on node <{node.name}>")
+                        seen.add(task.key)
+
+            twins = [r[1] for r in resolved]
+            hostnames = [r[3] for r in resolved]
+            # one native pass pulls every request the batched arithmetic
+            # needs (host units; falls back to a per-item loop without
+            # the packer)
+            raw = extract_resreq(twins)
+
+            # --- job side: index moves off the OLD status, allocated as
+            #     per-job net sums, priority restamp (last explicit wins,
+            #     matching the per-task order) -------------------------
+            by_job: Dict[str, list] = {}
+            for k, (job, _, _, _) in enumerate(resolved):
+                by_job.setdefault(job.uid, []).append(k)
+            cpu_l = raw[:, 0].tolist()
+            mem_l = raw[:, 1].tolist()
+            gpu_l = raw[:, 2].tolist()
+            for idxs in by_job.values():
+                job = resolved[idxs[0]][0]
+                index = job.task_status_index
+                c = m = g = 0.0
+                # whole-bucket fast path: when this batch drains the
+                # job's entire old-status bucket (a full gang binding out
+                # of PENDING — the cold-cycle common case), drop the
+                # bucket once instead of popping per task
+                first = resolved[idxs[0]][1]
+                bucket0 = index.get(first.status)
+                if (bucket0 is not None and len(bucket0) == len(idxs)
+                        and not allocated_status(first.status)
+                        and all(resolved[k][1].status is first.status
+                                and resolved[k][1].uid in bucket0
+                                for k in idxs)):
+                    del index[first.status]
+                    for k in idxs:
+                        c += cpu_l[k]
+                        m += mem_l[k]
+                        g += gpu_l[k]
+                else:
+                    for k in idxs:
+                        task = resolved[k][1]
+                        bucket = index.get(task.status)
+                        if bucket is not None:
+                            bucket.pop(task.uid, None)
+                            if not bucket:
+                                del index[task.status]
+                        # update_task_status(task, BINDING), inlined: the
+                        # stored task IS ti's cache twin, so the net-zero
+                        # total_request ops drop out; Pending isn't an
+                        # allocated status, Binding is — and a twin
+                        # already in an allocated status contributes
+                        # sub+add = nothing
+                        if not allocated_status(task.status):
+                            c += cpu_l[k]
+                            m += mem_l[k]
+                            g += gpu_l[k]
+                job.allocated.add_vec((c, m, g))
+                bucket = index.get(binding)
+                if bucket is None:
+                    bucket = index[binding] = {}
+                bucket.update((resolved[k][1].uid, resolved[k][1])
+                              for k in idxs)
+                for k in reversed(idxs):
+                    if resolved[k][1].pod.priority is not None:
+                        job.priority = resolved[k][1].priority
+                        break
+                self._mark_job(job.uid)
+
+            batch_set_attr(twins, "status", binding)
+            batch_set_attr(twins, "node_name", hostnames)
+            clones = batch_clone_tasks(twins, binding, hostnames)
+
+            # --- node side: NodeInfo.add_task with the per-task
+            #     arithmetic batched per node; Binding consumes idle ----
+            backfill_l = [t.is_backfill for t in twins]
+            has_backfill = True in backfill_l
+            for hostname, idxs in by_host.items():
+                node = self.nodes[hostname]
+                if node.node is not None:
+                    if has_backfill:
+                        for k in idxs:
+                            if backfill_l[k]:
+                                node.backfilled.add(twins[k].resreq)
+                    take = raw[idxs].sum(axis=0)
+                    node.idle.sub_vec(take)
+                    node.used.add_vec(take)
+                # the maintained job counter screens the per-pod affinity
+                # walk: a job with zero affinity tasks can't contribute
+                if any(resolved[k][0].affinity_tasks for k in idxs):
+                    node.affinity_tasks += sum(
+                        1 for k in idxs if twins[k].pod.has_pod_affinity())
+                node._own_tasks()
+                node.tasks.update((twins[k].key, clones[k]) for k in idxs)
+                self._mark_node(hostname)
+
+            submits.extend((t, t.pod, h) for t, h in zip(twins, hostnames))
 
         if self._pool is None:
             # sync mode: run inline without the per-task closure allocation

@@ -14,7 +14,7 @@ from typing import List
 
 from ..conf import Tier
 from ..metrics import (ON_SESSION_CLOSE, ON_SESSION_OPEN,
-                       update_plugin_duration)
+                       update_host_phase, update_plugin_duration)
 from .registry import get_plugin_builder
 from .session import Session, close_session, open_session, validate_jobs
 
@@ -23,6 +23,7 @@ def open_session_with_tiers(cache, tiers: List[Tier],
                             enable_preemption: bool = False,
                             snapshot=None) -> Session:
     """ref: framework.go:29-50 (OpenSession)."""
+    t0 = time.perf_counter()
     ssn = open_session(cache, enable_preemption, snapshot=snapshot)
     ssn.tiers = tiers
     for tier in tiers:
@@ -38,6 +39,7 @@ def open_session_with_tiers(cache, tiers: List[Tier],
         update_plugin_duration(plugin.name, ON_SESSION_OPEN,
                                time.perf_counter() - start)
     validate_jobs(ssn)
+    update_host_phase("open", time.perf_counter() - t0)
     return ssn
 
 
@@ -47,12 +49,14 @@ OpenSession = open_session_with_tiers
 
 def CloseSession(ssn: Session) -> None:
     """ref: framework.go:53-61."""
+    t0 = time.perf_counter()
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
         plugin.on_session_close(ssn)
         update_plugin_duration(plugin.name, ON_SESSION_CLOSE,
                                time.perf_counter() - start)
     close_session(ssn)
+    update_host_phase("close", time.perf_counter() - t0)
 
 
 close_session_with_plugins = CloseSession

@@ -287,7 +287,7 @@ class SessionAffinityMasks:
         self._task_pref: Dict[str, tuple] = {}
         for t in pending:
             aff = t.pod.affinity
-            if aff is None and not t.pod.host_ports():
+            if aff is None and not t.pod.has_host_ports():
                 continue
             req = anti = ()
             if aff is not None and with_predicates:

@@ -141,20 +141,33 @@ class StaticTerms:
 
 
 def _build_profiles(names: Sequence[str], n_padded: int, rel_keys: Tuple,
-                    labels_of, taints_of):
+                    labels_taints_of):
     """Dedup nodes into (restricted-labels, taints) profiles. Shared by
     the per-cycle builder and the persistent TermsCache — their contract
     is exact equality (test_terms_cache_matches_fresh_build_across_cycles),
-    so the profile key lives in exactly one place."""
+    so the profile key lives in exactly one place.
+
+    ``labels_taints_of(name) -> (labels, taints)`` resolves both fields in
+    one lookup; the loop runs once per node per (re)build — O(5k) at the
+    stress config — so the dominant plain-node shape (no referenced
+    labels, no taints) takes the hoisted-key fast branch."""
     profile_of = np.zeros(n_padded, np.int32)
     profiles: List[Tuple[Dict[str, str], list]] = []
     prof_index: Dict[Tuple, int] = {}
+    no_rel = not rel_keys
+    plain_key = ((), ())
+    plain_restricted: Dict[str, str] = {}
     for col, name in enumerate(names):
-        labels = labels_of(name)
-        taints = taints_of(name)
-        restricted = {k: labels[k] for k in rel_keys if k in labels}
-        key = (tuple(sorted(restricted.items())),
-               tuple((t.key, t.value, t.effect) for t in taints))
+        labels, taints = labels_taints_of(name)
+        if no_rel or not labels:
+            restricted = plain_restricted
+            key = (plain_key if not taints
+                   else ((), tuple((t.key, t.value, t.effect)
+                                   for t in taints)))
+        else:
+            restricted = {k: labels[k] for k in rel_keys if k in labels}
+            key = (tuple(sorted(restricted.items())),
+                   tuple((t.key, t.value, t.effect) for t in taints))
         p = prof_index.get(key)
         if p is None:
             p = len(profiles)
@@ -217,8 +230,8 @@ def build_static_terms(state: NodeState, tasks: Sequence[TaskInfo],
     # --- unique node profiles ----------------------------------------
     profile_of, profiles = _build_profiles(
         state.names, state.n_padded, rel_keys,
-        lambda name: node_labels.get(name, {}),
-        lambda name: node_taints.get(name, []))
+        lambda name: (node_labels.get(name, {}),
+                      node_taints.get(name, [])))
     n_prof = max(1, len(profiles))
 
     # --- evaluate per (sig, profile) via the host matchers ------------
@@ -266,28 +279,30 @@ class TermsCache:
         self._pred_rows: List[np.ndarray] = []
         self._score_rows: List[np.ndarray] = []
         self._stacked: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        #: generation token for the per-pod sig-row memo; a fresh object()
+        #: per rebuild invalidates every memo by identity
+        self._gen = object()
 
     def _rebuild_profiles(self, state: NodeState, ssn,
                           rel_keys: frozenset) -> None:
         self.rel_keys = rel_keys
         self.names = list(state.names)
         nodes = ssn.nodes
+        _empty_lt = ({}, [])
 
-        def labels_of(name):
+        def labels_taints_of(name):
             ni = nodes.get(name)
-            return ni.node.labels if (ni is not None and ni.node) else {}
-
-        def taints_of(name):
-            ni = nodes.get(name)
-            return ni.node.taints if (ni is not None and ni.node) else []
+            nd = ni.node if ni is not None else None
+            return (nd.labels, nd.taints) if nd is not None else _empty_lt
 
         self.profile_of, self.profiles = _build_profiles(
             state.names, state.n_padded, tuple(sorted(rel_keys)),
-            labels_of, taints_of)
+            labels_taints_of)
         self.sig_index = {}
         self._pred_rows = []
         self._score_rows = []
         self._stacked = None
+        self._gen = object()    # identity token for the per-pod row memo
         self.ready = True
 
     def _sig_row(self, pod: Pod, with_predicates: bool,
@@ -323,11 +338,23 @@ class TermsCache:
                 or self.names != list(state.names)):
             self.flags = flags
             self._rebuild_profiles(state, ssn, rel | self.rel_keys)
-        sig_of = {
-            t.uid: self._sig_row(t.pod, with_predicates,
-                                 with_node_affinity_score,
-                                 node_affinity_weight)
-            for t in tasks}
+        # per-pod row memo: pod specs are immutable and sig_index only
+        # grows within a generation, so (gen, row) cached on the pod
+        # replaces the signature-tuple hash per task per cycle — 10k
+        # pending share a handful of signatures at the stress configs
+        gen = self._gen
+        sig_of = {}
+        for t in tasks:
+            pod = t.pod
+            memo = getattr(pod, "_kb_sigrow", None)
+            if memo is not None and memo[0] is gen:
+                sig_of[t.uid] = memo[1]
+            else:
+                s = self._sig_row(pod, with_predicates,
+                                  with_node_affinity_score,
+                                  node_affinity_weight)
+                pod._kb_sigrow = (gen, s)
+                sig_of[t.uid] = s
         if not self._pred_rows:             # no tasks at all
             self._sig_row(Pod(name="-empty-"), with_predicates,
                           with_node_affinity_score, node_affinity_weight)
@@ -374,17 +401,43 @@ def dynamic_features(ssn, pending: Sequence[TaskInfo]) -> Optional[str]:
 
     The pending-dependent scans run fresh per call (callers pass
     differently-filtered pending lists — allocate drops BestEffort
-    tasks, the victim solvers don't). Only the SESSION-WIDE walk over
-    jobs/nodes is memoized: existing pods' affinity counters can only
-    decrease in-session (no pod is added mid-session), so a cached
+    tasks, the victim solvers don't), EXCEPT when the caller hands the
+    very same list object again (the cycle tensorizer asks twice per
+    build: the engine-support gate, then the affinity screen) — that
+    repeat is memoized by list identity. The SESSION-WIDE walk over
+    jobs/nodes is memoized too: existing pods' affinity counters can
+    only decrease in-session (no pod is added mid-session), so a cached
     positive is at worst over-conservative.
     """
+    memo = getattr(ssn, "_dyn_pending_memo", None)
+    if memo is not None and memo[0] is pending:
+        return memo[1]
+    result = _dynamic_features_uncached(ssn, pending)
+    try:
+        ssn._dyn_pending_memo = (pending, result)
+    except Exception:       # slots-only fake sessions in tests
+        pass
+    return result
+
+
+def _dynamic_features_uncached(ssn,
+                               pending: Sequence[TaskInfo]) -> Optional[str]:
     for t in pending:
-        if t.pod.host_ports():
+        if t.pod.has_host_ports():
             return "pending task with host ports"
-    for t in pending:
-        if _has_pod_affinity(t.pod):
-            return "pending task with pod (anti-)affinity"
+    # the maintained per-job counters screen the O(pending) affinity walk:
+    # every pending task belongs to a session job, so zero affinity tasks
+    # across jobs proves no pending pod carries a term (the walk then runs
+    # only on cycles that can actually hit)
+    try:
+        jobs_have_affinity = any(job.affinity_tasks
+                                 for job in ssn.jobs.values())
+    except Exception:       # slots-only fake sessions in tests
+        jobs_have_affinity = True
+    if jobs_have_affinity:
+        for t in pending:
+            if _has_pod_affinity(t.pod):
+                return "pending task with pod (anti-)affinity"
     memo = getattr(ssn, "_dyn_session_aff_memo", _DYN_MISS)
     if memo is not _DYN_MISS:
         return memo

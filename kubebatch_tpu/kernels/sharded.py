@@ -26,6 +26,20 @@ from .tensorize import VEC_EPS
 SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
 AXIS = "nodes"
 
+# jax moved shard_map out of experimental and renamed check_rep ->
+# check_vma; support both spellings (0.4.x containers run the
+# experimental one)
+if hasattr(jax, "shard_map"):
+    def _shard_map(mesh, in_specs, out_specs):
+        return partial(jax.shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(mesh, in_specs, out_specs):
+        return partial(_exp_shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=False)
+
 
 def _sharded_scan_body(backfilled, max_task_num, node_ok, min_available):
     """Returns the per-task scan step closed over static-per-visit arrays
@@ -93,11 +107,10 @@ def build_sharded_allocate(mesh: Mesh):
     rep = P()
     tn = P(None, AXIS)
 
-    @partial(jax.shard_map, mesh=mesh,
-             in_specs=(node2, node2, node2, node1, node1, node1,
-                       rep, rep, rep, tn, tn, rep, rep),
-             out_specs=(rep, rep, node2, node2, node1, rep),
-             check_vma=False)
+    @_shard_map(mesh,
+                in_specs=(node2, node2, node2, node1, node1, node1,
+                          rep, rep, rep, tn, tn, rep, rep),
+                out_specs=(rep, rep, node2, node2, node1, rep))
     def run(idle, releasing, backfilled, max_task_num, n_tasks, node_ok,
             resreq, init_resreq, task_valid, scores, pred_mask,
             min_available, init_allocated):

@@ -26,7 +26,7 @@ from ..util import env_on
 from ..api.resource import RESOURCE_DIM, VEC_EPS, VEC_SCALE
 
 __all__ = ["NodeState", "TaskBatch", "pad_to_bucket", "sticky_bucket",
-           "VEC_EPS",
+           "VEC_EPS", "batch_clone_tasks", "batch_set_attr",
            "NONZERO_MILLI_CPU", "NONZERO_MEM_MIB", "nz_request_vec"]
 
 #: upstream DefaultNonZeroRequest (priorityutil.GetNonzeroRequests) in
@@ -224,6 +224,69 @@ _NODE_PATHS = _intern_paths(
 
 _NZ_PATHS = _intern_paths(("resreq", "milli_cpu"), ("resreq", "memory"))
 
+_RESREQ_PATHS = _intern_paths(
+    ("resreq", "milli_cpu"), ("resreq", "memory"), ("resreq", "milli_gpu"))
+
+#: TaskInfo slots copied verbatim by batch_clone_tasks; status/node_name
+#: arrive as overrides so the C pass writes each slot exactly once
+_TASK_CLONE_COPY = tuple(s for s in TaskInfo.__slots__
+                         if s not in ("status", "node_name"))
+_CLONE_OVERRIDES = ("status", "node_name")
+
+
+def batch_clone_tasks(tasks, statuses, node_names):
+    """TaskInfo.clone over a whole decision batch, with status/node_name
+    overridden in the same pass — the decision replay inserts one clone
+    per placement into the node task maps (NodeInfo's COW contract), 10k+
+    per cold stress cycle. ``statuses``: a list (per task) or one shared
+    status; ``node_names``: a list of hostnames. Runs in C when the
+    packer module carries clone_with (kb_pack.c); the Python fallback is
+    semantically identical."""
+    pack = load_kb_pack()
+    if pack is not None and hasattr(pack, "clone_with"):
+        return pack.clone_with(tasks, _TASK_CLONE_COPY, _CLONE_OVERRIDES,
+                               (statuses, node_names))
+    per_task = isinstance(statuses, list)
+    out = []
+    for i, t in enumerate(tasks):
+        c = t.clone()
+        c.status = statuses[i] if per_task else statuses
+        c.node_name = node_names[i]
+        out.append(c)
+    return out
+
+
+def extract_resreq(tasks) -> np.ndarray:
+    """[n, 3] float64 host-unit resreq rows for a task list — one native
+    pass when the packer is built (cache.bind_many batches its per-job /
+    per-node arithmetic from these)."""
+    n = len(tasks)
+    out = np.empty((n, RESOURCE_DIM), np.float64)
+    if n:
+        pack = load_kb_pack()
+        if pack is not None:
+            pack.extract_f64(tasks, _RESREQ_PATHS, out)
+        else:
+            for i, t in enumerate(tasks):
+                rr = t.resreq
+                out[i] = (rr.milli_cpu, rr.memory, rr.milli_gpu)
+    return out
+
+
+def batch_set_attr(objs, name: str, values) -> None:
+    """objs[i].name = values[i] (list) or = values (shared), in C when
+    available — the replay's status/node_name flips over 10k+ tasks."""
+    pack = load_kb_pack()
+    if pack is not None and hasattr(pack, "set_attr"):
+        pack.set_attr(objs, name, values)
+        return
+    if isinstance(values, list):
+        for o, v in zip(objs, values):
+            setattr(o, name, v)
+    else:
+        for o in objs:
+            setattr(o, name, values)
+
 
 @dataclass
 class NodeState:
@@ -320,6 +383,36 @@ class TaskBatch:
     def from_tasks(cls, tasks: Sequence[TaskInfo],
                    min_bucket: int = 8) -> "TaskBatch":
         t = len(tasks)
+        raw = None
+        if t:
+            # one packed pass (see NodeState.from_nodes)
+            pack = load_kb_pack()
+            if pack is not None:
+                raw = np.empty((t, len(_TASK_PATHS)), np.float64)
+                pack.extract_f64(tasks, _TASK_PATHS, raw)
+            else:
+                raw = np.array(
+                    [(tk.resreq.milli_cpu, tk.resreq.memory,
+                      tk.resreq.milli_gpu,
+                      tk.init_resreq.milli_cpu, tk.init_resreq.memory,
+                      tk.init_resreq.milli_gpu) for tk in tasks],
+                    np.float64)
+        return cls._from_extracted(tasks, raw, min_bucket)
+
+    @classmethod
+    def from_raw(cls, tasks: Sequence[TaskInfo], raw6: np.ndarray,
+                 min_bucket: int = 8) -> "TaskBatch":
+        """Build from a pre-extracted [T, 6] float64 (resreq, init_resreq)
+        host-unit matrix in task order — the bulk cycle gather extracts
+        once for its filter/sort and hands the columns straight here,
+        skipping a second native pass over the backlog. ``raw6`` is
+        consumed (scaled in place); pass a private copy."""
+        assert raw6.shape == (len(tasks), 2 * RESOURCE_DIM)
+        return cls._from_extracted(tasks, raw6, min_bucket)
+
+    @classmethod
+    def _from_extracted(cls, tasks, raw, min_bucket: int) -> "TaskBatch":
+        t = len(tasks)
         t_pad = pad_to_bucket(t, min_bucket)
         resreq = np.zeros((t_pad, RESOURCE_DIM), np.float32)
         init_resreq = np.zeros((t_pad, RESOURCE_DIM), np.float32)
@@ -327,19 +420,7 @@ class TaskBatch:
         valid = np.zeros(t_pad, bool)
         resreq_raw = np.zeros((t_pad, RESOURCE_DIM), np.float64)
         if t:
-            # one packed pass (see NodeState.from_nodes)
-            pack = load_kb_pack()
-            if pack is not None:
-                raw = np.empty((t, len(_TASK_PATHS)), np.float64)
-                pack.extract_f64(tasks, _TASK_PATHS, raw)
-                raw = raw.reshape(t, 2, RESOURCE_DIM)
-            else:
-                raw = np.array(
-                    [(tk.resreq.milli_cpu, tk.resreq.memory,
-                      tk.resreq.milli_gpu,
-                      tk.init_resreq.milli_cpu, tk.init_resreq.memory,
-                      tk.init_resreq.milli_gpu) for tk in tasks],
-                    np.float64).reshape(t, 2, RESOURCE_DIM)
+            raw = np.ascontiguousarray(raw).reshape(t, 2, RESOURCE_DIM)
             resreq_raw[:t] = raw[:, 0]
             raw *= VEC_SCALE
             raw32 = raw.astype(np.float32)

@@ -235,6 +235,16 @@ class Pod:
             ports.extend(c.ports)
         return ports
 
+    def has_host_ports(self) -> bool:
+        """Memoized truthiness of host_ports() — the device-feature screen
+        asks this per pending pod per cycle, and container specs are
+        immutable for the pod's lifetime."""
+        flag = getattr(self, "_kb_hostports", None)
+        if flag is None:
+            flag = any(c.ports for c in self.containers)
+            self._kb_hostports = flag
+        return flag
+
     def has_pod_affinity(self) -> bool:
         """Any inter-pod (anti-)affinity term — the feature class that
         makes predicates/scores allocation-dependent (kernels/encode.py

@@ -42,6 +42,10 @@ def _default_identity() -> str:
     return f"{socket.gethostname()}_{uuid.uuid4()}"
 
 
+#: FileLease._read sentinel: a lease file exists but does not parse
+_UNREADABLE = object()
+
+
 @runtime_checkable
 class LeaseLock(Protocol):
     """The shared-medium seam (ref: client-go resourcelock.Interface as
@@ -83,18 +87,25 @@ class LeaderElector:
         lost = threading.Event()
 
         def renew_loop():
+            # Loss is declared from ACTUAL elapsed time since the last
+            # successful renew, measured on the monotonic clock AFTER each
+            # attempt. The old shape (a wall-clock deadline armed before
+            # the attempt window) mis-times under CPU starvation: a
+            # starved thread could wake past its own deadline having made
+            # zero real attempts, or keep re-arming windows and never
+            # accumulate the failures into a loss. Here every iteration
+            # performs one attempt, and a failed attempt counts against
+            # the renew deadline no matter how late the scheduler ran it.
+            last_renew = time.monotonic()
             while not stop.is_set() and not lost.is_set():
-                deadline = time.time() + self.renew_deadline
-                ok = False
-                while time.time() < deadline:
-                    if self.lock.try_acquire_or_renew():
-                        ok = True
-                        break
-                    stop.wait(min(1.0, self.retry_period))
-                if not ok:
+                if self.lock.try_acquire_or_renew():
+                    last_renew = time.monotonic()
+                    stop.wait(self.retry_period)
+                    continue
+                if time.monotonic() - last_renew >= self.renew_deadline:
                     lost.set()
                     return
-                stop.wait(self.retry_period)
+                stop.wait(min(1.0, self.retry_period))
 
         renewer = threading.Thread(target=renew_loop, daemon=True,
                                    name="kb-lease-renew")
@@ -133,11 +144,21 @@ class FileLease:
         self.identity = identity or _default_identity()
 
     def _read(self):
+        """The lease record, None when no lease file exists, or
+        ``_UNREADABLE`` when a file exists but does not parse. The
+        distinction is load-bearing: our own writes are atomic
+        (os.replace), so an unparseable file is another writer mid-write
+        — treating it as "free" would let a reader racing a non-atomic
+        writer steal the lease back (the lease-loss flake: a renew racing
+        the takeover's truncate+write window re-acquired over the new
+        holder, and loss was never detected)."""
         try:
             with open(self.path) as f:
                 return json.load(f)
-        except (OSError, ValueError):
+        except FileNotFoundError:
             return None
+        except (OSError, ValueError):
+            return _UNREADABLE
 
     def _write(self) -> bool:
         record = {"holder": self.identity,
@@ -163,6 +184,10 @@ class FileLease:
         try:
             fcntl.flock(guard, fcntl.LOCK_EX)
             rec = self._read()
+            if rec is _UNREADABLE:
+                # cannot prove the lease is free or ours — not renewed;
+                # the elector's retry loop settles it once readable
+                return False
             now = time.time()
             if rec is not None and rec.get("holder") != self.identity:
                 expires = rec.get("renew_time", 0) + rec.get(

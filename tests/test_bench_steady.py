@@ -8,11 +8,13 @@ import bench
 
 
 def test_run_steady_small_config():
-    latencies, bound, action_ms, readbacks = bench.run_steady(2, 2, "auto", 16)
+    latencies, bound, action_ms, readbacks, rss_mb = bench.run_steady(
+        2, 2, "auto", 16)
     assert len(latencies) == 2
     assert bound == 32          # 16 churn pods per measured cycle
     assert all(dt > 0 for dt in latencies)
     assert "allocate" in action_ms and action_ms["allocate"] >= 0
+    assert rss_mb > 0           # soak evidence: peak RSS is reported
 
 
 def test_bench_main_one_json_line(capsys):
@@ -39,14 +41,17 @@ def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
 
     monkeypatch.setattr(bench, "ensure_responsive_backend",
                         lambda *a, **k: "cpu-fallback")
-    monkeypatch.setattr(bench, "run_config",
-                        lambda *a: ([0.1, 0.1], 200, 0.2, 0, {}, ["batched"], [1, 1], [0.01, 0.01]))
+    monkeypatch.setattr(
+        bench, "run_config",
+        lambda *a: ([0.1, 0.1], 200, 0.2, 0, {}, ["batched"], [1, 1],
+                    [0.01, 0.01], {"tensorize": 1.0, "replay": 2.0,
+                                   "close": 0.5}))
     steady_ran = {}
 
     def fake_steady(*a):
         # the primary line must already be visible at this point
         steady_ran["primary_first"] = capsys.readouterr().out.strip()
-        return [0.05] * 5, 1280, {"allocate": 40.0}, [1, 1, 1, 1, 1]
+        return [0.05] * 5, 1280, {"allocate": 40.0}, [1, 1, 1, 1, 1], 100.0
 
     monkeypatch.setattr(bench, "run_steady", fake_steady)
     rc = bench.main(["--config", "5", "--cycles", "2"])

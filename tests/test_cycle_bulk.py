@@ -1,0 +1,292 @@
+"""Bulk host-path equivalence (VERDICT r5 directive 1).
+
+The cold-cycle host rebuild (columnar batch tensorize + bulk bind
+replay) must be semantically invisible:
+
+- the native gather+lexsort produces the same tasks, in the same
+  per-job task order, with the same arrays as the per-job Python path;
+- a full engine cycle through the bulk replay + batched cache.bind_many
+  leaves the CACHE (twin resolution included), not just the session, in
+  the same end state as the ordered per-event replay.
+
+Wall-time budgets live in bench.py evidence lines; the structural pin
+here is the slow-path item counter — per-item fallback work must be 0
+on supported cycles — which is throttle-immune where a milliseconds
+assertion is not.
+"""
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.metrics import slow_path_items
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+FULL_TIERS = [
+    Tier(plugins=[PluginOption(name="priority"),
+                  PluginOption(name="gang"),
+                  PluginOption(name="conformance")]),
+    Tier(plugins=[PluginOption(name="drf"),
+                  PluginOption(name="predicates"),
+                  PluginOption(name="proportion"),
+                  PluginOption(name="nodeorder")]),
+]
+
+#: no priority plugin: the fifo (creation, uid) task-sort key
+NO_PRIORITY_TIERS = [
+    Tier(plugins=[PluginOption(name="gang"),
+                  PluginOption(name="drf"),
+                  PluginOption(name="predicates"),
+                  PluginOption(name="proportion"),
+                  PluginOption(name="nodeorder")]),
+]
+
+
+class RecordingBinder:
+    def __init__(self):
+        self.binds = {}
+
+    def bind(self, pod, hostname):
+        self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+
+    def evict(self, pod):
+        pod.deletion_timestamp = 1.0
+
+
+def _populate(cache, seed=23, n_jobs=12):
+    """Adversarial sort shapes: duplicate priorities, equal creation
+    timestamps (uid tie-break), interleaved job creation ranks, one
+    all-BestEffort job (empty resreq -> filtered), one partially-empty
+    job, and a backfill-annotated job."""
+    rng = np.random.default_rng(seed)
+    cache.add_queue(build_queue("q1"))
+    cache.add_queue(build_queue("q2", 3))
+    for i in range(10):
+        cache.add_node(build_node(
+            f"n{i:02d}", rl(float(rng.uniform(3000, 6000)),
+                            float(rng.uniform(6, 12)) * GiB, pods=24)))
+    for g in range(n_jobs):
+        cache.add_pod_group(build_group(
+            "ns", f"g{g:02d}", int(rng.integers(1, 3)),
+            queue=f"q{g % 2 + 1}",
+            creation_timestamp=float(rng.integers(0, 4))))
+        for p in range(int(rng.integers(2, 5))):
+            empty = (g == 4) or (g == 5 and p == 0)
+            cache.add_pod(build_pod(
+                "ns", f"g{g:02d}-{p}", "", "Pending",
+                rl(0.0, 0.0) if empty else
+                rl(float(rng.uniform(200, 900)),
+                   float(rng.uniform(0.3, 1.5)) * GiB),
+                group=f"g{g:02d}",
+                priority=(None if g == 6 else int(rng.integers(0, 3))),
+                backfill=(g == 7),
+                creation_timestamp=float(rng.integers(0, 3))))
+
+
+@pytest.mark.parametrize("tiers", [FULL_TIERS, NO_PRIORITY_TIERS],
+                         ids=["priority", "fifo"])
+def test_bulk_gather_matches_per_item(tiers, monkeypatch):
+    """bulk tensorize == per-item tensorize: same tasks, same order, same
+    arrays — and the per-item path is the one that counts slow-path
+    items, the bulk path counts none."""
+    from kubebatch_tpu.actions.cycle_inputs import build_cycle_inputs
+
+    def build(per_item):
+        if per_item:
+            monkeypatch.setenv("KB_BULK_TENSORIZE", "0")
+        else:
+            monkeypatch.delenv("KB_BULK_TENSORIZE", raising=False)
+        cache = SchedulerCache(binder=RecordingBinder(),
+                               async_writeback=False)
+        _populate(cache)
+        ssn = OpenSession(cache, tiers)
+        sp0 = slow_path_items().get("tensorize", 0)
+        inputs = build_cycle_inputs(ssn)
+        slow = slow_path_items().get("tensorize", 0) - sp0
+        assert inputs is not None and inputs != "empty-cycle"
+        return inputs, slow
+
+    bulk, bulk_slow = build(per_item=False)
+    item, item_slow = build(per_item=True)
+
+    assert bulk_slow == 0, "bulk gather must not count slow-path items"
+    assert item_slow == len(item.tasks) > 0, \
+        "per-item gather must count its items"
+    assert [t.uid for t in bulk.tasks] == [t.uid for t in item.tasks], \
+        "task gather order diverges"
+    np.testing.assert_array_equal(np.asarray(bulk.task_job),
+                                  np.asarray(item.task_job))
+    np.testing.assert_array_equal(np.asarray(bulk.task_rank),
+                                  np.asarray(item.task_rank))
+    for field in ("resreq", "init_resreq", "resreq_raw", "task_nz",
+                  "task_valid"):
+        np.testing.assert_array_equal(
+            getattr(bulk, field), getattr(item, field),
+            err_msg=f"{field} diverges between bulk and per-item gather")
+
+
+def _cache_state(cache):
+    """Cache-twin end state: task statuses/placements, node task maps
+    (held status included — allocation-time semantics), node accounting,
+    job allocated totals."""
+    jobs = {uid: sorted((t.uid, t.status.name, t.node_name)
+                        for t in j.tasks.values())
+            for uid, j in cache.jobs.items()}
+    node_maps = {n.name: sorted((k, t.status.name)
+                                for k, t in n.tasks.items())
+                 for n in cache.nodes.values()}
+    accounting = {n.name: (n.idle.milli_cpu, n.idle.memory,
+                           n.used.milli_cpu, n.used.memory,
+                           n.backfilled.milli_cpu)
+                  for n in cache.nodes.values()}
+    alloc = {uid: (j.allocated.milli_cpu, j.allocated.memory)
+             for uid, j in cache.jobs.items()}
+    return jobs, node_maps, accounting, alloc
+
+
+@pytest.mark.parametrize("mode", ["batched", "fused"])
+def test_bulk_replay_cache_state_matches_ordered(mode, monkeypatch):
+    """Full-cycle end-state equivalence INCLUDING the cache twins: the
+    bulk replay (batched cache.bind_many) and the ordered per-event
+    replay must leave identical cache state — statuses, node task maps,
+    accounting (to float tolerance: the sums run in a different addition
+    order), and identical external binds."""
+    from kubebatch_tpu.actions import cycle_inputs
+
+    def run(ordered):
+        if ordered:
+            monkeypatch.setattr(cycle_inputs, "_bulk_replay_supported",
+                                lambda ssn: False)
+        binder = RecordingBinder()
+        cache = SchedulerCache(binder=binder, evictor=binder,
+                               async_writeback=False)
+        _populate(cache, seed=31, n_jobs=14)
+        ssn = OpenSession(cache, FULL_TIERS)
+        engine = AllocateAction(mode=mode)
+        engine.execute(ssn)
+        CloseSession(ssn)
+        return _cache_state(cache), dict(binder.binds)
+
+    (jobs_b, maps_b, acct_b, alloc_b), binds_b = run(ordered=False)
+    monkeypatch.undo()
+    (jobs_o, maps_o, acct_o, alloc_o), binds_o = run(ordered=True)
+
+    assert binds_b, "scenario must actually schedule"
+    assert binds_b == binds_o, "external binds diverge"
+    assert jobs_b == jobs_o, "cache job/task statuses diverge"
+    assert maps_b == maps_o, "cache node task maps diverge"
+    for name in acct_o:
+        np.testing.assert_allclose(
+            np.asarray(acct_b[name]), np.asarray(acct_o[name]),
+            rtol=1e-9, atol=1e-3, err_msg=f"node {name} accounting")
+    for uid in alloc_o:
+        np.testing.assert_allclose(
+            np.asarray(alloc_b[uid]), np.asarray(alloc_o[uid]),
+            rtol=1e-9, atol=1e-3, err_msg=f"job {uid} allocated")
+
+
+def test_bind_many_batched_matches_per_task_bind():
+    """cache.bind_many's grouped/batched internals == a per-task bind()
+    loop on an identical cache (twin resolution, index moves, node maps,
+    arithmetic), including a mixed multi-job multi-node batch."""
+    def fresh():
+        binder = RecordingBinder()
+        cache = SchedulerCache(binder=binder, async_writeback=False)
+        _populate(cache, seed=7, n_jobs=8)
+        return cache, binder
+
+    def pending_bindings(cache):
+        out = []
+        hosts = sorted(cache.nodes)
+        i = 0
+        for j in sorted(cache.jobs.values(), key=lambda j: j.uid):
+            for t in sorted(j.tasks.values(), key=lambda t: t.uid):
+                if t.status.name == "PENDING" and not t.resreq.is_empty():
+                    out.append((t, hosts[i % len(hosts)]))
+                    i += 1
+        return out
+
+    cache_a, binder_a = fresh()
+    cache_b, binder_b = fresh()
+    many = pending_bindings(cache_a)
+    cache_a.bind_many(many)
+    for ti, hostname in pending_bindings(cache_b):
+        cache_b.bind(ti, hostname)
+    cache_a.drain()
+    cache_b.drain()
+
+    assert binder_a.binds == binder_b.binds and binder_a.binds
+    sa, sb = _cache_state(cache_a), _cache_state(cache_b)
+    assert sa[0] == sb[0], "job/task statuses diverge"
+    assert sa[1] == sb[1], "node task maps diverge"
+    for name in sb[2]:
+        np.testing.assert_allclose(np.asarray(sa[2][name]),
+                                   np.asarray(sb[2][name]),
+                                   rtol=1e-9, atol=1e-3, err_msg=name)
+    for uid in sb[3]:
+        np.testing.assert_allclose(np.asarray(sa[3][uid]),
+                                   np.asarray(sb[3][uid]),
+                                   rtol=1e-9, atol=1e-3, err_msg=uid)
+
+
+#: predicates AND nodeorder disabled: the affinity tensor build is
+#: skipped regardless of pod specs (terms.py device_supported), so
+#: inputs.affinity is None even when pods carry (anti-)affinity terms
+NO_AFFINITY_BUILD_TIERS = [
+    Tier(plugins=[PluginOption(name="priority"),
+                  PluginOption(name="gang"),
+                  PluginOption(name="drf"),
+                  PluginOption(name="proportion")]),
+]
+
+
+@pytest.mark.parametrize("mode", ["batched", "fused"])
+def test_bulk_replay_affinity_counters_without_affinity_build(
+        mode, monkeypatch):
+    """node.affinity_tasks maintenance must not be gated on the affinity
+    TENSOR build: with predicates/nodeorder disabled the build is skipped
+    (inputs.affinity is None) while placed pods can still carry affinity
+    terms — the bulk replay must keep the session counters identical to
+    the ordered path (regression: the bulk path skipped the counter
+    walk whenever inputs.affinity was None)."""
+    from kubebatch_tpu.actions import cycle_inputs
+    from kubebatch_tpu.objects import Affinity, PodAffinityTerm
+
+    def run(ordered):
+        if ordered:
+            monkeypatch.setattr(cycle_inputs, "_bulk_replay_supported",
+                                lambda ssn: False)
+        binder = RecordingBinder()
+        cache = SchedulerCache(binder=binder, evictor=binder,
+                               async_writeback=False)
+        _populate(cache, seed=11, n_jobs=6)
+        # a gang whose pods all carry an anti-affinity term
+        cache.add_pod_group(build_group("ns", "gaff", 1, queue="q1"))
+        for p in range(3):
+            cache.add_pod(build_pod(
+                "ns", f"gaff-{p}", "", "Pending", rl(300.0, GiB),
+                group="gaff", labels={"app": "aff"},
+                affinity=Affinity(pod_anti_affinity_required=[
+                    PodAffinityTerm(match_labels={"app": "aff"})])))
+        ssn = OpenSession(cache, NO_AFFINITY_BUILD_TIERS)
+        engine = AllocateAction(mode=mode)
+        engine.execute(ssn)
+        counters = {n.name: n.affinity_tasks for n in ssn.nodes.values()}
+        CloseSession(ssn)
+        return counters, dict(binder.binds)
+
+    counters_b, binds_b = run(ordered=False)
+    monkeypatch.undo()
+    counters_o, binds_o = run(ordered=True)
+
+    assert binds_b == binds_o and binds_b, "scenario must schedule"
+    assert any(f"gaff-{p}" in f"ns/gaff-{p}" and f"ns/gaff-{p}" in binds_b
+               for p in range(3)), "affinity pods must place"
+    assert counters_b == counters_o, \
+        "session node affinity_tasks diverge between bulk and ordered"
+    assert sum(counters_b.values()) >= 1, \
+        "placed affinity pods must be counted"

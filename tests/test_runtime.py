@@ -1,6 +1,7 @@
 """Scheduler loop, conf loading, CLI, leader election
 (ref: scheduler.go, util.go, cmd/kube-batch)."""
 import json
+import os
 import subprocess
 import sys
 import threading
@@ -121,9 +122,24 @@ class _FileBackend:
                          identity=identity)
 
     def steal(self):
-        with open(self.path, "w") as f:
-            json.dump({"holder": "thief", "renew_time": time.time() + 100,
-                       "lease_duration": 60}, f)
+        # Every legitimate writer of the shared medium serializes on the
+        # guard flock (FileLease.try_acquire_or_renew does; a k8s-style
+        # CAS would too). Writing WITHOUT it can land between the
+        # holder's guarded read and its atomic replace — the renew then
+        # overwrites the thief and no holder logic can ever detect the
+        # (lost-update) takeover. The unguarded/non-atomic writer
+        # scenarios are covered by
+        # test_file_lease_unreadable_file_is_not_stolen.
+        import fcntl
+        with open(f"{self.path}.guard", "a+") as guard:
+            fcntl.flock(guard, fcntl.LOCK_EX)
+            tmp = f"{self.path}.thief.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"holder": "thief",
+                           "renew_time": time.time() + 100,
+                           "lease_duration": 60}, f)
+            os.replace(tmp, self.path)
+            fcntl.flock(guard, fcntl.LOCK_UN)
 
     def close(self):
         pass
@@ -196,6 +212,31 @@ def test_lease_run_and_loss(lease_backend):
 
     lease.run(work, lost, stop)
     assert events == ["started", "workload-stopped", "lost"]
+
+
+def test_file_lease_unreadable_file_is_not_stolen(tmp_path):
+    """A lease file that exists but does not parse is another writer
+    mid-write (our own writes are atomic) — reading it as 'free' let a
+    renew racing a takeover's truncate+write window steal the lease back,
+    so loss was never detected (the test_lease_run_and_loss flake)."""
+    path = str(tmp_path / "leader.lock")
+    lease = FileLease(path, lease_duration=0.5, renew_deadline=0.3,
+                      retry_period=0.1, identity="a")
+    assert lease.try_acquire_or_renew() is True
+    # a non-atomic writer's window: the file exists but holds garbage
+    with open(path, "w") as f:
+        f.write('{"holder": "thi')
+    assert lease.try_acquire_or_renew() is False, \
+        "an unreadable lease file must read as not-renewed, not free"
+    # the thief's write completes -> a live foreign lease, still False
+    with open(path, "w") as f:
+        json.dump({"holder": "thief", "renew_time": time.time() + 100,
+                   "lease_duration": 60}, f)
+    assert lease.try_acquire_or_renew() is False
+    # a missing file IS free
+    import os
+    os.unlink(path)
+    assert lease.try_acquire_or_renew() is True
 
 
 def test_http_lease_server_boot_grace_blocks_takeover():

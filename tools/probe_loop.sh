@@ -2,6 +2,12 @@
 # Recurring tunnel probe, appending one JSON line per attempt to
 # PROBE_LOG_r05.jsonl — the evidence trail for VERDICT r4 directive 6
 # ("or the probe log proving no window existed").
+#
+# VERDICT r5 directive 4: the FIRST alive probe triggers the full device
+# sweep (tools/device_sweep.sh) so a transient tunnel window is spent on
+# the automated measurement set, not on opportunistic manual runs. A
+# marker file makes the sweep one-shot per revision; every bench line
+# lands in BENCH_DEVICE.jsonl (bench.py stamps ts + git SHA itself).
 cd /root/repo || exit 1
 TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
 RAW=$(timeout 100 python tools/probe_tunnel.py 2>/dev/null)
@@ -13,3 +19,14 @@ if ! printf %s "$OUT" | python -c 'import json,sys; json.loads(sys.stdin.read())
   OUT="{\"alive\": false, \"error\": \"probe produced no parseable line (rc=$RC; outer-timeout wedge or mid-print kill)\"}"
 fi
 echo "{\"probe_ts\": \"$TS\", \"rc\": $RC, \"result\": $OUT}" >> PROBE_LOG_r05.jsonl
+
+if [ "$RC" -eq 0 ]; then
+  SHA=$(git rev-parse --short HEAD 2>/dev/null || echo unknown)
+  MARKER="/tmp/kb_device_sweep_done_$SHA"
+  if [ ! -e "$MARKER" ]; then
+    : > "$MARKER"
+    echo "{\"probe_ts\": \"$TS\", \"sweep\": \"started\", \"sha\": \"$SHA\"}" >> PROBE_LOG_r05.jsonl
+    sh tools/device_sweep.sh >> /tmp/kb_device_sweep.log 2>&1
+    echo "{\"probe_ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"sweep\": \"finished\", \"rc\": $?, \"sha\": \"$SHA\"}" >> PROBE_LOG_r05.jsonl
+  fi
+fi
