@@ -92,6 +92,57 @@ def ensure_responsive_backend(timeout: float = 120.0) -> str:
     return backend
 
 
+def ensure_rpc_sidecar():
+    """--mode rpc support: probe KUBEBATCH_SOLVER_ADDR for a live
+    sidecar; when nothing answers, start an in-process one on a free
+    port (rpc/server.make_server) and point the env at it — a real gRPC
+    hop over localhost TCP, the co-located deployment shape, so the
+    recorded per-dispatch cost is serialization + wire + queueing, not a
+    stub. Returns (address, server_or_None); the caller stops the
+    server after the run."""
+    import grpc
+
+    addr = os.environ.get("KUBEBATCH_SOLVER_ADDR", "")
+    if addr:
+        try:
+            ch = grpc.insecure_channel(addr)
+            grpc.channel_ready_future(ch).result(timeout=2.0)
+            ch.close()
+            return addr, None
+        except Exception:
+            print(f"rpc sidecar {addr} unreachable; starting in-process",
+                  file=sys.stderr)
+    from kubebatch_tpu.rpc.server import make_server
+
+    server, port = make_server("127.0.0.1:0")
+    server.start()
+    addr = f"127.0.0.1:{port}"
+    os.environ["KUBEBATCH_SOLVER_ADDR"] = addr
+    return addr, server
+
+
+def rpc_stats_fields(cycle_engines, rpc_addr: str) -> dict:
+    """The rpc deployment-mode evidence fields, shared by the cold and
+    steady bench paths (one implementation — the two modes must never
+    drift apart on how the hop cost or the fallback count is derived):
+    per-dispatch hop cost = client-observed RTT minus the server's own
+    solve wall (serialization + wire + queueing), and rpc_fallbacks =
+    the number of MEASURED CYCLES whose allocate ran a non-rpc engine
+    (per-cycle engagements, not distinct engine names)."""
+    from kubebatch_tpu.rpc import client as rpc_client
+
+    stats = list(rpc_client.DISPATCH_STATS)
+    hops = [max(0.0, rtt * 1e3 - solve) for rtt, solve in stats]
+    out = {"rpc_sidecar": rpc_addr, "rpc_dispatches": len(stats)}
+    if hops:
+        out["rpc_hop_ms_p50"] = round(float(np.percentile(hops, 50)), 3)
+        out["rpc_hop_ms_max"] = round(float(np.max(hops)), 3)
+        out["rpc_solve_ms_p50"] = round(float(np.percentile(
+            [s for _, s in stats], 50)), 3)
+    out["rpc_fallbacks"] = sum(1 for e in cycle_engines if e != "rpc")
+    return out
+
+
 #: per-config action order (BASELINE.md scenarios; cfg4/cfg5 use the
 #: shipped config/kube-batch-conf.yaml order). "2p"/"3p"/"5p" are the
 #: predicate-rich variants (labels/taints/selectors/affinity/ports at
@@ -145,7 +196,7 @@ def run_config(config: int, cycles: int, mode: str):
     evicted_total = 0
     action_seconds = {name: 0.0 for name in CONFIG_ACTIONS[config]}
     measured_cycles = 0
-    engines = set()
+    engines = []   # one entry per measured cycle (rpc_fallbacks counts cycles)
     readbacks = []
     kernel_s = []
     phase_s: dict = {}
@@ -201,7 +252,7 @@ def run_config(config: int, cycles: int, mode: str):
                 for name, s in act_times:
                     action_seconds[name] += s
                 measured_cycles += 1
-                engines.add(_alloc_mod.last_cycle_engine)
+                engines.append(_alloc_mod.last_cycle_engine)
                 readbacks.append(blocking_readbacks() - rb0)
                 kernel_s.append(solver_kernel_seconds() - ks0)
                 hp = host_phase_seconds()
@@ -217,7 +268,7 @@ def run_config(config: int, cycles: int, mode: str):
     phase_ms = {k: round(1e3 * float(np.median(v)), 3)
                 for k, v in sorted(phase_s.items())}
     return (latencies, bound_total, bind_seconds, evicted_total, action_ms,
-            sorted(engines), readbacks, kernel_s, phase_ms)
+            engines, readbacks, kernel_s, phase_ms)
 
 
 def run_steady(config, cycles: int, mode: str, churn_pods: int,
@@ -306,12 +357,14 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
             for _, act in acts:
                 act.execute(ssn)
             CloseSession(ssn)
+        from kubebatch_tpu.actions import allocate as _alloc_mod
         from kubebatch_tpu.metrics import blocking_readbacks
 
         latencies = []
         bound = 0
         action_seconds = {name: 0.0 for name in CONFIG_ACTIONS[config]}
         readbacks = []
+        engines = []   # one entry per measured cycle
         for cycle in range(cycles):
             before = len(binds)
             kubelet_tick()
@@ -339,13 +392,14 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
             for name, secs in act_times:
                 action_seconds[name] += secs
             readbacks.append(blocking_readbacks() - rb0)
+            engines.append(_alloc_mod.last_cycle_engine)
     finally:
         gc.enable()
     action_ms = {name: round(1e3 * secs / max(1, len(latencies)), 3)
                  for name, secs in action_seconds.items()}
     # peak RSS in MiB (ru_maxrss is KiB on Linux) — the soak evidence
     rss_mb = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024.0
-    return latencies, bound, action_ms, readbacks, rss_mb
+    return latencies, bound, action_ms, readbacks, rss_mb, engines
 
 
 def main(argv=None):
@@ -400,6 +454,15 @@ def main(argv=None):
     from kubebatch_tpu import enable_persistent_compile_cache
     enable_persistent_compile_cache()
     backend = ensure_responsive_backend()
+    rpc_addr, rpc_server = "", None
+    if args.mode == "rpc":
+        # the rpc deployment-mode bench (VERDICT r5 weak 4): solve
+        # through a LIVE sidecar, record cycle p50 plus the per-dispatch
+        # hop cost, and assert zero fallback engagements — a fallback
+        # would silently measure the in-process engine instead
+        rpc_addr, rpc_server = ensure_rpc_sidecar()
+        from kubebatch_tpu.rpc import client as rpc_client
+        rpc_client.DISPATCH_STATS.clear()
     if backend == "cpu-fallback" and not args.steady:
         # run the REQUESTED config on the host XLA backend so the degraded
         # number still measures the full stack at the asked-for scale (a
@@ -411,7 +474,7 @@ def main(argv=None):
 
     if args.steady > 0:
         # >=9 measured cycles so the reported p95 means something
-        latencies, bound, action_ms, readbacks, rss_mb = run_steady(
+        latencies, bound, action_ms, readbacks, rss_mb, engines = run_steady(
             args.config, max(args.cycles, 9), args.mode, args.steady,
             skew=args.steady_skew)
         p50_ms = float(np.percentile(latencies, 50) * 1e3)
@@ -433,9 +496,20 @@ def main(argv=None):
             "mode": args.mode,
             "readbacks_per_cycle": round(float(np.mean(readbacks)), 1)
             if readbacks else 0.0,
+            "engines": sorted(set(engines)),
             "backend": backend,
         }
+        if args.mode == "rpc":
+            # same hop-cost / zero-fallback contract as the cold path: a
+            # steady rpc line must not silently record in-process cycles
+            out.update(rpc_stats_fields(engines, rpc_addr))
         emit(out)
+        if rpc_server is not None:
+            rpc_server.stop(grace=None)
+        if out.get("rpc_fallbacks"):
+            print(f"rpc bench engaged fallback engines: {engines}",
+                  file=sys.stderr)
+            return 1
         return 0
 
     (latencies, bound, seconds, evicted, action_ms, engines,
@@ -456,7 +530,7 @@ def main(argv=None):
         "measured_cycles": len(latencies),
         "action_ms": action_ms,
         "mode": args.mode,
-        "engines": engines,
+        "engines": sorted(set(engines)),
         # blocking device->host transfers per measured cycle — the
         # environment-sensitive cost driver (each one pays the tunnel
         # RTT); budget pinned by tests/test_readbacks.py
@@ -479,6 +553,11 @@ def main(argv=None):
     }
     if evicted:
         out["evictions_per_cycle"] = evicted // max(1, len(latencies))
+    #: every cycle the rpc evidence fields must cover — the cfg5
+    #: steady-extra below appends its cycles so dispatch/hop counts and
+    #: the fallback count describe the SAME set (an internally
+    #: inconsistent evidence line is worse than none)
+    rpc_cycle_engines = list(engines)
     # the primary cfg5 line also carries a steady-state measurement (the
     # regime the 1 s schedule loop actually lives in); guarded so a steady
     # failure can never cost the primary number. On cpu-fallback the extra
@@ -499,8 +578,8 @@ def main(argv=None):
             emit(out, flush=True, partial=True)
         try:
             churn = 256
-            s_lat, s_bound, s_act, s_rb, _ = run_steady(args.config, 9,
-                                                        args.mode, churn)
+            s_lat, s_bound, s_act, s_rb, _, s_eng = run_steady(
+                args.config, 9, args.mode, churn)
             out["steady_p50_ms"] = round(
                 float(np.percentile(s_lat, 50) * 1e3), 3)
             out["steady_p95_ms"] = round(
@@ -510,9 +589,28 @@ def main(argv=None):
             out["steady_action_ms"] = s_act
             out["steady_readbacks_per_cycle"] = round(
                 float(np.mean(s_rb)), 1) if s_rb else 0.0
+            if args.mode == "rpc":
+                # the steady-extra's cycles are rpc evidence too — a
+                # breaker trip mid-extra must not record in-process
+                # steady numbers under an rpc line with exit 0
+                out["steady_engines"] = sorted(set(s_eng))
+            rpc_cycle_engines += s_eng
         except Exception as e:   # pragma: no cover — diagnostics only
             out["steady_error"] = f"{type(e).__name__}: {e}"
+    if args.mode == "rpc":
+        # zero-fallback assertion rides the shared fields (computed
+        # AFTER the steady extra so dispatches, hop cost and fallbacks
+        # all describe every cycle on this line); a nonzero count fails
+        # the run after the line is emitted so the evidence file still
+        # records what happened
+        out.update(rpc_stats_fields(rpc_cycle_engines, rpc_addr))
     emit(out)
+    if rpc_server is not None:
+        rpc_server.stop(grace=None)
+    if out.get("rpc_fallbacks"):
+        print(f"rpc bench engaged fallback engines: {engines}",
+              file=sys.stderr)
+        return 1
     return 0
 
 

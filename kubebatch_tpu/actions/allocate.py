@@ -127,18 +127,23 @@ class AllocateAction(Action):
             if self._execute_rpc(ssn):
                 last_cycle_engine = "rpc"
                 return
+            from ..metrics import count_engine_demotion
+            count_engine_demotion("rpc", "in-process")
             mode = self._auto_mode(ssn)
         if mode in ("batched", "sharded"):
             from .allocate_batched import batched_supported, execute_batched
-            # execute_batched returns the engine that actually ran (it
-            # demotes sharded->batched for affinity cycles and on single-
-            # device hosts) or False — without consuming state — when the
-            # snapshot carries unsupported features
+            # execute_batched returns the engine that actually ran
+            # ("sharded" / "batched"; the only degradation left is
+            # sharded->batched on a 1-device host, which it counts) or
+            # False — without consuming state — when the snapshot
+            # carries unsupported features
             ran = batched_supported(ssn) \
                 and execute_batched(ssn, sharded=(mode == "sharded"))
             if ran:
                 last_cycle_engine = ran
                 return
+            from ..metrics import count_engine_demotion
+            count_engine_demotion(mode, "visit")
             mode = "batched"   # device fallback path below
         elif mode == "fused":
             from .allocate_fused import execute_fused, fused_supported
@@ -149,6 +154,8 @@ class AllocateAction(Action):
                 return
             # configured plugins exceed the fused vocabulary; fall back to
             # the per-visit device solver
+            from ..metrics import count_engine_demotion
+            count_engine_demotion("fused", "visit")
         self._execute_queued(ssn, mode)
 
     def _execute_rpc(self, ssn: Session) -> bool:

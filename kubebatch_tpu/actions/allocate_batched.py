@@ -23,19 +23,19 @@ batched_supported = cycle_supported
 
 def execute_batched(ssn: Session, sharded: bool = False):
     """Run the whole allocate action as a handful of round dispatches.
-    Returns the engine that actually ran ("batched" / "sharded" — truthy,
-    and honest about the silent sharded->batched demotions below), or
-    False — without consuming any state — when the snapshot has features
-    the kernels can't express (the caller falls back)."""
+    Returns the engine that actually ran ("batched" / "sharded" —
+    truthy), or False — without consuming any state — when the snapshot
+    has features the kernels can't express (the caller falls back).
+    Affinity/port cycles run first-class on BOTH engines: the sharded
+    twin partitions the affinity matmuls over the mesh with a replicated
+    carry (kernels/batched_sharded.py), so the only remaining
+    sharded->batched degradation is the 1-device topology, and it is
+    counted (metrics.engine_demotions_total), never silent."""
     inputs = build_cycle_inputs(ssn, allow_affinity=True)
     if inputs is EMPTY_CYCLE:
         return "sharded" if sharded else "batched"
     if inputs is None:
         return False
-    if inputs.affinity is not None:
-        # the sharded twin has no affinity carry partitioning yet — run
-        # the single-chip engine for affinity cycles
-        sharded = False
     if sharded:
         import jax
 
@@ -47,6 +47,8 @@ def execute_batched(ssn: Session, sharded: bool = False):
             replay_decisions(ssn, inputs, task_state, task_node, task_seq)
             return "sharded"
         # single device: the mesh adds nothing — plain engine below
+        from ..metrics import count_engine_demotion
+        count_engine_demotion("sharded", "batched")
     task_state, task_node, task_seq, _ = solve_batched(inputs.device, inputs)
     replay_decisions(ssn, inputs, task_state, task_node, task_seq)
     return "batched"

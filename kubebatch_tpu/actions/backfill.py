@@ -44,6 +44,9 @@ def backfill_job(ssn: Session, job: JobInfo) -> None:
     IsBackfill (ref: backfill.go:120-147)."""
     for task in list(job.task_status_index.get(TaskStatus.PENDING,
                                                {}).values()):
+        # CoW: is_backfill is written in place — resolve to the job's
+        # canonical task first (JobInfo.own_task)
+        task = job.own_task(task)
         for node in ssn.nodes.values():
             try:
                 ssn.predicate_fn(task, node)

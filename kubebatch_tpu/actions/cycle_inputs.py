@@ -407,9 +407,13 @@ def _build_cycle_inputs(ssn: Session,
     if allow_affinity:
         from ..kernels.affinity import (affinity_features_present,
                                         affinity_within_vocabulary)
+        from ..metrics import count_affinity_host_fallback
         if affinity_features_present(ssn, tasks):
             if not affinity_within_vocabulary(ssn, tasks):
-                return None   # over the caps — reference-literal host path
+                # raw vocabulary past even the compaction window —
+                # reference-literal host path, recorded by counter
+                count_affinity_host_fallback("allocate-raw-window")
+                return None
             aff_wanted = True
     device = ensure_device_snapshot(ssn)
     terms = solver_terms(ssn, device, tasks, assume_supported=True)
@@ -429,8 +433,13 @@ def _build_cycle_inputs(ssn: Session,
     aff_inputs = None
     if aff_wanted:
         from ..kernels.affinity import build_affinity_inputs
+        from ..metrics import count_affinity_host_fallback
         aff_inputs = build_affinity_inputs(ssn, tasks, device, t_pad)
-        if aff_inputs is None:   # pragma: no cover — pre-checked above
+        if aff_inputs is None:
+            # inside the raw window but still over MAX_PAIRS/MAX_PORTS
+            # after compaction — host path (the cached device snapshot
+            # was touched, but it is incremental and reused next cycle)
+            count_affinity_host_fallback("allocate-compact-cap")
             return None
 
     # ---- job arrays ------------------------------------------------------
@@ -739,6 +748,15 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
         from ..kernels.tensorize import batch_clone_tasks, batch_set_attr
 
         placed_tasks = [tasks[i] for i in placed_list]
+        # CoW ownership: the gathered task objects may still be shared
+        # with cache truth (JobInfo.clone is copy-on-write) — own every
+        # placed job ONCE and rebind to its canonical task objects
+        # before the first attribute write below (batch_set_attr)
+        p_jobs_l = p_jobs_idx.tolist()
+        for ji in set(p_jobs_l):
+            inputs.jobs[int(ji)]._own_tasks()
+        placed_tasks = [inputs.jobs[int(ji)].tasks.get(t.uid, t)
+                        for ji, t in zip(p_jobs_l, placed_tasks)]
         placed_kinds_l = placed_states.tolist()
         is_pipe_l = is_pipe.tolist()
         node_names_l = [names[c] for c in placed_nodes_l]

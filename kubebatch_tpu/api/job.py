@@ -126,6 +126,9 @@ class JobInfo:
         self.nodes_fit_delta: Dict[str, Resource] = {}
         self.tasks: Dict[str, TaskInfo] = {}
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        #: True while tasks/task_status_index (dicts AND TaskInfo
+        #: objects) are shared with a clone twin — see clone()/_own_tasks
+        self._tasks_shared: bool = False
         self.allocated: Resource = Resource.empty()
         self.total_request: Resource = Resource.empty()
         #: count of tasks whose pod carries inter-pod (anti-)affinity —
@@ -160,11 +163,52 @@ class JobInfo:
     def unset_pdb(self) -> None:
         self.pdb = None
 
+    # --- task map copy-on-write ------------------------------------------
+    def _own_tasks(self) -> None:
+        """Materialize a private task map + status index before the first
+        mutation: clone every TaskInfo (native column pass when the
+        packer is built) and rebuild both dicts around the clones. Until
+        this runs, the dicts AND task objects are shared with the clone
+        twin (see clone()) — job-held tasks are mutated IN PLACE by the
+        session/cache mutators (status flips, node_name, volume_ready),
+        so unlike NodeInfo's dict-level CoW the task objects themselves
+        must be privatized. Every JobInfo mutator owns first; code that
+        writes task attributes directly (session/statement mutators,
+        the bulk replays, cache bind/evict) resolves its reference
+        through own_task() before the first write — a direct write to a
+        pre-ownership reference corrupts the other side's snapshot."""
+        if not self._tasks_shared:
+            return
+        self._tasks_shared = False
+        old = self.tasks
+        if not old:
+            self.tasks = {}
+            self.task_status_index = {}
+            return
+        from ..kernels.tensorize import batch_clone_tasks
+        values = list(old.values())
+        clones = batch_clone_tasks(values, [t.status for t in values],
+                                   [t.node_name for t in values])
+        tasks = dict(zip(old.keys(), clones))
+        self.tasks = tasks
+        self.task_status_index = {
+            status: {uid: tasks[uid] for uid in bucket}
+            for status, bucket in self.task_status_index.items()}
+
+    def own_task(self, task: TaskInfo) -> TaskInfo:
+        """CoW resolution: own the map and return THIS job's canonical
+        object for ``task`` (a caller's reference may predate ownership
+        and still point at the shared twin). Mutators must write through
+        the returned object."""
+        self._own_tasks()
+        return self.tasks.get(task.uid, task)
+
     # --- task index maintenance (ref: job_info.go:231-292) ---------------
     def _add_task_index(self, ti: TaskInfo) -> None:
         self.task_status_index.setdefault(ti.status, {})[ti.uid] = ti
 
     def add_task_info(self, ti: TaskInfo) -> None:
+        self._own_tasks()
         self.tasks[ti.uid] = ti
         self._add_task_index(ti)
         # Only an explicit pod priority overrides the job's priority; the
@@ -180,6 +224,7 @@ class JobInfo:
             self.affinity_tasks += 1
 
     def delete_task_info(self, ti: TaskInfo) -> None:
+        self._own_tasks()
         task = self.tasks.get(ti.uid)
         if task is None:
             raise KeyError(
@@ -203,9 +248,26 @@ class JobInfo:
         operation of the decision replay (10k+ per cycle at the stress
         config), so the net-zero total_request sub/add and the task-dict
         delete/re-insert are skipped when the stored task IS the incoming
-        one (also avoiding float round-trip drift the naive pair has)."""
+        one (also avoiding float round-trip drift the naive pair has).
+
+        CoW note: a ``task`` reference that predates an ownership
+        (whether THIS call or an earlier one materialized the private
+        map) points at the shared twin of the stored clone — the
+        mutation is redirected to the canonical stored object so the
+        twin's (or another snapshot's) map is neither mutated nor
+        re-aliased. Twins are recognized by sharing the stored clone's
+        ``resreq`` OBJECT (every clone path shares request vectors); a
+        genuinely different TaskInfo for the same uid keeps the legacy
+        replace-the-entry semantics. Callers that keep writing through
+        their own reference must resolve it first (own_task)."""
         validate_status_update(task.status, status)
+        self._own_tasks()
         stored = self.tasks.get(task.uid)
+        if stored is not None and stored is not task \
+                and stored.resreq is task.resreq:
+            # pre-ownership twin of the stored clone — mutate the clone,
+            # not the shared original backing the other side's snapshot
+            task = stored
         if stored is None:
             raise KeyError(
                 f"failed to find task <{task.namespace}/{task.name}> in job "
@@ -278,12 +340,20 @@ class JobInfo:
                 f"{', '.join(parts)}.")
 
     def clone(self) -> "JobInfo":
-        """Deep copy (ref: job_info.go:294-326). Copies the maintained
-        aggregates and rebuilds the double-index from cloned tasks directly
-        — equivalent to re-running add_task_info per task (which this
-        method did originally; it runs O(jobs) per snapshot, every cycle),
-        including the reference's quirk that tasks carrying an explicit pod
-        priority re-stamp the job priority in insertion order."""
+        """Deep copy (ref: job_info.go:294-326) with a COPY-ON-WRITE task
+        map: the clone shares the task dicts AND TaskInfo objects with
+        the source, and whichever side mutates first materializes a
+        private deep copy (_own_tasks) — the other side keeps the shared
+        originals untouched. In the steady regime most refreshed jobs
+        are fully Running and never mutated by the session, so their
+        per-task clone cost (the dominant open-phase term per
+        docs/SCALING.md) drops to two dict references. Equivalence with
+        the eager deep copy is pinned by the incremental-snapshot
+        oracle (debug.snapshot_diff == 0 in tests).
+
+        The reference's quirk — tasks carrying an explicit pod priority
+        re-stamp the job priority in insertion order — is preserved
+        eagerly (a read-only walk; ownership may never happen)."""
         info = JobInfo(self.uid)
         info.name = self.name
         info.namespace = self.namespace
@@ -294,15 +364,13 @@ class JobInfo:
         info.creation_timestamp = self.creation_timestamp
         info.pod_group = self.pod_group
         info.pdb = self.pdb
-        tasks = info.tasks
-        for uid, task in self.tasks.items():
-            t = task.clone()
-            tasks[uid] = t
+        info.tasks = self.tasks
+        info.task_status_index = self.task_status_index
+        info._tasks_shared = True
+        self._tasks_shared = True
+        for t in self.tasks.values():
             if t.pod.priority is not None:
                 info.priority = t.priority
-        info.task_status_index = {
-            status: {uid: tasks[uid] for uid in bucket}
-            for status, bucket in self.task_status_index.items()}
         info.allocated = self.allocated.clone()
         info.total_request = self.total_request.clone()
         info.affinity_tasks = self.affinity_tasks

@@ -589,6 +589,10 @@ class SchedulerCache:
         job = self.jobs.get(ti.job)
         if job is None:
             raise KeyError(f"failed to find Job {ti.job} for Task {ti.uid}")
+        # CoW: the cache twin must be privately owned before the caller
+        # mutates it in place — the shared object may still back a live
+        # session's snapshot (JobInfo.clone is copy-on-write)
+        job._own_tasks()
         task = job.tasks.get(ti.uid)
         if task is None:
             raise KeyError(f"failed to find task in status {ti.status} "
@@ -653,6 +657,11 @@ class SchedulerCache:
             nodes_d = self.nodes
             for ti, hostname in bindings:
                 job = jobs_d.get(ti.job)
+                if job is not None:
+                    # CoW: own before resolving — the twins get mutated
+                    # in place below (batch_set_attr), and a shared map
+                    # would leak the flips into a live session snapshot
+                    job._own_tasks()
                 task = job.tasks.get(ti.uid) if job is not None else None
                 if task is None:
                     job, task = self._find_job_and_task(ti)

@@ -364,6 +364,10 @@ class Session:
         self.touched_nodes.add(hostname)
         job = self.jobs.get(task.job)
         if job is not None:
+            # CoW: the caller's reference may still point at the shared
+            # clone twin — resolve to this job's canonical object before
+            # the first attribute write (JobInfo.own_task)
+            task = job.own_task(task)
             job.update_task_status(task, TaskStatus.PIPELINED)
         task.node_name = hostname
         node = self.nodes.get(hostname)
@@ -375,15 +379,20 @@ class Session:
                  using_backfill_task_res: bool = False) -> None:
         """Assign task to host within the session; dispatch the whole job
         once it reaches Ready — the gang barrier (ref: session.go:237-297)."""
+        # CoW resolution BEFORE any write — allocate_volumes already
+        # mutates the task (volume_ready), so the job lookup moves ahead
+        # of it (owning a map is not a semantic mutation; a pre-mutation
+        # volume failure still leaves the session untouched)
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job}")
+        task = job.own_task(task)
         try:
             self.cache.allocate_volumes(task, hostname)
         except Exception as e:
             raise VolumeAllocationError(str(e)) from e
         self.touched_jobs.add(task.job)
         self.touched_nodes.add(hostname)
-        job = self.jobs.get(task.job)
-        if job is None:
-            raise KeyError(f"failed to find job {task.job}")
         new_status = (TaskStatus.ALLOCATED_OVER_BACKFILL
                       if using_backfill_task_res else TaskStatus.ALLOCATED)
         job.update_task_status(task, new_status)
@@ -401,9 +410,11 @@ class Session:
     def dispatch(self, task: TaskInfo) -> None:
         """Bind an allocated task for real (ref: session.go:299-321)."""
         self.touched_jobs.add(task.job)
+        job = self.jobs.get(task.job)
+        if job is not None:
+            task = job.own_task(task)   # CoW (see pipeline)
         self.cache.bind_volumes(task)
         self.cache.bind(task, task.node_name)
-        job = self.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.BINDING)
         # creation -> bind latency (ref: session.go:319)
@@ -415,8 +426,10 @@ class Session:
         (ref: session.go:323-357)."""
         self.touched_jobs.add(reclaimee.job)
         self.touched_nodes.add(reclaimee.node_name)
-        self.cache.evict(reclaimee, reason)
         job = self.jobs.get(reclaimee.job)
+        if job is not None:
+            reclaimee = job.own_task(reclaimee)   # CoW (see pipeline)
+        self.cache.evict(reclaimee, reason)
         if job is not None:
             job.update_task_status(reclaimee, TaskStatus.RELEASING)
         node = self.nodes.get(reclaimee.node_name)

@@ -25,6 +25,10 @@ class Statement:
         self.ssn.touched_nodes.add(reclaimee.node_name)
         job = self.ssn.jobs.get(reclaimee.job)
         if job is not None:
+            # CoW: resolve to the job's canonical task before any write;
+            # the op log records the resolved object so rollback mutates
+            # the same one (Session.pipeline has the same contract)
+            reclaimee = job.own_task(reclaimee)
             job.update_task_status(reclaimee, TaskStatus.RELEASING)
         node = self.ssn.nodes.get(reclaimee.node_name)
         if node is not None:
@@ -38,6 +42,7 @@ class Statement:
         self.ssn.touched_nodes.add(hostname)
         job = self.ssn.jobs.get(task.job)
         if job is not None:
+            task = job.own_task(task)   # CoW (see evict)
             job.update_task_status(task, TaskStatus.PIPELINED)
         task.node_name = hostname
         node = self.ssn.nodes.get(hostname)

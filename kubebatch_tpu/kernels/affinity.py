@@ -45,11 +45,23 @@ import numpy as np
 from ..api import TaskInfo, allocated_status
 from ..objects import Pod, PodAffinityTerm
 
-#: vocabulary caps — snapshots beyond them fall back to the host path
-#: (the same contract as TermsCache.MAX_SIGS: degenerate shapes must not
-#: grow device state unboundedly)
+#: vocabulary caps on the COMPACTED spaces — snapshots beyond them fall
+#: back to the host path (the same contract as TermsCache.MAX_SIGS:
+#: degenerate shapes must not grow device state unboundedly). Raw
+#: collections may exceed the caps by the compaction window below: pairs
+#: dedupe by (group identity, domain column) and ports fold by identical
+#: (claimant, base-usage) columns before the cap applies, so a snapshot
+#: with >MAX_PAIRS raw terms stays on the device engines whenever its
+#: distinct kernel-visible behaviors fit.
 MAX_PAIRS = 128
 MAX_PORTS = 64
+
+#: raw collection window — how far past the caps the encoders keep
+#: collecting before giving up without attempting compaction (a snapshot
+#: whose RAW vocabulary exceeds even this is degenerate; the host-side
+#: victim masks use the same window as their support bound)
+RAW_PAIR_LIMIT = 8 * MAX_PAIRS
+RAW_PORT_LIMIT = 8 * MAX_PORTS
 
 #: mirror of plugins/nodeorder.HARD_POD_AFFINITY_SYMMETRIC_WEIGHT
 #: (imported lazily in build to avoid a plugins<->kernels import cycle)
@@ -112,10 +124,14 @@ def affinity_features_present(ssn, pending: Sequence[TaskInfo]) -> bool:
 
 
 def affinity_within_vocabulary(ssn, pending: Sequence[TaskInfo]) -> bool:
-    """Cheap host-side cap check (no tensorization, no device work): do
-    the snapshot's pair/port counts fit the vocabulary? Lets the builder
-    refuse BEFORE the full-cluster device upload — a fallback cycle must
-    not pay the transfer (same contract as terms.device_supported)."""
+    """Cheap host-side window check (no tensorization, no device work):
+    do the snapshot's RAW pair/port counts fit the collection window the
+    compacting encoder accepts? Lets the builder refuse degenerate
+    snapshots BEFORE the full-cluster device upload (same contract as
+    terms.device_supported). Snapshots inside the window but over the
+    compacted caps are caught by build_affinity_inputs after the
+    dedupe — a rare shape that pays the (cached, incremental) device
+    snapshot before falling back."""
     pairs = _PairSpace()
     ports = set()
     for t in pending:
@@ -133,9 +149,9 @@ def affinity_within_vocabulary(ssn, pending: Sequence[TaskInfo]) -> bool:
             pairs.add(term, pod)
         for _w, term in aff.pod_anti_affinity_preferred:
             pairs.add(term, pod)
-    if len(ports) > MAX_PORTS:
+    if len(ports) > RAW_PORT_LIMIT:
         return False
-    if len(pairs) > MAX_PAIRS:
+    if len(pairs) > RAW_PAIR_LIMIT:
         return False
     for t in _candidates(ssn):
         pod = t.pod
@@ -150,7 +166,7 @@ def affinity_within_vocabulary(ssn, pending: Sequence[TaskInfo]) -> bool:
             pairs.add(term, pod)
         for term in aff.pod_affinity_required:
             pairs.add(term, pod)
-        if len(pairs) > MAX_PAIRS:
+        if len(pairs) > RAW_PAIR_LIMIT:
             return False
     return True
 
@@ -267,6 +283,8 @@ class SessionAffinityMasks:
         self.ip_weight = _interpod_weight(ssn) if with_scores else 0.0
         self.supported = affinity_within_vocabulary(ssn, pending)
         if not self.supported:
+            from ..metrics import count_affinity_host_fallback
+            count_affinity_host_fallback("victim-masks")
             return
 
         def _bump(event):
@@ -548,6 +566,47 @@ class SessionAffinityMasks:
         return out
 
 
+def _compact_pairs(keys: List[Tuple], key_dom: Dict[str, np.ndarray]):
+    """Dedupe raw (group, topology) pairs whose KERNEL behavior is
+    identical: same label selector + resolved namespace set (those two
+    alone decide membership, bootstrap self-selection and the symmetry
+    match) AND same node->domain column (the topology key enters the
+    kernel only through that column). Two such pairs are
+    indistinguishable to every matmul, carry scatter and rollback, so
+    one representative carries them all; weights accumulate onto it
+    exactly as the host's per-term sums do. Returns (compact_keys,
+    remap) with remap[raw_index] -> compact_index."""
+    index: Dict[Tuple, int] = {}
+    compact: List[Tuple] = []
+    remap: List[int] = []
+    col_sig: Dict[str, bytes] = {}
+    for key in keys:
+        topo = key[2]
+        sig = col_sig.get(topo)
+        if sig is None:
+            sig = col_sig[topo] = key_dom[topo].tobytes()
+        ckey = (key[0], key[1], sig)
+        ci = index.get(ckey)
+        if ci is None:
+            ci = len(compact)
+            index[ckey] = ci
+            compact.append(key)
+        remap.append(ci)
+    return compact, remap
+
+
+def _fold_ports(task_ports: np.ndarray, port_base: np.ndarray):
+    """Fold port columns with identical (claimant, base-usage) patterns
+    into one slot. Every kernel use of a port column is boolean — the
+    conflict matmul only asks "any overlap" (port_fail < 0.5) and the
+    per-node claim scatter ORs — so ports always claimed/used together
+    are indistinguishable and one representative column suffices."""
+    stack = np.concatenate([task_ports, port_base], axis=0)
+    _, first = np.unique(stack.T, axis=0, return_index=True)
+    keep = np.sort(first)
+    return task_ports[:, keep], port_base[:, keep]
+
+
 def build_affinity_inputs(ssn, tasks: Sequence[TaskInfo], device,
                           t_pad: int) -> Optional[AffinityInputs]:
     """Encode the snapshot's affinity/port features, or None when they
@@ -621,8 +680,47 @@ def build_affinity_inputs(ssn, tasks: Sequence[TaskInfo], device,
         if anti or carry:
             cand_terms.append((t, anti, carry))
 
-    if len(pairs) > MAX_PAIRS:
+    if len(pairs) > RAW_PAIR_LIMIT:
         return None
+
+    # ---- node domains (per topology key; shared by compaction + kernel)
+    key_dom: Dict[str, np.ndarray] = {}   # topology key -> [N_pad] ids
+    nodes = ssn.nodes
+    for key in pairs.keys:
+        topo = key[2]
+        if topo in key_dom:
+            continue
+        col = np.full(n_pad, -1, np.int32)
+        values: Dict[str, int] = {}
+        for col_i, name in enumerate(names):
+            ni = nodes.get(name)
+            if ni is None or ni.node is None:
+                continue
+            v = ni.node.labels.get(topo)
+            if v is None:
+                continue
+            col[col_i] = values.setdefault(v, len(values))
+        key_dom[topo] = col
+
+    # ---- pair compaction (only past the cap: the common small snapshot
+    # pays nothing) — dedupe raw pairs by (group, domain column), remap
+    # every collected term index onto the compact space ------------------
+    pair_keys: List[Tuple] = pairs.keys
+    if len(pairs) > MAX_PAIRS:
+        pair_keys, remap = _compact_pairs(pairs.keys, key_dom)
+        if len(pair_keys) > MAX_PAIRS:
+            return None
+        rm = remap.__getitem__
+        pend_terms = [
+            (i, pod,
+             [(rm(p), term) for p, term in req],
+             [(rm(p), term) for p, term in anti],
+             [(rm(p), w) for p, w in pref])
+            for i, pod, req, anti, pref in pend_terms]
+        cand_terms = [
+            (t, [(rm(p), term) for p, term in anti],
+             [(rm(p), w) for p, w in carry])
+            for t, anti, carry in cand_terms]
 
     # ---- ports (a predicate: enforced only when predicates run) -------
     port_ids: Dict[int, int] = {}
@@ -631,34 +729,16 @@ def build_affinity_inputs(ssn, tasks: Sequence[TaskInfo], device,
             for port in t.pod.host_ports():
                 if port not in port_ids:
                     port_ids[port] = len(port_ids)
-    if len(port_ids) > MAX_PORTS:
+    if len(port_ids) > RAW_PORT_LIMIT:
         return None
     pt = max(1, len(port_ids))
 
-    p_cnt = max(1, len(pairs))
+    p_cnt = max(1, len(pair_keys))
     d_pad = n_pad  # distinct domain values per key <= real node count
 
-    # ---- node domains -------------------------------------------------
-    key_dom: Dict[str, np.ndarray] = {}   # topology key -> [N_pad] ids
     node_dom = np.full((p_cnt, n_pad), -1, np.int32)
-    nodes = ssn.nodes
-    for p, key in enumerate(pairs.keys):
-        topo = key[2]
-        col = key_dom.get(topo)
-        if col is None:
-            col = np.full(n_pad, -1, np.int32)
-            values: Dict[str, int] = {}
-            for col_i, name in enumerate(names):
-                ni = nodes.get(name)
-                if ni is None or ni.node is None:
-                    continue
-                v = ni.node.labels.get(topo)
-                if v is None:
-                    continue
-                d = values.setdefault(v, len(values))
-                col[col_i] = d
-            key_dom[topo] = col
-        node_dom[p] = col
+    for p, key in enumerate(pair_keys):
+        node_dom[p] = key_dom[key[2]]
 
     # ---- membership memo (per label-shape x namespace) ----------------
     member_memo: Dict[Tuple, np.ndarray] = {}
@@ -671,10 +751,10 @@ def build_affinity_inputs(ssn, tasks: Sequence[TaskInfo], device,
         row = member_memo.get(sig)
         if row is None:
             row = np.fromiter(
-                (_member(k, pod) for k in pairs.keys), bool,
-                count=len(pairs))
-            if len(pairs) < p_cnt:      # p_cnt >= 1 floor
-                row = np.pad(row, (0, p_cnt - len(pairs)))
+                (_member(k, pod) for k in pair_keys), bool,
+                count=len(pair_keys))
+            if len(pair_keys) < p_cnt:      # p_cnt >= 1 floor
+                row = np.pad(row, (0, p_cnt - len(pair_keys)))
             member_memo[sig] = row
         return row
 
@@ -748,6 +828,12 @@ def build_affinity_inputs(ssn, tasks: Sequence[TaskInfo], device,
                     slot = port_ids.get(port)
                     if slot is not None:
                         port_base[col, slot] = True
+
+    # ---- port compaction (only past the cap, like pairs) ---------------
+    if len(port_ids) > MAX_PORTS:
+        task_ports, port_base = _fold_ports(task_ports, port_base)
+        if task_ports.shape[1] > MAX_PORTS:
+            return None
 
     ip_enabled = bool(ip_weight != 0.0
                       and (np.any(task_pref_w) or np.any(pref_w0)

@@ -11,6 +11,15 @@ the per-task any-eligible and acceptance reductions, all-gathers for the
 global waterfall order) — the scaling-book recipe: pick a mesh, annotate
 shardings, let the compiler place the communication on ICI.
 
+The inter-pod affinity / host-port vocabulary (kernels/affinity.py)
+rides the same recipe: the [T,P] x [P,N] affinity matmuls get their
+node dimension from the sharded ``node_dom`` / ``port_base`` columns,
+while the [P,D] domain-count carry stays REPLICATED — D indexes
+topology-label values, not nodes, and a replicated carry is what makes
+the per-(pair, domain) serialization deterministic on every device
+(docs/SCALING.md "Sharded affinity"). Predicate-rich cycles therefore
+run first-class on the mesh; there is no sharded->batched demotion.
+
 Numerics: identical operations to the single-chip engine; the only
 tolerated divergence is floating-point reduction order inside segment
 sums, which sits far below the resource epsilons. The equivalence test
@@ -58,10 +67,24 @@ def node_mesh(n_devices: Optional[int] = None,
     return Mesh(np.array(devs), (AXIS,))
 
 
-def _specs_for(mesh: Mesh):
+def _specs_for(mesh: Mesh, affinity: bool = False, ports: bool = False,
+               ip: bool = False):
     """(array_specs, state_specs) for the mesh: the node dimension is
     split over every mesh axis — ``("nodes",)`` on a 1-D mesh,
-    ``("hosts", "nodes")`` hierarchically on the 2-D multi-host mesh."""
+    ``("hosts", "nodes")`` hierarchically on the 2-D multi-host mesh.
+
+    Affinity placement mirrors the resource terms: the node axis is the
+    ONLY partitioned axis. ``node_dom`` [P,N] and ``port_base`` /
+    ``port_claim`` [N,PT] shard on their node dimension like the sig
+    matrices / capacity carry; the [T,P] term matrices and — crucially —
+    the [P,D] domain-count CARRY stay replicated. The carry is the state
+    the per-(pair, domain) serialization adjudicates against, and with
+    it replicated every device computes the identical keep/reject
+    verdict from the identical all-gathered proposal set (see the
+    replicated-carry argument in docs/SCALING.md); the domain axis D is
+    NOT the node axis (it indexes distinct topology-label values), so
+    partitioning it would buy nothing and cost the serialization its
+    locality."""
     na = (tuple(mesh.axis_names) if len(mesh.axis_names) > 1
           else AXIS)
     array_specs = dict(
@@ -80,6 +103,18 @@ def _specs_for(mesh: Mesh):
         nz_req=P(na, None), q_allocated=P(), j_allocated=P(),
         alloc_cnt=P(), job_alive=P(), task_state=P(), task_node=P(),
         task_seq=P())
+    if affinity:
+        array_specs.update(
+            node_dom=P(None, na), task_grp=P(), task_req_aff=P(),
+            task_req_anti=P(), task_self_ok=P(), task_carry_w=P(),
+            task_pref_w=P())
+        state_specs.update(aff_grp_cnt=P(), aff_anti_cnt=P(),
+                           aff_pref_w=P(), aff_grp_total=P())
+        if ports:
+            array_specs.update(task_ports=P(), port_base=P(na, None))
+            state_specs.update(port_claim=P(na, None))
+        if ip:
+            array_specs.update(ip_weight=P())
     return array_specs, state_specs
 
 
@@ -106,6 +141,17 @@ def _pad_nodes(a: np.ndarray, n_pad: int) -> np.ndarray:
         return a
     pad = [(0, n_pad - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
     return np.pad(a, pad)
+
+
+def _pad_node_cols(a: np.ndarray, n_pad: int, fill) -> np.ndarray:
+    """Pad axis 1 (the node columns of [P,N] arrays) to the shard
+    bucket. ``fill`` = -1 for node_dom: a padding node belongs to NO
+    domain, so it can never satisfy, reject or count toward any pair."""
+    if a.shape[1] == n_pad:
+        return a
+    out = np.full((a.shape[0], n_pad), fill, a.dtype)
+    out[:, :a.shape[1]] = a
+    return out
 
 
 def shard_bucket(n: int, n_devices: int, minimum: int = 8) -> int:
@@ -148,6 +194,34 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
     def nodes_np(x):
         return _pad_nodes(np.asarray(x), n_sh)
 
+    # inter-pod affinity / host ports join the mesh run with the node
+    # dimension of node_dom / port_base / port_claim padded to the shard
+    # bucket (padding nodes carry no domain and no ports); everything
+    # else ships as-is and the specs in _specs_for place it
+    aff = getattr(inputs, "affinity", None)
+    aff_arrays: dict = {}
+    aff_state: dict = {}
+    has_ports = False
+    if aff is not None:
+        has_ports = bool(np.any(aff.task_ports))
+        aff_arrays = dict(
+            node_dom=_pad_node_cols(aff.node_dom, n_sh, -1),
+            task_grp=aff.task_grp, task_req_aff=aff.task_req_aff,
+            task_req_anti=aff.task_req_anti,
+            task_self_ok=aff.task_self_ok,
+            task_carry_w=aff.task_carry_w, task_pref_w=aff.task_pref_w)
+        if has_ports:
+            aff_arrays.update(task_ports=aff.task_ports,
+                              port_base=_pad_nodes(aff.port_base, n_sh))
+        if aff.ip_enabled:
+            aff_arrays["ip_weight"] = np.float32(aff.ip_weight)
+        aff_state = dict(
+            aff_grp_cnt=aff.grp_cnt0, aff_anti_cnt=aff.anti_cnt0,
+            aff_pref_w=aff.pref_w0, aff_grp_total=aff.grp_total0)
+        if has_ports:
+            aff_state["port_claim"] = np.zeros(
+                (n_sh, aff.task_ports.shape[1]), bool)
+
     arrays = CycleArrays(
         backfilled=nodes_np(device.backfilled),
         allocatable_cm=nodes_np(device.allocatable_cm),
@@ -164,7 +238,8 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
         job_queue=inputs.job_queue, job_priority=inputs.job_priority,
         job_create_rank=inputs.job_create_rank, job_valid=inputs.job_valid,
         q_deserved=inputs.q_deserved, q_create_rank=inputs.q_create_rank,
-        cluster_total=inputs.cluster_total, dyn_weights=inputs.dyn_weights)
+        cluster_total=inputs.cluster_total, dyn_weights=inputs.dyn_weights,
+        **aff_arrays)
     state = RoundState(
         idle=nodes_np(device.idle), releasing=nodes_np(device.releasing),
         n_tasks=nodes_np(device.n_tasks), nz_req=nodes_np(device.nz_req),
@@ -172,14 +247,17 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
         alloc_cnt=inputs.init_allocated, job_alive=inputs.job_valid,
         task_state=np.full(t_pad, SKIP, np.int32),
         task_node=np.full(t_pad, -1, np.int32),
-        task_seq=np.full(t_pad, _IMAX, np.int32))
+        task_seq=np.full(t_pad, _IMAX, np.int32),
+        **aff_state)
 
     def put(tree, specs):
         return type(tree)(**{
             k: jax.device_put(getattr(tree, k), NamedSharding(mesh, s))
             for k, s in specs.items()})
 
-    array_specs, state_specs = _specs_for(mesh)
+    array_specs, state_specs = _specs_for(
+        mesh, affinity=aff is not None, ports=has_ports,
+        ip=aff is not None and aff.ip_enabled)
     start = time.perf_counter()
     with solver_trace("batched_allocate_sharded"):
         final, packed = _sharded_entry(

@@ -10,6 +10,18 @@ fit bits) over ICI, makes the identical argmax selection on every device,
 and only the winning shard updates its local carry. One all-gather per
 task step is the only collective — it rides ICI, never DCN, and XLA
 overlaps it with the local elementwise work.
+
+Affinity carve-out (documented, deliberate): this explicit-collective
+scan is the REFERENCE engine — it exists to pin the communication
+pattern the GSPMD production twin (kernels/batched_sharded.py) must
+reproduce, and it is reached only from the dryrun/multi-process tools
+and their tests, never from the action layer. It therefore does NOT
+carry the inter-pod affinity / host-port vocabulary: predicate-rich
+cycles on a mesh run the GSPMD batched engine, whose affinity matmuls
+shard on the node axis with a replicated [P,D] carry (the
+serialization argument lives there and in docs/SCALING.md). Teaching
+this scan the same carry would duplicate that logic in a second
+numbering scheme with no production consumer.
 """
 from __future__ import annotations
 

@@ -74,6 +74,16 @@ if _PROM:
         "tensorize_latency_microseconds",
         "Snapshot tensorization wall time in microseconds",
         namespace=NAMESPACE, buckets=_buckets(5, 2, 14))
+    engine_demotions = Counter(
+        "engine_demotions_total",
+        "Cycles a requested solver engine degraded to a lesser one "
+        "(sharded->batched, device->per-visit, rpc->in-process)",
+        ["from_engine", "to_engine"], namespace=NAMESPACE)
+    affinity_host_fallbacks = Counter(
+        "affinity_host_fallback_total",
+        "Cycles/actions whose affinity/port features forced the "
+        "O(pods x nodes) host path off the device vocabulary",
+        ["site"], namespace=NAMESPACE)
 
 
 def update_plugin_duration(plugin: str, phase: str, seconds: float) -> None:
@@ -149,6 +159,51 @@ def update_unschedule_job_count(count: int) -> None:
 def register_job_retries(job_id: str) -> None:
     if _PROM:
         job_retry_counts.labels(job_id).inc()
+
+
+# ---------------------------------------------------------------------------
+# engine demotion / affinity host-fallback accounting (ISSUE 3 satellite 1)
+# ---------------------------------------------------------------------------
+# A demotion is silent by design (a degraded cycle beats a skipped one),
+# which is exactly why it must be a COUNTER: the predicate-rich bench
+# configs pin both totals to zero, so a regression that re-demotes
+# affinity cycles fails a structural assertion instead of showing up as
+# unexplained wall-time drift. Process-lifetime ints (consumers diff
+# across a window), mirrored into prometheus when available.
+
+_engine_demotions = 0
+_affinity_host_fallbacks = 0
+
+
+def count_engine_demotion(from_engine: str, to_engine: str) -> None:
+    """Record one cycle whose requested engine degraded (sharded->batched
+    on a 1-device host, device engine -> per-visit on an unsupported
+    snapshot, rpc -> in-process on sidecar failure)."""
+    global _engine_demotions
+    _engine_demotions += 1
+    if _PROM:
+        engine_demotions.labels(from_engine, to_engine).inc()
+
+
+def engine_demotions_total() -> int:
+    """Process-lifetime demotion count; consumers diff across a window."""
+    return _engine_demotions
+
+
+def count_affinity_host_fallback(site: str) -> None:
+    """Record one action whose affinity/port features pushed it off the
+    device vocabulary onto the host path (over-cap vocabulary after
+    compaction, raw collection window exceeded, victim-mask refusal)."""
+    global _affinity_host_fallbacks
+    _affinity_host_fallbacks += 1
+    if _PROM:
+        affinity_host_fallbacks.labels(site).inc()
+
+
+def affinity_host_fallback_total() -> int:
+    """Process-lifetime affinity-fallback count; consumers diff across a
+    window."""
+    return _affinity_host_fallbacks
 
 
 _solver_kernel_seconds = 0.0

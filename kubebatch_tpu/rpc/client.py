@@ -7,6 +7,7 @@ nonzero-request inputs, and the drf/proportion fairness seeds."""
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, List
 
 import grpc
@@ -36,6 +37,17 @@ class _StateShim:
 #: process-wide client per sidecar address (KUBEBATCH_SOLVER=rpc mode —
 #: one channel per daemon, not one per cycle)
 _CLIENTS: Dict[str, "SolverClient"] = {}
+
+#: (client-observed rtt seconds, server solve_ms) per Solve dispatch —
+#: bench.py --mode rpc diffs this to report the per-dispatch HOP cost
+#: (rtt - solve = serialization + wire + queueing, the deployment-mode
+#: overhead the sidecar charges on top of the kernel). A bounded deque:
+#: a long-running daemon with nobody reading it keeps the most RECENT
+#: window (first-N retention would freeze diagnostics on warmup-era
+#: samples), while bench runs clear it at start and never hit the cap.
+import collections
+
+DISPATCH_STATS = collections.deque(maxlen=4096)
 
 
 def get_solver_client(target: str) -> "SolverClient":
@@ -177,6 +189,13 @@ class SolverClient:
                     "in-process")
             aff = build_affinity_inputs(ssn, pending, _StateShim(state),
                                         t_pad=len(pending))
+            if aff is None:
+                # inside the raw window but over MAX_PAIRS/MAX_PORTS
+                # even after compaction — the in-process path owns the
+                # host fallback for this shape
+                raise ValueError(
+                    "affinity vocabulary exceeds the caps after "
+                    "compaction; run allocate in-process")
             from ..kernels.affinity import WIRE_FIELDS
             from .victims_wire import to_tensor
             for name in WIRE_FIELDS:
@@ -217,7 +236,11 @@ class SolverClient:
         a fallback path must fall back BEFORE apply_decisions runs;
         after the replay starts the session is committed to the remote
         decisions."""
-        return self._solve(req, timeout=timeout)
+        t0 = time.perf_counter()
+        resp = self._solve(req, timeout=timeout)
+        DISPATCH_STATS.append((time.perf_counter() - t0,
+                               float(resp.solve_ms)))
+        return resp
 
     @staticmethod
     def apply_decisions(ssn: Session, resp, tasks_by_uid) -> None:

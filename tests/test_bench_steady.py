@@ -8,8 +8,9 @@ import bench
 
 
 def test_run_steady_small_config():
-    latencies, bound, action_ms, readbacks, rss_mb = bench.run_steady(
+    latencies, bound, action_ms, readbacks, rss_mb, engines = bench.run_steady(
         2, 2, "auto", 16)
+    assert engines and all(e for e in engines)
     assert len(latencies) == 2
     assert bound == 32          # 16 churn pods per measured cycle
     assert all(dt > 0 for dt in latencies)
@@ -51,7 +52,8 @@ def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
     def fake_steady(*a):
         # the primary line must already be visible at this point
         steady_ran["primary_first"] = capsys.readouterr().out.strip()
-        return [0.05] * 5, 1280, {"allocate": 40.0}, [1, 1, 1, 1, 1], 100.0
+        return ([0.05] * 5, 1280, {"allocate": 40.0}, [1, 1, 1, 1, 1],
+                100.0, ["batched"])
 
     monkeypatch.setattr(bench, "run_steady", fake_steady)
     rc = bench.main(["--config", "5", "--cycles", "2"])

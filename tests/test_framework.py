@@ -171,7 +171,11 @@ class TestSessionMutators:
     def test_allocate_dispatches_when_ready(self):
         cache, ssn = self._setup(min_member=2, n_pods=2)
         job = ssn.jobs["ns/g1"]
+        # the snapshot task map is copy-on-write against cache truth:
+        # resolve held references to the session's canonical objects
+        # before mutating through them (JobInfo.own_task)
         tasks = sorted(job.tasks.values(), key=lambda x: x.name)
+        tasks = [job.own_task(t) for t in tasks]
         ssn.allocate(tasks[0], "n1")
         # gang barrier: 1/2 allocated -> nothing bound yet
         assert tasks[0].status == TaskStatus.ALLOCATED
@@ -195,7 +199,8 @@ class TestSessionMutators:
 
     def test_allocate_over_backfill_status(self):
         cache, ssn = self._setup(min_member=1)
-        task = next(iter(ssn.jobs["ns/g1"].tasks.values()))
+        job = ssn.jobs["ns/g1"]
+        task = job.own_task(next(iter(job.tasks.values())))  # CoW resolve
         # force min_member high so dispatch doesn't fire
         ssn.jobs["ns/g1"].min_available = 5
         ssn.allocate(task, "n1", using_backfill_task_res=True)
@@ -203,7 +208,8 @@ class TestSessionMutators:
 
     def test_pipeline_session_only(self):
         cache, ssn = self._setup()
-        task = next(iter(ssn.jobs["ns/g1"].tasks.values()))
+        job = ssn.jobs["ns/g1"]
+        task = job.own_task(next(iter(job.tasks.values())))  # CoW resolve
         ssn.pipeline(task, "n1")
         assert task.status == TaskStatus.PIPELINED
         # nothing reached the cache
@@ -222,8 +228,12 @@ class TestStatement:
 
     def test_discard_rolls_back_in_reverse(self):
         cache, ssn = self._running_setup()
-        victim = next(iter(ssn.jobs["ns/gv"].tasks.values()))
-        preemptor = next(iter(ssn.jobs["ns/gp"].tasks.values()))
+        # CoW resolve (see TestSessionMutators): mutations land on the
+        # session's canonical objects, not the pre-ownership references
+        victim = ssn.jobs["ns/gv"].own_task(
+            next(iter(ssn.jobs["ns/gv"].tasks.values())))
+        preemptor = ssn.jobs["ns/gp"].own_task(
+            next(iter(ssn.jobs["ns/gp"].tasks.values())))
         node = ssn.nodes["n1"]
         idle0 = node.idle.clone()
         stmt = Statement(ssn)

@@ -108,6 +108,59 @@ def test_clone_deep():
     assert job.allocated.equal(Resource())
 
 
+def test_clone_task_map_copy_on_write():
+    """clone() shares the task dicts AND objects until one side mutates
+    (JobInfo._own_tasks); mutation through any path — JobInfo mutators,
+    own_task-resolved attribute writes — leaves the other side's
+    snapshot bit-untouched, in both directions."""
+    job = JobInfo("default/j1")
+    job.set_pod_group(build_group("default", "j1", 2))
+    t1 = task("default", "p1", "", PodPhase.PENDING, 1000, GiB)
+    t2 = task("default", "p2", "n1", PodPhase.RUNNING, 2000, 2 * GiB)
+    job.add_task_info(t1)
+    job.add_task_info(t2)
+    c = job.clone()
+    # shared until mutation: no per-task allocations happened
+    assert c.tasks is job.tasks
+    assert c.task_status_index is job.task_status_index
+    # clone-side mutation via own_task + direct attribute write
+    ct1 = c.own_task(t1)
+    assert ct1 is not t1, "ownership must privatize the task objects"
+    c.update_task_status(ct1, TaskStatus.ALLOCATED)
+    ct1.node_name = "n9"
+    assert job.tasks[t1.uid].status == TaskStatus.PENDING
+    assert job.tasks[t1.uid].node_name == ""
+    assert job.allocated.equal(Resource(2000, 2 * GiB, 0))
+    assert c.tasks[t1.uid].status == TaskStatus.ALLOCATED
+    # source-side mutation after the clone detached: clone unaffected
+    job.update_task_status(job.tasks[t2.uid], TaskStatus.RELEASING)
+    assert c.tasks[t2.uid].status == TaskStatus.RUNNING
+    # a second clone of the (now-owned) source shares again
+    c2 = job.clone()
+    assert c2.tasks is job.tasks
+    # stale-reference redirect: mutating through a pre-ownership
+    # reference must NOT corrupt the twin (update_task_status redirects
+    # to the canonical stored clone)
+    job2 = JobInfo("default/j2")
+    t3 = task("default", "p3", "", PodPhase.PENDING, 500, GiB, group="j2")
+    job2.add_task_info(t3)
+    c3 = job2.clone()
+    c3.update_task_status(t3, TaskStatus.ALLOCATED)   # t3 = shared ref
+    assert job2.tasks[t3.uid].status == TaskStatus.PENDING
+    assert c3.tasks[t3.uid].status == TaskStatus.ALLOCATED
+    assert t3.status == TaskStatus.PENDING, \
+        "the shared original must stay untouched"
+    # ...and the ALREADY-OWNED ordering: the map was privatized by an
+    # earlier mutation, then a pre-ownership reference is passed —
+    # the redirect must still protect (and not re-alias) the twin
+    c3.update_task_status(t3, TaskStatus.BINDING)
+    assert t3.status == TaskStatus.PENDING
+    assert job2.tasks[t3.uid] is t3, "truth's object must stay its own"
+    assert c3.tasks[t3.uid].status == TaskStatus.BINDING
+    assert c3.tasks[t3.uid] is not t3, \
+        "a foreign twin must never be re-inserted into the owned map"
+
+
 def test_fit_error_histogram():
     job = JobInfo("default/j1")
     assert job.fit_error() == "0 nodes are available"

@@ -394,6 +394,74 @@ def mk_big_affinity_cluster():
     return cache, binder
 
 
+def test_affinity_wire_roundtrip_compacted_vocabulary():
+    """A COMPACTED affinity vocabulary (raw pairs > MAX_PAIRS, deduped
+    by domain-column equality) crosses the solver.proto wire
+    bit-identically: encode in the client's WIRE_FIELDS order, decode
+    with the server's _affinity_from_wire, compare every array. Several
+    fields share shape and dtype, so a field-order skew would pass all
+    structural checks and misplace pods — this pins the contract for
+    the compacted shapes specifically."""
+    import numpy as np
+
+    from kubebatch_tpu.kernels.affinity import (MAX_PAIRS, WIRE_FIELDS,
+                                                build_affinity_inputs)
+    from kubebatch_tpu.kernels.tensorize import NodeState
+    from kubebatch_tpu.objects import Affinity, PodAffinityTerm
+    from kubebatch_tpu.rpc import solver_pb2
+    from kubebatch_tpu.rpc.client import _StateShim
+    from kubebatch_tpu.rpc.server import _affinity_from_wire
+    from kubebatch_tpu.rpc.victims_wire import to_tensor
+
+    n_topos = MAX_PAIRS + 20
+    binder = RecordingBinder()
+    cache = SchedulerCache(binder=binder, async_writeback=False)
+    cache.add_queue(build_queue("q1", 1))
+    for i in range(4):
+        labels = {"kubernetes.io/hostname": f"n{i}"}
+        labels.update({f"alias-{k}": f"n{i}" for k in range(n_topos)})
+        cache.add_node(build_node(f"n{i}", rl(8000, 16 * GiB, pods=110),
+                                  labels=labels))
+    cache.add_pod_group(build_group("ns", "db", 1, queue="q1"))
+    cache.add_pod(build_pod("ns", "db-0", "n2", PodPhase.RUNNING,
+                            rl(100, GiB // 4), group="db",
+                            labels={"app": "db"}))
+    cache.add_pod_group(build_group("ns", "web", 2, queue="q1"))
+    for p in range(3):
+        pod = build_pod("ns", f"web-{p}", "", PodPhase.PENDING,
+                        rl(200, GiB // 4), group="web", ports=[8080 + p])
+        pod.affinity = Affinity(pod_affinity_required=[
+            PodAffinityTerm(match_labels={"app": "db"},
+                            topology_key=f"alias-{k}")
+            for k in range(n_topos)])
+        cache.add_pod(pod)
+
+    ssn = OpenSession(cache, full_tiers())
+    pending = [t for job in ssn.jobs.values()
+               for t in job.tasks.values() if t.node_name == ""]
+    state = NodeState.from_nodes(ssn.nodes)
+    aff = build_affinity_inputs(ssn, pending, _StateShim(state),
+                                t_pad=len(pending))
+    CloseSession(ssn)
+    assert aff is not None, "over-cap raw vocabulary must compact"
+    assert aff.n_pairs <= MAX_PAIRS
+
+    req = solver_pb2.SnapshotRequest()
+    for name in WIRE_FIELDS:
+        req.affinity.append(to_tensor(getattr(aff, name)))
+    req.affinity_ip_weight = aff.ip_weight
+    req.affinity_ip_enabled = aff.ip_enabled
+
+    decoded = _affinity_from_wire(req, n_pad=aff.node_dom.shape[1],
+                                  t_pad=aff.task_grp.shape[0])
+    for name in WIRE_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(decoded, name)),
+            np.asarray(getattr(aff, name)), err_msg=name)
+    assert decoded.ip_weight == aff.ip_weight
+    assert decoded.ip_enabled == aff.ip_enabled
+
+
 def test_sidecar_solves_affinity_snapshot(sidecar):
     """The Solve leg carries the affinity vocabulary (r5): a 1000-task
     predicate-rich snapshot solves remotely through the round engine

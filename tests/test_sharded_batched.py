@@ -182,6 +182,225 @@ def test_auto_mode_selects_sharded_on_multi_device(monkeypatch):
     assert calls, "auto mode did not dispatch the sharded engine"
 
 
+# ---------------------------------------------------------------------
+# inter-pod affinity / host ports ON the mesh (ISSUE 3 tentpole): the
+# sharded engine carries the kernels/affinity.py vocabulary with the
+# node axis partitioned and the [P,D] carry replicated — decisions must
+# be bit-identical to the single-chip batched engine, and the demotion
+# that used to drop affinity cycles off the mesh is gone.
+# ---------------------------------------------------------------------
+
+def build_affinity_cluster(cache, n_nodes=12, n_groups=10, seed=0):
+    """Predicate-rich cluster: anti-affinity spread, zone co-location,
+    preferred steering toward an existing pod, host ports — the cfg*p
+    feature mix at test scale."""
+    from kubebatch_tpu.objects import Affinity, PodAffinityTerm
+
+    rng = np.random.default_rng(seed)
+    cache.add_queue(build_queue("default"))
+    for i in range(n_nodes):
+        labels = {"kubernetes.io/hostname": f"n{i:03d}",
+                  "zone": f"z{i % 3}"}
+        cache.add_node(build_node(f"n{i:03d}", rl(8000, 16 * GiB, pods=110),
+                                  labels=labels))
+    # an existing carrier for the preferred/symmetry halves
+    cache.add_pod_group(build_group("ns", "db", 1))
+    cache.add_pod(build_pod("ns", "db-0", "n002", PodPhase.RUNNING,
+                            rl(500, GiB), group="db",
+                            labels={"app": "db"}))
+    apps = ["red", "blue", "green"]
+    for g in range(n_groups):
+        app = apps[int(rng.integers(len(apps)))]
+        size = int(rng.integers(2, 5))
+        cache.add_pod_group(build_group("ns", f"g{g:03d}", size,
+                                        creation_timestamp=float(g)))
+        for p in range(size):
+            pod = build_pod("ns", f"g{g:03d}-{p}", "", PodPhase.PENDING,
+                            rl(400, GiB // 2), group=f"g{g:03d}",
+                            labels={"app": app},
+                            creation_timestamp=float(g * 100 + p))
+            roll = rng.random()
+            if roll < 0.3:
+                pod.affinity = Affinity(pod_anti_affinity_required=[
+                    PodAffinityTerm(match_labels={"app": app},
+                                    topology_key="kubernetes.io/hostname")])
+            elif roll < 0.5:
+                pod.affinity = Affinity(pod_affinity_required=[
+                    PodAffinityTerm(match_labels={"app": app},
+                                    topology_key="zone")])
+            elif roll < 0.7:
+                pod.affinity = Affinity(pod_affinity_preferred=[
+                    (50, PodAffinityTerm(match_labels={"app": "db"},
+                                         topology_key="kubernetes.io/"
+                                                      "hostname"))])
+            elif roll < 0.8:
+                pod.containers[0].ports = [8080]
+            cache.add_pod(pod)
+
+
+def _open_affinity(seed):
+    cache = SchedulerCache(binder=_B(), async_writeback=False)
+    build_affinity_cluster(cache, seed=seed)
+    return OpenSession(cache, shipped_tiers())
+
+
+@pytest.mark.parametrize("seed", [0, 5])
+def test_sharded_affinity_decisions_match_single_device(seed):
+    ssn_a = _open_affinity(seed)
+    inputs_a = build_cycle_inputs(ssn_a, allow_affinity=True)
+    assert inputs_a.affinity is not None, "cluster must carry affinity"
+    st_a, nd_a, seq_a, _ = solve_batched(inputs_a.device, inputs_a,
+                                         compact_bucket=0)
+
+    ssn_b = _open_affinity(seed)
+    inputs_b = build_cycle_inputs(ssn_b, allow_affinity=True)
+    assert inputs_b.affinity is not None
+    st_b, nd_b, seq_b, _ = solve_batched_sharded(node_mesh(),
+                                                 inputs_b.device, inputs_b)
+
+    np.testing.assert_array_equal(st_a, st_b)
+    np.testing.assert_array_equal(seq_a, seq_b)
+    placed = np.isin(st_a, [1, 2, 3])
+    np.testing.assert_array_equal(nd_a[placed], nd_b[placed])
+    CloseSession(ssn_a)
+    CloseSession(ssn_b)
+
+
+def test_sharded_affinity_hierarchical_mesh_matches():
+    """The 2-D hosts x nodes mesh carries the affinity vocabulary too —
+    the multi-host recipe needs no affinity carve-out."""
+    ssn_a = _open_affinity(3)
+    inputs_a = build_cycle_inputs(ssn_a, allow_affinity=True)
+    st_a, nd_a, seq_a, _ = solve_batched(inputs_a.device, inputs_a,
+                                         compact_bucket=0)
+
+    ssn_b = _open_affinity(3)
+    inputs_b = build_cycle_inputs(ssn_b, allow_affinity=True)
+    st_b, nd_b, seq_b, _ = solve_batched_sharded(node_mesh(n_hosts=2),
+                                                 inputs_b.device, inputs_b)
+
+    np.testing.assert_array_equal(st_a, st_b)
+    np.testing.assert_array_equal(seq_a, seq_b)
+    placed = np.isin(st_a, [1, 2, 3])
+    np.testing.assert_array_equal(nd_a[placed], nd_b[placed])
+    CloseSession(ssn_a)
+    CloseSession(ssn_b)
+
+
+def test_sharded_mode_affinity_end_to_end_no_demotion():
+    """cfg5p-shaped (predicate-rich sim mix) at test scale through the
+    ACTION on the 8-device mesh: the engine that runs is 'sharded' (the
+    old silent sharded->batched affinity demotion is deleted), session
+    end state matches the single-chip batched mode, and the demotion /
+    affinity-fallback counters stay at ZERO — the structural pin that
+    replaces wall-time as the regression signal."""
+    from kubebatch_tpu.actions import allocate as allocate_mod
+    from kubebatch_tpu.metrics import (affinity_host_fallback_total,
+                                       engine_demotions_total)
+    from kubebatch_tpu.sim import ClusterSpec, build_cluster
+
+    spec = ClusterSpec(
+        n_nodes=64, n_groups=48, pods_per_group=4, n_queues=4,
+        queue_weights=(1, 2, 3, 4), pod_cpu_millis=800,
+        pod_mem_bytes=GiB, n_zones=8, selector_frac=0.15, taint_frac=0.1,
+        toleration_frac=0.15, anti_affinity_frac=0.08,
+        zone_affinity_frac=0.05, pref_affinity_frac=0.08,
+        hostport_frac=0.04)
+    results = {}
+    for mode in ("batched", "sharded"):
+        sim = build_cluster(spec)
+        cache = SchedulerCache(binder=_B(), async_writeback=False)
+        sim.populate(cache)
+        ssn = OpenSession(cache, shipped_tiers())
+        d0 = engine_demotions_total()
+        f0 = affinity_host_fallback_total()
+        AllocateAction(mode=mode).execute(ssn)
+        assert engine_demotions_total() == d0, \
+            "predicate-rich cycle demoted its engine"
+        assert affinity_host_fallback_total() == f0, \
+            "predicate-rich cycle fell off the device vocabulary"
+        assert allocate_mod.last_cycle_engine == mode
+        statuses = {t.key: (t.status, t.node_name)
+                    for job in ssn.jobs.values()
+                    for t in job.tasks.values()}
+        CloseSession(ssn)
+        results[mode] = statuses
+    assert results["sharded"] == results["batched"]
+
+
+def test_over_cap_raw_pairs_compact_onto_device():
+    """A synthetic spec whose RAW pair count exceeds MAX_PAIRS but
+    dedupes under it (many topology-key aliases with identical domain
+    columns) stays on the batched DEVICE engine — engine-ran asserted —
+    with decisions unchanged vs the reference-literal host path, and
+    the affinity-fallback counter untouched."""
+    from kubebatch_tpu.actions.allocate import AllocateAction
+    from kubebatch_tpu.actions.allocate_batched import execute_batched
+    from kubebatch_tpu.kernels.affinity import MAX_PAIRS
+    from kubebatch_tpu.metrics import affinity_host_fallback_total
+    from kubebatch_tpu.objects import Affinity, PodAffinityTerm
+
+    n_topos = MAX_PAIRS + 12   # raw pairs > MAX_PAIRS, all one behavior
+
+    def mk():
+        binds = {}
+
+        class Seam:
+            def bind(self, pod, hostname):
+                binds[f"{pod.namespace}/{pod.name}"] = hostname
+                pod.node_name = hostname
+
+        cache = SchedulerCache(binder=Seam(), async_writeback=False)
+        cache.add_queue(build_queue("default"))
+        for i in range(4):
+            # every alias label carries the hostname value -> every
+            # alias topology key induces the SAME domain column
+            labels = {"kubernetes.io/hostname": f"n{i}"}
+            labels.update({f"alias-{k}": f"n{i}" for k in range(n_topos)})
+            cache.add_node(build_node(f"n{i}", rl(8000, 16 * GiB, pods=110),
+                                      labels=labels))
+        # an existing target pod: required affinity toward it forces
+        # every pending pod onto ITS node — the outcome is order-free,
+        # so host and batched decisions are comparable bit-for-bit
+        cache.add_pod_group(build_group("ns", "db", 1))
+        cache.add_pod(build_pod("ns", "db-0", "n2", PodPhase.RUNNING,
+                                rl(100, GiB // 4), group="db",
+                                labels={"app": "db"}))
+        cache.add_pod_group(build_group("ns", "web", 2))
+        for p in range(3):
+            pod = build_pod("ns", f"web-{p}", "", PodPhase.PENDING,
+                            rl(200, GiB // 4), group="web")
+            pod.affinity = Affinity(pod_affinity_required=[
+                PodAffinityTerm(match_labels={"app": "db"},
+                                topology_key=f"alias-{k}")
+                for k in range(n_topos)])
+            cache.add_pod(pod)
+        return cache, binds
+
+    cache, binds = mk()
+    ssn = OpenSession(cache, shipped_tiers())
+    inputs = build_cycle_inputs(ssn, allow_affinity=True)
+    assert inputs is not None and inputs.affinity is not None, \
+        "over-cap raw vocabulary must compact onto the device engine"
+    assert inputs.affinity.n_pairs <= MAX_PAIRS
+    CloseSession(ssn)
+
+    cache, binds = mk()
+    ssn = OpenSession(cache, shipped_tiers())
+    f0 = affinity_host_fallback_total()
+    ran = execute_batched(ssn)
+    CloseSession(ssn)
+    assert ran == "batched", "engine must run, not fall back"
+    assert affinity_host_fallback_total() == f0
+
+    cache_h, binds_h = mk()
+    ssn_h = OpenSession(cache_h, shipped_tiers())
+    AllocateAction(mode="host").execute(ssn_h)
+    CloseSession(ssn_h)
+    assert binds == binds_h, (binds, binds_h)
+    assert set(binds.values()) == {"n2"}, binds
+
+
 @pytest.mark.skipif(not os.environ.get("KB_BIG_SMOKE"),
                     reason="cfg5-shaped memory-layout smoke (set "
                            "KB_BIG_SMOKE=1; several GB + minutes on CPU)")

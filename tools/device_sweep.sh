@@ -26,5 +26,9 @@ $B 1200 python bench.py --config 3
 $B 1200 python bench.py --config 3 --steady 128 --cycles 9
 $B  900 python bench.py --config 2
 $B  900 python bench.py --config 1
+# rpc deployment mode: cycle p50 + per-dispatch hop cost against a live
+# sidecar, zero fallback engagements asserted (exit 1 on any)
+$B  900 python bench.py --config 2 --mode rpc
+$B 1200 python bench.py --config 3 --mode rpc
 # 60+-cycle steady soak (p50/p95/max + RSS in the JSON line)
 $B 2400 python bench.py --config 5 --steady 256 --cycles 60
