@@ -34,6 +34,7 @@ import os
 from typing import Dict, List, Optional
 
 from ..api import JobInfo, TaskInfo, TaskStatus
+from ..faults import LADDER as _LADDER, check as _fault_check
 from ..framework import (Action, Session, VolumeAllocationError,
                          register_action)
 from ..kernels.solver import (ALLOC, ALLOC_OB, FAIL, PIPELINE, SKIP,
@@ -116,6 +117,11 @@ class AllocateAction(Action):
         mode = self.mode
         if mode == "auto":
             mode = self._auto_mode(ssn)
+        # the degradation ladder's engine cap (faults.py): after repeated
+        # cycle failures the scheduler loop demotes the tier — sharded ->
+        # batched -> fused -> host — and this is the single consult site
+        # (cap_engine counts the demotion in engine_demotions_total)
+        mode = _LADDER.cap_engine(mode)
         if mode == "rpc":
             # route the whole action through the gRPC solver sidecar
             # (KUBEBATCH_SOLVER=rpc; address from KUBEBATCH_SOLVER_ADDR).
@@ -168,7 +174,8 @@ class AllocateAction(Action):
         import logging
 
         from ..rpc.client import get_solver_client
-        from ..rpc.victims_wire import breaker_open, trip_breaker
+        from ..rpc.victims_wire import (breaker_open, clear_breaker,
+                                        trip_breaker)
 
         addr = os.environ.get("KUBEBATCH_SOLVER_ADDR", "127.0.0.1:50061")
         if breaker_open(addr):
@@ -199,6 +206,9 @@ class AllocateAction(Action):
                 addr, e)
             trip_breaker(addr)
             return False
+        # a successful solve answers the quarantine's recovery probe:
+        # reset the strike escalation for this sidecar
+        clear_breaker(addr)
         client.apply_decisions(ssn, resp, tasks_by_uid)
         return True
 
@@ -281,6 +291,10 @@ class AllocateAction(Action):
     def _visit_job_device(self, ssn: Session, device: DeviceSession,
                           job: JobInfo, tasks: PriorityQueue,
                           jobs: PriorityQueue, terms=None) -> None:
+        # injection seam: before the dispatch AND before any session
+        # mutation, so a device fault fails the cycle without leaving
+        # half-applied decisions behind
+        _fault_check("device.dispatch")
         ordered: List[TaskInfo] = []
         while not tasks.empty():
             ordered.append(tasks.pop())

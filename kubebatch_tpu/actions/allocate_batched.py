@@ -13,6 +13,7 @@ when only one device exists.
 """
 from __future__ import annotations
 
+from ..faults import check as _fault_check
 from ..framework import Session
 from ..kernels.batched import solve_batched
 from .cycle_inputs import (EMPTY_CYCLE, build_cycle_inputs, cycle_supported,
@@ -36,6 +37,9 @@ def execute_batched(ssn: Session, sharded: bool = False):
         return "sharded" if sharded else "batched"
     if inputs is None:
         return False
+    # injection seam: after the support gates (no state consumed yet),
+    # before the device dispatch and the replay
+    _fault_check("device.dispatch")
     if sharded:
         import jax
 

@@ -14,6 +14,7 @@ from functools import partial
 import jax
 import numpy as np
 
+from ..faults import check as _fault_check
 from ..framework import Session
 from ..kernels.fused import fused_allocate, unpack_host_block
 from ..kernels.pack import pack_inputs, unpack
@@ -73,6 +74,8 @@ def execute_fused(ssn: Session) -> bool:
         return True
     if inputs is None:
         return False
+    # injection seam: after the support gates, before the dispatch
+    _fault_check("device.dispatch")
     device = inputs.device
     t_pad = inputs.task_valid.shape[0]
     j_pad = inputs.job_valid.shape[0]

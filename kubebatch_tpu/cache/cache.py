@@ -32,6 +32,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, Resource,
                    TaskInfo, TaskStatus, allocated_status, job_terminated)
+from ..faults import BackoffPolicy, backoff_policy
+from ..faults import check as _fault_check
 from ..objects import (Node, Pod, PodDisruptionBudget, PodGroup,
                        PodGroupPhase, PodPhase, PriorityClass, Queue,
                        UNSCHEDULABLE_CONDITION)
@@ -63,15 +65,21 @@ def _is_terminated(status: TaskStatus) -> bool:
 class RetryQueue:
     """Rate-limited retry queue (the workqueue.RateLimiting equivalent).
 
-    Items become due after an exponential backoff (5ms * 2^retries, capped).
-    ``pop_due`` is pumped by the cache's worker loop or ``drain()``.
+    Items become due after an exponential backoff (base * 2^retries,
+    capped). The constants come from the process-wide BackoffPolicy
+    (faults.py) — one object configures these retries, the rpc circuit
+    breaker, and the ladder's recovery probes. ``pop_due`` is pumped by
+    the cache's worker loop or ``drain()``.
     """
 
-    def __init__(self, base_delay: float = 0.005, max_delay: float = 10.0):
+    def __init__(self, base_delay: Optional[float] = None,
+                 max_delay: Optional[float] = None,
+                 policy: Optional[BackoffPolicy] = None):
+        pol = policy or backoff_policy()
         self._items: deque = deque()
         self._retries: Dict[int, int] = {}
-        self._base = base_delay
-        self._max = max_delay
+        self._base = base_delay if base_delay is not None else pol.base_delay
+        self._max = max_delay if max_delay is not None else pol.max_delay
         self._lock = threading.Lock()
 
     def add_rate_limited(self, item) -> None:
@@ -622,6 +630,10 @@ class SchedulerCache:
         the task on failure, emit the Scheduled event on success. Shared by
         bind() and both bind_many() submission paths."""
         try:
+            # injection seam: a transient API-server write failure —
+            # heals through the rate-limited resync loop, like the real
+            # one would
+            _fault_check("cache.bind")
             self.binder.bind(pod, hostname)
         except Exception:
             self.resync_task(task)
@@ -824,6 +836,7 @@ class SchedulerCache:
 
         def do_evict(task=task, pod=pod):
             try:
+                _fault_check("cache.evict")    # injection seam
                 self.evictor.evict(pod)
             except Exception:
                 self.resync_task(task)
@@ -854,6 +867,9 @@ class SchedulerCache:
 
     def sync_task(self, old_task: TaskInfo) -> None:
         """Re-fetch ground truth and replay (ref: event_handlers.go:88-106)."""
+        # injection seam: a failed resync re-enqueues rate-limited
+        # (process_resync_tasks catches), like a failed GET would
+        _fault_check("cache.resync")
         with self._lock:
             if self.pod_lister is None:
                 # no external truth: replay the task's own pod state

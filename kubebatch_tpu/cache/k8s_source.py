@@ -35,6 +35,8 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..faults import check as _fault_check
+from ..faults import check_raise as _fault_check_raise
 from ..objects import (Affinity, Container, MatchExpression, Node,
                        NodeAffinity, NodeSelectorTerm, Pod, PodAffinityTerm,
                        PodDisruptionBudget, PodGroup, PodGroupCondition,
@@ -413,6 +415,12 @@ class K8sEventSource:
         last: Dict[str, dict] = {}
         while not self._stop.is_set():
             try:
+                # injection seams: a 410 Gone must flow through the
+                # relist path (typed), a dropped stream through the
+                # generic backoff+rewatch path — both BEFORE the watch
+                # call, like failures the transport itself would raise
+                _fault_check_raise("source.gone", ResourceExpired)
+                _fault_check("source.disconnect")
                 for event_type, manifest in watch_fn(kind, rv):
                     if self._stop.is_set():
                         return

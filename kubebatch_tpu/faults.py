@@ -1,0 +1,496 @@
+"""Process-wide fault injection, backoff policy, and the degradation ladder.
+
+Borg's central lesson is that the scheduler must stay up and making
+progress through component failure (Verma et al., EuroSys'15); injected
+faults are the only way to TEST that rather than assert it (Basiri et
+al., IEEE Software 2016). This module is both halves for the whole
+process:
+
+- **Injection seams** (``check``/``should_fail``): named crossing points
+  wired into every failure-prone layer (the ``SEAMS`` catalog below).
+  Disarmed cost is one module-global read and a ``None`` compare per
+  crossing — no env lookup, no lock, no branch into plan logic. Armed
+  via ``KUBEBATCH_FAULTS`` / the CLI ``--faults`` flag / ``arm()``.
+- **BackoffPolicy**: the ONE object holding every retry/quarantine
+  timing constant. The cache's ``RetryQueue`` (write-back retries), the
+  rpc sidecar circuit breaker (``rpc/victims_wire.py``), and the
+  ladder's recovery probes all read it, so quarantine timing is
+  configured in a single place (``set_backoff_policy`` or
+  ``KUBEBATCH_QUARANTINE_S``).
+- **Quarantine**: per-target failure state with backoff-gated recovery
+  probes — the generalization of the startup watchdog and the private
+  rpc breaker into one mid-run mechanism. ``blocked(t)`` is True inside
+  the cooldown; when it elapses, exactly the next caller gets one probe
+  attempt, and a re-trip escalates the cooldown.
+- **DegradationLadder**: cycle-level engine degradation driven by the
+  scheduler loop (runtime/scheduler.py). Repeated cycle failures (raise
+  or deadline overrun) demote the allocate engine one tier at a time —
+  sharded -> batched -> fused -> host — through ``cap_engine``; every
+  demotion lands in the existing ``engine_demotions_total`` taxonomy.
+  Sustained healthy cycles plus an optional health probe re-promote one
+  level per cooldown, back to the full device engine.
+
+The chaos soak (sim/chaos.py, ``bench.py --chaos``, tests/test_chaos.py)
+drives hundreds of cycles with a seeded plan over every seam family and
+asserts the invariants: loop alive, no task lost or double-bound,
+fairness conserved, full recovery with bit-identical decisions.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from .metrics import (count_engine_demotion, count_fault_injected,
+                      set_degradation_level)
+
+log = logging.getLogger("kubebatch.faults")
+
+#: the seam catalog: every named injection point, grouped into five
+#: families (device / rpc / cache / source / lease). Rates in a plan may
+#: address an exact seam, a family wildcard ("cache.*"), or "*".
+SEAMS: Dict[str, str] = {
+    "device.dispatch": "device solver dispatch (allocate visit, fused, "
+                       "batched and sharded kernels)",
+    "rpc.solve": "sidecar Solve call (rpc/client.py)",
+    "rpc.victim": "sidecar victim wave/visit call (rpc/victims_wire.py)",
+    "cache.bind": "binder write-back (cache/cache.py _bind_one)",
+    "cache.evict": "evictor write-back (cache/cache.py evict)",
+    "cache.resync": "resync ground-truth replay (cache/cache.py "
+                    "sync_task)",
+    "source.deliver": "sim event-stream delivery (sim/source.py pump)",
+    "source.disconnect": "watch stream drop (cache/k8s_source.py watch "
+                         "loop)",
+    "source.gone": "HTTP 410 Gone on the watch (cache/k8s_source.py)",
+    "lease.renew": "leader lease renew CAS (runtime/leaderelection.py)",
+}
+
+FAMILIES = ("device", "rpc", "cache", "source", "lease")
+
+
+class FaultInjected(RuntimeError):
+    """Raised at an armed seam. Deliberately a plain RuntimeError
+    subclass: every seam sits inside a layer whose real failures are
+    generic exceptions, so the injected fault exercises the exact
+    handler the real one would."""
+
+
+class FaultPlan:
+    """A seeded, thread-safe fault schedule.
+
+    ``rates`` maps seam (or "family.*" / "*") to a per-crossing failure
+    probability; ``counts`` maps an exact seam to "fail the first N
+    crossings, then pass" (deterministic — the test-seam form). A seam
+    with a count entry is governed by the count alone. The same seed
+    yields the same schedule for the same crossing sequence, which is
+    what makes a chaos soak replayable."""
+
+    def __init__(self, rates: Optional[Dict[str, float]] = None,
+                 counts: Optional[Dict[str, int]] = None, seed: int = 0):
+        self.rates = dict(rates or {})
+        self.counts = dict(counts or {})
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        #: injected crossings per seam, for assertions and evidence lines
+        self.injected: Dict[str, int] = {}
+
+    def _rate_for(self, seam: str) -> float:
+        rate = self.rates.get(seam)
+        if rate is not None:
+            return rate
+        fam = seam.split(".", 1)[0] + ".*"
+        rate = self.rates.get(fam)
+        if rate is not None:
+            return rate
+        return self.rates.get("*", 0.0)
+
+    def should_fail(self, seam: str) -> bool:
+        with self._lock:
+            n = self.counts.get(seam)
+            if n is not None:
+                if n <= 0:
+                    return False
+                self.counts[seam] = n - 1
+            else:
+                rate = self._rate_for(seam)
+                if rate <= 0.0 or self._rng.random() >= rate:
+                    return False
+            self.injected[seam] = self.injected.get(seam, 0) + 1
+            return True
+
+
+#: the armed plan; None = disarmed (the zero-cost fast path)
+_PLAN: Optional[FaultPlan] = None
+
+
+def arm(plan: FaultPlan) -> FaultPlan:
+    """Install ``plan`` process-wide and return it."""
+    global _PLAN
+    _PLAN = plan
+    log.warning("fault injection ARMED (seed=%d rates=%s counts=%s)",
+                plan.seed, plan.rates, plan.counts)
+    return plan
+
+
+def disarm() -> None:
+    global _PLAN
+    if _PLAN is not None:
+        log.warning("fault injection disarmed (injected=%s)",
+                    _PLAN.injected)
+    _PLAN = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def armed() -> bool:
+    return _PLAN is not None
+
+
+def should_fail(seam: str) -> bool:
+    """True when the armed plan fires at ``seam`` (counted). The form
+    for seams whose failure is a refused operation rather than an
+    exception (lease renew)."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    if plan.should_fail(seam):
+        count_fault_injected(seam)
+        return True
+    return False
+
+
+def check(seam: str) -> None:
+    """Raise FaultInjected when the armed plan fires at ``seam``."""
+    if _PLAN is not None and should_fail(seam):
+        raise FaultInjected(f"injected fault at seam <{seam}>")
+
+
+def check_raise(seam: str, exc_factory: Callable[[str], BaseException]
+                ) -> None:
+    """Typed variant for seams whose handlers dispatch on the exception
+    class (e.g. a watch 410 must be a ResourceExpired)."""
+    if _PLAN is not None and should_fail(seam):
+        raise exc_factory(f"injected fault at seam <{seam}>")
+
+
+def parse_fault_spec(spec: str, seed: int = 0) -> FaultPlan:
+    """Parse "seam:rate,seam:nN,..." — ``rate`` a probability, ``nN`` a
+    deterministic fail-first-N count; a bare seam means rate 1.0."""
+    rates: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        seam, _, val = part.partition(":")
+        seam = seam.strip()
+        val = val.strip() or "1"
+        if val.startswith("n"):
+            counts[seam] = int(val[1:])
+        else:
+            rates[seam] = float(val)
+    return FaultPlan(rates=rates, counts=counts, seed=seed)
+
+
+def arm_from_env(env: str = "KUBEBATCH_FAULTS",
+                 seed_env: str = "KUBEBATCH_FAULTS_SEED"
+                 ) -> Optional[FaultPlan]:
+    """Arm from the environment (the daemon/CLI path); None when the
+    variable is unset — the default, and the zero-cost state."""
+    spec = os.environ.get(env, "")
+    if not spec:
+        return None
+    seed = int(os.environ.get(seed_env, "0") or "0")
+    return arm(parse_fault_spec(spec, seed=seed))
+
+
+# ---------------------------------------------------------------------
+# the one backoff/quarantine policy (ISSUE 5 satellite: the rpc breaker
+# cooldown and the cache RetryQueue constants lived in two modules)
+# ---------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class BackoffPolicy:
+    """Every retry/quarantine timing constant, in one object.
+
+    ``base_delay``/``max_delay`` drive the cache's rate-limited retry
+    queues (5ms * 2^retries, capped — the workqueue.RateLimiting
+    equivalent); ``cooldown`` is the quarantine before the first
+    recovery probe (the old private rpc-breaker constant), escalated by
+    ``probe_backoff`` per repeated trip up to ``max_cooldown``."""
+
+    base_delay: float = 0.005
+    max_delay: float = 10.0
+    cooldown: float = 60.0
+    probe_backoff: float = 2.0
+    max_cooldown: float = 480.0
+
+    def retry_delay(self, retries: int) -> float:
+        return min(self.base_delay * (2 ** retries), self.max_delay)
+
+    def quarantine_for(self, strikes: int) -> float:
+        return min(self.cooldown * (self.probe_backoff
+                                    ** max(0, strikes - 1)),
+                   self.max_cooldown)
+
+
+DEFAULT_BACKOFF = BackoffPolicy()
+
+_policy: BackoffPolicy = DEFAULT_BACKOFF
+_env_cooldown = os.environ.get("KUBEBATCH_QUARANTINE_S", "")
+if _env_cooldown:
+    _policy = BackoffPolicy(cooldown=float(_env_cooldown))
+
+
+def backoff_policy() -> BackoffPolicy:
+    """The process-wide policy (consumers that cache it at construction
+    time, like RetryQueue, read it once — set the policy before building
+    the cache/scheduler)."""
+    return _policy
+
+
+def set_backoff_policy(policy: BackoffPolicy) -> BackoffPolicy:
+    global _policy
+    _policy = policy
+    return policy
+
+
+class Quarantine:
+    """Per-target failure quarantine with backoff-gated recovery probes.
+
+    ``trip(t)`` starts (or escalates) the cooldown; ``blocked(t)`` is
+    True inside it. When the cooldown elapses the NEXT ``blocked`` call
+    returns False exactly once — the probe window — and a failure
+    re-trips with an escalated cooldown while a success (``clear``)
+    resets the strike count. This is the rpc circuit breaker and the
+    mid-run engine watchdog expressed as one mechanism."""
+
+    def __init__(self, policy: Optional[BackoffPolicy] = None):
+        #: None = follow the process-wide policy dynamically
+        self.policy = policy
+        self._until: Dict[str, float] = {}
+        self._strikes: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def _pol(self) -> BackoffPolicy:
+        return self.policy or _policy
+
+    def trip(self, target: str) -> None:
+        if not target:
+            return
+        with self._lock:
+            strikes = self._strikes.get(target, 0) + 1
+            self._strikes[target] = strikes
+            self._until[target] = (time.monotonic()
+                                   + self._pol().quarantine_for(strikes))
+
+    def blocked(self, target: str) -> bool:
+        with self._lock:
+            until = self._until.get(target)
+            if until is None:
+                return False
+            now = time.monotonic()
+            if now >= until:
+                # probe window: let exactly THIS caller through and
+                # re-arm the cooldown immediately, so concurrent callers
+                # (and later cycles while the probe is still timing out
+                # against a wedged target) stay blocked. A successful
+                # probe calls clear(); a failed one trips and escalates.
+                strikes = self._strikes.get(target, 1)
+                self._until[target] = (now
+                                       + self._pol().quarantine_for(strikes))
+                return False
+            return True
+
+    def clear(self, target: str) -> None:
+        """The target answered a probe — full reset."""
+        with self._lock:
+            self._until.pop(target, None)
+            self._strikes.pop(target, None)
+
+    def strikes(self, target: str) -> int:
+        with self._lock:
+            return self._strikes.get(target, 0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._until.clear()
+            self._strikes.clear()
+
+
+#: the sidecar circuit breaker (rpc/victims_wire.py breaker_open /
+#: trip_breaker delegate here) — one quarantine for BOTH rpc legs
+SIDECAR_QUARANTINE = Quarantine()
+
+
+# ---------------------------------------------------------------------
+# the cycle degradation ladder
+# ---------------------------------------------------------------------
+
+#: ladder levels in demotion order; level 0 imposes no cap
+LADDER_LEVELS = ("full", "batched", "fused", "host")
+
+#: engine tier ranks: an engine at rank >= the ladder level is already
+#: at or below the cap and passes through unchanged. rpc counts as a
+#: full-tier engine (its own breaker handles sidecar failure; the
+#: ladder demotes it with everything else once CYCLES start failing).
+_ENGINE_RANK = {"rpc": 0, "sharded": 0, "batched": 1, "native": 1,
+                "fused": 2, "jax": 2, "host": 3}
+
+
+class DegradationLadder:
+    """Engine degradation driven by guarded scheduler cycles.
+
+    ``record_failure`` after ``demote_after`` consecutive failed cycles
+    demotes one level (counted in engine_demotions_total at the
+    cap_engine site); ``record_success`` after ``promote_after``
+    consecutive healthy cycles — and once the policy cooldown since the
+    demotion has elapsed, and the optional health ``probe`` answers —
+    re-promotes one level. The scheduler loop owns the transitions;
+    AllocateAction consults ``cap_engine`` once per cycle."""
+
+    def __init__(self, policy: Optional[BackoffPolicy] = None,
+                 demote_after: int = 2, promote_after: int = 3,
+                 probe: Optional[Callable[[], bool]] = None):
+        self.demote_after = demote_after
+        self.promote_after = promote_after
+        self.policy = policy
+        self.probe = probe
+        self._lock = threading.Lock()
+        self.level = 0
+        self._fail_streak = 0
+        self._ok_streak = 0
+        self._next_probe_at = 0.0
+        #: async probe state: the probe (a subprocess device query, up
+        #: to 20 s against a wedged accelerator) must never block the
+        #: scheduling thread — record_success consults the LAST result
+        #: and kicks off a fresh probe on a daemon thread when due
+        self._probe_running = False
+        self._probe_result: Optional[bool] = None
+
+    def _pol(self) -> BackoffPolicy:
+        return self.policy or _policy
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._fail_streak += 1
+            self._ok_streak = 0
+            if (self._fail_streak < self.demote_after
+                    or self.level >= len(LADDER_LEVELS) - 1):
+                return
+            self.level += 1
+            self._fail_streak = 0
+            self._next_probe_at = (time.monotonic()
+                                   + self._pol().quarantine_for(self.level))
+            set_degradation_level(self.level)
+            log.warning("degradation ladder DEMOTED to level %d (%s)",
+                        self.level, LADDER_LEVELS[self.level])
+
+    def _run_probe_async(self, probe: Callable[[], bool]) -> None:
+        def _worker():
+            try:
+                ok = bool(probe())
+            except Exception:
+                ok = False
+            with self._lock:
+                self._probe_running = False
+                self._probe_result = ok
+                if not ok:
+                    self._next_probe_at = (
+                        time.monotonic()
+                        + self._pol().quarantine_for(self.level))
+            if not ok:
+                log.warning("degradation ladder: recovery probe failed "
+                            "at level %d; staying", self.level)
+
+        threading.Thread(target=_worker, daemon=True,
+                         name="kb-ladder-probe").start()
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._ok_streak += 1
+            self._fail_streak = 0
+            if self.level == 0 or self._ok_streak < self.promote_after:
+                return
+            if time.monotonic() < self._next_probe_at:
+                return
+            probe = self.probe
+            if probe is not None:
+                if self._probe_running:
+                    return                     # answer pending; stay put
+                if self._probe_result is None:
+                    # kick off a probe on its own thread — a wedged
+                    # accelerator costs that thread the probe timeout,
+                    # never the scheduling loop
+                    self._probe_running = True
+                    self._probe_result = None
+                    do_probe = True
+                else:
+                    do_probe = False
+                    if not self._probe_result:   # consumed: failed
+                        self._probe_result = None
+                        return
+                    self._probe_result = None    # consumed: passed
+            else:
+                do_probe = False
+            if not do_probe:
+                if self.level > 0:
+                    self.level -= 1
+                    self._ok_streak = 0
+                    set_degradation_level(self.level)
+                    log.warning("degradation ladder promoted to level "
+                                "%d (%s)", self.level,
+                                LADDER_LEVELS[self.level])
+                return
+        self._run_probe_async(probe)
+
+    def cap_engine(self, mode: str) -> str:
+        """The engine the current level allows: modes already at or
+        below the cap pass through; higher tiers demote to the level's
+        engine (counted in engine_demotions_total)."""
+        level = self.level
+        if level == 0:
+            return mode
+        if _ENGINE_RANK.get(mode, len(LADDER_LEVELS)) >= level:
+            return mode
+        capped = LADDER_LEVELS[level]
+        count_engine_demotion(mode, capped)
+        return capped
+
+    def reset(self) -> None:
+        with self._lock:
+            self.level = 0
+            self._fail_streak = 0
+            self._ok_streak = 0
+            self._next_probe_at = 0.0
+            self._probe_running = False
+            self._probe_result = None
+        set_degradation_level(0)
+
+
+#: the process-wide ladder — the scheduler loop drives it, the allocate
+#: action consults it (one scheduler per process is the deployment
+#: shape; interleaved test schedulers share it and reset() between runs)
+LADDER = DegradationLadder()
+
+
+def reset() -> None:
+    """Test/soak helper: disarm and clear every piece of process-wide
+    robustness state."""
+    global _PLAN
+    _PLAN = None
+    LADDER.reset()
+    SIDECAR_QUARANTINE.reset()
+
+
+# daemon path: arm directly from the environment at import so every
+# entry point (CLI, bench, sidecar) honors KUBEBATCH_FAULTS without
+# plumbing
+arm_from_env()

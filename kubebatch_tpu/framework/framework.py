@@ -48,8 +48,13 @@ OpenSession = open_session_with_tiers
 
 
 def CloseSession(ssn: Session) -> None:
-    """ref: framework.go:53-61."""
+    """ref: framework.go:53-61. Before anything else, roll back any
+    statement a mid-action fault left open — plugin close hooks and the
+    status write-back must observe the pre-transaction state, never a
+    half-applied eviction batch."""
     t0 = time.perf_counter()
+    for st in list(getattr(ssn, "open_statements", ()) or ()):
+        st.discard()
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
         plugin.on_session_close(ssn)

@@ -95,6 +95,11 @@ class Session:
         #: device-side snapshot, built on first use by kernels.tensorize
         self.device_snapshot = None
 
+        #: statements opened via statement() and not yet committed or
+        #: discarded — CloseSession discards leftovers, so a mid-action
+        #: fault can never leak half-applied evictions into write-back
+        self.open_statements: List = []
+
         #: entities this session mutated in ways a fresh cache clone would
         #: not reproduce — folded into the cache's dirty sets when the
         #: snapshot is adopted as the next cycle's base (cache.py
@@ -355,7 +360,9 @@ class Session:
     # ------------------------------------------------------------------
     def statement(self):
         from .statement import Statement
-        return Statement(self)
+        st = Statement(self)
+        self.open_statements.append(st)
+        return st
 
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         """Session-only assignment onto releasing resources
@@ -600,3 +607,4 @@ def close_session(ssn: Session) -> None:
     ssn.plugins = {}
     ssn.event_handlers = []
     ssn.device_snapshot = None
+    ssn.open_statements = []

@@ -80,6 +80,17 @@ class Statement:
         self.ssn._fire_deallocate(task)
 
     # --- transaction close -------------------------------------------------
+    def _retire(self) -> None:
+        """Leave the session's open-statement registry (session.py
+        tracks statements so CloseSession can discard any a mid-action
+        fault left open)."""
+        open_list = getattr(self.ssn, "open_statements", None)
+        if open_list is not None:
+            try:
+                open_list.remove(self)
+            except ValueError:
+                pass
+
     def commit(self) -> None:
         """Replay real evictions through the cache (ref: statement.go:207-217).
         Pipelines stay session-only."""
@@ -91,6 +102,7 @@ class Statement:
                 except Exception:
                     self._unevict(reclaimee)
         self.operations = []
+        self._retire()
 
     def discard(self) -> None:
         """Roll back in reverse order (ref: statement.go:194-205)."""
@@ -100,3 +112,4 @@ class Statement:
             elif name == "pipeline":
                 self._unpipeline(args[0])
         self.operations = []
+        self._retire()

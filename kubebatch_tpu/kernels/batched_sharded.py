@@ -179,8 +179,13 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
     """
     import time
 
+    from ..faults import check as _fault_check
     from ..metrics import (count_blocking_readback, solver_trace,
                            update_solver_kernel_duration)
+
+    # injection seam: before any carry is consumed, so a faulted sharded
+    # dispatch leaves the DeviceSession state untouched
+    _fault_check("device.dispatch")
 
     n_dev = mesh.devices.size
     n_pad = device.n_padded

@@ -84,6 +84,20 @@ if _PROM:
         "Cycles/actions whose affinity/port features forced the "
         "O(pods x nodes) host path off the device vocabulary",
         ["site"], namespace=NAMESPACE)
+    cycle_failures = Counter(
+        "cycle_failures_total",
+        "Scheduling cycles that raised or blew their deadline budget "
+        "(the loop survives either; the ladder may demote)",
+        ["reason"], namespace=NAMESPACE)
+    fault_injections = Counter(
+        "fault_injected_total",
+        "Faults injected by the armed fault plan, per seam (faults.py; "
+        "pinned to zero whenever injection is disarmed)",
+        ["seam"], namespace=NAMESPACE)
+    degradation_level_gauge = Gauge(
+        "degradation_level",
+        "Current engine degradation-ladder level (0=full device engine, "
+        "1=batched, 2=fused, 3=host)", namespace=NAMESPACE)
 
 
 def update_plugin_duration(plugin: str, phase: str, seconds: float) -> None:
@@ -204,6 +218,74 @@ def affinity_host_fallback_total() -> int:
     """Process-lifetime affinity-fallback count; consumers diff across a
     window."""
     return _affinity_host_fallbacks
+
+
+# ---------------------------------------------------------------------------
+# robustness accounting (ISSUE 5: fault seams + degradation ladder)
+# ---------------------------------------------------------------------------
+# Same discipline as the demotion counters: process-lifetime values that
+# consumers diff across a window, mirrored into prometheus when present.
+# The steady bench pins fault_injected_total to zero on disarmed runs, so
+# an injection seam that fires outside an armed plan fails a structural
+# assertion instead of silently perturbing production cycles.
+# Unlike the single-thread scheduler counters above, these are hit from
+# the write-back pool, the sim pump, the watch threads, and the lease
+# renewer concurrently — the read-modify-write needs a real lock.
+
+import threading as _threading
+
+_robust_lock = _threading.Lock()
+_cycle_failures: dict = {}
+_fault_injected: dict = {}
+_degradation_level = 0
+
+
+def count_cycle_failure(reason: str = "exception") -> None:
+    """Record one scheduling cycle that raised ("exception") or exceeded
+    its deadline budget ("deadline"). The loop survives both; the
+    degradation ladder consumes the same signal."""
+    with _robust_lock:
+        _cycle_failures[reason] = _cycle_failures.get(reason, 0) + 1
+    if _PROM:
+        cycle_failures.labels(reason).inc()
+
+
+def cycle_failures_total() -> int:
+    """Process-lifetime failed-cycle count; consumers diff a window."""
+    with _robust_lock:
+        return sum(_cycle_failures.values())
+
+
+def cycle_failures_by_reason() -> dict:
+    with _robust_lock:
+        return dict(_cycle_failures)
+
+
+def count_fault_injected(seam: str) -> None:
+    """Record one injected fault at ``seam`` (called only by faults.py
+    when an armed plan fires)."""
+    with _robust_lock:
+        _fault_injected[seam] = _fault_injected.get(seam, 0) + 1
+    if _PROM:
+        fault_injections.labels(seam).inc()
+
+
+def fault_injected_total() -> dict:
+    """Process-lifetime injected-fault counts per seam (a copy)."""
+    with _robust_lock:
+        return dict(_fault_injected)
+
+
+def set_degradation_level(level: int) -> None:
+    global _degradation_level
+    _degradation_level = level
+    if _PROM:
+        degradation_level_gauge.set(level)
+
+
+def degradation_level() -> int:
+    """Current engine degradation-ladder level (0 = full engine)."""
+    return _degradation_level
 
 
 _solver_kernel_seconds = 0.0

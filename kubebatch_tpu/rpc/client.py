@@ -236,6 +236,11 @@ class SolverClient:
         a fallback path must fall back BEFORE apply_decisions runs;
         after the replay starts the session is committed to the remote
         decisions."""
+        from ..faults import check as _fault_check
+
+        # injection seam: sidecar unavailability, exercised before the
+        # wire call — callers treat it exactly like a dead channel
+        _fault_check("rpc.solve")
         t0 = time.perf_counter()
         resp = self._solve(req, timeout=timeout)
         DISPATCH_STATS.append((time.perf_counter() - t0,

@@ -143,30 +143,30 @@ class VictimRegistry:
 # client side
 # ---------------------------------------------------------------------
 
-#: process-wide circuit breaker: address -> monotonic deadline until
-#: which rpc-mode callers (the victim attach AND allocate's Solve leg,
-#: actions/allocate.py) skip the sidecar (a wedged sidecar must not
-#: stall EVERY cycle for its timeouts — one failed action trips the
-#: breaker, later cycles go straight to the in-process path and
-#: re-probe after the cooldown)
-_BROKEN: Dict[str, float] = {}
-_BREAKER_COOLDOWN_S = 60.0
+# process-wide circuit breaker: rpc-mode callers (the victim attach AND
+# allocate's Solve leg, actions/allocate.py) skip a sidecar inside its
+# failure cooldown — a wedged sidecar must not stall EVERY cycle for its
+# timeouts; one failed action trips the breaker, later cycles go
+# straight in-process and re-probe after the cooldown. The mechanism and
+# its timing constants live in faults.py (SIDECAR_QUARANTINE +
+# BackoffPolicy) so quarantine timing is configured in ONE place,
+# shared with the cache retry queues and the degradation ladder.
+from ..faults import SIDECAR_QUARANTINE
 
 
 def breaker_open(address: str) -> bool:
-    """True while the address is inside its failure cooldown."""
-    until = _BROKEN.get(address)
-    if until is None:
-        return False
-    if time.monotonic() >= until:
-        del _BROKEN[address]
-        return False
-    return True
+    """True while the address is inside its failure cooldown; when the
+    cooldown elapses exactly one caller gets a recovery probe."""
+    return SIDECAR_QUARANTINE.blocked(address)
 
 
 def trip_breaker(address: str) -> None:
-    if address:
-        _BROKEN[address] = time.monotonic() + _BREAKER_COOLDOWN_S
+    SIDECAR_QUARANTINE.trip(address)
+
+
+def clear_breaker(address: str) -> None:
+    """A successful call answered the recovery probe — reset strikes."""
+    SIDECAR_QUARANTINE.clear(address)
 
 #: rpc deadlines: the sidecar is co-located — seconds mean it is wedged
 _UPLOAD_TIMEOUT_S = 10.0
@@ -226,6 +226,11 @@ class RemoteVictimBackend:
 
     def _call_once(self, solver, lanes, wave: bool, filter_kind: str,
                    visited) -> np.ndarray:
+        from ..faults import check as _fault_check
+
+        # injection seam: sidecar failure on the victim leg — the
+        # dispatch site answers None and runs the local kernels
+        _fault_check("rpc.victim")
         state_id = self._ensure_uploaded(solver)
         req = solver_pb2.VictimVisitRequest(
             state_id=state_id, wave=wave, filter_kind=filter_kind,
@@ -250,8 +255,10 @@ class RemoteVictimBackend:
             return None
         for attempt in (0, 1):
             try:
-                return self._call_once(solver, lanes, wave, filter_kind,
-                                       visited)
+                out = self._call_once(solver, lanes, wave, filter_kind,
+                                      visited)
+                clear_breaker(self.address)
+                return out
             except Exception as e:  # noqa: BLE001 — any failure -> local
                 # a shared sidecar's LRU may have evicted our state id
                 # between visits: retry ONCE with a fresh upload

@@ -65,6 +65,19 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["", "auto", "host", "jax", "fused", "batched",
                             "sharded", "native"],
                    help="override the allocate solver mode")
+    # robustness extensions (docs/ROBUSTNESS.md)
+    p.add_argument("--faults", default="",
+                   help="arm fault injection: 'seam:rate,seam:nN,...' "
+                        "(rate = probability per crossing, nN = fail the "
+                        "first N deterministically); see faults.SEAMS "
+                        "for the catalog. Also armable via "
+                        "KUBEBATCH_FAULTS.")
+    p.add_argument("--faults-seed", type=int, default=0,
+                   help="seed for the randomized fault schedule")
+    p.add_argument("--cycle-deadline", type=float, default=None,
+                   help="per-cycle wall budget in seconds; overruns count "
+                        "as cycle failures and demote the engine ladder "
+                        "(also via KUBEBATCH_CYCLE_DEADLINE)")
     return p
 
 
@@ -90,6 +103,11 @@ def main(argv=None) -> int:
 
     if args.solver:
         os.environ["KUBEBATCH_SOLVER"] = args.solver
+
+    if args.faults:
+        from .. import faults
+        faults.arm(faults.parse_fault_spec(args.faults,
+                                           seed=args.faults_seed))
 
     # accelerator wedge watchdog: a hung transport must degrade the daemon
     # to host scheduling, not hang its first kernel dispatch forever
@@ -129,7 +147,8 @@ def main(argv=None) -> int:
 
     sched = Scheduler(cache, scheduler_conf=conf_str,
                       schedule_period=args.schedule_period,
-                      enable_preemption=args.enable_preemption)
+                      enable_preemption=args.enable_preemption,
+                      cycle_deadline=args.cycle_deadline)
 
     stop = threading.Event()
 
@@ -139,13 +158,23 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, handle_signal)
     signal.signal(signal.SIGTERM, handle_signal)
 
+    #: finite-cycle outcome: every cycle failing must surface as a
+    #: nonzero exit (run_cycle guards the loop, so a totally broken
+    #: scheduler would otherwise exit 0 with nothing but log lines)
+    cycle_outcome = {"ran": 0, "failed": 0}
+
     def run_workload(workload_stop: threading.Event) -> None:
         if args.cycles:
             cache.run()
             for _ in range(args.cycles):
                 if stop.is_set() or workload_stop.is_set():
                     break
-                sched.run_once()
+                cycle_outcome["ran"] += 1
+                if not sched.run_cycle() \
+                        and sched.last_cycle_failure == "exception":
+                    # deadline overruns are slow-but-working cycles;
+                    # only raising cycles count toward total breakage
+                    cycle_outcome["failed"] += 1
         else:
             merged = threading.Event()
 
@@ -175,6 +204,10 @@ def main(argv=None) -> int:
         lease.run(run_workload, fatal, stop)
     else:
         run_workload(threading.Event())
+    if cycle_outcome["ran"] and cycle_outcome["failed"] == cycle_outcome["ran"]:
+        print(f"all {cycle_outcome['ran']} scheduling cycles failed",
+              file=sys.stderr)
+        return 1
     return 0
 
 

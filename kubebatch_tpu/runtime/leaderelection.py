@@ -29,6 +29,7 @@ Both backends pass the same acquire/renew/loss/fatal contract tests
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
 import socket
@@ -36,6 +37,8 @@ import threading
 import time
 import uuid
 from typing import Callable, Optional, Protocol, runtime_checkable
+
+from ..faults import should_fail as _fault_should_fail
 
 
 def _default_identity() -> str:
@@ -72,15 +75,73 @@ class LeaderElector:
         self.lease_duration = lease_duration
         self.renew_deadline = renew_deadline
         self.retry_period = retry_period
+        #: wall seconds each recent acquire/renew attempt actually took —
+        #: the observed cadence on THIS box, feeding loss_wait_budget()
+        self.attempt_seconds: collections.deque = collections.deque(
+            maxlen=32)
+        #: total attempts ever made (the deque above is a bounded
+        #: window; evidence consumers want the real count)
+        self.renew_attempts = 0
+        #: worst observed oversleep of the renew loop's waits — GIL/
+        #: scheduler starvation BETWEEN attempts (jit compiles on other
+        #: threads), which CAS wall time alone cannot see
+        self.observed_lateness = 0.0
+
+    def _wait(self, stop, seconds: float) -> bool:
+        """Event.wait that also folds its own oversleep into
+        observed_lateness; returns the event's state like wait()."""
+        t0 = time.monotonic()
+        signalled = stop.wait(seconds)
+        late = time.monotonic() - t0 - seconds
+        if late > self.observed_lateness:
+            self.observed_lateness = late
+        return signalled
+
+    def loss_wait_budget(self) -> float:
+        """How long a caller should wait for loss-of-leadership to be
+        declared after the lease is gone, derived from the OBSERVED
+        renew cadence instead of a fixed wall constant (the
+        test_lease_run_and_loss flake: a fixed 30 s budget is both too
+        short for a badly starved box and meaninglessly long for a
+        healthy one). Loss needs the elapsed-since-last-renew to cross
+        renew_deadline, discovered by the first attempt after it — each
+        attempt costing up to its own wall time plus the failure wait
+        plus the worst wake-up lateness this process has measured
+        (scheduler starvation between attempts)."""
+        worst = max(self.attempt_seconds, default=self.retry_period)
+        per_attempt = (worst + min(1.0, self.retry_period)
+                       + self.observed_lateness)
+        return max(5.0, self.renew_deadline + 25.0 * per_attempt)
+
+    def wait_for_loss(self, workload_stop, poll: float = 0.25) -> bool:
+        """Wait until leadership loss is signalled, with a deadline
+        RE-DERIVED while waiting: starvation that begins only after the
+        wait starts (the original flake — jit compiles delaying the
+        renew thread past any budget computed up front) shows up as
+        oversleep of this poller's own waits and of the renew loop's,
+        both folded into observed_lateness, which grows the budget it
+        has to absorb. Returns True when loss was signalled inside the
+        (final) budget."""
+        start = time.monotonic()
+        while True:
+            remaining = start + self.loss_wait_budget() - time.monotonic()
+            if remaining <= 0:
+                return workload_stop.is_set()
+            if self._wait(workload_stop, min(poll, remaining)):
+                return True
 
     def run(self, on_started_leading: Callable[[threading.Event], None],
             on_stopped_leading: Callable[[], None],
             stop: Optional[threading.Event] = None) -> None:
         stop = stop or threading.Event()
         while not stop.is_set():
-            if self.lock.try_acquire_or_renew():
+            t0 = time.monotonic()
+            ok = self.lock.try_acquire_or_renew()
+            self.attempt_seconds.append(time.monotonic() - t0)
+            self.renew_attempts += 1
+            if ok:
                 break
-            stop.wait(self.retry_period)
+            self._wait(stop, self.retry_period)
         if stop.is_set():
             return
 
@@ -98,14 +159,18 @@ class LeaderElector:
             # the renew deadline no matter how late the scheduler ran it.
             last_renew = time.monotonic()
             while not stop.is_set() and not lost.is_set():
-                if self.lock.try_acquire_or_renew():
+                t0 = time.monotonic()
+                ok = self.lock.try_acquire_or_renew()
+                self.attempt_seconds.append(time.monotonic() - t0)
+                self.renew_attempts += 1
+                if ok:
                     last_renew = time.monotonic()
-                    stop.wait(self.retry_period)
+                    self._wait(stop, self.retry_period)
                     continue
                 if time.monotonic() - last_renew >= self.renew_deadline:
                     lost.set()
                     return
-                stop.wait(min(1.0, self.retry_period))
+                self._wait(stop, min(1.0, self.retry_period))
 
         renewer = threading.Thread(target=renew_loop, daemon=True,
                                    name="kb-lease-renew")
@@ -176,6 +241,11 @@ class FileLease:
     def try_acquire_or_renew(self) -> bool:
         import fcntl
 
+        # injection seam: a failed renew (a CAS the medium refused) —
+        # the elector's elapsed-based deadline turns persistence into
+        # loss, a transient blip heals on the next retry
+        if _fault_should_fail("lease.renew"):
+            return False
         guard_path = f"{self.path}.guard"
         try:
             guard = open(guard_path, "a+")
@@ -233,6 +303,8 @@ class HttpLease:
     def try_acquire_or_renew(self) -> bool:
         import urllib.request
 
+        if _fault_should_fail("lease.renew"):    # injection seam
+            return False
         body = json.dumps({"holder": self.identity,
                            "lease_duration": self.lease_duration}).encode()
         req = urllib.request.Request(

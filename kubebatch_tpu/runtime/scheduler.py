@@ -9,6 +9,7 @@ back to the compiled-in default; an unknown action name is an error
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import List, Optional, Tuple
@@ -16,10 +17,12 @@ from typing import List, Optional, Tuple
 log = logging.getLogger("kubebatch")
 
 from .. import actions as _actions  # noqa: F401  (self-registration)
+from .. import faults as _faults
 from .. import plugins as _plugins  # noqa: F401  (self-registration)
 from ..conf import SchedulerConfiguration, Tier, parse_scheduler_conf
 from ..framework import (Action, CloseSession, OpenSession, get_action)
-from ..metrics import update_action_duration, update_e2e_duration
+from ..metrics import (count_cycle_failure, update_action_duration,
+                       update_e2e_duration)
 
 DEFAULT_SCHEDULER_CONF = """
 actions: "allocate, backfill"
@@ -55,12 +58,28 @@ class Scheduler:
 
     def __init__(self, cache, scheduler_conf: str = "",
                  schedule_period: float = 1.0,
-                 enable_preemption: bool = False):
+                 enable_preemption: bool = False,
+                 cycle_deadline: Optional[float] = None):
         self.cache = cache
         self.schedule_period = schedule_period
         self.enable_preemption = enable_preemption
         self.actions, self.tiers = self._load_conf(scheduler_conf)
         self._stop = threading.Event()
+        if cycle_deadline is None:
+            env = os.environ.get("KUBEBATCH_CYCLE_DEADLINE", "")
+            cycle_deadline = float(env) if env else None
+        #: per-cycle wall budget (seconds); an overrun counts as a cycle
+        #: failure for the degradation ladder. None = no budget.
+        self.cycle_deadline = cycle_deadline
+        #: the process-wide degradation ladder (faults.py): run_cycle
+        #: feeds it failures/successes, AllocateAction consults its cap
+        self.ladder = _faults.LADDER
+        if self.ladder.probe is None:
+            self.ladder.probe = self._recovery_probe
+        #: why the last run_cycle returned False (None / "exception" /
+        #: "deadline") — a deadline overrun is a SLOW cycle, not a
+        #: broken one
+        self.last_cycle_failure: Optional[str] = None
 
     @staticmethod
     def _load_conf(conf_str: str):
@@ -94,11 +113,7 @@ class Scheduler:
         try:
             while not stop.is_set():
                 start = time.perf_counter()
-                try:
-                    self.run_once()
-                except Exception:  # a failed cycle must not kill the loop
-                    import traceback
-                    traceback.print_exc()
+                self.run_cycle()
                 gc.collect()
                 elapsed = time.perf_counter() - start
                 stop.wait(max(0.0, self.schedule_period - elapsed))
@@ -109,6 +124,49 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
+
+    @staticmethod
+    def _recovery_probe() -> bool:
+        """Mid-run health probe gating ladder re-promotion: the startup
+        accelerator watchdog generalized to run between cycles. Honors
+        the same skip env as startup (tests, CPU-only runs)."""
+        from .watchdog import midrun_probe
+        return midrun_probe()
+
+    def run_cycle(self) -> bool:
+        """One GUARDED cycle: never raises. A raising cycle is logged
+        structurally and counted (cycle_failures_total{reason=exception});
+        a cycle that completes but blows the deadline budget counts as
+        {reason=deadline}. Both feed the degradation ladder; a healthy
+        cycle feeds its recovery side. Returns True iff healthy;
+        ``last_cycle_failure`` then carries None, "exception" or
+        "deadline" for callers that must tell a broken cycle from a
+        merely slow one (the CLI's finite-cycle exit code)."""
+        self.last_cycle_failure = None
+        start = time.perf_counter()
+        try:
+            self.run_once()
+        except Exception:
+            # a failed cycle must not kill the loop (run_once guarantees
+            # CloseSession ran: statements rolled back, status written,
+            # snapshot adopted — the session did not leak)
+            log.exception("scheduling cycle failed; loop continues "
+                          "(ladder level %d)", self.ladder.level)
+            count_cycle_failure("exception")
+            self.last_cycle_failure = "exception"
+            self.ladder.record_failure()
+            return False
+        elapsed = time.perf_counter() - start
+        if self.cycle_deadline is not None and elapsed > self.cycle_deadline:
+            log.warning("scheduling cycle took %.3fs, over the %.3fs "
+                        "deadline budget (ladder level %d)",
+                        elapsed, self.cycle_deadline, self.ladder.level)
+            count_cycle_failure("deadline")
+            self.last_cycle_failure = "deadline"
+            self.ladder.record_failure()
+            return False
+        self.ladder.record_success()
+        return True
 
     def run_once(self) -> None:
         """One scheduling cycle (ref: scheduler.go:88-105). CloseSession is

@@ -8,6 +8,12 @@ stuck in an uninterruptible driver call cannot be reaped, so on timeout
 it is killed best-effort and left un-waited (start_new_session keeps it
 out of our process group; the zombie is collected when this process
 exits).
+
+``midrun_probe`` is the same subprocess probe generalized into a
+between-cycles health check: the degradation ladder (faults.py) calls
+it before re-promoting back onto a device engine after device-fault
+demotions, so a scheduler never climbs back onto an accelerator that is
+still wedged.
 """
 from __future__ import annotations
 
@@ -50,6 +56,21 @@ def probe_backend(timeout: float = 60.0,
         if proc.returncode == 0:
             return "ok", out_f.read().strip() or "unknown"
         return "error", err_f.read().strip()[-400:]
+
+
+def midrun_probe(timeout: float = 20.0,
+                 skip_env: Optional[str] = "KUBEBATCH_NO_BACKEND_PROBE",
+                 probe_src: str = PROBE_SRC) -> bool:
+    """Between-cycles health probe: True when the accelerator answers a
+    device query (or probing is skipped — tests and CPU-only runs, where
+    a subprocess probe is pure latency). Unlike the startup path this
+    never flips the platform: the caller (the degradation ladder) only
+    wants a go/no-go for re-promotion, and mid-run the backend is
+    already initialized."""
+    if skip_env and os.environ.get(skip_env):
+        return True
+    status, _ = probe_backend(timeout, probe_src)
+    return status == "ok"
 
 
 def ensure_responsive_backend(timeout: float = 60.0,

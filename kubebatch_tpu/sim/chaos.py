@@ -1,0 +1,496 @@
+"""Chaos soak — hundreds of scheduler cycles under a seeded fault plan.
+
+The executable form of the robustness claim (docs/ROBUSTNESS.md): drive
+a live scheduler — streaming event source, async cache write-back,
+leader lease, optionally a real gRPC sidecar — through a seeded
+randomized fault schedule spanning every seam family (faults.SEAMS),
+and ASSERT the invariants instead of trusting the error handling:
+
+- the loop never exits (every cycle runs through the guarded
+  ``Scheduler.run_cycle``; a raising cycle is a counted failure, never
+  a dead scheduler);
+- no task is lost or double-bound (ground truth vs cache vs the
+  recording binder; ``debug.audit_cache`` holds every cycle);
+- fairness shares are conserved (job-side allocated == node-side used);
+- once faults stop, the degradation ladder re-promotes to the original
+  engine and the recovered process produces decisions BIT-IDENTICAL to
+  a fault-free run of the same seed (the pre-chaos fingerprint).
+
+Entry points: ``bench.py --chaos`` (the committed evidence line) and
+tests/test_chaos.py (tier-1 smoke + the full ``slow`` soak).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import faults
+from ..api import TaskStatus
+from ..cache import SchedulerCache
+from ..debug import audit_cache
+from ..objects import (Container, GROUP_NAME_ANNOTATION, Pod, PodGroup,
+                       PodPhase, resource_list)
+from ..runtime.leaderelection import FileLease, LeaderElector
+from ..runtime.scheduler import Scheduler
+from .cluster import ClusterSpec, build_cluster
+from .source import StreamingEventSource
+
+log = logging.getLogger("kubebatch.chaos")
+
+GiB = 1024 ** 3
+
+#: the soak cluster: small enough that a cycle is milliseconds on any
+#: backend, rich enough that every layer runs (two queues for fairness,
+#: full gangs for the barrier). Capacity exceeds demand so a quiesced
+#: fault-free scheduler MUST bind everything — "pending remains" is a
+#: real violation, not a capacity artifact.
+def chaos_spec(seed: int = 0) -> ClusterSpec:
+    return ClusterSpec(n_nodes=12, node_cpu_millis=8000,
+                       node_mem_bytes=16 * GiB, n_groups=20,
+                       pods_per_group=4, pod_cpu_millis=1000,
+                       pod_mem_bytes=2 * GiB, n_queues=2, seed=seed)
+
+
+#: default per-crossing fault rates for the full soak — every one of the
+#: five seam families (device / rpc / cache / source / lease)
+DEFAULT_RATES: Dict[str, float] = {
+    "device.dispatch": 0.25,
+    "rpc.solve": 0.4,
+    "rpc.victim": 0.4,
+    "cache.bind": 0.3,
+    "cache.resync": 0.2,
+    "source.deliver": 0.2,
+    "lease.renew": 0.3,
+}
+
+#: the smoke-test subset: no device/rpc seams, so the ladder never
+#: demotes and the tier-1 run compiles no extra engines
+SMOKE_RATES: Dict[str, float] = {
+    "cache.bind": 0.3,
+    "cache.resync": 0.2,
+    "source.deliver": 0.2,
+    "lease.renew": 0.3,
+}
+
+
+class _RecordingSeams:
+    """Binder/evictor that records write-backs and flags double-binds.
+
+    A successful bind for a uid already bound (and not deleted since) is
+    the double-bind violation the soak exists to catch; failed binds
+    (injected upstream at the cache.bind seam) never reach here, so a
+    retry that finally lands records exactly once."""
+
+    def __init__(self):
+        self.bound: Dict[str, str] = {}
+        self.bind_calls = 0
+        self.evicted: List[str] = []
+        self.violations: List[str] = []
+        #: (namespace/name, hostname) in successful-bind order — the
+        #: decision fingerprint (deterministic under
+        #: async_writeback=False; pod NAMES are deterministic per spec
+        #: where auto-assigned uids are process-global counters)
+        self.decisions: List[Tuple[str, str]] = []
+        self._lock = threading.Lock()
+
+    def bind(self, pod, hostname):
+        with self._lock:
+            self.bind_calls += 1
+            if pod.uid in self.bound:
+                self.violations.append(
+                    f"double bind: {pod.namespace}/{pod.name} already on "
+                    f"{self.bound[pod.uid]}, re-bound to {hostname}")
+            self.bound[pod.uid] = hostname
+            self.decisions.append((f"{pod.namespace}/{pod.name}",
+                                   hostname))
+            pod.node_name = hostname
+
+    def evict(self, pod):
+        with self._lock:
+            self.evicted.append(pod.uid)
+            self.bound.pop(pod.uid, None)
+            pod.deletion_timestamp = 1.0
+
+    def forget(self, uid: str):
+        with self._lock:
+            self.bound.pop(uid, None)
+
+    def snapshot_bound(self) -> Dict[str, str]:
+        """A locked copy — the async write-back pool mutates ``bound``
+        concurrently with the soak thread's reads."""
+        with self._lock:
+            return dict(self.bound)
+
+    def take_violations(self) -> List[str]:
+        """Swap-and-clear under the lock: a violation appended by a
+        write-back thread mid-harvest must reach SOME harvest, never be
+        wiped between an unlocked read and clear."""
+        with self._lock:
+            taken, self.violations = self.violations, []
+            return taken
+
+
+@dataclass
+class ChaosReport:
+    cycles: int = 0
+    seed: int = 0
+    failures: int = 0                 # guarded cycles that failed
+    faults_injected: Dict[str, int] = field(default_factory=dict)
+    families_injected: List[str] = field(default_factory=list)
+    violations: List[str] = field(default_factory=list)
+    max_ladder_level: int = 0
+    final_ladder_level: int = -1
+    baseline_engine: str = ""
+    final_engine: str = ""
+    engines_seen: List[str] = field(default_factory=list)
+    recovered_bit_identical: bool = False
+    degraded_p50_ms: float = 0.0
+    healthy_p50_ms: float = 0.0
+    pods_bound: int = 0
+    lease_lost: bool = False
+    lease_renew_attempts: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def _fingerprint(seed: int) -> Tuple[List[Tuple[str, str]], str]:
+    """Decisions of ONE fault-free scheduling pass over a fresh cluster
+    built from ``seed`` — (uid, node) pairs in bind order, plus the
+    engine that ran. Called before the chaos (the oracle) and after
+    recovery (the recovered process must reproduce it bit-identically)."""
+    from ..actions import allocate as _alloc_mod
+
+    sim = build_cluster(chaos_spec(seed))
+    seams = _RecordingSeams()
+    cache = SchedulerCache(binder=seams, evictor=seams,
+                           async_writeback=False)
+    sim.populate(cache)
+    sched = Scheduler(cache, schedule_period=0.01)
+    # schedule to quiescence (the gang barrier may take two passes)
+    for _ in range(3):
+        sched.run_once()
+    return seams.decisions, _alloc_mod.last_cycle_engine
+
+
+def run_chaos(cycles: int = 200, seed: int = 0,
+              rates: Optional[Dict[str, float]] = None,
+              rpc_sidecar: bool = False,
+              fault_start: int = 3,
+              fault_stop: Optional[int] = None,
+              churn_gangs: int = 1) -> ChaosReport:
+    """Run the soak and return the report (callers assert ``report.ok``).
+
+    ``fault_stop`` defaults to leaving ~the last fifth of the cycles
+    (min 12) fault-free so quarantines expire, the ladder re-promotes,
+    and the bit-identical recovery check runs against a fully healthy
+    scheduler. ``rpc_sidecar`` starts an in-process gRPC solver sidecar
+    and routes allocate through it (KUBEBATCH_SOLVER=rpc) so the rpc
+    seams are crossed by real wire calls.
+    """
+    from ..actions import allocate as _alloc_mod
+
+    report = ChaosReport(cycles=cycles, seed=seed)
+    rates = dict(rates if rates is not None else DEFAULT_RATES)
+    if fault_stop is None:
+        fault_stop = max(fault_start + 1, cycles - max(12, cycles // 5))
+
+    # ---- process-wide robustness state: start clean, run on a fast
+    # quarantine policy (cooldowns sized to cycles, not minutes), and
+    # restore everything on the way out ------------------------------
+    saved_policy = faults.backoff_policy()
+    saved_env = {k: os.environ.get(k) for k in
+                 ("KUBEBATCH_SOLVER", "KUBEBATCH_SOLVER_ADDR",
+                  "KUBEBATCH_NO_BACKEND_PROBE")}
+    faults.reset()
+    faults.set_backoff_policy(faults.BackoffPolicy(
+        base_delay=0.002, max_delay=0.05, cooldown=0.25,
+        probe_backoff=1.5, max_cooldown=1.0))
+    # ladder re-promotion probes must not spawn jax subprocesses here —
+    # the soak measures ladder logic; the wedge probe has its own tests
+    os.environ["KUBEBATCH_NO_BACKEND_PROBE"] = "1"
+
+    server = None
+    lease_stop = threading.Event()
+    lease_thread = None
+    source = None
+    cache = None
+    try:
+        if rpc_sidecar:
+            from ..rpc.server import make_server
+            server, port = make_server("127.0.0.1:0")
+            server.start()
+            os.environ["KUBEBATCH_SOLVER"] = "rpc"
+            os.environ["KUBEBATCH_SOLVER_ADDR"] = f"127.0.0.1:{port}"
+
+        # ---- the fault-free oracle, recorded BEFORE any chaos ------
+        baseline_decisions, baseline_engine = _fingerprint(seed)
+        report.baseline_engine = baseline_engine
+        if not baseline_decisions:
+            report.violations.append("baseline run bound nothing")
+            return report
+
+        # ---- the live stack: source -> cache -> scheduler ----------
+        sim = build_cluster(chaos_spec(seed))
+        seams = _RecordingSeams()
+        cache = SchedulerCache(binder=seams, evictor=seams,
+                               async_writeback=True)
+        source = StreamingEventSource()
+        pods_by_uid: Dict[str, Pod] = {}
+        with source._lock:
+            for q in sim.queues:
+                source.queues[q.name] = q
+            for n in sim.nodes:
+                source.nodes[n.name] = n
+            for g in sim.groups:
+                source.groups[f"{g.namespace}/{g.name}"] = g
+            for p in sim.pods:
+                source.pods[f"{p.namespace}/{p.name}"] = p
+                pods_by_uid[p.uid] = p
+        source.start(cache)
+        cache.run()                      # resync/cleanup repair worker
+        sched = Scheduler(cache, schedule_period=0.01,
+                          cycle_deadline=30.0)
+
+        # ---- the leader lease, renewed throughout the soak ---------
+        lease_dir = tempfile.mkdtemp(prefix="kb-chaos-lease-")
+        lease = FileLease(os.path.join(lease_dir, "leader.lock"),
+                          lease_duration=30.0, renew_deadline=20.0,
+                          retry_period=0.1)
+        elector = LeaderElector(lease, 30.0, 20.0, 0.1)
+        lease_lost: List[bool] = []
+
+        def _workload(workload_stop: threading.Event) -> None:
+            while not lease_stop.is_set() and not workload_stop.is_set():
+                workload_stop.wait(0.1)
+
+        lease_thread = threading.Thread(
+            target=lambda: elector.run(_workload,
+                                       lambda: lease_lost.append(True),
+                                       lease_stop),
+            name="kb-chaos-lease", daemon=True)
+        lease_thread.start()
+
+        # ---- churn + kubelet helpers -------------------------------
+        churn_seq = [0]
+
+        def kubelet_tick() -> None:
+            """Successfully bound pods start Running (via the source,
+            like real status updates arrive)."""
+            for uid, host in seams.snapshot_bound().items():
+                pod = pods_by_uid.get(uid)
+                if pod is None or pod.phase != PodPhase.PENDING \
+                        or not pod.node_name:
+                    continue
+                pod.phase = PodPhase.RUNNING
+                source.emit_pod_update(pod, pod)
+
+        def churn() -> None:
+            """Oldest fully-Running gangs complete; equal fresh gangs
+            arrive — all through the event stream."""
+            by_group: Dict[str, List[Pod]] = {}
+            for pod in pods_by_uid.values():
+                by_group.setdefault(
+                    pod.annotations.get(GROUP_NAME_ANNOTATION, ""),
+                    []).append(pod)
+            done = 0
+            for key in sorted(source.groups):
+                if done >= churn_gangs:
+                    break
+                pg = source.groups.get(key)
+                if pg is None or not pg.name.startswith("job-"):
+                    continue
+                pods = by_group.get(pg.name, [])
+                if not pods or any(p.phase != PodPhase.RUNNING
+                                   for p in pods):
+                    continue
+                for pod in pods:
+                    source.emit_pod_delete(pod)
+                    pods_by_uid.pop(pod.uid, None)
+                    seams.forget(pod.uid)
+                source.emit_group_delete(pg)
+                done += 1
+            spec = chaos_spec(seed)
+            base_ts = 1e9 + churn_seq[0]
+            for k in range(done):
+                gid = churn_seq[0]
+                churn_seq[0] += 1
+                queue = sim.queues[gid % len(sim.queues)].name
+                pg = PodGroup(name=f"job-churn-{gid:06d}", namespace="sim",
+                              min_member=spec.pods_per_group, queue=queue,
+                              creation_timestamp=base_ts + k)
+                source.emit_group(pg)
+                for p in range(spec.pods_per_group):
+                    pod = Pod(
+                        name=f"{pg.name}-{p:03d}", namespace="sim",
+                        annotations={GROUP_NAME_ANNOTATION: pg.name},
+                        containers=[Container(requests=resource_list(
+                            cpu=spec.pod_cpu_millis,
+                            memory=spec.pod_mem_bytes))],
+                        creation_timestamp=base_ts + k + p / 1000.0)
+                    source.emit_pod(pod)   # also records it in the world
+                    pods_by_uid[pod.uid] = pod
+
+        def check_invariants(where: str) -> None:
+            with cache._lock:
+                problems = audit_cache(cache)
+            for p in problems:
+                report.violations.append(f"{where}: {p}")
+            # fairness conservation: job-side allocated == node-side used
+            with cache._lock:
+                job_cpu = sum(j.allocated.milli_cpu
+                              for j in cache.jobs.values())
+                job_mem = sum(j.allocated.memory
+                              for j in cache.jobs.values())
+                node_cpu = sum(n.used.milli_cpu
+                               for n in cache.nodes.values())
+                node_mem = sum(n.used.memory
+                               for n in cache.nodes.values())
+            if abs(job_cpu - node_cpu) > 1e-3 \
+                    or abs(job_mem - node_mem) > 64.0:
+                report.violations.append(
+                    f"{where}: fairness shares diverged — jobs allocated "
+                    f"({job_cpu:.3f}m, {job_mem:.0f}B) != nodes used "
+                    f"({node_cpu:.3f}m, {node_mem:.0f}B)")
+            report.violations.extend(
+                f"{where}: {v}" for v in seams.take_violations())
+
+        # ---- the soak loop -----------------------------------------
+        plan = faults.FaultPlan(rates=rates, seed=seed)
+        degraded_s: List[float] = []
+        healthy_s: List[float] = []
+        engines: set = set()
+        for cycle in range(cycles):
+            if cycle == fault_start:
+                faults.arm(plan)
+            if cycle == fault_stop:
+                faults.disarm()
+            in_window = fault_start <= cycle < fault_stop
+            kubelet_tick()
+            churn()
+            source.sync(timeout=15.0)
+            t0 = time.perf_counter()
+            try:
+                ok = sched.run_cycle()
+            except BaseException as e:   # run_cycle must NEVER raise
+                report.violations.append(
+                    f"cycle {cycle}: guarded cycle raised {e!r} — the "
+                    f"loop would have died")
+                break
+            dt = time.perf_counter() - t0
+            (degraded_s if in_window else healthy_s).append(dt)
+            if not ok:
+                report.failures += 1
+            engines.add(_alloc_mod.last_cycle_engine)
+            report.max_ladder_level = max(report.max_ladder_level,
+                                          faults.LADDER.level)
+            kubelet_tick()
+            if not in_window:
+                # the cache must be internally consistent every healthy
+                # cycle; inside the window the SAME check runs — faults
+                # land between cycles as retries, never as corruption
+                check_invariants(f"cycle {cycle}")
+            else:
+                check_invariants(f"cycle {cycle} (faulted)")
+            if not in_window and cycle > fault_stop:
+                # recovery phase: give the ladder's cooldown real time
+                time.sleep(0.05)
+
+        faults.disarm()
+        report.faults_injected = dict(plan.injected)
+        report.families_injected = sorted(
+            {s.split(".", 1)[0] for s in plan.injected})
+
+        # ---- quiesce fault-free: retries drain, pending rebinds ----
+        for settle in range(20):
+            cache.drain(timeout=10.0)
+            kubelet_tick()
+            source.sync(timeout=10.0)
+            sched.run_cycle()
+            kubelet_tick()
+            source.sync(timeout=10.0)
+            cache.drain(timeout=10.0)
+            with cache._lock:
+                pending = sum(
+                    len(j.task_status_index.get(TaskStatus.PENDING, {}))
+                    for j in cache.jobs.values())
+            if pending == 0:
+                break
+            time.sleep(0.05)
+        engines.add(_alloc_mod.last_cycle_engine)
+        report.engines_seen = sorted(engines)
+        report.final_engine = _alloc_mod.last_cycle_engine
+        report.final_ladder_level = faults.LADDER.level
+        report.pods_bound = len(seams.snapshot_bound())
+
+        # ---- final invariants --------------------------------------
+        check_invariants("final")
+        if report.final_ladder_level != 0:
+            report.violations.append(
+                f"ladder failed to re-promote: level "
+                f"{report.final_ladder_level} after recovery window")
+        with cache._lock:
+            cache_uids = {uid for j in cache.jobs.values()
+                          for uid in j.tasks}
+        for uid, pod in pods_by_uid.items():
+            if uid not in cache_uids:
+                report.violations.append(
+                    f"task lost: {pod.namespace}/{pod.name} in ground "
+                    f"truth but absent from the cache")
+            if not pod.node_name:
+                report.violations.append(
+                    f"task never bound after quiesce: "
+                    f"{pod.namespace}/{pod.name}")
+        report.lease_renew_attempts = elector.renew_attempts
+        report.lease_lost = bool(lease_lost)
+        if lease_lost:
+            report.violations.append(
+                "leadership lost during the soak (injected renew faults "
+                "must heal inside the deadline, never accumulate to loss)")
+
+        # ---- recovery fingerprint: bit-identical decisions ---------
+        recovered_decisions, recovered_engine = _fingerprint(seed)
+        report.recovered_bit_identical = (
+            recovered_decisions == baseline_decisions
+            and recovered_engine == baseline_engine)
+        if not report.recovered_bit_identical:
+            report.violations.append(
+                f"post-recovery decisions diverged from the fault-free "
+                f"oracle (engine {recovered_engine} vs {baseline_engine}, "
+                f"{len(recovered_decisions)} vs {len(baseline_decisions)} "
+                f"binds)")
+
+        if degraded_s:
+            report.degraded_p50_ms = round(
+                float(np.percentile(degraded_s, 50) * 1e3), 3)
+        if healthy_s:
+            report.healthy_p50_ms = round(
+                float(np.percentile(healthy_s, 50) * 1e3), 3)
+        return report
+    finally:
+        faults.disarm()
+        faults.set_backoff_policy(saved_policy)
+        faults.LADDER.reset()
+        faults.SIDECAR_QUARANTINE.reset()
+        lease_stop.set()
+        if lease_thread is not None:
+            lease_thread.join(timeout=5.0)
+        if source is not None:
+            source.stop()
+        if cache is not None:
+            cache.stop()
+        if server is not None:
+            server.stop(grace=None)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

@@ -29,14 +29,18 @@ module provides the simulated equivalent with the same shape:
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..api import TaskInfo
+from ..faults import check as _fault_check
 from ..objects import (Node, Pod, PodDisruptionBudget, PodGroup,
                        PriorityClass, Queue)
+
+log = logging.getLogger("kubebatch.sim")
 
 GiB = 1024 ** 3
 
@@ -274,6 +278,8 @@ class _Event:
     verb: str            # "add" | "update" | "delete"
     obj: object
     old: object = None
+    #: delivery attempts so far (the pump redelivers failed events)
+    attempts: int = 0
 
 
 class StreamingEventSource:
@@ -348,20 +354,57 @@ class StreamingEventSource:
             time.sleep(0.002)
         return False
 
+    #: delivery attempts before an event is dropped for good — transient
+    #: handler failures (injected or real) redeliver and heal; an event
+    #: the cache permanently rejects cannot wedge the stream forever
+    MAX_DELIVERY_ATTEMPTS = 8
+
     def _pump_loop(self) -> None:
         while not self._stop.is_set():
             with self._wake:
                 while not self._queue and not self._stop.is_set():
                     self._wake.wait(timeout=0.05)
                 events, self._queue = self._queue, []
-            for ev in events:
+            requeue: List[_Event] = []
+            for i, ev in enumerate(events):
                 try:
                     self._deliver(ev)
                 except Exception:   # a bad event must not kill the stream
-                    import traceback
-                    traceback.print_exc()
+                    ev.attempts += 1
+                    if ev.attempts < self.MAX_DELIVERY_ATTEMPTS:
+                        # a real informer gets redelivery from relist; the
+                        # sim stream requeues the delta itself. Delivery
+                        # STOPS at the failure: the failed event and
+                        # everything after it go back in order, because
+                        # delivering later events first would reorder
+                        # same-key deltas (a retried update landing after
+                        # its object's delete would resurrect it).
+                        log.warning(
+                            "event delivery failed (%s %s, attempt %d); "
+                            "requeueing it and %d later events", ev.kind,
+                            ev.verb, ev.attempts, len(events) - i - 1,
+                            exc_info=True)
+                        requeue = events[i:]
+                    else:
+                        log.exception(
+                            "event %s %s dropped after %d delivery "
+                            "attempts", ev.kind, ev.verb, ev.attempts)
+                        requeue = events[i + 1:]
+                    break
+            if requeue:
+                with self._wake:
+                    # front of the queue, ahead of anything enqueued
+                    # meanwhile: global order is preserved exactly
+                    self._queue[:0] = requeue
+                    self._wake.notify_all()
+                # let the failure clear instead of spinning hot on an
+                # event that fails deterministically
+                self._stop.wait(0.002)
 
     def _deliver(self, ev: _Event) -> None:
+        # injection seam: a delivery fault rides the same redelivery
+        # path as a real handler failure
+        _fault_check("source.deliver")
         cache = self._cache
         vb = self.volume_binder
         route = {
@@ -438,6 +481,11 @@ class StreamingEventSource:
         with self._lock:
             self.groups[f"{pg.namespace}/{pg.name}"] = pg
         self._emit("group", "add", pg)
+
+    def emit_group_delete(self, pg: PodGroup) -> None:
+        with self._lock:
+            self.groups.pop(f"{pg.namespace}/{pg.name}", None)
+        self._emit("group", "delete", pg)
 
     def emit_queue(self, q: Queue) -> None:
         with self._lock:

@@ -1,0 +1,368 @@
+"""Fault-injection seams, the degradation ladder, and the chaos soak
+(ISSUE 5). The tier-1 smoke runs a short soak over the cache/source/
+lease families; the full five-family soak with a live rpc sidecar is
+``slow`` (the acceptance-criteria run: >=200 cycles, zero invariant
+violations, bit-identical recovery)."""
+import threading
+import time
+
+import pytest
+
+from kubebatch_tpu import faults
+from kubebatch_tpu.api import TaskStatus
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.cache.cache import RetryQueue
+from kubebatch_tpu.debug import audit_cache
+from kubebatch_tpu.framework import Action, register_action
+from kubebatch_tpu.metrics import (cycle_failures_by_reason,
+                                   cycle_failures_total,
+                                   fault_injected_total)
+from kubebatch_tpu.objects import PodPhase
+from kubebatch_tpu.runtime import Scheduler
+
+from .fixtures import GiB, build_group, build_node, build_pod, \
+    build_queue, rl
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Every test starts and ends disarmed, ladder at level 0, default
+    policy — process-wide state must never leak between tests."""
+    saved = faults.backoff_policy()
+    faults.reset()
+    yield
+    faults.reset()
+    faults.set_backoff_policy(saved)
+
+
+# ---------------------------------------------------------------------
+# the plan: determinism, wildcards, counts, zero-cost disarmed
+# ---------------------------------------------------------------------
+
+def test_fault_plan_seeded_determinism():
+    a = faults.FaultPlan(rates={"x.y": 0.5}, seed=42)
+    b = faults.FaultPlan(rates={"x.y": 0.5}, seed=42)
+    seq_a = [a.should_fail("x.y") for _ in range(64)]
+    seq_b = [b.should_fail("x.y") for _ in range(64)]
+    assert seq_a == seq_b
+    assert any(seq_a) and not all(seq_a)
+
+
+def test_fault_plan_wildcards_and_counts():
+    plan = faults.FaultPlan(rates={"cache.*": 1.0},
+                            counts={"rpc.solve": 2})
+    assert plan.should_fail("cache.bind")
+    assert plan.should_fail("cache.resync")
+    assert not plan.should_fail("device.dispatch")
+    # counted seam: exactly the first N crossings fail
+    assert plan.should_fail("rpc.solve")
+    assert plan.should_fail("rpc.solve")
+    assert not plan.should_fail("rpc.solve")
+    assert plan.injected == {"cache.bind": 1, "cache.resync": 1,
+                             "rpc.solve": 2}
+    glob = faults.FaultPlan(rates={"*": 1.0})
+    assert glob.should_fail("lease.renew")
+
+
+def test_disarmed_seams_are_inert_and_uncounted():
+    before = fault_injected_total()
+    assert not faults.should_fail("cache.bind")
+    faults.check("device.dispatch")           # must not raise
+    assert fault_injected_total() == before
+
+
+def test_seam_catalog_covers_five_families():
+    fams = {s.split(".", 1)[0] for s in faults.SEAMS}
+    assert fams == set(faults.FAMILIES)
+
+
+def test_parse_fault_spec_roundtrip():
+    plan = faults.parse_fault_spec("rpc.solve:0.25,cache.bind:n3,"
+                                   "lease.renew", seed=9)
+    assert plan.rates == {"rpc.solve": 0.25, "lease.renew": 1.0}
+    assert plan.counts == {"cache.bind": 3}
+    assert plan.seed == 9
+
+
+# ---------------------------------------------------------------------
+# one policy object for every retry/quarantine timing (satellite 6)
+# ---------------------------------------------------------------------
+
+def test_retry_queue_reads_the_shared_policy():
+    assert RetryQueue()._base == faults.backoff_policy().base_delay
+    assert RetryQueue()._max == faults.backoff_policy().max_delay
+    faults.set_backoff_policy(faults.BackoffPolicy(base_delay=0.123,
+                                                   max_delay=9.0))
+    q = RetryQueue()
+    assert q._base == 0.123 and q._max == 9.0
+    # explicit args still win (tests that pin specific delays)
+    assert RetryQueue(base_delay=0.5)._base == 0.5
+
+
+def test_rpc_breaker_rides_the_quarantine():
+    from kubebatch_tpu.rpc.victims_wire import (breaker_open,
+                                                clear_breaker,
+                                                trip_breaker)
+
+    faults.set_backoff_policy(faults.BackoffPolicy(cooldown=0.05,
+                                                   probe_backoff=2.0))
+    trip_breaker("127.0.0.1:1")
+    assert breaker_open("127.0.0.1:1")
+    time.sleep(0.06)
+    assert not breaker_open("127.0.0.1:1")    # probe window opens
+    # single-flight: the probe re-arms the cooldown, so a second caller
+    # stays out while the probe is still in flight
+    assert breaker_open("127.0.0.1:1")
+    trip_breaker("127.0.0.1:1")               # probe failed: escalates
+    assert faults.SIDECAR_QUARANTINE.strikes("127.0.0.1:1") == 2
+    clear_breaker("127.0.0.1:1")              # probe succeeded: reset
+    assert not breaker_open("127.0.0.1:1")
+    assert faults.SIDECAR_QUARANTINE.strikes("127.0.0.1:1") == 0
+
+
+def test_ladder_demotes_and_repromotes():
+    lad = faults.DegradationLadder(
+        policy=faults.BackoffPolicy(cooldown=0.0),
+        demote_after=2, promote_after=2)
+    assert lad.cap_engine("sharded") == "sharded"
+    lad.record_failure()
+    assert lad.level == 0                     # one failure is not a trend
+    lad.record_failure()
+    assert lad.level == 1
+    assert lad.cap_engine("sharded") == "batched"
+    assert lad.cap_engine("rpc") == "batched"
+    assert lad.cap_engine("host") == "host"   # already below the cap
+    lad.record_failure(), lad.record_failure()
+    assert lad.level == 2 and lad.cap_engine("batched") == "fused"
+    for _ in range(4):
+        lad.record_success()
+    assert lad.level == 0
+
+
+def test_ladder_probe_gates_promotion():
+    """The recovery probe runs on its own thread (a wedged-accelerator
+    probe can take 20 s — it must never stall the scheduling loop);
+    record_success consults the last answer: False pins the level, True
+    promotes."""
+    answers = [False, True]
+    lad = faults.DegradationLadder(
+        policy=faults.BackoffPolicy(cooldown=0.0),
+        demote_after=1, promote_after=1,
+        probe=lambda: answers.pop(0))
+
+    def _settle():
+        for _ in range(200):
+            with lad._lock:
+                if not lad._probe_running:
+                    return
+            time.sleep(0.01)
+
+    lad.record_failure()
+    assert lad.level == 1
+    lad.record_success()                      # kicks async probe #1
+    _settle()
+    lad.record_success()                      # consumes False: stays
+    assert lad.level == 1
+    lad.record_success()                      # kicks async probe #2
+    _settle()
+    lad.record_success()                      # consumes True: promotes
+    assert lad.level == 0
+    assert answers == []
+
+
+# ---------------------------------------------------------------------
+# the guarded scheduler cycle (satellite 2)
+# ---------------------------------------------------------------------
+
+def _tiny_cache():
+    binds = {}
+
+    class _B:
+        def bind(self, pod, hostname):
+            binds[pod.uid] = hostname
+            pod.node_name = hostname
+
+    cache = SchedulerCache(binder=_B(), async_writeback=False)
+    cache.add_queue(build_queue("q1"))
+    cache.add_node(build_node("n1", rl(8000, 16 * GiB, pods=110)))
+    cache.add_pod_group(build_group("ns", "g", 1, queue="q1"))
+    cache.add_pod(build_pod("ns", "g-0", "", PodPhase.PENDING,
+                            rl(1000, GiB), group="g"))
+    return cache, binds
+
+
+class _ExplodingAction(Action):
+    """Opens a statement, applies an op, then dies mid-action — the
+    exact shape run_once's finally + CloseSession must clean up."""
+
+    def __init__(self):
+        self.captured = {}
+        self.explode = True
+
+    @property
+    def name(self) -> str:
+        return "explode"
+
+    def execute(self, ssn) -> None:
+        if not self.explode:
+            return
+        job = next(iter(ssn.jobs.values()))
+        task = next(iter(job.task_status_index[TaskStatus.PENDING]
+                         .values()))
+        stmt = ssn.statement()
+        stmt.pipeline(task, "n1")
+        self.captured["ssn"] = ssn
+        self.captured["task"] = stmt.operations[0][1][0]  # resolved twin
+        raise RuntimeError("boom: injected mid-action fault")
+
+
+_EXPLODER = _ExplodingAction()
+register_action(_EXPLODER)
+
+_EXPLODE_CONF = """
+actions: "explode"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+"""
+
+
+def test_raising_action_survives_with_rollback_and_close(monkeypatch):
+    """A raising action neither kills the loop nor leaks an open session
+    (satellite 2): cycle_failures_total counts it, the open statement is
+    rolled back, the session is closed, and the next cycle runs."""
+    monkeypatch.setenv("KUBEBATCH_SOLVER", "host")
+    cache, _ = _tiny_cache()
+    sched = Scheduler(cache, scheduler_conf=_EXPLODE_CONF,
+                      schedule_period=0.01)
+    _EXPLODER.explode = True
+    _EXPLODER.captured.clear()
+    before = cycle_failures_total()
+    try:
+        assert sched.run_cycle() is False
+        assert cycle_failures_total() == before + 1
+        assert cycle_failures_by_reason().get("exception", 0) >= 1
+        ssn = _EXPLODER.captured["ssn"]
+        task = _EXPLODER.captured["task"]
+        # the statement was discarded: the pipeline op rolled back...
+        assert task.status == TaskStatus.PENDING
+        assert task.node_name == ""
+        # ...and the session fully closed — no leaked statements, no
+        # live plugin/job references
+        assert ssn.open_statements == []
+        assert ssn.plugins == {} and ssn.jobs == {}
+        with cache._lock:
+            assert audit_cache(cache) == []
+        # the loop survives: the next (healthy) cycle binds the pod
+        _EXPLODER.explode = False
+        assert sched.run_cycle() is True
+    finally:
+        _EXPLODER.explode = False
+
+
+def test_cycle_deadline_counts_and_demotes(monkeypatch):
+    """A cycle over its deadline budget is a counted failure feeding the
+    ladder, even though nothing raised."""
+    monkeypatch.setenv("KUBEBATCH_SOLVER", "host")
+    cache, _ = _tiny_cache()
+    sched = Scheduler(cache, schedule_period=0.01, cycle_deadline=1e-9)
+    before = cycle_failures_by_reason().get("deadline", 0)
+    assert sched.run_cycle() is False
+    assert cycle_failures_by_reason()["deadline"] == before + 1
+    assert sched.run_cycle() is False
+    # demote_after=2 consecutive failures -> level 1
+    assert faults.LADDER.level == 1
+    assert faults.LADDER.cap_engine("sharded") == "batched"
+
+
+def test_counters_pin_to_zero_disarmed(monkeypatch):
+    """With injection disarmed, normal cycles move NO fault counters
+    (the acceptance pin: seams must be invisible in production)."""
+    monkeypatch.setenv("KUBEBATCH_SOLVER", "host")
+    cache, binds = _tiny_cache()
+    sched = Scheduler(cache, schedule_period=0.01)
+    inj0 = fault_injected_total()
+    fail0 = cycle_failures_total()
+    for _ in range(3):
+        assert sched.run_cycle() is True
+    assert binds
+    assert fault_injected_total() == inj0
+    assert cycle_failures_total() == fail0
+    assert faults.LADDER.level == 0
+
+
+def test_lease_renew_seam_refuses_once(tmp_path):
+    from kubebatch_tpu.runtime.leaderelection import FileLease
+
+    lease = FileLease(str(tmp_path / "l.lock"), identity="a")
+    faults.arm(faults.FaultPlan(counts={"lease.renew": 1}))
+    assert lease.try_acquire_or_renew() is False   # injected refusal
+    assert lease.try_acquire_or_renew() is True    # heals
+    assert fault_injected_total().get("lease.renew", 0) >= 1
+
+
+def test_bind_seam_heals_through_resync(monkeypatch):
+    """An injected cache.bind fault lands the task on the resync queue
+    and the repair loop re-drives it to a successful bind — no task
+    lost, no double bind."""
+    monkeypatch.setenv("KUBEBATCH_SOLVER", "host")
+    cache, binds = _tiny_cache()
+    sched = Scheduler(cache, schedule_period=0.01)
+    faults.arm(faults.FaultPlan(counts={"cache.bind": 1}))
+    sched.run_cycle()
+    # first bind attempt was injected away; the resync repair loop puts
+    # the task back to Pending and the next cycle rebinds
+    deadline = time.monotonic() + 10.0
+    while not binds and time.monotonic() < deadline:
+        cache.drain(timeout=1.0)
+        sched.run_cycle()
+    assert len(binds) == 1
+    with cache._lock:
+        assert audit_cache(cache) == []
+
+
+# ---------------------------------------------------------------------
+# the chaos soak
+# ---------------------------------------------------------------------
+
+def test_chaos_smoke(monkeypatch):
+    """Tier-1 chaos smoke: a short soak over the cache/source/lease
+    families (no device/rpc seams, so no extra engine compiles). Loop
+    alive, zero invariant violations, faults actually injected, and the
+    recovered process reproduces the fault-free decisions."""
+    monkeypatch.setenv("KUBEBATCH_SOLVER", "host")
+    from kubebatch_tpu.sim.chaos import SMOKE_RATES, run_chaos
+
+    rep = run_chaos(cycles=10, seed=1, rates=SMOKE_RATES,
+                    fault_start=2, fault_stop=7)
+    assert rep.ok, rep.violations[:5]
+    assert rep.faults_injected, "the armed window injected nothing"
+    assert set(rep.families_injected) <= {"cache", "source", "lease"}
+    assert rep.recovered_bit_identical
+    assert rep.final_ladder_level == 0
+    assert not rep.lease_lost
+    assert rep.pods_bound > 0
+
+
+@pytest.mark.slow
+def test_chaos_soak_full_five_families():
+    """The acceptance soak: >=200 cycles, a live rpc sidecar, faults
+    across ALL FIVE seam families, zero invariant violations, ladder
+    demotion observed and fully recovered, decisions bit-identical to
+    the fault-free oracle of the same seed."""
+    from kubebatch_tpu.sim.chaos import run_chaos
+
+    rep = run_chaos(cycles=200, seed=7, rpc_sidecar=True)
+    assert rep.ok, rep.violations[:10]
+    assert rep.cycles >= 200
+    assert set(rep.families_injected) == set(faults.FAMILIES)
+    assert rep.failures > 0, "no cycle ever failed — the soak proved " \
+                             "nothing about the ladder"
+    assert rep.max_ladder_level >= 1
+    assert rep.final_ladder_level == 0
+    assert rep.baseline_engine == "rpc"
+    assert rep.final_engine == "rpc"
+    assert rep.recovered_bit_identical
+    assert not rep.lease_lost
+    assert rep.lease_renew_attempts > 0

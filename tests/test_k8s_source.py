@@ -227,6 +227,82 @@ def test_watch_modified_and_deleted_flow():
     src.stop()
 
 
+def _pods_only_seam_harness():
+    """A deterministic single-watcher harness for the fault-seam tests:
+    only the pods kind is watched (counts-based injection budgets are
+    process-global, so concurrent watcher threads would race for them),
+    and the other kinds are fed to the cache directly."""
+    from kubebatch_tpu.objects import Node, PodGroup, Queue, resource_list
+
+    cache = SchedulerCache(binder=RecordingBinder(), async_writeback=False)
+    cache.add_queue(Queue(name="default", weight=1))
+    cache.add_node(Node(name="n1",
+                        allocatable=resource_list(cpu=4000,
+                                                  memory=8 * 2 ** 30,
+                                                  pods=110)))
+    cache.add_pod_group(PodGroup(name="g1", namespace="ns", min_member=2,
+                                 queue="default"))
+    lists = {"pods": [pod_manifest("ns", "g1-0", "g1")]}
+    watch_events = {
+        "pods": [("ADDED", pod_manifest("ns", "g1-1", "g1", rv="300"))]}
+    return cache, ReplayTransport(lists, watch_events)
+
+
+def test_fault_seam_410_drives_the_relist_path():
+    """The source.gone fault seam injects a typed ResourceExpired into
+    the live watch loop (ISSUE 5 satellite: the 410 path was only
+    fixture-replay tested) — the loop must re-LIST and resume exactly
+    like a real etcd-window expiry."""
+    from kubebatch_tpu import faults
+
+    cache, t = _pods_only_seam_harness()
+    faults.reset()
+    # exactly ONE injected 410, guaranteed to land on the single watcher
+    faults.arm(faults.FaultPlan(counts={"source.gone": 1}))
+    try:
+        src = drained_source(t, cache, kinds=("pods",))
+        wait = threading.Event()
+        for _ in range(100):
+            if t.list_calls["pods"] >= 2 and "ns/g1" in cache.jobs \
+                    and len(cache.jobs["ns/g1"].tasks) == 2:
+                break
+            wait.wait(0.05)
+        assert t.list_calls["pods"] >= 2, "injected 410 never relisted"
+        names = sorted(task.pod.name
+                       for task in cache.jobs["ns/g1"].tasks.values())
+        assert names == ["g1-0", "g1-1"]
+        src.stop()
+    finally:
+        faults.reset()
+
+
+def test_fault_seam_disconnect_backs_off_and_rewatches(monkeypatch):
+    """The source.disconnect fault seam drops the watch stream mid-run:
+    the loop logs, backs off, re-watches, and the deltas still land."""
+    from kubebatch_tpu import faults
+
+    monkeypatch.setattr(K8sEventSource, "RELIST_BACKOFF", 0.01)
+    cache, t = _pods_only_seam_harness()
+    faults.reset()
+    faults.arm(faults.FaultPlan(counts={"source.disconnect": 1}))
+    try:
+        src = drained_source(t, cache, kinds=("pods",))
+        wait = threading.Event()
+        for _ in range(100):
+            if "ns/g1" in cache.jobs \
+                    and len(cache.jobs["ns/g1"].tasks) == 2:
+                break
+            wait.wait(0.05)
+        names = sorted(task.pod.name
+                       for task in cache.jobs["ns/g1"].tasks.values())
+        assert names == ["g1-0", "g1-1"], \
+            "watched delta lost across the injected disconnect"
+        assert faults.active_plan().injected.get("source.disconnect", 0) > 0
+        src.stop()
+    finally:
+        faults.reset()
+
+
 def test_watch_410_relists_and_resumes():
     """A 410 Gone on the watch triggers re-LIST + resume: adds become
     idempotent MODIFIED/ADDED replays, and the stream continues."""

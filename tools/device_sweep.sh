@@ -32,3 +32,6 @@ $B  900 python bench.py --config 2 --mode rpc
 $B 1200 python bench.py --config 3 --mode rpc
 # 60+-cycle steady soak (p50/p95/max + RSS in the JSON line)
 $B 2400 python bench.py --config 5 --steady 256 --cycles 60
+# chaos soak: degraded-mode p50 alongside healthy p50, invariant
+# violations fail the run (docs/ROBUSTNESS.md)
+$B 1200 python bench.py --chaos --cycles 240
