@@ -143,20 +143,12 @@ def rpc_stats_fields(cycle_engines, rpc_addr: str) -> dict:
     return out
 
 
-#: per-config action order (BASELINE.md scenarios; cfg4/cfg5 use the
-#: shipped config/kube-batch-conf.yaml order). "2p"/"3p"/"5p" are the
-#: predicate-rich variants (labels/taints/selectors/affinity/ports at
-#: workload-ish fractions — sim/cluster.py BASELINE_SPECS).
-CONFIG_ACTIONS = {
-    1: ("allocate",),
-    2: ("allocate",),
-    3: ("allocate", "backfill"),
-    4: ("reclaim", "allocate", "backfill", "preempt"),
-    5: ("reclaim", "allocate", "backfill", "preempt"),
-    "2p": ("allocate",),
-    "3p": ("allocate", "backfill"),
-    "5p": ("reclaim", "allocate", "backfill", "preempt"),
-}
+#: per-config action order — shared with compilesvc/profile.py (the
+#: registered compile surface describes the same cycles the bench
+#: drives); predicate-rich "2p"/"3p"/"5p" variants included
+#: (labels/taints/selectors/affinity/ports at workload-ish fractions —
+#: sim/cluster.py BASELINE_SPECS)
+from kubebatch_tpu.conf import CONFIG_ACTIONS  # noqa: E402
 
 
 def build_actions(config: int, mode: str):
@@ -186,7 +178,7 @@ def run_config(config: int, cycles: int, mode: str):
     import gc
 
     from kubebatch_tpu.actions import allocate as _alloc_mod
-    from kubebatch_tpu.metrics import (blocking_readbacks,
+    from kubebatch_tpu.metrics import (blocking_readbacks, compile_ms_total,
                                        host_phase_seconds,
                                        solver_kernel_seconds)
 
@@ -200,6 +192,12 @@ def run_config(config: int, cycles: int, mode: str):
     readbacks = []
     kernel_s = []
     phase_s: dict = {}
+    #: first-cycle split (ISSUE 6 satellite): the cold cycle's wall used
+    #: to lump XLA compile into the host share — the compile manager's
+    #: counters split them, so cold lines carry cold_compile_ms (jit
+    #: compile path) next to cold_host_ms (tensorize/replay/close host
+    #: work) instead of one conflated number
+    cold_split: dict = {}
     # GC discipline mirrors runtime/scheduler.py: automatic collection off
     # during the timed cycle (a gen2 pass scans the whole 100k+ object
     # cluster graph mid-cycle otherwise), explicit collection between
@@ -229,6 +227,7 @@ def run_config(config: int, cycles: int, mode: str):
             rb0 = blocking_readbacks()
             ks0 = solver_kernel_seconds()
             hp0 = host_phase_seconds()
+            cm0 = compile_ms_total()
             t0 = time.perf_counter()
             ssn = OpenSession(cache, tiers)
             t1 = time.perf_counter()
@@ -244,6 +243,16 @@ def run_config(config: int, cycles: int, mode: str):
                 per = " ".join(f"{n}={s:.3f}s" for n, s in act_times)
                 print(f"cycle {cycle}: open={t1 - t0:.3f}s {per} "
                       f"close={dt - (t2 - t0):.3f}s", file=sys.stderr)
+            if cycle == 0:
+                # the first cycle pays jit compile — split it: compile
+                # path (counters) vs the host share (phase timers)
+                hp_c = host_phase_seconds()
+                cold_split = {
+                    "cold_wall_ms": round(dt * 1e3, 3),
+                    "cold_compile_ms": round(compile_ms_total() - cm0, 3),
+                    "cold_host_ms": round(1e3 * sum(
+                        hp_c[k] - hp0.get(k, 0.0) for k in hp_c), 3),
+                }
             if cycle > 0 or cycles == 1:   # first cycle pays jit compile
                 latencies.append(dt)
                 bound_total += len(binds)
@@ -268,7 +277,7 @@ def run_config(config: int, cycles: int, mode: str):
     phase_ms = {k: round(1e3 * float(np.median(v)), 3)
                 for k, v in sorted(phase_s.items())}
     return (latencies, bound_total, bind_seconds, evicted_total, action_ms,
-            engines, readbacks, kernel_s, phase_ms)
+            engines, readbacks, kernel_s, phase_ms, cold_split)
 
 
 def run_steady(config, cycles: int, mode: str, churn_pods: int,
@@ -357,9 +366,16 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
             for _, act in acts:
                 act.execute(ssn)
             CloseSession(ssn)
+        from kubebatch_tpu import compilesvc
         from kubebatch_tpu.actions import allocate as _alloc_mod
-        from kubebatch_tpu.metrics import blocking_readbacks
+        from kubebatch_tpu.metrics import blocking_readbacks, recompiles_total
 
+        # the warm-up / churn cycles above traced every steady shape:
+        # from here a real compile is a counted recompile, and the
+        # steady line FAILS on a nonzero count (ISSUE 6 enforcement —
+        # a compile wall mid-steady-cycle must never pass silently)
+        compilesvc.mark_warm()
+        recompiles0 = recompiles_total()
         latencies = []
         bound = 0
         action_seconds = {name: 0.0 for name in CONFIG_ACTIONS[config]}
@@ -393,13 +409,14 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
                 action_seconds[name] += secs
             readbacks.append(blocking_readbacks() - rb0)
             engines.append(_alloc_mod.last_cycle_engine)
+        recompiles = recompiles_total() - recompiles0
     finally:
         gc.enable()
     action_ms = {name: round(1e3 * secs / max(1, len(latencies)), 3)
                  for name, secs in action_seconds.items()}
     # peak RSS in MiB (ru_maxrss is KiB on Linux) — the soak evidence
     rss_mb = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024.0
-    return latencies, bound, action_ms, readbacks, rss_mb, engines
+    return latencies, bound, action_ms, readbacks, rss_mb, engines, recompiles
 
 
 def main(argv=None):
@@ -468,6 +485,11 @@ def main(argv=None):
     from kubebatch_tpu import enable_persistent_compile_cache
     enable_persistent_compile_cache()
     backend = ensure_responsive_backend()
+    if backend == "cpu-fallback":
+        # the watchdog flipped the platform: re-salt the managed cache
+        # onto the cpu directory so fallback executables never mix into
+        # the accelerator's entries (compilesvc/cache.py cache_salt)
+        enable_persistent_compile_cache()
 
     if args.chaos:
         # the chaos soak evidence line: degraded-mode p50 next to healthy
@@ -503,6 +525,9 @@ def main(argv=None):
             "invariant_violations": len(rep.violations),
             "backend": backend,
         }
+        from kubebatch_tpu.metrics import compile_ms_total, recompiles_total
+        out["compile_ms_total"] = round(compile_ms_total(), 1)
+        out["recompiles_total"] = recompiles_total()
         if rep.violations:
             out["violations"] = rep.violations[:10]
         emit(out)
@@ -531,7 +556,8 @@ def main(argv=None):
 
     if args.steady > 0:
         # >=9 measured cycles so the reported p95 means something
-        latencies, bound, action_ms, readbacks, rss_mb, engines = run_steady(
+        (latencies, bound, action_ms, readbacks, rss_mb, engines,
+         recompiles) = run_steady(
             args.config, max(args.cycles, 9), args.mode, args.steady,
             skew=args.steady_skew)
         p50_ms = float(np.percentile(latencies, 50) * 1e3)
@@ -558,10 +584,16 @@ def main(argv=None):
         }
         # injection disarmed -> these pin to zero; a nonzero value on a
         # steady line means a seam fired outside an armed plan
-        from kubebatch_tpu.metrics import (cycle_failures_total,
+        from kubebatch_tpu.metrics import (compile_ms_total,
+                                           cycle_failures_total,
                                            fault_injected_total)
         out["faults_injected"] = sum(fault_injected_total().values())
         out["cycle_failures"] = cycle_failures_total()
+        # the recompiles==0 invariant (ISSUE 6): the in-run warm-up
+        # cycles traced every steady shape, so a compile inside the
+        # measured window is a structural failure, not wall-time noise
+        out["recompiles_total"] = recompiles
+        out["compile_ms_total"] = round(compile_ms_total(), 1)
         if args.mode == "rpc":
             # same hop-cost / zero-fallback contract as the cold path: a
             # steady rpc line must not silently record in-process cycles
@@ -573,11 +605,16 @@ def main(argv=None):
             print(f"rpc bench engaged fallback engines: {engines}",
                   file=sys.stderr)
             return 1
+        if recompiles:
+            from kubebatch_tpu.metrics import recompiles_by_reason
+            print(f"steady run recompiled after warm-up: "
+                  f"{recompiles_by_reason()}", file=sys.stderr)
+            return 1
         return 0
 
     (latencies, bound, seconds, evicted, action_ms, engines,
-     readbacks, kernel_s, phase_ms) = run_config(args.config, args.cycles,
-                                                 args.mode)
+     readbacks, kernel_s, phase_ms, cold_split) = run_config(
+        args.config, args.cycles, args.mode)
     p50_ms = float(np.percentile(latencies, 50) * 1e3)
     p95_ms = float(np.percentile(latencies, 95) * 1e3)
     pods_per_sec = bound / seconds if seconds > 0 else 0.0
@@ -612,8 +649,23 @@ def main(argv=None):
         "host_share_ms": round(phase_ms.get("tensorize", 0.0)
                                + phase_ms.get("replay", 0.0)
                                + phase_ms.get("close", 0.0), 3),
+        # first-cycle split (cold_compile_ms vs cold_host_ms — the jit
+        # compile no longer hides inside the host share) + the compile
+        # manager's process counters, on every line (docs/COMPILE.md)
+        **cold_split,
         "backend": backend,
     }
+    from kubebatch_tpu.metrics import compile_ms_total, recompiles_total
+
+    def stamp_compile_counters():
+        """(Re)stamp the compile-manager process counters — called again
+        right before the FINAL emit so the authoritative last line covers
+        whatever the steady extra compiled (consumers parse the last
+        line; stale counters on it would under-report the compile wall)."""
+        out["compile_ms_total"] = round(compile_ms_total(), 1)
+        out["recompiles_total"] = recompiles_total()
+
+    stamp_compile_counters()
     if evicted:
         out["evictions_per_cycle"] = evicted // max(1, len(latencies))
     #: every cycle the rpc evidence fields must cover — the cfg5
@@ -641,8 +693,9 @@ def main(argv=None):
             emit(out, flush=True, partial=True)
         try:
             churn = 256
-            s_lat, s_bound, s_act, s_rb, _, s_eng = run_steady(
+            s_lat, s_bound, s_act, s_rb, _, s_eng, s_rc = run_steady(
                 args.config, 9, args.mode, churn)
+            out["steady_recompiles"] = s_rc
             out["steady_p50_ms"] = round(
                 float(np.percentile(s_lat, 50) * 1e3), 3)
             out["steady_p95_ms"] = round(
@@ -667,6 +720,7 @@ def main(argv=None):
         # the run after the line is emitted so the evidence file still
         # records what happened
         out.update(rpc_stats_fields(rpc_cycle_engines, rpc_addr))
+    stamp_compile_counters()   # cover the steady extra's compiles too
     emit(out)
     if rpc_server is not None:
         rpc_server.stop(grace=None)

@@ -35,24 +35,12 @@ __version__ = "0.4.1"
 
 
 def enable_persistent_compile_cache(path=None) -> str:
-    """Point XLA's persistent compilation cache at ``path`` (default
-    ``$KUBEBATCH_COMPILE_CACHE`` or ``~/.cache/kubebatch-tpu/xla``) so a
-    restarted scheduler reuses compiled solver programs instead of
-    re-tracing+compiling them — measured on the v5e tunnel, the first
-    cfg5 solve of a fresh process drops 67 s -> 11 s. Process entry
-    points (CLI, bench) call this; embedders opt in explicitly. Set
-    ``KUBEBATCH_COMPILE_CACHE=0`` to disable. Returns the directory
-    ("" when disabled)."""
-    import os
+    """Point XLA's persistent compilation cache at the compile manager's
+    managed, version-salted directory (compilesvc/cache.py — the
+    subsystem that owns compile-state discipline; see docs/COMPILE.md).
+    Process entry points (CLI, bench, tools/precompile.py) call this;
+    embedders opt in explicitly. Set ``KUBEBATCH_COMPILE_CACHE=0`` to
+    disable. Returns the directory ("" when disabled)."""
+    from .compilesvc.cache import enable_persistent_compile_cache as enable
 
-    env = os.environ.get("KUBEBATCH_COMPILE_CACHE", "")
-    if env in ("0", "false", "off"):
-        return ""
-    if path is None:
-        path = env or os.path.expanduser("~/.cache/kubebatch-tpu/xla")
-    os.makedirs(path, exist_ok=True)
-    import jax
-
-    jax.config.update("jax_compilation_cache_dir", path)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    return path
+    return enable(path)

@@ -14,6 +14,8 @@ from functools import partial
 import jax
 import numpy as np
 
+from ..compilesvc import instrument as _instrument
+from ..compilesvc import register_provider as _register_provider
 from ..faults import check as _fault_check
 from ..framework import Session
 from ..kernels.fused import fused_allocate, unpack_host_block
@@ -65,6 +67,35 @@ def _fused_packed(buf_f, buf_i, buf_b, idle, releasing, backfilled,
         max_iters=max_iters)
 
 
+# accounted trace boundary (compilesvc): the small-cycle fused entry
+_fused_packed = _instrument("fused", "_fused_packed", _fused_packed)
+
+
+def prepare_fused(inputs):
+    """The exact (args, statics) the fused packed entry dispatches for
+    these CycleInputs — shared by the live dispatch and the compilesvc
+    signature provider (a registered signature can never drift from the
+    live arg-building code)."""
+    device = inputs.device
+    t_pad = inputs.task_valid.shape[0]
+    j_pad = inputs.job_valid.shape[0]
+    q_pad = inputs.q_weight.shape[0]
+    max_iters = int(t_pad + 3 * j_pad + q_pad + 8)
+    buf_f, lay_f, buf_i, lay_i, buf_b, lay_b = pack_inputs(
+        lambda n: getattr(inputs, n), _F32, _I32, _BOOL)
+    args = (buf_f, buf_i, buf_b,
+            device.idle, device.releasing, device.backfilled,
+            device.allocatable_cm, device.nz_req,
+            device.max_task_num, device.n_tasks, device.node_ok)
+    statics = dict(
+        lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
+        job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+        gang_enabled=inputs.gang_enabled,
+        prop_overused=inputs.prop_overused,
+        dyn_enabled=inputs.dyn_enabled, max_iters=max_iters)
+    return args, statics
+
+
 def execute_fused(ssn: Session) -> bool:
     """Run the whole allocate action as one dispatch. Returns False —
     without consuming any state — when the snapshot has features the
@@ -77,26 +108,12 @@ def execute_fused(ssn: Session) -> bool:
     # injection seam: after the support gates, before the dispatch
     _fault_check("device.dispatch")
     device = inputs.device
-    t_pad = inputs.task_valid.shape[0]
-    j_pad = inputs.job_valid.shape[0]
-    q_pad = inputs.q_weight.shape[0]
-    max_iters = int(t_pad + 3 * j_pad + q_pad + 8)
-
-    buf_f, lay_f, buf_i, lay_i, buf_b, lay_b = pack_inputs(
-        lambda n: getattr(inputs, n), _F32, _I32, _BOOL)
+    args, statics = prepare_fused(inputs)
 
     start = time.perf_counter()
     with solver_trace("fused_allocate"):
         (host_block, idle_f, rel_f, ntasks_f, nz_f) = _fused_packed(
-            buf_f, buf_i, buf_b,
-            device.idle, device.releasing, device.backfilled,
-            device.allocatable_cm, device.nz_req,
-            device.max_task_num, device.n_tasks, device.node_ok,
-            lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
-            job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
-            gang_enabled=inputs.gang_enabled,
-            prop_overused=inputs.prop_overused,
-            dyn_enabled=inputs.dyn_enabled, max_iters=max_iters)
+            *args, **statics)
         count_blocking_readback()
         host_block = np.asarray(host_block)   # the cycle's ONE blocking read
     task_state, task_node, task_seq, _ = unpack_host_block(host_block)
@@ -107,3 +124,33 @@ def execute_fused(ssn: Session) -> bool:
 
     replay_decisions(ssn, inputs, task_state, task_node, task_seq)
     return True
+
+
+# ---------------------------------------------------------------------
+# compilesvc signature provider — fused engages below the auto-batched
+# threshold: tiny cold configs and the steady churn regime
+# ---------------------------------------------------------------------
+
+@_register_provider("actions.allocate_fused")
+def compile_signatures(materials):
+    from ..compilesvc.registry import Signature, signature_key
+    from .allocate import AUTO_BATCHED_MIN
+
+    out = []
+    for regime, inputs in (("cold", materials.cold_inputs),
+                           ("steady", materials.steady_inputs)):
+        if inputs is None or isinstance(inputs, str):
+            continue
+        if len(inputs.tasks) >= AUTO_BATCHED_MIN:
+            continue    # this regime dispatches the batched engine
+        if getattr(inputs, "affinity", None) is not None:
+            continue    # fused never consumes the affinity vocabulary
+        args, statics = prepare_fused(inputs)
+        out.append(Signature(
+            engine="fused", entry="_fused_packed",
+            key=signature_key("_fused_packed", args, statics),
+            lower=lambda a=args, s=statics: _fused_packed.lower(*a, **s),
+            run=lambda a=args, s=statics: _fused_packed(*a, **s),
+            note=(f"{regime} T={inputs.task_valid.shape[0]} "
+                  f"N={inputs.device.n_padded}")))
+    return out

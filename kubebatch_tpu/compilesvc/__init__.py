@@ -1,0 +1,52 @@
+"""compilesvc — the compile manager (ISSUE 6 / ROADMAP item 4).
+
+XLA compilation is a first-class production concern for this scheduler:
+the one recorded cfg5p device-shaped run spent 536 s dominated by
+compile, and a daemon serving the <15 ms p50 target cannot eat a
+compile wall mid-cycle. This subsystem makes the compile surface
+explicit and keeps it off the latency path, in three parts:
+
+- **Shape-bucket registry** (registry.py + providers in every engine
+  module): the canonical (shape-bucket x static-arg) signatures each
+  jitted entry point dispatches per config — listable, countable,
+  diffable.
+- **AOT warm-up** (warmup.py, profile.py, cache.py): compile the
+  registered set at daemon start (CLI ``--warmup``) or offline
+  (``tools/precompile.py``), with managed persistent-cache discipline
+  (salted directory) so warmed executables survive restarts.
+- **Enforcement** (monitor.py + metrics): ``compile_ms_total`` and
+  ``recompiles_total{engine, reason}`` at every trace boundary, wired
+  into bench emission and the scheduler's degradation ladder; steady
+  benches fail when ``recompiles_total > 0`` after warm-up, and a
+  mid-run shape outside the registry surfaces as
+  ``reason="unregistered"`` instead of a silent stall.
+
+Import discipline: this package root and registry/monitor are light
+(kernel modules import them at load); profile/warmup pull in the sim
+and actions lazily.
+"""
+from __future__ import annotations
+
+from .cache import (cache_salt, enable_persistent_compile_cache)  # noqa: F401
+from .monitor import (install, instrument, is_warm, known_keys,  # noqa: F401
+                      mark_warm, reset)
+from .registry import (Signature, diff_signatures,  # noqa: F401
+                       enumerate_signatures, register_provider,
+                       signature_key)
+
+__all__ = [
+    "Signature", "register_provider", "enumerate_signatures",
+    "diff_signatures", "signature_key", "instrument", "install",
+    "mark_warm", "is_warm", "known_keys", "reset",
+    "enable_persistent_compile_cache", "cache_salt", "warmup",
+]
+
+
+def warmup(config, execute: bool = True, steady: bool = True,
+           persistent_cache: bool = True):
+    """Warm the registered bucket set (see compilesvc.warmup.warmup) —
+    lazy wrapper so importing the package stays light."""
+    from .warmup import warmup as _warmup
+
+    return _warmup(config, execute=execute, steady=steady,
+                   persistent_cache=persistent_cache)

@@ -1,0 +1,210 @@
+"""Compile monitoring — every trace boundary observed, every compile
+accounted.
+
+Two mechanisms compose:
+
+- A process-wide ``jax.monitoring`` listener accumulates every XLA
+  backend compile's wall (persistent-cache retrieval wall included)
+  into ``metrics.compile_ms_total``, whatever thread compiles.
+
+- :func:`instrument` wraps each jitted entry point. The wrapper is the
+  TRACE BOUNDARY: it detects a dispatch-cache miss (``_cache_size``
+  growth on the underlying pjit function), derives the live call's
+  canonical signature key (registry.signature_key — identical to the
+  registry's derivation), and classifies any post-warm-up REAL compile
+  (a backend compile not served by the persistent cache) into
+  ``metrics.recompiles_total{engine, reason}``:
+
+  * ``reason="unregistered"`` — the signature is outside the known set
+    (registered bucket set + everything traced before warm-up): a
+    mid-run shape the registry does not cover, surfaced instead of
+    silently absorbed.
+  * ``reason="warm-miss"`` — a known signature compiled anyway (the
+    persistent cache is off, was evicted, or its key salt changed).
+
+  Boundaries nest (``fused_allocate`` inside ``_fused_packed``'s trace,
+  ``batched_allocate`` inside ``_sharded_entry``): only the outermost
+  wrapper on a thread accounts, so one logical dispatch is one boundary.
+
+The hot path cost is two C++ ``_cache_size`` calls and a list
+push/pop; the key is only derived on a cache miss.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Set
+
+from .. import metrics
+from .registry import signature_key
+
+__all__ = ["install", "instrument", "mark_warm", "is_warm", "known_keys",
+           "add_known_keys", "reset"]
+
+_tls = threading.local()
+_lock = threading.Lock()
+_installed = False
+_warm = False
+#: signatures the process may legitimately trace without it counting as
+#: a recompile source classification of "unregistered": the registered /
+#: warmed bucket set plus everything traced BEFORE mark_warm()
+_known: Set[str] = set()
+
+
+class _Boundary:
+    __slots__ = ("engine", "entry", "compiles", "disk_hits")
+
+    def __init__(self, engine: str, entry: str):
+        self.engine = engine
+        self.entry = entry
+        self.compiles = 0
+        self.disk_hits = 0
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _on_duration(name: str, duration: float, **kw) -> None:
+    # backend_compile only: the trace/lowering phase events nest (one
+    # fires per inner jaxpr) and would double-count against wall time;
+    # backend compiles are disjoint per program, so their sum is a true
+    # "XLA compile wall" (persistent-cache retrieval wall included)
+    if not name.endswith("backend_compile_duration"):
+        return
+    metrics.add_compile_ms(duration * 1e3)
+    st = getattr(_tls, "stack", None)
+    if st:
+        st[-1].compiles += 1
+
+
+def _on_event(name: str, **kw) -> None:
+    # a persistent-cache retrieval still fires backend_compile_duration
+    # (the retrieval wall); the paired cache_hits event marks it warm
+    if name == "/jax/compilation_cache/cache_hits":
+        st = getattr(_tls, "stack", None)
+        if st:
+            st[-1].disk_hits += 1
+
+
+def install() -> None:
+    """Register the jax.monitoring listeners once per process."""
+    global _installed
+    if _installed:
+        return
+    with _lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        jax.monitoring.register_event_listener(_on_event)
+        _installed = True
+
+
+def mark_warm(keys=()) -> None:
+    """Declare warm-up complete: from here on, a real compile at an
+    instrumented boundary is a counted recompile. ``keys``: extra
+    signature keys to fold into the known set (warmup passes the
+    registered bucket set)."""
+    global _warm
+    with _lock:
+        _known.update(keys)
+        _warm = True
+
+
+def is_warm() -> bool:
+    return _warm
+
+
+def add_known_keys(keys) -> None:
+    with _lock:
+        _known.update(keys)
+
+
+def known_keys() -> Set[str]:
+    """A copy of the known signature set (registered + pre-warm-traced)."""
+    with _lock:
+        return set(_known)
+
+
+def reset() -> None:
+    """Drop compile-manager state AND jax's in-process executable caches.
+
+    The scoped reset the test fixture uses (tests/conftest.py): clears
+    jax's native compiler caches (the accumulated-state segfault
+    mitigation), the warm mark + known-signature set (so one module's
+    warm-up cannot classify another module's compiles), and the sticky
+    shape-bucket holds (so a big module's pow2 hold never leaks onto a
+    small module's shapes). Process-lifetime metrics counters are NOT
+    zeroed — consumers diff across a window, like every other counter.
+    """
+    global _warm
+    with _lock:
+        _warm = False
+        _known.clear()
+    from ..kernels import tensorize
+
+    tensorize._STICKY.clear()
+    import jax
+
+    jax.clear_caches()
+
+
+def _note_miss(engine: str, entry: str, args, statics, b: _Boundary) -> None:
+    key = signature_key(entry, args, statics)
+    with _lock:
+        known = key in _known
+        _known.add(key)
+        warm = _warm
+    if warm and b.compiles > b.disk_hits:
+        metrics.count_recompile(engine,
+                                "warm-miss" if known else "unregistered")
+
+
+def instrument(engine: str, entry: str, fn) -> Callable:
+    """Wrap a jitted entry point as an accounted trace boundary.
+
+    The wrapper forwards ``lower`` / ``_cache_size`` (AOT warm-up and
+    tests use them) and exposes the underlying pjit function as
+    ``jit_fn``. Nested boundaries pass straight through to the pjit
+    function — the outermost boundary owns the accounting.
+    """
+    def wrapper(*args, **kwargs):
+        st = _stack()
+        if st:                      # nested under an outer boundary
+            return fn(*args, **kwargs)
+        install()
+        try:
+            size0 = fn._cache_size()
+        except Exception:           # pragma: no cover — older jax
+            size0 = None
+        b = _Boundary(engine, entry)
+        st.append(b)
+        try:
+            out = fn(*args, **kwargs)
+        finally:
+            st.pop()
+        grew = b.compiles > 0 if size0 is None else False
+        if size0 is not None:
+            try:
+                grew = fn._cache_size() > size0
+            except Exception:       # pragma: no cover
+                grew = b.compiles > 0
+        if grew and b.compiles:
+            _note_miss(engine, entry, args, kwargs, b)
+        return out
+
+    wrapper.__name__ = entry
+    wrapper.__qualname__ = entry
+    wrapper.__wrapped__ = fn
+    wrapper.jit_fn = fn
+    wrapper.engine = engine
+    wrapper.lower = fn.lower
+    try:
+        wrapper._cache_size = fn._cache_size
+    except Exception:               # pragma: no cover — older jax
+        pass
+    return wrapper

@@ -1,0 +1,193 @@
+"""Per-config bucket profiles — the canonical cycle materials the
+signature providers derive shapes from.
+
+A config's compile surface is a function of the shapes its scheduling
+cycles produce, and those shapes are already deterministic: the sim
+generators are seeded, the pad buckets are pow2 with documented minimums
+(kernels/tensorize.py), and the static jit args derive from the shipped
+plugin stack. So instead of hand-maintaining a shape table that would
+drift from the code, a profile IS a deterministically-built cycle:
+:func:`build_materials` populates the config's simulated cluster, opens
+a session, and tensorizes it exactly the way a live cycle would —
+without dispatching anything. Providers then read real
+``CycleInputs`` / ``DeviceSession`` / ``VictimSolver`` objects, so a
+registered signature can never disagree with the live path's
+arg-building code (they share it).
+
+Two regimes per config:
+
+- **cold** (always built): the full-backlog first cycle — the big
+  batched/fused shapes, the per-visit scan, the scatter buckets. Pure
+  host work; building it compiles nothing.
+- **steady** (``advance_to_steady``): one full scheduling round is
+  EXECUTED, bound pods flip Running, a canonical churn tick arrives,
+  and a fresh session is tensorized — the regime the 1 s schedule loop
+  lives in, where the victim kernels (running tasks exist now) and the
+  small-cycle fused shapes appear. Reaching this state necessarily
+  executes the cold engines once; warmup orders its passes so that
+  execution is itself the cold warm-up, not a redundant compile.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+__all__ = ["ConfigMaterials", "build_materials", "STEADY_CHURN"]
+
+#: canonical steady-regime churn (pods per tick) — matches the committed
+#: steady bench lines (bench.py --steady 256); tiny configs clamp to
+#: their population
+STEADY_CHURN = 256
+
+
+class _Seam:
+    """Binder/evictor seam for the profile cluster (sim pods never touch
+    a real apiserver)."""
+
+    def __init__(self):
+        self.bound: List = []
+
+    def bind(self, pod, hostname):
+        pod.node_name = hostname
+        self.bound.append(pod)
+
+    def evict(self, pod):
+        pod.deletion_timestamp = 1.0
+
+
+@dataclass
+class ConfigMaterials:
+    """Everything the signature providers need for one config."""
+    config: object
+    actions: Tuple[str, ...]
+    tiers: list
+    sim: object
+    cache: object
+    seam: _Seam
+    #: cold-regime cycle inputs (CycleInputs | EMPTY_CYCLE | None) and
+    #: the pending-task gang sizes feeding the per-visit scan buckets
+    cold_inputs: object = None
+    gang_buckets: Tuple[int, ...] = ()
+    #: steady-regime products (None until advance_to_steady)
+    steady_inputs: object = None
+    reclaim_solver: object = None
+    preempt_solver: object = None
+    is_steady: bool = False
+    #: sessions kept referenced so device snapshots stay attached
+    _sessions: list = field(default_factory=list)
+
+    # -- construction ---------------------------------------------------
+
+    def _open(self):
+        from ..framework import OpenSession
+
+        return OpenSession(self.cache, self.tiers)
+
+    def _build_cold(self) -> None:
+        from ..actions.cycle_inputs import build_cycle_inputs
+        from ..api import TaskStatus
+        from ..framework import CloseSession
+        from ..kernels.tensorize import pad_to_bucket
+
+        ssn = self._open()
+        try:
+            self.cold_inputs = build_cycle_inputs(ssn, allow_affinity=True)
+            buckets = sorted({
+                pad_to_bucket(len(j.task_status_index.get(
+                    TaskStatus.PENDING, {})), 8)
+                for j in ssn.jobs.values()
+                if TaskStatus.PENDING in j.task_status_index})
+            self.gang_buckets = tuple(buckets)
+        finally:
+            CloseSession(ssn)
+
+    def advance_to_steady(self) -> None:
+        """Execute one full scheduling round, flip bound pods Running,
+        churn-tick, and tensorize the resulting steady session. The
+        execution is deliberate: it is the only honest way to reach the
+        shapes the steady loop dispatches (and it warms the cold
+        signatures as a side effect — warmup sequences around that)."""
+        if self.is_steady:
+            return
+        from ..actions.allocate import AllocateAction
+        from ..actions.backfill import BackfillAction
+        from ..actions.cycle_inputs import build_cycle_inputs
+        from ..actions.preempt import PreemptAction
+        from ..actions.reclaim import ReclaimAction
+        from ..framework import CloseSession
+        from ..objects import PodPhase
+
+        mk = {"allocate": lambda: AllocateAction(mode="auto"),
+              "backfill": BackfillAction,
+              "preempt": PreemptAction,
+              "reclaim": ReclaimAction}
+        acts = [mk[name]() for name in self.actions]
+        ssn = self._open()
+        try:
+            for act in acts:
+                act.execute(ssn)
+        finally:
+            CloseSession(ssn)
+        # kubelet tick: bound pods start Running outside the cycle, so
+        # the steady session carries victim rows (running tasks)
+        for pod in self.seam.bound:
+            if pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                self.cache.update_pod(pod, pod)
+        self.seam.bound.clear()
+        spec = self.sim.spec
+        churn = max(1, min(STEADY_CHURN,
+                           spec.pods_per_group * spec.n_groups))
+        # churn_tick degrades gracefully on its own (a cluster with no
+        # fully-bound gang recycles 0 pods); a real raise here must
+        # propagate — a silently churn-less profile would register the
+        # wrong steady shapes, i.e. exactly the mid-run recompiles this
+        # subsystem exists to prevent
+        self.sim.churn_tick(self.cache, churn)
+
+        ssn = self._open()
+        self._sessions.append(ssn)   # stays open: victim solvers read it
+        self.steady_inputs = build_cycle_inputs(ssn, allow_affinity=True)
+        if "reclaim" in self.actions or "preempt" in self.actions:
+            from ..kernels.victims import SKIP_ACTION, build_action_solver
+
+            if "reclaim" in self.actions:
+                s = build_action_solver(ssn, "reclaimable_fns",
+                                        "reclaimable_disabled",
+                                        score_nodes=False)
+                self.reclaim_solver = None if s is SKIP_ACTION else s
+            if "preempt" in self.actions:
+                s = build_action_solver(ssn, "preemptable_fns",
+                                        "preemptable_disabled",
+                                        score_nodes=True)
+                self.preempt_solver = None if s is SKIP_ACTION else s
+        self.is_steady = True
+
+    def close(self) -> None:
+        from ..framework import CloseSession
+
+        while self._sessions:
+            CloseSession(self._sessions.pop())
+
+
+def build_materials(config, steady: bool = False) -> ConfigMaterials:
+    """Deterministic materials for ``config`` (a BASELINE key: 1..5,
+    "2p"/"3p"/"5p"). Cold regime always; ``steady=True`` also advances
+    to the churn regime (executes one scheduling round — see class
+    docstring)."""
+    from ..cache import SchedulerCache
+    from ..conf import CONFIG_ACTIONS, shipped_tiers
+    from ..sim import baseline_cluster
+
+    seam = _Seam()
+    cache = SchedulerCache(binder=seam, evictor=seam,
+                           async_writeback=False)
+    sim = baseline_cluster(config)
+    sim.populate(cache)
+    m = ConfigMaterials(config=config, actions=CONFIG_ACTIONS[config],
+                        tiers=shipped_tiers(), sim=sim, cache=cache,
+                        seam=seam)
+    m._build_cold()
+    if steady:
+        m.advance_to_steady()
+    return m

@@ -1,0 +1,161 @@
+"""Shape-bucket registry — the compile surface, explicit and enumerable.
+
+Every jitted entry point registers a *signature provider*: a function
+that, given a config's :class:`~kubebatch_tpu.compilesvc.profile.
+ConfigMaterials`, yields the canonical (shape-bucket x static-arg)
+signatures that engine dispatches for the config. The padding
+granularity itself already lives in ``kernels/tensorize.py``
+(``pad_to_bucket`` / ``sticky_bucket``) and in each engine's static jit
+args; this module only makes the resulting bucket set a first-class,
+listable object so the full compile surface of a config can be listed,
+counted, and diffed — and so the warm-up pass (compilesvc/warmup.py)
+can compile it ahead of the first scheduling cycle.
+
+A signature's ``key`` is a canonical string derived from the entry name,
+the avals (dtype x shape, weak-typedness included) of every positional
+argument, and the static kwargs — the SAME derivation the monitor's
+instrumented trace boundaries apply to live calls (monitor.py), so
+registry membership of a live dispatch is a set lookup. Keys carry no
+process-local state (no ids, no addresses); for a fixed config and
+environment they are bit-stable across fresh processes, which
+tests/test_compilesvc.py pins.
+
+This module is import-light on purpose: the kernel modules import it at
+module load to register their providers, so it must not import jax, the
+kernels, or the sim at module level.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Signature", "register_provider", "providers",
+           "enumerate_signatures", "diff_signatures", "signature_key"]
+
+
+# ---------------------------------------------------------------------
+# canonical signature keys
+# ---------------------------------------------------------------------
+
+def _aval(x) -> str:
+    """Canonical token for one argument: dtype[shape] for array-likes
+    (jnp / np arrays and scalars), repr for python statics, recursion
+    for tuples (pack layouts, order-key specs) and NamedTuple pytrees
+    (RoundState / CycleArrays)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        weak = "~" if getattr(x, "weak_type", False) else ""
+        name = getattr(dtype, "name", str(dtype))
+        return f"{weak}{name}[{'x'.join(str(int(d)) for d in shape)}]"
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return repr(x)
+    if hasattr(x, "_fields"):          # NamedTuple pytree
+        inner = ",".join(f"{f}={_aval(getattr(x, f))}" for f in x._fields)
+        return f"{type(x).__name__}({inner})"
+    if isinstance(x, (tuple, list)):
+        return "(" + ",".join(_aval(v) for v in x) + ")"
+    return type(x).__name__
+
+
+def signature_key(entry: str, args: tuple, statics: dict) -> str:
+    """The canonical key for one (entry, avals, statics) combination —
+    shared by registry enumeration and the monitor's live boundaries."""
+    kw = ";".join(f"{k}={_aval(v)}" for k, v in sorted(statics.items()))
+    return f"{entry}|{','.join(_aval(a) for a in args)}|{kw}"
+
+
+# ---------------------------------------------------------------------
+# signatures + providers
+# ---------------------------------------------------------------------
+
+@dataclass
+class Signature:
+    """One registered (shape-bucket x static-arg) compile signature.
+
+    ``lower``: zero-arg callable returning a ``jax.stages.Lowered`` for
+    the AOT ``.lower().compile()`` pass. ``run``: zero-arg callable that
+    EXECUTES the entry on canonical inputs through its instrumented
+    wrapper — unlike AOT compilation this also populates the in-process
+    jit dispatch cache, which is what pins same-process recompiles to
+    zero (jax's AOT executables do not feed the live-call cache; see
+    docs/COMPILE.md "Warm-up modes").
+    """
+    engine: str
+    entry: str
+    key: str
+    lower: Optional[Callable] = None
+    run: Optional[Callable] = None
+    note: str = ""
+
+    def __repr__(self) -> str:  # keys are long; keep repr scannable
+        return f"Signature({self.engine}/{self.entry}, {self.note or self.key[:60]})"
+
+
+#: provider registry: insertion-ordered {name: provider}; providers are
+#: registered by the engine modules at import (see PROVIDER_MODULES)
+_PROVIDERS: Dict[str, Callable] = {}
+
+#: modules whose import registers every provider — the one list that
+#: defines "the full compile surface" (new engines add themselves here)
+PROVIDER_MODULES: Tuple[str, ...] = (
+    "kubebatch_tpu.kernels.solver",
+    "kubebatch_tpu.kernels.batched",
+    "kubebatch_tpu.kernels.batched_sharded",
+    "kubebatch_tpu.kernels.sharded",
+    "kubebatch_tpu.kernels.victims",
+    "kubebatch_tpu.actions.allocate_fused",
+)
+
+
+def register_provider(name: str):
+    """Decorator: register ``fn(materials) -> List[Signature]`` under
+    ``name`` (the engine module's identity in listings)."""
+    def deco(fn):
+        _PROVIDERS[name] = fn
+        return fn
+    return deco
+
+
+def providers() -> Dict[str, Callable]:
+    """The registered providers (imports PROVIDER_MODULES first so the
+    listing is complete regardless of what the process touched)."""
+    import importlib
+
+    for mod in PROVIDER_MODULES:
+        importlib.import_module(mod)
+    return dict(_PROVIDERS)
+
+
+def enumerate_signatures(config, steady: bool = True,
+                         materials=None) -> List[Signature]:
+    """The full registered compile surface for ``config`` (cfg1..cfg5p),
+    deduped by key and sorted — the listed/counted/diffed object.
+
+    ``steady=False`` restricts to the cold-cycle surface (cheap: no
+    engine executes); ``steady=True`` also advances the profile cluster
+    to the steady/churn regime, which is where the victim kernels and
+    the small-cycle fused shapes live — reaching that state executes one
+    scheduling round (see profile.ConfigMaterials.advance_to_steady).
+    """
+    from .profile import build_materials
+
+    if materials is None:
+        materials = build_materials(config, steady=steady)
+    elif steady and not materials.is_steady:
+        materials.advance_to_steady()
+    out: Dict[str, Signature] = {}
+    for name, provider in providers().items():
+        for sig in provider(materials):
+            out.setdefault(sig.key, sig)
+    return sorted(out.values(), key=lambda s: (s.engine, s.entry, s.key))
+
+
+def diff_signatures(a: List[Signature], b: List[Signature]):
+    """(only_in_a, only_in_b) by key — the config-to-config compile
+    surface diff (e.g. what cfg5p adds over cfg5)."""
+    ka = {s.key: s for s in a}
+    kb = {s.key: s for s in b}
+    only_a = [s for k, s in sorted(ka.items()) if k not in kb]
+    only_b = [s for k, s in sorted(kb.items()) if k not in ka]
+    return only_a, only_b

@@ -1,0 +1,130 @@
+"""AOT warm-up — compile the registered bucket set before the first
+scheduling cycle, then pin recompiles to zero.
+
+Two warm-up modes per signature (docs/COMPILE.md "Warm-up modes"):
+
+- ``execute`` (the default for a live process): run the entry on its
+  canonical inputs through the instrumented wrapper. This both compiles
+  the program (persisted by the managed cache) AND populates jax's
+  in-process dispatch cache, so the daemon's first real cycle is a pure
+  cache hit — the property the steady benches pin
+  (``recompiles_total == 0``). Executing a scheduler kernel on
+  synthetic inputs is safe: every entry is a pure function of its
+  arguments.
+
+- ``aot`` (``execute=False`` — the offline ``tools/precompile.py``
+  shape): ``jax.jit(...).lower().compile()`` only. No device execution;
+  the product is the persistent-cache entries, which a later process
+  retrieves in milliseconds instead of recompiling. jax's AOT
+  executables do NOT feed the live dispatch cache, so an aot-warmed
+  process still pays a (cheap, disk-served) retrace per signature —
+  retrievals are warm by definition and never count as recompiles.
+
+Sequencing matters: the cold surface is compiled FIRST, so that
+advancing the profile cluster to the steady regime (which executes one
+scheduling round) rides the just-warmed cold signatures instead of
+compiling them as an untracked side effect.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+from . import monitor
+from .registry import Signature, enumerate_signatures
+
+__all__ = ["warmup", "WarmupReport"]
+
+
+@dataclass
+class WarmupReport:
+    config: object
+    mode: str
+    signatures: int = 0
+    compiled: int = 0
+    skipped: int = 0
+    failed: List[Tuple[str, str]] = field(default_factory=list)
+    compile_ms: float = 0.0
+    wall_ms: float = 0.0
+    cache_dir: str = ""
+    keys: List[str] = field(default_factory=list)
+
+    def summary(self) -> str:
+        out = (f"cfg{self.config}: {self.compiled}/{self.signatures} "
+               f"signatures compiled ({self.mode}), "
+               f"{self.compile_ms:.0f} ms compile wall, "
+               f"{self.wall_ms:.0f} ms total")
+        if self.skipped:
+            out += f", {self.skipped} already warm"
+        if self.failed:
+            out += f", {len(self.failed)} FAILED"
+        if self.cache_dir:
+            out += f", cache {self.cache_dir}"
+        return out
+
+
+def _one(sig: Signature, execute: bool, seen: set, report: WarmupReport):
+    if sig.key in seen:      # cold keys re-listed by the steady pass
+        return
+    seen.add(sig.key)
+    try:
+        if execute and sig.run is not None:
+            import jax
+
+            jax.block_until_ready(sig.run())
+        elif sig.lower is not None:
+            sig.lower().compile()
+        else:                      # pragma: no cover — providers set one
+            report.skipped += 1
+            return
+        report.compiled += 1
+    except Exception as e:         # a failed signature must not sink the
+        report.failed.append((sig.key, f"{type(e).__name__}: {e}"))
+
+
+def warmup(config, execute: bool = True, steady: bool = True,
+           persistent_cache: bool = True) -> WarmupReport:
+    """Warm the registered bucket set for ``config`` and mark the
+    process warm (``monitor.mark_warm``): from the moment this returns,
+    any real compile at a trace boundary increments
+    ``recompiles_total{engine, reason}``.
+
+    ``steady=False`` restricts to the cold surface (no execution of a
+    scheduling round); ``persistent_cache=False`` leaves the cache
+    config untouched (tests)."""
+    from .. import metrics
+    from .profile import build_materials
+
+    monitor.install()
+    report = WarmupReport(config=config,
+                          mode="execute" if execute else "aot")
+    if persistent_cache:
+        from .cache import enable_persistent_compile_cache
+
+        report.cache_dir = enable_persistent_compile_cache()
+    t0 = time.perf_counter()
+    c0 = metrics.compile_ms_total()
+    seen: set = set()
+
+    materials = build_materials(config, steady=False)
+    cold = enumerate_signatures(config, steady=False, materials=materials)
+    for sig in cold:
+        _one(sig, execute, seen, report)
+    sigs = cold
+    if steady:
+        # the steady advance executes one scheduling round — its cold
+        # dispatches are cache hits now, its steady shapes get compiled
+        # by the round itself (execute) or lowered below (aot)
+        materials.advance_to_steady()
+        sigs = enumerate_signatures(config, steady=True,
+                                    materials=materials)
+        for sig in sigs:
+            _one(sig, execute, seen, report)
+    materials.close()
+    report.signatures = len(sigs)
+    report.keys = [s.key for s in sigs]
+    report.compile_ms = metrics.compile_ms_total() - c0
+    report.wall_ms = (time.perf_counter() - t0) * 1e3
+    monitor.mark_warm(report.keys)
+    return report

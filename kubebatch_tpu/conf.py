@@ -91,3 +91,20 @@ def shipped_tiers() -> List[Tier]:
     single construction point benches, the multichip dryrun, and the
     equivalence suites share."""
     return parse_scheduler_conf(SHIPPED_CONF).tiers
+
+
+#: per-config action order (BASELINE.md scenarios; cfg4/cfg5 use the
+#: shipped config/kube-batch-conf.yaml order). "2p"/"3p"/"5p" are the
+#: predicate-rich variants. ONE definition shared by bench.py and
+#: compilesvc/profile.py — the registered compile surface must describe
+#: the same cycles the bench drives.
+CONFIG_ACTIONS = {
+    1: ("allocate",),
+    2: ("allocate",),
+    3: ("allocate", "backfill"),
+    4: ("reclaim", "allocate", "backfill", "preempt"),
+    5: ("reclaim", "allocate", "backfill", "preempt"),
+    "2p": ("allocate",),
+    "3p": ("allocate", "backfill"),
+    "5p": ("reclaim", "allocate", "backfill", "preempt"),
+}

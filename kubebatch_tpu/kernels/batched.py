@@ -68,6 +68,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..compilesvc import instrument as _instrument
+from ..compilesvc import register_provider as _register_provider
 from ..metrics import (count_blocking_readback, solver_trace,
                        update_solver_kernel_duration)
 from .fused import (ALLOC, ALLOC_OB, FAIL, K_DRF_SHARE, K_GANG_READY,
@@ -918,6 +920,11 @@ def batched_round(state: RoundState, a: CycleArrays, round_idx,
                   dyn_enabled, pipe_enabled)
 
 
+# accounted trace boundary (compilesvc); nested calls from the packed /
+# sharded entries pass straight through to the pjit function
+batched_round = _instrument("batched", "batched_round", batched_round)
+
+
 #: task-axis fields of CycleArrays (compacted for the post-round-0 loop)
 _TASK_FIELDS = ("resreq", "init_resreq", "task_nz", "task_job", "task_rank",
                 "task_sig", "task_pair", "task_valid")
@@ -1063,6 +1070,12 @@ def batched_allocate(state: RoundState, a: CycleArrays,
     return epilogue(merged, rounds)
 
 
+# accounted trace boundary (compilesvc); calls nested inside the packed
+# or sharded entries' traces pass straight through
+batched_allocate = _instrument("batched", "batched_allocate",
+                               batched_allocate)
+
+
 #: (buffer kind, CycleArrays/RoundState source) for the packed upload; the
 #: order defines buffer layout.  Node-axis arrays live on the DeviceSession
 #: (uploaded once per session), everything per-cycle ships as THREE host
@@ -1120,6 +1133,11 @@ def _batched_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
                                       compact_bucket, gang_enabled))
 
 
+# accounted trace boundary (compilesvc): the production whole-cycle entry
+_batched_packed = _instrument("batched", "_batched_packed",
+                              _batched_packed)
+
+
 def _pack_result(final: RoundState, rounds):
     """Decisions + round count as ONE int32 buffer: every blocking
     device->host read pays full tunnel latency (~70 ms on axon), so the
@@ -1162,14 +1180,13 @@ def _run_batched(state, f, i, b, backfilled, allocatable_cm, max_task_num,
         compact_bucket=compact_bucket, gang_enabled=gang_enabled)
 
 
-def solve_batched(device, inputs, max_rounds: int = 0,
-                  compact_bucket=None):
-    """Drive the round loop.  ``device`` is a solver.DeviceSession (its
-    capacity arrays are committed on return); ``inputs`` a CycleInputs
-    (actions/cycle_inputs.py).  Returns (task_state, task_node, task_seq)
-    as numpy plus the round count.  ``compact_bucket``: None = auto-size
-    the post-round-0 compaction (tests pass 0 to force the full-width
-    loop for equivalence checks)."""
+def prepare_batched(device, inputs, max_rounds: int = 0,
+                    compact_bucket=None):
+    """Build the exact (args, statics) the packed entry dispatches for
+    this (device, inputs) pair — shared by the live dispatch below and
+    the compilesvc signature provider, so a registered signature can
+    never drift from what the engine actually traces. Returns
+    (args tuple, statics dict)."""
     t_pad = inputs.task_valid.shape[0]
     if max_rounds <= 0:
         # every productive round places >= 1 task or fails >= 1 job; the
@@ -1201,28 +1218,42 @@ def solve_batched(device, inputs, max_rounds: int = 0,
     buf_f, lay_f, buf_i, lay_i, buf_b, lay_b = pack_inputs(
         lambda n: extra[n] if n in extra else getattr(inputs, n),
         f32_names, i32_names, bool_names)
-
-    start = time.perf_counter()
     # compact continuation pays off once the [T,N] matrices dwarf the
     # straggler count; below ~2k tasks the full-width rounds are cheap
     if compact_bucket is None:
         compact = max(256, t_pad // 8) if t_pad >= 2048 else 0
     else:
         compact = compact_bucket
-    with solver_trace("batched_allocate"):
-        final, packed = _batched_packed(
-            buf_f, buf_i, buf_b,
+    args = (buf_f, buf_i, buf_b,
             device.idle, device.releasing, device.n_tasks, device.nz_req,
             device.backfilled, device.allocatable_cm, device.max_task_num,
-            device.node_ok,
-            lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
-            job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
-            prop_overused=inputs.prop_overused,
-            pipe_enabled=inputs.pipe_enabled,
-            dyn_enabled=inputs.dyn_enabled,
-            max_rounds=min(max_rounds, 4096),
-            compact_bucket=compact,
-            gang_enabled=inputs.gang_enabled)
+            device.node_ok)
+    statics = dict(
+        lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
+        job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+        prop_overused=inputs.prop_overused,
+        pipe_enabled=inputs.pipe_enabled,
+        dyn_enabled=inputs.dyn_enabled,
+        max_rounds=min(max_rounds, 4096),
+        compact_bucket=compact,
+        gang_enabled=inputs.gang_enabled)
+    return args, statics
+
+
+def solve_batched(device, inputs, max_rounds: int = 0,
+                  compact_bucket=None):
+    """Drive the round loop.  ``device`` is a solver.DeviceSession (its
+    capacity arrays are committed on return); ``inputs`` a CycleInputs
+    (actions/cycle_inputs.py).  Returns (task_state, task_node, task_seq)
+    as numpy plus the round count.  ``compact_bucket``: None = auto-size
+    the post-round-0 compaction (tests pass 0 to force the full-width
+    loop for equivalence checks)."""
+    t_pad = inputs.task_valid.shape[0]
+    args, statics = prepare_batched(device, inputs, max_rounds,
+                                    compact_bucket)
+    start = time.perf_counter()
+    with solver_trace("batched_allocate"):
+        final, packed = _batched_packed(*args, **statics)
         # ONE blocking transfer for everything the host needs; it stays
         # inside the trace so a one-shot capture includes the device
         # execution, not just the async dispatch
@@ -1240,3 +1271,54 @@ def solve_batched(device, inputs, max_rounds: int = 0,
     update_solver_kernel_duration("batched_allocate",
                                   time.perf_counter() - start)
     return task_state, task_node, task_seq, int(rounds)
+
+
+# ---------------------------------------------------------------------
+# compilesvc signature provider — the packed whole-cycle entry at the
+# config's canonical buckets (shapes/statics via prepare_batched, the
+# SAME code the live dispatch runs)
+# ---------------------------------------------------------------------
+
+def _batched_signatures(inputs, regime: str, pipe_variants=(None,)):
+    from ..compilesvc.registry import Signature, signature_key
+
+    # ONE packed buffer set — only the statics differ between pipe
+    # variants, and every lambda closing over `args` shares it (packing
+    # the [T,N]-scale buffers per variant would double the warm-up
+    # pass's work and peak memory for nothing)
+    args, base = prepare_batched(inputs.device, inputs)
+    out = []
+    for pipe in pipe_variants:
+        statics = (base if pipe is None
+                   else dict(base, pipe_enabled=pipe))
+        out.append(Signature(
+            engine="batched", entry="_batched_packed",
+            key=signature_key("_batched_packed", args, statics),
+            lower=lambda a=args, s=statics: _batched_packed.lower(*a, **s),
+            run=lambda a=args, s=statics: _batched_packed(*a, **s),
+            note=(f"{regime} T={inputs.task_valid.shape[0]} "
+                  f"N={inputs.device.n_padded} "
+                  f"pipe={statics['pipe_enabled']}")))
+    return out
+
+
+@_register_provider("kernels.batched")
+def compile_signatures(materials):
+    from ..actions.allocate import AUTO_BATCHED_MIN
+
+    out = []
+    for regime, inputs in (("cold", materials.cold_inputs),
+                           ("steady", materials.steady_inputs)):
+        if inputs is None or isinstance(inputs, str):
+            continue
+        if len(inputs.tasks) < AUTO_BATCHED_MIN:
+            continue    # this regime dispatches the fused engine
+        # reclaim/preempt configs can open a batched cycle with releasing
+        # capacity on the nodes (evictions pending) — pipe_enabled is a
+        # static, so both variants are part of the registered surface
+        pipes = ((False, True)
+                 if ("reclaim" in materials.actions
+                     or "preempt" in materials.actions)
+                 else (bool(inputs.pipe_enabled),))
+        out.extend(_batched_signatures(inputs, regime, pipes))
+    return out

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compilesvc import instrument as _instrument
+from ..compilesvc import register_provider as _register_provider
 from .batched import RoundState, CycleArrays, _IMAX, batched_allocate
 from .fused import SKIP
 
@@ -136,6 +138,10 @@ def _sharded_entry(state: RoundState, arrays: CycleArrays, job_keys,
          rounds.astype(jnp.int32)[None]])
 
 
+# accounted trace boundary (compilesvc): the GSPMD mesh entry
+_sharded_entry = _instrument("sharded", "_sharded_entry", _sharded_entry)
+
+
 def _pad_nodes(a: np.ndarray, n_pad: int) -> np.ndarray:
     if a.shape[0] == n_pad:
         return a
@@ -187,6 +193,38 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
     # dispatch leaves the DeviceSession state untouched
     _fault_check("device.dispatch")
 
+    n_pad = device.n_padded
+    t_pad = inputs.task_valid.shape[0]
+    placed_state, placed_arrays, statics = prepare_sharded(
+        mesh, device, inputs, max_rounds)
+    start = time.perf_counter()
+    with solver_trace("batched_allocate_sharded"):
+        final, packed = _sharded_entry(placed_state, placed_arrays,
+                                       **statics)
+        count_blocking_readback()
+        out = np.asarray(packed)
+    task_state = out[:t_pad]
+    task_node = out[t_pad:2 * t_pad]
+    task_seq = out[2 * t_pad:3 * t_pad]
+    rounds = out[3 * t_pad]
+
+    # commit the carry back to the session's device state (trimmed to the
+    # single-chip bucket) so later actions see the updated accounting
+    count_blocking_readback(4)
+    device.idle = jnp.asarray(np.asarray(final.idle)[:n_pad])
+    device.releasing = jnp.asarray(np.asarray(final.releasing)[:n_pad])
+    device.n_tasks = jnp.asarray(np.asarray(final.n_tasks)[:n_pad])
+    device.nz_req = jnp.asarray(np.asarray(final.nz_req)[:n_pad])
+    update_solver_kernel_duration("batched_allocate_sharded",
+                                  time.perf_counter() - start)
+    return task_state, task_node, task_seq, int(rounds)
+
+
+def prepare_sharded(mesh: Mesh, device, inputs, max_rounds: int = 0):
+    """Pad, annotate, and place the round solver's inputs on ``mesh`` —
+    the exact (placed RoundState, placed CycleArrays, statics) the mesh
+    entry dispatches, shared by the live path above and the compilesvc
+    signature provider."""
     n_dev = mesh.devices.size
     n_pad = device.n_padded
     n_sh = shard_bucket(n_pad, n_dev)
@@ -263,29 +301,56 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
     array_specs, state_specs = _specs_for(
         mesh, affinity=aff is not None, ports=has_ports,
         ip=aff is not None and aff.ip_enabled)
-    start = time.perf_counter()
-    with solver_trace("batched_allocate_sharded"):
-        final, packed = _sharded_entry(
-            put(state, state_specs), put(arrays, array_specs),
-            job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
-            prop_overused=inputs.prop_overused,
-            dyn_enabled=inputs.dyn_enabled,
-            pipe_enabled=inputs.pipe_enabled,
-            max_rounds=min(max_rounds, 4096))
-        count_blocking_readback()
-        out = np.asarray(packed)
-    task_state = out[:t_pad]
-    task_node = out[t_pad:2 * t_pad]
-    task_seq = out[2 * t_pad:3 * t_pad]
-    rounds = out[3 * t_pad]
+    statics = dict(
+        job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+        prop_overused=inputs.prop_overused,
+        dyn_enabled=inputs.dyn_enabled,
+        pipe_enabled=inputs.pipe_enabled,
+        max_rounds=min(max_rounds, 4096))
+    return put(state, state_specs), put(arrays, array_specs), statics
 
-    # commit the carry back to the session's device state (trimmed to the
-    # single-chip bucket) so later actions see the updated accounting
-    count_blocking_readback(4)
-    device.idle = jnp.asarray(np.asarray(final.idle)[:n_pad])
-    device.releasing = jnp.asarray(np.asarray(final.releasing)[:n_pad])
-    device.n_tasks = jnp.asarray(np.asarray(final.n_tasks)[:n_pad])
-    device.nz_req = jnp.asarray(np.asarray(final.nz_req)[:n_pad])
-    update_solver_kernel_duration("batched_allocate_sharded",
-                                  time.perf_counter() - start)
-    return task_state, task_node, task_seq, int(rounds)
+
+# ---------------------------------------------------------------------
+# compilesvc signature provider — the mesh twin registers whenever more
+# than one device is visible and the node axis clears the auto-sharded
+# threshold (the shipped default then partitions the round engine)
+# ---------------------------------------------------------------------
+
+@_register_provider("kernels.batched_sharded")
+def compile_signatures(materials):
+    from ..actions.allocate import (AUTO_BATCHED_MIN,
+                                    AUTO_SHARDED_MIN_NODES)
+    from ..compilesvc.registry import Signature, signature_key
+
+    if len(jax.devices()) <= 1:
+        return []
+    out = []
+    for regime, inputs in (("cold", materials.cold_inputs),
+                           ("steady", materials.steady_inputs)):
+        if inputs is None or isinstance(inputs, str):
+            continue
+        if len(inputs.tasks) < AUTO_BATCHED_MIN \
+                or len(inputs.device.state.names) < AUTO_SHARDED_MIN_NODES:
+            continue
+        mesh = node_mesh()
+        placed_state, placed_arrays, base = prepare_sharded(
+            mesh, inputs.device, inputs)
+        args = (placed_state, placed_arrays)
+        # pipe_enabled is a static: like the batched twin, reclaim/
+        # preempt configs can open a sharded cycle with releasing
+        # capacity on the nodes — both variants are registered surface
+        pipes = ((False, True)
+                 if ("reclaim" in materials.actions
+                     or "preempt" in materials.actions)
+                 else (base["pipe_enabled"],))
+        for pipe in pipes:
+            statics = dict(base, pipe_enabled=pipe)
+            out.append(Signature(
+                engine="sharded", entry="_sharded_entry",
+                key=signature_key("_sharded_entry", args, statics),
+                lower=lambda a=args, s=statics: _sharded_entry.lower(
+                    *a, **s),
+                run=lambda a=args, s=statics: _sharded_entry(*a, **s),
+                note=(f"{regime} T={inputs.task_valid.shape[0]} "
+                      f"mesh={mesh.devices.size} pipe={pipe}")))
+    return out

@@ -33,6 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compilesvc import instrument as _instrument
+from ..compilesvc import register_provider as _register_provider
 from .tensorize import VEC_EPS
 
 SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
@@ -137,9 +139,48 @@ def build_sharded_allocate(mesh: Mesh):
         became_ready = allocated_f >= min_available
         return decisions, node_idx, idle_f, rel_f, ntasks_f, became_ready
 
-    return jax.jit(run)
+    # accounted trace boundary (compilesvc); one jit per mesh build
+    return _instrument("sharded-visit", "sharded_allocate", jax.jit(run))
 
 
 def demo_mesh(n_devices: int) -> Mesh:
     devs = np.array(jax.devices()[:n_devices])
     return Mesh(devs, (AXIS,))
+
+
+# ---------------------------------------------------------------------
+# compilesvc signature provider — this explicit-collective scan is the
+# dryrun/multiproc REFERENCE engine (module docstring): it never runs
+# from the action layer, so its registered surface is the dryrun shape,
+# present only so `sharded.py` is enumerable like every other entry
+# ---------------------------------------------------------------------
+
+@_register_provider("kernels.sharded")
+def compile_signatures(materials):
+    from ..compilesvc.registry import Signature, signature_key
+
+    if len(jax.devices()) <= 1:
+        return []
+    mesh = demo_mesh(len(jax.devices()))
+    run = build_sharded_allocate(mesh)
+    n_dev = mesh.devices.size
+    n = n_dev * max(2, -(-8 // n_dev))
+    t = 8
+    args = (np.zeros((n, 3), np.float32), np.zeros((n, 3), np.float32),
+            np.zeros((n, 3), np.float32), np.zeros(n, np.int32),
+            np.zeros(n, np.int32), np.ones(n, bool),
+            np.zeros((t, 3), np.float32), np.zeros((t, 3), np.float32),
+            np.ones(t, bool), np.zeros((t, n), np.float32),
+            np.ones((t, n), bool),
+            jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
+    specs = [P(AXIS, None), P(AXIS, None), P(AXIS, None),
+             P(AXIS), P(AXIS), P(AXIS),
+             P(), P(), P(), P(None, AXIS), P(None, AXIS), P(), P()]
+    placed = tuple(jax.device_put(a, NamedSharding(mesh, s))
+                   for a, s in zip(args, specs))
+    return [Signature(
+        engine="sharded-visit", entry="sharded_allocate",
+        key=signature_key("sharded_allocate", placed, {}),
+        lower=lambda r=run, p=placed: r.lower(*p),
+        run=lambda r=run, p=placed: r(*p),
+        note=f"dryrun N={n} T={t} mesh={n_dev}")]

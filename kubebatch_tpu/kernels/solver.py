@@ -37,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..api import NodeInfo
+from ..compilesvc import instrument as _instrument
+from ..compilesvc import register_provider as _register_provider
 from ..metrics import (count_blocking_readback,
                        update_solver_kernel_duration,
                        update_tensorize_duration)
@@ -176,6 +178,10 @@ def _allocate_scan(idle, releasing, backfilled, allocatable_cm, nz_req,
             final.nz_req)
 
 
+# accounted trace boundary (compilesvc): per-visit allocate engine
+_allocate_scan = _instrument("visit", "_allocate_scan", _allocate_scan)
+
+
 class Decision(NamedTuple):
     kind: int
     node_name: str
@@ -232,6 +238,10 @@ def _scatter_rows(idle, releasing, backfilled, alloc_cm, nz_req, n_tasks,
             n_tasks.at[jidx].set(r_nt),
             max_task_num.at[jidx].set(r_mt),
             node_ok.at[jidx].set(r_ok))
+
+
+# accounted trace boundary (compilesvc): steady dirty-row scatter
+_scatter_rows = _instrument("scatter", "_scatter_rows", _scatter_rows)
 
 
 class DeviceSession:
@@ -397,3 +407,89 @@ class DeviceSession:
                     if kind in (ALLOC, ALLOC_OB, PIPELINE) else "")
             out.append(Decision(kind, name))
         return out, became_ready
+
+
+# ---------------------------------------------------------------------
+# compilesvc signature provider — the per-visit scan's (gang bucket x N)
+# surface and the dirty-row scatter's grow-only bucket ladder
+# ---------------------------------------------------------------------
+
+def _scatter_buckets(n_pad: int):
+    """Every k_pad the update_rows scatter can dispatch for an n_pad-row
+    session: the pow2 ladder up to min(high-water cap, node axis) — the
+    grow-only high-water walks it — plus the over-cap plain buckets up
+    to the full node axis (rare transient cluster-wide dirty sets; the
+    dirty-row count never exceeds the node count)."""
+    top = min(_SCATTER_HW_CAP, pad_to_bucket(n_pad, 8))
+    out = []
+    b = 8
+    while b <= top:
+        out.append(b)
+        b *= 2
+    while b <= pad_to_bucket(n_pad, 8):   # over-cap plain buckets
+        out.append(b)
+        b *= 2
+    return sorted(set(out))
+
+
+@_register_provider("kernels.solver")
+def compile_signatures(materials):
+    from ..compilesvc.registry import Signature, signature_key
+
+    inputs = materials.cold_inputs
+    if inputs is None or isinstance(inputs, str):
+        return []
+    device = inputs.device
+    n_pad = device.n_padded
+    out = []
+
+    # --- _allocate_scan: one signature per gang task-bucket -----------
+    dyn_enabled = bool(inputs.dyn_enabled)
+    for t_pad in materials.gang_buckets:
+        args = (device.idle, device.releasing, device.backfilled,
+                device.allocatable_cm, device.nz_req, device.max_task_num,
+                device.n_tasks, device.node_ok,
+                np.zeros((t_pad, 3), np.float32),
+                np.zeros((t_pad, 3), np.float32),
+                np.zeros((t_pad, 2), np.float32),
+                np.zeros(t_pad, bool),
+                np.zeros((t_pad, n_pad), np.float32),
+                np.ones((t_pad, n_pad), bool),
+                jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+                np.zeros(2, np.float32))
+        statics = {"dyn_enabled": dyn_enabled}
+        out.append(Signature(
+            engine="visit", entry="_allocate_scan",
+            key=signature_key("_allocate_scan", args, statics),
+            lower=lambda a=args, s=statics: _allocate_scan.lower(*a, **s),
+            run=lambda a=args, s=statics: _allocate_scan(*a, **s),
+            note=f"T={t_pad} N={n_pad} dyn={dyn_enabled}"))
+
+    # --- _scatter_rows: the high-water bucket ladder ------------------
+    st = device.state
+    for k in _scatter_buckets(n_pad):
+        def mk(k=k):
+            """Fresh donated buffers per execution (donation consumes
+            them); the numpy mirrors stay authoritative."""
+            return (jnp.asarray(st.idle), jnp.asarray(st.releasing),
+                    jnp.asarray(st.backfilled),
+                    jnp.asarray(st.allocatable[:, :2]),
+                    jnp.asarray(st.nz_requested), jnp.asarray(st.n_tasks),
+                    jnp.asarray(st.max_task_num),
+                    jnp.asarray(st.schedulable & st.valid),
+                    np.zeros(k, np.int32),
+                    np.zeros((k, 3), np.float32),
+                    np.zeros((k, 3), np.float32),
+                    np.zeros((k, 3), np.float32),
+                    np.zeros((k, 2), np.float32),
+                    np.zeros((k, 2), np.float32),
+                    np.zeros(k, np.int32), np.zeros(k, np.int32),
+                    np.zeros(k, bool))
+        key_args = mk()
+        out.append(Signature(
+            engine="scatter", entry="_scatter_rows",
+            key=signature_key("_scatter_rows", key_args, {}),
+            lower=lambda mk=mk: _scatter_rows.lower(*mk()),
+            run=lambda mk=mk: _scatter_rows(*mk()),
+            note=f"k={k} N={n_pad}"))
+    return out

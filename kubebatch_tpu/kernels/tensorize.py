@@ -115,6 +115,17 @@ def sticky_bucket(key: str, n: int, minimum: int = 8,
     small scenario after a stress test in the same process) snaps down
     immediately so big shapes never leak onto small runs.
 
+    Once the compile manager has declared the process warm
+    (compilesvc.mark_warm — AOT warm-up done, or a steady bench's
+    measured window started), the one-below decay FREEZES: stepping
+    down to the tighter bucket would trace a shape the warm set never
+    compiled — a counted recompile — to save at most 2x padding waste,
+    exactly the trade the recompiles==0 invariant forbids (the cfg2
+    steady bench caught the decay firing its compile inside the
+    measured window). The two-bucket snap-down still applies: that is a
+    genuinely different workload, and the resulting compile SHOULD
+    surface as recompiles_total{reason="unregistered"}.
+
     ``store``: optional per-stream state dict (e.g. one per
     SchedulerCache) so interleaved streams of different sizes in one
     process don't fight over a shared hold; defaults to the
@@ -127,12 +138,21 @@ def sticky_bucket(key: str, n: int, minimum: int = 8,
         return b
     if b * 2 == ent[0]:
         ent[1] += 1
-        if ent[1] >= decay:
+        if ent[1] >= decay and not _shape_hold():
             ent[0], ent[1] = b, 0
             return b
         return ent[0]
     st[key] = [b, 0]
     return b
+
+
+def _shape_hold() -> bool:
+    """True when the compile manager forbids voluntary shape changes
+    (post-warm-up). Lazy import: compilesvc.monitor imports nothing
+    heavy, but tensorize must stay importable standalone."""
+    from ..compilesvc.monitor import is_warm
+
+    return is_warm()
 
 
 # ---------------------------------------------------------------------

@@ -381,9 +381,13 @@ def _visit_core(p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
     return jnp.concatenate([head, mask.astype(jnp.int32)])
 
 
-_visit_kernel = partial(jax.jit, static_argnames=(
-    "tiers", "veto_critical", "filter_kind", "dyn_enabled", "score_nodes",
-    "room_check"))(_visit_core)
+from ..compilesvc import instrument as _cs_instrument
+from ..compilesvc import register_provider as _cs_register_provider
+
+_visit_kernel = _cs_instrument("victims", "_visit_kernel", partial(
+    jax.jit, static_argnames=(
+        "tiers", "veto_critical", "filter_kind", "dyn_enabled",
+        "score_nodes", "room_check"))(_visit_core))
 
 
 @partial(jax.jit, static_argnames=("tiers", "veto_critical", "filter_kind",
@@ -419,24 +423,47 @@ def _wave_kernel(p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
     return jnp.concatenate([pick, guard, victims], axis=1)
 
 
+_wave_kernel = _cs_instrument("victims", "_wave_kernel", _wave_kernel)
+
+
+def _shared_args(static, mut):
+    """The interleaved shared-arg tail of both kernels — the ONE place
+    the order is written down, shared by the local dispatches, the rpc
+    sidecar's server-side execution (rpc/victims_wire.py), and the
+    compilesvc signature provider."""
+    return (static[0], mut[0], static[1], mut[1],
+            static[2], static[3],
+            static[4], static[5], static[6], static[7],
+            mut[2],
+            static[8], static[9], static[10], static[11],
+            mut[3], static[12], mut[4], static[13],
+            mut[5], static[14], static[15], static[16], static[17])
+
+
+def wave_kernel_args(static, mut, sig, p_res, p_resreq, p_nz, p_sig,
+                     p_job, p_queue):
+    """The wave kernel's full positional tuple."""
+    sig_scores, sig_pred = sig
+    return (p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
+            p_job, p_queue) + _shared_args(static, mut)
+
+
+def visit_kernel_args(static, mut, sig, p_res, p_resreq, p_nz, p_sig,
+                      p_job, p_queue, visited):
+    """The single-lane visit kernel's full positional tuple."""
+    sig_scores, sig_pred = sig
+    return (p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
+            p_job, p_queue, visited) + _shared_args(static, mut)
+
+
 def run_wave_kernel(static, mut, sig, p_res, p_resreq, p_nz, p_sig,
                     p_job, p_queue, *, tiers, veto_critical, filter_kind,
                     dyn_enabled, score_nodes, room_check):
     """Invoke the wave kernel from the (static, mutable, sig) tuples of
-    VictimSolver._upload — the ONE place the interleaved shared-arg order
-    is written down, shared by the local dispatch and the rpc sidecar's
-    server-side execution (rpc/victims_wire.py)."""
-    sig_scores, sig_pred = sig
+    VictimSolver._upload."""
     return _wave_kernel(
-        p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
-        p_job, p_queue,
-        static[0], mut[0], static[1], mut[1],
-        static[2], static[3],
-        static[4], static[5], static[6], static[7],
-        mut[2],
-        static[8], static[9], static[10], static[11],
-        mut[3], static[12], mut[4], static[13],
-        mut[5], static[14], static[15], static[16], static[17],
+        *wave_kernel_args(static, mut, sig, p_res, p_resreq, p_nz, p_sig,
+                          p_job, p_queue),
         tiers=tiers, veto_critical=veto_critical,
         filter_kind=filter_kind, dyn_enabled=dyn_enabled,
         score_nodes=score_nodes, room_check=room_check)
@@ -446,17 +473,9 @@ def run_visit_kernel(static, mut, sig, p_res, p_resreq, p_nz, p_sig,
                      p_job, p_queue, visited, *, tiers, veto_critical,
                      filter_kind, dyn_enabled, score_nodes, room_check):
     """Single-lane twin of run_wave_kernel (kernels' _visit_kernel)."""
-    sig_scores, sig_pred = sig
     return _visit_kernel(
-        p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
-        p_job, p_queue, visited,
-        static[0], mut[0], static[1], mut[1],
-        static[2], static[3],
-        static[4], static[5], static[6], static[7],
-        mut[2],
-        static[8], static[9], static[10], static[11],
-        mut[3], static[12], mut[4], static[13],
-        mut[5], static[14], static[15], static[16], static[17],
+        *visit_kernel_args(static, mut, sig, p_res, p_resreq, p_nz, p_sig,
+                           p_job, p_queue, visited),
         tiers=tiers, veto_critical=veto_critical,
         filter_kind=filter_kind, dyn_enabled=dyn_enabled,
         score_nodes=score_nodes, room_check=room_check)
@@ -1780,3 +1799,73 @@ def build_victim_solver(ssn, pending: Sequence[TaskInfo],
         attach_remote(solver, os.environ.get("KUBEBATCH_SOLVER_ADDR",
                                              "127.0.0.1:50061"))
     return solver
+
+
+# ---------------------------------------------------------------------
+# compilesvc signature provider — the preempt/reclaim analysis kernels
+# at the steady regime's canonical lane buckets (victim rows only exist
+# once the cluster carries RUNNING tasks, so these register from the
+# profile's steady materials)
+# ---------------------------------------------------------------------
+
+def _wave_buckets(solver) -> List[int]:
+    """The lane (p_pad) buckets the wave dispatcher produces: single-lane
+    refresh, small prefetch pow2s, the full block, and the tail block's
+    pow2 for the solver's pending count."""
+    w = solver._wave_size
+    tail = len(solver.pending) % w or w
+    return sorted({1, 2, 4, w, pad_to_bucket(tail, 8)})
+
+
+def _solver_signatures(solver, filter_kind: str) -> list:
+    from ..compilesvc.registry import Signature, signature_key
+
+    static = solver.host_static_arrays()
+    mut = solver.host_mutable_arrays()
+    sig = solver.host_sig_arrays()
+    n_pad = solver.state.n_pad
+    statics = dict(tiers=solver.tiers, veto_critical=solver.veto_critical,
+                   filter_kind=filter_kind,
+                   dyn_enabled=bool(solver.dyn is not None
+                                    and solver.dyn.enabled),
+                   score_nodes=solver.score_nodes,
+                   room_check=solver.room_check)
+    out = []
+    for p_pad in _wave_buckets(solver):
+        lanes = (np.zeros((p_pad, RESOURCE_DIM), np.float32),
+                 np.zeros((p_pad, RESOURCE_DIM), np.float32),
+                 np.zeros((p_pad, 2), np.float32),
+                 np.zeros(p_pad, np.int32),
+                 np.full(p_pad, -1, np.int32),
+                 np.full(p_pad, -1, np.int32))
+        args = wave_kernel_args(static, mut, sig, *lanes)
+        out.append(Signature(
+            engine="victims", entry="_wave_kernel",
+            key=signature_key("_wave_kernel", args, statics),
+            lower=lambda a=args, s=statics: _wave_kernel.lower(*a, **s),
+            run=lambda a=args, s=statics: _wave_kernel(*a, **s),
+            note=f"{filter_kind} wave W={p_pad} N={n_pad}"))
+    vargs = visit_kernel_args(
+        static, mut, sig,
+        np.zeros(RESOURCE_DIM, np.float32),
+        np.zeros(RESOURCE_DIM, np.float32),
+        np.zeros(2, np.float32), np.int32(0), np.int32(0), np.int32(-1),
+        np.zeros(n_pad, bool))
+    out.append(Signature(
+        engine="victims", entry="_visit_kernel",
+        key=signature_key("_visit_kernel", vargs, statics),
+        lower=lambda a=vargs, s=statics: _visit_kernel.lower(*a, **s),
+        run=lambda a=vargs, s=statics: _visit_kernel(*a, **s),
+        note=f"{filter_kind} visit N={n_pad}"))
+    return out
+
+
+@_cs_register_provider("kernels.victims")
+def compile_signatures(materials):
+    out = []
+    for kind, solver in (("reclaim", materials.reclaim_solver),
+                         ("preempt", materials.preempt_solver)):
+        if solver is None:
+            continue
+        out.extend(_solver_signatures(solver, kind))
+    return out

@@ -98,6 +98,17 @@ if _PROM:
         "degradation_level",
         "Current engine degradation-ladder level (0=full device engine, "
         "1=batched, 2=fused, 3=host)", namespace=NAMESPACE)
+    compile_milliseconds = Counter(
+        "compile_milliseconds_total",
+        "XLA backend-compile wall (persistent-cache retrieval wall "
+        "included), milliseconds",
+        namespace=NAMESPACE)
+    recompile_counter = Counter(
+        "recompiles_total",
+        "Trace-boundary crossings after compilesvc warm-up that paid a "
+        "real XLA compile (not a persistent-cache retrieval); pinned to "
+        "zero by the steady benches",
+        ["engine", "reason"], namespace=NAMESPACE)
 
 
 def update_plugin_duration(plugin: str, phase: str, seconds: float) -> None:
@@ -286,6 +297,64 @@ def set_degradation_level(level: int) -> None:
 def degradation_level() -> int:
     """Current engine degradation-ladder level (0 = full engine)."""
     return _degradation_level
+
+
+# ---------------------------------------------------------------------------
+# compile accounting (ISSUE 6: compilesvc — AOT warm-up + recompile pinning)
+# ---------------------------------------------------------------------------
+# Same discipline as the robustness counters: process-lifetime values
+# consumers diff across a window. compile_ms_total accumulates from a
+# jax.monitoring listener (compilesvc/monitor.py installs it), so it is
+# hit from whatever thread compiles — grpc handler pools included — and
+# needs the lock. recompiles_total counts trace-boundary crossings AFTER
+# compilesvc.mark_warm() that paid a real XLA compile (persistent-cache
+# retrievals are warm by definition); reason "unregistered" = the
+# signature is outside the registered bucket set, "warm-miss" = a known
+# signature compiled anyway (cache off, evicted, or salt changed). The
+# steady benches pin the post-warm-up total to zero.
+
+_compile_ms = 0.0
+_recompiles: dict = {}
+
+
+def add_compile_ms(ms: float) -> None:
+    """Accumulate compile-path wall time (called by the compilesvc
+    monitoring listener on every jax compile event)."""
+    global _compile_ms
+    with _robust_lock:
+        _compile_ms += ms
+    if _PROM:
+        compile_milliseconds.inc(ms)
+
+
+def compile_ms_total() -> float:
+    """Process-lifetime XLA backend-compile wall in ms (disjoint per
+    compiled program, so the sum is true wall); consumers diff across a
+    window."""
+    with _robust_lock:
+        return _compile_ms
+
+
+def count_recompile(engine: str, reason: str) -> None:
+    """Record one post-warm-up trace-boundary compile (compilesvc only)."""
+    with _robust_lock:
+        key = (engine, reason)
+        _recompiles[key] = _recompiles.get(key, 0) + 1
+    if _PROM:
+        recompile_counter.labels(engine, reason).inc()
+
+
+def recompiles_total() -> int:
+    """Process-lifetime post-warm-up recompile count; consumers diff
+    across a window. Zero after warm-up is the compilesvc invariant."""
+    with _robust_lock:
+        return sum(_recompiles.values())
+
+
+def recompiles_by_reason() -> dict:
+    """{(engine, reason): count} (a copy)."""
+    with _robust_lock:
+        return dict(_recompiles)
 
 
 _solver_kernel_seconds = 0.0

@@ -78,6 +78,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-cycle wall budget in seconds; overruns count "
                         "as cycle failures and demote the engine ladder "
                         "(also via KUBEBATCH_CYCLE_DEADLINE)")
+    # compile manager (docs/COMPILE.md)
+    p.add_argument("--warmup", nargs="?", const="auto", default="",
+                   metavar="CONFIG",
+                   help="compile the registered shape-bucket set before "
+                        "the first cycle (compilesvc AOT warm-up) and arm "
+                        "the recompiles_total==0 invariant; CONFIG is a "
+                        "BASELINE key (1-5, 2p/3p/5p; default: the "
+                        "--sim-config). Warmed executables persist via "
+                        "the managed compile cache and survive restarts.")
     return p
 
 
@@ -112,7 +121,45 @@ def main(argv=None) -> int:
     # accelerator wedge watchdog: a hung transport must degrade the daemon
     # to host scheduling, not hang its first kernel dispatch forever
     from .watchdog import ensure_responsive_backend
-    ensure_responsive_backend()
+    if ensure_responsive_backend() == "cpu-fallback":
+        # platform flipped: re-salt the managed compile cache onto the
+        # cpu directory (compilesvc/cache.py cache_salt) so fallback
+        # executables never mix into the accelerator's entries
+        enable_persistent_compile_cache()
+
+    if args.warmup:
+        # AOT warm-up over the registered bucket set BEFORE the loop: the
+        # daemon's first cycle must not eat the compile wall, and from
+        # here on an unexpected recompile is counted (and attributed as
+        # a cycle-overrun cause by the scheduler's ladder)
+        from .. import compilesvc
+        from ..conf import CONFIG_ACTIONS
+
+        cfg = args.warmup
+        if cfg == "auto":
+            cfg = str(args.sim_config or 2)
+        cfg = int(cfg) if cfg.isdigit() else cfg
+        if cfg not in CONFIG_ACTIONS:
+            # an operator typo must fail loudly at startup, not start an
+            # un-warmed daemon that then eats the compile wall mid-cycle
+            print(f"--warmup: unknown BASELINE config {cfg!r} "
+                  f"(choose from {sorted(map(str, CONFIG_ACTIONS))})",
+                  file=sys.stderr)
+            return 2
+        try:
+            report = compilesvc.warmup(cfg)
+        except Exception as e:  # materials/profile failure: degrade —
+            # an un-warmed daemon still schedules (recompiles are
+            # counted + attributed); losing the warm start must not
+            # lose the scheduler
+            print(f"compilesvc warm-up failed ({type(e).__name__}: {e}); "
+                  f"starting un-warmed", file=sys.stderr)
+        else:
+            print(f"compilesvc warm-up: {report.summary()}",
+                  file=sys.stderr)
+            for key, err in report.failed:
+                print(f"compilesvc warm-up FAILED {key[:100]}: {err}",
+                      file=sys.stderr)
 
     from ..cache import SchedulerCache
     from ..sim import baseline_cluster

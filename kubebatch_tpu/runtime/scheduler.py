@@ -137,12 +137,20 @@ class Scheduler:
         """One GUARDED cycle: never raises. A raising cycle is logged
         structurally and counted (cycle_failures_total{reason=exception});
         a cycle that completes but blows the deadline budget counts as
-        {reason=deadline}. Both feed the degradation ladder; a healthy
-        cycle feeds its recovery side. Returns True iff healthy;
-        ``last_cycle_failure`` then carries None, "exception" or
-        "deadline" for callers that must tell a broken cycle from a
-        merely slow one (the CLI's finite-cycle exit code)."""
+        {reason=deadline} — or {reason=recompile} when the compile
+        manager observed a post-warm-up recompile during the cycle (an
+        unexpected mid-run XLA compile is an explained overrun cause,
+        not a silent stall; ISSUE 6 enforcement). All feed the
+        degradation ladder; a healthy cycle feeds its recovery side.
+        Returns True iff healthy; ``last_cycle_failure`` then carries
+        None, "exception", "deadline" or "recompile" for callers that
+        must tell a broken cycle from a merely slow one (the CLI's
+        finite-cycle exit code treats everything but "exception" as
+        slow-but-working)."""
+        from ..metrics import recompiles_total
+
         self.last_cycle_failure = None
+        recompiles0 = recompiles_total()
         start = time.perf_counter()
         try:
             self.run_once()
@@ -157,14 +165,25 @@ class Scheduler:
             self.ladder.record_failure()
             return False
         elapsed = time.perf_counter() - start
+        recompiled = recompiles_total() - recompiles0
         if self.cycle_deadline is not None and elapsed > self.cycle_deadline:
+            reason = "recompile" if recompiled else "deadline"
             log.warning("scheduling cycle took %.3fs, over the %.3fs "
-                        "deadline budget (ladder level %d)",
-                        elapsed, self.cycle_deadline, self.ladder.level)
-            count_cycle_failure("deadline")
-            self.last_cycle_failure = "deadline"
+                        "deadline budget (%s; ladder level %d)",
+                        elapsed, self.cycle_deadline,
+                        f"{recompiled} mid-run recompiles" if recompiled
+                        else "no recompile observed", self.ladder.level)
+            count_cycle_failure(reason)
+            self.last_cycle_failure = reason
             self.ladder.record_failure()
             return False
+        if recompiled:
+            # inside budget but still unexpected: surface it — the next
+            # occurrence of this shape is warm, but the registry (or the
+            # warm-up config) missed it
+            log.warning("scheduling cycle performed %d post-warm-up "
+                        "recompile(s) (recompiles_total; see "
+                        "docs/COMPILE.md)", recompiled)
         self.ladder.record_success()
         return True
 

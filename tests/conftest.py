@@ -42,17 +42,24 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True, scope="module")
 def _bounded_jax_native_state():
-    """Clear jax's executable caches between test MODULES.
+    """Scoped compile-state reset between test MODULES, owned by the
+    compile manager (kubebatch_tpu.compilesvc.reset).
 
-    After ~290 tests' worth of compiled programs in one process, the
-    FIRST large compile issued from a secondary thread (the rpc
-    sidecar's handler pool) segfaulted inside XLA's CPU backend —
-    reproducibly at the same test in three full-suite runs, while the
-    same tests pass standalone and in any short slice. Process-
-    cumulative native compiler state is the trigger; per-module cache
-    clearing bounds it (modules rarely share jit signatures, so the
-    recompile cost is small)."""
+    Why a blanket per-module clear is needed at all: after ~290 tests'
+    worth of compiled programs in one process, the FIRST large compile
+    issued from a secondary thread (the rpc sidecar's handler pool)
+    segfaulted inside XLA's CPU backend — reproducibly at the same test
+    in three full-suite runs, while the same tests pass standalone and
+    in any short slice. Process-CUMULATIVE native compiler state is the
+    trigger; bounding it per module keeps the suite under the threshold
+    (modules rarely share jit signatures, so the recompile cost is
+    small). The bare ``jax.clear_caches()`` this fixture used to call
+    was only half the reset: compilesvc.reset() also drops the warm
+    mark + known-signature set (one module's warm-up must not classify
+    another module's compiles as recompiles) and the sticky
+    shape-bucket holds (a stress module's pow2 hold must not leak onto
+    a small module's shapes)."""
     yield
-    import jax
+    from kubebatch_tpu import compilesvc
 
-    jax.clear_caches()
+    compilesvc.reset()

@@ -8,14 +8,17 @@ import bench
 
 
 def test_run_steady_small_config():
-    latencies, bound, action_ms, readbacks, rss_mb, engines = bench.run_steady(
-        2, 2, "auto", 16)
+    (latencies, bound, action_ms, readbacks, rss_mb, engines,
+     recompiles) = bench.run_steady(2, 2, "auto", 16)
     assert engines and all(e for e in engines)
     assert len(latencies) == 2
     assert bound == 32          # 16 churn pods per measured cycle
     assert all(dt > 0 for dt in latencies)
     assert "allocate" in action_ms and action_ms["allocate"] >= 0
     assert rss_mb > 0           # soak evidence: peak RSS is reported
+    # the in-run warm-up cycles must leave the measured window compile-
+    # free — the recompiles==0 invariant the steady evidence lines pin
+    assert recompiles == 0
 
 
 def test_bench_main_one_json_line(capsys):
@@ -46,14 +49,16 @@ def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
         bench, "run_config",
         lambda *a: ([0.1, 0.1], 200, 0.2, 0, {}, ["batched"], [1, 1],
                     [0.01, 0.01], {"tensorize": 1.0, "replay": 2.0,
-                                   "close": 0.5}))
+                                   "close": 0.5},
+                    {"cold_wall_ms": 500.0, "cold_compile_ms": 400.0,
+                     "cold_host_ms": 80.0}))
     steady_ran = {}
 
     def fake_steady(*a):
         # the primary line must already be visible at this point
         steady_ran["primary_first"] = capsys.readouterr().out.strip()
         return ([0.05] * 5, 1280, {"allocate": 40.0}, [1, 1, 1, 1, 1],
-                100.0, ["batched"])
+                100.0, ["batched"], 0)
 
     monkeypatch.setattr(bench, "run_steady", fake_steady)
     rc = bench.main(["--config", "5", "--cycles", "2"])
@@ -61,6 +66,11 @@ def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
     first = json.loads(steady_ran["primary_first"].splitlines()[-1])
     assert first["metric"] == "sched_cycle_p50_ms_cfg5"
     assert "steady_p50_ms" not in first
+    # the cold split rides every cold line (cold_compile_ms no longer
+    # hides inside the host share) next to the compile-manager counters
+    assert first["cold_compile_ms"] == 400.0
+    assert first["cold_host_ms"] == 80.0
+    assert "compile_ms_total" in first and "recompiles_total" in first
     last = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert last["steady_p50_ms"] == 50.0
     assert last["backend"] == "cpu-fallback"

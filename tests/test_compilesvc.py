@@ -1,0 +1,296 @@
+"""Compile manager (ISSUE 6): the shape-bucket registry is enumerable
+and process-stable, sticky buckets hold through boundary flip-flop, AOT
+warm-up pins live-cycle recompiles to zero, and an out-of-registry shape
+surfaces as recompiles_total{reason="unregistered"} instead of being
+silently absorbed."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kubebatch_tpu import compilesvc, metrics
+from kubebatch_tpu.kernels.tensorize import pad_to_bucket, sticky_bucket
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------
+# sticky_bucket hysteresis (satellite: boundary flip-flop must not
+# alternate compile shapes)
+# ---------------------------------------------------------------------
+
+def test_sticky_bucket_holds_larger_bucket_through_flip_flop():
+    """A churn regime oscillating across a pow2 boundary (255 <-> 257
+    around 256) must keep ONE shape — the larger bucket — for the whole
+    oscillation, not alternate 256/512 (each flip would be a fresh XLA
+    compile, the 1 s p95 tail the steady benches showed)."""
+    store: dict = {}
+    assert sticky_bucket("t", 257, 8, store=store) == 512
+    seen = set()
+    for i in range(30):
+        n = 255 if i % 2 == 0 else 257
+        seen.add(sticky_bucket("t", n, 8, store=store))
+    assert seen == {512}, f"bucket flip-flopped: {sorted(seen)}"
+
+
+def test_sticky_bucket_decays_after_sustained_one_below():
+    store: dict = {}
+    assert sticky_bucket("t", 300, 8, store=store) == 512
+    # sustained one-below (not oscillating) steps down after `decay`
+    held = [sticky_bucket("t", 200, 8, store=store) for _ in range(12)]
+    assert held[0] == 512 and held[-1] == 256
+
+
+def test_sticky_bucket_snaps_down_two_buckets():
+    """A genuinely different workload (two or more buckets smaller) must
+    snap down immediately — big stress shapes must not leak onto small
+    runs in the same process."""
+    store: dict = {}
+    assert sticky_bucket("t", 1000, 8, store=store) == 1024
+    assert sticky_bucket("t", 60, 8, store=store) == 64
+
+
+def test_sticky_bucket_decay_freezes_once_warm():
+    """Post-warm-up, the one-below decay must NOT step down: the tighter
+    bucket is a never-traced shape, and stepping onto it mid-soak is a
+    counted recompile (this exact case fired in the cfg2 steady bench's
+    measured window before the freeze)."""
+    store: dict = {}
+    assert sticky_bucket("t", 300, 8, store=store) == 512
+    compilesvc.mark_warm()
+    held = {sticky_bucket("t", 200, 8, store=store) for _ in range(20)}
+    assert held == {512}, f"decay stepped down while warm: {sorted(held)}"
+    # the two-bucket snap-down still applies while warm
+    assert sticky_bucket("t", 60, 8, store=store) == 64
+
+
+# ---------------------------------------------------------------------
+# registry: enumerable, unique, diffable, covering the engines
+# ---------------------------------------------------------------------
+
+def test_registry_enumerates_cold_surface():
+    sigs = compilesvc.enumerate_signatures(2, steady=False)
+    assert sigs, "cfg2 cold surface must not be empty"
+    keys = [s.key for s in sigs]
+    assert len(keys) == len(set(keys)), "signature keys must be unique"
+    engines = {s.engine for s in sigs}
+    # cfg2 cold: 800 pending -> batched engine; per-visit scan + the
+    # scatter ladder always register
+    assert {"batched", "visit", "scatter"} <= engines
+    # the scatter ladder never exceeds the node axis (k <= N)
+    n_pad = pad_to_bucket(50, 8)
+    for s in sigs:
+        if s.engine == "scatter":
+            assert f"N={n_pad}" in s.note
+
+
+def test_registry_diff_between_configs():
+    a = compilesvc.enumerate_signatures(1, steady=False)
+    b = compilesvc.enumerate_signatures(2, steady=False)
+    only_a, only_b = compilesvc.diff_signatures(a, b)
+    # cfg1 (1 node, 3 pods) is fused-shaped; cfg2 is batched-shaped —
+    # the surfaces must differ in both directions
+    assert only_a and only_b
+    assert any(s.engine == "fused" for s in only_a)
+    assert any(s.engine == "batched" for s in only_b)
+
+
+def test_signature_key_is_shape_and_static_sensitive():
+    k1 = compilesvc.signature_key(
+        "e", (np.zeros((8, 3), np.float32),), {"flag": True})
+    k2 = compilesvc.signature_key(
+        "e", (np.zeros((8, 3), np.float32),), {"flag": True})
+    k3 = compilesvc.signature_key(
+        "e", (np.zeros((16, 3), np.float32),), {"flag": True})
+    k4 = compilesvc.signature_key(
+        "e", (np.zeros((8, 3), np.float32),), {"flag": False})
+    assert k1 == k2
+    assert len({k1, k3, k4}) == 3
+
+
+def test_registry_signatures_stable_across_fresh_processes():
+    """Satellite: the registered signature set for a fixed config must
+    be bit-identical across two fresh processes (seeded sim + pow2
+    buckets + shipped statics — nothing process-local may leak into a
+    key)."""
+    def run():
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "precompile.py"),
+             "--config", "1", "--list", "--cold"],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+            env={**os.environ, "KUBEBATCH_COMPILE_CACHE": "0"})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return proc.stdout.strip().splitlines()
+
+    first, second = run(), run()
+    assert first == second
+    assert len(first) > 1        # keys + trailing JSON summary
+
+
+# ---------------------------------------------------------------------
+# warm-up + the recompiles==0 invariant (acceptance: dedicated pin)
+# ---------------------------------------------------------------------
+
+def _one_cycle(cache, tiers):
+    from kubebatch_tpu.actions.allocate import AllocateAction
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+
+    ssn = OpenSession(cache, tiers)
+    AllocateAction(mode="auto").execute(ssn)
+    CloseSession(ssn)
+
+
+def _fresh_cfg(config):
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.sim import baseline_cluster
+
+    class _B:
+        def bind(self, pod, hostname):
+            pod.node_name = hostname
+
+        def evict(self, pod):
+            pod.deletion_timestamp = 1.0
+
+    sim = baseline_cluster(config)
+    cache = SchedulerCache(binder=_B(), evictor=_B(),
+                           async_writeback=False)
+    sim.populate(cache)
+    return sim, cache
+
+
+def test_warmup_pins_live_cycles_to_zero_recompiles():
+    """The dedicated recompiles==0 pin: compilesvc.warmup over the
+    registered cfg1 bucket set, then live scheduling cycles on a FRESH
+    cluster of the same config perform zero post-warm-up recompiles."""
+    from kubebatch_tpu.conf import shipped_tiers
+
+    report = compilesvc.warmup(1, persistent_cache=False)
+    assert not report.failed, report.failed[:3]
+    assert report.signatures > 0
+    assert compilesvc.is_warm()
+
+    sim, cache = _fresh_cfg(1)
+    tiers = shipped_tiers()
+    r0 = metrics.recompiles_total()
+    for _ in range(3):
+        _one_cycle(cache, tiers)
+    assert metrics.recompiles_total() - r0 == 0, \
+        metrics.recompiles_by_reason()
+
+
+def test_unregistered_shape_is_counted_not_absorbed():
+    """Acceptance: a mid-run shape outside the registry increments
+    recompiles_total{reason="unregistered"} at the trace boundary."""
+    import jax.numpy as jnp
+
+    from kubebatch_tpu.kernels.solver import _allocate_scan
+
+    compilesvc.mark_warm()          # idempotent if already warm
+    n, t = 8, 2                     # t=2 is outside any registered bucket
+    r0 = metrics.recompiles_by_reason()
+    _allocate_scan(
+        np.zeros((n, 3), np.float32), np.zeros((n, 3), np.float32),
+        np.zeros((n, 3), np.float32), np.zeros((n, 2), np.float32),
+        np.zeros((n, 2), np.float32), np.zeros(n, np.int32),
+        np.zeros(n, np.int32), np.ones(n, bool),
+        np.zeros((t, 3), np.float32), np.zeros((t, 3), np.float32),
+        np.zeros((t, 2), np.float32), np.zeros(t, bool),
+        np.zeros((t, n), np.float32), np.ones((t, n), bool),
+        jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32),
+        np.zeros(2, np.float32), dyn_enabled=False)
+    delta = metrics.recompiles_by_reason()
+    key = ("visit", "unregistered")
+    assert delta.get(key, 0) == r0.get(key, 0) + 1
+    # ... and the SAME shape again is warm: no second count
+    _allocate_scan(
+        np.zeros((n, 3), np.float32), np.zeros((n, 3), np.float32),
+        np.zeros((n, 3), np.float32), np.zeros((n, 2), np.float32),
+        np.zeros((n, 2), np.float32), np.zeros(n, np.int32),
+        np.zeros(n, np.int32), np.ones(n, bool),
+        np.zeros((t, 3), np.float32), np.zeros((t, 3), np.float32),
+        np.zeros((t, 2), np.float32), np.zeros(t, bool),
+        np.zeros((t, n), np.float32), np.ones((t, n), bool),
+        jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32),
+        np.zeros(2, np.float32), dyn_enabled=False)
+    assert metrics.recompiles_by_reason().get(key, 0) \
+        == r0.get(key, 0) + 1
+
+
+def test_compile_ms_total_accumulates():
+    """Every compile lands in compile_ms_total, boundary or not."""
+    import jax
+    import jax.numpy as jnp
+
+    c0 = metrics.compile_ms_total()
+    compilesvc.install()
+    jax.jit(lambda x: x * 3 + 1)(jnp.ones(17))   # novel tiny program
+    assert metrics.compile_ms_total() > c0
+
+
+def test_scheduler_attributes_overrun_to_recompile():
+    """Ladder wiring: a deadline overrun WITH a mid-cycle post-warm-up
+    recompile is attributed {reason="recompile"}; without one it stays
+    {reason="deadline"} — an unexpected compile is an explained overrun
+    cause, not a silent stall."""
+    from kubebatch_tpu import faults
+    from kubebatch_tpu.runtime.scheduler import Scheduler
+
+    sim, cache = _fresh_cfg(1)
+    ladder_state = dict(faults.LADDER.__dict__)
+    try:
+        compilesvc.reset()          # cold caches: the cycle WILL compile
+        compilesvc.mark_warm()      # ... and every compile now counts
+        sched = Scheduler(cache, schedule_period=0.01,
+                          cycle_deadline=0.0)
+        assert sched.run_cycle() is False
+        assert sched.last_cycle_failure == "recompile"
+        # second cycle: warm now, still over the 0-second budget
+        assert sched.run_cycle() is False
+        assert sched.last_cycle_failure == "deadline"
+    finally:
+        faults.LADDER.__dict__.update(ladder_state)
+        from kubebatch_tpu.metrics import set_degradation_level
+        set_degradation_level(0)
+
+
+@pytest.mark.slow
+def test_warmup_cfg2_full_then_steady_cycles_zero_recompiles():
+    """The bigger pin (cfg2, full cold+steady warm-up, canonical churn):
+    5 steady cycles after warmup() trace nothing new."""
+    from kubebatch_tpu.compilesvc.profile import STEADY_CHURN
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.objects import PodPhase
+
+    compilesvc.reset()
+    report = compilesvc.warmup(2, persistent_cache=False)
+    assert not report.failed, report.failed[:3]
+
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.sim import baseline_cluster
+
+    fresh = []
+
+    class _B:
+        def bind(self, pod, hostname):
+            pod.node_name = hostname
+            fresh.append(pod)
+
+    sim = baseline_cluster(2)
+    cache = SchedulerCache(binder=_B(), async_writeback=False)
+    sim.populate(cache)
+    tiers = shipped_tiers()
+    r0 = metrics.recompiles_total()
+    for _ in range(5):
+        for pod in fresh:
+            if pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                cache.update_pod(pod, pod)
+        fresh.clear()
+        sim.churn_tick(cache, STEADY_CHURN)
+        _one_cycle(cache, tiers)
+    assert metrics.recompiles_total() - r0 == 0, \
+        metrics.recompiles_by_reason()

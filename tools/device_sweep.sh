@@ -14,6 +14,17 @@
 cd /root/repo || exit 1
 B="timeout -k 15"
 
+# Offline compile warm-up FIRST (tools/precompile.py): populate the
+# persistent compile cache for the sweep's configs so the bench
+# wall-times below measure scheduling, not XLA compilation (the cfg5p
+# KB_BIG_SMOKE run spent 536 s dominated by compile). Each bench line
+# still reports compile_ms_total/recompiles_total, so any residual
+# compile cost is visible, not silently folded into wall time.
+$B 2400 python tools/precompile.py --config 5
+$B 2400 python tools/precompile.py --config 5p
+$B 1200 python tools/precompile.py --config 3p
+$B 1200 python tools/precompile.py --config 4
+
 $B 1800 python bench.py --config 5                      # cold + steady extra
 $B 1800 python bench.py --config 5p                     # predicate-rich stress
 $B 1200 python bench.py --config 3p                     # MXU-claim mid-scale

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Offline compile warmer — populate the persistent compile cache for a
+config's registered shape-bucket set before any scheduler or bench
+process runs (docs/COMPILE.md).
+
+    python tools/precompile.py --config 5          # warm cfg5 (execute)
+    python tools/precompile.py --config 5 --aot    # lower().compile() only
+    python tools/precompile.py --config 2 --list   # print the registry
+
+Run by tools/device_sweep.sh before the bench lines so sweep wall-times
+measure scheduling, not compilation (the one recorded cfg5p device run
+spent 536 s dominated by XLA compile).
+
+Output contract: the LAST stdout line is one JSON object; ``--list``
+prints the signature keys instead (stable across fresh processes for a
+fixed config — pinned by tests/test_compilesvc.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", default="5",
+                    choices=["1", "2", "3", "4", "5", "2p", "3p", "5p"])
+    ap.add_argument("--list", action="store_true",
+                    help="print the registered signature keys (no "
+                         "compilation)")
+    ap.add_argument("--cold", action="store_true",
+                    help="cold-cycle surface only (skip the steady "
+                         "advance, which executes one scheduling round)")
+    ap.add_argument("--aot", action="store_true",
+                    help="pure jax.jit(...).lower().compile() — no "
+                         "execution; the product is the persistent-cache "
+                         "entries a later process retrieves")
+    args = ap.parse_args(argv)
+    config = int(args.config) if args.config.isdigit() else args.config
+
+    from kubebatch_tpu import compilesvc
+
+    if args.list:
+        sigs = compilesvc.enumerate_signatures(config,
+                                               steady=not args.cold)
+        for s in sigs:
+            print(s.key)
+        print(json.dumps({"config": args.config, "signatures": len(sigs),
+                          "engines": sorted({s.engine for s in sigs})}))
+        return 0
+
+    report = compilesvc.warmup(config, execute=not args.aot,
+                               steady=not args.cold)
+    print(report.summary(), file=sys.stderr)
+    print(json.dumps({
+        "config": args.config,
+        "mode": report.mode,
+        "signatures": report.signatures,
+        "compiled": report.compiled,
+        "skipped": report.skipped,
+        "failed": len(report.failed),
+        "compile_ms": round(report.compile_ms, 1),
+        "wall_ms": round(report.wall_ms, 1),
+        "cache_dir": report.cache_dir,
+    }))
+    return 1 if report.failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
