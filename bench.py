@@ -381,6 +381,12 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
         action_seconds = {name: 0.0 for name in CONFIG_ACTIONS[config]}
         readbacks = []
         engines = []   # one entry per measured cycle
+        # span-tree evidence (ISSUE 7): each measured cycle runs under an
+        # obs cycle root, so the line can report spans_per_cycle and the
+        # calibrated cost of always-on tracing next to the wall numbers
+        from kubebatch_tpu import obs
+        span_counts = []
+        trace_roots = []
         for cycle in range(cycles):
             before = len(binds)
             kubelet_tick()
@@ -388,15 +394,16 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
             gc.collect()
             rb0 = blocking_readbacks()
             t0 = time.perf_counter()
-            ssn = OpenSession(cache, tiers)
-            t1 = time.perf_counter()
-            act_times = []
-            for name, act in acts:
-                a0 = time.perf_counter()
-                act.execute(ssn)
-                act_times.append((name, time.perf_counter() - a0))
-            t2 = time.perf_counter()
-            CloseSession(ssn)
+            with obs.cycle(cycle) as root:
+                ssn = OpenSession(cache, tiers)
+                t1 = time.perf_counter()
+                act_times = []
+                for name, act in acts:
+                    a0 = time.perf_counter()
+                    act.execute(ssn)
+                    act_times.append((name, time.perf_counter() - a0))
+                t2 = time.perf_counter()
+                CloseSession(ssn)
             dt = time.perf_counter() - t0
             if os.environ.get("KB_BENCH_DEBUG"):
                 per = " ".join(f"{n}={s:.3f}s" for n, s in act_times)
@@ -409,6 +416,8 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
                 action_seconds[name] += secs
             readbacks.append(blocking_readbacks() - rb0)
             engines.append(_alloc_mod.last_cycle_engine)
+            span_counts.append(root.count())
+            trace_roots.append(root)
         recompiles = recompiles_total() - recompiles0
     finally:
         gc.enable()
@@ -416,7 +425,8 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
                  for name, secs in action_seconds.items()}
     # peak RSS in MiB (ru_maxrss is KiB on Linux) — the soak evidence
     rss_mb = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024.0
-    return latencies, bound, action_ms, readbacks, rss_mb, engines, recompiles
+    return (latencies, bound, action_ms, readbacks, rss_mb, engines,
+            recompiles, span_counts, trace_roots)
 
 
 def main(argv=None):
@@ -468,6 +478,11 @@ def main(argv=None):
                          "p50. Exit 1 on any invariant violation.")
     ap.add_argument("--chaos-seed", type=int, default=7,
                     help="seed for the chaos fault schedule")
+    ap.add_argument("--trace-export", default="", metavar="PATH",
+                    help="with --steady: write the measured cycles' span "
+                         "trees as Chrome trace-event JSON (Perfetto-"
+                         "loadable) to PATH and record the path on the "
+                         "JSON line (trace_file)")
     ap.add_argument("--mode", default="auto",
                     choices=["auto", "batched", "sharded", "fused", "jax",
                              "host", "rpc"],
@@ -557,7 +572,7 @@ def main(argv=None):
     if args.steady > 0:
         # >=9 measured cycles so the reported p95 means something
         (latencies, bound, action_ms, readbacks, rss_mb, engines,
-         recompiles) = run_steady(
+         recompiles, span_counts, trace_roots) = run_steady(
             args.config, max(args.cycles, 9), args.mode, args.steady,
             skew=args.steady_skew)
         p50_ms = float(np.percentile(latencies, 50) * 1e3)
@@ -594,6 +609,20 @@ def main(argv=None):
         # measured window is a structural failure, not wall-time noise
         out["recompiles_total"] = recompiles
         out["compile_ms_total"] = round(compile_ms_total(), 1)
+        # the cost of always-on tracing, on the record next to the wall
+        # numbers (ISSUE 7): span count per measured cycle and the
+        # calibrated per-span cost x that count — an estimate labeled as
+        # such (self-measuring every span would double the timestamps)
+        from kubebatch_tpu import obs as _obs
+        if span_counts:
+            spc = float(np.mean(span_counts))
+            out["spans_per_cycle"] = round(spc, 1)
+            out["trace_overhead_ms"] = round(
+                spc * _obs.span_overhead_estimate() * 1e3, 4)
+        if args.trace_export and trace_roots:
+            from kubebatch_tpu.obs import export as _obs_export
+            out["trace_file"] = _obs_export.write_trace(
+                args.trace_export, trace_roots)
         if args.mode == "rpc":
             # same hop-cost / zero-fallback contract as the cold path: a
             # steady rpc line must not silently record in-process cycles
@@ -693,8 +722,8 @@ def main(argv=None):
             emit(out, flush=True, partial=True)
         try:
             churn = 256
-            s_lat, s_bound, s_act, s_rb, _, s_eng, s_rc = run_steady(
-                args.config, 9, args.mode, churn)
+            (s_lat, s_bound, s_act, s_rb, _, s_eng, s_rc, s_spans,
+             _s_roots) = run_steady(args.config, 9, args.mode, churn)
             out["steady_recompiles"] = s_rc
             out["steady_p50_ms"] = round(
                 float(np.percentile(s_lat, 50) * 1e3), 3)
@@ -705,6 +734,12 @@ def main(argv=None):
             out["steady_action_ms"] = s_act
             out["steady_readbacks_per_cycle"] = round(
                 float(np.mean(s_rb)), 1) if s_rb else 0.0
+            if s_spans:
+                from kubebatch_tpu import obs as _obs
+                spc = float(np.mean(s_spans))
+                out["steady_spans_per_cycle"] = round(spc, 1)
+                out["steady_trace_overhead_ms"] = round(
+                    spc * _obs.span_overhead_estimate() * 1e3, 4)
             if args.mode == "rpc":
                 # the steady-extra's cycles are rpc evidence too — a
                 # breaker trip mid-extra must not record in-process

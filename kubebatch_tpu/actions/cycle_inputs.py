@@ -350,15 +350,10 @@ def build_cycle_inputs(ssn: Session,
     batched engine's vocabulary (kernels/affinity.py) instead of falling
     back on them; the fused engine passes False — its one-placement scan
     has no affinity carry."""
-    import time as _time
+    from ..obs import span as _span
 
-    from ..metrics import update_host_phase
-
-    start = _time.perf_counter()
-    try:
+    with _span("tensorize", cat="phase"):
         return _build_cycle_inputs(ssn, allow_affinity)
-    finally:
-        update_host_phase("tensorize", _time.perf_counter() - start)
 
 
 def _build_cycle_inputs(ssn: Session,
@@ -575,18 +570,13 @@ def replay_decisions(ssn: Session, inputs: CycleInputs,
     registered event handler is a recognized built-in and the volume
     binder is the no-op default — anything custom gets the per-event
     ordering it may depend on."""
-    import time as _time
+    from ..obs import span as _span
 
-    from ..metrics import update_host_phase
-
-    start = _time.perf_counter()
-    try:
+    with _span("replay", cat="phase", bulk=_bulk_replay_supported(ssn)):
         if _bulk_replay_supported(ssn):
             _replay_bulk(ssn, inputs, task_state, task_node, task_seq)
         else:
             _replay_ordered(ssn, inputs, task_state, task_node, task_seq)
-    finally:
-        update_host_phase("replay", _time.perf_counter() - start)
 
 
 def _bulk_replay_supported(ssn: Session) -> bool:

@@ -75,6 +75,15 @@ def _on_duration(name: str, duration: float, **kw) -> None:
     if not name.endswith("backend_compile_duration"):
         return
     metrics.add_compile_ms(duration * 1e3)
+    # land the compile as an event in the obs span tree (the listener
+    # fires on the compiling thread, so it attaches inside the dispatch
+    # span that paid the wall) — exported traces then SHOW the compile
+    # instead of an unexplained gap
+    try:
+        from ..obs import add_event
+        add_event("xla_compile", duration, cat="compile")
+    except Exception:       # pragma: no cover — obs must never break jax
+        pass
     st = getattr(_tls, "stack", None)
     if st:
         st[-1].compiles += 1

@@ -337,6 +337,31 @@ SIDECAR_QUARANTINE = Quarantine()
 #: ladder levels in demotion order; level 0 imposes no cap
 LADDER_LEVELS = ("full", "batched", "fused", "host")
 
+#: observers notified (with the new level) after a ladder demotion — the
+#: obs flight recorder registers here when armed; hooks run OUTSIDE the
+#: ladder lock and must never raise into the scheduling loop
+_DEMOTION_HOOKS: list = []
+
+
+def on_ladder_demotion(cb: Callable[[int], None]) -> None:
+    if cb not in _DEMOTION_HOOKS:
+        _DEMOTION_HOOKS.append(cb)
+
+
+def remove_ladder_demotion_hook(cb: Callable[[int], None]) -> None:
+    try:
+        _DEMOTION_HOOKS.remove(cb)
+    except ValueError:
+        pass
+
+
+def _notify_demotion(level: int) -> None:
+    for cb in list(_DEMOTION_HOOKS):
+        try:
+            cb(level)
+        except Exception:                  # pragma: no cover — observer bug
+            log.exception("ladder demotion hook failed")
+
 #: engine tier ranks: an engine at rank >= the ladder level is already
 #: at or below the cap and passes through unchanged. rpc counts as a
 #: full-tier engine (its own breaker handles sidecar failure; the
@@ -386,12 +411,14 @@ class DegradationLadder:
                     or self.level >= len(LADDER_LEVELS) - 1):
                 return
             self.level += 1
+            level = self.level
             self._fail_streak = 0
             self._next_probe_at = (time.monotonic()
                                    + self._pol().quarantine_for(self.level))
             set_degradation_level(self.level)
             log.warning("degradation ladder DEMOTED to level %d (%s)",
                         self.level, LADDER_LEVELS[self.level])
+        _notify_demotion(level)
 
     def _run_probe_async(self, probe: Callable[[], bool]) -> None:
         def _worker():

@@ -9,12 +9,11 @@ invalid jobs could never dispatch anyway.
 """
 from __future__ import annotations
 
-import time
 from typing import List
 
 from ..conf import Tier
-from ..metrics import (ON_SESSION_CLOSE, ON_SESSION_OPEN,
-                       update_host_phase, update_plugin_duration)
+from ..metrics import ON_SESSION_CLOSE, ON_SESSION_OPEN
+from ..obs import span as _span
 from .registry import get_plugin_builder
 from .session import Session, close_session, open_session, validate_jobs
 
@@ -22,24 +21,23 @@ from .session import Session, close_session, open_session, validate_jobs
 def open_session_with_tiers(cache, tiers: List[Tier],
                             enable_preemption: bool = False,
                             snapshot=None) -> Session:
-    """ref: framework.go:29-50 (OpenSession)."""
-    t0 = time.perf_counter()
-    ssn = open_session(cache, enable_preemption, snapshot=snapshot)
-    ssn.tiers = tiers
-    for tier in tiers:
-        for opt in tier.plugins:
-            builder = get_plugin_builder(opt.name)
-            if builder is None:
-                continue
-            plugin = builder(opt.arguments)
-            ssn.plugins[plugin.name] = plugin
-    for plugin in ssn.plugins.values():
-        start = time.perf_counter()
-        plugin.on_session_open(ssn)
-        update_plugin_duration(plugin.name, ON_SESSION_OPEN,
-                               time.perf_counter() - start)
-    validate_jobs(ssn)
-    update_host_phase("open", time.perf_counter() - t0)
+    """ref: framework.go:29-50 (OpenSession). Timing routes through obs
+    spans; update_host_phase("open") / update_plugin_duration are the
+    derived views fired at span exit."""
+    with _span("open", cat="phase"):
+        ssn = open_session(cache, enable_preemption, snapshot=snapshot)
+        ssn.tiers = tiers
+        for tier in tiers:
+            for opt in tier.plugins:
+                builder = get_plugin_builder(opt.name)
+                if builder is None:
+                    continue
+                plugin = builder(opt.arguments)
+                ssn.plugins[plugin.name] = plugin
+        for plugin in ssn.plugins.values():
+            with _span(plugin.name, cat="plugin", phase=ON_SESSION_OPEN):
+                plugin.on_session_open(ssn)
+        validate_jobs(ssn)
     return ssn
 
 
@@ -52,16 +50,13 @@ def CloseSession(ssn: Session) -> None:
     statement a mid-action fault left open — plugin close hooks and the
     status write-back must observe the pre-transaction state, never a
     half-applied eviction batch."""
-    t0 = time.perf_counter()
-    for st in list(getattr(ssn, "open_statements", ()) or ()):
-        st.discard()
-    for plugin in ssn.plugins.values():
-        start = time.perf_counter()
-        plugin.on_session_close(ssn)
-        update_plugin_duration(plugin.name, ON_SESSION_CLOSE,
-                               time.perf_counter() - start)
-    close_session(ssn)
-    update_host_phase("close", time.perf_counter() - t0)
+    with _span("close", cat="phase"):
+        for st in list(getattr(ssn, "open_statements", ()) or ()):
+            st.discard()
+        for plugin in ssn.plugins.values():
+            with _span(plugin.name, cat="plugin", phase=ON_SESSION_CLOSE):
+                plugin.on_session_close(ssn)
+        close_session(ssn)
 
 
 close_session_with_plugins = CloseSession

@@ -60,7 +60,6 @@ Faithfulness contract (vs the reference allocate action):
 """
 from __future__ import annotations
 
-import time
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
@@ -70,8 +69,8 @@ import numpy as np
 
 from ..compilesvc import instrument as _instrument
 from ..compilesvc import register_provider as _register_provider
-from ..metrics import (count_blocking_readback, solver_trace,
-                       update_solver_kernel_duration)
+from ..metrics import count_blocking_readback
+from ..obs import span as _span
 from .fused import (ALLOC, ALLOC_OB, FAIL, K_DRF_SHARE, K_GANG_READY,
                     K_PRIORITY, K_PROP_SHARE, PIPELINE, SKIP, _share)
 from .pack import pack_inputs
@@ -1251,25 +1250,24 @@ def solve_batched(device, inputs, max_rounds: int = 0,
     t_pad = inputs.task_valid.shape[0]
     args, statics = prepare_batched(device, inputs, max_rounds,
                                     compact_bucket)
-    start = time.perf_counter()
-    with solver_trace("batched_allocate"):
+    with _span("batched_allocate", cat="kernel"):
         final, packed = _batched_packed(*args, **statics)
         # ONE blocking transfer for everything the host needs; it stays
-        # inside the trace so a one-shot capture includes the device
-        # execution, not just the async dispatch
+        # inside the kernel span (which carries the jax TraceAnnotation)
+        # so a one-shot capture includes the device execution, not just
+        # the async dispatch
         count_blocking_readback()
-        out = np.asarray(packed)
+        with _span("readback", cat="readback"):
+            out = np.asarray(packed)
         task_state = out[:t_pad]
         task_node = out[t_pad:2 * t_pad]
         task_seq = out[2 * t_pad:3 * t_pad]
         rounds = out[3 * t_pad]
 
-    device.idle = final.idle
-    device.releasing = final.releasing
-    device.n_tasks = final.n_tasks
-    device.nz_req = final.nz_req
-    update_solver_kernel_duration("batched_allocate",
-                                  time.perf_counter() - start)
+        device.idle = final.idle
+        device.releasing = final.releasing
+        device.n_tasks = final.n_tasks
+        device.nz_req = final.nz_req
     return task_state, task_node, task_seq, int(rounds)
 
 

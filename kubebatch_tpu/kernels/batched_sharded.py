@@ -183,11 +183,9 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
     so later actions observe the same node accounting as the single-chip
     path.
     """
-    import time
-
     from ..faults import check as _fault_check
-    from ..metrics import (count_blocking_readback, solver_trace,
-                           update_solver_kernel_duration)
+    from ..metrics import count_blocking_readback
+    from ..obs import span as _span
 
     # injection seam: before any carry is consumed, so a faulted sharded
     # dispatch leaves the DeviceSession state untouched
@@ -197,26 +195,27 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
     t_pad = inputs.task_valid.shape[0]
     placed_state, placed_arrays, statics = prepare_sharded(
         mesh, device, inputs, max_rounds)
-    start = time.perf_counter()
-    with solver_trace("batched_allocate_sharded"):
+    with _span("batched_allocate_sharded", cat="kernel"):
         final, packed = _sharded_entry(placed_state, placed_arrays,
                                        **statics)
         count_blocking_readback()
-        out = np.asarray(packed)
-    task_state = out[:t_pad]
-    task_node = out[t_pad:2 * t_pad]
-    task_seq = out[2 * t_pad:3 * t_pad]
-    rounds = out[3 * t_pad]
+        with _span("readback", cat="readback"):
+            out = np.asarray(packed)
+        task_state = out[:t_pad]
+        task_node = out[t_pad:2 * t_pad]
+        task_seq = out[2 * t_pad:3 * t_pad]
+        rounds = out[3 * t_pad]
 
-    # commit the carry back to the session's device state (trimmed to the
-    # single-chip bucket) so later actions see the updated accounting
-    count_blocking_readback(4)
-    device.idle = jnp.asarray(np.asarray(final.idle)[:n_pad])
-    device.releasing = jnp.asarray(np.asarray(final.releasing)[:n_pad])
-    device.n_tasks = jnp.asarray(np.asarray(final.n_tasks)[:n_pad])
-    device.nz_req = jnp.asarray(np.asarray(final.nz_req)[:n_pad])
-    update_solver_kernel_duration("batched_allocate_sharded",
-                                  time.perf_counter() - start)
+        # commit the carry back to the session's device state (trimmed to
+        # the single-chip bucket) so later actions see the updated
+        # accounting
+        count_blocking_readback(4)
+        with _span("readback_carry", cat="readback", n=4):
+            device.idle = jnp.asarray(np.asarray(final.idle)[:n_pad])
+            device.releasing = jnp.asarray(
+                np.asarray(final.releasing)[:n_pad])
+            device.n_tasks = jnp.asarray(np.asarray(final.n_tasks)[:n_pad])
+            device.nz_req = jnp.asarray(np.asarray(final.nz_req)[:n_pad])
     return task_state, task_node, task_seq, int(rounds)
 
 

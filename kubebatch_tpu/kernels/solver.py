@@ -28,7 +28,6 @@ and Pipelined don't advance gang readiness (api/types.go:82-84).
 """
 from __future__ import annotations
 
-import time
 from functools import partial
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
@@ -39,9 +38,8 @@ import numpy as np
 from ..api import NodeInfo
 from ..compilesvc import instrument as _instrument
 from ..compilesvc import register_provider as _register_provider
-from ..metrics import (count_blocking_readback,
-                       update_solver_kernel_duration,
-                       update_tensorize_duration)
+from ..metrics import count_blocking_readback
+from ..obs import span as _span
 from .tensorize import VEC_EPS, NodeState, TaskBatch, pad_to_bucket
 
 SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
@@ -250,22 +248,22 @@ class DeviceSession:
     (the host applies exactly the decisions the kernel produced)."""
 
     def __init__(self, nodes: Dict[str, NodeInfo], min_bucket: int = 8):
-        start = time.perf_counter()
-        self.state = NodeState.from_nodes(nodes, min_bucket)
-        self.idle = jnp.asarray(self.state.idle)
-        self.releasing = jnp.asarray(self.state.releasing)
-        self.backfilled = jnp.asarray(self.state.backfilled)
-        self.allocatable_cm = jnp.asarray(self.state.allocatable[:, :2])
-        self.nz_req = jnp.asarray(self.state.nz_requested)
-        self.n_tasks = jnp.asarray(self.state.n_tasks)
-        self.max_task_num = jnp.asarray(self.state.max_task_num)
-        self.node_ok = jnp.asarray(self.state.schedulable & self.state.valid)
-        #: grow-only high-water bucket for this session's dirty-row
-        #: scatter shape: one shape per session lifetime -> one compile
-        #: per shape, without a big session's mark leaking onto smaller
-        #: sessions in the same process
-        self._scatter_hw = 8
-        update_tensorize_duration(time.perf_counter() - start)
+        with _span("device_snapshot", cat="tensorize"):
+            self.state = NodeState.from_nodes(nodes, min_bucket)
+            self.idle = jnp.asarray(self.state.idle)
+            self.releasing = jnp.asarray(self.state.releasing)
+            self.backfilled = jnp.asarray(self.state.backfilled)
+            self.allocatable_cm = jnp.asarray(self.state.allocatable[:, :2])
+            self.nz_req = jnp.asarray(self.state.nz_requested)
+            self.n_tasks = jnp.asarray(self.state.n_tasks)
+            self.max_task_num = jnp.asarray(self.state.max_task_num)
+            self.node_ok = jnp.asarray(self.state.schedulable
+                                       & self.state.valid)
+            #: grow-only high-water bucket for this session's dirty-row
+            #: scatter shape: one shape per session lifetime -> one compile
+            #: per shape, without a big session's mark leaking onto smaller
+            #: sessions in the same process
+            self._scatter_hw = 8
 
     @property
     def n_padded(self) -> int:
@@ -287,8 +285,6 @@ class DeviceSession:
         (cache dirty set) nor session-mutated (touched set folded in by
         the caller) since they were last packed, so both mirrors still
         hold their host-truth values."""
-        from ..api.resource import VEC_SCALE
-
         state = self.state
         if len(nodes) != len(state.names) \
                 or any(n not in state.index for n in nodes):
@@ -296,7 +292,12 @@ class DeviceSession:
         rows = sorted(state.index[n] for n in names if n in state.index)
         if not rows:
             return True
-        start = time.perf_counter()
+        with _span("update_rows", cat="tensorize", rows=len(rows)):
+            return self._update_rows_inner(nodes, rows, state)
+
+    def _update_rows_inner(self, nodes, rows, state) -> bool:
+        from ..api.resource import VEC_SCALE
+
         from .tensorize import accumulate_nz, pack_node_raw
         k = len(rows)
         dirty_nodes = [nodes[state.names[r]] for r in rows]
@@ -346,7 +347,6 @@ class DeviceSession:
             raw32[:, 0], raw32[:, 1], raw32[:, 2], raw32[:, 3, :2],
             nz, state.n_tasks[idx], state.max_task_num[idx],
             state.schedulable[idx] & state.valid[idx])
-        update_tensorize_duration(time.perf_counter() - start)
         return True
 
     def resync(self, nodes: Dict[str, NodeInfo]) -> None:
@@ -381,25 +381,26 @@ class DeviceSession:
         dyn_weights = np.asarray(
             [dyn.least_requested, dyn.balanced_resource] if dyn_enabled
             else [0.0, 0.0], np.float32)
-        start = time.perf_counter()
-        (packed, idle, releasing, n_tasks, nz_req) = _allocate_scan(
-            self.idle, self.releasing, self.backfilled, self.allocatable_cm,
-            self.nz_req, self.max_task_num, self.n_tasks, self.node_ok,
-            jnp.asarray(batch.resreq), jnp.asarray(batch.init_resreq),
-            jnp.asarray(batch.nz_req), jnp.asarray(batch.valid),
-            jnp.asarray(scores), jnp.asarray(pred_mask),
-            jnp.asarray(min_available, jnp.int32),
-            jnp.asarray(init_allocated, jnp.int32),
-            jnp.asarray(dyn_weights), dyn_enabled=dyn_enabled)
-        count_blocking_readback()
-        host = np.asarray(packed)      # ONE blocking read per job visit
-        decisions = host[:t_pad]
-        node_idx = host[t_pad:2 * t_pad]
-        became_ready = bool(host[2 * t_pad])
-        self.idle, self.releasing, self.n_tasks = idle, releasing, n_tasks
-        self.nz_req = nz_req
-        update_solver_kernel_duration("allocate_scan",
-                                      time.perf_counter() - start)
+        with _span("allocate_scan", cat="kernel"):
+            (packed, idle, releasing, n_tasks, nz_req) = _allocate_scan(
+                self.idle, self.releasing, self.backfilled,
+                self.allocatable_cm, self.nz_req, self.max_task_num,
+                self.n_tasks, self.node_ok,
+                jnp.asarray(batch.resreq), jnp.asarray(batch.init_resreq),
+                jnp.asarray(batch.nz_req), jnp.asarray(batch.valid),
+                jnp.asarray(scores), jnp.asarray(pred_mask),
+                jnp.asarray(min_available, jnp.int32),
+                jnp.asarray(init_allocated, jnp.int32),
+                jnp.asarray(dyn_weights), dyn_enabled=dyn_enabled)
+            count_blocking_readback()
+            with _span("readback", cat="readback"):
+                host = np.asarray(packed)  # ONE blocking read per job visit
+            decisions = host[:t_pad]
+            node_idx = host[t_pad:2 * t_pad]
+            became_ready = bool(host[2 * t_pad])
+            self.idle, self.releasing, self.n_tasks = \
+                idle, releasing, n_tasks
+            self.nz_req = nz_req
         out: List[Decision] = []
         for i in range(len(batch.tasks)):
             kind = int(decisions[i])

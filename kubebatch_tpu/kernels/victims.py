@@ -69,7 +69,6 @@ ones, and wave size auto-tunes to the pending set
 from __future__ import annotations
 
 import os
-import time as _time
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -80,7 +79,8 @@ import numpy as np
 
 from ..api import TaskInfo, TaskStatus, ready_statuses
 from ..util import env_on
-from ..metrics import count_blocking_readback, update_solver_kernel_duration
+from ..metrics import count_blocking_readback
+from ..obs import span as _span
 from ..api.resource import RESOURCE_DIM
 from .solver import dynamic_node_score
 from .tensorize import (VEC_EPS, _intern_paths, accumulate_nz, load_kb_pack,
@@ -142,14 +142,13 @@ def _link_rtt() -> float:
     co-located one answers in microseconds)."""
     global _LINK_RTT
     if _LINK_RTT is None:
-        import time as _t
         dev = jax.devices()[0]
         x = jax.device_put(np.zeros(8, np.float32), dev)
         np.asarray(x)                      # warm the path
-        t0 = _t.perf_counter()
-        for _ in range(3):
-            np.asarray(jax.device_put(np.zeros(8, np.float32), dev))
-        _LINK_RTT = (_t.perf_counter() - t0) / 3
+        with _span("link_rtt_probe", cat="probe") as sp:
+            for _ in range(3):
+                np.asarray(jax.device_put(np.zeros(8, np.float32), dev))
+        _LINK_RTT = sp.dur / 3
     return _LINK_RTT
 
 
@@ -778,8 +777,8 @@ class VictimState:
                  allocatable_cm: np.ndarray):
         self.node_index = node_index
         self.n_pad = n_pad
-        _t = _time.perf_counter if os.environ.get(
-            "KB_VICTIM_TIMING") else None
+        from ..obs import now as _obs_now
+        _t = _obs_now if os.environ.get("KB_VICTIM_TIMING") else None
         _m = [] if _t else None
         if _t:
             _m.append(("start", _t()))
@@ -1583,30 +1582,29 @@ class VictimSolver:
                 score_nodes=self.score_nodes, room_check=self.room_check)
 
         self.dispatches += 1
-        k0 = _time.perf_counter()
-        packed = None
-        if self.remote is not None:
-            # sidecar analysis (KUBEBATCH_SOLVER=rpc): statics were
-            # uploaded once; a failed call falls back to the local
-            # kernels for THIS dispatch (analysis is pure — retrying
-            # locally cannot double-apply anything)
-            packed = self.remote.wave(
-                self, p_res, p_resreq, p_nz, p_sig, p_job, p_queue,
-                filter_kind=filter_kind, dyn_enabled=dyn_enabled)
-        if packed is None:
-            if self._dev is not None:
-                with jax.default_device(self._dev):
+        with _span("victim_wave", cat="kernel"):
+            packed = None
+            if self.remote is not None:
+                # sidecar analysis (KUBEBATCH_SOLVER=rpc): statics were
+                # uploaded once; a failed call falls back to the local
+                # kernels for THIS dispatch (analysis is pure — retrying
+                # locally cannot double-apply anything)
+                packed = self.remote.wave(
+                    self, p_res, p_resreq, p_nz, p_sig, p_job, p_queue,
+                    filter_kind=filter_kind, dyn_enabled=dyn_enabled)
+            if packed is None:
+                if self._dev is not None:
+                    with jax.default_device(self._dev):
+                        out = run()
+                else:
                     out = run()
-            else:
-                out = run()
-            count_blocking_readback()
-            packed = np.asarray(out)   # [W, N+N+V] — ONE blocking read
-        n_pad = self.state.n_pad
-        pick = packed[:, :n_pad]
-        guard = packed[:, n_pad:2 * n_pad]
-        victims = packed[:, 2 * n_pad:]
-        update_solver_kernel_duration("victim_wave",
-                                      _time.perf_counter() - k0)
+                count_blocking_readback()
+                with _span("readback", cat="readback"):
+                    packed = np.asarray(out)  # [W,N+N+V] — ONE blocking read
+            n_pad = self.state.n_pad
+            pick = packed[:, :n_pad]
+            guard = packed[:, n_pad:2 * n_pad]
+            victims = packed[:, 2 * n_pad:]
         log_pos = len(st.events)
         for i, t in enumerate(chunk):
             self._wave_cache[(filter_kind, t.uid)] = {
@@ -1640,23 +1638,22 @@ class VictimSolver:
                 filter_kind=filter_kind, dyn_enabled=dyn_enabled,
                 score_nodes=self.score_nodes, room_check=self.room_check)
 
-        k0 = _time.perf_counter()
-        packed = None
-        if self.remote is not None:
-            packed = self.remote.visit(
-                self, p_res, p_resreq, p_nz, int(sig), int(p_job),
-                int(p_queue), visited, filter_kind=filter_kind,
-                dyn_enabled=dyn_enabled)
-        if packed is None:
-            if self._dev is not None:
-                with jax.default_device(self._dev):
+        with _span("victim_visit", cat="kernel"):
+            packed = None
+            if self.remote is not None:
+                packed = self.remote.visit(
+                    self, p_res, p_resreq, p_nz, int(sig), int(p_job),
+                    int(p_queue), visited, filter_kind=filter_kind,
+                    dyn_enabled=dyn_enabled)
+            if packed is None:
+                if self._dev is not None:
+                    with jax.default_device(self._dev):
+                        out = run()
+                else:
                     out = run()
-            else:
-                out = run()
-            count_blocking_readback()
-            packed = np.asarray(out)   # [4+V] — ONE blocking read
-        update_solver_kernel_duration("victim_visit",
-                                      _time.perf_counter() - k0)
+                count_blocking_readback()
+                with _span("readback", cat="readback"):
+                    packed = np.asarray(out)   # [4+V] — ONE blocking read
         found, node, vcount, guard = (bool(packed[0]), int(packed[1]),
                                       int(packed[2]), bool(packed[3]))
         rows = np.nonzero(packed[4:])[0].tolist() if found else []
@@ -1772,10 +1769,12 @@ def build_victim_solver(ssn, pending: Sequence[TaskInfo],
         return None
 
     ns = device.state
-    state = VictimState(
-        ssn, node_index=ns.index, n_pad=ns.n_padded,
-        node_ok=ns.schedulable & ns.valid, max_task_num=ns.max_task_num,
-        allocatable_cm=ns.allocatable[:, :2])
+    with _span("victim_state_build", cat="tensorize"):
+        state = VictimState(
+            ssn, node_index=ns.index, n_pad=ns.n_padded,
+            node_ok=ns.schedulable & ns.valid,
+            max_task_num=ns.max_task_num,
+            allocatable_cm=ns.allocatable[:, :2])
     solver = VictimSolver(
         state, terms, names=ns.names, tiers=tuple(tiers),
         veto_critical="conformance" in ssn.victim_veto_fns,

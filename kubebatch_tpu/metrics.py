@@ -453,6 +453,81 @@ def blocking_readbacks() -> int:
 
 
 # ---------------------------------------------------------------------------
+# rpc dispatch-latency exposure (ISSUE 7 satellite 1)
+# ---------------------------------------------------------------------------
+# rpc/client.py keeps a bounded ring of (client rtt, server solve_ms)
+# per Solve dispatch; consumers should get percentiles, not raw tuples.
+# The import is lazy and function-scoped: metrics is imported BY
+# rpc.client, and a process that never touches the sidecar (or has no
+# grpc) must not pay for — or crash on — the rpc stack here.
+
+def rpc_dispatch_percentiles() -> dict:
+    """p50/p99 of the recent rpc Solve dispatches, ms: client-observed
+    rtt, server-side solve wall, and the hop (rtt - solve =
+    serialization + wire + queueing). Empty dict when no dispatches (or
+    no rpc stack) — never raises."""
+    try:
+        from .rpc.client import DISPATCH_STATS
+        stats = list(DISPATCH_STATS)
+    except Exception:
+        return {}
+    if not stats:
+        return {}
+    import numpy as _np
+
+    rtt = _np.asarray([r for r, _ in stats]) * 1e3
+    solve = _np.asarray([s for _, s in stats])
+    hop = _np.maximum(0.0, rtt - solve)
+    out = {"dispatches": len(stats)}
+    for name, arr in (("rtt_ms", rtt), ("solve_ms", solve),
+                      ("hop_ms", hop)):
+        out[f"{name}_p50"] = round(float(_np.percentile(arr, 50)), 3)
+        out[f"{name}_p99"] = round(float(_np.percentile(arr, 99)), 3)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the one-call counter snapshot (ISSUE 7: /debug/vars + flight recorder)
+# ---------------------------------------------------------------------------
+
+def counters_snapshot(include_rpc: bool = True) -> dict:
+    """Every process-lifetime mirror counter as one JSON-able dict — the
+    payload of /debug/vars and of each flight-recorder cycle record.
+    Values are the same process-lifetime accumulators the bench diffs
+    across windows; consumers diff snapshots, they do not expect zeroing.
+    ``include_rpc=False`` skips the percentile pass over the dispatch
+    ring (six np.percentile calls over up to 4096 tuples) — the form the
+    flight recorder uses per cycle, where only the dump needs them."""
+    snap = {
+        "engine_demotions_total": engine_demotions_total(),
+        "affinity_host_fallback_total": affinity_host_fallback_total(),
+        "cycle_failures_total": cycle_failures_total(),
+        "cycle_failures_by_reason": cycle_failures_by_reason(),
+        "fault_injected_total": fault_injected_total(),
+        "degradation_level": degradation_level(),
+        "compile_ms_total": round(compile_ms_total(), 3),
+        "recompiles_total": recompiles_total(),
+        "recompiles_by_reason": {f"{e}/{r}": n for (e, r), n
+                                 in recompiles_by_reason().items()},
+        "solver_kernel_seconds": round(solver_kernel_seconds(), 6),
+        "host_phase_seconds": {k: round(v, 6) for k, v
+                               in host_phase_seconds().items()},
+        "slow_path_items": slow_path_items(),
+        "blocking_readbacks": blocking_readbacks(),
+    }
+    if include_rpc:
+        rpc = rpc_dispatch_percentiles()
+        if rpc:
+            snap["rpc_dispatch"] = rpc
+    try:                                   # lazy: obs imports metrics
+        from .obs import spans as _spans
+        snap["tracer"] = _spans.tracer_stats()
+    except Exception:                      # pragma: no cover — import race
+        pass
+    return snap
+
+
+# ---------------------------------------------------------------------------
 # device-side tracing (SURVEY.md sect. 5: keep the reference's histogram
 # taxonomy, add jax.profiler traces around the kernels)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,32 @@
+"""kubebatch_tpu.obs — tracing, flight recording, and explainability.
+
+The observability subsystem (ISSUE 7 / docs/OBSERVABILITY.md):
+
+- :mod:`.spans`   — the span tracer every legacy perf_counter timing
+  site routes through; builds the per-cycle span tree (cycle -> action
+  -> host phase -> kernel dispatch -> blocking readback) and fires the
+  old metric accumulators as derived views at span exit;
+- :mod:`.export`  — Chrome trace-event JSON (Perfetto-loadable) export
+  of span trees, armed per trace directory;
+- :mod:`.flight`  — the bounded flight-recorder ring (span trees +
+  counter snapshots + ladder state), auto-dumped on cycle failures,
+  ladder demotions and chaos invariant violations;
+- :mod:`.explain` — the opt-in unschedulability explainer (one extra
+  readback, never on the steady path);
+- :mod:`.http`    — /metrics, /healthz, /debug/vars, /debug/explain.
+
+Import discipline: this package imports only metrics (and jax, which
+every kernel module already pays for); actions/kernels/rpc import obs,
+never the reverse at module scope — no cycles.
+"""
+from .spans import (CYCLE_HOOKS, Span, add_event, arm_profile, begin_cycle,
+                    begin_server_root, current_cycle, cycle, enabled,
+                    end_cycle, end_server_root, graft, last_cycle, now,
+                    set_enabled, span, span_overhead_estimate, spans_total,
+                    tracer_stats)
+
+__all__ = ["CYCLE_HOOKS", "Span", "add_event", "arm_profile",
+           "begin_cycle", "begin_server_root", "current_cycle", "cycle",
+           "enabled", "end_cycle", "end_server_root", "graft",
+           "last_cycle", "now", "set_enabled", "span",
+           "span_overhead_estimate", "spans_total", "tracer_stats"]

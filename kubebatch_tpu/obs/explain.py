@@ -1,0 +1,276 @@
+"""Unschedulability explainer — WHY is a pending pod still pending?
+
+kube-batch answers this with per-pod ``Unschedulable`` events written
+back to the API server; the TPU-native equivalent has to answer it from
+the device-resident predicate state instead. This is an OPT-IN debug
+pass (never on the steady hot path): it evaluates, for every still-
+pending task, which of a fixed reason set fails on each candidate node,
+folds the per-(task, node) failure bitmask into per-task reason counts
+on device, and reads the counts back in EXACTLY ONE blocking transfer.
+The counts then fold into structured per-job reasons on the host:
+
+    {"job": "sim/job-0042", "pending": 143, "unschedulable": 143,
+     "reasons": {"port-conflict": 143}, ...}
+
+meaning "143 tasks failed port-conflict on all candidate nodes".
+
+Reason semantics (evaluated over CANDIDATE nodes — real, schedulable
+rows; identical in the device kernel and the host oracle, which the
+tests pin against each other):
+
+- ``no-candidate-nodes``  — the cluster has zero schedulable nodes;
+- ``predicate``     — the task's static predicate signature row
+  (node selector / required affinity / taints — kernels/encode.py)
+  excludes the node;
+- ``resources``     — some resreq dimension exceeds the node's idle
+  capacity (the task cannot allocate now; it may still pipeline);
+- ``task-slots``    — the node is at its max_task_num pod cap;
+- ``port-conflict`` — a required host port is already claimed on the
+  node (affinity vocabulary present only).
+
+A reason is BLOCKING for a task when it fails on every candidate node;
+a task is unschedulable when no candidate node passes all reasons.
+Both derivations run on the same [T, R] count matrix, so the device
+pass and the numpy host oracle agree exactly or the test fails.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+REASONS = ("predicate", "resources", "task-slots", "port-conflict")
+
+__all__ = ["REASONS", "failure_counts_host", "failure_counts_device",
+           "fold_reasons", "explain_session", "latest", "set_latest"]
+
+
+# ---------------------------------------------------------------------
+# device pass — one jitted reduction, ONE blocking readback
+# ---------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("has_ports",))
+def _explain_kernel(idle, node_ok, n_tasks, max_task_num, sig_pred,
+                    task_sig, task_valid, resreq, task_ports, port_base,
+                    has_ports):
+    """Per-task failure counts over candidate nodes, packed as one
+    [T, 6] int32 block: 4 reason columns + eligible-node count +
+    broadcast candidate count (ONE readback for everything)."""
+    import jax.numpy as jnp
+
+    cand = node_ok                                     # [N] bool
+    n_cand = jnp.sum(cand.astype(jnp.int32))
+    pred_ok = sig_pred[task_sig]                       # [T, N] bool
+    res_ok = jnp.all(resreq[:, None, :] <= idle[None, :, :],
+                     axis=-1)                          # [T, N]
+    slots_ok = jnp.broadcast_to((n_tasks < max_task_num)[None, :],
+                                res_ok.shape)          # [T, N]
+    if has_ports:
+        # conflict iff any required port is already claimed on the node
+        conflict = jnp.einsum("tp,np->tn", task_ports.astype(jnp.int32),
+                              port_base.astype(jnp.int32)) > 0
+        ports_ok = ~conflict
+    else:
+        ports_ok = jnp.ones_like(pred_ok)
+    candf = cand[None, :]
+
+    def count_fail(ok):
+        return jnp.sum((~ok & candf).astype(jnp.int32), axis=1)
+
+    counts = jnp.stack([count_fail(pred_ok), count_fail(res_ok),
+                        count_fail(slots_ok), count_fail(ports_ok)],
+                       axis=1)                         # [T, 4]
+    eligible = jnp.sum((pred_ok & res_ok & slots_ok & ports_ok
+                        & candf).astype(jnp.int32), axis=1)   # [T]
+    tvalid = task_valid.astype(jnp.int32)
+    packed = jnp.concatenate(
+        [counts * tvalid[:, None], (eligible * tvalid)[:, None],
+         jnp.full_like(tvalid, n_cand)[:, None]], axis=1)
+    return packed
+
+
+def failure_counts_device(inputs) -> Tuple[np.ndarray, np.ndarray, int]:
+    """(counts [T_real, 4], eligible [T_real], n_candidates) from the
+    DEVICE arrays — the one-extra-readback debug pass. Reads the device
+    session's live capacity carry, so it describes the state the NEXT
+    solve would see."""
+    import jax.numpy as jnp
+
+    from ..metrics import count_blocking_readback
+
+    device = inputs.device
+    aff = inputs.affinity
+    has_ports = bool(aff is not None and np.any(aff.task_ports))
+    if has_ports:
+        task_ports = jnp.asarray(aff.task_ports)
+        port_base = jnp.asarray(aff.port_base)
+    else:
+        # zero-width placeholders keep the signature shape-stable
+        t_pad = inputs.task_valid.shape[0]
+        task_ports = jnp.zeros((t_pad, 1), bool)
+        port_base = jnp.zeros((device.n_padded, 1), bool)
+    packed = _explain_kernel(
+        device.idle, device.node_ok, device.n_tasks, device.max_task_num,
+        jnp.asarray(inputs.sig_pred), jnp.asarray(inputs.task_sig),
+        jnp.asarray(inputs.task_valid), jnp.asarray(inputs.resreq),
+        task_ports, port_base, has_ports=has_ports)
+    count_blocking_readback()
+    host = np.asarray(packed)          # the explainer's ONE blocking read
+    n_real = len(inputs.tasks)
+    return (host[:n_real, :4], host[:n_real, 4],
+            int(host[0, 5]) if len(host) else 0)
+
+
+# ---------------------------------------------------------------------
+# host oracle — same semantics from the numpy mirrors, zero device work
+# ---------------------------------------------------------------------
+
+def failure_counts_host(inputs) -> Tuple[np.ndarray, np.ndarray, int]:
+    """The numpy twin of failure_counts_device, computed from the
+    DeviceSession's host mirror (NodeState) — the oracle the device pass
+    is pinned against, and the fallback when no device session exists."""
+    state = inputs.device.state
+    cand = np.asarray(state.schedulable & state.valid)          # [N_pad]
+    n_cand = int(cand.sum())
+    t_real = len(inputs.tasks)
+    idle = np.asarray(state.idle, np.float32)
+    pred_ok = np.asarray(inputs.sig_pred)[
+        np.asarray(inputs.task_sig)[:t_real]]                   # [T, N]
+    res_ok = np.all(np.asarray(inputs.resreq, np.float32)[:t_real, None, :]
+                    <= idle[None, :, :], axis=-1)
+    slots_ok = np.broadcast_to(
+        (np.asarray(state.n_tasks)
+         < np.asarray(state.max_task_num))[None, :], res_ok.shape)
+    aff = inputs.affinity
+    if aff is not None and np.any(aff.task_ports):
+        conflict = (aff.task_ports[:t_real].astype(np.int32)
+                    @ aff.port_base.T.astype(np.int32)) > 0
+        ports_ok = ~conflict
+    else:
+        ports_ok = np.ones_like(pred_ok)
+    candf = cand[None, :]
+
+    def count_fail(ok):
+        return np.sum(~ok & candf, axis=1).astype(np.int32)
+
+    counts = np.stack([count_fail(pred_ok), count_fail(res_ok),
+                       count_fail(slots_ok), count_fail(ports_ok)], axis=1)
+    eligible = np.sum(pred_ok & res_ok & slots_ok & ports_ok & candf,
+                      axis=1).astype(np.int32)
+    return counts, eligible, n_cand
+
+
+# ---------------------------------------------------------------------
+# folding into per-job structured reasons
+# ---------------------------------------------------------------------
+
+def fold_reasons(inputs, counts: np.ndarray, eligible: np.ndarray,
+                 n_cand: int) -> dict:
+    """Fold the [T, R] failure-count matrix into the structured snapshot
+    served by /debug/explain and printed by sim summaries."""
+    per_job: Dict[int, dict] = {}
+    task_job = np.asarray(inputs.task_job)
+    for i in range(len(inputs.tasks)):
+        ji = int(task_job[i])
+        rec = per_job.get(ji)
+        if rec is None:
+            job = inputs.jobs[ji] if 0 <= ji < len(inputs.jobs) else None
+            rec = per_job[ji] = {
+                "job": (f"{job.namespace}/{job.name}" if job is not None
+                        else f"job[{ji}]"),
+                "pending": 0, "unschedulable": 0,
+                "reasons": {},
+            }
+        rec["pending"] += 1
+        if n_cand == 0:
+            rec["unschedulable"] += 1
+            rec["reasons"]["no-candidate-nodes"] = \
+                rec["reasons"].get("no-candidate-nodes", 0) + 1
+            continue
+        if int(eligible[i]) == 0:
+            rec["unschedulable"] += 1
+            for r, name in enumerate(REASONS):
+                if int(counts[i, r]) == n_cand:
+                    rec["reasons"][name] = rec["reasons"].get(name, 0) + 1
+    jobs = sorted(per_job.values(),
+                  key=lambda r: (-r["unschedulable"], r["job"]))
+    return {
+        "ts": time.time(),
+        "candidate_nodes": n_cand,
+        "pending_tasks": int(sum(r["pending"] for r in jobs)),
+        "unschedulable_tasks": int(sum(r["unschedulable"] for r in jobs)),
+        "jobs": [r for r in jobs if r["pending"]],
+    }
+
+
+def summarize(snapshot: dict, limit: int = 8) -> List[str]:
+    """Human lines — the kube-batch per-pod-event analogue, per JOB:
+    '143 tasks failed port-conflict on all candidate nodes'."""
+    lines = []
+    for rec in snapshot.get("jobs", ())[:limit]:
+        if not rec["unschedulable"]:
+            continue
+        if rec["reasons"]:
+            why = "; ".join(
+                f"{n} tasks failed {reason} on all candidate nodes"
+                for reason, n in sorted(rec["reasons"].items(),
+                                        key=lambda kv: -kv[1]))
+        else:
+            why = (f"{rec['unschedulable']} tasks have no single node "
+                   f"passing every reason (mixed per-node failures)")
+        lines.append(f"{rec['job']}: {why}")
+    return lines
+
+
+# ---------------------------------------------------------------------
+# session entry point + the /debug/explain snapshot
+# ---------------------------------------------------------------------
+
+_lock = threading.Lock()
+_latest: Optional[dict] = None
+
+
+def explain_session(ssn, device_pass: bool = True) -> dict:
+    """Run the explainer against a live Session (post-actions, pre-close:
+    the pending set is what this cycle could not place). Builds cycle
+    inputs through the SAME tensorize path the solvers use (the cached
+    incremental device snapshot is reused, not rebuilt), runs the device
+    reduction (one readback) or the host oracle, folds, and publishes
+    the snapshot for /debug/explain."""
+    from ..actions.cycle_inputs import EMPTY_CYCLE, build_cycle_inputs
+
+    inputs = build_cycle_inputs(ssn, allow_affinity=True)
+    if inputs is EMPTY_CYCLE:
+        snap = {"ts": time.time(), "candidate_nodes": len(ssn.nodes),
+                "pending_tasks": 0, "unschedulable_tasks": 0, "jobs": []}
+    elif inputs is None:
+        # over-vocabulary / host-path cycle: no device arrays to fold —
+        # report that honestly instead of half an answer
+        snap = {"ts": time.time(), "error":
+                "cycle features exceed the device vocabulary; "
+                "explainer has no predicate tensors for this snapshot"}
+    else:
+        if device_pass:
+            counts, eligible, n_cand = failure_counts_device(inputs)
+        else:
+            counts, eligible, n_cand = failure_counts_host(inputs)
+        snap = fold_reasons(inputs, counts, eligible, n_cand)
+    set_latest(snap)
+    return snap
+
+
+def set_latest(snapshot: Optional[dict]) -> None:
+    global _latest
+    with _lock:
+        _latest = snapshot
+
+
+def latest() -> Optional[dict]:
+    """The most recent snapshot (None when the explainer never ran —
+    it is off by default and costs nothing until invoked)."""
+    with _lock:
+        return _latest

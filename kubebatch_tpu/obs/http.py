@@ -1,0 +1,162 @@
+"""Debug/metrics HTTP endpoint — scrapeable even without prometheus_client.
+
+The reference serves /metrics through promhttp and nothing else; a
+production scheduler needs liveness and debug surfaces too, and they
+must not disappear just because the prometheus client library is absent
+(the mirror counters in metrics.py are the source of truth either way).
+One small stdlib ThreadingHTTPServer serves:
+
+- ``/metrics``       — the Prometheus registry when prometheus_client is
+  importable, else a minimal text rendering of the mirror counters (the
+  scrape contract degrades, it never 404s);
+- ``/healthz``       — liveness JSON: status "ok" at the full engine,
+  "degraded" under any ladder demotion, "failing" when the ladder is
+  pinned at its floor; plus ladder level, cycle failure count,
+  spans/cycle;
+- ``/debug/vars``    — every process-lifetime mirror counter
+  (metrics.counters_snapshot) as one JSON document: demotions, faults,
+  compile/recompile, host phases, readbacks, rpc dispatch percentiles,
+  tracer stats;
+- ``/debug/explain`` — the latest unschedulability-explainer snapshot
+  (obs/explain.py), or ``{"enabled": false}`` when it never ran.
+
+Replaces the bare prometheus ``start_http_server`` call in runtime/cli.py.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from .. import metrics
+
+__all__ = ["DebugHTTPServer", "start"]
+
+
+def _render_vars_text(snapshot: dict) -> str:
+    """Prometheus-ish text fallback for /metrics without the client lib:
+    flat ``kube_batch_<key>{...} value`` lines from the mirror counters."""
+    lines = []
+
+    def walk(prefix: str, value):
+        if isinstance(value, dict):
+            for k, v in sorted(value.items()):
+                walk(f"{prefix}_{k}".replace("-", "_")
+                     .replace(".", "_").replace("/", "_"), v)
+        elif isinstance(value, bool):
+            lines.append(f"kube_batch_{prefix} {int(value)}")
+        elif isinstance(value, (int, float)) and value is not None:
+            lines.append(f"kube_batch_{prefix} {value}")
+
+    walk("", snapshot)
+    return "\n".join(line.replace("kube_batch__", "kube_batch_")
+                     for line in lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "kubebatch-obs/1"
+
+    def log_message(self, *args) -> None:   # quiet; the scheduler logs
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, obj, code: int = 200) -> None:
+        self._send(code, json.dumps(obj, indent=1, default=str).encode(),
+                   "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/healthz":
+                from ..faults import LADDER_LEVELS
+                snap = metrics.counters_snapshot()
+                level = snap.get("degradation_level", 0)
+                # "ok" only at the full engine; any demotion is
+                # "degraded", and a ladder pinned at its floor (every
+                # engine tier exhausted) is the failing state
+                at_floor = level >= len(LADDER_LEVELS) - 1
+                self._send_json({
+                    "status": ("failing" if at_floor
+                               else "degraded" if level else "ok"),
+                    "degradation_level": level,
+                    "cycle_failures_total":
+                        snap.get("cycle_failures_total", 0),
+                    "blocking_readbacks":
+                        snap.get("blocking_readbacks", 0),
+                    "tracer": snap.get("tracer", {}),
+                })
+            elif path == "/debug/vars":
+                self._send_json(metrics.counters_snapshot())
+            elif path == "/debug/explain":
+                from . import explain
+                snap = explain.latest()
+                if snap is None:
+                    self._send_json({
+                        "enabled": False,
+                        "hint": "run with --explain-unschedulable (or "
+                                "call obs.explain.explain_session) to "
+                                "populate this snapshot",
+                    })
+                else:
+                    self._send_json(snap)
+            elif path == "/metrics":
+                try:
+                    from prometheus_client import (REGISTRY,
+                                                   generate_latest)
+                    self._send(200, generate_latest(REGISTRY),
+                               "text/plain; version=0.0.4")
+                except Exception:
+                    self._send(200, _render_vars_text(
+                        metrics.counters_snapshot()).encode(),
+                        "text/plain")
+            else:
+                self._send_json({"error": "not found", "endpoints": [
+                    "/metrics", "/healthz", "/debug/vars",
+                    "/debug/explain"]}, code=404)
+        except BrokenPipeError:            # pragma: no cover — client gone
+            pass
+        except Exception as e:             # a debug surface never crashes
+            try:
+                self._send_json({"error": f"{type(e).__name__}: {e}"},
+                                code=500)
+            except Exception:              # pragma: no cover
+                pass
+
+
+class DebugHTTPServer:
+    """Owns the ThreadingHTTPServer + its daemon thread."""
+
+    def __init__(self, addr: str = "0.0.0.0", port: int = 8080):
+        self._httpd = ThreadingHTTPServer((addr, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "DebugHTTPServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="kb-obs-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+def start(listen_address: str) -> Optional[DebugHTTPServer]:
+    """CLI helper: ':8080' / 'host:port' -> a started server, or None on
+    bind failure (the daemon must schedule even when the port is taken)."""
+    host, _, port = listen_address.rpartition(":")
+    try:
+        return DebugHTTPServer(host or "0.0.0.0", int(port)).start()
+    except Exception:
+        return None

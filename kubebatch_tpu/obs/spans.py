@@ -1,0 +1,491 @@
+"""The span tracer — one structured timing system for the whole cycle.
+
+Every legacy ``time.perf_counter()`` pair in the scheduler loop, the
+actions, the kernels and the rpc layer routes through here: a span is a
+named, categorized interval in a per-cycle tree (cycle -> action -> host
+phase -> kernel dispatch -> blocking readback), and the OLD accounting —
+``metrics.update_host_phase``, ``update_solver_kernel_duration``,
+``update_action_duration``, ``update_plugin_duration``,
+``update_tensorize_duration``, the jax-profiler ``solver_trace``
+annotation — is a DERIVED VIEW fired at span exit. Callers that pinned
+those counters (bench.py ``host_phase_ms``, the readback budget tests)
+keep working unchanged; the span tree is strictly additive evidence.
+
+Overhead discipline (the ISSUE 7 budget: tracing-on steady cycles within
+2% of tracing-off, enforced by tests/test_obs.py):
+
+- a span enter/exit costs two ``perf_counter`` calls, one small object,
+  and one list append — no locks, no dict lookups on the hot path;
+- tree RETENTION only happens inside an open cycle root. A span closed
+  with no root still fires its derived metric views (bench drives
+  sessions without the scheduler loop) and is then dropped, so ad-hoc
+  calls can never accumulate memory;
+- ``set_enabled(False)`` disables tree construction entirely (no stack
+  push, no child lists) but NEVER the derived views — the tracing-off
+  half of an A/B still accounts identically while paying only the Span
+  object and its two timestamps.
+
+Thread model: one tree per thread (``threading.local``). The scheduler
+loop owns its cycle root; rpc server handler threads open their own
+per-request root (``server_root``) and serialize it back to the client,
+which grafts it under its rpc span — that is how server-side solve spans
+stitch into the client's cycle tree without touching the wire schema.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .. import metrics
+
+__all__ = ["Span", "span", "begin_cycle", "end_cycle", "current_cycle",
+           "last_cycle", "set_enabled", "enabled", "cycle",
+           "begin_server_root", "end_server_root", "graft", "add_event",
+           "arm_profile", "span_overhead_estimate", "CYCLE_HOOKS",
+           "tracer_stats", "spans_total"]
+
+_perf = time.perf_counter
+
+
+class Span:
+    """One timed interval. ``t0``/``dur`` are perf_counter seconds;
+    ``cat`` drives the derived metric view at exit (see _DERIVED)."""
+
+    __slots__ = ("name", "cat", "t0", "dur", "args", "children")
+
+    def __init__(self, name: str, cat: str, args: Optional[dict] = None):
+        self.name = name
+        self.cat = cat
+        self.t0 = 0.0
+        self.dur = 0.0
+        self.args = args
+        self.children: List["Span"] = []
+
+    # -- serialization (flight recorder + rpc stitching) ----------------
+    def to_dict(self) -> dict:
+        d: Dict = {"name": self.name, "cat": self.cat,
+                   "t0": self.t0, "dur": self.dur}
+        if self.args:
+            d["args"] = dict(self.args)
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Span":
+        sp = cls(d.get("name", "?"), d.get("cat", "host"),
+                 dict(d["args"]) if d.get("args") else None)
+        sp.t0 = float(d.get("t0", 0.0))
+        sp.dur = float(d.get("dur", 0.0))
+        sp.children = [cls.from_dict(c) for c in d.get("children", ())]
+        return sp
+
+    def count(self) -> int:
+        """Number of spans in this subtree (spans_per_cycle evidence)."""
+        return 1 + sum(c.count() for c in self.children)
+
+    def shift(self, delta: float) -> None:
+        """Rebase the subtree's timestamps by ``delta`` seconds (used when
+        grafting a remote tree whose perf_counter base is another
+        process's)."""
+        self.t0 += delta
+        for c in self.children:
+            c.shift(delta)
+
+    def find(self, name: str) -> Optional["Span"]:
+        """Depth-first lookup by span name (tests / diagnostics)."""
+        if self.name == name:
+            return self
+        for c in self.children:
+            hit = c.find(name)
+            if hit is not None:
+                return hit
+        return None
+
+
+# ---------------------------------------------------------------------
+# per-thread tree state
+# ---------------------------------------------------------------------
+
+_TLS = threading.local()
+
+#: retention switch — derived metric views fire regardless (see module
+#: docstring); guarded by nothing, it is a read-mostly bool
+_ENABLED = True
+
+#: hooks called with the finished root span at every cycle end (flight
+#: recorder + trace exporter register here; hooks must never raise)
+CYCLE_HOOKS: List[Callable[[Span], None]] = []
+
+#: the most recent finished cycle root on ANY thread (diagnostics; the
+#: scheduler is single-threaded so last-writer-wins is exact there)
+_last_cycle: Optional[Span] = None
+
+#: process-lifetime span count (consumers diff across a window, like
+#: every other counter in metrics.py)
+_spans_total = 0
+
+
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def now() -> float:
+    """The tracer's clock (perf_counter seconds) — for milestone probes
+    that want timestamps comparable with span t0/dur without importing
+    their own timing source."""
+    return _perf()
+
+
+def set_enabled(on: bool) -> None:
+    """Toggle tree retention (the A/B switch for the overhead budget
+    test). Derived metric views are unaffected."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def spans_total() -> int:
+    """Process-lifetime completed-span count; consumers diff a window."""
+    return _spans_total
+
+
+# ---------------------------------------------------------------------
+# derived metric views (the old accounting, fired at span exit)
+# ---------------------------------------------------------------------
+
+def _exit_phase(sp: Span) -> None:
+    metrics.update_host_phase(sp.name, sp.dur)
+
+
+def _exit_kernel(sp: Span) -> None:
+    metrics.update_solver_kernel_duration(sp.name, sp.dur)
+
+
+def _exit_action(sp: Span) -> None:
+    metrics.update_action_duration(sp.name, sp.dur)
+
+
+def _exit_plugin(sp: Span) -> None:
+    metrics.update_plugin_duration(sp.name,
+                                   (sp.args or {}).get("phase", ""), sp.dur)
+
+
+def _exit_tensorize(sp: Span) -> None:
+    metrics.update_tensorize_duration(sp.dur)
+
+
+def _exit_e2e(sp: Span) -> None:
+    metrics.update_e2e_duration(sp.dur)
+
+
+_DERIVED = {
+    "phase": _exit_phase,
+    "kernel": _exit_kernel,
+    "action": _exit_action,
+    "plugin": _exit_plugin,
+    "tensorize": _exit_tensorize,
+    "e2e": _exit_e2e,
+}
+
+
+#: categories whose derived view also fires on an EXCEPTION exit —
+#: matching the pre-migration accounting exactly where it matters: the
+#: old tensorize/replay/e2e sites updated from try/finally (partial wall
+#: counted), while the old kernel/action/plugin sites updated after the
+#: work and skipped on a raise (an aborted dispatch must not inflate
+#: solver_kernel_seconds across a fault window).
+_VIEW_ON_ERROR = frozenset({"phase", "e2e"})
+
+
+class _SpanCtx:
+    """The context manager ``span()`` returns. Kernel-cat spans also
+    enter the jax-profiler annotation (metrics.solver_trace), so a
+    surrounding profiler session — including the gated --profile-cycles
+    capture — sees the same names the span tree carries.
+
+    When retention is disabled (set_enabled(False), the A/B off arm)
+    the span never touches the thread stack or any parent's child list —
+    the off cost is the Span object, two perf_counter calls, and the
+    derived view; tree construction is genuinely off, so the overhead
+    budget test compares something real."""
+
+    __slots__ = ("sp", "_trace", "_pushed")
+
+    def __init__(self, sp: Span):
+        self.sp = sp
+        self._trace = None
+        self._pushed = False
+
+    def __enter__(self) -> Span:
+        sp = self.sp
+        if _ENABLED:
+            st = _stack()
+            if st:
+                st[-1].children.append(sp)
+            st.append(sp)
+            self._pushed = True
+        if sp.cat == "kernel":
+            self._trace = metrics.solver_trace(sp.name)
+            self._trace.__enter__()
+        sp.t0 = _perf()
+        return sp
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        sp = self.sp
+        sp.dur = _perf() - sp.t0
+        if self._trace is not None:
+            self._trace.__exit__(exc_type, exc, tb)
+        if self._pushed:
+            st = _stack()
+            # pop THIS span; a hook that raised mid-tree must not desync
+            # the stack, so tolerate (and repair) a non-top position
+            if st and st[-1] is sp:
+                st.pop()
+            elif sp in st:                 # pragma: no cover — repair path
+                while st and st[-1] is not sp:
+                    st.pop()
+                if st:
+                    st.pop()
+        global _spans_total
+        _spans_total += 1
+        if exc_type is None or sp.cat in _VIEW_ON_ERROR:
+            view = _DERIVED.get(sp.cat)
+            if view is not None:
+                view(sp)
+        if not _ENABLED or (self._pushed and not _stack()):
+            sp.children = []               # retention off / rootless: drop
+
+
+def span(name: str, cat: str = "host", **args) -> _SpanCtx:
+    """Open a child span under the current thread's tree.
+
+    ``cat`` picks the derived metric view fired at exit:
+    "phase" -> update_host_phase, "kernel" -> update_solver_kernel_duration
+    (+ jax TraceAnnotation), "action"/"plugin"/"tensorize"/"e2e" -> their
+    histogram updaters, anything else -> span-tree only ("host", "rpc",
+    "readback", "compile", "probe").
+    """
+    return _SpanCtx(Span(name, cat, args or None))
+
+
+# ---------------------------------------------------------------------
+# cycle roots
+# ---------------------------------------------------------------------
+
+def begin_cycle(cycle_id: Optional[int] = None, **args) -> Span:
+    """Open a cycle root span on this thread. Pair with end_cycle in a
+    try/finally — the scheduler needs the measured duration after exit
+    (deadline budget), which a plain with-statement can't give it."""
+    if cycle_id is not None:
+        args["cycle"] = cycle_id
+    root = Span("cycle", "cycle", args or None)
+    if _ENABLED:
+        st = _stack()
+        if st:                             # nested cycle: plain child span
+            st[-1].children.append(root)
+        st.append(root)
+    _profile_cycle_begin()
+    root.t0 = _perf()
+    return root
+
+
+def end_cycle(root: Span, **args) -> Span:
+    """Close a cycle root: stamps dur, fires the cycle hooks (flight
+    recorder ring + trace exporter), clears the thread stack."""
+    root.dur = _perf() - root.t0
+    if args:
+        root.args = dict(root.args or {}, **args)
+    st = _stack()
+    if root in st:             # not pushed at all when retention was off
+        while st and st[-1] is not root:   # a raising action left spans open
+            st.pop()
+        if st:
+            st.pop()
+    global _spans_total, _last_cycle
+    _spans_total += 1          # descendants already counted at their exit
+    _profile_cycle_end()
+    # outermost CYCLE on this thread (plain host spans around it — the
+    # loop tick — don't make it "nested"): fire the cycle hooks
+    if not any(s.cat == "cycle" for s in st):
+        _last_cycle = root
+        if _ENABLED:
+            for hook in CYCLE_HOOKS:
+                try:
+                    hook(root)
+                except Exception:          # a hook must never fail a cycle
+                    import logging
+                    logging.getLogger("kubebatch.obs").exception(
+                        "cycle hook failed")
+    return root
+
+
+class _CycleCtx:
+    __slots__ = ("root",)
+
+    def __init__(self, root: Span):
+        self.root = root
+
+    def __enter__(self) -> Span:
+        return self.root
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        end_cycle(self.root,
+                  **({"error": exc_type.__name__} if exc_type else {}))
+
+
+def cycle(cycle_id: Optional[int] = None, **args) -> _CycleCtx:
+    """``with obs.cycle(i) as root:`` — the with-statement form of
+    begin_cycle/end_cycle for callers that don't need the duration after
+    exit (bench, tests)."""
+    return _CycleCtx(begin_cycle(cycle_id, **args))
+
+
+def current_cycle() -> Optional[Span]:
+    """This thread's outermost open CYCLE span, or None."""
+    st = getattr(_TLS, "stack", None)
+    if not st:
+        return None
+    for s in st:
+        if s.cat == "cycle":
+            return s
+    return None
+
+
+def last_cycle() -> Optional[Span]:
+    """The most recently finished cycle root (any thread)."""
+    return _last_cycle
+
+
+def add_event(name: str, dur: float, cat: str = "compile", **args) -> None:
+    """Attach an already-finished interval (ending NOW) to the current
+    open span — how compilesvc's jax.monitoring listener lands XLA
+    compile events inside the cycle tree without wrapping the compiler."""
+    st = getattr(_TLS, "stack", None)
+    if not st:
+        return
+    sp = Span(name, cat, args or None)
+    sp.dur = dur
+    sp.t0 = _perf() - dur
+    st[-1].children.append(sp)
+
+
+# ---------------------------------------------------------------------
+# rpc stitching
+# ---------------------------------------------------------------------
+
+def begin_server_root(name: str = "sidecar", **args) -> Span:
+    """Per-request root for an rpc handler thread. Same mechanics as a
+    cycle root but marked remote: the exporter gives it its own pid lane
+    and end-of-request serialization ships it back to the client."""
+    root = Span(name, "remote", dict(args, remote=True))
+    _stack().append(root)
+    root.t0 = _perf()
+    return root
+
+
+def end_server_root(root: Span) -> Span:
+    root.dur = _perf() - root.t0
+    st = _stack()
+    while st and st[-1] is not root:
+        st.pop()
+    if st:
+        st.pop()
+    global _spans_total
+    _spans_total += 1          # descendants already counted at their exit
+    return root
+
+
+def graft(parent: Span, remote: Span) -> None:
+    """Attach a deserialized remote tree under ``parent`` (the client's
+    rpc span), rebasing its timestamps: the remote perf_counter base is
+    another process's, so the remote root is centered inside the parent
+    span (the unsynchronized-clock convention for one-shot RPCs — the
+    DURATIONS are measured, only the offset is aligned)."""
+    delta = (parent.t0 + max(0.0, (parent.dur - remote.dur) / 2.0)
+             - remote.t0)
+    remote.shift(delta)
+    parent.children.append(remote)
+
+
+# ---------------------------------------------------------------------
+# gated jax.profiler programmatic capture (--profile-cycles N)
+# ---------------------------------------------------------------------
+
+_profile_state = {"remaining": 0, "dir": "", "active": False}
+
+
+def arm_profile(cycles: int, directory: str) -> None:
+    """Capture a jax.profiler trace covering the next ``cycles`` cycle
+    roots into ``directory`` (the same trace dir the Chrome export uses,
+    so host spans and device timelines land side by side)."""
+    _profile_state["remaining"] = int(cycles)
+    _profile_state["dir"] = directory
+
+
+def _profile_cycle_begin() -> None:
+    ps = _profile_state
+    if ps["remaining"] > 0 and not ps["active"]:
+        try:
+            import jax.profiler as _prof
+            _prof.start_trace(ps["dir"])
+            ps["active"] = True
+        except Exception:                  # never fail a cycle for a trace
+            ps["remaining"] = 0
+
+
+def _profile_cycle_end() -> None:
+    ps = _profile_state
+    if not ps["active"]:
+        return
+    ps["remaining"] -= 1
+    if ps["remaining"] <= 0:
+        try:
+            import jax.profiler as _prof
+            _prof.stop_trace()
+        except Exception:                  # pragma: no cover
+            pass
+        ps["active"] = False
+
+
+# ---------------------------------------------------------------------
+# overhead evidence (bench.py trace_overhead_ms)
+# ---------------------------------------------------------------------
+
+_overhead_estimate: Optional[float] = None
+
+
+def span_overhead_estimate(samples: int = 2000) -> float:
+    """Measured per-span cost in SECONDS on this box (enter+exit of a
+    retention-on span), calibrated once per process. bench multiplies by
+    spans_per_cycle to report trace_overhead_ms — a calibrated estimate,
+    labeled as such, instead of doubling every hot-path timestamp to
+    self-measure."""
+    global _overhead_estimate
+    if _overhead_estimate is None:
+        with cycle(None):                  # retention on, realistic path
+            t0 = _perf()
+            for _ in range(samples):
+                with span("calib", cat="host"):
+                    pass
+            _overhead_estimate = (_perf() - t0) / samples
+    return _overhead_estimate
+
+
+def tracer_stats() -> dict:
+    """Snapshot for /debug/vars and bench lines."""
+    lc = _last_cycle
+    return {
+        "enabled": _ENABLED,
+        "spans_total": _spans_total,
+        "last_cycle_spans": lc.count() if lc is not None else 0,
+        "span_overhead_us": (round(_overhead_estimate * 1e6, 3)
+                             if _overhead_estimate is not None else None),
+    }

@@ -7,11 +7,13 @@ nonzero-request inputs, and the drf/proportion fairness seeds."""
 from __future__ import annotations
 
 import functools
-import time
+import json
 from typing import Dict, List
 
 import grpc
 import numpy as np
+
+from .. import obs
 
 from ..actions.cycle_inputs import (cycle_supported, gang_enabled,
                                     job_order_spec)
@@ -41,13 +43,18 @@ _CLIENTS: Dict[str, "SolverClient"] = {}
 #: (client-observed rtt seconds, server solve_ms) per Solve dispatch —
 #: bench.py --mode rpc diffs this to report the per-dispatch HOP cost
 #: (rtt - solve = serialization + wire + queueing, the deployment-mode
-#: overhead the sidecar charges on top of the kernel). A bounded deque:
-#: a long-running daemon with nobody reading it keeps the most RECENT
+#: overhead the sidecar charges on top of the kernel). A FIXED RING
+#: (deque maxlen), never an unbounded process-lifetime list: a
+#: long-running daemon with nobody reading it keeps the most RECENT
 #: window (first-N retention would freeze diagnostics on warmup-era
 #: samples), while bench runs clear it at start and never hit the cap.
+#: Consumers should prefer metrics.rpc_dispatch_percentiles() (p50/p99
+#: of rtt/solve/hop, also on /debug/vars) over the raw tuples.
 import collections
 
-DISPATCH_STATS = collections.deque(maxlen=4096)
+DISPATCH_STATS_CAPACITY = 4096
+
+DISPATCH_STATS = collections.deque(maxlen=DISPATCH_STATS_CAPACITY)
 
 
 def get_solver_client(target: str) -> "SolverClient":
@@ -235,16 +242,38 @@ class SolverClient:
         """The remote call alone — no session mutation. Callers that want
         a fallback path must fall back BEFORE apply_decisions runs;
         after the replay starts the session is committed to the remote
-        decisions."""
+        decisions.
+
+        Trace context travels as gRPC METADATA (cycle id + parent span
+        name) — wire *metadata*, so solver.proto and the affinity
+        WIRE_FIELDS contract are untouched — and the server ships its
+        own span tree back in trailing metadata; it is grafted under
+        this call's rpc span so sidecar solve spans stitch into the
+        client's cycle tree."""
         from ..faults import check as _fault_check
 
         # injection seam: sidecar unavailability, exercised before the
         # wire call — callers treat it exactly like a dead channel
         _fault_check("rpc.solve")
-        t0 = time.perf_counter()
-        resp = self._solve(req, timeout=timeout)
-        DISPATCH_STATS.append((time.perf_counter() - t0,
-                               float(resp.solve_ms)))
+        md = [("kb-trace-span", "rpc_solve")]
+        root = obs.current_cycle()
+        cyc = (root.args or {}).get("cycle") if root is not None else None
+        if cyc is not None:
+            md.append(("kb-trace-cycle", str(cyc)))
+        with obs.span("rpc_solve", cat="rpc") as sp:
+            resp, call = self._solve.with_call(req, timeout=timeout,
+                                               metadata=md)
+        # the span's dur is the client-observed rtt (the graft below is
+        # deliberately outside it — deserializing the remote tree is not
+        # wire time); DISPATCH_STATS keeps its (rtt s, server solve ms)
+        # ring contract for bench.py / metrics.rpc_dispatch_percentiles
+        DISPATCH_STATS.append((sp.dur, float(resp.solve_ms)))
+        try:
+            for key, value in (call.trailing_metadata() or ()):
+                if key == "kb-trace-bin":
+                    obs.graft(sp, obs.Span.from_dict(json.loads(value)))
+        except Exception:       # a malformed trace must never fail a solve
+            pass
         return resp
 
     @staticmethod

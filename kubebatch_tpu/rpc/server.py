@@ -10,13 +10,15 @@ in this image; message classes are protoc-generated into solver_pb2.py).
 """
 from __future__ import annotations
 
+import json
 import os
-import time
 from concurrent import futures
 
 import grpc
 import jax.numpy as jnp
 import numpy as np
+
+from .. import obs
 
 from ..kernels.fused import (ALLOC, ALLOC_OB, PIPELINE, SKIP,
                              K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
@@ -185,30 +187,34 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
             dyn_enabled=dyn_enabled, job_keys=tuple(job_keys),
             queue_keys=queue_keys)
 
-    start = time.perf_counter()
-    (host_block, *_device_state) = fused_allocate(
-        idle, releasing, backfilled, jnp.asarray(allocatable_cm),
-        jnp.asarray(nz_req0), mtn, ntasks, node_ok,
-        jnp.asarray(resreq), jnp.asarray(init_resreq),
-        jnp.asarray(task_nz), jnp.asarray(task_job),
-        jnp.asarray(task_rank), jnp.asarray(task_sig),
-        jnp.asarray(task_valid), jnp.asarray(sig_scores),
-        jnp.asarray(sig_pred),
-        jnp.asarray(min_av), jnp.asarray(order_min_av),
-        jnp.asarray(init_ready), jnp.asarray(job_queue),
-        jnp.asarray(job_priority), jnp.asarray(job_create_rank),
-        jnp.asarray(job_valid), jnp.asarray(q_weight),
-        jnp.asarray(q_entries), jnp.asarray(q_create_rank),
-        jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
-        jnp.asarray(j_alloc0), jnp.asarray(cluster_total),
-        jnp.asarray(dyn_weights),
-        job_keys=tuple(job_keys), queue_keys=queue_keys,
-        gang_enabled=req.gang_enabled,
-        prop_overused=req.proportion_enabled,
-        dyn_enabled=dyn_enabled,
-        max_iters=int(t_pad + 3 * j_pad + q_pad + 8))
-    solve_ms = (time.perf_counter() - start) * 1e3
-    host_block = np.asarray(host_block)   # one device->host transfer
+    # cat="host": the server-side solve wall; the update_solver_kernel
+    # histogram belongs to the CLIENT's engine accounting, not the
+    # sidecar's (solve_ms travels back on the wire as before)
+    with obs.span("solve_fused", cat="host", engine="fused") as sp:
+        (host_block, *_device_state) = fused_allocate(
+            idle, releasing, backfilled, jnp.asarray(allocatable_cm),
+            jnp.asarray(nz_req0), mtn, ntasks, node_ok,
+            jnp.asarray(resreq), jnp.asarray(init_resreq),
+            jnp.asarray(task_nz), jnp.asarray(task_job),
+            jnp.asarray(task_rank), jnp.asarray(task_sig),
+            jnp.asarray(task_valid), jnp.asarray(sig_scores),
+            jnp.asarray(sig_pred),
+            jnp.asarray(min_av), jnp.asarray(order_min_av),
+            jnp.asarray(init_ready), jnp.asarray(job_queue),
+            jnp.asarray(job_priority), jnp.asarray(job_create_rank),
+            jnp.asarray(job_valid), jnp.asarray(q_weight),
+            jnp.asarray(q_entries), jnp.asarray(q_create_rank),
+            jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
+            jnp.asarray(j_alloc0), jnp.asarray(cluster_total),
+            jnp.asarray(dyn_weights),
+            job_keys=tuple(job_keys), queue_keys=queue_keys,
+            gang_enabled=req.gang_enabled,
+            prop_overused=req.proportion_enabled,
+            dyn_enabled=dyn_enabled,
+            max_iters=int(t_pad + 3 * j_pad + q_pad + 8))
+    solve_ms = sp.dur * 1e3        # same extent the perf_counter pair had
+    with obs.span("readback", cat="readback"):
+        host_block = np.asarray(host_block)   # one device->host transfer
     task_state, task_node, task_seq, iters = unpack_host_block(host_block)
 
     resp = solver_pb2.DecisionsResponse(solve_ms=solve_ms,
@@ -335,9 +341,12 @@ def _solve_batched_wire(req, nodes, tasks, n, t, *, idle, releasing,
         pipe_enabled=bool((np.asarray(releasing)[:n] > 0).any()))
     device = _WireDevice(idle, releasing, backfilled, allocatable_cm,
                          nz_req0, ntasks, mtn, node_ok)
-    start = time.perf_counter()
-    task_state, task_node, task_seq, rounds = solve_batched(device, inputs)
-    solve_ms = (time.perf_counter() - start) * 1e3
+    # cat="host": solve_batched's own kernel span (inside) carries the
+    # update_solver_kernel view; this wrapper is the wire solve_ms extent
+    with obs.span("solve_batched", cat="host", engine="batched") as sp:
+        task_state, task_node, task_seq, rounds = solve_batched(device,
+                                                                inputs)
+    solve_ms = sp.dur * 1e3
 
     resp = solver_pb2.DecisionsResponse(solve_ms=solve_ms,
                                         iterations=int(rounds))
@@ -352,8 +361,26 @@ def _solve_batched_wire(req, nodes, tasks, n, t, *, idle, releasing,
 
 
 def _solve_handler(request: bytes, context) -> bytes:
+    """Unary handler with trace stitching: incoming gRPC metadata carries
+    the client's cycle id + parent span name; the handler runs under a
+    per-request server root span and ships the finished tree back in
+    TRAILING metadata (kb-trace-bin) for the client to graft — the wire
+    request/response schema is untouched."""
     req = solver_pb2.SnapshotRequest.FromString(request)
-    return solve_snapshot(req).SerializeToString()
+    md = {k: v for k, v in (context.invocation_metadata() or ())}
+    root = obs.begin_server_root(
+        "sidecar_solve", cycle=md.get("kb-trace-cycle"),
+        parent=md.get("kb-trace-span"))
+    try:
+        resp = solve_snapshot(req)
+    finally:
+        obs.end_server_root(root)
+        try:
+            context.set_trailing_metadata(
+                (("kb-trace-bin", json.dumps(root.to_dict()).encode()),))
+        except Exception:       # trailing trace is best-effort evidence
+            pass
+    return resp.SerializeToString()
 
 
 def make_server(address: str = "127.0.0.1:0",

@@ -20,12 +20,12 @@ allocate path, actions/allocate.py _execute_rpc).
 """
 from __future__ import annotations
 
-import time
 import uuid
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from .. import obs
 from . import solver_pb2
 
 _DTYPES = {0: np.float32, 1: np.int32, 2: np.bool_}
@@ -122,21 +122,25 @@ class VictimRegistry:
                   dyn_enabled=entry["dyn_enabled"],
                   score_nodes=entry["score_nodes"],
                   room_check=entry["room_check"])
-        start = time.perf_counter()
-        if req.wave:
-            out = run_wave_kernel(entry["static"], mut,
-                                  entry["sig"], p_res, p_resreq, p_nz,
-                                  p_sig, p_job, p_queue, **kw)
-        else:
-            out = run_visit_kernel(entry["static"], mut,
-                                   entry["sig"], p_res, p_resreq, p_nz,
-                                   p_sig.reshape(()), p_job.reshape(()),
-                                   p_queue.reshape(()),
-                                   from_tensor(req.visited), **kw)
-        packed = np.asarray(out)
+        # server-side victim solve wall (cat="host": the client's
+        # victim_wave/visit kernel span owns the histogram accounting)
+        with obs.span("victim_solve", cat="host",
+                      wave=bool(req.wave)) as sp:
+            if req.wave:
+                out = run_wave_kernel(entry["static"], mut,
+                                      entry["sig"], p_res, p_resreq, p_nz,
+                                      p_sig, p_job, p_queue, **kw)
+            else:
+                out = run_visit_kernel(entry["static"], mut,
+                                       entry["sig"], p_res, p_resreq,
+                                       p_nz,
+                                       p_sig.reshape(()),
+                                       p_job.reshape(()),
+                                       p_queue.reshape(()),
+                                       from_tensor(req.visited), **kw)
+            packed = np.asarray(out)
         return solver_pb2.VictimVisitResponse(
-            packed=to_tensor(packed),
-            solve_ms=(time.perf_counter() - start) * 1e3)
+            packed=to_tensor(packed), solve_ms=sp.dur * 1e3)
 
 
 # ---------------------------------------------------------------------

@@ -87,6 +87,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "BASELINE key (1-5, 2p/3p/5p; default: the "
                         "--sim-config). Warmed executables persist via "
                         "the managed compile cache and survive restarts.")
+    # observability (docs/OBSERVABILITY.md)
+    p.add_argument("--flight-record", nargs="?", const="flight-records",
+                   default="", metavar="DIR",
+                   help="arm the flight recorder: ring-buffer the last "
+                        "cycles' span trees + counter snapshots + ladder "
+                        "state and auto-dump to DIR on cycle failures, "
+                        "ladder demotions and chaos invariant violations "
+                        "(also armable via KUBEBATCH_FLIGHT_RECORD)")
+    p.add_argument("--trace-dir", default="", metavar="DIR",
+                   help="export every cycle's span tree as Chrome "
+                        "trace-event JSON (Perfetto-loadable) into "
+                        "DIR/trace.json, written at exit")
+    p.add_argument("--profile-cycles", type=int, default=0, metavar="N",
+                   help="with --trace-dir: additionally capture a "
+                        "jax.profiler programmatic trace covering the "
+                        "first N cycles into the same directory")
+    p.add_argument("--explain-unschedulable", action="store_true",
+                   help="run the unschedulability explainer after each "
+                        "cycle's actions (one extra device readback; "
+                        "off the steady path by default) and serve the "
+                        "snapshot on /debug/explain")
     return p
 
 
@@ -165,14 +186,31 @@ def main(argv=None) -> int:
     from ..sim import baseline_cluster
     from .scheduler import Scheduler
 
-    # /metrics endpoint (ref: server.go:138-141)
+    # observability arming (docs/OBSERVABILITY.md): flight recorder,
+    # Chrome-trace export dir, gated jax.profiler capture
+    from ..obs import export as obs_export
+    from ..obs import flight as obs_flight
+    if args.flight_record:
+        obs_flight.arm(args.flight_record)
+    else:
+        obs_flight.arm_from_env()
+    if args.trace_dir:
+        obs_export.arm(args.trace_dir)
+        if args.profile_cycles:
+            from ..obs import arm_profile
+            arm_profile(args.profile_cycles, args.trace_dir)
+
+    # /metrics endpoint (ref: server.go:138-141) — served with /healthz,
+    # /debug/vars and /debug/explain by the obs HTTP server; /metrics
+    # delegates to prometheus_client when present and degrades to a text
+    # rendering of the mirror counters when it is not
+    http_server = None
     if args.listen_address:
-        try:
-            from prometheus_client import start_http_server
-            host, _, port = args.listen_address.rpartition(":")
-            start_http_server(int(port), addr=host or "0.0.0.0")
-        except Exception as e:  # pragma: no cover
-            print(f"metrics endpoint disabled: {e}", file=sys.stderr)
+        from ..obs.http import start as start_debug_http
+        http_server = start_debug_http(args.listen_address)
+        if http_server is None:
+            print(f"metrics endpoint disabled: could not bind "
+                  f"{args.listen_address}", file=sys.stderr)
 
     cache = SchedulerCache(scheduler_name=args.scheduler_name,
                            default_queue=args.default_queue)
@@ -195,7 +233,8 @@ def main(argv=None) -> int:
     sched = Scheduler(cache, scheduler_conf=conf_str,
                       schedule_period=args.schedule_period,
                       enable_preemption=args.enable_preemption,
-                      cycle_deadline=args.cycle_deadline)
+                      cycle_deadline=args.cycle_deadline,
+                      explain_unschedulable=args.explain_unschedulable)
 
     stop = threading.Event()
 
@@ -251,6 +290,10 @@ def main(argv=None) -> int:
         lease.run(run_workload, fatal, stop)
     else:
         run_workload(threading.Event())
+    if args.trace_dir:
+        written = obs_export.flush()
+        if written:
+            print(f"trace written to {written}", file=sys.stderr)
     if cycle_outcome["ran"] and cycle_outcome["failed"] == cycle_outcome["ran"]:
         print(f"all {cycle_outcome['ran']} scheduling cycles failed",
               file=sys.stderr)

@@ -11,18 +11,17 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from typing import List, Optional, Tuple
 
 log = logging.getLogger("kubebatch")
 
 from .. import actions as _actions  # noqa: F401  (self-registration)
 from .. import faults as _faults
+from .. import obs as _obs
 from .. import plugins as _plugins  # noqa: F401  (self-registration)
 from ..conf import SchedulerConfiguration, Tier, parse_scheduler_conf
 from ..framework import (Action, CloseSession, OpenSession, get_action)
-from ..metrics import (count_cycle_failure, update_action_duration,
-                       update_e2e_duration)
+from ..metrics import count_cycle_failure
 
 DEFAULT_SCHEDULER_CONF = """
 actions: "allocate, backfill"
@@ -59,7 +58,8 @@ class Scheduler:
     def __init__(self, cache, scheduler_conf: str = "",
                  schedule_period: float = 1.0,
                  enable_preemption: bool = False,
-                 cycle_deadline: Optional[float] = None):
+                 cycle_deadline: Optional[float] = None,
+                 explain_unschedulable: bool = False):
         self.cache = cache
         self.schedule_period = schedule_period
         self.enable_preemption = enable_preemption
@@ -80,6 +80,12 @@ class Scheduler:
         #: "deadline") — a deadline overrun is a SLOW cycle, not a
         #: broken one
         self.last_cycle_failure: Optional[str] = None
+        #: opt-in unschedulability explainer (obs/explain.py): one extra
+        #: readback per cycle when on, /debug/explain serves the snapshot
+        self.explain_unschedulable = explain_unschedulable
+        #: monotonically increasing cycle id stamped on each cycle root
+        #: span (and propagated over the rpc hop as trace context)
+        self._cycle_seq = -1
 
     @staticmethod
     def _load_conf(conf_str: str):
@@ -112,11 +118,10 @@ class Scheduler:
         gc.disable()
         try:
             while not stop.is_set():
-                start = time.perf_counter()
-                self.run_cycle()
-                gc.collect()
-                elapsed = time.perf_counter() - start
-                stop.wait(max(0.0, self.schedule_period - elapsed))
+                with _obs.span("loop_tick", cat="host") as tick:
+                    self.run_cycle()
+                    gc.collect()
+                stop.wait(max(0.0, self.schedule_period - tick.dur))
         finally:
             if gc_was_enabled:
                 gc.enable()
@@ -148,23 +153,31 @@ class Scheduler:
         finite-cycle exit code treats everything but "exception" as
         slow-but-working)."""
         from ..metrics import recompiles_total
+        from ..obs import flight as _flight
 
         self.last_cycle_failure = None
         recompiles0 = recompiles_total()
-        start = time.perf_counter()
+        self._cycle_seq += 1
+        root = _obs.begin_cycle(self._cycle_seq,
+                                ladder=self.ladder.level)
         try:
             self.run_once()
         except Exception:
             # a failed cycle must not kill the loop (run_once guarantees
             # CloseSession ran: statements rolled back, status written,
             # snapshot adopted — the session did not leak)
+            _obs.end_cycle(root, failed="exception")
             log.exception("scheduling cycle failed; loop continues "
                           "(ladder level %d)", self.ladder.level)
             count_cycle_failure("exception")
             self.last_cycle_failure = "exception"
             self.ladder.record_failure()
+            # the failing cycle's span tree is IN the ring the dump
+            # writes — end_cycle above ran before the dump trigger
+            _flight.maybe_dump_on_failure("exception")
             return False
-        elapsed = time.perf_counter() - start
+        _obs.end_cycle(root)
+        elapsed = root.dur
         recompiled = recompiles_total() - recompiles0
         if self.cycle_deadline is not None and elapsed > self.cycle_deadline:
             reason = "recompile" if recompiled else "deadline"
@@ -176,6 +189,7 @@ class Scheduler:
             count_cycle_failure(reason)
             self.last_cycle_failure = reason
             self.ladder.record_failure()
+            _flight.maybe_dump_on_failure(reason)
             return False
         if recompiled:
             # inside budget but still unexpected: surface it — the next
@@ -190,25 +204,44 @@ class Scheduler:
     def run_once(self) -> None:
         """One scheduling cycle (ref: scheduler.go:88-105). CloseSession is
         guaranteed even when an action throws (the reference defers it) so
-        status write-back happens and the loop survives."""
-        start = time.perf_counter()
-        ssn = OpenSession(self.cache, self.tiers, self.enable_preemption)
-        jobs, nodes = len(ssn.jobs), len(ssn.nodes)
+        status write-back happens and the loop survives. Timing routes
+        through obs spans: the session span is the e2e histogram's source,
+        each action span feeds action_scheduling_latency."""
+        jobs = nodes = None
+        session_span = None
         try:
-            for action in self.actions:
-                action.initialize()
-                action_start = time.perf_counter()
-                action.execute(ssn)
-                action_dur = time.perf_counter() - action_start
-                update_action_duration(action.name, action_dur)
-                log.debug("action %s took %.2fms", action.name,
-                          1e3 * action_dur)
-                action.uninitialize()
+            with _obs.span("session", cat="e2e") as session_span:
+                ssn = OpenSession(self.cache, self.tiers,
+                                  self.enable_preemption)
+                jobs, nodes = len(ssn.jobs), len(ssn.nodes)
+                try:
+                    for action in self.actions:
+                        action.initialize()
+                        with _obs.span(action.name, cat="action") as asp:
+                            action.execute(ssn)
+                        log.debug("action %s took %.2fms", action.name,
+                                  1e3 * asp.dur)
+                        action.uninitialize()
+                    if self.explain_unschedulable:
+                        # opt-in debug pass (ISSUE 7): one extra readback,
+                        # published to /debug/explain — NEVER on by
+                        # default, and guarded: a diagnostic must not
+                        # fail the cycle (decisions are already applied)
+                        # or feed the degradation ladder
+                        from ..obs import explain as _explain
+                        try:
+                            with _obs.span("explain", cat="host"):
+                                _explain.explain_session(ssn)
+                        except Exception:
+                            log.exception("unschedulability explainer "
+                                          "failed; cycle unaffected")
+                finally:
+                    CloseSession(ssn)
         finally:
-            CloseSession(ssn)
-            elapsed = time.perf_counter() - start
-            update_e2e_duration(elapsed)
-            # the glog V(2)-style cycle line (ref: scheduler.go:92 metric;
-            # verbosity wired by the CLI --v flag)
-            log.info("scheduling cycle: %d jobs / %d nodes in %.2fms",
-                     jobs, nodes, 1e3 * elapsed)
+            # the glog V(2)-style cycle line (ref: scheduler.go:92
+            # metric; verbosity wired by the CLI --v flag) — emitted on
+            # raising cycles too (the session span has closed by now,
+            # so its dur is final), exactly like the old finally did
+            if jobs is not None:
+                log.info("scheduling cycle: %d jobs / %d nodes in %.2fms",
+                         jobs, nodes, 1e3 * session_span.dur)

@@ -156,6 +156,10 @@ class ChaosReport:
     pods_bound: int = 0
     lease_lost: bool = False
     lease_renew_attempts: int = 0
+    #: unschedulability-explainer lines for pods still pending after the
+    #: quiesce window (obs/explain.py) — the sim-summary form of
+    #: kube-batch's per-pod Unschedulable events
+    explain: List[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -340,6 +344,7 @@ def run_chaos(cycles: int = 200, seed: int = 0,
                     pods_by_uid[pod.uid] = pod
 
         def check_invariants(where: str) -> None:
+            before = len(report.violations)
             with cache._lock:
                 problems = audit_cache(cache)
             for p in problems:
@@ -362,6 +367,12 @@ def run_chaos(cycles: int = 200, seed: int = 0,
                     f"({node_cpu:.3f}m, {node_mem:.0f}B)")
             report.violations.extend(
                 f"{where}: {v}" for v in seams.take_violations())
+            if len(report.violations) > before:
+                # a violated invariant is exactly what the flight
+                # recorder exists for: dump the last cycles' span trees
+                # + counters + ladder state (no-op unless armed)
+                from ..obs import flight as _flight
+                _flight.dump(f"chaos_invariant-{where.split(':')[0]}")
 
         # ---- the soak loop -----------------------------------------
         plan = faults.FaultPlan(rates=rates, seed=seed)
@@ -440,15 +451,32 @@ def run_chaos(cycles: int = 200, seed: int = 0,
         with cache._lock:
             cache_uids = {uid for j in cache.jobs.values()
                           for uid in j.tasks}
+        never_bound = 0
         for uid, pod in pods_by_uid.items():
             if uid not in cache_uids:
                 report.violations.append(
                     f"task lost: {pod.namespace}/{pod.name} in ground "
                     f"truth but absent from the cache")
             if not pod.node_name:
+                never_bound += 1
                 report.violations.append(
                     f"task never bound after quiesce: "
                     f"{pod.namespace}/{pod.name}")
+        if never_bound:
+            # the sim-summary form of kube-batch's per-pod Unschedulable
+            # events: WHY are those pods still pending (host-oracle pass;
+            # a broken soak must not depend on another device dispatch)
+            try:
+                from ..framework import CloseSession, OpenSession
+                from ..obs import explain as _explain
+                ssn = OpenSession(cache, sched.tiers)
+                snap = _explain.explain_session(ssn, device_pass=False)
+                CloseSession(ssn)
+                report.explain = _explain.summarize(snap)
+                for line in report.explain:
+                    log.warning("explain: %s", line)
+            except Exception:      # diagnostics must not mask the soak
+                log.exception("unschedulability explainer failed")
         report.lease_renew_attempts = elector.renew_attempts
         report.lease_lost = bool(lease_lost)
         if lease_lost:

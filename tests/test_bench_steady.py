@@ -9,7 +9,8 @@ import bench
 
 def test_run_steady_small_config():
     (latencies, bound, action_ms, readbacks, rss_mb, engines,
-     recompiles) = bench.run_steady(2, 2, "auto", 16)
+     recompiles, span_counts, trace_roots) = bench.run_steady(
+        2, 2, "auto", 16)
     assert engines and all(e for e in engines)
     assert len(latencies) == 2
     assert bound == 32          # 16 churn pods per measured cycle
@@ -19,6 +20,11 @@ def test_run_steady_small_config():
     # the in-run warm-up cycles must leave the measured window compile-
     # free — the recompiles==0 invariant the steady evidence lines pin
     assert recompiles == 0
+    # the span-tree evidence rides every measured cycle (ISSUE 7):
+    # one cycle root per measured cycle, each with a real tree under it
+    assert len(span_counts) == 2 and all(c > 5 for c in span_counts)
+    assert len(trace_roots) == 2
+    assert all(r.cat == "cycle" for r in trace_roots)
 
 
 def test_bench_main_one_json_line(capsys):
@@ -58,7 +64,7 @@ def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
         # the primary line must already be visible at this point
         steady_ran["primary_first"] = capsys.readouterr().out.strip()
         return ([0.05] * 5, 1280, {"allocate": 40.0}, [1, 1, 1, 1, 1],
-                100.0, ["batched"], 0)
+                100.0, ["batched"], 0, [20] * 5, [])
 
     monkeypatch.setattr(bench, "run_steady", fake_steady)
     rc = bench.main(["--config", "5", "--cycles", "2"])

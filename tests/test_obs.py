@@ -1,0 +1,513 @@
+"""Observability subsystem (ISSUE 7): span tracer, flight recorder,
+unschedulability explainer, debug HTTP endpoints, overhead budget.
+
+What the pins mean:
+
+- the span TREE is the new evidence surface, but the OLD accounting
+  (host_phase_seconds, solver_kernel_seconds, rpc solve_ms, the
+  blocking-readback budget) must be derivable from it and match the
+  accumulators exactly — the migration replaced the timing sites, it
+  must not have changed what they measure;
+- the flight recorder's dump triggers are exercised through the round-8
+  fault-injection registry (faults.py), not by calling dump() by hand;
+- the explainer's device pass is pinned bit-equal to the numpy host
+  oracle and to EXACTLY one extra blocking readback;
+- tracing is always-on: the budget test pins the A/B p50 delta and the
+  calibrated per-span cost so a regression in the tracer's hot path
+  fails structurally.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, faults, obs, plugins  # noqa: F401
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.metrics import (blocking_readbacks, counters_snapshot,
+                                   host_phase_seconds,
+                                   rpc_dispatch_percentiles,
+                                   solver_kernel_seconds)
+from kubebatch_tpu.obs import explain as obs_explain
+from kubebatch_tpu.obs import export as obs_export
+from kubebatch_tpu.obs import flight as obs_flight
+from kubebatch_tpu.runtime.scheduler import Scheduler
+from kubebatch_tpu.sim import baseline_cluster
+
+from .fixtures import (GiB, build_group, build_node, build_pod,
+                       build_queue, rl)
+from kubebatch_tpu.objects import PodPhase
+
+
+class _Binder:
+    def __init__(self):
+        self.bound = {}
+
+    def bind(self, pod, hostname):
+        self.bound[pod.uid] = hostname
+        pod.node_name = hostname
+
+    def evict(self, pod):
+        pod.deletion_timestamp = 1.0
+
+
+def _sim_cache(config=1):
+    sim = baseline_cluster(config)
+    seam = _Binder()
+    cache = SchedulerCache(binder=seam, evictor=seam,
+                          async_writeback=False)
+    sim.populate(cache)
+    return cache, seam
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Every test starts disarmed and retention-on; faults reset too."""
+    obs.set_enabled(True)
+    yield
+    obs.set_enabled(True)
+    obs_flight.disarm()
+    obs_export.disarm()
+    faults.reset()
+    obs_explain.set_latest(None)
+
+
+# ---------------------------------------------------------------------
+# span-tree shape + derived views
+# ---------------------------------------------------------------------
+
+def test_cycle_span_tree_shape():
+    """cycle -> session -> action -> phase -> kernel -> readback, with
+    the phases and the one blocking readback exactly where the model
+    says they are."""
+    cache, _ = _sim_cache(1)
+    sched = Scheduler(cache, schedule_period=0.01)
+    assert sched.run_cycle()
+    root = obs.last_cycle()
+    assert root is not None and root.cat == "cycle"
+    session = root.find("session")
+    assert session is not None and session.cat == "e2e"
+    alloc = session.find("allocate")
+    assert alloc is not None and alloc.cat == "action"
+    for phase in ("open", "close"):
+        sp = session.find(phase)
+        assert sp is not None and sp.cat == "phase", phase
+    assert alloc.find("tensorize") is not None
+    assert alloc.find("replay") is not None
+    kernels = [c for c in alloc.children if c.cat == "kernel"]
+    assert kernels, "allocate dispatched no kernel span"
+    readbacks = [c for c in kernels[0].children if c.cat == "readback"]
+    assert readbacks, "kernel span carries no readback child"
+    # parent extents contain their children (same clock, same thread)
+    assert session.t0 >= root.t0
+    assert session.t0 + session.dur <= root.t0 + root.dur + 1e-6
+
+
+def test_derived_views_match_span_tree():
+    """The accumulators the benches pin (host_phase_seconds,
+    solver_kernel_seconds) must equal the sums over the span tree —
+    the old accounting IS a view over spans now."""
+    cache, _ = _sim_cache(1)
+    sched = Scheduler(cache, schedule_period=0.01)
+    hp0 = host_phase_seconds()
+    ks0 = solver_kernel_seconds()
+    assert sched.run_cycle()
+    root = obs.last_cycle()
+    hp1 = host_phase_seconds()
+    ks1 = solver_kernel_seconds()
+
+    def tree_sum(sp, cat, name=None, acc=None):
+        acc = [] if acc is None else acc
+        if sp.cat == cat and (name is None or sp.name == name):
+            acc.append(sp.dur)
+        for c in sp.children:
+            tree_sum(c, cat, name, acc)
+        return acc
+
+    for phase in ("open", "tensorize", "replay", "close"):
+        delta = hp1.get(phase, 0.0) - hp0.get(phase, 0.0)
+        spans = sum(tree_sum(root, "phase", phase))
+        assert delta == pytest.approx(spans, abs=1e-9), phase
+    kernel_delta = ks1 - ks0
+    kernel_spans = sum(tree_sum(root, "kernel"))
+    assert kernel_delta == pytest.approx(kernel_spans, abs=1e-9)
+
+
+def test_rootless_spans_feed_views_without_retention():
+    """bench drives sessions without the scheduler loop: spans with no
+    open cycle root still update the accumulators and never accumulate
+    tree memory."""
+    hp0 = host_phase_seconds().get("tensorize", 0.0)
+    with obs.span("tensorize", cat="phase"):
+        time.sleep(0.001)
+    assert host_phase_seconds()["tensorize"] > hp0
+    assert obs.current_cycle() is None
+
+
+# ---------------------------------------------------------------------
+# rpc hop: context propagation + server-tree grafting
+# ---------------------------------------------------------------------
+
+def test_rpc_span_parenting_across_hop():
+    from kubebatch_tpu.rpc.client import get_solver_client
+    from kubebatch_tpu.rpc.server import make_server
+
+    server, port = make_server("127.0.0.1:0")
+    server.start()
+    try:
+        cache, _ = _sim_cache(1)
+        ssn = OpenSession(cache, shipped_tiers())
+        client = get_solver_client(f"127.0.0.1:{port}")
+        with obs.cycle(77) as root:
+            resp = client.solve_and_apply(ssn)
+        CloseSession(ssn)
+    finally:
+        server.stop(grace=None)
+    rpc_span = root.find("rpc_solve")
+    assert rpc_span is not None and rpc_span.cat == "rpc"
+    sidecar = root.find("sidecar_solve")
+    assert sidecar is not None, "server span tree did not stitch in"
+    assert sidecar in rpc_span.children
+    # the trace context travelled as metadata: the server recorded the
+    # client's cycle id and parent span name
+    assert sidecar.args.get("cycle") == "77"
+    assert sidecar.args.get("parent") == "rpc_solve"
+    assert sidecar.args.get("remote") is True
+    # the server-side solve span is inside the grafted subtree, and the
+    # wire solve_ms is derived from it (same number both ways)
+    solve = sidecar.find("solve_fused") or sidecar.find("solve_batched")
+    assert solve is not None
+    assert resp.solve_ms == pytest.approx(solve.dur * 1e3, rel=1e-6)
+    # rebased inside the client's rpc span, duration preserved
+    assert sidecar.t0 >= rpc_span.t0
+    assert sidecar.dur <= rpc_span.dur + 1e-6
+
+
+def test_dispatch_stats_percentiles_exposed():
+    from kubebatch_tpu.rpc import client as rpc_client
+
+    rpc_client.DISPATCH_STATS.clear()
+    for i in range(100):
+        rpc_client.DISPATCH_STATS.append((0.010 + i * 1e-4, 5.0 + i * 0.1))
+    pct = rpc_dispatch_percentiles()
+    assert pct["dispatches"] == 100
+    assert pct["rtt_ms_p50"] == pytest.approx(15.0, rel=0.05)
+    assert pct["rtt_ms_p99"] >= pct["rtt_ms_p50"]
+    assert pct["hop_ms_p50"] == pytest.approx(
+        pct["rtt_ms_p50"] - pct["solve_ms_p50"], abs=0.5)
+    # the ring is bounded: a long-running daemon cannot grow it
+    assert rpc_client.DISPATCH_STATS.maxlen == \
+        rpc_client.DISPATCH_STATS_CAPACITY
+    assert "rpc_dispatch" in counters_snapshot()
+    rpc_client.DISPATCH_STATS.clear()
+
+
+# ---------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------
+
+def test_flight_recorder_dump_via_fault_seam(tmp_path):
+    """A mid-cycle injected fault (round-8 registry, device.dispatch
+    seam) fails the guarded cycle; the recorder must auto-dump a
+    self-contained artifact holding the FAILING cycle's span tree, the
+    counter snapshot, and the ladder state."""
+    obs_flight.arm(str(tmp_path), capacity=8)
+    cache, _ = _sim_cache(1)
+    sched = Scheduler(cache, schedule_period=0.01)
+    assert sched.run_cycle()          # a healthy cycle lands in the ring
+    # the seam only crosses when a dispatch happens — fresh pending work
+    cache2, _ = _sim_cache(1)
+    sched2 = Scheduler(cache2, schedule_period=0.01)
+    faults.arm(faults.FaultPlan(counts={"device.dispatch": 1}))
+    assert not sched2.run_cycle()     # the injected fault fails the cycle
+    faults.disarm()
+    dumps = sorted(tmp_path.glob("flightrec-*.json"))
+    assert dumps, "cycle failure produced no flight-recorder dump"
+    doc = json.loads(dumps[0].read_text())
+    assert doc["reason"].startswith("cycle_failure")
+    assert doc["cycles"], "dump carries no cycles"
+    last = doc["cycles"][-1]
+    assert last["spans"]["cat"] == "cycle"
+    assert last["spans"].get("args", {}).get("failed") == "exception"
+    assert "cycle_failures_total" in last["counters"]
+    assert "blocking_readbacks" in last["counters"]
+    assert last["ladder"]["level_name"] in faults.LADDER_LEVELS
+    # the armed plan's injected census rides along
+    assert doc["counters"]["fault_injected_total"].get(
+        "device.dispatch", 0) >= 1
+
+
+def test_flight_recorder_dump_on_ladder_demotion(tmp_path):
+    obs_flight.arm(str(tmp_path))
+    # demote_after consecutive failures demote the ladder -> hook fires;
+    # each failing cycle needs fresh pending work for the seam to cross
+    faults.arm(faults.FaultPlan(
+        counts={"device.dispatch": faults.LADDER.demote_after}))
+    for _ in range(faults.LADDER.demote_after):
+        cache, _ = _sim_cache(1)
+        sched = Scheduler(cache, schedule_period=0.01)
+        assert not sched.run_cycle()
+    faults.disarm()
+    reasons = [json.loads(p.read_text())["reason"]
+               for p in tmp_path.glob("flightrec-*.json")]
+    assert any(r.startswith("ladder_demotion") for r in reasons), reasons
+    faults.LADDER.reset()
+
+
+def test_flight_recorder_unarmed_is_free(tmp_path):
+    """Disarmed, the recorder registers no cycle hook at all."""
+    from kubebatch_tpu.obs.spans import CYCLE_HOOKS
+
+    assert obs_flight._on_cycle not in CYCLE_HOOKS
+    assert obs_flight.dump("manual") is None
+
+
+# ---------------------------------------------------------------------
+# unschedulability explainer
+# ---------------------------------------------------------------------
+
+def _infeasible_cache():
+    """A mix where every unschedulability reason class fires: an
+    oversized gang (resources), a cordoned-node selector... kept simple:
+    2 nodes, one cordoned; pods that fit, pods that can't anywhere."""
+    cache = SchedulerCache(binder=_Binder(), async_writeback=False)
+    cache.add_queue(build_queue("q", 1))
+    cache.add_node(build_node("n0", rl(4000, 8 * GiB, pods=10)))
+    cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=10),
+                              unschedulable=True))
+    cache.add_pod_group(build_group("ns", "fits", 1, "q"))
+    cache.add_pod_group(build_group("ns", "huge", 1, "q"))
+    cache.add_pod(build_pod("ns", "ok-0", "", PodPhase.PENDING,
+                            rl(500, GiB), group="fits"))
+    for i in range(3):
+        cache.add_pod(build_pod("ns", f"huge-{i}", "", PodPhase.PENDING,
+                                rl(64000, 64 * GiB), group="huge"))
+    return cache
+
+
+def test_explainer_device_matches_host_oracle_cfg2():
+    """cfg2p mix (predicates + affinity + ports in play): the device
+    reduction's counts must equal the numpy host oracle bit-for-bit,
+    and cost exactly ONE extra blocking readback."""
+    from kubebatch_tpu.actions.cycle_inputs import build_cycle_inputs
+
+    cache, _ = _sim_cache("2p")
+    ssn = OpenSession(cache, shipped_tiers())
+    inputs = build_cycle_inputs(ssn, allow_affinity=True)
+    assert inputs is not None and inputs.affinity is not None
+    rb0 = blocking_readbacks()
+    d_counts, d_elig, d_cand = obs_explain.failure_counts_device(inputs)
+    assert blocking_readbacks() - rb0 == 1, \
+        "the explainer must add exactly one readback"
+    h_counts, h_elig, h_cand = obs_explain.failure_counts_host(inputs)
+    assert d_cand == h_cand
+    assert np.array_equal(d_counts, h_counts)
+    assert np.array_equal(d_elig, h_elig)
+    # folding both yields the same structured reasons
+    d_snap = obs_explain.fold_reasons(inputs, d_counts, d_elig, d_cand)
+    h_snap = obs_explain.fold_reasons(inputs, h_counts, h_elig, h_cand)
+    d_snap.pop("ts"), h_snap.pop("ts")
+    assert d_snap == h_snap
+    CloseSession(ssn)
+
+
+def test_explainer_reasons_on_infeasible_mix():
+    cache = _infeasible_cache()
+    cache.wait_for_cache_sync()
+    ssn = OpenSession(cache, shipped_tiers())
+    snap = obs_explain.explain_session(ssn)
+    CloseSession(ssn)
+    assert snap["pending_tasks"] == 4
+    assert snap["unschedulable_tasks"] == 3
+    # only n0 is a candidate (n1 cordoned): the huge gang fails
+    # "resources" on ALL candidate nodes — the kube-batch-event analogue
+    huge = next(r for r in snap["jobs"] if r["job"] == "ns/huge")
+    assert huge["reasons"] == {"resources": 3}
+    assert snap["candidate_nodes"] == 1
+    lines = obs_explain.summarize(snap)
+    assert any("3 tasks failed resources on all candidate nodes" in ln
+               for ln in lines), lines
+    # the pass published the /debug/explain snapshot
+    assert obs_explain.latest() is snap
+
+
+def test_explainer_off_by_default():
+    # identical infeasible clusters (pending tasks REMAIN after the
+    # actions — the regime the explainer exists for) for both arms
+    cache = _infeasible_cache()
+    cache.wait_for_cache_sync()
+    sched = Scheduler(cache, schedule_period=0.01)
+    rb0 = blocking_readbacks()
+    assert sched.run_cycle()
+    baseline = blocking_readbacks() - rb0
+    assert obs_explain.latest() is None       # never ran
+    # opt in: exactly one more readback than the plain cycle
+    cache2 = _infeasible_cache()
+    cache2.wait_for_cache_sync()
+    sched2 = Scheduler(cache2, schedule_period=0.01,
+                       explain_unschedulable=True)
+    rb1 = blocking_readbacks()
+    assert sched2.run_cycle()
+    assert blocking_readbacks() - rb1 == baseline + 1
+    assert obs_explain.latest() is not None
+    assert obs_explain.latest()["unschedulable_tasks"] == 3
+    root = obs.last_cycle()
+    assert root.find("explain") is not None
+
+
+# ---------------------------------------------------------------------
+# chrome trace export
+# ---------------------------------------------------------------------
+
+def test_chrome_trace_export_valid(tmp_path):
+    out = str(tmp_path / "trace")
+    obs_export.arm(out)
+    cache, _ = _sim_cache(1)
+    sched = Scheduler(cache, schedule_period=0.01)
+    assert sched.run_cycle()
+    assert sched.run_cycle()
+    path = obs_export.flush()
+    assert path and os.path.exists(path)
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    assert len(events) > 10
+    for ev in events:
+        assert ev["ph"] == "X"
+        assert set(ev) >= {"name", "cat", "ts", "dur", "pid", "tid"}
+        assert ev["dur"] >= 0.0
+    assert {e["name"] for e in events} >= {"cycle", "session", "open",
+                                           "close", "allocate"}
+    # two cycles were buffered
+    assert sum(1 for e in events if e["name"] == "cycle") == 2
+
+
+# ---------------------------------------------------------------------
+# http endpoints
+# ---------------------------------------------------------------------
+
+def test_debug_http_endpoints():
+    from kubebatch_tpu.obs.http import DebugHTTPServer
+
+    srv = DebugHTTPServer("127.0.0.1", 0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        health = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read())
+        assert health["status"] == "ok"
+        assert "degradation_level" in health
+        varz = json.loads(urllib.request.urlopen(
+            base + "/debug/vars", timeout=10).read())
+        for key in ("cycle_failures_total", "blocking_readbacks",
+                    "compile_ms_total", "recompiles_total",
+                    "host_phase_seconds", "tracer"):
+            assert key in varz, key
+        exp = json.loads(urllib.request.urlopen(
+            base + "/debug/explain", timeout=10).read())
+        assert exp == {"enabled": False, "hint": exp.get("hint")}
+        obs_explain.set_latest({"pending_tasks": 7, "jobs": []})
+        exp = json.loads(urllib.request.urlopen(
+            base + "/debug/explain", timeout=10).read())
+        assert exp["pending_tasks"] == 7
+        # /metrics answers whatever the prometheus situation is
+        metrics_body = urllib.request.urlopen(
+            base + "/metrics", timeout=10).read()
+        assert metrics_body
+        missing = urllib.request.urlopen(base + "/nope", timeout=10)
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------
+# the overhead budget
+# ---------------------------------------------------------------------
+
+def test_tracing_overhead_budget_and_readback_pin():
+    """Same-box A/B over one persistent cluster: tracing-on cycles vs
+    tracing-off cycles (set_enabled(False): no stack, no tree),
+    interleaved so box drift cancels. Pins:
+
+    - blocking_readbacks per cycle IDENTICAL between the two arms;
+    - wall regression within 2% (1 ms absolute floor), compared on the
+      per-arm MINIMUM — tracer overhead is a constant per-cycle cost so
+      it shifts the minimum as much as any percentile, and the minimum
+      is immune to the scheduler/GC jitter that makes a 10-sample p50
+      flaky on a ~5 ms test cycle (the 2%-of-p50 acceptance claim is
+      measured at bench scale, where a cfg5 cycle is ~70 ms);
+    - the calibrated per-span cost times the observed spans/cycle stays
+      under 2% of the measured p50 — the structural form of the budget,
+      immune to wall noise entirely.
+    """
+    cache, _ = _sim_cache(2)
+    tiers = shipped_tiers()
+    sched = Scheduler(cache, schedule_period=0.01)
+    for _ in range(2):                    # compile + settle, unmeasured
+        sched.run_cycle()
+
+    arms = {True: {"lat": [], "rb": []}, False: {"lat": [], "rb": []}}
+    span_counts = []
+    for i in range(20):
+        enabled = (i % 2 == 0)
+        obs.set_enabled(enabled)
+        rb0 = blocking_readbacks()
+        t0 = time.perf_counter()
+        assert sched.run_cycle()
+        arms[enabled]["lat"].append(time.perf_counter() - t0)
+        arms[enabled]["rb"].append(blocking_readbacks() - rb0)
+        if enabled:
+            span_counts.append(obs.last_cycle().count())
+    obs.set_enabled(True)
+
+    assert arms[True]["rb"] == arms[False]["rb"], \
+        "tracing changed the blocking-readback count"
+    p50_on = float(np.percentile(arms[True]["lat"], 50))
+    p50_off = float(np.percentile(arms[False]["lat"], 50))
+    min_on = min(arms[True]["lat"])
+    min_off = min(arms[False]["lat"])
+    budget = max(0.02 * min_off, 1e-3)
+    assert min_on - min_off <= budget, (
+        f"tracing-on min {min_on * 1e3:.3f}ms vs off "
+        f"{min_off * 1e3:.3f}ms exceeds the budget {budget * 1e3:.3f}ms "
+        f"(p50: {p50_on * 1e3:.3f} vs {p50_off * 1e3:.3f}ms)")
+    # structural bound: measured span cost x spans/cycle < 2% of p50
+    per_span = obs.span_overhead_estimate()
+    spans_per_cycle = float(np.mean(span_counts))
+    assert per_span < 25e-6, f"span enter/exit costs {per_span * 1e6:.1f}us"
+    assert spans_per_cycle * per_span <= 0.02 * max(p50_on, 1e-3), (
+        f"{spans_per_cycle:.0f} spans x {per_span * 1e6:.1f}us is over "
+        f"2% of the {p50_on * 1e3:.2f}ms cycle")
+
+
+def test_spans_total_counts_each_span_once():
+    """Regression: end_cycle must not re-count descendants that already
+    incremented the counter at their own exit."""
+    t0 = obs.spans_total()
+    with obs.cycle(9):
+        with obs.span("a"):
+            with obs.span("b"):
+                pass
+    assert obs.spans_total() - t0 == 3
+
+
+def test_span_exception_safety():
+    """A raising action must leave no dangling spans on the thread stack
+    (the next cycle's tree must be clean)."""
+    with pytest.raises(RuntimeError):
+        with obs.cycle(1):
+            with obs.span("boom", cat="action"):
+                raise RuntimeError("x")
+    assert obs.current_cycle() is None
+    root = obs.begin_cycle(2)
+    try:
+        with obs.span("fine", cat="host"):
+            pass
+    finally:
+        obs.end_cycle(root)
+    assert [c.name for c in root.children] == ["fine"]

@@ -29,7 +29,11 @@ $B 1800 python bench.py --config 5                      # cold + steady extra
 $B 1800 python bench.py --config 5p                     # predicate-rich stress
 $B 1200 python bench.py --config 3p                     # MXU-claim mid-scale
 $B 1200 python bench.py --config 2p
-$B 1200 python bench.py --config 5 --steady 256 --cycles 9
+# one steady line carries a span-trace artifact (Chrome trace-event
+# JSON, Perfetto-loadable; the line records the path as trace_file and
+# the tracing cost as spans_per_cycle/trace_overhead_ms)
+$B 1200 python bench.py --config 5 --steady 256 --cycles 9 \
+    --trace-export BENCH_trace_cfg5_steady.json
 $B 1200 python bench.py --config 5 --steady 256 --cycles 9 --steady-skew
 $B 1200 python bench.py --config 4
 $B 1200 python bench.py --config 4 --steady 256 --cycles 9
