@@ -173,19 +173,24 @@ class AllocateAction(Action):
         not be re-solved by another engine on inconsistent state)."""
         import logging
 
-        from ..rpc.client import get_solver_client
-        from ..rpc.victims_wire import (breaker_open, clear_breaker,
-                                        trip_breaker)
+        from ..rpc.client import (AdmissionRejected, current_tenant,
+                                  get_solver_client)
+        from ..rpc.victims_wire import (breaker_open, breaker_target,
+                                        clear_breaker, trip_breaker)
 
         addr = os.environ.get("KUBEBATCH_SOLVER_ADDR", "127.0.0.1:50061")
-        if breaker_open(addr):
+        tenant = current_tenant()
+        target = breaker_target(addr, tenant)
+        if breaker_open(target):
             # the sidecar failed recently (process-wide breaker shared
-            # with the victim path): go straight in-process, re-probe
-            # after the cooldown — a wedged sidecar must not stall every
-            # cycle on the rpc deadline
+            # with the victim path, keyed per (address, tenant)): go
+            # straight in-process, re-probe after the cooldown — a
+            # wedged sidecar must not stall every cycle on the rpc
+            # deadline, and one tenant's quarantine must not block its
+            # in-process neighbors
             return False
         try:
-            client = get_solver_client(addr)
+            client = get_solver_client(addr, tenant=tenant)
             req, tasks_by_uid = client.snapshot_from_session(ssn)
         except ValueError:
             # snapshot exceeds the sidecar vocabulary — known, quiet
@@ -194,21 +199,30 @@ class AllocateAction(Action):
             logging.getLogger("kubebatch").warning(
                 "solver sidecar %s unavailable (%s); running in-process",
                 addr, e)
-            trip_breaker(addr)
+            trip_breaker(target)
             return False
         try:
             resp = client.solve(req)
+        except AdmissionRejected as e:
+            # the tenant service shed this request (overload, queue
+            # bound, quarantine) — run in-process for the cycle but do
+            # NOT trip the breaker: the sidecar is alive and the next
+            # cycle should try again
+            logging.getLogger("kubebatch").info(
+                "solver sidecar %s shed tenant %s (%s); running "
+                "in-process this cycle", addr, tenant, e)
+            return False
         except Exception as e:
             # a solve()-side ValueError is a sidecar/response bug, not an
             # out-of-vocabulary snapshot — fall back, but say so
             logging.getLogger("kubebatch").warning(
                 "solver sidecar %s solve failed (%s); running in-process",
                 addr, e)
-            trip_breaker(addr)
+            trip_breaker(target)
             return False
         # a successful solve answers the quarantine's recovery probe:
         # reset the strike escalation for this sidecar
-        clear_breaker(addr)
+        clear_breaker(target)
         client.apply_decisions(ssn, resp, tasks_by_uid)
         return True
 

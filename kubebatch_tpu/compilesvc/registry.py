@@ -105,6 +105,7 @@ PROVIDER_MODULES: Tuple[str, ...] = (
     "kubebatch_tpu.kernels.sharded",
     "kubebatch_tpu.kernels.victims",
     "kubebatch_tpu.actions.allocate_fused",
+    "kubebatch_tpu.tenantsvc.megasolve",
 )
 
 

@@ -107,4 +107,9 @@ CONFIG_ACTIONS = {
     "2p": ("allocate",),
     "3p": ("allocate", "backfill"),
     "5p": ("reclaim", "allocate", "backfill", "preempt"),
+    # "t": the per-tenant cluster of the multi-tenant sidecar mix
+    # (ISSUE 8) — sized so its steady cycles stay BELOW the batched
+    # threshold, i.e. the fused/mega-coalescible regime the tenantsvc
+    # dispatcher batches across tenants
+    "t": ("allocate",),
 }

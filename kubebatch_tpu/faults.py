@@ -58,6 +58,10 @@ SEAMS: Dict[str, str] = {
                        "batched and sharded kernels)",
     "rpc.solve": "sidecar Solve call (rpc/client.py)",
     "rpc.victim": "sidecar victim wave/visit call (rpc/victims_wire.py)",
+    "rpc.admission": "tenantsvc admission gate (tenantsvc/service.py — "
+                     "an injected fault rejects the request; the client "
+                     "falls back in-process without tripping the "
+                     "breaker)",
     "cache.bind": "binder write-back (cache/cache.py _bind_one)",
     "cache.evict": "evictor write-back (cache/cache.py evict)",
     "cache.resync": "resync ground-truth replay (cache/cache.py "
@@ -508,6 +512,91 @@ class DegradationLadder:
 LADDER = DegradationLadder()
 
 
+# ---------------------------------------------------------------------
+# the shed ladder (ISSUE 8): the degradation ladder's overload twin
+# ---------------------------------------------------------------------
+
+#: shed modes in escalation order. The engine ladder answers "the device
+#: path is failing" by demoting the ENGINE; this one answers "demand
+#: exceeds capacity" by degrading the lowest service tier first:
+#: level 1 serves the lowest lane from the tenant's stale decision
+#: mirror (a cached answer beats a queue timeout), level 2 rejects the
+#: lowest lane outright and stale-serves the middle one. The "latency"
+#: lane is never shed — only bounded by its per-tenant queue.
+SHED_LEVELS = ("none", "serve-stale", "reject-lowest")
+
+
+class ShedLadder:
+    """Overload-driven shedding for the tenant solve service.
+
+    ``record_pressure(overloaded)`` is called at every admission with
+    the queue-depth verdict: ``shed_after`` consecutive overloaded
+    admissions escalate one level; ``recover_after`` consecutive calm
+    ones — once the BackoffPolicy cooldown since the escalation has
+    elapsed — step back down. Same streak+cooldown shape as the
+    DegradationLadder, same one policy object for the timing."""
+
+    def __init__(self, policy: Optional[BackoffPolicy] = None,
+                 shed_after: int = 3, recover_after: int = 8):
+        self.policy = policy
+        self.shed_after = shed_after
+        self.recover_after = recover_after
+        self._lock = threading.Lock()
+        self.level = 0
+        self._over_streak = 0
+        self._ok_streak = 0
+        self._cooldown_until = 0.0
+
+    def _pol(self) -> BackoffPolicy:
+        return self.policy or _policy
+
+    def record_pressure(self, overloaded: bool) -> None:
+        from .metrics import set_shed_level
+        with self._lock:
+            if overloaded:
+                self._over_streak += 1
+                self._ok_streak = 0
+                if (self._over_streak < self.shed_after
+                        or self.level >= len(SHED_LEVELS) - 1):
+                    return
+                self.level += 1
+                self._over_streak = 0
+                self._cooldown_until = (time.monotonic()
+                                        + self._pol().quarantine_for(1))
+                set_shed_level(self.level)
+                log.warning("shed ladder ESCALATED to level %d (%s)",
+                            self.level, SHED_LEVELS[self.level])
+            else:
+                self._ok_streak += 1
+                self._over_streak = 0
+                if (self.level == 0
+                        or self._ok_streak < self.recover_after
+                        or time.monotonic() < self._cooldown_until):
+                    return
+                self.level -= 1
+                self._ok_streak = 0
+                set_shed_level(self.level)
+                log.warning("shed ladder recovered to level %d (%s)",
+                            self.level, SHED_LEVELS[self.level])
+
+    def mode(self) -> str:
+        return SHED_LEVELS[self.level]
+
+    def reset(self) -> None:
+        from .metrics import set_shed_level
+        with self._lock:
+            self.level = 0
+            self._over_streak = 0
+            self._ok_streak = 0
+            self._cooldown_until = 0.0
+        set_shed_level(0)
+
+
+#: the process-wide shed ladder — tenantsvc admission drives and
+#: consults it
+SHED = ShedLadder()
+
+
 def reset() -> None:
     """Test/soak helper: disarm and clear every piece of process-wide
     robustness state."""
@@ -515,6 +604,7 @@ def reset() -> None:
     _PLAN = None
     LADDER.reset()
     SIDECAR_QUARANTINE.reset()
+    SHED.reset()
 
 
 # daemon path: arm directly from the environment at import so every

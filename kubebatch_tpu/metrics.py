@@ -109,6 +109,25 @@ if _PROM:
         "real XLA compile (not a persistent-cache retrieval); pinned to "
         "zero by the steady benches",
         ["engine", "reason"], namespace=NAMESPACE)
+    tenant_requests = Counter(
+        "tenant_requests_total",
+        "Tenant solve-service events per tenant (tenantsvc: solves, "
+        "mega_solves, rejected, stale_served, queue_full, quarantined)",
+        ["tenant", "result"], namespace=NAMESPACE)
+    mega_dispatch_counter = Counter(
+        "mega_dispatches_total",
+        "Cross-tenant coalesced solve dispatches (tenantsvc/megasolve: "
+        "one padded kernel dispatch serving >=2 tenant lanes)",
+        namespace=NAMESPACE)
+    load_shed_counter = Counter(
+        "load_shed_total",
+        "Requests degraded by the shed ladder under overload, by mode "
+        "(serve-stale / reject-lowest)",
+        ["mode"], namespace=NAMESPACE)
+    shed_level_gauge = Gauge(
+        "shed_level",
+        "Current tenantsvc shed-ladder level (0=none, 1=serve-stale, "
+        "2=reject-lowest)", namespace=NAMESPACE)
 
 
 def update_plugin_duration(plugin: str, phase: str, seconds: float) -> None:
@@ -357,6 +376,88 @@ def recompiles_by_reason() -> dict:
         return dict(_recompiles)
 
 
+# ---------------------------------------------------------------------------
+# tenant-service accounting (ISSUE 8: tenantsvc — sessions, mega-solve,
+# admission). Same discipline as the robustness counters: process-lifetime
+# values consumers diff across a window, hit from grpc handler threads
+# concurrently (the lock is required), mirrored into prometheus when
+# present. The per-tenant section rides counters_snapshot -> /debug/vars
+# and the flight recorder, so a shared sidecar's dumps are attributable
+# per tenant (ISSUE 8 satellite 1).
+# ---------------------------------------------------------------------------
+
+_tenant_counters: dict = {}
+_mega_dispatches = 0
+_mega_lanes = 0
+_shed_level = 0
+_load_shed: dict = {}
+
+
+def count_tenant(tenant: str, result: str, n: int = 1) -> None:
+    """Record n tenant solve-service events ("solves", "mega_solves",
+    "rejected", "stale_served", "queue_full", "quarantined")."""
+    with _robust_lock:
+        per = _tenant_counters.setdefault(tenant, {})
+        per[result] = per.get(result, 0) + n
+    if _PROM:
+        tenant_requests.labels(tenant, result).inc(n)
+
+
+def tenant_counters() -> dict:
+    """Per-tenant event counts, {tenant: {result: n}} (a deep copy)."""
+    with _robust_lock:
+        return {t: dict(per) for t, per in _tenant_counters.items()}
+
+
+def count_mega_dispatch(lanes: int) -> None:
+    """Record one coalesced mega-solve dispatch serving ``lanes`` real
+    tenant lanes."""
+    global _mega_dispatches, _mega_lanes
+    with _robust_lock:
+        _mega_dispatches += 1
+        _mega_lanes += lanes
+    if _PROM:
+        mega_dispatch_counter.inc()
+
+
+def mega_dispatches_total() -> int:
+    with _robust_lock:
+        return _mega_dispatches
+
+
+def mega_lanes_total() -> int:
+    """Total real lanes served by mega dispatches; divide by
+    mega_dispatches_total() for the mean coalescing factor."""
+    with _robust_lock:
+        return _mega_lanes
+
+
+def set_shed_level(level: int) -> None:
+    global _shed_level
+    _shed_level = level
+    if _PROM:
+        shed_level_gauge.set(level)
+
+
+def shed_level() -> int:
+    """Current tenantsvc shed-ladder level (0 = no shedding)."""
+    return _shed_level
+
+
+def count_load_shed(mode: str) -> None:
+    """Record one request degraded by the shed ladder ("serve-stale" /
+    "reject-lowest")."""
+    with _robust_lock:
+        _load_shed[mode] = _load_shed.get(mode, 0) + 1
+    if _PROM:
+        load_shed_counter.labels(mode).inc()
+
+
+def load_shed_total() -> dict:
+    with _robust_lock:
+        return dict(_load_shed)
+
+
 _solver_kernel_seconds = 0.0
 
 
@@ -514,7 +615,16 @@ def counters_snapshot(include_rpc: bool = True) -> dict:
                                in host_phase_seconds().items()},
         "slow_path_items": slow_path_items(),
         "blocking_readbacks": blocking_readbacks(),
+        "shed_level": shed_level(),
+        "load_shed_total": load_shed_total(),
+        "mega_dispatches_total": mega_dispatches_total(),
+        "mega_lanes_total": mega_lanes_total(),
     }
+    tenants = tenant_counters()
+    if tenants:
+        # the per-tenant section: /debug/vars and flight dumps from a
+        # SHARED sidecar stay attributable per tenant
+        snap["tenants"] = tenants
     if include_rpc:
         rpc = rpc_dispatch_percentiles()
         if rpc:

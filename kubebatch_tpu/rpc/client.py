@@ -3,12 +3,25 @@ and applies the returned decisions — the front-end half of the gRPC
 boundary (SURVEY.md sect. 2.9). The wire carries the FULL policy-term
 payload the in-process engines consume: sig-indexed predicate/score
 matrices, dynamic nodeorder weights with their per-task / per-node
-nonzero-request inputs, and the drf/proportion fairness seeds."""
+nonzero-request inputs, and the drf/proportion fairness seeds.
+
+Multi-tenant (ISSUE 8): every call carries a ``kb-tenant`` (and
+``kb-lane``) metadata key. The tenant id resolves per THREAD
+(``set_tenant``) before the ``KUBEBATCH_TENANT`` env, so a sim driving
+N tenants from one process gets per-tenant clients, per-tenant breaker
+targets, and per-tenant span attribution without env juggling; an
+unconfigured client is the "default" tenant and behaves exactly as
+before. A sidecar shedding load answers RESOURCE_EXHAUSTED
+(``AdmissionRejected`` here) or a stale mirror (``StaleDecisions``) —
+both are fallback signals, NOT sidecar death: callers go in-process
+for the cycle without tripping the quarantine breaker."""
 from __future__ import annotations
 
 import functools
 import json
-from typing import Dict, List
+import os
+import threading
+from typing import Dict, List, Optional
 
 import grpc
 import numpy as np
@@ -36,9 +49,64 @@ class _StateShim:
         self.state = state
 
 
-#: process-wide client per sidecar address (KUBEBATCH_SOLVER=rpc mode —
-#: one channel per daemon, not one per cycle)
-_CLIENTS: Dict[str, "SolverClient"] = {}
+class AdmissionRejected(RuntimeError):
+    """The sidecar's tenant service refused the request (queue full,
+    shed mode, quarantined tenant). An overload signal — fall back
+    in-process for the cycle, do NOT trip the sidecar breaker."""
+
+
+class StaleDecisions(AdmissionRejected):
+    """The sidecar answered from the tenant's stale decision mirror
+    (serve-stale shed mode). Stale decisions reference a previous
+    snapshot's tasks, so a scheduler client must not replay them —
+    treated as a fallback signal unless the caller opted in
+    (``accept_stale=True``, for saturation benches that only measure
+    service behavior)."""
+
+    def __init__(self, msg: str, resp=None):
+        super().__init__(msg)
+        self.resp = resp
+
+
+# -- per-thread tenant identity ---------------------------------------
+_TENANT_TLS = threading.local()
+
+
+def set_tenant(tenant: Optional[str],
+               weight: Optional[float] = None) -> None:
+    """Pin this thread's tenant id (None clears back to the env/default
+    resolution) — the multi-tenant sim drives one tenant per thread.
+    ``weight`` pins the tenant's weighted-fair share alongside; it rides
+    every Solve as ``kb-weight`` metadata (server-side last writer
+    wins)."""
+    _TENANT_TLS.value = tenant
+    _TENANT_TLS.weight = weight
+
+
+def current_tenant() -> str:
+    """Thread-local tenant, else KUBEBATCH_TENANT, else "default"."""
+    return (getattr(_TENANT_TLS, "value", None)
+            or os.environ.get("KUBEBATCH_TENANT", "")
+            or "default")
+
+
+def current_weight() -> Optional[float]:
+    """Thread-local WFQ weight, else KUBEBATCH_TENANT_WEIGHT, else None
+    (meaning: don't send kb-weight; the server keeps its last value)."""
+    wt = getattr(_TENANT_TLS, "weight", None)
+    if wt is not None:
+        return float(wt)
+    env = os.environ.get("KUBEBATCH_TENANT_WEIGHT", "")
+    try:
+        return float(env) if env else None
+    except ValueError:
+        return None
+
+
+#: process-wide client per (sidecar address, tenant) —
+#: KUBEBATCH_SOLVER=rpc mode keeps one channel per daemon per tenant,
+#: not one per cycle
+_CLIENTS: Dict[tuple, "SolverClient"] = {}
 
 #: (client-observed rtt seconds, server solve_ms) per Solve dispatch —
 #: bench.py --mode rpc diffs this to report the per-dispatch HOP cost
@@ -57,15 +125,22 @@ DISPATCH_STATS_CAPACITY = 4096
 DISPATCH_STATS = collections.deque(maxlen=DISPATCH_STATS_CAPACITY)
 
 
-def get_solver_client(target: str) -> "SolverClient":
-    client = _CLIENTS.get(target)
+def get_solver_client(target: str,
+                      tenant: Optional[str] = None) -> "SolverClient":
+    tenant = tenant or current_tenant()
+    key = (target, tenant)
+    client = _CLIENTS.get(key)
     if client is None:
-        client = _CLIENTS[target] = SolverClient(target)
+        client = _CLIENTS[key] = SolverClient(target, tenant=tenant)
     return client
 
 
 class SolverClient:
-    def __init__(self, target: str):
+    def __init__(self, target: str, tenant: str = "default",
+                 lane: str = "normal", accept_stale: bool = False):
+        self.tenant = tenant or "default"
+        self.lane = lane
+        self.accept_stale = accept_stale
         self._channel = grpc.insecure_channel(target)
         self._solve = self._channel.unary_unary(
             f"/{SERVICE}/Solve",
@@ -77,17 +152,24 @@ class SolverClient:
 
     # ------------------------------------------------------------------
     def snapshot_from_session(self, ssn: Session):
-        """Returns (SnapshotRequest, {task_uid: TaskInfo}). Raises
-        ValueError for configurations the sidecar kernel cannot express
-        (custom order fns, predicate/node-order plugins) — silent
-        divergence from the in-process path is worse than an error."""
+        """Returns (SnapshotRequest, {task_uid: TaskInfo}) — delegates to
+        the module-level :func:`build_snapshot` (shared with the mega
+        signature provider, which derives registered compile keys
+        through the live wire encode)."""
+        return build_snapshot(ssn)
+
+    @staticmethod
+    def _build_snapshot(ssn: Session):
+        """Raises ValueError for configurations the sidecar kernel
+        cannot express (custom order fns, predicate/node-order plugins)
+        — silent divergence from the in-process path is worse than an
+        error."""
         if not cycle_supported(ssn):
             raise ValueError(
                 "session plugins exceed the sidecar solver's vocabulary; "
                 "run allocate in-process for this configuration")
         req = solver_pb2.SnapshotRequest()
         node_names = sorted(ssn.nodes)
-        node_index = {n: i for i, n in enumerate(node_names)}
         for name in node_names:
             ni = ssn.nodes[name]
             req.nodes.names.append(name)
@@ -158,7 +240,7 @@ class SolverClient:
                        else np.zeros(3, np.float32))
                 req.jobs.allocated.extend(vec.tolist())
 
-        self._attach_terms(ssn, req, node_names, tasks_by_uid)
+        SolverClient._attach_terms(ssn, req, node_names, tasks_by_uid)
         return req, tasks_by_uid
 
     @staticmethod
@@ -245,35 +327,59 @@ class SolverClient:
         decisions.
 
         Trace context travels as gRPC METADATA (cycle id + parent span
-        name) — wire *metadata*, so solver.proto and the affinity
-        WIRE_FIELDS contract are untouched — and the server ships its
-        own span tree back in trailing metadata; it is grafted under
-        this call's rpc span so sidecar solve spans stitch into the
-        client's cycle tree."""
+        name, plus the tenant id and lane) — wire *metadata*, so
+        solver.proto and the affinity WIRE_FIELDS contract are
+        untouched — and the server ships its own span tree back in
+        trailing metadata; it is grafted under this call's rpc span so
+        sidecar solve spans stitch into the client's cycle tree,
+        attributable per tenant on both sides.
+
+        Raises AdmissionRejected when the sidecar's tenant service
+        refused the request (RESOURCE_EXHAUSTED — overload, not death)
+        and StaleDecisions when it answered from the tenant's stale
+        mirror and this client did not opt in."""
         from ..faults import check as _fault_check
 
         # injection seam: sidecar unavailability, exercised before the
         # wire call — callers treat it exactly like a dead channel
         _fault_check("rpc.solve")
-        md = [("kb-trace-span", "rpc_solve")]
+        md = [("kb-trace-span", "rpc_solve"),
+              ("kb-tenant", self.tenant), ("kb-lane", self.lane)]
+        wt = current_weight()
+        if wt is not None:
+            md.append(("kb-weight", f"{wt:g}"))
         root = obs.current_cycle()
         cyc = (root.args or {}).get("cycle") if root is not None else None
         if cyc is not None:
             md.append(("kb-trace-cycle", str(cyc)))
-        with obs.span("rpc_solve", cat="rpc") as sp:
-            resp, call = self._solve.with_call(req, timeout=timeout,
-                                               metadata=md)
+        try:
+            with obs.span("rpc_solve", cat="rpc",
+                          tenant=self.tenant) as sp:
+                resp, call = self._solve.with_call(req, timeout=timeout,
+                                                   metadata=md)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                raise AdmissionRejected(e.details() or "admission "
+                                        "rejected") from e
+            raise
         # the span's dur is the client-observed rtt (the graft below is
         # deliberately outside it — deserializing the remote tree is not
         # wire time); DISPATCH_STATS keeps its (rtt s, server solve ms)
         # ring contract for bench.py / metrics.rpc_dispatch_percentiles
         DISPATCH_STATS.append((sp.dur, float(resp.solve_ms)))
+        stale = False
         try:
             for key, value in (call.trailing_metadata() or ()):
                 if key == "kb-trace-bin":
                     obs.graft(sp, obs.Span.from_dict(json.loads(value)))
+                elif key == "kb-stale":
+                    stale = value in ("1", b"1")
         except Exception:       # a malformed trace must never fail a solve
             pass
+        if stale and not self.accept_stale:
+            raise StaleDecisions(
+                "sidecar shed load by serving the stale decision mirror; "
+                "this client did not opt in — solve in-process", resp)
         return resp
 
     @staticmethod
@@ -307,3 +413,12 @@ class SolverClient:
         resp = self.solve(req, timeout=timeout)
         self.apply_decisions(ssn, resp, tasks_by_uid)
         return resp
+
+
+def build_snapshot(ssn: Session):
+    """Module-level wire encode: (SnapshotRequest, {task_uid: TaskInfo})
+    from a Session — no channel needed. The mega compile-signature
+    provider (tenantsvc/megasolve.py) derives registered keys through
+    THIS function so they share the live encode code with every real
+    tenant request."""
+    return SolverClient._build_snapshot(ssn)

@@ -7,12 +7,30 @@ AUTO_BATCHED_MIN pending tasks run the round-based batched engine,
 smaller ones the bind-for-bind fused engine. The service wiring is
 hand-written over grpc generic handlers (grpcio-tools is not available
 in this image; message classes are protoc-generated into solver_pb2.py).
+
+Multi-tenant (ISSUE 8): every request is attributed to a tenant via the
+``kb-tenant`` gRPC metadata key (absent = the "default" tenant — a
+tenant-unaware client behaves exactly as before). Solve routes through
+the tenantsvc service (admission + priority lanes + cross-tenant mega
+coalescing, tenantsvc/service.py); the victim endpoints resolve their
+registry through the tenant's session, so state ids are namespaced per
+tenant and cross-tenant bleed is structurally impossible. The wire
+schema (solver.proto) is untouched — tenancy is metadata, like the
+kb-trace-* keys.
+
+The request decode is split out (``decode_snapshot``) so the single
+solve path and the mega dispatcher consume the same arrays, and the
+fused branch exposes its exact (args, statics) via ``fused_lane_args``
+— the coalescing key and the registered mega compile signatures both
+derive from it, so they cannot drift from a live dispatch.
 """
 from __future__ import annotations
 
 import json
 import os
 from concurrent import futures
+from dataclasses import dataclass, field
+from typing import Optional
 
 import grpc
 import jax.numpy as jnp
@@ -36,8 +54,63 @@ def _mat(values, n, r=3) -> np.ndarray:
     return out
 
 
-def solve_snapshot(req: solver_pb2.SnapshotRequest
-                   ) -> solver_pb2.DecisionsResponse:
+@dataclass
+class WireSolve:
+    """One decoded SnapshotRequest: every padded array the engines read,
+    plus the derived static flags. Built once per request (the tenant
+    dispatcher decodes before grouping; the solve paths reuse it)."""
+
+    n: int
+    t: int
+    j: int
+    q: int
+    n_pad: int
+    t_pad: int
+    j_pad: int
+    q_pad: int
+    idle: np.ndarray
+    releasing: np.ndarray
+    backfilled: np.ndarray
+    mtn: np.ndarray
+    ntasks: np.ndarray
+    node_ok: np.ndarray
+    resreq: np.ndarray
+    init_resreq: np.ndarray
+    task_job: np.ndarray
+    task_rank: np.ndarray
+    task_valid: np.ndarray
+    min_av: np.ndarray
+    order_min_av: np.ndarray
+    init_ready: np.ndarray
+    job_queue: np.ndarray
+    job_priority: np.ndarray
+    job_create_rank: np.ndarray
+    job_valid: np.ndarray
+    q_weight: np.ndarray
+    q_entries: np.ndarray
+    q_create_rank: np.ndarray
+    q_deserved: np.ndarray
+    q_alloc0: np.ndarray
+    cluster_total: np.ndarray
+    sig_scores: np.ndarray
+    sig_pred: np.ndarray
+    task_sig: np.ndarray
+    dyn_weights: np.ndarray
+    dyn_enabled: bool
+    task_nz: np.ndarray
+    allocatable_cm: np.ndarray
+    nz_req0: np.ndarray
+    j_alloc0: np.ndarray
+    job_keys: tuple
+    queue_keys: tuple
+    affinity: object = None
+    use_batched: bool = False
+    max_iters: int = 0
+    pipe_enabled: bool = False
+    _extra: dict = field(default_factory=dict)
+
+
+def decode_snapshot(req: solver_pb2.SnapshotRequest) -> WireSolve:
     nodes, tasks, jobs, queues = req.nodes, req.tasks, req.jobs, req.queues
     n = len(nodes.names)
     t = len(tasks.uids)
@@ -168,58 +241,73 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
     # affinity snapshots always take the round engine — it alone carries
     # the vocabulary (the client refuses small affinity snapshots) ------
     from ..actions.allocate import AUTO_BATCHED_MIN
-    if t >= AUTO_BATCHED_MIN or affinity is not None:
-        return _solve_batched_wire(
-            req, nodes, tasks, n, t, affinity=affinity,
-            idle=idle, releasing=releasing, backfilled=backfilled,
-            mtn=mtn, ntasks=ntasks, node_ok=node_ok,
-            resreq=resreq, init_resreq=init_resreq, task_job=task_job,
-            task_rank=task_rank, task_valid=task_valid, task_sig=task_sig,
-            sig_scores=sig_scores, sig_pred=sig_pred, task_nz=task_nz,
-            allocatable_cm=allocatable_cm, nz_req0=nz_req0,
-            min_av=min_av, order_min_av=order_min_av,
-            init_ready=init_ready, job_queue=job_queue,
-            job_priority=job_priority, job_create_rank=job_create_rank,
-            job_valid=job_valid, q_weight=q_weight, q_entries=q_entries,
-            q_create_rank=q_create_rank, q_deserved=q_deserved,
-            q_alloc0=q_alloc0, j_alloc0=j_alloc0,
-            cluster_total=cluster_total, dyn_weights=dyn_weights,
-            dyn_enabled=dyn_enabled, job_keys=tuple(job_keys),
-            queue_keys=queue_keys)
+    use_batched = t >= AUTO_BATCHED_MIN or affinity is not None
+    # strictly-positive like the in-process derivation
+    # (cycle_inputs.py pipe_enabled) — negative releasing rows
+    # (pipelined reuse) must not enable the pipeline path
+    pipe_enabled = bool((releasing[:n] > 0).any())
 
-    # cat="host": the server-side solve wall; the update_solver_kernel
-    # histogram belongs to the CLIENT's engine accounting, not the
-    # sidecar's (solve_ms travels back on the wire as before)
-    with obs.span("solve_fused", cat="host", engine="fused") as sp:
-        (host_block, *_device_state) = fused_allocate(
-            idle, releasing, backfilled, jnp.asarray(allocatable_cm),
-            jnp.asarray(nz_req0), mtn, ntasks, node_ok,
-            jnp.asarray(resreq), jnp.asarray(init_resreq),
-            jnp.asarray(task_nz), jnp.asarray(task_job),
-            jnp.asarray(task_rank), jnp.asarray(task_sig),
-            jnp.asarray(task_valid), jnp.asarray(sig_scores),
-            jnp.asarray(sig_pred),
-            jnp.asarray(min_av), jnp.asarray(order_min_av),
-            jnp.asarray(init_ready), jnp.asarray(job_queue),
-            jnp.asarray(job_priority), jnp.asarray(job_create_rank),
-            jnp.asarray(job_valid), jnp.asarray(q_weight),
-            jnp.asarray(q_entries), jnp.asarray(q_create_rank),
-            jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
-            jnp.asarray(j_alloc0), jnp.asarray(cluster_total),
-            jnp.asarray(dyn_weights),
-            job_keys=tuple(job_keys), queue_keys=queue_keys,
-            gang_enabled=req.gang_enabled,
-            prop_overused=req.proportion_enabled,
-            dyn_enabled=dyn_enabled,
-            max_iters=int(t_pad + 3 * j_pad + q_pad + 8))
-    solve_ms = sp.dur * 1e3        # same extent the perf_counter pair had
-    with obs.span("readback", cat="readback"):
-        host_block = np.asarray(host_block)   # one device->host transfer
+    return WireSolve(
+        n=n, t=t, j=j, q=q, n_pad=n_pad, t_pad=t_pad, j_pad=j_pad,
+        q_pad=q_pad, idle=idle, releasing=releasing, backfilled=backfilled,
+        mtn=mtn, ntasks=ntasks, node_ok=node_ok, resreq=resreq,
+        init_resreq=init_resreq, task_job=task_job, task_rank=task_rank,
+        task_valid=task_valid, min_av=min_av, order_min_av=order_min_av,
+        init_ready=init_ready, job_queue=job_queue,
+        job_priority=job_priority, job_create_rank=job_create_rank,
+        job_valid=job_valid, q_weight=q_weight, q_entries=q_entries,
+        q_create_rank=q_create_rank, q_deserved=q_deserved,
+        q_alloc0=q_alloc0, cluster_total=cluster_total,
+        sig_scores=sig_scores, sig_pred=sig_pred, task_sig=task_sig,
+        dyn_weights=dyn_weights, dyn_enabled=dyn_enabled, task_nz=task_nz,
+        allocatable_cm=allocatable_cm, nz_req0=nz_req0, j_alloc0=j_alloc0,
+        job_keys=tuple(job_keys), queue_keys=queue_keys,
+        affinity=affinity, use_batched=use_batched,
+        max_iters=int(t_pad + 3 * j_pad + q_pad + 8),
+        pipe_enabled=pipe_enabled)
+
+
+def fused_lane_args(req: solver_pb2.SnapshotRequest,
+                    w: Optional[WireSolve] = None):
+    """The fused branch's exact (positional args, statics) in
+    kernels/fused.fused_allocate order — or None when the snapshot
+    takes the batched engine (mega never coalesces those). The mega
+    coalescing key and the registered mega compile signatures both
+    derive from this, so they share the live decode path."""
+    if w is None:
+        w = decode_snapshot(req)
+    if w.use_batched:
+        return None
+    args = (w.idle, w.releasing, w.backfilled, w.allocatable_cm,
+            w.nz_req0, w.mtn, w.ntasks, w.node_ok,
+            w.resreq, w.init_resreq, w.task_nz, w.task_job, w.task_rank,
+            w.task_sig, w.task_valid, w.sig_scores, w.sig_pred,
+            w.min_av, w.order_min_av, w.init_ready, w.job_queue,
+            w.job_priority, w.job_create_rank, w.job_valid,
+            w.q_weight, w.q_entries, w.q_create_rank, w.q_deserved,
+            w.q_alloc0, w.j_alloc0, w.cluster_total, w.dyn_weights)
+    statics = dict(job_keys=w.job_keys, queue_keys=w.queue_keys,
+                   gang_enabled=bool(req.gang_enabled),
+                   prop_overused=bool(req.proportion_enabled),
+                   dyn_enabled=w.dyn_enabled, max_iters=w.max_iters)
+    return args, statics
+
+
+def fused_response(req, w: WireSolve, host_block: np.ndarray,
+                   solve_ms: float) -> solver_pb2.DecisionsResponse:
+    """Decode one fused/mega host block into the wire response."""
     task_state, task_node, task_seq, iters = unpack_host_block(host_block)
+    return _decisions(req, w, task_state, task_node, task_seq,
+                      int(iters), solve_ms)
 
+
+def _decisions(req, w: WireSolve, task_state, task_node, task_seq,
+               iterations: int, solve_ms: float
+               ) -> solver_pb2.DecisionsResponse:
     resp = solver_pb2.DecisionsResponse(solve_ms=solve_ms,
-                                        iterations=int(iters))
-    for i in range(t):
+                                        iterations=iterations)
+    nodes, tasks = req.nodes, req.tasks
+    for i in range(w.t):
         kind = int(task_state[i])
         resp.decisions.append(solver_pb2.Decision(
             task_uid=tasks.uids[i], kind=kind,
@@ -227,6 +315,27 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest
                        if kind in (ALLOC, ALLOC_OB, PIPELINE) else ""),
             order=int(task_seq[i]) if kind != SKIP else -1))
     return resp
+
+
+def solve_snapshot(req: solver_pb2.SnapshotRequest,
+                   w: Optional[WireSolve] = None
+                   ) -> solver_pb2.DecisionsResponse:
+    if w is None:
+        w = decode_snapshot(req)
+    if w.use_batched:
+        return _solve_batched_wire(req, w)
+
+    lane = fused_lane_args(req, w)
+    args, statics = lane
+    # cat="host": the server-side solve wall; the update_solver_kernel
+    # histogram belongs to the CLIENT's engine accounting, not the
+    # sidecar's (solve_ms travels back on the wire as before)
+    with obs.span("solve_fused", cat="host", engine="fused") as sp:
+        (host_block, *_device_state) = fused_allocate(*args, **statics)
+    solve_ms = sp.dur * 1e3        # same extent the perf_counter pair had
+    with obs.span("readback", cat="readback"):
+        host_block = np.asarray(host_block)   # one device->host transfer
+    return fused_response(req, w, host_block, solve_ms)
 
 
 def _affinity_from_wire(req, n_pad: int, t_pad: int):
@@ -299,93 +408,115 @@ class _WireDevice:
         self.node_ok = jnp.asarray(node_ok)
 
 
-def _solve_batched_wire(req, nodes, tasks, n, t, *, idle, releasing,
-                        backfilled, mtn, ntasks, node_ok, resreq,
-                        init_resreq, task_job, task_rank, task_valid,
-                        task_sig, sig_scores, sig_pred, task_nz,
-                        allocatable_cm, nz_req0, min_av, order_min_av,
-                        init_ready, job_queue, job_priority,
-                        job_create_rank, job_valid, q_weight, q_entries,
-                        q_create_rank, q_deserved, q_alloc0, j_alloc0,
-                        cluster_total, dyn_weights, dyn_enabled, job_keys,
-                        queue_keys,
-                        affinity=None) -> solver_pb2.DecisionsResponse:
+def _solve_batched_wire(req, w: WireSolve) -> solver_pb2.DecisionsResponse:
     """Round-engine path: rebuild CycleInputs from the wire arrays and
     run the same solve_batched the in-process batched mode uses."""
     from ..actions.cycle_inputs import CycleInputs
     from ..kernels.batched import solve_batched
 
     inputs = CycleInputs(
-        queue_ids=list(req.queues.names), jobs=[], tasks=[None] * t,
+        queue_ids=list(req.queues.names), jobs=[], tasks=[None] * w.t,
         device=None,
-        resreq=resreq, init_resreq=init_resreq, resreq_raw=None,
-        task_nz=task_nz, task_job=task_job, task_rank=task_rank,
-        task_sig=task_sig, task_valid=task_valid,
-        sig_scores=sig_scores, sig_pred=sig_pred,
-        min_available=min_av, order_min_available=order_min_av,
-        init_allocated=init_ready, job_queue=job_queue,
-        job_priority=job_priority, job_create_rank=job_create_rank,
-        job_valid=job_valid,
-        q_weight=q_weight, q_entries=q_entries,
-        q_create_rank=q_create_rank, q_deserved=q_deserved,
-        q_alloc0=q_alloc0, j_alloc0=j_alloc0,
-        cluster_total=cluster_total,
-        dyn_weights=dyn_weights, dyn_enabled=dyn_enabled,
-        job_keys=job_keys, queue_keys=queue_keys,
+        resreq=w.resreq, init_resreq=w.init_resreq, resreq_raw=None,
+        task_nz=w.task_nz, task_job=w.task_job, task_rank=w.task_rank,
+        task_sig=w.task_sig, task_valid=w.task_valid,
+        sig_scores=w.sig_scores, sig_pred=w.sig_pred,
+        min_available=w.min_av, order_min_available=w.order_min_av,
+        init_allocated=w.init_ready, job_queue=w.job_queue,
+        job_priority=w.job_priority, job_create_rank=w.job_create_rank,
+        job_valid=w.job_valid,
+        q_weight=w.q_weight, q_entries=w.q_entries,
+        q_create_rank=w.q_create_rank, q_deserved=w.q_deserved,
+        q_alloc0=w.q_alloc0, j_alloc0=w.j_alloc0,
+        cluster_total=w.cluster_total,
+        dyn_weights=w.dyn_weights, dyn_enabled=w.dyn_enabled,
+        job_keys=w.job_keys, queue_keys=w.queue_keys,
         gang_enabled=req.gang_enabled,
         prop_overused=req.proportion_enabled,
-        affinity=affinity,
-        # strictly-positive like the in-process derivation
-        # (cycle_inputs.py pipe_enabled) — negative releasing rows
-        # (pipelined reuse) must not enable the pipeline path
-        pipe_enabled=bool((np.asarray(releasing)[:n] > 0).any()))
-    device = _WireDevice(idle, releasing, backfilled, allocatable_cm,
-                         nz_req0, ntasks, mtn, node_ok)
+        affinity=w.affinity,
+        pipe_enabled=w.pipe_enabled)
+    device = _WireDevice(w.idle, w.releasing, w.backfilled,
+                         w.allocatable_cm, w.nz_req0, w.ntasks, w.mtn,
+                         w.node_ok)
     # cat="host": solve_batched's own kernel span (inside) carries the
     # update_solver_kernel view; this wrapper is the wire solve_ms extent
     with obs.span("solve_batched", cat="host", engine="batched") as sp:
         task_state, task_node, task_seq, rounds = solve_batched(device,
                                                                 inputs)
-    solve_ms = sp.dur * 1e3
-
-    resp = solver_pb2.DecisionsResponse(solve_ms=solve_ms,
-                                        iterations=int(rounds))
-    for i in range(t):
-        kind = int(task_state[i])
-        resp.decisions.append(solver_pb2.Decision(
-            task_uid=tasks.uids[i], kind=kind,
-            node_name=(nodes.names[int(task_node[i])]
-                       if kind in (ALLOC, ALLOC_OB, PIPELINE) else ""),
-            order=int(task_seq[i]) if kind != SKIP else -1))
-    return resp
+    return _decisions(req, w, task_state, task_node, task_seq,
+                      int(rounds), sp.dur * 1e3)
 
 
-def _solve_handler(request: bytes, context) -> bytes:
-    """Unary handler with trace stitching: incoming gRPC metadata carries
-    the client's cycle id + parent span name; the handler runs under a
-    per-request server root span and ships the finished tree back in
-    TRAILING metadata (kb-trace-bin) for the client to graft — the wire
-    request/response schema is untouched."""
-    req = solver_pb2.SnapshotRequest.FromString(request)
+def _tenant_of(context) -> tuple:
+    """(tenant, lane) from the request metadata; absent keys mean the
+    single-tenant default."""
     md = {k: v for k, v in (context.invocation_metadata() or ())}
-    root = obs.begin_server_root(
-        "sidecar_solve", cycle=md.get("kb-trace-cycle"),
-        parent=md.get("kb-trace-span"))
-    try:
-        resp = solve_snapshot(req)
-    finally:
-        obs.end_server_root(root)
+    return (md.get("kb-tenant") or "default",
+            md.get("kb-lane") or "normal", md)
+
+
+def _make_solve_handler(svc):
+    """Unary Solve handler bound to the server's tenant service. Trace
+    stitching: incoming gRPC metadata carries the client's cycle id +
+    parent span name (and now the tenant id); the handler runs under a
+    per-request server root span TAGGED with the tenant and ships the
+    finished tree back in TRAILING metadata (kb-trace-bin) for the
+    client to graft — the wire request/response schema is untouched."""
+    from ..tenantsvc.admission import AdmissionError
+
+    def _solve_handler(request: bytes, context) -> bytes:
+        req = solver_pb2.SnapshotRequest.FromString(request)
+        tenant, lane, md = _tenant_of(context)
+        wt = md.get("kb-weight")
+        if wt:
+            # per-request WFQ weight update, last writer wins; a full
+            # registry is ignored here — admit() below raises the same
+            # AdmissionError with the proper wire code
+            try:
+                svc.registry.get(tenant).weight = max(1e-6, float(wt))
+            except (ValueError, AdmissionError):
+                pass
+        root = obs.begin_server_root(
+            "sidecar_solve", cycle=md.get("kb-trace-cycle"),
+            parent=md.get("kb-trace-span"), tenant=tenant, lane=lane)
+        resp = None
+        stale = False
+        reject: Optional[AdmissionError] = None
         try:
-            context.set_trailing_metadata(
-                (("kb-trace-bin", json.dumps(root.to_dict()).encode()),))
-        except Exception:       # trailing trace is best-effort evidence
-            pass
-    return resp.SerializeToString()
+            try:
+                resp, stale = svc.solve(tenant, lane, req)
+            except AdmissionError as e:
+                reject = e
+        finally:
+            obs.end_server_root(root)
+            try:
+                trailing = [("kb-trace-bin",
+                             json.dumps(root.to_dict()).encode())]
+                if stale:
+                    trailing.append(("kb-stale", "1"))
+                context.set_trailing_metadata(tuple(trailing))
+            except Exception:   # trailing trace is best-effort evidence
+                pass
+        if reject is not None:
+            # admission rejection -> RESOURCE_EXHAUSTED; the client
+            # falls back in-process WITHOUT tripping its breaker
+            context.set_code(grpc.StatusCode.RESOURCE_EXHAUSTED)
+            context.set_details(f"{reject.reason}: {reject}")
+            return b""
+        return resp.SerializeToString()
+
+    return _solve_handler
 
 
 def make_server(address: str = "127.0.0.1:0",
-                max_workers: int = 4) -> tuple:
+                max_workers: int = 4,
+                tenant_service=None) -> tuple:
     """Returns (grpc.Server, bound_port).
+
+    ``tenant_service``: a pre-built tenantsvc TenantSolveService (tests
+    pass one to tune queue depth / batching window); None builds the
+    default. The built service is installed as tenantsvc.service.active()
+    so the dryrun and /debug surfaces can reach it.
 
     Handler threads get a 64 MB stack: XLA/LLVM compilation of the big
     round-engine graphs recurses deeply, and on the default 8 MB pool
@@ -397,7 +528,8 @@ def make_server(address: str = "127.0.0.1:0",
     embedding process creates later are unaffected."""
     import threading
 
-    from .victims_wire import VictimRegistry
+    from ..tenantsvc import service as tenantsvc_service
+    from ..tenantsvc.service import TenantSolveService
 
     executor = futures.ThreadPoolExecutor(max_workers=max_workers)
     try:
@@ -420,21 +552,45 @@ def make_server(address: str = "127.0.0.1:0",
             except (ValueError, RuntimeError):  # pragma: no cover
                 pass
 
-    registry = VictimRegistry()
+    svc = tenant_service or TenantSolveService()
+    tenantsvc_service.install(svc)
+
+    def _victim_session(context):
+        tenant, _, _ = _tenant_of(context)
+        session = svc.registry.get(tenant)
+        if session.quarantined():
+            # same refusal the Solve leg gets at admission — the client
+            # falls back to its local kernels (pure analysis, safe)
+            raise PermissionError(
+                f"tenant {tenant!r} is quarantined; retry after the "
+                "cooldown")
+        return session, tenant
 
     def _victim_upload(request: bytes, context) -> bytes:
         req = solver_pb2.VictimUploadRequest.FromString(request)
+        session, _ = _victim_session(context)
         return solver_pb2.VictimUploadResponse(
-            state_id=registry.upload(req)).SerializeToString()
+            state_id=session.victims.upload(req)).SerializeToString()
 
     def _victim_visit(request: bytes, context) -> bytes:
         req = solver_pb2.VictimVisitRequest.FromString(request)
-        return registry.visit(req).SerializeToString()
+        session, tenant = _victim_session(context)
+        if req.mutable:
+            # the tenant's mutable mirrors route through the versioned
+            # MirrorStore BEFORE the registry applies them: a rollback
+            # (version not strictly advancing for this state id — a
+            # split-brain tenant replaying old uploads) is REJECTED
+            # here and strikes toward the tenant's quarantine; the
+            # legit client only re-ships mirrors when its version moved
+            session.upload_mirror(f"victim-mut:{req.state_id}",
+                                  req.mut_version, None)
+        return session.victims.visit(req,
+                                     tenant=tenant).SerializeToString()
 
     server = grpc.server(executor)
     handler = grpc.method_handlers_generic_handler(SERVICE, {
         "Solve": grpc.unary_unary_rpc_method_handler(
-            _solve_handler,
+            _make_solve_handler(svc),
             request_deserializer=None,   # raw bytes in
             response_serializer=None),   # raw bytes out
         "VictimUpload": grpc.unary_unary_rpc_method_handler(

@@ -93,7 +93,8 @@ class VictimRegistry:
             self._states[state_id] = entry
         return state_id
 
-    def visit(self, req: solver_pb2.VictimVisitRequest
+    def visit(self, req: solver_pb2.VictimVisitRequest,
+              tenant: str = "default"
               ) -> solver_pb2.VictimVisitResponse:
         import jax
 
@@ -123,9 +124,10 @@ class VictimRegistry:
                   score_nodes=entry["score_nodes"],
                   room_check=entry["room_check"])
         # server-side victim solve wall (cat="host": the client's
-        # victim_wave/visit kernel span owns the histogram accounting)
+        # victim_wave/visit kernel span owns the histogram accounting;
+        # tenant-tagged so shared-sidecar dumps stay attributable)
         with obs.span("victim_solve", cat="host",
-                      wave=bool(req.wave)) as sp:
+                      wave=bool(req.wave), tenant=tenant) as sp:
             if req.wave:
                 out = run_wave_kernel(entry["static"], mut,
                                       entry["sig"], p_res, p_resreq, p_nz,
@@ -158,6 +160,19 @@ class VictimRegistry:
 from ..faults import SIDECAR_QUARANTINE
 
 
+def breaker_target(address: str, tenant: str = "default") -> str:
+    """Quarantine key for one (sidecar, tenant) pair. In production each
+    tenant is its own scheduler process, so the process-wide breaker is
+    naturally per-tenant; a multi-tenant test/sim process gets the same
+    isolation by keying non-default tenants separately — one tenant's
+    sidecar failures must not quarantine the sidecar for its neighbors
+    in the same process. The default tenant keeps the bare address so
+    single-tenant behavior (and every existing caller) is unchanged."""
+    if not tenant or tenant == "default":
+        return address
+    return f"{address}#{tenant}"
+
+
 def breaker_open(address: str) -> bool:
     """True while the address is inside its failure cooldown; when the
     cooldown elapses exactly one caller gets a recovery probe."""
@@ -187,8 +202,13 @@ class RemoteVictimBackend:
     other failure disables the backend for the rest of the action and
     trips the process-wide breaker for the address."""
 
-    def __init__(self, channel, address: str = ""):
+    def __init__(self, channel, address: str = "",
+                 tenant: str = "default"):
         self.address = address
+        self.tenant = tenant or "default"
+        #: tenancy rides gRPC metadata next to the kb-trace-* keys — the
+        #: sidecar scopes the victim registry per tenant with it
+        self._md = (("kb-tenant", self.tenant),)
         from .server import SERVICE
 
         self._upload_rpc = channel.unary_unary(
@@ -224,7 +244,7 @@ class RemoteVictimBackend:
         for arr in (*static, score, pred):
             req.static.arrays.append(to_tensor(np.asarray(arr)))
         self._state_id = self._upload_rpc(
-            req, timeout=_UPLOAD_TIMEOUT_S).state_id
+            req, timeout=_UPLOAD_TIMEOUT_S, metadata=self._md).state_id
         self._sent_version = -1        # fresh server state has no mirrors
         return self._state_id
 
@@ -246,7 +266,8 @@ class RemoteVictimBackend:
             req.lanes.append(to_tensor(np.asarray(arr)))
         if visited is not None:
             req.visited.CopyFrom(to_tensor(np.asarray(visited)))
-        resp = self._visit_rpc(req, timeout=_VISIT_TIMEOUT_S)
+        resp = self._visit_rpc(req, timeout=_VISIT_TIMEOUT_S,
+                               metadata=self._md)
         # commit the version only after the server accepted it
         self._sent_version = solver.state.version
         self.calls += 1
@@ -257,11 +278,12 @@ class RemoteVictimBackend:
               visited: Optional[np.ndarray]) -> Optional[np.ndarray]:
         if self._dead:
             return None
+        target = breaker_target(self.address, self.tenant)
         for attempt in (0, 1):
             try:
                 out = self._call_once(solver, lanes, wave, filter_kind,
                                       visited)
-                clear_breaker(self.address)
+                clear_breaker(target)
                 return out
             except Exception as e:  # noqa: BLE001 — any failure -> local
                 # a shared sidecar's LRU may have evicted our state id
@@ -275,7 +297,7 @@ class RemoteVictimBackend:
                     "victim sidecar call failed (%s); using local kernels",
                     e)
                 self._dead = True
-                trip_breaker(self.address)
+                trip_breaker(target)
                 return None
         return None   # pragma: no cover — loop always returns
 
@@ -300,17 +322,24 @@ class RemoteVictimBackend:
 def attach_remote(solver, address: str) -> bool:
     """Wire a RemoteVictimBackend onto the solver; False if the channel
     can't be created or the address recently failed (process-wide
-    breaker — a wedged sidecar must not stall every cycle on rpc
-    timeouts; the breaker re-probes after the cooldown)."""
-    if breaker_open(address):
+    breaker, keyed per (address, tenant) — a wedged sidecar must not
+    stall every cycle on rpc timeouts, and one tenant's quarantine must
+    not block its in-process neighbors; the breaker re-probes after the
+    cooldown)."""
+    from .client import current_tenant
+
+    tenant = current_tenant()
+    target = breaker_target(address, tenant)
+    if breaker_open(target):
         return False
     try:
         from .client import get_solver_client
 
-        client = get_solver_client(address)
+        client = get_solver_client(address, tenant=tenant)
         solver.remote = RemoteVictimBackend(client._channel,
-                                            address=address)
+                                            address=address,
+                                            tenant=tenant)
         return True
     except Exception:
-        trip_breaker(address)
+        trip_breaker(target)
         return False

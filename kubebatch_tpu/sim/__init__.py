@@ -4,8 +4,10 @@ from .cluster import (BASELINE_SPECS, ClusterSpec, SimCluster,
 from .source import (FlakyBinder, FlakyEvictor, PersistentVolume,
                      PersistentVolumeClaim, PVVolumeBinder, StorageClass,
                      StreamingEventSource)
+from .tenants import run_multi_tenant, run_saturation  # noqa: F401
 
 __all__ = ["BASELINE_SPECS", "ClusterSpec", "SimCluster", "baseline_cluster",
            "build_cluster", "FlakyBinder", "FlakyEvictor",
            "PersistentVolume", "PersistentVolumeClaim", "PVVolumeBinder",
-           "StorageClass", "StreamingEventSource"]
+           "StorageClass", "StreamingEventSource", "run_multi_tenant",
+           "run_saturation"]

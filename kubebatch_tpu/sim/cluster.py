@@ -384,6 +384,19 @@ BASELINE_SPECS["3p"] = ClusterSpec(
     n_zones=8, selector_frac=0.15, taint_frac=0.1, toleration_frac=0.15,
     anti_affinity_frac=0.08, zone_affinity_frac=0.05,
     pref_affinity_frac=0.08, hostport_frac=0.04)
+#: the multi-tenant per-cluster spec (ISSUE 8, tenantsvc): one tenant's
+#: simulated cluster in the shared-sidecar mix. Deliberately BELOW the
+#: batched threshold in both cold and steady regimes (32 pods pending)
+#: so every tenant solve takes the fused branch — the mega-coalescible
+#: shape class the cross-tenant dispatcher batches. The per-tenant
+#: variation in the mix is the SEED (tenant index), which changes
+#: resource numerics but not shapes — exactly the condition for lanes
+#: to share one compile signature.
+BASELINE_SPECS["t"] = ClusterSpec(
+    n_nodes=12, n_groups=16, pods_per_group=2,
+    n_queues=2, queue_weights=(1, 3),
+    pod_cpu_millis=900, pod_mem_bytes=GiB)
+
 BASELINE_SPECS["5p"] = ClusterSpec(
     n_nodes=5000, n_groups=1250, pods_per_group=8,
     n_queues=4, queue_weights=(1, 2, 3, 4),

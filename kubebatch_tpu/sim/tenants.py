@@ -1,0 +1,335 @@
+"""Multi-tenant simulation: N simulated clusters through ONE sidecar
+pool, plus the saturation driver behind ``bench.py --tenants N``.
+
+Two drivers:
+
+- :func:`run_multi_tenant` — the ISSUE 8 done-bar: every tenant is an
+  independent simulated cluster (the "t" spec, seeded by tenant index)
+  driving real scheduling cycles with ``AllocateAction(mode="rpc")``
+  against one shared sidecar, one thread per tenant (so the service's
+  combining dispatcher sees real concurrency and coalesces
+  opportunistically). Each tenant's end state is compared bit-identical
+  against a DEDICATED in-process run of the same seeded cluster — the
+  shared sidecar must be observationally indistinguishable from a
+  private solver.
+
+- :func:`run_saturation` — the capacity evidence: per-tenant clients
+  fire pre-built solve requests closed-loop to measure solves/sec at
+  capacity, then an open-loop pass offers 2x that rate and records the
+  p99 latency of completed solves plus the shed census (rejected /
+  stale-served) — the admission-control story measured, not asserted.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .cluster import BASELINE_SPECS, build_cluster
+
+__all__ = ["run_multi_tenant", "run_saturation", "drive_tenant_cycles",
+           "TENANT_CONFIG"]
+
+#: the per-tenant cluster spec key (sim/cluster.py BASELINE_SPECS)
+TENANT_CONFIG = "t"
+
+#: canonical churn per steady tick for the tenant spec (whole cluster
+#: recycles — matches compilesvc/profile.py's clamped STEADY_CHURN)
+_TENANT_CHURN = 32
+
+
+class _Binder:
+    def __init__(self):
+        self.binds: Dict[str, str] = {}
+        self.fresh: List = []
+
+    def bind(self, pod, hostname):
+        self.binds[pod.uid] = hostname
+        pod.node_name = hostname
+        self.fresh.append(pod)
+
+    def evict(self, pod):
+        pod.deletion_timestamp = 1.0
+
+
+def _tenant_cluster(idx: int, config=TENANT_CONFIG):
+    from ..cache import SchedulerCache
+
+    spec = replace(BASELINE_SPECS[config], seed=idx)
+    sim = build_cluster(spec)
+    binder = _Binder()
+    cache = SchedulerCache(binder=binder, evictor=binder,
+                           async_writeback=False)
+    sim.populate(cache)
+    return sim, cache, binder
+
+
+def drive_tenant_cycles(sim, cache, binder, cycles: int, mode: str,
+                        tiers=None) -> Dict[str, tuple]:
+    """Run ``cycles`` scheduling cycles (kubelet tick + canonical churn
+    between cycles — the steady regime) and return the final task state
+    map {task_key: (status, node)} — the bit-identity comparand."""
+    from ..actions.allocate import AllocateAction
+    from ..conf import shipped_tiers
+    from ..framework import CloseSession, OpenSession
+    from ..objects import PodPhase
+
+    tiers = tiers or shipped_tiers()
+    act = AllocateAction(mode=mode)
+    state: Dict[str, tuple] = {}
+    for cyc in range(cycles):
+        for pod in binder.fresh:
+            if pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                cache.update_pod(pod, pod)
+        binder.fresh.clear()
+        if cyc:
+            sim.churn_tick(cache, _TENANT_CHURN)
+        ssn = OpenSession(cache, tiers)
+        act.execute(ssn)
+        state = {t.key: (str(t.status), t.node_name)
+                 for job in ssn.jobs.values() for t in job.tasks.values()}
+        CloseSession(ssn)
+    return state
+
+
+@dataclass
+class MultiTenantReport:
+    tenants: int
+    cycles: int
+    bit_identical: bool
+    mismatched: List[str] = field(default_factory=list)
+    solves_by_tenant: Dict[str, int] = field(default_factory=dict)
+    mega_dispatches: int = 0
+    mega_lanes: int = 0
+    rpc_errors: List[str] = field(default_factory=list)
+
+
+def run_multi_tenant(n_tenants: int = 4, cycles: int = 4,
+                     address: Optional[str] = None,
+                     config=TENANT_CONFIG) -> MultiTenantReport:
+    """N seeded tenant clusters, one thread each, through one sidecar at
+    ``address`` (spawned in-process when None); per-tenant end states
+    compared bit-identical to dedicated in-process runs."""
+    from .. import metrics
+    from ..rpc.client import set_tenant
+
+    server = None
+    if address is None:
+        from ..rpc.server import make_server
+
+        server, port = make_server("127.0.0.1:0")
+        server.start()
+        address = f"127.0.0.1:{port}"
+    prev_addr = os.environ.get("KUBEBATCH_SOLVER_ADDR")
+    os.environ["KUBEBATCH_SOLVER_ADDR"] = address
+
+    mega0 = metrics.mega_dispatches_total()
+    lanes0 = metrics.mega_lanes_total()
+    try:
+        # dedicated oracle runs (same seeds, in-process auto engine)
+        dedicated = {}
+        for i in range(n_tenants):
+            sim, cache, binder = _tenant_cluster(i, config)
+            dedicated[f"tenant-{i}"] = drive_tenant_cycles(
+                sim, cache, binder, cycles, mode="auto")
+
+        shared: Dict[str, Dict] = {}
+        errors: List[str] = []
+
+        def worker(i: int):
+            tenant = f"tenant-{i}"
+            set_tenant(tenant)
+            try:
+                sim, cache, binder = _tenant_cluster(i, config)
+                shared[tenant] = drive_tenant_cycles(
+                    sim, cache, binder, cycles, mode="rpc")
+            except Exception as e:  # noqa: BLE001 — reported, not raised
+                errors.append(f"{tenant}: {type(e).__name__}: {e}")
+            finally:
+                set_tenant(None)
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    name=f"kb-tenant-{i}")
+                   for i in range(n_tenants)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+
+        mismatched = [t for t in dedicated
+                      if shared.get(t) != dedicated[t]]
+        per_tenant = metrics.tenant_counters()
+        return MultiTenantReport(
+            tenants=n_tenants, cycles=cycles,
+            bit_identical=not mismatched and not errors,
+            mismatched=mismatched,
+            solves_by_tenant={t: per_tenant.get(t, {}).get("solves", 0)
+                              for t in dedicated},
+            mega_dispatches=metrics.mega_dispatches_total() - mega0,
+            mega_lanes=metrics.mega_lanes_total() - lanes0,
+            rpc_errors=errors)
+    finally:
+        if prev_addr is None:
+            os.environ.pop("KUBEBATCH_SOLVER_ADDR", None)
+        else:
+            os.environ["KUBEBATCH_SOLVER_ADDR"] = prev_addr
+        if server is not None:
+            server.stop(grace=None)
+
+
+# ---------------------------------------------------------------------
+# saturation
+# ---------------------------------------------------------------------
+
+def _tenant_requests(n_tenants: int, config=TENANT_CONFIG) -> list:
+    """One pre-built SnapshotRequest per tenant (seeded numerics, one
+    shape class — the coalescible mix)."""
+    from ..framework import CloseSession, OpenSession
+    from ..conf import shipped_tiers
+    from ..rpc.client import build_snapshot
+
+    out = []
+    tiers = shipped_tiers()
+    for i in range(n_tenants):
+        _, cache, _ = _tenant_cluster(i, config)
+        ssn = OpenSession(cache, tiers)
+        req, _ = build_snapshot(ssn)
+        CloseSession(ssn)
+        out.append(req)
+    return out
+
+
+@dataclass
+class SaturationReport:
+    tenants: int
+    capacity_solves_per_sec: float
+    capacity_p50_ms: float
+    capacity_solves: int
+    overload_offered_per_sec: float
+    overload_completed_per_sec: float
+    overload_p99_ms: float
+    overload_rejected: int
+    overload_stale_served: int
+    #: NON-admission failures during the overload phase (timeouts, wire
+    #: errors, handler crashes) — kept apart from rejected so a failing
+    #: sidecar can never masquerade as healthy load shedding
+    overload_errors: int = 0
+    shed_modes_seen: Dict[str, int] = field(default_factory=dict)
+
+
+def run_saturation(n_tenants: int = 4, address: str = "",
+                   duration_s: float = 3.0,
+                   config=TENANT_CONFIG) -> SaturationReport:
+    """Closed-loop capacity, then 2x-offered overload, through the live
+    sidecar at ``address``. Bench-facing: clients accept stale answers
+    (they measure service behavior, they schedule nothing)."""
+    from .. import metrics
+    from ..rpc.client import AdmissionRejected, SolverClient
+
+    reqs = _tenant_requests(n_tenants, config)
+    clients = [SolverClient(address, tenant=f"tenant-{i}", lane="batch",
+                            accept_stale=True)
+               for i in range(n_tenants)]
+    # warm the wire + dispatch caches off the clock
+    for client, req in zip(clients, reqs):
+        client.solve(req)
+
+    # ---- phase 1: closed-loop capacity ------------------------------
+    lat: List[float] = []
+    lock = threading.Lock()
+    stop_at = time.perf_counter() + duration_s
+
+    def cap_worker(i: int):
+        client, req = clients[i], reqs[i]
+        mine = []
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            client.solve(req)
+            mine.append(time.perf_counter() - t0)
+        with lock:
+            lat.extend(mine)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=cap_worker, args=(i,))
+               for i in range(n_tenants)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    capacity = len(lat) / wall if wall else 0.0
+
+    # ---- phase 2: 2x offered overload -------------------------------
+    shed0 = metrics.load_shed_total()
+    offered_rate = 2.0 * max(1.0, capacity)
+    n_workers = 2 * n_tenants
+    per_worker_interval = n_workers / offered_rate
+    over_lat: List[float] = []
+    rejected = [0]
+    errored = [0]
+    stale = [0]
+    stop2 = time.perf_counter() + duration_s
+
+    def over_worker(k: int):
+        client = clients[k % n_tenants]
+        req = reqs[k % n_tenants]
+        mine = []
+        next_fire = time.perf_counter() + (k / n_workers) \
+            * per_worker_interval
+        while True:
+            now = time.perf_counter()
+            if now >= stop2:
+                break
+            if now < next_fire:
+                time.sleep(min(next_fire - now, 0.005))
+                continue
+            next_fire += per_worker_interval   # offered schedule, not
+            t0 = time.perf_counter()           # completion-paced
+            try:
+                resp = client.solve(req)
+                mine.append(time.perf_counter() - t0)
+                del resp
+            except AdmissionRejected:
+                with lock:
+                    rejected[0] += 1
+            except Exception:   # noqa: BLE001 — NOT shedding: a wedged
+                with lock:      # sidecar must not read as admission
+                    errored[0] += 1
+        with lock:
+            over_lat.extend(mine)
+
+    threads = [threading.Thread(target=over_worker, args=(k,))
+               for k in range(n_workers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall2 = time.perf_counter() - t0
+    shed_delta = {k: v - shed0.get(k, 0)
+                  for k, v in metrics.load_shed_total().items()
+                  if v - shed0.get(k, 0)}
+    stale[0] = shed_delta.get("serve-stale", 0)
+
+    for client in clients:
+        client.close()
+    return SaturationReport(
+        tenants=n_tenants,
+        capacity_solves_per_sec=round(capacity, 1),
+        capacity_p50_ms=round(float(np.percentile(lat, 50)) * 1e3, 3)
+        if lat else 0.0,
+        capacity_solves=len(lat),
+        overload_offered_per_sec=round(offered_rate, 1),
+        overload_completed_per_sec=round(len(over_lat) / wall2, 1)
+        if wall2 else 0.0,
+        overload_p99_ms=round(float(np.percentile(over_lat, 99)) * 1e3, 3)
+        if over_lat else 0.0,
+        overload_rejected=rejected[0],
+        overload_stale_served=stale[0],
+        overload_errors=errored[0],
+        shed_modes_seen=shed_delta)

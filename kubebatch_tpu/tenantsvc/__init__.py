@@ -1,0 +1,50 @@
+"""tenantsvc — the sidecar as a multi-tenant TPU solve service (ROADMAP
+item 3 / ISSUE 8).
+
+The rpc sidecar carries the full policy vocabulary and survives
+quarantine/failover, but it served exactly one scheduler. The
+production shape for "millions of users" is many clusters sharing a
+pool of TPU solver workers; this subsystem is that shape, in three
+parts:
+
+- **Tenant sessions** (sessions.py): per-tenant server-side state —
+  the VictimUpload mirror-version scheme generalized into a
+  :class:`MirrorStore` (independently versioned per kind, stale
+  uploads REJECTED, repeat offenders quarantined through the shared
+  faults.Quarantine mechanism) plus a per-tenant victim registry so
+  one tenant's uploads can never be visited by another.
+- **Cross-tenant dispatch batching** (megasolve.py): concurrent Solve
+  requests whose fused shape buckets + static config coincide coalesce
+  into ONE padded mega-solve — a vmapped lane axis over the fused
+  allocate kernel, one device dispatch, one blocking readback, the
+  per-tenant slices scattered back. The entry is an instrumented
+  compilesvc trace boundary with its own registered signatures
+  (MEGA_LANE_BUCKETS x the config's fused surface) so warm-up still
+  pins ``recompiles_total == 0`` across a tenant shape mix.
+- **Admission control** (admission.py + service.py): a bounded
+  per-tenant queue with priority lanes ("latency" drains strictly
+  first) and weighted-fair dequeue across tenants, riding the shed
+  ladder in faults.py (``SHED_LEVELS``): under sustained overload the
+  service first serves the lowest lane from the tenant's stale
+  decision mirror, then rejects the lowest lane outright — both modes
+  counted per tenant and visible on /debug/vars.
+
+Wire contract: solver.proto is UNTOUCHED. Tenancy travels as gRPC
+metadata (``kb-tenant`` / ``kb-lane`` next to the ``kb-trace-*`` keys),
+so a tenant-unaware client is simply the "default" tenant on the
+"normal" lane and behaves exactly as before.
+
+Evidence: the tenantsvc dryrun stage (__graft_entry__) drives 2
+simulated tenants through one in-process sidecar with decisions
+bit-identical to dedicated runs and recompiles pinned to zero;
+``bench.py --tenants N`` records the saturation line (solves/sec at
+capacity, p99 under 2x offered overload) in BENCH_DEVICE.jsonl.
+Design notes: docs/TENANCY.md.
+"""
+from __future__ import annotations
+
+from .sessions import (MirrorStore, StaleMirrorError,  # noqa: F401
+                       TenantRegistry, TenantSession, TENANT_QUARANTINE)
+
+__all__ = ["MirrorStore", "StaleMirrorError", "TenantRegistry",
+           "TenantSession", "TENANT_QUARANTINE"]

@@ -1,0 +1,183 @@
+"""Cross-tenant dispatch batching — coincident shape buckets become ONE
+padded mega-solve.
+
+A pool sidecar serving N schedulers sees N concurrent small steady
+solves per scheduling period, and each one is a separate kernel
+dispatch today. But the fused allocate kernel is a pure function of
+its arguments, the wire path pads every tenant's snapshot with the
+same deterministic ``pad_to_bucket``, and tenants running the same
+cluster class therefore dispatch the SAME (shape-bucket x static-arg)
+signature — so the lanes can ride one ``jax.vmap`` axis: one compile,
+one kernel dispatch, one blocking readback, per-tenant host blocks
+scattered back. Verified bit-identical per lane against the dedicated
+dispatch (tests/test_tenantsvc.py) — vmap batches the elementwise ops
+and per-lane reductions without reassociating them.
+
+The lane count itself is a compile-relevant shape, so it pads to
+``MEGA_LANE_BUCKETS`` (duplicating lane 0 — the kernel is pure, the
+padding lanes' results are discarded) and the entry is a registered
+compilesvc provider: warm-up compiles the config's fused surface at
+every lane bucket, so a tenant mix landing on the warmed configs keeps
+``recompiles_total == 0`` (the ISSUE 8 done-bar). The signatures are
+derived through the LIVE wire path — build_snapshot -> decode ->
+fused_lane_args, the same code a real tenant request crosses — so the
+registered keys cannot drift from what the service dispatches.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..compilesvc import instrument as _instrument
+from ..compilesvc import register_provider as _register_provider
+from ..compilesvc.registry import Signature, signature_key
+from ..metrics import count_blocking_readback
+
+__all__ = ["MEGA_LANE_BUCKETS", "MAX_MEGA_LANES", "lane_bucket",
+           "lane_key", "solve_lanes"]
+
+#: lane-axis pad buckets; the dispatcher never pulls more than the top
+MEGA_LANE_BUCKETS: Tuple[int, ...] = (2, 4, 8)
+MAX_MEGA_LANES = MEGA_LANE_BUCKETS[-1]
+
+_MEGA_STATICS = ("job_keys", "queue_keys", "gang_enabled", "prop_overused",
+                 "dyn_enabled", "max_iters")
+
+
+def _build_mega():
+    import jax
+
+    from ..kernels.fused import fused_allocate
+
+    @partial(jax.jit, static_argnames=_MEGA_STATICS)
+    def _mega_fused(*lanes, job_keys, queue_keys, gang_enabled,
+                    prop_overused, dyn_enabled, max_iters):
+        fn = partial(fused_allocate, job_keys=job_keys,
+                     queue_keys=queue_keys, gang_enabled=gang_enabled,
+                     prop_overused=prop_overused, dyn_enabled=dyn_enabled,
+                     max_iters=max_iters)
+        return jax.vmap(fn)(*lanes)
+
+    return _instrument("mega", "_mega_fused", _mega_fused)
+
+
+#: the accounted trace boundary (built lazily so importing tenantsvc
+#: does not pull jax into grpc-free unit tests)
+_mega_fused = None
+
+
+def _mega():
+    global _mega_fused
+    if _mega_fused is None:
+        _mega_fused = _build_mega()
+    return _mega_fused
+
+
+def lane_bucket(n: int) -> int:
+    """Smallest registered lane bucket >= n (callers chunk at the top)."""
+    for b in MEGA_LANE_BUCKETS:
+        if n <= b:
+            return b
+    return MEGA_LANE_BUCKETS[-1]
+
+
+def lane_key(args: tuple, statics: dict) -> str:
+    """Coalescing key for ONE lane: two requests may share a mega
+    dispatch iff their unstacked avals + statics coincide (then the
+    stacked signature coincides too)."""
+    return signature_key("_mega_fused_lane", args, statics)
+
+
+def _stack_lanes(lane_args: List[tuple], b_pad: int) -> tuple:
+    """[B real lanes] -> per-argument stacked arrays, lane 0 duplicated
+    into the padding rows (pure kernel — padding output is discarded)."""
+    padded = list(lane_args) + [lane_args[0]] * (b_pad - len(lane_args))
+    return tuple(np.stack([la[i] for la in padded])
+                 for i in range(len(lane_args[0])))
+
+
+def solve_lanes(lanes: List[Tuple[tuple, dict]]
+                ) -> Tuple[List[np.ndarray], float]:
+    """One mega dispatch over coalesced lanes (same key — the caller
+    grouped them). Returns (per-real-lane host blocks, solve wall ms);
+    ONE blocking readback for the whole group."""
+    assert lanes and len(lanes) <= MAX_MEGA_LANES
+    statics = lanes[0][1]
+    b = len(lanes)
+    b_pad = lane_bucket(b)
+    stacked = _stack_lanes([args for args, _ in lanes], b_pad)
+    # same span extents as the single fused path (server.solve_snapshot):
+    # solve_ms is the solve span ALONE and the readback sits outside it,
+    # so a coalesced lane's solve_ms stays comparable to a dedicated
+    # dispatch — the rpc hop metric (rtt - server solve) depends on the
+    # two paths measuring the same thing
+    with obs.span("solve_mega", cat="host", engine="mega",
+                  lanes=b, lanes_padded=b_pad) as sp:
+        out = _mega()(*stacked, **statics)
+        host_blocks = out[0]
+    count_blocking_readback()
+    with obs.span("readback", cat="readback"):
+        host_blocks = np.asarray(host_blocks)
+    return [host_blocks[i] for i in range(b)], sp.dur * 1e3
+
+
+# ---------------------------------------------------------------------
+# compilesvc signature provider — the mega surface per config
+# ---------------------------------------------------------------------
+
+def _wire_fused_lane(ssn) -> Optional[Tuple[tuple, dict]]:
+    """One canonical lane through the LIVE wire path: encode the session
+    the way a tenant client would, decode it the way the sidecar does,
+    and keep it only if the fused branch (the mega-eligible one) would
+    take it. Shared code start to finish — a registered mega signature
+    cannot drift from a live dispatch."""
+    from ..rpc.client import build_snapshot
+    from ..rpc.server import decode_snapshot, fused_lane_args
+
+    try:
+        req, _ = build_snapshot(ssn)
+    except ValueError:
+        return None            # outside the sidecar vocabulary entirely
+    w = decode_snapshot(req)
+    return fused_lane_args(req, w)
+
+
+@_register_provider("tenantsvc.megasolve")
+def compile_signatures(materials):
+    from ..framework import CloseSession, OpenSession
+
+    lanes = []
+    if materials.is_steady and materials._sessions:
+        # the profile's steady session is already open (victim providers
+        # read it too); building a snapshot from it is read-only
+        lane = _wire_fused_lane(materials._sessions[-1])
+        if lane is not None:
+            lanes.append(("steady", lane))
+    elif not materials.is_steady:
+        # cold surface: open/close our own session — safe here because
+        # no profile session is open in the cold regime (cfg>=2 cold is
+        # batched-sized and yields no lane anyway)
+        ssn = OpenSession(materials.cache, materials.tiers)
+        try:
+            lane = _wire_fused_lane(ssn)
+        finally:
+            CloseSession(ssn)
+        if lane is not None:
+            lanes.append(("cold", lane))
+
+    out = []
+    for regime, (args, statics) in lanes:
+        for b in MEGA_LANE_BUCKETS:
+            stacked = _stack_lanes([args], b)
+            out.append(Signature(
+                engine="mega", entry="_mega_fused",
+                key=signature_key("_mega_fused", stacked, statics),
+                lower=lambda s=stacked, st=statics: _mega()
+                .lower(*s, **st),
+                run=lambda s=stacked, st=statics: _mega()(*s, **st),
+                note=(f"{regime} B={b} T={args[8].shape[0]} "
+                      f"N={args[0].shape[0]}")))
+    return out

@@ -1,0 +1,192 @@
+"""Per-tenant server-side state — the mirror-version scheme, generalized.
+
+rpc/victims_wire.py introduced the pattern for ONE client: immutable
+state ships once, mutable mirrors re-ship only when the host's version
+moved, and an out-of-sync visit is refused rather than silently solved
+against stale arrays. A multi-tenant sidecar needs that per tenant,
+for every kind of uploaded state, with three extra guarantees:
+
+- **independent versioning**: each tenant's mirrors (node capacity,
+  affinity vocabulary, host-port occupancy, last decisions) carry
+  their own monotonic version per kind; tenants never share a
+  version sequence, so one tenant's churn can't invalidate another's
+  mirrors;
+- **validation**: a version that does not strictly advance is a
+  rollback — two schedulers claiming the same tenant id, or a client
+  replaying an old upload — and is REJECTED (StaleMirrorError), never
+  silently applied;
+- **quarantine**: a tenant that keeps uploading stale versions is
+  misbehaving (split-brain is the usual cause) and gets quarantined
+  through the same faults.Quarantine mechanism the sidecar breaker
+  uses — admission refuses it until the cooldown's recovery probe.
+
+Cross-tenant isolation is structural, not advisory: every
+TenantSession owns its own VictimRegistry instance, so a victim state
+id uploaded by tenant A does not exist in tenant B's namespace at all.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..faults import Quarantine
+
+__all__ = ["MirrorStore", "StaleMirrorError", "TenantSession",
+           "TenantRegistry", "TENANT_QUARANTINE"]
+
+#: quarantine for misbehaving tenants (repeated stale/rollback uploads);
+#: same policy object semantics as the sidecar breaker — backoff-gated
+#: recovery probes, escalating cooldown, clear() on a clean upload
+TENANT_QUARANTINE = Quarantine()
+
+#: consecutive stale uploads before the tenant trips its quarantine —
+#: one stale upload is a benign race (a retried rpc, a slow pipe), a
+#: streak is split-brain
+STALE_STRIKES_BEFORE_QUARANTINE = 2
+
+
+class StaleMirrorError(ValueError):
+    """An upload whose version does not strictly advance the tenant's
+    mirror for that kind — rejected, never applied."""
+
+
+class MirrorStore:
+    """Versioned per-kind mirrors for one tenant.
+
+    ``upload(kind, version, payload)`` requires ``version`` to strictly
+    exceed the stored version for ``kind`` (first upload: any version).
+    ``get(kind, version)`` returns the payload only when the stored
+    version matches — the out-of-sync refusal the victim wire pioneered.
+    ``latest(kind)`` returns (version, payload) regardless, for the
+    serve-stale-mirror shed mode, which by definition wants the last
+    good state."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mirrors: Dict[str, Tuple[int, object]] = {}
+
+    def upload(self, kind: str, version: int, payload) -> None:
+        with self._lock:
+            have = self._mirrors.get(kind)
+            if have is not None and version <= have[0]:
+                raise StaleMirrorError(
+                    f"stale {kind} mirror upload: version {version} does "
+                    f"not advance stored version {have[0]}")
+            self._mirrors[kind] = (int(version), payload)
+
+    def get(self, kind: str, version: int):
+        with self._lock:
+            have = self._mirrors.get(kind)
+            if have is None or have[0] != version:
+                raise StaleMirrorError(
+                    f"{kind} mirror out of sync (have "
+                    f"{have[0] if have else None}, asked {version}); "
+                    "resend mirrors")
+            return have[1]
+
+    def latest(self, kind: str) -> Optional[Tuple[int, object]]:
+        with self._lock:
+            return self._mirrors.get(kind)
+
+    def version(self, kind: str) -> int:
+        with self._lock:
+            have = self._mirrors.get(kind)
+            return have[0] if have is not None else -1
+
+    def kinds(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._mirrors))
+
+
+class TenantSession:
+    """Everything the sidecar holds for one tenant. Built lazily on the
+    tenant's first request; victim state and mirrors live here so there
+    is no shared namespace to bleed across."""
+
+    def __init__(self, tenant: str):
+        self.tenant = tenant
+        self.created = time.monotonic()
+        self.mirrors = MirrorStore()
+        #: per-tenant victim registry (rpc/victims_wire.VictimRegistry);
+        #: lazy import keeps this module grpc-free for unit tests
+        from ..rpc.victims_wire import VictimRegistry
+
+        self.victims = VictimRegistry()
+        #: scheduling weight for the weighted-fair dequeue — the solve
+        #: handler (rpc/server.py) updates it from the ``kb-weight``
+        #: gRPC metadata on any request (last writer wins); clients set
+        #: it per thread via rpc.client.set_tenant(weight=...) or the
+        #: KUBEBATCH_TENANT_WEIGHT env
+        self.weight = 1.0
+        self._stale_streak = 0
+        self._lock = threading.Lock()
+
+    # -- mirror uploads with the quarantine discipline -------------------
+    def upload_mirror(self, kind: str, version: int, payload) -> None:
+        """Versioned upload; a stale version raises AND counts toward
+        the tenant's quarantine strike streak (cleared by any clean
+        upload)."""
+        try:
+            self.mirrors.upload(kind, version, payload)
+        except StaleMirrorError:
+            with self._lock:
+                self._stale_streak += 1
+                streak = self._stale_streak
+            if streak >= STALE_STRIKES_BEFORE_QUARANTINE:
+                TENANT_QUARANTINE.trip(self.tenant)
+                from ..metrics import count_tenant
+                count_tenant(self.tenant, "quarantined")
+            raise
+        with self._lock:
+            self._stale_streak = 0
+        TENANT_QUARANTINE.clear(self.tenant)
+
+    def quarantined(self) -> bool:
+        return TENANT_QUARANTINE.blocked(self.tenant)
+
+
+class TenantRegistry:
+    """Thread-safe tenant-session store. Bounded: the sidecar serves a
+    configured pool of clusters, not the open internet — when the cap
+    is hit, UNKNOWN tenants are refused at admission instead of
+    silently evicting a live tenant's state (evicting mirrors mid-run
+    would force a full re-upload storm, the exact overload amplifier
+    admission control exists to prevent)."""
+
+    MAX_TENANTS = 64
+
+    def __init__(self, max_tenants: Optional[int] = None):
+        self.max_tenants = max_tenants or self.MAX_TENANTS
+        self._sessions: Dict[str, TenantSession] = {}
+        self._lock = threading.Lock()
+
+    def get(self, tenant: str, create: bool = True
+            ) -> Optional[TenantSession]:
+        with self._lock:
+            ssn = self._sessions.get(tenant)
+            if ssn is None and create:
+                if len(self._sessions) >= self.max_tenants:
+                    # an AdmissionError subclass: the solve handler maps
+                    # it to RESOURCE_EXHAUSTED, so the over-cap tenant
+                    # gets a clean refusal instead of a generic failure
+                    # that would trip its breaker
+                    from .admission import RegistryFullError
+
+                    raise RegistryFullError(
+                        f"tenant registry full ({self.max_tenants}); "
+                        f"refusing new tenant {tenant!r}")
+                ssn = self._sessions[tenant] = TenantSession(tenant)
+            return ssn
+
+    def tenants(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._sessions))
+
+    def drop(self, tenant: str) -> None:
+        with self._lock:
+            self._sessions.pop(tenant, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sessions.clear()

@@ -194,7 +194,13 @@ def test_fixture_replay_list_then_watch():
     cache = SchedulerCache(binder=RecordingBinder(), async_writeback=False)
     src = drained_source(t, cache)
     for th in src._threads:
-        th.join(5.0)
+        # settle window only: the replay watch threads deliberately never
+        # exit (watch_fn parks in done.wait to model an idle stream), so
+        # this join ALWAYS burns its full timeout — deliveries are
+        # synchronous host work that landed before the park, and 2 s of
+        # settle is generous; 5 s here cost 4 kinds x 5 s x 3 tests = 60 s
+        # of pure dead time per suite run
+        th.join(2.0)
 
     assert set(cache.nodes) == {"n1", "n2", "n3"}
     job = cache.jobs["ns/g1"]
@@ -220,7 +226,13 @@ def test_watch_modified_and_deleted_flow():
     cache = SchedulerCache(binder=RecordingBinder(), async_writeback=False)
     src = drained_source(t, cache)
     for th in src._threads:
-        th.join(5.0)
+        # settle window only: the replay watch threads deliberately never
+        # exit (watch_fn parks in done.wait to model an idle stream), so
+        # this join ALWAYS burns its full timeout — deliveries are
+        # synchronous host work that landed before the park, and 2 s of
+        # settle is generous; 5 s here cost 4 kinds x 5 s x 3 tests = 60 s
+        # of pure dead time per suite run
+        th.join(2.0)
     job = cache.jobs["ns/g1"]
     assert not job.tasks                       # deleted again
     assert cache.nodes["n1"].used.milli_cpu == 0.0
@@ -316,7 +328,13 @@ def test_watch_410_relists_and_resumes():
     cache = SchedulerCache(binder=RecordingBinder(), async_writeback=False)
     src = drained_source(t, cache)
     for th in src._threads:
-        th.join(5.0)
+        # settle window only: the replay watch threads deliberately never
+        # exit (watch_fn parks in done.wait to model an idle stream), so
+        # this join ALWAYS burns its full timeout — deliveries are
+        # synchronous host work that landed before the park, and 2 s of
+        # settle is generous; 5 s here cost 4 kinds x 5 s x 3 tests = 60 s
+        # of pure dead time per suite run
+        th.join(2.0)
     assert t.list_calls["pods"] == 2           # initial LIST + relist
     job = cache.jobs["ns/g1"]
     names = sorted(task.pod.name for task in job.tasks.values())

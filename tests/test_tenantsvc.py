@@ -1,0 +1,467 @@
+"""tenantsvc: multi-tenant sessions, cross-tenant mega coalescing,
+admission/shed, and the shared-sidecar parity + quarantine smoke
+(ISSUE 8)."""
+import threading
+
+import pytest
+
+from kubebatch_tpu import actions, faults, metrics, plugins  # noqa: F401
+from kubebatch_tpu.tenantsvc import (MirrorStore, StaleMirrorError,
+                                     TENANT_QUARANTINE, TenantRegistry,
+                                     TenantSession)
+from kubebatch_tpu.tenantsvc.admission import (AdmissionQueue, Item,
+                                               QueueFullError)
+from kubebatch_tpu.tenantsvc.service import TenantSolveService
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    yield
+    faults.reset()
+    TENANT_QUARANTINE.reset()
+    from kubebatch_tpu.tenantsvc import service as _svc
+    _svc.install(None)
+
+
+# ---------------------------------------------------------------------
+# sessions: the generalized mirror-version scheme
+# ---------------------------------------------------------------------
+
+def test_mirror_store_versions_are_monotonic_per_kind():
+    store = MirrorStore()
+    store.upload("nodes", 1, "n1")
+    store.upload("nodes", 2, "n2")
+    store.upload("ports", 1, "p1")     # kinds version independently
+    assert store.get("nodes", 2) == "n2"
+    assert store.latest("ports") == (1, "p1")
+    with pytest.raises(StaleMirrorError):
+        store.upload("nodes", 2, "replay")      # equal = rejected
+    with pytest.raises(StaleMirrorError):
+        store.upload("nodes", 1, "rollback")    # lower = rejected
+    with pytest.raises(StaleMirrorError):
+        store.get("nodes", 1)                   # out-of-sync read refused
+    assert store.get("nodes", 2) == "n2"        # nothing was applied
+
+
+def test_repeated_stale_uploads_quarantine_the_tenant():
+    ssn = TenantSession("splitbrain")
+    ssn.upload_mirror("nodes", 5, "v5")
+    for _ in range(2):
+        with pytest.raises(StaleMirrorError):
+            ssn.upload_mirror("nodes", 3, "old")
+    assert ssn.quarantined()
+    # a clean upload after the cooldown clears the strikes
+    TENANT_QUARANTINE.clear("splitbrain")
+    ssn.upload_mirror("nodes", 6, "v6")
+    assert not ssn.quarantined()
+
+
+def test_victim_registries_are_per_tenant_namespaces():
+    registry = TenantRegistry()
+    a = registry.get("a").victims
+    b = registry.get("b").victims
+    assert a is not b
+    # a state id in A's namespace does not exist in B's at all
+    a._states["deadbeef"] = {"mut": None, "mut_version": -1}
+    assert b._states.get("deadbeef") is None
+
+
+# ---------------------------------------------------------------------
+# admission: lanes, weighted fairness, bounds
+# ---------------------------------------------------------------------
+
+def test_admission_lane_priority_and_weighted_fair():
+    q = AdmissionQueue(depth=8)
+    q.set_weight("heavy", 3.0)
+    q.set_weight("light", 1.0)
+    for i in range(6):
+        q.submit(Item("heavy", "normal", f"h{i}"))
+    for i in range(2):
+        q.submit(Item("light", "normal", f"l{i}"))
+    q.submit(Item("light", "latency", "urgent"))
+    pulled = q.pull(6)
+    # the latency lane drains strictly first
+    assert pulled[0].req == "urgent"
+    # weighted fair within the lane: heavy (w=3) gets ~3x light's share
+    normals = [it.tenant for it in pulled[1:]]
+    assert normals.count("heavy") >= 3
+    assert normals.count("light") >= 1
+
+
+def test_admission_queue_bound_rejects_the_bursting_tenant():
+    q = AdmissionQueue(depth=2)
+    q.submit(Item("t", "normal", 1))
+    q.submit(Item("t", "normal", 2))
+    with pytest.raises(QueueFullError):
+        q.submit(Item("t", "normal", 3))
+    # other lanes and other tenants are unaffected
+    q.submit(Item("t", "batch", 4))
+    q.submit(Item("other", "normal", 5))
+
+
+def test_shed_ladder_escalates_and_recovers():
+    ladder = faults.ShedLadder(
+        policy=faults.BackoffPolicy(cooldown=0.0), shed_after=2,
+        recover_after=2)
+    assert ladder.mode() == "none"
+    for _ in range(2):
+        ladder.record_pressure(True)
+    assert ladder.mode() == "serve-stale"
+    for _ in range(2):
+        ladder.record_pressure(True)
+    assert ladder.mode() == "reject-lowest"
+    for _ in range(4):
+        ladder.record_pressure(False)
+    assert ladder.level <= 1
+    ladder.reset()
+
+
+def test_shed_modes_serve_stale_then_reject_lowest():
+    from kubebatch_tpu.tenantsvc.admission import ShedRejectError
+
+    svc = TenantSolveService()
+    # seed a decision mirror for the tenant (what serve-stale serves)
+    svc.registry.get("t").mirrors.upload("decisions", 1, "cached-resp")
+    faults.SHED.level = 1           # serve-stale
+    try:
+        item = svc.admit("t", "batch", object())
+        assert item.done.is_set() and item.stale
+        assert item.resp == "cached-resp"
+        # the latency lane is never shed — it queues normally
+        item = svc.admit("t", "latency", object())
+        assert not item.done.is_set()
+        faults.SHED.level = 2       # reject-lowest
+        with pytest.raises(ShedRejectError):
+            svc.admit("t", "batch", object())
+        # normal lane now serves stale
+        item = svc.admit("t", "normal", object())
+        assert item.done.is_set() and item.stale
+    finally:
+        faults.SHED.level = 0
+    per = metrics.tenant_counters().get("t", {})
+    assert per.get("stale_served", 0) >= 2
+
+
+def test_admission_fault_seam_rejects():
+    from kubebatch_tpu.tenantsvc.admission import AdmissionError
+
+    svc = TenantSolveService()
+    faults.arm(faults.FaultPlan(counts={"rpc.admission": 1}))
+    with pytest.raises(faults.FaultInjected) as ei:
+        svc.admit("t", "normal", object())
+    # the seam's contract: the injected fault is ALSO an AdmissionError,
+    # so the solve handler maps it to RESOURCE_EXHAUSTED and the client
+    # falls back in-process WITHOUT tripping the breaker
+    assert isinstance(ei.value, AdmissionError)
+    faults.disarm()
+
+
+def test_registry_full_is_an_admission_refusal():
+    from kubebatch_tpu.tenantsvc.admission import (AdmissionError,
+                                                   RegistryFullError)
+
+    svc = TenantSolveService(registry=TenantRegistry(max_tenants=1))
+    svc.admit("first", "normal", object())
+    # the over-cap tenant gets the admission taxonomy (-> wire
+    # RESOURCE_EXHAUSTED), never a generic error that trips its breaker
+    with pytest.raises(RegistryFullError) as ei:
+        svc.admit("second", "normal", object())
+    assert isinstance(ei.value, AdmissionError)
+    # the existing tenant is unaffected
+    svc.admit("first", "normal", object())
+
+
+def test_cancelled_item_is_dropped_not_dispatched():
+    svc = TenantSolveService()
+    item = svc.admit("t", "normal", _tenant_request(0))
+    item.cancelled = True           # what a timed-out waiter does
+    before = metrics.tenant_counters().get("t", {}).get("solves", 0)
+    with svc._leader:
+        svc._drain()
+    assert item.done.is_set()
+    assert isinstance(item.error, TimeoutError)
+    # no dispatch burned, no counter advanced, no mirror stashed
+    assert metrics.tenant_counters().get("t", {}).get("solves", 0) == before
+    assert svc.registry.get("t").mirrors.latest("decisions") is None
+
+
+# ---------------------------------------------------------------------
+# mega coalescing: bit-identity + one dispatch
+# ---------------------------------------------------------------------
+
+def _tenant_request(seed: int):
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.rpc.client import build_snapshot
+    from kubebatch_tpu.sim.tenants import _tenant_cluster
+
+    _, cache, _ = _tenant_cluster(seed)
+    ssn = OpenSession(cache, shipped_tiers())
+    req, _ = build_snapshot(ssn)
+    CloseSession(ssn)
+    return req
+
+
+def test_mega_solve_bit_identical_to_dedicated():
+    from kubebatch_tpu.rpc.server import solve_snapshot
+
+    reqs = [_tenant_request(s) for s in range(4)]
+    singles = [solve_snapshot(r) for r in reqs]
+    assert all(len(r.decisions) == 32 for r in singles)
+    svc = TenantSolveService()
+    m0 = metrics.mega_dispatches_total()
+    resps = svc.solve_many([(f"t{i}", "normal", r)
+                            for i, r in enumerate(reqs)])
+    assert metrics.mega_dispatches_total() == m0 + 1, \
+        "4 same-bucket lanes must coalesce into ONE dispatch"
+    for i, (a, b) in enumerate(zip(singles, resps)):
+        assert list(a.decisions) == list(b.decisions), f"lane {i}"
+    per = metrics.tenant_counters()
+    assert all(per[f"t{i}"].get("mega_solves", 0) >= 1 for i in range(4))
+
+
+def test_mega_groups_only_matching_buckets():
+    """A batched-sized request must NOT coalesce — it solves singly
+    through the round engine while the small lanes share a dispatch."""
+    from kubebatch_tpu.rpc.server import decode_snapshot, fused_lane_args
+
+    small = _tenant_request(0)
+    assert fused_lane_args(small, decode_snapshot(small)) is not None
+    import tests.test_rpc as tr
+
+    cache, _ = tr.mk_big_cluster()
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.rpc.client import build_snapshot
+
+    ssn = OpenSession(cache, tr.tiers())
+    big, _ = build_snapshot(ssn)
+    CloseSession(ssn)
+    assert fused_lane_args(big, decode_snapshot(big)) is None
+
+
+# ---------------------------------------------------------------------
+# the done-bar: N tenants through one sidecar, bit-identical + isolated
+# ---------------------------------------------------------------------
+
+def test_four_tenants_one_sidecar_bit_identical():
+    """ISSUE 8 acceptance: N>=4 simulated clusters through one sidecar
+    pool (threads -> real concurrency -> opportunistic coalescing),
+    per-tenant decisions bit-identical to dedicated in-process runs."""
+    from kubebatch_tpu.sim.tenants import run_multi_tenant
+
+    rep = run_multi_tenant(n_tenants=4, cycles=2)
+    assert rep.bit_identical, (rep.mismatched, rep.rpc_errors)
+    # every tenant actually solved through the sidecar every cycle
+    assert all(v >= 2 for v in rep.solves_by_tenant.values()), \
+        rep.solves_by_tenant
+
+
+def test_concurrent_conflicting_mirror_uploads_stay_isolated():
+    """Satellite: two tenants upload conflicting mirror versions
+    interleaved — neither solves against the other's state, stale
+    uploads are rejected (not silently applied)."""
+    registry = TenantRegistry()
+    errors = []
+    barrier = threading.Barrier(2)
+
+    def tenant_worker(name, versions):
+        ssn = registry.get(name)
+        barrier.wait(timeout=10)
+        for v in versions:
+            try:
+                ssn.upload_mirror("nodes", v, f"{name}-v{v}")
+            except StaleMirrorError:
+                errors.append((name, v))
+
+    # same version NUMBERS on both tenants, interleaved: versions are
+    # per-tenant sequences, so neither interferes with the other
+    a = threading.Thread(target=tenant_worker, args=("a", [1, 2, 3, 2]))
+    b = threading.Thread(target=tenant_worker, args=("b", [1, 2, 3, 1]))
+    a.start(); b.start(); a.join(10); b.join(10)
+    # each tenant's final mirror is its OWN v3; the rollbacks (a:2, b:1)
+    # were rejected, not applied
+    assert registry.get("a").mirrors.latest("nodes") == (3, "a-v3")
+    assert registry.get("b").mirrors.latest("nodes") == (3, "b-v3")
+    assert sorted(errors) == [("a", 2), ("b", 1)]
+    TENANT_QUARANTINE.reset()
+
+
+def test_sidecar_quarantine_smoke_unaffected_tenant_keeps_running(
+        monkeypatch):
+    """Chaos tie-in satellite: the sidecar-quarantine seam fires mid
+    multi-tenant run for ONE tenant; the other tenant's cycles keep
+    completing through the sidecar, and the affected tenant recovers
+    bit-identical post-quarantine."""
+    from kubebatch_tpu.rpc.client import set_tenant
+    from kubebatch_tpu.rpc.server import make_server
+    from kubebatch_tpu.rpc.victims_wire import breaker_target
+    from kubebatch_tpu.sim.tenants import (_tenant_cluster,
+                                           drive_tenant_cycles)
+
+    cycles = 4
+
+    # dedicated oracle runs
+    oracle = {}
+    for i in range(2):
+        sim, cache, binder = _tenant_cluster(i)
+        oracle[i] = drive_tenant_cycles(sim, cache, binder, cycles,
+                                        mode="auto")
+
+    server, port = make_server("127.0.0.1:0")
+    server.start()
+    addr = f"127.0.0.1:{port}"
+    monkeypatch.setenv("KUBEBATCH_SOLVER_ADDR", addr)
+    solves0 = {t: metrics.tenant_counters().get(t, {}).get("solves", 0)
+               for t in ("q-a", "q-b")}
+    try:
+        # interleaved per-cycle driving, one tenant at a time
+        worlds = {t: _tenant_cluster(i)
+                  for i, t in enumerate(("q-a", "q-b"))}
+        states = {}
+        for cyc in range(cycles):
+            for tenant in ("q-a", "q-b"):
+                sim, cache, binder = worlds[tenant]
+                set_tenant(tenant)
+                try:
+                    if cyc == 1 and tenant == "q-a":
+                        # the seam: q-a's solve fails -> in-process
+                        # fallback + per-tenant breaker trip
+                        faults.arm(faults.FaultPlan(
+                            counts={"rpc.solve": 1}))
+                    states[tenant] = _one_cycle(sim, cache, binder, cyc)
+                finally:
+                    faults.disarm()
+                    set_tenant(None)
+            if cyc == 1:
+                # q-a is quarantined now (its breaker target tripped);
+                # q-b's target is separate and untouched
+                assert faults.SIDECAR_QUARANTINE.blocked(
+                    breaker_target(addr, "q-a"))
+                assert not faults.SIDECAR_QUARANTINE.blocked(
+                    breaker_target(addr, "q-b"))
+            if cyc == 2:
+                # cooldown "elapses": clear the quarantine so q-a's
+                # recovery probe goes back through the sidecar
+                faults.SIDECAR_QUARANTINE.clear(
+                    breaker_target(addr, "q-a"))
+    finally:
+        server.stop(grace=None)
+
+    # bit-identical end states for BOTH tenants (the faulted cycles ran
+    # the same engine in-process)
+    assert states["q-a"] == oracle[0]
+    assert states["q-b"] == oracle[1]
+    per = metrics.tenant_counters()
+    solved = {t: per.get(t, {}).get("solves", 0) - solves0[t]
+              for t in ("q-a", "q-b")}
+    # the unaffected tenant solved through the sidecar EVERY cycle; the
+    # affected one lost exactly the faulted + quarantined cycles and
+    # recovered after
+    assert solved["q-b"] == cycles, solved
+    assert solved["q-a"] == cycles - 2, solved
+
+
+def _one_cycle(sim, cache, binder, cyc):
+    """One rpc-mode scheduling cycle (kubelet tick + canonical churn
+    between cycles), returning the end-state map."""
+    from kubebatch_tpu.actions.allocate import AllocateAction
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.objects import PodPhase
+
+    for pod in binder.fresh:
+        if pod.phase == PodPhase.PENDING:
+            pod.phase = PodPhase.RUNNING
+            cache.update_pod(pod, pod)
+    binder.fresh.clear()
+    if cyc:
+        sim.churn_tick(cache, 32)
+    ssn = OpenSession(cache, shipped_tiers())
+    AllocateAction(mode="rpc").execute(ssn)
+    state = {t.key: (str(t.status), t.node_name)
+             for job in ssn.jobs.values() for t in job.tasks.values()}
+    CloseSession(ssn)
+    return state
+
+
+# ---------------------------------------------------------------------
+# span/metadata attribution (satellite 1)
+# ---------------------------------------------------------------------
+
+def test_rpc_span_tree_tagged_with_tenant():
+    from kubebatch_tpu import obs
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.rpc import SolverClient, make_server
+    from kubebatch_tpu.sim.tenants import _tenant_cluster
+
+    server, port = make_server("127.0.0.1:0")
+    server.start()
+    client = SolverClient(f"127.0.0.1:{port}", tenant="acme")
+    try:
+        _, cache, _ = _tenant_cluster(0)
+        ssn = OpenSession(cache, shipped_tiers())
+        with obs.cycle(7):
+            client.solve_and_apply(ssn)
+        CloseSession(ssn)
+    finally:
+        client.close()
+        server.stop(grace=None)
+    root = obs.last_cycle()
+    rpc_span = root.find("rpc_solve")
+    assert rpc_span is not None
+    assert (rpc_span.args or {}).get("tenant") == "acme"
+    remote = root.find("sidecar_solve")
+    assert remote is not None, "server tree must stitch into the cycle"
+    assert (remote.args or {}).get("tenant") == "acme"
+    # /debug/vars carries the per-tenant section
+    snap = metrics.counters_snapshot()
+    assert "acme" in snap.get("tenants", {})
+
+
+def test_kb_weight_metadata_updates_wfq_weight():
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.rpc import SolverClient, make_server
+    from kubebatch_tpu.rpc.client import current_weight, set_tenant
+    from kubebatch_tpu.sim.tenants import _tenant_cluster
+    from kubebatch_tpu.tenantsvc import service as tenantsvc_service
+
+    # thread-local resolution, env fallback, none by default
+    assert current_weight() is None
+    set_tenant("heavy", weight=3.0)
+    try:
+        assert current_weight() == 3.0
+        server, port = make_server("127.0.0.1:0")
+        server.start()
+        client = SolverClient(f"127.0.0.1:{port}", tenant="heavy")
+        try:
+            _, cache, _ = _tenant_cluster(0)
+            ssn = OpenSession(cache, shipped_tiers())
+            client.solve_and_apply(ssn)
+            CloseSession(ssn)
+        finally:
+            client.close()
+            server.stop(grace=None)
+        svc = tenantsvc_service.active()
+        assert svc.registry.get("heavy").weight == 3.0
+    finally:
+        set_tenant(None)
+
+
+def test_debug_vars_tenant_section_over_http():
+    from kubebatch_tpu.obs.http import DebugHTTPServer
+    import json
+    import urllib.request
+
+    metrics.count_tenant("http-t", "solves")
+    srv = DebugHTTPServer(addr="127.0.0.1", port=0).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/vars", timeout=5).read()
+        doc = json.loads(body)
+        assert "http-t" in doc["tenants"]
+        assert "mega_dispatches_total" in doc
+        assert "shed_level" in doc
+    finally:
+        srv.stop()

@@ -45,6 +45,10 @@ $B  900 python bench.py --config 1
 # sidecar, zero fallback engagements asserted (exit 1 on any)
 $B  900 python bench.py --config 2 --mode rpc
 $B 1200 python bench.py --config 3 --mode rpc
+# multi-tenant saturation (ISSUE 8): 4 tenants through one sidecar —
+# parity gate (bit-identical to dedicated runs), solves/sec at
+# capacity, p99 under 2x offered overload, recompiles pinned to 0
+$B  900 python bench.py --tenants 4
 # 60+-cycle steady soak (p50/p95/max + RSS in the JSON line)
 $B 2400 python bench.py --config 5 --steady 256 --cycles 60
 # chaos soak: degraded-mode p50 alongside healthy p50, invariant
