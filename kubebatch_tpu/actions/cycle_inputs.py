@@ -847,10 +847,17 @@ def _replay_bulk(ssn: Session, inputs: CycleInputs,
             index = job.task_status_index
             pend = index.get(pending)
             if pend is not None:
-                for i in seg_l:
-                    pend.pop(placed_uids[i], None)
-                if not pend:
+                if len(seg_l) == len(pend) and all(
+                        placed_uids[i] in pend for i in seg_l):
+                    # the batch drains the job's whole pending bucket (a
+                    # full gang placing at once — the steady common
+                    # case): one dict drop instead of per-task pops
                     del index[pending]
+                else:
+                    for i in seg_l:
+                        pend.pop(placed_uids[i], None)
+                    if not pend:
+                        del index[pending]
             for i in seg_l:
                 st = final_status[i]
                 bucket = index.get(st)

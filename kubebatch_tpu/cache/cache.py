@@ -22,6 +22,7 @@ Architecture notes (TPU-first redesign, not a Go translation):
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -37,10 +38,14 @@ from ..faults import check as _fault_check
 from ..objects import (Node, Pod, PodDisruptionBudget, PodGroup,
                        PodGroupPhase, PodPhase, PriorityClass, Queue,
                        UNSCHEDULABLE_CONDITION)
+from ..obs import span as _span
 from ..util import env_on
+from .eventfold import EventFold
 from .interface import (Binder, EventRecorder, Evictor, ListRecorder,
                         NullBinder, NullEvictor, NullStatusUpdater,
                         NullVolumeBinder, StatusUpdater, VolumeBinder)
+
+log = logging.getLogger("kubebatch.cache")
 
 SHADOW_POD_GROUP_KEY = "kube-batch/shadow-pod-group"
 
@@ -154,24 +159,19 @@ class SchedulerCache:
         self.deleted_jobs = RetryQueue()
 
         # ------------------------------------------------------------
-        # incremental snapshot state (no reference counterpart — the
-        # reference deep-copies the whole cluster every cycle,
-        # cache.go:515-583, which is exactly the steady-state bottleneck
-        # this removes). Invariant: snapshot() output is always
-        # deep-equal to a from-scratch clone of cache truth; entities
-        # whose previous-session clone may diverge from truth are
-        # re-cloned, everything else is reused from the adopted base.
+        # event-fold state (no reference counterpart — the reference
+        # deep-copies the whole cluster every cycle, cache.go:515-583,
+        # which is exactly the steady-state bottleneck this removes).
+        # Every event handler folds its event into the EventFold layer
+        # (cache/eventfold.py): per-entity dirty marks for the O(churn)
+        # snapshot patch, dirty rows for the persistent device arrays,
+        # and victim-segment marks — counted per kind. Invariant:
+        # snapshot() output is always deep-equal to a from-scratch clone
+        # of cache truth (audited on demand via audited_snapshot()).
         # ------------------------------------------------------------
         if incremental_snapshot is None:
             incremental_snapshot = env_on("KUBEBATCH_INCREMENTAL")
-        self._incremental = incremental_snapshot
-        #: previous session's entity clones (jobs-by-uid, nodes-by-name),
-        #: adopted at session close; None = next snapshot is a full clone
-        self._snap_base: Optional[Tuple[Dict[str, JobInfo],
-                                        Dict[str, NodeInfo]]] = None
-        #: entities whose cache truth changed since their base clone
-        self._dirty_jobs: set = set()
-        self._dirty_nodes: set = set()
+        self.fold = EventFold(self, incremental_snapshot)
         #: bumped by cluster-wide invalidations; a session snapshot handed
         #: out under an older epoch is refused at adoption
         self._snap_epoch = 0
@@ -180,24 +180,15 @@ class SchedulerCache:
         #: whose snapshot predates the change is refused persistence
         self._shape_epoch = 0
         self._handout_shape_epoch = 0
-        #: persistent device-side node arrays (kernels/solver.DeviceSession).
-        #: _dev_dirty holds marks made since the LAST snapshot; at snapshot
-        #: time they migrate to _dev_refresh, the set device_session may
-        #: safely repack from the session's clones (a mark made AFTER the
-        #: snapshot refers to truth the session cannot see — it must wait
-        #: for the next snapshot, not be consumed against stale clones)
+        #: persistent device-side node arrays (kernels/solver.DeviceSession)
         self._dev_state = None
-        self._dev_dirty: set = set()
-        self._dev_refresh: set = set()
         #: persistent per-node victim segments (kernels/victims.py
-        #: SegmentStore) — same dirty/refresh discipline as _dev_state
+        #: SegmentStore) — same dirty/refresh discipline, in the fold
         self.victim_segments = None
-        self._vic_dirty: set = set()
-        self._vic_refresh: set = set()
-        #: job-level marks for the SegmentStore's persistent job-row
-        #: space (ready counts / allocations) — same discipline
-        self._vicjob_dirty: set = set()
-        self._vicjob_refresh: set = set()
+        #: observers fired (outside the lock) when a PENDING pod lands —
+        #: the schedule-on-arrival sub-cycle registers here
+        #: (runtime/subcycle.py); hooks must never raise
+        self.arrival_hooks: List[Callable[[Pod], None]] = []
         #: persistent static-term encoder state (kernels/terms.TermsCache);
         #: invalidated whenever node labels/taints/shape change
         self.terms_cache = None
@@ -218,10 +209,6 @@ class SchedulerCache:
         #: proportion consume it each open, drf.go:59-60); recomputed
         #: lazily after any node-shape change instead of walked per open
         self._alloc_total: Optional[Resource] = None
-        #: uids cache truth holds that snapshots exclude (no PodGroup/
-        #: PDB, or missing queue) — rebuilt by the full snapshot paths,
-        #: patched at dirty jobs by the incremental path
-        self._excluded_uids: set = set()
         #: bumped whenever the NODE ITERATION ORDER can change (new node
         #: appended, node deleted — a delete+re-add reorders the dict
         #: without changing the set); consumers caching order-derived
@@ -310,18 +297,27 @@ class SchedulerCache:
         return False
 
     # ------------------------------------------------------------------
-    # incremental-snapshot bookkeeping
+    # event-fold bookkeeping (cache/eventfold.py owns the state; these
+    # properties keep the old read surface for external consumers —
+    # kernels/victims.py and tests)
     # ------------------------------------------------------------------
+    @property
+    def _incremental(self) -> bool:
+        return self.fold.enabled
+
+    @property
+    def _vic_refresh(self) -> set:
+        return self.fold.vic_refresh
+
+    @property
+    def _vicjob_refresh(self) -> set:
+        return self.fold.vicjob_refresh
+
     def _mark_job(self, uid: str) -> None:
-        if self._incremental:
-            self._dirty_jobs.add(uid)
-            self._vicjob_dirty.add(uid)
+        self.fold.mark_job(uid)
 
     def _mark_node(self, name: str) -> None:
-        if self._incremental:
-            self._dirty_nodes.add(name)
-            self._dev_dirty.add(name)
-            self._vic_dirty.add(name)
+        self.fold.mark_node(name)
 
     def _mark_node_shape(self, name: str) -> None:
         """A node's static profile (labels/taints/unschedulable/allocatable)
@@ -347,7 +343,8 @@ class SchedulerCache:
         full clone next cycle. The epoch bump also voids adoption of any
         session snapshot handed out BEFORE the change (its clones carry
         pre-change priorities/inclusion)."""
-        self._snap_base = None
+        self.fold.invalidate()
+        self.fold.record("invalidate")
         self._dev_state = None
         self.terms_cache = None
         self.victim_segments = None
@@ -421,20 +418,42 @@ class SchedulerCache:
             return
         with self._lock:
             self._add_task(TaskInfo(pod))
+            self.fold.record("pod.add")
+        self._fire_arrival_hooks(pod)
+
+    def _fire_arrival_hooks(self, pod: Pod) -> None:
+        """Notify arrival observers (the schedule-on-arrival sub-cycle)
+        about a freshly-added PENDING pod — OUTSIDE the cache lock: the
+        hook opens a session, which re-enters the cache."""
+        if not self.arrival_hooks or pod.phase != PodPhase.PENDING:
+            return
+        for hook in list(self.arrival_hooks):
+            try:
+                hook(pod)
+            except Exception:   # an observer must never wedge ingestion
+                log.exception("pod arrival hook failed")
 
     def update_pod(self, old: Pod, new: Pod) -> None:
         """Delete + re-add (ref: event_handlers.go:108-122). Relevance is
         per-side: a pod that was filtered at add time (old irrelevant) is
-        treated as a fresh add, like client-go's filtering handler does."""
+        treated as a fresh add, like client-go's filtering handler does —
+        including the arrival hooks, so a latency-lane pod that becomes
+        relevant via an update still gets its sub-cycle."""
         with self._lock:
-            if self._pod_relevant(old):
+            was_relevant = self._pod_relevant(old)
+            if was_relevant:
                 self._delete_pod_locked(old)
-            if self._pod_relevant(new):
+            now_relevant = self._pod_relevant(new)
+            if now_relevant:
                 self._add_task(TaskInfo(new))
+            self.fold.record("pod.update")
+        if now_relevant and not was_relevant:
+            self._fire_arrival_hooks(new)
 
     def delete_pod(self, pod: Pod) -> None:
         with self._lock:
             self._delete_pod_locked(pod)
+            self.fold.record("pod.delete")
 
     def _delete_pod_locked(self, pod: Pod) -> None:
         """ref: event_handlers.go:151-171 — prefer the cache's own task (it
@@ -459,6 +478,7 @@ class SchedulerCache:
                 self.nodes[node.name] = NodeInfo(node)
                 self._node_order_epoch += 1
             self._mark_node_shape(node.name)
+            self.fold.record("node.add")
 
     def update_node(self, old: Node, new: Node) -> None:
         with self._lock:
@@ -470,6 +490,7 @@ class SchedulerCache:
                     or old.unschedulable != new.unschedulable):
                 ni.set_node(new)
                 self._mark_node_shape(new.name)
+            self.fold.record("node.update")
 
     def delete_node(self, node: Node) -> None:
         with self._lock:
@@ -478,6 +499,7 @@ class SchedulerCache:
             del self.nodes[node.name]
             self._node_order_epoch += 1
             self._mark_node_shape(node.name)
+            self.fold.record("node.delete")
 
     # ------------------------------------------------------------------
     # PodGroup / PDB / Queue / PriorityClass (ref: event_handlers.go:358-769)
@@ -485,10 +507,12 @@ class SchedulerCache:
     def add_pod_group(self, pg: PodGroup) -> None:
         with self._lock:
             self._set_pod_group(pg)
+            self.fold.record("podgroup.add")
 
     def update_pod_group(self, old: PodGroup, new: PodGroup) -> None:
         with self._lock:
             self._set_pod_group(new)
+            self.fold.record("podgroup.update")
 
     def delete_pod_group(self, pg: PodGroup) -> None:
         with self._lock:
@@ -498,6 +522,7 @@ class SchedulerCache:
                 raise KeyError(f"can not find job {job_id}")
             job.unset_pod_group()
             self._mark_job(job_id)
+            self.fold.record("podgroup.delete")
             self.deleted_jobs.add_rate_limited(job)
 
     def _set_pod_group(self, pg: PodGroup) -> None:
@@ -621,6 +646,7 @@ class SchedulerCache:
             node.add_task(task)
             self._mark_job(job.uid)
             self._mark_node(hostname)
+            self.fold.record("bind")
             pod = task.pod
 
         self._submit(lambda: self._bind_one(task, pod, hostname))
@@ -658,7 +684,11 @@ class SchedulerCache:
 
         submits = []
         binding = TaskStatus.BINDING
-        with self._lock:
+        # the "apply" phase: grouped column updates under ONE lock hold —
+        # the decision-apply share of the steady host split
+        # (bench host_share split; ISSUE 9 tentpole part 3)
+        with _span("apply", cat="phase", decisions=len(bindings)), \
+                self._lock:
             # resolve every lookup BEFORE mutating: a vanished pod or a
             # duplicate key must reject the batch while the cache is still
             # consistent (the deferred arithmetic below never half-applies).
@@ -806,18 +836,62 @@ class SchedulerCache:
                 self._mark_node(hostname)
 
             submits.extend((t, t.pod, h) for t, h in zip(twins, hostnames))
+            self.fold.record("bind", n=len(submits))
 
-        if self._pool is None:
-            # sync mode: run inline without the per-task closure allocation
-            # (10k+ binds per cycle at the stress configs)
-            bind_one = self._bind_one
-            for task, pod, hostname in submits:
-                bind_one(task, pod, hostname)
+        self._submit_binds(submits)
+
+    def _submit_binds(self, submits: List[tuple]) -> None:
+        """Ship a decision batch through the binder seam. A binder that
+        exposes ``bind_many`` gets the whole batch in a few chunked
+        calls (one seam crossing + one API round-trip per chunk instead
+        of one per task — the last per-decision Python in the apply
+        path); per-task ``bind`` stays the fallback, byte-for-byte the
+        old behavior."""
+        if not submits:
             return
+        binder_many = getattr(self.binder, "bind_many", None)
+        if binder_many is None:
+            if self._pool is None:
+                # sync mode: run inline without the per-task closure
+                # allocation (10k+ binds per cycle at the stress configs)
+                bind_one = self._bind_one
+                for task, pod, hostname in submits:
+                    bind_one(task, pod, hostname)
+                return
+            for task, pod, hostname in submits:
+                self._submit(
+                    lambda t=task, p=pod, h=hostname: self._bind_one(t, p, h))
+            return
+        # batched seam: chunk so the async pool still parallelizes the
+        # write-back where it used to fan out per task
+        n_chunks = 8 if self._pool is not None else 1
+        size = max(1, -(-len(submits) // n_chunks))
+        for i in range(0, len(submits), size):
+            chunk = submits[i:i + size]
+            if self._pool is None:
+                self._bind_batch(chunk)
+            else:
+                self._submit(lambda c=chunk: self._bind_batch(c))
 
-        for task, pod, hostname in submits:
-            self._submit(
-                lambda t=task, p=pod, h=hostname: self._bind_one(t, p, h))
+    def _bind_batch(self, chunk: List[tuple]) -> None:
+        """The API-side half of a bind batch: ONE seam crossing + one
+        ``binder.bind_many`` POST for the chunk; on failure every task
+        of the chunk resyncs (the rate-limited repair loop re-derives
+        per-task truth, so the conservative blast radius heals exactly
+        like per-task failures do)."""
+        try:
+            _fault_check("cache.bind")    # injection seam, once per chunk
+            self.binder.bind_many([(pod, hostname)
+                                   for _, pod, hostname in chunk])
+        except Exception:
+            for task, _, _ in chunk:
+                self.resync_task(task)
+            return
+        for _, pod, hostname in chunk:
+            self.recorder.eventf(
+                pod, "Normal", "Scheduled",
+                f"Successfully assigned {pod.namespace}/{pod.name} "
+                f"to {hostname}")
 
     def evict(self, ti: TaskInfo, reason: str) -> None:
         """ref: cache.go:349-389."""
@@ -831,6 +905,7 @@ class SchedulerCache:
             node.update_task(task)
             self._mark_job(job.uid)
             self._mark_node(task.node_name)
+            self.fold.record("evict")
             pod = task.pod
             pg = job.pod_group
 
@@ -876,6 +951,7 @@ class SchedulerCache:
                 new_pod: Optional[Pod] = old_task.pod
             else:
                 new_pod = self.pod_lister(old_task.namespace, old_task.name)
+            self.fold.record("resync")
             if new_pod is None:
                 self._delete_task(old_task)
                 return
@@ -899,106 +975,117 @@ class SchedulerCache:
     # snapshot (ref: cache.go:515-583)
     # ------------------------------------------------------------------
     def snapshot(self) -> ClusterInfo:
-        """Deep-copied cluster view for one session. With incremental
-        snapshots enabled, entity clones from the previous session are
-        reused when neither the cache (dirty sets) nor that session
-        (touched sets, folded in at adopt_snapshot) invalidated them —
-        output is deep-equal to snapshot_full() by construction."""
+        """The session's cluster view, assembled from the FOLDED state:
+        entity clones from the previous session are reused when neither
+        the cache (event-fold dirty marks) nor that session (touched
+        sets, folded in at adopt_snapshot) invalidated them — output is
+        deep-equal to snapshot_full() by construction, and the lazy
+        audit (audited_snapshot / KUBEBATCH_AUDIT_EVERY) asserts it."""
         with self._lock:
             self._handout_epoch = self._snap_epoch
             self._handout_shape_epoch = self._shape_epoch
-            self._dev_refresh |= self._dev_dirty
-            self._dev_dirty = set()
-            self._vic_refresh |= self._vic_dirty
-            self._vic_dirty = set()
-            self._vicjob_refresh |= self._vicjob_dirty
-            self._vicjob_dirty = set()
-            if self.victim_segments is None:
-                # no store to refresh against (host victim mode, store
-                # dropped, or never built): the next build is a full one
-                # anyway — without this, a scheduler that never runs the
-                # device victim path accumulates job uids forever
-                self._vic_refresh.clear()
-                self._vicjob_refresh.clear()
+            fold = self.fold
+            fold.migrate_marks(self.victim_segments is not None)
             alloc_total = self._allocatable_total_locked()
-            base = self._snap_base
-            if not self._incremental or base is None:
+            if not fold.enabled or fold.base is None:
                 snap = self.snapshot_full()
-                if self._incremental:
+                if fold.enabled:
                     # the full clone IS current truth for every entity
-                    self._dirty_jobs.clear()
-                    self._dirty_nodes.clear()
+                    fold.dirty_jobs.clear()
+                    fold.dirty_nodes.clear()
                 return snap
-            base_jobs, base_nodes = base
-            # the base is consumed: the objects are handed to the new
-            # session, which will mutate them. If the session dies before
-            # adoption, the next snapshot is a full clone.
-            self._snap_base = None
-            dirty_jobs, self._dirty_jobs = self._dirty_jobs, set()
-            dirty_nodes, self._dirty_nodes = self._dirty_nodes, set()
-            snap = ClusterInfo()
-            snap.allocatable_total = alloc_total
-            snap.node_order_epoch = self._node_order_epoch
-            snap.refreshed_jobs = set()
-            # O(churn) assembly: bulk dict copies of the adopted base
-            # (C-speed) patched only at dirty keys — the per-entity
-            # Python walk over 5k nodes + 1k jobs was the steady open
-            # phase's floor. Soundness: every way an entity can appear,
-            # vanish, or change marks it dirty (cache handlers, session
-            # touched sets folded at adoption, validate-dropped jobs),
-            # and cluster-wide inputs (queues, priority classes) bump the
-            # snapshot epoch, which forces the full path instead.
-            nodes_map = dict(base_nodes)
-            for name in dirty_nodes:
-                ni = self.nodes.get(name)
-                if ni is None:
-                    nodes_map.pop(name, None)
-                else:
-                    nodes_map[name] = ni.clone()
-            snap.nodes = nodes_map
-            for uid, q in self.queues.items():
-                snap.queues[uid] = q.clone()
-            jobs_map = dict(base_jobs)
-            excluded = self._excluded_uids
-            for uid in dirty_jobs:
-                job = self.jobs.get(uid)
-                if job is None:
-                    jobs_map.pop(uid, None)
-                    excluded.discard(uid)
-                    continue
-                if self._job_excluded(job, snap.queues):
-                    jobs_map.pop(uid, None)
-                    excluded.add(uid)
-                    continue
+            with _span("fold", cat="phase"):
+                return self._snapshot_folded_locked(alloc_total)
+
+    def _snapshot_folded_locked(self, alloc_total) -> ClusterInfo:
+        """O(events) assembly: bulk dict copies of the adopted base
+        (C-speed) patched only at event-dirtied keys — the per-entity
+        Python walk over 5k nodes + 1k jobs was the steady open phase's
+        floor. Soundness: every way an entity can appear, vanish, or
+        change folds a dirty mark (cache handlers via EventFold, session
+        touched sets folded at adoption, validate-dropped jobs), and
+        cluster-wide inputs (queues, priority classes) bump the snapshot
+        epoch, which forces the full path instead."""
+        base, dirty_jobs, dirty_nodes = self.fold.take_base()
+        base_jobs, base_nodes = base
+        snap = ClusterInfo()
+        snap.allocatable_total = alloc_total
+        snap.node_order_epoch = self._node_order_epoch
+        snap.refreshed_jobs = set()
+        nodes_map = dict(base_nodes)
+        for name in dirty_nodes:
+            ni = self.nodes.get(name)
+            if ni is None:
+                nodes_map.pop(name, None)
+            else:
+                nodes_map[name] = ni.clone()
+        snap.nodes = nodes_map
+        for uid, q in self.queues.items():
+            snap.queues[uid] = q.clone()
+        jobs_map = dict(base_jobs)
+        excluded = self.fold.excluded_uids
+        for uid in dirty_jobs:
+            job = self.jobs.get(uid)
+            if job is None:
+                jobs_map.pop(uid, None)
                 excluded.discard(uid)
-                self._stamp_priority(job)
-                jobs_map[uid] = job.clone()
-                snap.refreshed_jobs.add(uid)
-            snap.jobs = jobs_map
-            snap.jobs_excluded = len(excluded)
-            return snap
+                continue
+            if self._job_excluded(job, snap.queues):
+                jobs_map.pop(uid, None)
+                excluded.add(uid)
+                continue
+            excluded.discard(uid)
+            self._stamp_priority(job)
+            jobs_map[uid] = job.clone()
+            snap.refreshed_jobs.add(uid)
+        snap.jobs = jobs_map
+        snap.jobs_excluded = len(excluded)
+        return snap
 
     def snapshot_full(self) -> ClusterInfo:
         """From-scratch deep clone (the reference's snapshot semantics,
-        cache.go:515-583) — also the oracle the incremental path is
-        equality-tested against."""
+        cache.go:515-583) — demoted from the per-cycle input to the LAZY
+        AUDIT VIEW: built on demand (debug endpoints, host-oracle pins,
+        audited_snapshot) and by the snapshot-primary fallback, never on
+        the folded steady cycle's critical path. Also the oracle the
+        fold path is equality-tested against."""
         with self._lock:
             snap = ClusterInfo()
             snap.allocatable_total = self._allocatable_total_locked()
             snap.node_order_epoch = self._node_order_epoch
-            self._excluded_uids = set()
+            excluded = self.fold.excluded_uids = set()
             for name, node in self.nodes.items():
                 snap.nodes[node.name] = node.clone()
             for uid, q in self.queues.items():
                 snap.queues[uid] = q.clone()
             for uid, job in self.jobs.items():
                 if self._job_excluded(job, snap.queues):
-                    self._excluded_uids.add(uid)
+                    excluded.add(uid)
                     continue
                 self._stamp_priority(job)
                 snap.jobs[uid] = job.clone()
-            snap.jobs_excluded = len(self._excluded_uids)
+            snap.jobs_excluded = len(excluded)
             return snap
+
+    def audited_snapshot(self) -> Tuple[ClusterInfo, List[str]]:
+        """The lazy audit: build the from-scratch oracle AND the folded
+        snapshot under ONE lock hold (no events can land between them)
+        and deep-compare. Returns ``(snapshot, diffs)`` — on divergence
+        the fold layer DEMOTES itself to snapshot-primary (the ladder
+        rung; counted in fold_demotions_total) and the returned snapshot
+        is the trustworthy full clone, so the calling cycle proceeds on
+        sound state. Scheduler cadence: KUBEBATCH_AUDIT_EVERY /
+        ``Scheduler(audit_every=N)``; the chaos soak runs it too."""
+        from ..debug import snapshot_diff
+
+        with self._lock:
+            full = self.snapshot_full()
+            snap = self.snapshot()
+            diffs = snapshot_diff(snap, full)
+            if diffs:
+                self.fold.demote("audit")
+                snap = full
+        return snap, diffs
 
     @staticmethod
     def _job_excluded(job: JobInfo, queues: Dict[str, QueueInfo]) -> bool:
@@ -1037,19 +1124,14 @@ class SchedulerCache:
         state a fresh clone would produce (clones share pod/pod_group/pdb
         objects with cache truth, so status write-back at close is visible
         on both sides)."""
-        if not self._incremental:
+        if not self.fold.enabled:
             return
         with self._lock:
             if self._snap_epoch != self._handout_epoch:
                 # a cluster-wide invalidation landed mid-session: the
                 # session's clones predate it — full clone next cycle
                 return
-            self._dirty_jobs |= ssn.touched_jobs
-            self._dirty_nodes |= ssn.touched_nodes
-            self._dev_dirty |= ssn.touched_nodes
-            self._vic_dirty |= ssn.touched_nodes
-            self._vicjob_dirty |= ssn.touched_jobs
-            self._snap_base = (ssn.jobs, ssn.nodes)
+            self.fold.adopt(ssn)
             if ssn.device_snapshot is not None:
                 self._dev_state = ssn.device_snapshot
             vs = getattr(ssn, "_victim_store", None)
@@ -1067,13 +1149,13 @@ class SchedulerCache:
         with self._lock:
             ds = self._dev_state
             self._dev_state = None   # consumed; re-adopted at close
-            if not self._incremental or ds is None:
+            if not self.fold.enabled or ds is None:
                 # the fresh build reflects the session snapshot — marks up
-                # to THAT point are satisfied; later marks (_dev_dirty)
+                # to THAT point are satisfied; later marks (dev_dirty)
                 # must survive to the next snapshot
-                self._dev_refresh.clear()
+                self.fold.dev_refresh.clear()
                 return DeviceSession(ssn.nodes)
-            refresh, self._dev_refresh = self._dev_refresh, set()
+            refresh, self.fold.dev_refresh = self.fold.dev_refresh, set()
         refresh |= ssn.touched_nodes
         if not ds.update_rows(ssn.nodes, refresh):
             return DeviceSession(ssn.nodes)

@@ -17,7 +17,13 @@ from ..objects import Pod, PodGroup
 @runtime_checkable
 class Binder(Protocol):
     def bind(self, pod: Pod, hostname: str) -> None:
-        """Bind pod to host; raise on failure (ref: interface.go:63-65)."""
+        """Bind pod to host; raise on failure (ref: interface.go:63-65).
+
+        A binder MAY additionally expose ``bind_many(pairs)`` taking a
+        list of ``(pod, hostname)`` tuples; the cache then ships whole
+        decision batches through one call per chunk instead of one seam
+        crossing per task (cache.py _submit_binds). All-or-nothing per
+        chunk: a raise resyncs every task of the chunk."""
         ...
 
 
@@ -71,6 +77,12 @@ class NullBinder:
 
     def bind(self, pod: Pod, hostname: str) -> None:
         pod.node_name = hostname
+
+    def bind_many(self, pairs) -> None:
+        """Batched form (see Binder protocol): one call per decision
+        chunk instead of one per task."""
+        for pod, hostname in pairs:
+            pod.node_name = hostname
 
 
 class NullEvictor:

@@ -66,6 +66,10 @@ SEAMS: Dict[str, str] = {
     "cache.evict": "evictor write-back (cache/cache.py evict)",
     "cache.resync": "resync ground-truth replay (cache/cache.py "
                     "sync_task)",
+    "cache.fold": "event-fold layer (cache/eventfold.py — a fired seam "
+                  "DEMOTES the cache to snapshot-primary full clones "
+                  "for the rest of the process instead of raising; the "
+                  "degradation rung, not a crash)",
     "source.deliver": "sim event-stream delivery (sim/source.py pump)",
     "source.disconnect": "watch stream drop (cache/k8s_source.py watch "
                          "loop)",

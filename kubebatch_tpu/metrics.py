@@ -128,6 +128,27 @@ if _PROM:
         "shed_level",
         "Current tenantsvc shed-ladder level (0=none, 1=serve-stale, "
         "2=reject-lowest)", namespace=NAMESPACE)
+    subcycle_counter = Counter(
+        "subcycles_total",
+        "Schedule-on-arrival sub-cycles run between full cycles "
+        "(runtime/subcycle.py: a latency-lane pod arrival solved "
+        "against the live device arrays without waiting for the period)",
+        namespace=NAMESPACE)
+    audit_cycle_counter = Counter(
+        "audit_cycles_total",
+        "Lazy-audit snapshot builds (cache.audited_snapshot: folded "
+        "state deep-compared against a fresh full clone), by result",
+        ["result"], namespace=NAMESPACE)
+    fold_demotion_counter = Counter(
+        "fold_demotions_total",
+        "Event-fold layer demotions back to snapshot-primary full "
+        "clones (audit mismatch or injected cache.fold fault)",
+        ["reason"], namespace=NAMESPACE)
+    arrival_latency = Histogram(
+        "subcycle_arrival_latency_milliseconds",
+        "Latency-lane pod arrival -> decision latency through the "
+        "schedule-on-arrival sub-cycle, milliseconds",
+        namespace=NAMESPACE, buckets=_buckets(1, 2, 12))
 
 
 def update_plugin_duration(plugin: str, phase: str, seconds: float) -> None:
@@ -458,6 +479,131 @@ def load_shed_total() -> dict:
         return dict(_load_shed)
 
 
+# ---------------------------------------------------------------------------
+# event-fold / sub-cycle accounting (ISSUE 9: event-driven incremental
+# cycles). Same discipline as the robustness counters: process-lifetime
+# values consumers diff across a window. events_folded is hit from
+# whatever thread delivers cache events (sim pump, grpc handlers, the
+# scheduler's own write-back), so the read-modify-write takes the lock.
+# The per-kind fold counts are deliberately NOT mirrored into prometheus
+# per event (a label lookup per cache event is measurable at 10k-pod
+# populate bursts); /debug/vars serves them from counters_snapshot.
+# ---------------------------------------------------------------------------
+
+from collections import deque as _deque
+
+_events_folded: dict = {}
+_subcycles = 0
+_audit_cycles = 0
+_audit_failures = 0
+_fold_demotions: dict = {}
+
+#: bounded ring of arrival -> decision latencies (seconds) observed by
+#: the schedule-on-arrival sub-cycle; consumers read percentiles
+ARRIVAL_STATS: "_deque" = _deque(maxlen=4096)
+
+
+def count_event_folded(kind: str, n: int = 1) -> None:
+    """Record n cache events folded into the persistent state by the
+    event-fold layer (cache/eventfold.py), per kind ("pod.add", "bind",
+    ...)."""
+    with _robust_lock:
+        _events_folded[kind] = _events_folded.get(kind, 0) + n
+
+
+def events_folded_total() -> dict:
+    """Process-lifetime folded-event counts per kind (a copy)."""
+    with _robust_lock:
+        return dict(_events_folded)
+
+
+def count_subcycle() -> None:
+    """Record one schedule-on-arrival sub-cycle."""
+    global _subcycles
+    with _robust_lock:
+        _subcycles += 1
+    if _PROM:
+        subcycle_counter.inc()
+
+
+def subcycles_total() -> int:
+    with _robust_lock:
+        return _subcycles
+
+
+def count_audit_cycle(ok: bool) -> None:
+    """Record one lazy-audit build (folded state vs fresh full clone);
+    ``ok=False`` means snapshot_diff found divergence — the fold layer
+    demotes to snapshot-primary on that path."""
+    global _audit_cycles, _audit_failures
+    with _robust_lock:
+        _audit_cycles += 1
+        if not ok:
+            _audit_failures += 1
+    if _PROM:
+        audit_cycle_counter.labels("ok" if ok else "diff").inc()
+
+
+def audit_cycles_total() -> int:
+    with _robust_lock:
+        return _audit_cycles
+
+
+def audit_failures_total() -> int:
+    with _robust_lock:
+        return _audit_failures
+
+
+def count_fold_demotion(reason: str) -> None:
+    """Record one event-fold demotion back to snapshot-primary
+    ("audit" = divergence caught by the lazy audit, "fault" = injected
+    cache.fold seam)."""
+    with _robust_lock:
+        _fold_demotions[reason] = _fold_demotions.get(reason, 0) + 1
+    if _PROM:
+        fold_demotion_counter.labels(reason).inc()
+
+
+def fold_demotions_total() -> dict:
+    with _robust_lock:
+        return dict(_fold_demotions)
+
+
+_arrivals_observed = 0
+
+
+def observe_arrival_latency(seconds: float) -> None:
+    """Record one latency-lane arrival -> decision duration (sub-cycle)."""
+    global _arrivals_observed
+    with _robust_lock:
+        _arrivals_observed += 1
+    ARRIVAL_STATS.append(seconds)
+    if _PROM:
+        arrival_latency.observe(seconds * 1e3)
+
+
+def arrivals_observed_total() -> int:
+    """Monotonic count of recorded arrival latencies (ARRIVAL_STATS is
+    a bounded ring, so ``len()`` stops growing once it wraps — windowed
+    consumers diff THIS counter instead)."""
+    with _robust_lock:
+        return _arrivals_observed
+
+
+def arrival_latency_percentiles() -> dict:
+    """p50/p99 (ms) of the recent sub-cycle arrival -> decision
+    latencies; empty dict when no sub-cycle ran."""
+    stats = list(ARRIVAL_STATS)
+    if not stats:
+        return {}
+    import numpy as _np
+
+    ms = _np.asarray(stats) * 1e3
+    return {"arrivals": len(stats),
+            "arrival_ms_p50": round(float(_np.percentile(ms, 50)), 3),
+            "arrival_ms_p99": round(float(_np.percentile(ms, 99)), 3)}
+
+
 _solver_kernel_seconds = 0.0
 
 
@@ -619,7 +765,17 @@ def counters_snapshot(include_rpc: bool = True) -> dict:
         "load_shed_total": load_shed_total(),
         "mega_dispatches_total": mega_dispatches_total(),
         "mega_lanes_total": mega_lanes_total(),
+        "events_folded_total": events_folded_total(),
+        "subcycles_total": subcycles_total(),
+        "audit_cycles_total": audit_cycles_total(),
+        "audit_failures_total": audit_failures_total(),
+        "fold_demotions_total": fold_demotions_total(),
     }
+    arrival = arrival_latency_percentiles()
+    if arrival:
+        # sub-cycle arrival -> decision percentiles on /debug/vars and
+        # the flight recorder — the latency-lane evidence (ISSUE 9)
+        snap["subcycle_arrival"] = arrival
     tenants = tenant_counters()
     if tenants:
         # the per-tenant section: /debug/vars and flight dumps from a

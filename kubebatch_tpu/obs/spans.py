@@ -280,13 +280,18 @@ def span(name: str, cat: str = "host", **args) -> _SpanCtx:
 # cycle roots
 # ---------------------------------------------------------------------
 
-def begin_cycle(cycle_id: Optional[int] = None, **args) -> Span:
+def begin_cycle(cycle_id: Optional[int] = None, name: str = "cycle",
+                **args) -> Span:
     """Open a cycle root span on this thread. Pair with end_cycle in a
     try/finally — the scheduler needs the measured duration after exit
-    (deadline budget), which a plain with-statement can't give it."""
+    (deadline budget), which a plain with-statement can't give it.
+    ``name`` labels the root ("cycle" for the period loop; the
+    schedule-on-arrival path opens "subcycle" roots, which therefore
+    appear as their own span roots in Chrome traces and the flight
+    ring — same tree machinery, no second tracer)."""
     if cycle_id is not None:
         args["cycle"] = cycle_id
-    root = Span("cycle", "cycle", args or None)
+    root = Span(name, "cycle", args or None)
     if _ENABLED:
         st = _stack()
         if st:                             # nested cycle: plain child span

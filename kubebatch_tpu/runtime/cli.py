@@ -108,6 +108,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "cycle's actions (one extra device readback; "
                         "off the steady path by default) and serve the "
                         "snapshot on /debug/explain")
+    p.add_argument("--audit-every", type=int, default=None, metavar="N",
+                   help="lazy-audit cadence: every Nth cycle deep-"
+                        "compares the folded snapshot against a fresh "
+                        "full clone (snapshot_diff == 0 asserted; a "
+                        "divergence demotes the event-fold layer to "
+                        "snapshot-primary). Default: KUBEBATCH_AUDIT_"
+                        "EVERY, else off")
+    p.add_argument("--subcycle", action="store_true", default=None,
+                   help="schedule-on-arrival: latency-lane pod arrivals "
+                        "(annotation scheduling.k8s.io/kube-batch/"
+                        "lane=latency) get a narrow allocate against "
+                        "the live device arrays immediately instead of "
+                        "waiting for the schedule period (also "
+                        "KUBEBATCH_SUBCYCLE=1)")
     return p
 
 
@@ -234,7 +248,9 @@ def main(argv=None) -> int:
                       schedule_period=args.schedule_period,
                       enable_preemption=args.enable_preemption,
                       cycle_deadline=args.cycle_deadline,
-                      explain_unschedulable=args.explain_unschedulable)
+                      explain_unschedulable=args.explain_unschedulable,
+                      audit_every=args.audit_every,
+                      subcycle=args.subcycle)
 
     stop = threading.Event()
 

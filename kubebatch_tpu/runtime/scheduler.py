@@ -59,7 +59,9 @@ class Scheduler:
                  schedule_period: float = 1.0,
                  enable_preemption: bool = False,
                  cycle_deadline: Optional[float] = None,
-                 explain_unschedulable: bool = False):
+                 explain_unschedulable: bool = False,
+                 audit_every: Optional[int] = None,
+                 subcycle: Optional[bool] = None):
         self.cache = cache
         self.schedule_period = schedule_period
         self.enable_preemption = enable_preemption
@@ -71,6 +73,31 @@ class Scheduler:
         #: per-cycle wall budget (seconds); an overrun counts as a cycle
         #: failure for the degradation ladder. None = no budget.
         self.cycle_deadline = cycle_deadline
+        #: lazy-audit cadence (ISSUE 9): every Nth cycle opens from
+        #: cache.audited_snapshot() — the folded state deep-compared
+        #: against a fresh full clone (snapshot_diff == 0 asserted; a
+        #: divergence demotes the fold layer to snapshot-primary and the
+        #: cycle proceeds on the trustworthy full clone). 0/None = off.
+        if audit_every is None:
+            env = os.environ.get("KUBEBATCH_AUDIT_EVERY", "")
+            audit_every = int(env) if env else 0
+        self.audit_every = int(audit_every or 0)
+        #: schedule-on-arrival sub-cycle (ISSUE 9): latency-lane pod
+        #: arrivals get a narrow allocate against the live device arrays
+        #: instead of waiting for the period (runtime/subcycle.py)
+        if subcycle is None:
+            from ..util import env_on
+            subcycle = env_on("KUBEBATCH_SUBCYCLE", default="0")
+        self.subcycle_enabled = bool(subcycle)
+        #: full cycles and sub-cycles never overlap: both run under this
+        #: lock (arrival hooks block on it for at most one cycle)
+        self._cycle_lock = threading.Lock()
+        self._arrival_lock = threading.Lock()
+        self._pending_arrivals: list = []
+        self._subcycle_seq = -1
+        if self.subcycle_enabled \
+                and hasattr(cache, "arrival_hooks"):
+            cache.arrival_hooks.append(self._on_pod_arrival)
         #: the process-wide degradation ladder (faults.py): run_cycle
         #: feeds it failures/successes, AllocateAction consults its cap
         self.ladder = _faults.LADDER
@@ -138,6 +165,44 @@ class Scheduler:
         from .watchdog import midrun_probe
         return midrun_probe()
 
+    # ------------------------------------------------------------------
+    # schedule-on-arrival (ISSUE 9; runtime/subcycle.py)
+    # ------------------------------------------------------------------
+    def _on_pod_arrival(self, pod) -> None:
+        """Cache arrival hook (fired outside the cache lock, on the
+        event-delivery thread): queue latency-lane pods and drain them
+        through a sub-cycle. A non-latency pod costs one annotation
+        lookup."""
+        import time as _time
+
+        from .subcycle import is_latency_pod
+        if not is_latency_pod(pod):
+            return
+        with self._arrival_lock:
+            self._pending_arrivals.append((pod, _time.perf_counter()))
+        self._drain_arrivals()
+
+    def _drain_arrivals(self) -> None:
+        """Run one sub-cycle over every queued arrival. Blocks on the
+        cycle lock (never overlaps a full cycle; a hook thread waiting
+        here coalesces the burst that accumulated meanwhile). Guarded:
+        a failing sub-cycle is counted, logged, and never propagates
+        into the event pump."""
+        from . import subcycle as _subcycle
+
+        with self._cycle_lock:
+            with self._arrival_lock:
+                arrivals, self._pending_arrivals = \
+                    self._pending_arrivals, []
+            if not arrivals:
+                return
+            try:
+                _subcycle.run_subcycle(self, arrivals)
+            except Exception:
+                log.exception("schedule-on-arrival sub-cycle failed; "
+                              "pods wait for the next full cycle")
+                count_cycle_failure("subcycle")
+
     def run_cycle(self) -> bool:
         """One GUARDED cycle: never raises. A raising cycle is logged
         structurally and counted (cycle_failures_total{reason=exception});
@@ -161,7 +226,10 @@ class Scheduler:
         root = _obs.begin_cycle(self._cycle_seq,
                                 ladder=self.ladder.level)
         try:
-            self.run_once()
+            # full cycles and schedule-on-arrival sub-cycles serialize
+            # on the cycle lock (an arrival hook mid-cycle waits here)
+            with self._cycle_lock:
+                self.run_once()
         except Exception:
             # a failed cycle must not kill the loop (run_once guarantees
             # CloseSession ran: statements rolled back, status written,
@@ -209,10 +277,29 @@ class Scheduler:
         each action span feeds action_scheduling_latency."""
         jobs = nodes = None
         session_span = None
+        snapshot = None
+        if (self.audit_every
+                and self._cycle_seq % self.audit_every == 0
+                and hasattr(self.cache, "audited_snapshot")):
+            # the lazy audit (ISSUE 9): build the full-clone oracle next
+            # to the folded snapshot and deep-compare; a divergence
+            # demotes the fold layer (cache side) — here it is counted,
+            # logged, and flight-dumped, and the cycle proceeds on the
+            # trustworthy full clone audited_snapshot returned
+            from ..metrics import count_audit_cycle
+            from ..obs import flight as _flight
+            with _obs.span("audit", cat="phase"):
+                snapshot, diffs = self.cache.audited_snapshot()
+            count_audit_cycle(ok=not diffs)
+            if diffs:
+                log.error("fold audit FAILED (%d diffs; fold demoted to "
+                          "snapshot-primary): %s", len(diffs), diffs[:4])
+                _flight.maybe_dump_on_failure("fold-audit")
         try:
             with _obs.span("session", cat="e2e") as session_span:
                 ssn = OpenSession(self.cache, self.tiers,
-                                  self.enable_preemption)
+                                  self.enable_preemption,
+                                  snapshot=snapshot)
                 jobs, nodes = len(ssn.jobs), len(ssn.nodes)
                 try:
                     for action in self.actions:

@@ -1,0 +1,161 @@
+"""Schedule-on-arrival sub-cycle — latency-lane pods don't wait for t.
+
+The period loop solves the whole cluster once per ``schedule_period``
+(1 s by default); a latency-sensitive pod that arrives right after a
+cycle closes used to wait the full period for its placement. With the
+event-fold layer the cache carries everything a solve needs ACROSS
+cycles — the folded host base and the persistent device arrays — so a
+narrow allocate can run the moment the pod lands:
+
+- the cache's arrival hook fires (outside the cache lock) for every
+  PENDING pod whose lane annotation says ``latency``;
+- the scheduler drains queued arrivals under its cycle lock (a sub-cycle
+  never overlaps a full cycle; bursts coalesce into one sub-cycle);
+- the sub-cycle opens a session off the folded snapshot (O(events)),
+  re-packs only the dirty device rows, and runs ONE per-visit allocate
+  scan for the arrived pod's job — one dispatch, one blocking readback,
+  through the SAME registered compilesvc shape buckets the period loop
+  warmed (a 1-pod gang pads to the smallest registered gang bucket), so
+  recompiles stay 0;
+- decisions apply through the ordinary Session mutators and CloseSession
+  write-back, which is the whole idempotence argument: the bind lands in
+  cache truth as BINDING, the session clones are adopted as the next
+  base, and the next FULL cycle sees a non-pending task — it re-places
+  nothing, exactly as if the bind had happened in a previous full cycle
+  (docs/INCREMENTAL.md "sub-cycle idempotence").
+
+Each sub-cycle runs under its own obs cycle root (name "subcycle"), so
+it shows up as a separate root in Chrome traces and the flight ring;
+arrival -> decision latency feeds ``metrics.ARRIVAL_STATS``
+(``subcycle_arrival`` percentiles on /debug/vars).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Tuple
+
+from .. import obs as _obs
+from ..api import TaskStatus
+from ..api.job import get_job_id
+from ..metrics import count_subcycle, observe_arrival_latency
+from ..objects import Pod
+
+log = logging.getLogger("kubebatch.subcycle")
+
+#: pod annotation carrying the service lane — same vocabulary as the
+#: tenantsvc rpc lanes (kb-lane metadata: latency > normal > batch)
+LANE_ANNOTATION = "scheduling.k8s.io/kube-batch/lane"
+LATENCY_LANE = "latency"
+
+
+def pod_lane(pod: Pod) -> str:
+    return pod.annotations.get(LANE_ANNOTATION, "normal")
+
+
+def is_latency_pod(pod: Pod) -> bool:
+    """True for pods the sub-cycle serves: PENDING arrivals on the
+    latency lane."""
+    return pod_lane(pod) == LATENCY_LANE
+
+
+def _job_uid(pod: Pod) -> str:
+    """The cache's job uid for this pod (grouped pods: 'ns/group';
+    ungrouped pods get the shadow-group uid, cache/cache.py
+    create_shadow_pod_group)."""
+    return get_job_id(pod) or str(pod.owner_uid or pod.uid)
+
+
+def run_subcycle(scheduler, arrivals: List[Tuple[Pod, float]]) -> int:
+    """One narrow allocate for ``arrivals`` (a list of (pod, t_arrival)
+    perf_counter pairs). Returns the number of arrived pods that got a
+    decision. Caller (Scheduler._drain_arrivals) holds the cycle lock
+    and guards exceptions — a failing sub-cycle is logged and counted
+    (cycle_failures{reason=subcycle}), never propagated into the event
+    pump."""
+    from ..framework import CloseSession, OpenSession
+
+    cache = scheduler.cache
+    scheduler._subcycle_seq += 1
+    root = _obs.begin_cycle(scheduler._subcycle_seq, name="subcycle",
+                            arrivals=len(arrivals))
+    decided = 0
+    try:
+        with _obs.span("subcycle", cat="phase"):
+            ssn = OpenSession(cache, scheduler.tiers,
+                              scheduler.enable_preemption)
+            try:
+                decided = _solve_arrivals(ssn, arrivals)
+            finally:
+                CloseSession(ssn)
+    finally:
+        _obs.end_cycle(root)
+    count_subcycle()
+    return decided
+
+
+def _solve_arrivals(ssn, arrivals: List[Tuple[Pod, float]]) -> int:
+    """The narrow allocate: one per-visit solve per arrived job against
+    the live device arrays (or the reference host loop when the session
+    carries features outside the device vocabulary — same gate as the
+    period loop's per-visit path)."""
+    from ..actions.allocate import AllocateAction
+    from ..kernels.solver import ensure_device_snapshot
+    from ..kernels.terms import device_supported, solver_terms
+    from ..util import PriorityQueue
+
+    #: job uid -> [(pod, t_arrival)] — a burst of same-gang arrivals
+    #: solves in one visit
+    by_job = {}
+    for pod, t0 in arrivals:
+        by_job.setdefault(_job_uid(pod), []).append((pod, t0))
+
+    act = AllocateAction(mode="jax")
+    device = None
+    terms = None
+    pending = [t for uid in by_job
+               for j in (ssn.jobs.get(uid),) if j is not None
+               for t in j.task_status_index.get(TaskStatus.PENDING,
+                                                {}).values()
+               if not t.resreq.is_empty()]
+    if pending and device_supported(ssn, pending):
+        device = ensure_device_snapshot(ssn)
+        terms = solver_terms(ssn, device, pending, assume_supported=True)
+        if terms is None:
+            device = None
+
+    decided = 0
+    for uid, pods in by_job.items():
+        job = ssn.jobs.get(uid)
+        if job is None:
+            continue
+        tasks = PriorityQueue(ssn.task_order_fn)
+        for task in job.task_status_index.get(TaskStatus.PENDING,
+                                              {}).values():
+            if not task.resreq.is_empty():
+                tasks.push(task)
+        if tasks.empty():
+            continue
+        jobs_pq = PriorityQueue(ssn.job_order_fn)   # one visit; re-push
+        #                                             goes nowhere
+        if device is not None:
+            act._visit_job_device(ssn, device, job, tasks, jobs_pq, terms)
+        else:
+            act._visit_job_host(ssn, job, tasks, jobs_pq)
+        if not ssn.job_ready(job):
+            # gang barrier: a lone member of a min_member > 1 gang may
+            # sit ALLOCATED in the session, but the all-or-nothing gate
+            # discards that at close — the pod was NOT decided, it
+            # waits for the rest of its gang (then the period loop)
+            continue
+        now = time.perf_counter()
+        for pod, t0 in pods:
+            task = job.tasks.get(pod.uid)
+            if task is not None and task.status != TaskStatus.PENDING:
+                # the pod got a decision (ALLOCATED / BINDING /
+                # PIPELINED) this sub-cycle AND its gang is at quorum,
+                # so the close write-back dispatches it: that IS the
+                # arrival -> decision latency the lane promises
+                observe_arrival_latency(max(0.0, now - t0))
+                decided += 1
+    return decided

@@ -70,6 +70,15 @@ DEFAULT_RATES: Dict[str, float] = {
     "lease.renew": 0.3,
 }
 
+#: deterministic fail-first-N counts armed next to the rates: the
+#: cache.fold seam fires exactly once per soak, proving the event-fold
+#: demotion rung (fold -> snapshot-primary full clones) lands mid-churn
+#: with zero invariant violations — every cache event crosses the seam,
+#: so a rate would demote on the first faulted event every run anyway
+DEFAULT_COUNTS: Dict[str, int] = {
+    "cache.fold": 1,
+}
+
 #: the smoke-test subset: no device/rpc seams, so the ladder never
 #: demotes and the tier-1 run compiles no extra engines
 SMOKE_RATES: Dict[str, float] = {
@@ -203,6 +212,12 @@ def run_chaos(cycles: int = 200, seed: int = 0,
     from ..actions import allocate as _alloc_mod
 
     report = ChaosReport(cycles=cycles, seed=seed)
+    # the deterministic counts (cache.fold: demote-the-fold rung) ride
+    # ONLY the default full-soak plan: a caller-scoped rate set (the
+    # tier-1 smoke's SMOKE_RATES) must not have extra seams armed
+    # behind its back — the smoke relies on the folded path staying
+    # engaged for its whole window
+    counts = dict(DEFAULT_COUNTS) if rates is None else {}
     rates = dict(rates if rates is not None else DEFAULT_RATES)
     if fault_stop is None:
         fault_stop = max(fault_start + 1, cycles - max(12, cycles // 5))
@@ -261,8 +276,12 @@ def run_chaos(cycles: int = 200, seed: int = 0,
                 pods_by_uid[p.uid] = p
         source.start(cache)
         cache.run()                      # resync/cleanup repair worker
+        # audit_every: the fold audit (snapshot_diff == 0 between the
+        # folded state and a fresh full clone) runs INSIDE the soak —
+        # the ISSUE 9 acceptance gate; failures surface as violations
+        # below via metrics.audit_failures_total
         sched = Scheduler(cache, schedule_period=0.01,
-                          cycle_deadline=30.0)
+                          cycle_deadline=30.0, audit_every=5)
 
         # ---- the leader lease, renewed throughout the soak ---------
         lease_dir = tempfile.mkdtemp(prefix="kb-chaos-lease-")
@@ -375,7 +394,9 @@ def run_chaos(cycles: int = 200, seed: int = 0,
                 _flight.dump(f"chaos_invariant-{where.split(':')[0]}")
 
         # ---- the soak loop -----------------------------------------
-        plan = faults.FaultPlan(rates=rates, seed=seed)
+        from ..metrics import audit_failures_total
+        audit_fail0 = audit_failures_total()
+        plan = faults.FaultPlan(rates=rates, counts=counts, seed=seed)
         degraded_s: List[float] = []
         healthy_s: List[float] = []
         engines: set = set()
@@ -444,6 +465,19 @@ def run_chaos(cycles: int = 200, seed: int = 0,
 
         # ---- final invariants --------------------------------------
         check_invariants("final")
+        # fold audit (ISSUE 9): any in-soak snapshot_diff != 0 between
+        # the folded state and the full-clone oracle is a violation,
+        # and the final state must audit clean too (regardless of
+        # whether the injected cache.fold seam demoted mid-soak)
+        audit_fails = audit_failures_total() - audit_fail0
+        if audit_fails:
+            report.violations.append(
+                f"fold audit diverged {audit_fails} time(s) during the "
+                f"soak (snapshot_diff != 0; see scheduler log)")
+        if hasattr(cache, "audited_snapshot"):
+            _, final_diffs = cache.audited_snapshot()
+            for d in final_diffs[:8]:
+                report.violations.append(f"final fold audit: {d}")
         if report.final_ladder_level != 0:
             report.violations.append(
                 f"ladder failed to re-promote: level "

@@ -9,7 +9,7 @@ import bench
 
 def test_run_steady_small_config():
     (latencies, bound, action_ms, readbacks, rss_mb, engines,
-     recompiles, span_counts, trace_roots) = bench.run_steady(
+     recompiles, span_counts, trace_roots, phase_ms) = bench.run_steady(
         2, 2, "auto", 16)
     assert engines and all(e for e in engines)
     assert len(latencies) == 2
@@ -25,6 +25,11 @@ def test_run_steady_small_config():
     assert len(span_counts) == 2 and all(c > 5 for c in span_counts)
     assert len(trace_roots) == 2
     assert all(r.cat == "cycle" for r in trace_roots)
+    # the ISSUE 9 steady host split rides the update_host_phase keys:
+    # the folded snapshot assembly and the bind_many apply phase must
+    # both have fired on an incremental steady cycle
+    assert "fold" in phase_ms, phase_ms
+    assert "apply" in phase_ms, phase_ms
 
 
 def test_bench_main_one_json_line(capsys):
@@ -64,7 +69,8 @@ def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
         # the primary line must already be visible at this point
         steady_ran["primary_first"] = capsys.readouterr().out.strip()
         return ([0.05] * 5, 1280, {"allocate": 40.0}, [1, 1, 1, 1, 1],
-                100.0, ["batched"], 0, [20] * 5, [])
+                100.0, ["batched"], 0, [20] * 5, [],
+                {"fold": 0.5, "apply": 1.0})
 
     monkeypatch.setattr(bench, "run_steady", fake_steady)
     rc = bench.main(["--config", "5", "--cycles", "2"])

@@ -229,6 +229,276 @@ def test_incremental_disabled_still_schedules(monkeypatch):
     assert results["0"] == results["1"]
 
 
+# ---------------------------------------------------------------------
+# ISSUE 9 — event-driven incremental cycles: the fold layer, the lazy
+# audit, the demotion rung, and the schedule-on-arrival sub-cycle
+# ---------------------------------------------------------------------
+
+def test_churn_soak_50_cycles_fold_audit_green():
+    """The ISSUE 9 churn soak: 50 randomized-churn cycles, each opening
+    from cache.audited_snapshot() — snapshot_diff == 0 between the
+    folded state and a freshly-built full clone asserted EVERY cycle,
+    with the session actually running on the audited snapshot."""
+    from kubebatch_tpu import metrics
+
+    rng = np.random.default_rng(23)
+    src, kubelet, cache = _mk_cluster(n_nodes=8)
+    acts = [AllocateAction(mode="auto"), BackfillAction()]
+    audits0 = metrics.audit_cycles_total()
+    fails0 = metrics.audit_failures_total()
+    folded0 = sum(metrics.events_folded_total().values())
+    next_group = 0
+    for cycle in range(50):
+        next_group = _churn_cycle(src, rng, cycle, next_group)
+        snap, diff = cache.audited_snapshot()
+        metrics.count_audit_cycle(ok=not diff)
+        assert not diff, (cycle, diff[:8])
+        ssn = OpenSession(cache, shipped_tiers(), snapshot=snap)
+        for act in acts:
+            act.execute(ssn)
+        CloseSession(ssn)
+        assert src.sync(5.0)
+        if cycle % 10 == 9:
+            assert not audit_cache(cache)
+    assert kubelet.binds, "churn must schedule work"
+    assert cache._incremental, "soak must stay on the folded path"
+    assert metrics.audit_cycles_total() - audits0 == 50
+    assert metrics.audit_failures_total() - fails0 == 0
+    assert sum(metrics.events_folded_total().values()) > folded0
+
+
+def test_fold_vs_replay_every_event_kind():
+    """Fold-vs-replay equivalence per event kind: after EACH kind of
+    cache event (add/update/delete x pod/node/podgroup, bind, evict)
+    the folded snapshot must deep-equal the full-clone oracle. Every
+    check runs against an adopted base (a session opens and closes
+    before the event), so the folded patch path — not the full-clone
+    fallback — is what's exercised."""
+    from kubebatch_tpu import metrics
+
+    src, kubelet, cache = _mk_cluster(n_nodes=3)
+
+    def checked(kind):
+        snap, diff = cache.audited_snapshot()
+        assert not diff, (kind, diff[:6])
+        # re-adopt a base so the NEXT event folds against it
+        ssn = OpenSession(cache, shipped_tiers())
+        CloseSession(ssn)
+
+    # seed a base
+    ssn = OpenSession(cache, shipped_tiers())
+    CloseSession(ssn)
+
+    # podgroup.add + pod.add
+    pg = build_group("ns", "g0", 1, queue="q1")
+    cache.add_pod_group(pg)
+    checked("podgroup.add")
+    pod = build_pod("ns", "g0-0", "", PodPhase.PENDING, rl(500, GiB),
+                    group="g0", priority=3)
+    cache.add_pod(pod)
+    checked("pod.add")
+
+    # podgroup.update
+    pg2 = build_group("ns", "g0", 1, queue="q2")
+    cache.update_pod_group(pg, pg2)
+    checked("podgroup.update")
+
+    # bind (decision write-back)
+    with cache._lock:
+        task = cache.jobs["ns/g0"].tasks[pod.uid]
+    cache.bind(task, "n00")
+    checked("bind")
+
+    # pod.update: the kubelet reports it Running
+    pod.phase = PodPhase.RUNNING
+    pod.node_name = "n00"
+    cache.update_pod(pod, pod)
+    checked("pod.update")
+
+    # evict (decision write-back off a running task)
+    with cache._lock:
+        task = cache.jobs["ns/g0"].tasks[pod.uid]
+    cache.evict(task, "test eviction")
+    checked("evict")
+
+    # pod.delete + podgroup.delete
+    cache.delete_pod(pod)
+    checked("pod.delete")
+    cache.delete_pod_group(pg2)
+    checked("podgroup.delete")
+
+    # node.add / node.update / node.delete
+    node = build_node("n99", rl(4000, 8 * GiB, pods=16))
+    cache.add_node(node)
+    checked("node.add")
+    bigger = build_node("n99", rl(8000, 16 * GiB, pods=32))
+    cache.update_node(node, bigger)
+    checked("node.update")
+    cache.delete_node(bigger)
+    checked("node.delete")
+
+    # resync: ground-truth replay outside the normal handler surface
+    # (no pod_lister -> replays the task's own pod state)
+    pg9 = build_group("ns", "g9", 1, queue="q1")
+    cache.add_pod_group(pg9)
+    pod9 = build_pod("ns", "g9-0", "", PodPhase.PENDING, rl(500, GiB),
+                     group="g9")
+    cache.add_pod(pod9)
+    checked("pod.add")
+    with cache._lock:
+        task9 = cache.jobs["ns/g9"].tasks[pod9.uid]
+    cache.sync_task(task9)
+    checked("resync")
+
+    # invalidate: a cluster-wide input change (new queue) voids the
+    # fold base — the folded snapshot must equal the oracle through
+    # the forced-full path too
+    cache.add_queue(build_queue("q9"))
+    checked("invalidate")
+
+    folded = metrics.events_folded_total()
+    for kind in ("pod.add", "pod.update", "pod.delete",
+                 "node.add", "node.update", "node.delete",
+                 "podgroup.add", "podgroup.update", "podgroup.delete",
+                 "bind", "evict", "resync", "invalidate"):
+        assert folded.get(kind), f"event kind {kind} was never folded"
+
+
+def test_fold_fault_seam_demotes_to_snapshot_primary():
+    """The ladder rung: an injected cache.fold fault demotes the cache
+    to snapshot-primary full clones (counted, never raised into the
+    event handler) and scheduling stays correct."""
+    from kubebatch_tpu import faults, metrics
+
+    src, kubelet, cache = _mk_cluster(n_nodes=2)
+    assert cache._incremental
+    demos0 = metrics.fold_demotions_total().get("fault", 0)
+    faults.arm(faults.FaultPlan(counts={"cache.fold": 1}))
+    try:
+        cache.add_pod_group(build_group("ns", "g0", 1, queue="q1"))
+    finally:
+        faults.disarm()
+    assert not cache._incremental, "fired seam must demote the fold"
+    assert metrics.fold_demotions_total().get("fault", 0) == demos0 + 1
+    # snapshot-primary keeps scheduling: full clones, diff still 0
+    cache.add_pod(build_pod("ns", "g0-0", "", PodPhase.PENDING,
+                            rl(500, GiB), group="g0"))
+    snap, diff = cache.audited_snapshot()
+    assert not diff
+    ssn = OpenSession(cache, shipped_tiers(), snapshot=snap)
+    AllocateAction().execute(ssn)
+    CloseSession(ssn)
+    assert kubelet.binds
+    assert not audit_cache(cache)
+
+
+def test_subcycle_schedules_latency_arrival_and_full_cycle_adopts():
+    """Schedule-on-arrival end to end: a latency-lane pod's arrival
+    triggers a sub-cycle that binds it WITHOUT waiting for the period,
+    and the next full cycle adopts the bind idempotently (no double
+    bind, fold audit green)."""
+    from kubebatch_tpu import metrics
+    from kubebatch_tpu.runtime.scheduler import Scheduler
+    from kubebatch_tpu.runtime.subcycle import LANE_ANNOTATION
+
+    src, kubelet, cache = _mk_cluster(n_nodes=4)
+    sched = Scheduler(cache, schedule_period=3600.0, subcycle=True,
+                      audit_every=1)
+    assert sched.run_cycle()
+
+    sub0 = metrics.subcycles_total()
+    pg = build_group("ns", "rush", 1, queue="q1")
+    src.emit_group(pg)
+    pod = build_pod("ns", "rush-0", "", PodPhase.PENDING, rl(500, GiB),
+                    group="rush")
+    pod.annotations[LANE_ANNOTATION] = "latency"
+    src.emit_pod(pod)
+    assert src.sync(5.0)
+
+    # the sub-cycle runs on the event-delivery thread; sync() only
+    # proves the queue drained, so wait for the sub-cycle's bind (the
+    # point is that NO run_cycle happens in between)
+    import time as _time
+    deadline = _time.monotonic() + 5.0
+    while (not kubelet.binds.get("ns/rush-0")
+           and _time.monotonic() < deadline):
+        _time.sleep(0.01)
+    assert kubelet.binds.get("ns/rush-0"), \
+        "latency arrival was not bound by the sub-cycle"
+    assert metrics.subcycles_total() == sub0 + 1
+    pct = metrics.arrival_latency_percentiles()
+    assert pct and pct["arrivals"] >= 1
+
+    # the following full cycle adopts the sub-cycle's bind idempotently
+    binds_before = dict(kubelet.binds)
+    assert sched.run_cycle()
+    assert src.sync(5.0)
+    assert kubelet.binds == binds_before, "full cycle re-bound something"
+    assert not audit_cache(cache)
+    snap, diff = cache.audited_snapshot()
+    assert not diff
+    # a NORMAL-lane arrival must not trigger a sub-cycle
+    src.emit_group(build_group("ns", "calm", 1, queue="q1"))
+    src.emit_pod(build_pod("ns", "calm-0", "", PodPhase.PENDING,
+                           rl(500, GiB), group="calm"))
+    assert src.sync(5.0)
+    assert metrics.subcycles_total() == sub0 + 1
+
+
+def test_subcycle_gang_barrier_not_counted_as_decided():
+    """A lone latency-lane member of a min_member > 1 gang may sit
+    ALLOCATED inside the sub-cycle's session, but the gang barrier
+    discards that at close — the pod must NOT be counted as decided
+    (no bind, no arrival-latency sample), and the full period loop
+    places the gang once the rest of it arrives."""
+    from kubebatch_tpu import metrics
+    from kubebatch_tpu.metrics import arrivals_observed_total
+    from kubebatch_tpu.runtime.scheduler import Scheduler
+    from kubebatch_tpu.runtime.subcycle import LANE_ANNOTATION
+
+    src, kubelet, cache = _mk_cluster(n_nodes=4)
+    sched = Scheduler(cache, schedule_period=3600.0, subcycle=True)
+    assert sched.run_cycle()
+
+    sub0 = metrics.subcycles_total()
+    obs0 = arrivals_observed_total()
+    pg = build_group("ns", "duo", 2, queue="q1")
+    src.emit_group(pg)
+    lone = build_pod("ns", "duo-0", "", PodPhase.PENDING, rl(500, GiB),
+                     group="duo")
+    lone.annotations[LANE_ANNOTATION] = "latency"
+    src.emit_pod(lone)
+    assert src.sync(5.0)
+
+    import time as _time
+    deadline = _time.monotonic() + 5.0
+    while metrics.subcycles_total() == sub0 \
+            and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert metrics.subcycles_total() == sub0 + 1, \
+        "arrival must still trigger a sub-cycle"
+    assert not kubelet.binds.get("ns/duo-0"), \
+        "gang-blocked member must not bind from the sub-cycle"
+    assert arrivals_observed_total() == obs0, \
+        "gang-blocked arrival must not record a decision latency"
+
+    # the second member completes the gang: its sub-cycle places BOTH
+    mate = build_pod("ns", "duo-1", "", PodPhase.PENDING, rl(500, GiB),
+                     group="duo")
+    mate.annotations[LANE_ANNOTATION] = "latency"
+    src.emit_pod(mate)
+    assert src.sync(5.0)
+    deadline = _time.monotonic() + 5.0
+    while (not kubelet.binds.get("ns/duo-1")
+           and _time.monotonic() < deadline):
+        _time.sleep(0.01)
+    assert kubelet.binds.get("ns/duo-0") \
+        and kubelet.binds.get("ns/duo-1"), \
+        "completed gang must place through the sub-cycle"
+    snap, diff = cache.audited_snapshot()
+    assert not diff
+
+
 def test_gc_deleted_job_vanishes_from_incremental_snapshot():
     """The deleted-jobs GC pops from cache truth OUTSIDE the handler
     surface (process_cleanup_jobs); the incremental snapshot's

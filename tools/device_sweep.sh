@@ -49,6 +49,12 @@ $B 1200 python bench.py --config 3 --mode rpc
 # parity gate (bit-identical to dedicated runs), solves/sec at
 # capacity, p99 under 2x offered overload, recompiles pinned to 0
 $B  900 python bench.py --tenants 4
+# schedule-on-arrival (ISSUE 9): latency-lane arrival -> decision
+# p50/p99 through the sub-cycle under 256-pod churn (~70%-fill
+# cluster); every offered arrival must get a sub-cycle decision and
+# recompiles must stay 0 (exit 1 on either)
+$B  900 python bench.py --config 2 --mode arrival --cycles 9
+$B 1800 python bench.py --config 5 --mode arrival --cycles 9
 # 60+-cycle steady soak (p50/p95/max + RSS in the JSON line)
 $B 2400 python bench.py --config 5 --steady 256 --cycles 60
 # chaos soak: degraded-mode p50 alongside healthy p50, invariant

@@ -1,13 +1,21 @@
 #!/usr/bin/env python
-"""Host-share profiler for the steady cfg5 regime (SCALING items 2-5).
+"""Host-share profiler for the steady regime (ISSUE 9 itemization).
 
 Runs the same persistent-cache churn loop as ``bench.py --steady`` but
-with cProfile around chosen phases, printing per-phase wall times and
-the hottest host functions. CPU backend recommended:
+with cProfile around chosen phases. The printed per-cycle split comes
+STRAIGHT from the ``metrics.update_host_phase`` accumulators (the span
+tracer's phase keys), so the itemization names the phases the new
+event-driven path actually runs — ``open`` (session open incl. plugin
+opens), ``fold`` (event-folded snapshot assembly, nested inside open),
+``tensorize``, ``replay`` (decision replay incl. ``apply`` =
+cache.bind_many column ops, nested), ``audit`` (lazy full-clone diff,
+present only when --audit-every is armed) and ``close`` — instead of
+the stale round-5 stopwatch names. CPU backend recommended:
 
     JAX_PLATFORMS=cpu KUBEBATCH_NO_BACKEND_PROBE=1 \
         python tools/profile_steady.py [--config 5] [--cycles 6]
         [--churn 256] [--phase open|reclaim|allocate|close|none]
+        [--audit-every N]
 """
 from __future__ import annotations
 
@@ -43,6 +51,9 @@ def main():
                     help="per-cycle reclaim diagnostics (read at session "
                          "close): overused queues, sub-quorum running "
                          "gangs, tasks currently in RELEASING")
+    ap.add_argument("--audit-every", type=int, default=0, metavar="N",
+                    help="run the lazy fold audit every Nth cycle (its "
+                         "cost then shows up as the 'audit' phase)")
     args = ap.parse_args()
 
     from bench import build_actions
@@ -61,6 +72,10 @@ def main():
         def bind(self, pod, hostname):
             pod.node_name = hostname
             fresh_binds.append(pod)
+
+        def bind_many(self, pairs):
+            for pod, hostname in pairs:
+                self.bind(pod, hostname)
 
         def evict(self, pod):
             pod.deletion_timestamp = 1.0
@@ -104,20 +119,29 @@ def main():
                 continue      # keep the split monotone across cycles
         return total * 1e-6
 
+    from kubebatch_tpu import metrics as _metrics
+
     prof = cProfile.Profile()
     for cycle in range(args.cycles):
         sim.churn_tick(cache, args.churn)
         gc.collect()
         last = cycle == args.cycles - 1
         dev0 = device_seconds()
+        hp0 = _metrics.host_phase_seconds()
         t0 = time.perf_counter()
+        snapshot = None
+        if args.audit_every and cycle % args.audit_every == 0:
+            # the lazy audit, on the record as its own phase
+            from kubebatch_tpu.obs import span as _span
+            with _span("audit", cat="phase"):
+                snapshot, diff = cache.audited_snapshot()
+            assert not diff, diff[:4]
         if last and args.phase == "open":
             prof.enable()
-        ssn = OpenSession(cache, tiers)
+        ssn = OpenSession(cache, tiers, snapshot=snapshot)
         if last and args.phase == "open":
             prof.disable()
-        t1 = time.perf_counter()
-        marks = [("open", t1 - t0)]
+        marks = []
         for name, act in acts:
             a0 = time.perf_counter()
             if last and args.phase == name:
@@ -149,17 +173,24 @@ def main():
             diag = (f"  diag: overused_queues={over} "
                     f"sub_quorum_running_gangs={broken} "
                     f"releasing_now={rel}")
-        c0 = time.perf_counter()
         if last and args.phase == "close":
             prof.enable()
         CloseSession(ssn)
         if last and args.phase == "close":
             prof.disable()
-        marks.append(("close", time.perf_counter() - c0))
         total = time.perf_counter() - t0
         dev = device_seconds() - dev0
+        # the itemization proper: per-phase deltas off the SAME
+        # update_host_phase accumulators bench host_phase_ms reads —
+        # the printed names match the metric keys by construction.
+        # NOTE: "fold" nests inside "open", "apply" inside "replay".
+        hp = _metrics.host_phase_seconds()
+        phases = " ".join(
+            f"{k}={(hp[k] - hp0.get(k, 0.0)) * 1e3:.1f}ms"
+            for k in sorted(hp) if hp[k] - hp0.get(k, 0.0) > 0)
         per = " ".join(f"{n}={s * 1e3:.1f}ms" for n, s in marks)
-        print(f"cycle {cycle}: {per} total={total * 1e3:.1f}ms "
+        print(f"cycle {cycle}: [phases] {phases}", file=sys.stderr)
+        print(f"  [actions] {per} total={total * 1e3:.1f}ms "
               f"device={dev * 1e3:.1f}ms host={(total - dev) * 1e3:.1f}ms",
               file=sys.stderr)
         if diag is not None:
