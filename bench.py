@@ -64,8 +64,50 @@ def record_line(out: dict, partial: bool = False) -> None:
         pass
 
 
+def memory_fields() -> dict:
+    """Peak-memory evidence for every bench line (ISSUE 10 satellite —
+    the narrowed-intermediate claim must be a measured number, not
+    prose): ``memory_peak_mb`` is the accelerator's own
+    ``peak_bytes_in_use`` where the backend exposes memory_stats()
+    (memory_peak_src="device"); on backends that don't (host XLA), the
+    process peak RSS stands in, labeled honestly
+    (memory_peak_src="rss"). ``host_rss_peak_mb`` (ru_maxrss, MiB) is
+    always reported alongside."""
+    out = {}
+    try:
+        import resource as _resource
+        out["host_rss_peak_mb"] = round(
+            _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+            1)
+    except Exception:
+        pass
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        if peak:
+            out["memory_peak_mb"] = round(peak / 2.0 ** 20, 1)
+            out["memory_peak_src"] = "device"
+    except Exception:
+        pass
+    if "memory_peak_mb" not in out and "host_rss_peak_mb" in out:
+        out["memory_peak_mb"] = out["host_rss_peak_mb"]
+        out["memory_peak_src"] = "rss"
+    return out
+
+
 def emit(out: dict, flush: bool = False, partial: bool = False) -> None:
-    """Print a bench JSON line AND record it to BENCH_DEVICE.jsonl."""
+    """Print a bench JSON line AND record it to BENCH_DEVICE.jsonl.
+    Every line carries the peak-memory fields (memory_fields),
+    REFRESHED at emit time — the cfg5 cpu-fallback path emits the same
+    dict twice (kill-safe partial, then enriched final after the steady
+    extra), and the final line must carry the true process peak, not
+    the partial emit's stale snapshot."""
+    out.update(memory_fields())
+    narrow_env = os.environ.get("KUBEBATCH_NARROW", "")
+    if narrow_env:
+        # label forced-dtype A/B arms (argv alone can't tell them apart)
+        out.setdefault("narrow_env", narrow_env)
     print(json.dumps(out), flush=flush)
     record_line(out, partial=partial)
 
@@ -160,6 +202,69 @@ def rpc_stats_fields(cycle_engines, rpc_addr: str) -> dict:
 #: (labels/taints/selectors/affinity/ports at workload-ish fractions —
 #: sim/cluster.py BASELINE_SPECS)
 from kubebatch_tpu.conf import CONFIG_ACTIONS  # noqa: E402
+
+
+def downsampled_oracle_check(config, factor: int = 50) -> dict:
+    """The cfg6/cfg7 done-bar's decision check, at a scale the host
+    oracle can run: the SAME spec shape downsampled by ``factor``,
+    solved three ways —
+
+    - **two-level (hier) vs the host oracle**: per-task status equality
+      and bound-set equality (the repo's established oracle contract for
+      the batched engine family — policy-equal; the task->node map is
+      round/wave-granular by design, see kernels/batched.py);
+    - **two-level vs the flat batched engine**: BIT-identical decision
+      arrays (states and node choices) — the decomposition itself must
+      not move a single placement at a scale where the flat engine runs.
+
+    Returns the evidence fields for the bench line."""
+    import dataclasses
+
+    from kubebatch_tpu import actions, plugins  # noqa: F401
+    from kubebatch_tpu.actions.allocate import AllocateAction
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.sim.cluster import BASELINE_SPECS, build_cluster
+
+    spec = BASELINE_SPECS[config]
+    spec = dataclasses.replace(
+        spec, n_nodes=max(64, spec.n_nodes // factor),
+        n_groups=max(8, spec.n_groups // factor))
+    decisions = {}
+    for mode in ("hier", "batched", "host"):
+        class _B:
+            def bind(self, pod, hostname):
+                pod.node_name = hostname
+
+            def evict(self, pod):
+                pod.deletion_timestamp = 1.0
+
+        cache = SchedulerCache(binder=_B(), evictor=_B(),
+                               async_writeback=False)
+        sim = build_cluster(spec)
+        sim.populate(cache)
+        ssn = OpenSession(cache, shipped_tiers())
+        AllocateAction(mode=mode).execute(ssn)
+        decisions[mode] = {
+            t.key: (str(t.status), t.node_name)
+            for job in ssn.jobs.values() for t in job.tasks.values()}
+        CloseSession(ssn)
+    hier, flat, host = (decisions["hier"], decisions["batched"],
+                        decisions["host"])
+    status_eq = all(hier[k][0] == host[k][0] for k in hier)
+    bound = {k for k, v in hier.items() if v[1]}
+    bound_host = {k for k, v in host.items() if v[1]}
+    return {
+        "oracle_downsample_factor": factor,
+        "oracle_nodes": spec.n_nodes,
+        "oracle_tasks_compared": len(hier),
+        "oracle_status_equal": status_eq,
+        "oracle_bound_set_equal": bound == bound_host,
+        "hier_vs_flat_bit_identical": hier == flat,
+        "oracle_downsampled_ok": (status_eq and bound == bound_host
+                                  and hier == flat),
+    }
 
 
 def build_actions(config: int, mode: str):
@@ -264,6 +369,12 @@ def run_config(config: int, cycles: int, mode: str):
                     "cold_host_ms": round(1e3 * sum(
                         hp_c[k] - hp0.get(k, 0.0) for k in hp_c), 3),
                 }
+                if config in (6, 7):
+                    # scale-axis lines must pin recompiles POST-warm-up:
+                    # cycle 0 traced the two-level surface; from here a
+                    # compile is a counted recompile on the line
+                    from kubebatch_tpu import compilesvc
+                    compilesvc.mark_warm()
             if cycle > 0 or cycles == 1:   # first cycle pays jit compile
                 latencies.append(dt)
                 bound_total += len(binds)
@@ -678,10 +789,13 @@ def main(argv=None):
                "emitted line is also appended (with timestamp + git SHA) "
                "to BENCH_DEVICE.jsonl, the committed evidence file.")
     ap.add_argument("--config", default="5",
-                    choices=["1", "2", "3", "4", "5", "2p", "3p", "5p"],
+                    choices=["1", "2", "3", "4", "5", "6", "7",
+                             "2p", "3p", "5p"],
                     help="BASELINE config number (default: the 10k pods x "
                          "5k nodes stress config — BASELINE.md's primary "
-                         "metric); 2p/3p/5p = predicate-rich variants")
+                         "metric); 2p/3p/5p = predicate-rich variants; "
+                         "6/7 = the 50k/100k-node scale axis (two-level "
+                         "solve, docs/SCALING.md)")
     # default sized so the primary metric carries >= 5 measured cycles
     # (the first cycle pays jit and is excluded); steady runs are floored
     # at 9 measured cycles (VERDICT r5 directive 9 — p95 on 5 samples is
@@ -732,8 +846,8 @@ def main(argv=None):
                          "loadable) to PATH and record the path on the "
                          "JSON line (trace_file)")
     ap.add_argument("--mode", default="auto",
-                    choices=["auto", "batched", "sharded", "fused", "jax",
-                             "host", "rpc", "arrival"],
+                    choices=["auto", "batched", "sharded", "hier", "fused",
+                             "jax", "host", "rpc", "arrival"],
                     help="allocate engine: auto = size-based selection "
                          "(the shipped default); batched = round-based "
                          "throughput engine (policy-exact, order-"
@@ -743,7 +857,11 @@ def main(argv=None):
     args.config = (int(args.config) if args.config.isdigit()
                    else args.config)
     if args.cycles is None:
-        args.cycles = 200 if args.chaos else 6
+        # cfg6/cfg7 cycles are minutes each on a fallback box; 4 total
+        # = 3 measured (cycle 0 pays jit and is excluded) banks the
+        # scale evidence without eating a sweep window
+        args.cycles = (200 if args.chaos
+                       else 4 if args.config in (6, 7) else 6)
 
     from kubebatch_tpu import enable_persistent_compile_cache
     enable_persistent_compile_cache()
@@ -965,6 +1083,13 @@ def main(argv=None):
         # measured window is a structural failure, not wall-time noise
         out["recompiles_total"] = recompiles
         out["compile_ms_total"] = round(compile_ms_total(), 1)
+        if args.config in (6, 7):
+            # the scale-axis steady line carries the same downsampled
+            # decision evidence as the cold line (ISSUE 10 done-bar)
+            try:
+                out.update(downsampled_oracle_check(args.config))
+            except Exception as e:   # pragma: no cover — diagnostics
+                out["oracle_error"] = f"{type(e).__name__}: {e}"
         # the cost of always-on tracing, on the record next to the wall
         # numbers (ISSUE 7): span count per measured cycle and the
         # calibrated per-span cost x that count — an estimate labeled as
@@ -1051,6 +1176,20 @@ def main(argv=None):
         out["recompiles_total"] = recompiles_total()
 
     stamp_compile_counters()
+    if args.config in (6, 7):
+        # the scale-axis done-bar's decision evidence (ISSUE 10): the
+        # two-level solve vs the host oracle + the flat engine on a
+        # downsampled twin of this spec, fields on the same line. The
+        # check's OWN downsampled graphs compile after the warm mark —
+        # attributed separately so recompiles_total keeps meaning "the
+        # production cycles", which cycle 0 warmed (see run_config)
+        rc_cycles = recompiles_total()
+        try:
+            out.update(downsampled_oracle_check(args.config))
+        except Exception as e:   # pragma: no cover — diagnostics only
+            out["oracle_error"] = f"{type(e).__name__}: {e}"
+        out["oracle_check_compiles"] = recompiles_total() - rc_cycles
+        out["recompiles_total"] = rc_cycles
     if evicted:
         out["evictions_per_cycle"] = evicted // max(1, len(latencies))
     #: every cycle the rpc evidence fields must cover — the cfg5
@@ -1113,7 +1252,10 @@ def main(argv=None):
         # the run after the line is emitted so the evidence file still
         # records what happened
         out.update(rpc_stats_fields(rpc_cycle_engines, rpc_addr))
-    stamp_compile_counters()   # cover the steady extra's compiles too
+    if args.config not in (6, 7):
+        # cover the steady extra's compiles too; cfg6/7 already split
+        # cycle recompiles from the oracle check's (above)
+        stamp_compile_counters()
     emit(out)
     if rpc_server is not None:
         rpc_server.stop(grace=None)

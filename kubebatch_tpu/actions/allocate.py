@@ -55,6 +55,13 @@ AUTO_BATCHED_MIN = 512
 #: collectives (on a single chip sharded degenerates to batched anyway)
 AUTO_SHARDED_MIN_NODES = 512
 
+#: auto mode switches to the hierarchical two-level engine
+#: (kernels/hier.py) at this many nodes: past it a flat [T, N] round
+#: materializes intermediates beyond the per-shard HBM budget
+#: (docs/SCALING.md "cfg6/cfg7 and the two-level solve"), so the node
+#: axis decomposes into pool buckets and the waterfall runs per bucket
+AUTO_HIER_MIN_NODES = 16384
+
 #: engine that actually consumed the last allocate cycle in this process
 #: ("batched" / "sharded" / "fused" / "jax-visit" / "host-visit" /
 #: "rpc") — observability for bench.py, so a silent fallback off the
@@ -103,6 +110,11 @@ class AllocateAction(Action):
             for j in ssn.jobs.values())
         if pending < AUTO_BATCHED_MIN:
             return "fused"
+        if len(ssn.nodes) >= AUTO_HIER_MIN_NODES:
+            # cluster-scale node axis: no flat engine (single-chip OR
+            # per-shard) materializes [T, N] inside the HBM budget —
+            # the two-level bucketed solve is the only fit
+            return "hier"
         if len(ssn.nodes) >= AUTO_SHARDED_MIN_NODES:
             import jax
             if len(jax.devices()) > 1:
@@ -121,7 +133,19 @@ class AllocateAction(Action):
         # cycle failures the scheduler loop demotes the tier — sharded ->
         # batched -> fused -> host — and this is the single consult site
         # (cap_engine counts the demotion in engine_demotions_total)
+        wanted = mode
         mode = _LADDER.cap_engine(mode)
+        if wanted == "hier" and mode == "batched" \
+                and len(ssn.nodes) >= AUTO_HIER_MIN_NODES:
+            # a demoted hier cycle must NOT land on the flat batched
+            # engine: its [T, N] graph at this node count is exactly the
+            # unbounded compile/OOM the two-level split exists to avoid
+            # (its provider refuses to even register it). Skip to the
+            # fused tier — slow but memory-bounded ([N]-sized state per
+            # step), which is what a degraded cycle is for.
+            from ..metrics import count_engine_demotion
+            count_engine_demotion("batched", "fused")
+            mode = "fused"
         if mode == "rpc":
             # route the whole action through the gRPC solver sidecar
             # (KUBEBATCH_SOLVER=rpc; address from KUBEBATCH_SOLVER_ADDR).
@@ -136,15 +160,17 @@ class AllocateAction(Action):
             from ..metrics import count_engine_demotion
             count_engine_demotion("rpc", "in-process")
             mode = self._auto_mode(ssn)
-        if mode in ("batched", "sharded"):
+        if mode in ("batched", "sharded", "hier"):
             from .allocate_batched import batched_supported, execute_batched
             # execute_batched returns the engine that actually ran
-            # ("sharded" / "batched"; the only degradation left is
-            # sharded->batched on a 1-device host, which it counts) or
-            # False — without consuming state — when the snapshot
-            # carries unsupported features
+            # ("hier" / "sharded" / "batched"; the remaining degradations
+            # — sharded->batched on a 1-device host, hier->batched/
+            # sharded on an affinity cycle — are counted) or False —
+            # without consuming state — when the snapshot carries
+            # unsupported features
             ran = batched_supported(ssn) \
-                and execute_batched(ssn, sharded=(mode == "sharded"))
+                and execute_batched(ssn, sharded=(mode == "sharded"),
+                                    hier=(mode == "hier"))
             if ran:
                 last_cycle_engine = ran
                 return

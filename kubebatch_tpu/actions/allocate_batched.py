@@ -22,24 +22,40 @@ from .cycle_inputs import (EMPTY_CYCLE, build_cycle_inputs, cycle_supported,
 batched_supported = cycle_supported
 
 
-def execute_batched(ssn: Session, sharded: bool = False):
+def execute_batched(ssn: Session, sharded: bool = False,
+                    hier: bool = False):
     """Run the whole allocate action as a handful of round dispatches.
-    Returns the engine that actually ran ("batched" / "sharded" —
-    truthy), or False — without consuming any state — when the snapshot
-    has features the kernels can't express (the caller falls back).
-    Affinity/port cycles run first-class on BOTH engines: the sharded
-    twin partitions the affinity matmuls over the mesh with a replicated
-    carry (kernels/batched_sharded.py), so the only remaining
-    sharded->batched degradation is the 1-device topology, and it is
-    counted (metrics.engine_demotions_total), never silent."""
+    Returns the engine that actually ran ("hier" / "batched" /
+    "sharded" — truthy), or False — without consuming any state — when
+    the snapshot has features the kernels can't express (the caller
+    falls back). Affinity/port cycles run first-class on the batched
+    and sharded engines: the sharded twin partitions the affinity
+    matmuls over the mesh with a replicated carry
+    (kernels/batched_sharded.py). The two-level engine cannot express
+    the cluster-global affinity carries, so an affinity cycle demotes
+    hier -> batched/sharded — counted
+    (metrics.engine_demotions_total), never silent."""
     inputs = build_cycle_inputs(ssn, allow_affinity=True)
     if inputs is EMPTY_CYCLE:
-        return "sharded" if sharded else "batched"
+        return "hier" if hier else ("sharded" if sharded else "batched")
     if inputs is None:
         return False
     # injection seam: after the support gates (no state consumed yet),
     # before the device dispatch and the replay
     _fault_check("device.dispatch")
+    if hier:
+        if getattr(inputs, "affinity", None) is None:
+            from ..kernels.hier import solve_hier
+            task_state, task_node, task_seq, _ = solve_hier(
+                inputs.device, inputs)
+            replay_decisions(ssn, inputs, task_state, task_node, task_seq)
+            return "hier"
+        # affinity vocabulary: the flat engines own it — demote, and
+        # keep the sharded upgrade when a mesh is visible
+        from ..metrics import count_engine_demotion
+        import jax as _jax
+        sharded = len(_jax.devices()) > 1
+        count_engine_demotion("hier", "sharded" if sharded else "batched")
     if sharded:
         import jax
 

@@ -18,6 +18,7 @@ from ..compilesvc import register_provider as _register_provider
 from ..faults import check as _fault_check
 from ..framework import Session
 from ..kernels.fused import fused_allocate, unpack_host_block
+from ..kernels.narrow import narrow_enabled
 from ..kernels.pack import pack_inputs, unpack
 from ..metrics import count_blocking_readback
 from ..obs import span as _span
@@ -41,11 +42,11 @@ _BOOL = ("task_valid", "job_valid", "sig_pred")
 @partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",
                                    "queue_keys", "gang_enabled",
                                    "prop_overused", "dyn_enabled",
-                                   "max_iters"))
+                                   "max_iters", "narrow"))
 def _fused_packed(buf_f, buf_i, buf_b, idle, releasing, backfilled,
                   allocatable_cm, nz_req0, max_task_num, n_tasks, node_ok,
                   lay_f, lay_i, lay_b, job_keys, queue_keys, gang_enabled,
-                  prop_overused, dyn_enabled, max_iters):
+                  prop_overused, dyn_enabled, max_iters, narrow=False):
     f = unpack(buf_f, lay_f)
     i = unpack(buf_i, lay_i)
     b = unpack(buf_b, lay_b)
@@ -63,7 +64,7 @@ def _fused_packed(buf_f, buf_i, buf_b, idle, releasing, backfilled,
         f["j_alloc0"], f["cluster_total"], f["dyn_weights"],
         job_keys=job_keys, queue_keys=queue_keys, gang_enabled=gang_enabled,
         prop_overused=prop_overused, dyn_enabled=dyn_enabled,
-        max_iters=max_iters)
+        max_iters=max_iters, narrow=narrow)
 
 
 # accounted trace boundary (compilesvc): the small-cycle fused entry
@@ -91,7 +92,14 @@ def prepare_fused(inputs):
         job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
         gang_enabled=inputs.gang_enabled,
         prop_overused=inputs.prop_overused,
-        dyn_enabled=inputs.dyn_enabled, max_iters=max_iters)
+        dyn_enabled=inputs.dyn_enabled, max_iters=max_iters,
+        # shape-derived (the rpc wire's device lacks n_padded); AUTO
+        # narrow requires bf16-exact score scale (kernels/narrow.py)
+        narrow=narrow_enabled(
+            int(device.node_ok.shape[0]), t_pad,
+            static_scores=inputs.sig_scores,
+            dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
+                         else None)))
     return args, statics
 
 

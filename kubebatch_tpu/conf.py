@@ -104,6 +104,11 @@ CONFIG_ACTIONS = {
     3: ("allocate", "backfill"),
     4: ("reclaim", "allocate", "backfill", "preempt"),
     5: ("reclaim", "allocate", "backfill", "preempt"),
+    # cfg6/cfg7 (50k / 100k nodes, ROADMAP item 2): allocate-only — the
+    # scale axis pins the SOLVER (two-level hier engine); the 4-action
+    # stack at this scale rides the scenario item (ROADMAP item 5)
+    6: ("allocate",),
+    7: ("allocate",),
     "2p": ("allocate",),
     "3p": ("allocate", "backfill"),
     "5p": ("reclaim", "allocate", "backfill", "preempt"),

@@ -374,8 +374,8 @@ def _notify_demotion(level: int) -> None:
 #: at or below the cap and passes through unchanged. rpc counts as a
 #: full-tier engine (its own breaker handles sidecar failure; the
 #: ladder demotes it with everything else once CYCLES start failing).
-_ENGINE_RANK = {"rpc": 0, "sharded": 0, "batched": 1, "native": 1,
-                "fused": 2, "jax": 2, "host": 3}
+_ENGINE_RANK = {"rpc": 0, "sharded": 0, "hier": 0, "batched": 1,
+                "native": 1, "fused": 2, "jax": 2, "host": 3}
 
 
 class DegradationLadder:

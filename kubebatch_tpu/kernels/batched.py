@@ -73,6 +73,7 @@ from ..metrics import count_blocking_readback
 from ..obs import span as _span
 from .fused import (ALLOC, ALLOC_OB, FAIL, K_DRF_SHARE, K_GANG_READY,
                     K_PRIORITY, K_PROP_SHARE, PIPELINE, SKIP, _share)
+from .narrow import narrow_enabled, score_dtype
 from .pack import pack_inputs
 from .pack import unpack as _unpack
 from .solver import dynamic_node_score
@@ -144,6 +145,26 @@ class CycleArrays(NamedTuple):
     task_ports: Optional[jnp.ndarray] = None     # [T,PT] bool
     port_base: Optional[jnp.ndarray] = None      # [N,PT] bool
     ip_weight: Optional[jnp.ndarray] = None      # [] f32 (pod_aff weight)
+
+
+def resource_eligibility(idle, releasing, n_tasks, a: CycleArrays,
+                         pipe_enabled: bool, eps) -> jnp.ndarray:
+    """[T, N] predicate + capacity eligibility (no affinity terms): the
+    sig-indexed static predicate AND task-count room AND (fits
+    idle+backfilled OR, with pipelining, fits releasing) against the
+    given carry. THE shared definition — the round's eligibility phase,
+    its same-round retry, and the two-level coarse pass
+    (kernels/hier.py) all call it, so the FAIL-vs-WAIT semantics the
+    coarse pass derives from it can never drift from what the round
+    actually enforces."""
+    accessible = idle + a.backfilled
+    base = a.node_ok & (n_tasks < a.max_task_num)
+    fit = jnp.all(a.init_resreq[:, None, :] <= accessible[None] + eps,
+                  axis=-1)
+    if pipe_enabled:
+        fit = fit | jnp.all(
+            a.init_resreq[:, None, :] <= releasing[None] + eps, axis=-1)
+    return a.sig_pred[a.task_sig] & base[None, :] & fit
 
 
 def _segmented_prefix(values: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
@@ -414,13 +435,25 @@ _WINDOW_SLACK = 0.85
 def _round(state: RoundState, a: CycleArrays, round_idx,
            job_keys: Tuple[str, ...], queue_keys: Tuple[str, ...],
            prop_overused: bool, dyn_enabled: bool,
-           pipe_enabled: bool = True, seq_stride: int = 0):
+           pipe_enabled: bool = True, seq_stride: int = 0,
+           narrow: bool = False, elig_elsewhere=None):
     """One allocation round.  Returns (new_state, progress).
 
     ``pipe_enabled`` is a static specialization: when the host saw no
     releasing resources anywhere at cycle start (the common case — and
     allocate never creates releasing), every pipeline-fit matrix folds to
-    False at trace time, halving the [T,N] fit work per round."""
+    False at trace time, halving the [T,N] fit work per round.
+
+    ``narrow`` (static) applies the kernels/narrow.py memory diet: the
+    [T,N]-scale score gathers materialize in bfloat16 (decision-identical
+    — scores are small integer-valued floats, exact in bf16) while every
+    epsilon-compared resource quantity stays float32.
+
+    ``elig_elsewhere`` ([T] bool, or None): the two-level solve's hook —
+    when the round runs on one node-pool BLOCK (kernels/hier.py), a task
+    with no eligible node in the block but an eligible node in some
+    OTHER pool must WAIT for a later wave, not fail its job; the flat
+    solve passes None and keeps the exact allocate.go drop semantics."""
     eps = jnp.asarray(VEC_EPS)
     t_pad = a.task_valid.shape[0]
     n_pad = a.node_ok.shape[0]
@@ -540,19 +573,13 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
         jnp.arange(t_pad, dtype=jnp.int32))
 
     # ---- 2. exact eligibility ------------------------------------------
+    # (the shared resource_eligibility definition; accessible/base/pred_t
+    # recomputed locally for the waterfall/retry — XLA CSEs the overlap)
     accessible = state.idle + a.backfilled
-    room = state.n_tasks < a.max_task_num
-    base = a.node_ok & room
-    fit_alloc = jnp.all(a.init_resreq[:, None, :] <= accessible[None] + eps,
-                        axis=-1)
-    if pipe_enabled:
-        fit_pipe = jnp.all(
-            a.init_resreq[:, None, :] <= state.releasing[None] + eps,
-            axis=-1)
-    else:
-        fit_pipe = jnp.zeros_like(fit_alloc)
+    base = a.node_ok & (state.n_tasks < a.max_task_num)
     pred_t = a.sig_pred[a.task_sig]
-    eligible = pred_t & base[None, :] & (fit_alloc | fit_pipe)
+    eligible = resource_eligibility(state.idle, state.releasing,
+                                    state.n_tasks, a, pipe_enabled, eps)
     aff = a.node_dom is not None   # static: pytree structure
     if aff:
         aff_ok, could_wait = _aff_eligibility(state, a)
@@ -564,6 +591,10 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
         # a positive-affinity task whose group a same-cycle placement can
         # still populate waits (stays SKIP) instead of killing its job
         fail_now = fail_now & ~could_wait
+    if elig_elsewhere is not None:
+        # block-restricted round (two-level solve): eligibility elsewhere
+        # in the cluster means "wait for a later wave", never FAIL
+        fail_now = fail_now & ~elig_elsewhere
     # first failing rank per job kills the job's later-ranked tasks; only
     # the breaking task itself is marked FAIL (allocate.go:187-189 — the
     # rest simply stay Pending once the job leaves the queue)
@@ -588,7 +619,10 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
             lambda nz: dynamic_node_score(state.nz_req, nz,
                                           a.allocatable_cm,
                                           a.dyn_weights))(a.pair_nz)
-    sc = a.sig_scores[a.pair_sig] + dyn_term              # [P,N]
+    # accumulate in f32 (the narrow seam), then store the [P,N] matrix —
+    # and its [T,N] task gather below — at the policy dtype
+    sdt = score_dtype(narrow)
+    sc = (a.sig_scores[a.pair_sig] + dyn_term).astype(sdt)  # [P,N]
 
     # The waterfall is ONE shared mass ledger (independent per-cohort
     # waterfalls over-propose the globally best nodes and serialize into
@@ -643,9 +677,11 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     if aff and a.ip_weight is not None:
         # interpod-affinity score term (nodeorder.go:305-313) against
         # round-start counts; scored tasks leave the shared waterfall —
-        # their rows are task-specific, not cohort-wide
+        # their rows are task-specific, not cohort-wide. The term is
+        # integer-valued (floor(10*x) * weight), so the f32-accumulate /
+        # narrow-store round trip is exact.
         ip_term, ip_scored = _ip_score(state, a)
-        sc_rows = sc_rows + ip_term
+        sc_rows = (sc_rows.astype(jnp.float32) + ip_term).astype(sdt)
         water_elig = water_elig & ~ip_scored
     fb = jnp.argmax(jnp.where(eligible, sc_rows, -jnp.inf), axis=1)
     proposal1 = jnp.where(water_elig, p_water, fb).astype(jnp.int32)
@@ -749,14 +785,8 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
             # could race a phase-1 winner in ways only the next round's
             # refreshed counts can adjudicate
             retry = retry & ~_aff_involved(state, a)
-        acc_c = idle_c + a.backfilled
-        fit_r = jnp.all(a.init_resreq[:, None, :] <= acc_c[None] + eps,
-                        axis=-1)
-        if pipe_enabled:
-            fit_r = fit_r | jnp.all(
-                a.init_resreq[:, None, :] <= rel_c[None] + eps, axis=-1)
-        room_r = ntasks_c < a.max_task_num
-        eligible_r = pred_t & (a.node_ok & room_r)[None, :] & fit_r
+        eligible_r = resource_eligibility(idle_c, rel_c, ntasks_c, a,
+                                          pipe_enabled, eps)
         if aff:
             eligible_r = eligible_r & aff_ok
         fb_r = jnp.argmax(jnp.where(eligible_r, sc_rows, -jnp.inf),
@@ -906,17 +936,18 @@ def _rollback_stranded(state: RoundState, a: CycleArrays,
 
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys",
                                    "prop_overused", "dyn_enabled",
-                                   "pipe_enabled"))
+                                   "pipe_enabled", "narrow"))
 def batched_round(state: RoundState, a: CycleArrays, round_idx,
                   job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY,
                                                K_DRF_SHARE),
                   queue_keys: Tuple[str, ...] = (K_PROP_SHARE,),
                   prop_overused: bool = True,
                   dyn_enabled: bool = False,
-                  pipe_enabled: bool = True):
+                  pipe_enabled: bool = True,
+                  narrow: bool = False):
     """Single-round entry point (tests / diagnostics)."""
     return _round(state, a, round_idx, job_keys, queue_keys, prop_overused,
-                  dyn_enabled, pipe_enabled)
+                  dyn_enabled, pipe_enabled, narrow=narrow)
 
 
 # accounted trace boundary (compilesvc); nested calls from the packed /
@@ -936,7 +967,8 @@ _AFF_TASK_FIELDS = ("task_grp", "task_req_aff", "task_req_anti",
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys",
                                    "prop_overused", "dyn_enabled",
                                    "pipe_enabled", "max_rounds",
-                                   "compact_bucket", "gang_enabled"))
+                                   "compact_bucket", "gang_enabled",
+                                   "narrow"))
 def batched_allocate(state: RoundState, a: CycleArrays,
                      job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY,
                                                   K_DRF_SHARE),
@@ -946,7 +978,8 @@ def batched_allocate(state: RoundState, a: CycleArrays,
                      pipe_enabled: bool = True,
                      max_rounds: int = 64,
                      compact_bucket: int = 0,
-                     gang_enabled: bool = True):
+                     gang_enabled: bool = True,
+                     narrow: bool = False):
     """The whole allocate cycle: rounds run in a device-side while_loop
     until a round makes no progress — ONE dispatch, one readback.
 
@@ -972,7 +1005,8 @@ def batched_allocate(state: RoundState, a: CycleArrays,
             s, round_idx, _ = carry
             ns, progress = _round(s, arrays, round_idx, job_keys,
                                   queue_keys, prop_overused, dyn_enabled,
-                                  pipe_enabled, seq_stride=t_pad)
+                                  pipe_enabled, seq_stride=t_pad,
+                                  narrow=narrow)
             return ns, round_idx + 1, progress
 
         init = (st, jnp.int32(start_round), jnp.asarray(True))
@@ -1017,7 +1051,7 @@ def batched_allocate(state: RoundState, a: CycleArrays,
 
     state, _ = _round(state, a, jnp.int32(0), job_keys, queue_keys,
                       prop_overused, dyn_enabled, pipe_enabled,
-                      seq_stride=t_pad)
+                      seq_stride=t_pad, narrow=narrow)
     unresolved = (a.task_valid & (state.task_state == SKIP)
                   & state.job_alive[jnp.maximum(a.task_job, 0)])
     if prop_overused:
@@ -1102,12 +1136,12 @@ _PORT_BOOL = ("task_ports", "port_base")
                                    "queue_keys", "prop_overused",
                                    "dyn_enabled", "pipe_enabled",
                                    "max_rounds", "compact_bucket",
-                                   "gang_enabled"))
+                                   "gang_enabled", "narrow"))
 def _batched_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
                     backfilled, allocatable_cm, max_task_num, node_ok,
                     lay_f, lay_i, lay_b, job_keys, queue_keys,
                     prop_overused, dyn_enabled, pipe_enabled, max_rounds,
-                    compact_bucket, gang_enabled=True):
+                    compact_bucket, gang_enabled=True, narrow=False):
     f = _unpack(buf_f, lay_f)
     i = _unpack(buf_i, lay_i)
     b = _unpack(buf_b, lay_b)
@@ -1129,7 +1163,7 @@ def _batched_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
                                       allocatable_cm, max_task_num, node_ok,
                                       job_keys, queue_keys, prop_overused,
                                       dyn_enabled, pipe_enabled, max_rounds,
-                                      compact_bucket, gang_enabled))
+                                      compact_bucket, gang_enabled, narrow))
 
 
 # accounted trace boundary (compilesvc): the production whole-cycle entry
@@ -1149,7 +1183,7 @@ def _pack_result(final: RoundState, rounds):
 def _run_batched(state, f, i, b, backfilled, allocatable_cm, max_task_num,
                  node_ok, job_keys, queue_keys, prop_overused, dyn_enabled,
                  pipe_enabled, max_rounds, compact_bucket,
-                 gang_enabled=True):
+                 gang_enabled=True, narrow=False):
     arrays = CycleArrays(
         backfilled=backfilled, allocatable_cm=allocatable_cm,
         max_task_num=max_task_num, node_ok=node_ok,
@@ -1176,7 +1210,8 @@ def _run_batched(state, f, i, b, backfilled, allocatable_cm, max_task_num,
         state, arrays, job_keys=job_keys, queue_keys=queue_keys,
         prop_overused=prop_overused, dyn_enabled=dyn_enabled,
         pipe_enabled=pipe_enabled, max_rounds=max_rounds,
-        compact_bucket=compact_bucket, gang_enabled=gang_enabled)
+        compact_bucket=compact_bucket, gang_enabled=gang_enabled,
+        narrow=narrow)
 
 
 def prepare_batched(device, inputs, max_rounds: int = 0,
@@ -1235,7 +1270,17 @@ def prepare_batched(device, inputs, max_rounds: int = 0,
         dyn_enabled=inputs.dyn_enabled,
         max_rounds=min(max_rounds, 4096),
         compact_bucket=compact,
-        gang_enabled=inputs.gang_enabled)
+        gang_enabled=inputs.gang_enabled,
+        # shape-derived node bucket (``device`` may be the rpc wire's
+        # duck-typed DeviceSession, no n_padded property); AUTO narrow
+        # also requires the score scale to round-trip bf16 exactly
+        narrow=narrow_enabled(
+            int(device.node_ok.shape[0]), t_pad,
+            static_scores=inputs.sig_scores,
+            dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
+                         else None),
+            ip_weight=(aff.ip_weight
+                       if aff is not None and aff.ip_enabled else 0.0)))
     return args, statics
 
 
@@ -1302,7 +1347,7 @@ def _batched_signatures(inputs, regime: str, pipe_variants=(None,)):
 
 @_register_provider("kernels.batched")
 def compile_signatures(materials):
-    from ..actions.allocate import AUTO_BATCHED_MIN
+    from ..actions.allocate import AUTO_BATCHED_MIN, AUTO_HIER_MIN_NODES
 
     out = []
     for regime, inputs in (("cold", materials.cold_inputs),
@@ -1311,6 +1356,12 @@ def compile_signatures(materials):
             continue
         if len(inputs.tasks) < AUTO_BATCHED_MIN:
             continue    # this regime dispatches the fused engine
+        if len(inputs.device.state.names) >= AUTO_HIER_MIN_NODES \
+                and getattr(inputs, "affinity", None) is None:
+            # the two-level engine owns this regime (kernels/hier.py);
+            # compiling the flat [T, N] graph here would be exactly the
+            # unbounded cold-compile (and OOM) the hier split avoids
+            continue
         # reclaim/preempt configs can open a batched cycle with releasing
         # capacity on the nodes (evictions pending) — pipe_enabled is a
         # static, so both variants are part of the registered surface

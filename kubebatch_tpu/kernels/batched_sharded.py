@@ -43,6 +43,7 @@ from ..compilesvc import instrument as _instrument
 from ..compilesvc import register_provider as _register_provider
 from .batched import RoundState, CycleArrays, _IMAX, batched_allocate
 from .fused import SKIP
+from .narrow import narrow_enabled
 
 AXIS = "nodes"
 HOST_AXIS = "hosts"
@@ -124,15 +125,16 @@ def _specs_for(mesh: Mesh, affinity: bool = False, ports: bool = False,
 
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys", "prop_overused",
                                    "dyn_enabled", "pipe_enabled",
-                                   "max_rounds"))
+                                   "max_rounds", "narrow"))
 def _sharded_entry(state: RoundState, arrays: CycleArrays, job_keys,
                    queue_keys, prop_overused, dyn_enabled, pipe_enabled,
-                   max_rounds):
+                   max_rounds, narrow=False):
     final, rounds = batched_allocate(
         state, arrays, job_keys=job_keys, queue_keys=queue_keys,
         prop_overused=prop_overused, dyn_enabled=dyn_enabled,
         pipe_enabled=pipe_enabled, max_rounds=max_rounds,
-        compact_bucket=0)   # compaction gathers are counterproductive SPMD
+        compact_bucket=0,   # compaction gathers are counterproductive SPMD
+        narrow=narrow)
     return final, jnp.concatenate(
         [final.task_state, final.task_node, final.task_seq,
          rounds.astype(jnp.int32)[None]])
@@ -161,12 +163,13 @@ def _pad_node_cols(a: np.ndarray, n_pad: int, fill) -> np.ndarray:
 
 
 def shard_bucket(n: int, n_devices: int, minimum: int = 8) -> int:
-    """Node bucket: pow2 like tensorize.pad_to_bucket, then rounded up to
-    the next multiple of the mesh size so every shard gets equal rows
-    (a 6- or 12-device mesh is not a power of two)."""
-    b = minimum
-    while b < n:
-        b *= 2
+    """Node bucket: tensorize.pad_to_bucket (pow2, re-grained above
+    LARGE_BUCKET), then rounded up to the next multiple of the mesh size
+    so every shard gets equal rows (a 6- or 12-device mesh is not a
+    power of two)."""
+    from .tensorize import pad_to_bucket
+
+    b = pad_to_bucket(max(n, minimum), minimum)
     if b % n_devices:
         b = -(-b // n_devices) * n_devices
     return b
@@ -305,7 +308,16 @@ def prepare_sharded(mesh: Mesh, device, inputs, max_rounds: int = 0):
         prop_overused=inputs.prop_overused,
         dyn_enabled=inputs.dyn_enabled,
         pipe_enabled=inputs.pipe_enabled,
-        max_rounds=min(max_rounds, 4096))
+        max_rounds=min(max_rounds, 4096),
+        # PER-SHARD narrow policy: each device materializes
+        # [T, N/shards]; AUTO additionally requires bf16-exact scores
+        narrow=narrow_enabled(
+            max(1, n_sh // n_dev), t_pad,
+            static_scores=inputs.sig_scores,
+            dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
+                         else None),
+            ip_weight=(aff.ip_weight
+                       if aff is not None and aff.ip_enabled else 0.0)))
     return put(state, state_specs), put(arrays, array_specs), statics
 
 
@@ -317,7 +329,7 @@ def prepare_sharded(mesh: Mesh, device, inputs, max_rounds: int = 0):
 
 @_register_provider("kernels.batched_sharded")
 def compile_signatures(materials):
-    from ..actions.allocate import (AUTO_BATCHED_MIN,
+    from ..actions.allocate import (AUTO_BATCHED_MIN, AUTO_HIER_MIN_NODES,
                                     AUTO_SHARDED_MIN_NODES)
     from ..compilesvc.registry import Signature, signature_key
 
@@ -331,6 +343,9 @@ def compile_signatures(materials):
         if len(inputs.tasks) < AUTO_BATCHED_MIN \
                 or len(inputs.device.state.names) < AUTO_SHARDED_MIN_NODES:
             continue
+        if len(inputs.device.state.names) >= AUTO_HIER_MIN_NODES \
+                and getattr(inputs, "affinity", None) is None:
+            continue    # the two-level engine owns this regime
         mesh = node_mesh()
         placed_state, placed_arrays, base = prepare_sharded(
             mesh, inputs.device, inputs)

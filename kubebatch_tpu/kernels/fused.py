@@ -104,7 +104,7 @@ class FusedState(NamedTuple):
 
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys", "gang_enabled",
                                    "prop_overused", "dyn_enabled",
-                                   "max_iters"))
+                                   "max_iters", "narrow"))
 def fused_allocate(
         # nodes
         idle, releasing, backfilled, allocatable_cm, nz_req0, max_task_num,
@@ -131,10 +131,18 @@ def fused_allocate(
         gang_enabled: bool = True,
         prop_overused: bool = True,
         dyn_enabled: bool = False,
-        max_iters: int = 0):
+        max_iters: int = 0,
+        narrow: bool = False):
+    from .narrow import score_dtype
     from .solver import dynamic_node_score
     if dyn_weights is None:
         dyn_weights = jnp.zeros(2, jnp.float32)
+    # the narrow memory diet (kernels/narrow.py): the device-resident
+    # [S, N] score matrix stores at the policy dtype; scores are small
+    # integer-valued floats, so the round trip is exact and the per-
+    # iteration arithmetic below re-promotes to f32 (the accumulation
+    # seam) before any comparison
+    sig_scores = sig_scores.astype(score_dtype(narrow))
     eps = jnp.asarray(VEC_EPS)
     n_nodes = idle.shape[0]
     n_jobs = min_available.shape[0]

@@ -1,0 +1,571 @@
+"""Hierarchical two-level allocate — node-pool buckets, then the
+waterfall within the winning bucket.
+
+The round solver (kernels/batched.py) materializes [T, N]-scale fit and
+score matrices every round. docs/SCALING.md budgets that layout to
+~10x past cfg5; at cfg6/cfg7 (50-100k nodes x 50-100k pods) a single
+[T, N] matrix is gigabytes even narrowed, and no shard of a practical
+mesh can hold one. This module is the standard large-cluster move
+(the Omega/Borg two-level lineage in PAPERS.md): decompose the node
+axis into B contiguous POOLS of ``pool_size`` nodes and schedule in
+WAVES —
+
+1. **Coarse pass** (pool level, small): an exact per-(task, pool)
+   eligibility fold — computed pool-by-pool at [T, pool_size] peak
+   memory, never [T, N] — plus a pool score (the demand-majority
+   cohort's best eligible node score per pool, the same cohort the
+   waterfall ledgers). One small [T, B] problem.
+2. **Winning bucket**: the best-scoring pool that still has eligible
+   pending work. Ties break to the lowest pool index — the same
+   direction the flat waterfall's stable node sort fills.
+3. **Within-bucket waterfall**: the EXISTING round solver
+   (batched._round — ordering, demand window, waterfall, two-phase
+   acceptance, gang kill semantics, all unchanged) runs with every
+   node-axis array dynamic-sliced to the winning bucket's block, so the
+   big intermediates are [T, pool_size]. A task with no eligible node
+   in the block but eligibility elsewhere WAITS for a later wave
+   (the ``elig_elsewhere`` hook) instead of failing its job; a task
+   eligible NOWHERE fails exactly like the flat solve (allocate.go's
+   drop-on-first-unassignable, same global-rank first-fail per job).
+4. Waves repeat — capacity consumed in one bucket re-ranks the next
+   coarse pass — until no pool has eligible pending work. The
+   stranded-gang epilogue (rollback + revive, then final retire) runs
+   at full task width, exactly as the flat engine's; it touches only
+   [T]- and [N]-scale state, never [T, N].
+
+The whole wave loop runs INSIDE one jit dispatch (a ``while_loop`` over
+waves around the existing ``while_loop`` over rounds), so the cycle
+still performs exactly ONE blocking readback — the [3T+1] packed
+decision buffer, identical to the flat entry's.
+
+Faithfulness: within a wave the solve IS the batched round solver on a
+node subset; across waves, ordering is wave-granular the same way the
+flat engine's is round-granular. When one bucket covers every eligible
+node of the cycle's demand (the regime the downsampled equality test
+pins), decisions are bit-identical to the flat solve. Under
+cross-bucket contention the task->node map can differ from the flat
+schedule while satisfying the same policy constraints — the same
+contract batched.py documents vs the sequential oracle, one level up.
+
+Inter-pod affinity / host-port cycles are NOT expressible here (their
+domain carries are cluster-global); the action layer falls back to the
+flat engines for them, counted in ``engine_demotions_total``.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compilesvc import instrument as _instrument
+from ..compilesvc import register_provider as _register_provider
+from ..metrics import count_blocking_readback
+from ..obs import span as _span
+from .batched import (CycleArrays, RoundState, _IMAX, _PACK_BOOL, _PACK_F32,
+                      _PACK_I32, _pack_result, _rollback_stranded, _round,
+                      _stranded_jobs, resource_eligibility)
+from .fused import (ALLOC, ALLOC_OB, K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
+                    K_PROP_SHARE, PIPELINE, SKIP)
+from .narrow import narrow_enabled
+from .pack import pack_inputs
+from .pack import unpack as _unpack
+from .solver import dynamic_node_score
+from .tensorize import VEC_EPS
+
+_BIG_NEG = jnp.float32(-3.0e38)
+
+#: placed-family decision codes (remap targets for block->global nodes)
+_PLACED = (ALLOC, ALLOC_OB, PIPELINE)
+
+
+def hier_pool_size(n_pad: int) -> int:
+    """The pool (bucket) width for a padded node axis — must divide
+    ``n_pad``. Large re-bucketed axes (multiples of the 4096 grain,
+    kernels/tensorize.pad_to_bucket) use the grain itself; small pow2
+    axes split in 8 so the equality tests exercise real multi-pool
+    plans. KUBEBATCH_HIER_POOL overrides (clamped to a divisor)."""
+    import os
+
+    def divisor_at_most(p: int) -> int:
+        p = max(1, min(p, n_pad))
+        while n_pad % p:
+            p -= 1
+        return p
+
+    env = os.environ.get("KUBEBATCH_HIER_POOL", "").strip()
+    if env:
+        return divisor_at_most(int(env))
+    if n_pad % 4096 == 0 and n_pad > 4096:
+        return 4096
+    # non-grain-aligned axes (mesh-rounded shard buckets on 6/12-device
+    # meshes) clamp down to the nearest divisor too
+    return divisor_at_most(n_pad // 8) if n_pad >= 64 else n_pad
+
+
+def _block_state(state: RoundState, off, pool: int):
+    """RoundState with the node-axis carry sliced to one block."""
+    r = state.idle.shape[1]
+    return state._replace(
+        idle=jax.lax.dynamic_slice(state.idle, (off, 0), (pool, r)),
+        releasing=jax.lax.dynamic_slice(state.releasing, (off, 0),
+                                        (pool, r)),
+        n_tasks=jax.lax.dynamic_slice(state.n_tasks, (off,), (pool,)),
+        nz_req=jax.lax.dynamic_slice(state.nz_req, (off, 0), (pool, 2)))
+
+
+def _block_arrays(a: CycleArrays, off, pool: int):
+    """CycleArrays with every node-axis array sliced to one block."""
+    r = a.backfilled.shape[1]
+    s = a.sig_scores.shape[0]
+    return a._replace(
+        backfilled=jax.lax.dynamic_slice(a.backfilled, (off, 0), (pool, r)),
+        allocatable_cm=jax.lax.dynamic_slice(a.allocatable_cm, (off, 0),
+                                             (pool, 2)),
+        max_task_num=jax.lax.dynamic_slice(a.max_task_num, (off,), (pool,)),
+        node_ok=jax.lax.dynamic_slice(a.node_ok, (off,), (pool,)),
+        sig_scores=jax.lax.dynamic_slice(a.sig_scores, (0, off), (s, pool)),
+        sig_pred=jax.lax.dynamic_slice(a.sig_pred, (0, off), (s, pool)))
+
+
+def _merge_block(state: RoundState, bfinal: RoundState, off, pool: int):
+    """Fold a finished wave's block state back into the full-width
+    state: node carry via dynamic_update_slice, task/job/queue state
+    carried whole (the block round updated them at full width), and the
+    block-LOCAL node indices of this wave's new placements remapped to
+    global rows."""
+    newly = (bfinal.task_state != state.task_state)
+    placed = ((bfinal.task_state == ALLOC) | (bfinal.task_state == ALLOC_OB)
+              | (bfinal.task_state == PIPELINE))
+    task_node = jnp.where(newly & placed,
+                          bfinal.task_node + off.astype(jnp.int32),
+                          state.task_node)
+    return state._replace(
+        idle=jax.lax.dynamic_update_slice(state.idle, bfinal.idle, (off, 0)),
+        releasing=jax.lax.dynamic_update_slice(state.releasing,
+                                               bfinal.releasing, (off, 0)),
+        n_tasks=jax.lax.dynamic_update_slice(state.n_tasks, bfinal.n_tasks,
+                                             (off,)),
+        nz_req=jax.lax.dynamic_update_slice(state.nz_req, bfinal.nz_req,
+                                            (off, 0)),
+        q_allocated=bfinal.q_allocated, j_allocated=bfinal.j_allocated,
+        alloc_cnt=bfinal.alloc_cnt, job_alive=bfinal.job_alive,
+        task_state=bfinal.task_state, task_node=task_node,
+        task_seq=bfinal.task_seq)
+
+
+def _coarse_pass(state: RoundState, a: CycleArrays, pool: int,
+                 pipe_enabled: bool, dyn_enabled: bool):
+    """The pool-level pass: exact per-(task, pool) any-eligibility —
+    the round solver's OWN resource_eligibility applied block by block
+    at [T, pool] peak memory (one shared definition, so the
+    FAIL-vs-WAIT semantics derived from it can never drift from what
+    the round enforces) — plus the demand-majority cohort's best
+    eligible score per pool.
+
+    Returns (task_pool_elig [T, B] bool, pool_best [B] f32)."""
+    eps = jnp.asarray(VEC_EPS)
+    n_pad = a.node_ok.shape[0]
+    t_pad = a.task_valid.shape[0]
+    n_pools = n_pad // pool
+
+    base = a.node_ok & (state.n_tasks < a.max_task_num)      # [N]
+
+    def one_pool(p, acc_elig):
+        off = p * pool
+        bs = _block_state(state, off, pool)
+        ba = _block_arrays(a, off, pool)
+        elig = resource_eligibility(bs.idle, bs.releasing, bs.n_tasks,
+                                    ba, pipe_enabled, eps)   # [T, pool]
+        col = jnp.any(elig, axis=1)                          # [T]
+        return jax.lax.dynamic_update_slice(acc_elig, col[:, None], (0, p))
+
+    task_pool_elig = jax.lax.fori_loop(
+        0, n_pools, one_pool, jnp.zeros((t_pad, n_pools), bool))
+
+    # demand-majority cohort (the waterfall's shared-ledger cohort)
+    engaged = (a.task_valid & (state.task_state == SKIP)
+               & state.job_alive[jnp.maximum(a.task_job, 0)]
+               & a.job_valid[jnp.maximum(a.task_job, 0)])
+    pair_demand = jax.ops.segment_sum(
+        engaged.astype(jnp.int32), a.task_pair,
+        num_segments=a.pair_sig.shape[0])
+    maj = jnp.argmax(pair_demand)
+    sc_maj = a.sig_scores[a.pair_sig[maj]].astype(jnp.float32)
+    if dyn_enabled:
+        sc_maj = sc_maj + dynamic_node_score(state.nz_req, a.pair_nz[maj],
+                                             a.allocatable_cm,
+                                             a.dyn_weights)
+    pred_maj = a.sig_pred[a.pair_sig[maj]]
+    pool_best = jnp.where(pred_maj & base, sc_maj, _BIG_NEG
+                          ).reshape(n_pools, pool).max(axis=1)
+    return task_pool_elig, pool_best
+
+
+def hier_allocate(state: RoundState, a: CycleArrays,
+                  job_keys: Tuple[str, ...] = (K_PRIORITY, K_GANG_READY,
+                                               K_DRF_SHARE),
+                  queue_keys: Tuple[str, ...] = (K_PROP_SHARE,),
+                  prop_overused: bool = True,
+                  dyn_enabled: bool = False,
+                  pipe_enabled: bool = True,
+                  max_rounds: int = 64,
+                  pool_size: int = 0,
+                  max_waves: int = 0,
+                  gang_enabled: bool = True,
+                  narrow: bool = True):
+    """The whole two-level allocate cycle — waves of (coarse pool pass →
+    within-bucket round loop) in ONE device dispatch. Same return shape
+    as batched_allocate: (final RoundState, rounds)."""
+    t_pad = a.task_valid.shape[0]
+    n_pad = a.node_ok.shape[0]
+    pool = pool_size if pool_size > 0 else hier_pool_size(n_pad)
+    assert n_pad % pool == 0, (n_pad, pool)
+    n_pools = n_pad // pool
+    if max_waves <= 0:
+        # every productive wave changes >= 1 task state, and between two
+        # productive waves at most n_pools dead waves can run (each dead
+        # wave quarantines a distinct pool; with every candidate pool
+        # blocked the loop exits) — so this bound can never cut off
+        # eligible pending work. It is a safety net like the flat
+        # engine's max_rounds, not the expected wave count, and a large
+        # value costs nothing (the loop exits on has_work).
+        max_waves = (t_pad + 8) * (n_pools + 1)
+
+    def block_rounds(st, barrays, rounds0, elig_elsewhere):
+        def cond(carry):
+            _, round_idx, progress = carry
+            return progress & (round_idx < max_rounds)
+
+        def body(carry):
+            s, round_idx, _ = carry
+            ns, progress = _round(s, barrays, round_idx, job_keys,
+                                  queue_keys, prop_overused, dyn_enabled,
+                                  pipe_enabled, seq_stride=t_pad,
+                                  narrow=narrow,
+                                  elig_elsewhere=elig_elsewhere)
+            return ns, round_idx + 1, progress
+
+        init = (st, rounds0, jnp.asarray(True))
+        return jax.lax.while_loop(cond, body, init)
+
+    def waves_loop(state, rounds0):
+        def cond(carry):
+            _, _, wave, _, has_work = carry
+            return has_work & (wave < max_waves)
+
+        def body(carry):
+            st, rounds, wave, blocked, _ = carry
+            task_pool_elig, pool_best = _coarse_pass(st, a, pool,
+                                                     pipe_enabled,
+                                                     dyn_enabled)
+            pending = (a.task_valid & (st.task_state == SKIP)
+                       & st.job_alive[jnp.maximum(a.task_job, 0)]
+                       & a.job_valid[jnp.maximum(a.task_job, 0)])
+            cand_cnt = (task_pool_elig
+                        & pending[:, None]).sum(axis=0)      # [B]
+            key = jnp.where((cand_cnt > 0) & ~blocked, pool_best, -jnp.inf)
+            has_work = jnp.any(key > -jnp.inf)
+            winner = jnp.argmax(key)
+
+            def run_block(args):
+                st, rounds, blocked = args
+                off = (winner * pool).astype(jnp.int32)
+                elig_elsewhere = jnp.any(
+                    task_pool_elig
+                    & (jnp.arange(n_pools) != winner)[None, :], axis=1)
+                bstate = _block_state(st, off, pool)
+                barrays = _block_arrays(a, off, pool)
+                bfinal, rounds_n, _ = block_rounds(bstate, barrays, rounds,
+                                                   elig_elsewhere)
+                merged = _merge_block(st, bfinal, off, pool)
+                progressed = jnp.any(merged.task_state != st.task_state)
+                # a dead wave quarantines its pool until the next
+                # productive wave refreshes capacity; a productive wave
+                # re-opens every pool
+                blocked_n = jnp.where(
+                    progressed, jnp.zeros_like(blocked),
+                    blocked.at[winner].set(True))
+                return merged, rounds_n, blocked_n
+
+            st_out, rounds_out, blocked_out = jax.lax.cond(
+                has_work, run_block, lambda args: args,
+                (st, rounds, blocked))
+            return st_out, rounds_out, wave + 1, blocked_out, has_work
+
+        init = (state, rounds0, jnp.int32(0),
+                jnp.zeros(n_pools, bool), jnp.asarray(True))
+        st, rounds, _, _, _ = jax.lax.while_loop(cond, body, init)
+
+        # terminal FAIL sweep: with no pool left holding eligible
+        # pending work, tasks eligible NOWHERE must still fail (and
+        # gang-kill) exactly like the flat engine's round would — the
+        # wave loop alone never runs a round for them (a cycle whose
+        # every pending task is oversized would otherwise leave all
+        # jobs alive). One block round on pool 0 with elig_elsewhere =
+        # any-pool eligibility applies the ordering/window/first-fail
+        # semantics; tasks eligible in some (possibly quarantined)
+        # pool keep waiting for the next cycle.
+        task_pool_elig, _ = _coarse_pass(st, a, pool, pipe_enabled,
+                                         dyn_enabled)
+        elig_any = jnp.any(task_pool_elig, axis=1)
+        off0 = jnp.int32(0)
+        bfinal, rounds, _ = block_rounds(
+            _block_state(st, off0, pool), _block_arrays(a, off0, pool),
+            rounds, elig_any)
+        return _merge_block(st, bfinal, off0, pool), rounds
+
+    final, rounds = waves_loop(state, jnp.int32(0))
+
+    if gang_enabled:
+        # stranded-gang epilogue at full task width, the flat engine's
+        # exact structure (batched.batched_allocate): rollback + revive
+        # up to 3 passes (freed capacity re-enters the WAVE loop), then
+        # the final non-reviving rollback retires alive partial gangs
+        def epi_cond(carry):
+            s, _, k = carry
+            return (k < 3) & jnp.any(_stranded_jobs(s, a))
+
+        def epi_body(carry):
+            s, rounds, k = carry
+            s, _ = _rollback_stranded(s, a, revive=True)
+            s, rounds = waves_loop(s, rounds)
+            return s, rounds, k + 1
+
+        final, rounds, _ = jax.lax.while_loop(
+            epi_cond, epi_body, (final, rounds, jnp.int32(0)))
+        final, _ = _rollback_stranded(final, a, revive=False)
+    return final, rounds
+
+
+@partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",
+                                   "queue_keys", "prop_overused",
+                                   "dyn_enabled", "pipe_enabled",
+                                   "max_rounds", "pool_size", "max_waves",
+                                   "gang_enabled", "narrow"))
+def _hier_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
+                 backfilled, allocatable_cm, max_task_num, node_ok,
+                 lay_f, lay_i, lay_b, job_keys, queue_keys,
+                 prop_overused, dyn_enabled, pipe_enabled, max_rounds,
+                 pool_size, max_waves=0, gang_enabled=True, narrow=True):
+    f = _unpack(buf_f, lay_f)
+    i = _unpack(buf_i, lay_i)
+    b = _unpack(buf_b, lay_b)
+    t_pad = i["task_job"].shape[0]
+    state = RoundState(
+        idle=idle, releasing=releasing, n_tasks=n_tasks, nz_req=nz_req,
+        q_allocated=f["q_alloc0"], j_allocated=f["j_alloc0"],
+        alloc_cnt=i["init_allocated"], job_alive=b["job_valid"],
+        task_state=jnp.full(t_pad, SKIP, jnp.int32),
+        task_node=jnp.full(t_pad, -1, jnp.int32),
+        task_seq=jnp.full(t_pad, _IMAX, jnp.int32))
+    arrays = CycleArrays(
+        backfilled=backfilled, allocatable_cm=allocatable_cm,
+        max_task_num=max_task_num, node_ok=node_ok,
+        resreq=f["resreq"], init_resreq=f["init_resreq"],
+        task_nz=f["task_nz"], task_job=i["task_job"],
+        task_rank=i["task_rank"], task_sig=i["task_sig"],
+        task_pair=i["task_pair"], task_valid=b["task_valid"],
+        sig_scores=f["sig_scores"], sig_pred=b["sig_pred"],
+        pair_sig=i["pair_sig"], pair_nz=f["pair_nz"],
+        order_min_available=i["order_min_available"],
+        job_queue=i["job_queue"], job_priority=f["job_priority"],
+        job_create_rank=i["job_create_rank"], job_valid=b["job_valid"],
+        q_deserved=f["q_deserved"], q_create_rank=i["q_create_rank"],
+        cluster_total=f["cluster_total"], dyn_weights=f["dyn_weights"])
+    return _pack_result(*hier_allocate(
+        state, arrays, job_keys=job_keys, queue_keys=queue_keys,
+        prop_overused=prop_overused, dyn_enabled=dyn_enabled,
+        pipe_enabled=pipe_enabled, max_rounds=max_rounds,
+        pool_size=pool_size, max_waves=max_waves,
+        gang_enabled=gang_enabled, narrow=narrow))
+
+
+# accounted trace boundary (compilesvc): the two-level whole-cycle entry
+_hier_packed = _instrument("hier", "_hier_packed", _hier_packed)
+
+
+def prepare_hier(device, inputs, max_rounds: int = 0,
+                 pool_size: int = 0):
+    """The exact (args, statics) the two-level packed entry dispatches
+    for this (device, inputs) pair — shared by the live dispatch and the
+    compilesvc signature provider (same can't-drift discipline as
+    prepare_batched). Affinity cycles are NOT expressible here — the
+    action layer gates them to the flat engines first."""
+    assert getattr(inputs, "affinity", None) is None, \
+        "hier requires an affinity-free cycle (action layer gates this)"
+    t_pad = inputs.task_valid.shape[0]
+    n_pad = int(device.node_ok.shape[0])   # wire devices lack n_padded
+    if max_rounds <= 0:
+        max_rounds = int(t_pad) + 8
+    task_pair, pair_sig, pair_nz, _ = inputs.pair_terms()
+    extra = {"task_pair": task_pair, "pair_sig": pair_sig,
+             "pair_nz": pair_nz}
+    buf_f, lay_f, buf_i, lay_i, buf_b, lay_b = pack_inputs(
+        lambda n: extra[n] if n in extra else getattr(inputs, n),
+        _PACK_F32, _PACK_I32, _PACK_BOOL)
+    pool = pool_size if pool_size > 0 else hier_pool_size(n_pad)
+    args = (buf_f, buf_i, buf_b,
+            device.idle, device.releasing, device.n_tasks, device.nz_req,
+            device.backfilled, device.allocatable_cm, device.max_task_num,
+            device.node_ok)
+    statics = dict(
+        lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
+        job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+        prop_overused=inputs.prop_overused,
+        pipe_enabled=inputs.pipe_enabled,
+        dyn_enabled=inputs.dyn_enabled,
+        max_rounds=min(max_rounds, 4096),
+        pool_size=pool,
+        gang_enabled=inputs.gang_enabled,
+        # narrow by the FULL [T, N] problem (the scale that forced the
+        # two-level split), not the block — cfg6/cfg7 blocks ride bf16
+        # when the score scale round-trips exactly
+        narrow=narrow_enabled(
+            n_pad, t_pad, static_scores=inputs.sig_scores,
+            dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
+                         else None)))
+    return args, statics
+
+
+def solve_hier(device, inputs, max_rounds: int = 0, pool_size: int = 0):
+    """Drive the two-level wave loop — the hier twin of
+    kernels/batched.solve_batched: same CycleInputs in, same
+    (task_state, task_node, task_seq, rounds) numpy out, ONE blocking
+    readback, device carry committed on return."""
+    t_pad = inputs.task_valid.shape[0]
+    args, statics = prepare_hier(device, inputs, max_rounds, pool_size)
+    with _span("hier_allocate", cat="kernel"):
+        final, packed = _hier_packed(*args, **statics)
+        count_blocking_readback()
+        with _span("readback", cat="readback"):
+            out = np.asarray(packed)
+        task_state = out[:t_pad]
+        task_node = out[t_pad:2 * t_pad]
+        task_seq = out[2 * t_pad:3 * t_pad]
+        rounds = out[3 * t_pad]
+
+        device.idle = final.idle
+        device.releasing = final.releasing
+        device.n_tasks = final.n_tasks
+        device.nz_req = final.nz_req
+    return task_state, task_node, task_seq, int(rounds)
+
+
+# ---------------------------------------------------------------------
+# mesh twin — the wave loop with the node axis partitioned (GSPMD).
+# The coarse fold and the block slices are plain lax ops on annotated
+# arrays; XLA's SPMD partitioner inserts the collectives exactly as it
+# does for the flat sharded entry. Used by the 1-D / 2-D mesh equality
+# tests; cluster-scale runs pick hier OR sharded by topology.
+# ---------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("job_keys", "queue_keys",
+                                   "prop_overused", "dyn_enabled",
+                                   "pipe_enabled", "max_rounds",
+                                   "pool_size", "gang_enabled", "narrow"))
+def _hier_sharded_entry(state: RoundState, arrays: CycleArrays, job_keys,
+                        queue_keys, prop_overused, dyn_enabled,
+                        pipe_enabled, max_rounds, pool_size,
+                        gang_enabled=True, narrow=True):
+    final, rounds = hier_allocate(
+        state, arrays, job_keys=job_keys, queue_keys=queue_keys,
+        prop_overused=prop_overused, dyn_enabled=dyn_enabled,
+        pipe_enabled=pipe_enabled, max_rounds=max_rounds,
+        pool_size=pool_size, gang_enabled=gang_enabled, narrow=narrow)
+    return final, jnp.concatenate(
+        [final.task_state, final.task_node, final.task_seq,
+         rounds.astype(jnp.int32)[None]])
+
+
+_hier_sharded_entry = _instrument("hier", "_hier_sharded_entry",
+                                  _hier_sharded_entry)
+
+
+def solve_hier_sharded(mesh, device, inputs, max_rounds: int = 0,
+                       pool_size: int = 0):
+    """Two-level solve on the mesh: prepare/placement via the flat
+    sharded twin's annotation recipe (batched_sharded.prepare_sharded —
+    node axis split over every mesh axis, everything else replicated),
+    then the wave loop as one GSPMD dispatch."""
+    from .batched_sharded import prepare_sharded
+
+    n_pad = device.n_padded
+    t_pad = inputs.task_valid.shape[0]
+    placed_state, placed_arrays, base = prepare_sharded(
+        mesh, device, inputs, max_rounds)
+    n_sh = placed_arrays.node_ok.shape[0]
+    pool = pool_size if pool_size > 0 else hier_pool_size(n_sh)
+    statics = dict(
+        job_keys=base["job_keys"], queue_keys=base["queue_keys"],
+        prop_overused=base["prop_overused"],
+        dyn_enabled=base["dyn_enabled"],
+        pipe_enabled=base["pipe_enabled"],
+        max_rounds=base["max_rounds"], pool_size=pool,
+        gang_enabled=getattr(inputs, "gang_enabled", True),
+        narrow=narrow_enabled(
+            n_sh, t_pad, static_scores=inputs.sig_scores,
+            dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
+                         else None)))
+    with _span("hier_allocate_sharded", cat="kernel"):
+        final, packed = _hier_sharded_entry(placed_state, placed_arrays,
+                                            **statics)
+        count_blocking_readback()
+        with _span("readback", cat="readback"):
+            out = np.asarray(packed)
+        task_state = out[:t_pad]
+        task_node = out[t_pad:2 * t_pad]
+        task_seq = out[2 * t_pad:3 * t_pad]
+        rounds = out[3 * t_pad]
+        count_blocking_readback(4)
+        with _span("readback_carry", cat="readback", n=4):
+            device.idle = jnp.asarray(np.asarray(final.idle)[:n_pad])
+            device.releasing = jnp.asarray(
+                np.asarray(final.releasing)[:n_pad])
+            device.n_tasks = jnp.asarray(np.asarray(final.n_tasks)[:n_pad])
+            device.nz_req = jnp.asarray(np.asarray(final.nz_req)[:n_pad])
+    return task_state, task_node, task_seq, int(rounds)
+
+
+# ---------------------------------------------------------------------
+# compilesvc signature provider — the two-level entry registers for
+# configs whose node axis crosses the hier threshold (cfg6/cfg7); the
+# flat batched provider skips those same regimes, so the registered
+# surface matches what auto mode actually dispatches and the warm-up
+# never compiles a [T, N] flat graph the engine would refuse to run
+# ---------------------------------------------------------------------
+
+@_register_provider("kernels.hier")
+def compile_signatures(materials):
+    from ..actions.allocate import AUTO_BATCHED_MIN, AUTO_HIER_MIN_NODES
+    from ..compilesvc.registry import Signature, signature_key
+
+    out = []
+    for regime, inputs in (("cold", materials.cold_inputs),
+                           ("steady", materials.steady_inputs)):
+        if inputs is None or isinstance(inputs, str):
+            continue
+        if len(inputs.tasks) < AUTO_BATCHED_MIN:
+            continue    # this regime dispatches the fused engine
+        if len(inputs.device.state.names) < AUTO_HIER_MIN_NODES:
+            continue    # flat engines own this node axis
+        if getattr(inputs, "affinity", None) is not None:
+            continue    # affinity gates to the flat engines
+        args, base = prepare_hier(inputs.device, inputs)
+        pipes = ((False, True)
+                 if ("reclaim" in materials.actions
+                     or "preempt" in materials.actions)
+                 else (bool(inputs.pipe_enabled),))
+        for pipe in pipes:
+            statics = dict(base, pipe_enabled=pipe)
+            out.append(Signature(
+                engine="hier", entry="_hier_packed",
+                key=signature_key("_hier_packed", args, statics),
+                lower=lambda a=args, s=statics: _hier_packed.lower(*a, **s),
+                run=lambda a=args, s=statics: _hier_packed(*a, **s),
+                note=(f"{regime} T={inputs.task_valid.shape[0]} "
+                      f"N={inputs.device.n_padded} "
+                      f"pool={statics['pool_size']} pipe={pipe}")))
+    return out

@@ -1,0 +1,121 @@
+"""Narrow-dtype policy for the [T, N]-scale solver intermediates.
+
+docs/SCALING.md budgets the scale axis in [T, N] intermediates: at cfg5
+(16384 x 8192) a float32 score matrix is 512 MB and the round keeps ~4
+such arrays live; at cfg6/cfg7 (50-100k nodes) the f32 layout stops
+fitting long before the FLOPs do.  The memory diet, applied where each
+kernel materializes [T, N]-scale data:
+
+- **eligibility / fit masks** are pred-typed ``bool`` (1 byte/cell under
+  XLA — already the narrow layout; this module documents the invariant
+  so a future refactor doesn't silently promote them to int32).
+- **scores** ride ``bfloat16``.  Sound because every score the engines
+  materialize at [T, N] scale is *integer-valued and small*: the static
+  sig terms are host plugin scores (``floor(10 * x) * weight`` per
+  nodeorder plugin), the dynamic least-requested / balanced-resource
+  terms are threshold counts (kernels/solver.dynamic_node_score), and
+  the inter-pod preferred term is ``floor(10 * x) * weight`` — all
+  exactly representable in bf16's 8-bit mantissa up to 256.  The
+  narrowed path is therefore DECISION-IDENTICAL to f32, which the
+  parity tests in tests/test_zscale.py pin bit-for-bit on
+  cfg2p/cfg5-shaped inputs.
+- **resource arithmetic stays float32** — the f32 accumulation seam.
+  Capacity carries, request prefixes, the waterfall mass ledger and
+  every epsilon-compared fit quantity keep the exact dtype the
+  documented resource epsilons (api/resource.VEC_EPS) were calibrated
+  for; only the score gathers narrow.
+
+The flag is a STATIC jit argument on every entry that honors it (part
+of the trace signature and the compilesvc registry key), never ambient
+state: flipping the env var between calls can therefore never reuse a
+stale trace.
+
+Selection: ``KUBEBATCH_NARROW=1/0`` forces; unset, the policy is
+auto-by-size — narrow engages when the [T, N] product crosses
+``NARROW_AUTO_CELLS`` (the cfg6+ regime), so every existing config keeps
+its historical f32 graphs and signature keys.
+"""
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+
+__all__ = ["NARROW_AUTO_CELLS", "SCORE_WIDE_DTYPE", "SCORE_NARROW_DTYPE",
+           "narrow_enabled", "score_dtype"]
+
+#: auto threshold on the [T, N] cell count: cfg5 (16384 x 8192 = 1.3e8)
+#: stays f32; cfg6 (53248 x 53248 = 2.8e9) narrows.  2**29 ~= 5.4e8
+#: cells == 2 GiB of f32 score matrix — past it the f32 layout is the
+#: thing that breaks, so narrowing is the default, not an opt-in.
+NARROW_AUTO_CELLS = 2 ** 29
+
+#: auto threshold on the node axis ALONE: past the hier/cfg6 regime
+#: every node-dimensioned store ([S, N] victim sig matrices, small-T
+#: affinity cycles) narrows regardless of its other axis, so one
+#: cluster runs one dtype policy across its engines.
+NARROW_AUTO_NODES = 16384
+
+#: bf16 represents every integer exactly up to this magnitude; past it
+#: integer neighbors collapse and argmax ties break differently than
+#: f32 — the decision-identity contract's hard boundary
+BF16_EXACT_MAX = 256.0
+
+SCORE_WIDE_DTYPE = jnp.float32
+SCORE_NARROW_DTYPE = jnp.bfloat16
+
+
+def scores_bf16_exact(static_scores, dyn_weights=None,
+                      ip_weight=0.0) -> bool:
+    """True when every score the kernels materialize at [T, N] scale
+    round-trips bf16 EXACTLY: the static matrix is integer-valued (the
+    plugin floor-semantics guarantee — but NodeAffinity is a raw
+    preferred-weight sum, so magnitude must be checked, not assumed)
+    and the worst-case |static| + dynamic-term bound (<= 10 per unit
+    weight) + interpod bound stays within bf16's exact-integer range.
+    Host-side numpy on the [S, N] matrix — negligible at arg-build."""
+    import numpy as np
+
+    s = np.asarray(static_scores)
+    if s.size and not np.array_equal(s, np.round(s)):
+        return False
+    bound = float(np.max(np.abs(s))) if s.size else 0.0
+    if dyn_weights is not None:
+        w = np.asarray(dyn_weights, np.float64)
+        # fractional weights make the dynamic terms (integer counts x
+        # weight) non-integral — not exactly representable, gate closed
+        if not np.array_equal(w, np.round(w)):
+            return False
+        bound += 10.0 * float(np.sum(np.abs(w)))
+    if ip_weight:
+        if float(ip_weight) != round(float(ip_weight)):
+            return False
+        bound += 10.0 * abs(float(ip_weight))
+    return bound <= BF16_EXACT_MAX
+
+
+def narrow_enabled(n_pad: int, t_pad: int, static_scores=None,
+                   dyn_weights=None, ip_weight=0.0) -> bool:
+    """The policy decision for one (node bucket, other-axis bucket)
+    pair — called at arg-build time (prepare_* / upload sites), result
+    a static (or the store dtype itself).
+
+    When ``static_scores`` is given, AUTO narrowing additionally
+    requires :func:`scores_bf16_exact` — a cycle whose score scale
+    exceeds bf16's exact-integer range keeps f32 rather than silently
+    trading decisions for memory. The env override skips the gate (an
+    explicit operator/A-B choice, e.g. tools/narrow_ab.py)."""
+    env = os.environ.get("KUBEBATCH_NARROW", "").strip()
+    if env:
+        return env not in ("0", "false", "off")
+    if not (int(n_pad) >= NARROW_AUTO_NODES
+            or int(n_pad) * int(t_pad) >= NARROW_AUTO_CELLS):
+        return False
+    if static_scores is None:
+        return True
+    return scores_bf16_exact(static_scores, dyn_weights, ip_weight)
+
+
+def score_dtype(narrow: bool):
+    """The dtype score matrices materialize at [T, N] scale."""
+    return SCORE_NARROW_DTYPE if narrow else SCORE_WIDE_DTYPE

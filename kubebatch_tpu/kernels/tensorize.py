@@ -88,9 +88,24 @@ def accumulate_nz(tasks, rows, n_rows: int) -> np.ndarray:
     return out.astype(np.float32)
 
 
+#: above this, buckets re-grain from pow2 to multiples of LARGE_GRAIN:
+#: pow2 padding wastes up to 2x, and at cfg6/cfg7 axis sizes (50-100k)
+#: that waste is [T, N]-squared — 100k nodes would pad to 131072 (+31%)
+#: where the 4096 grain pads to 102400 (+2.4%). Every config at or
+#: below cfg5 scale (axes <= 16384) keeps its historical pow2 bucket,
+#: so existing compile signatures don't move.
+LARGE_BUCKET = 16384
+LARGE_GRAIN = 4096
+
+
 def pad_to_bucket(n: int, minimum: int = 8) -> int:
-    """Next power-of-two bucket >= max(n, minimum) — keeps jit cache hits
-    across cycles while cluster size drifts."""
+    """Next bucket >= max(n, minimum) — keeps jit cache hits across
+    cycles while cluster size drifts. Power-of-two up to LARGE_BUCKET;
+    past it, the next multiple of LARGE_GRAIN (the cfg6/cfg7 re-bucket:
+    fewer, denser buckets so one cluster-size step costs one bounded
+    compile, and [T, N] padding waste stays a few percent, not 2x)."""
+    if n > LARGE_BUCKET:
+        return -(-n // LARGE_GRAIN) * LARGE_GRAIN
     b = minimum
     while b < n:
         b *= 2
@@ -136,7 +151,12 @@ def sticky_bucket(key: str, n: int, minimum: int = 8,
     if ent is None or b >= ent[0]:
         st[key] = [b, 0]
         return b
-    if b * 2 == ent[0]:
+    # "one bucket below": the pow2 half-step, or one LARGE_GRAIN step
+    # when the HELD bucket sits on the re-grained axis (covers the
+    # 16384 <-> 20480 boundary, where b itself is still pow2-sized)
+    one_below = (b * 2 == ent[0]
+                 or (ent[0] > LARGE_BUCKET and ent[0] - b == LARGE_GRAIN))
+    if one_below:
         ent[1] += 1
         if ent[1] >= decay and not _shape_hold():
             ent[0], ent[1] = b, 0

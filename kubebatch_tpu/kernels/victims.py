@@ -344,7 +344,12 @@ def _visit_core(p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
     a single blocking readback per visit (each device->host transfer
     pays the full tunnel RTT).
     """
-    p_score = sig_scores[p_sig]
+    # the [S, N] score matrix may be stored narrow (kernels/narrow.py,
+    # engaged by host_sig_arrays at big node counts); every consumer —
+    # the dyn add and the choice lexsort — runs f32 (the accumulation
+    # seam), and the upcast is exact for the integer-valued plugin
+    # scores, so choices are identical to the f32 store. No-op on f32.
+    p_score = sig_scores[p_sig].astype(jnp.float32)
     p_pred = sig_pred[p_sig]
     pick0, guard_n, victims = _analysis_core(
         p_res, p_resreq, p_nz, p_score, p_pred, p_job, p_queue,
@@ -407,8 +412,10 @@ def _wave_kernel(p_res, p_resreq, p_nz, p_sig, sig_scores, sig_pred,
     device-resident (see _visit_core)."""
 
     def one(a, b, c, sig, f, g):
-        return _analysis_core(a, b, c, sig_scores[sig], sig_pred[sig],
-                              f, g, *shared,
+        # f32 seam for a possibly-narrow score store (see _visit_core)
+        return _analysis_core(a, b, c,
+                              sig_scores[sig].astype(jnp.float32),
+                              sig_pred[sig], f, g, *shared,
                               tiers=tiers, veto_critical=veto_critical,
                               filter_kind=filter_kind,
                               dyn_enabled=dyn_enabled,
@@ -1330,7 +1337,17 @@ class VictimSolver:
                 st.cluster_total, dyn_w)
 
     def host_sig_arrays(self):
-        """The bucket-padded [S, N] static-term matrices (score, pred)."""
+        """The bucket-padded [S, N] static-term matrices (score, pred).
+
+        At big node counts the score matrix ships and resides NARROW
+        (kernels/narrow.py policy; the pred matrix is already bool) —
+        the kernels upcast gathered rows to f32 before any arithmetic,
+        and the host chooser's fresh-score recompute keeps reading the
+        f32 ``terms.static.score``, so choices are bit-identical either
+        way (scores are integer-valued; parity pinned in
+        tests/test_zscale.py)."""
+        from .narrow import narrow_enabled, score_dtype
+
         score = self.terms.static.score
         pred = self.terms.static.pred
         s_pad = pad_to_bucket(score.shape[0], 4)
@@ -1338,6 +1355,13 @@ class VictimSolver:
             pad = s_pad - score.shape[0]
             score = np.pad(score, ((0, pad), (0, 0)))
             pred = np.pad(pred, ((0, pad), (0, 0)))
+        dyn_w = None
+        if self.dyn is not None and self.dyn.enabled:
+            dyn_w = (self.dyn.least_requested, self.dyn.balanced_resource)
+        narrow = narrow_enabled(score.shape[1], s_pad,
+                                static_scores=score, dyn_weights=dyn_w)
+        if narrow:
+            score = score.astype(score_dtype(True))
         return score, pred
 
     def host_mutable_arrays(self):

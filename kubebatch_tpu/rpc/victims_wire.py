@@ -36,8 +36,15 @@ _DTYPE_IDS = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
 def to_tensor(arr: np.ndarray) -> solver_pb2.Tensor:
     arr = np.ascontiguousarray(arr)
     if arr.dtype not in _DTYPE_IDS:
-        arr = arr.astype(np.float32 if np.issubdtype(arr.dtype, np.floating)
-                         else np.int32)
+        # extended float dtypes (ml_dtypes bfloat16 — the narrowed sig
+        # store, kernels/narrow.py — registers as kind 'V', NOT a
+        # np.floating subdtype) must upcast to f32, never fall into the
+        # int32 arm: truncating scores remote-side would silently
+        # diverge remote decisions from local ones
+        import ml_dtypes
+        floatish = (np.issubdtype(arr.dtype, np.floating)
+                    or arr.dtype == np.dtype(ml_dtypes.bfloat16))
+        arr = arr.astype(np.float32 if floatish else np.int32)
     return solver_pb2.Tensor(shape=list(arr.shape),
                              dtype=_DTYPE_IDS[arr.dtype],
                              data=arr.tobytes())

@@ -213,8 +213,8 @@ def test_batched_policy_envelope_predicate_rich():
     ran = []
     orig = allocate_batched.execute_batched
 
-    def spy(ssn, sharded=False):
-        out = orig(ssn, sharded=sharded)
+    def spy(ssn, sharded=False, hier=False):
+        out = orig(ssn, sharded=sharded, hier=hier)
         ran.append(out)
         return out
 

@@ -1,0 +1,231 @@
+"""ISSUE 10 scale-axis satellites: the narrow-dtype policy and its
+decision parity, the cfg6/cfg7 re-bucketed padding, and the compile-
+surface swap the two-level engine performs past the hier threshold.
+
+(The file sorts last on purpose: the scale tests compile fresh XLA
+graphs, and the tier-1 budget banks the established suite first.)
+"""
+import os
+
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.actions.cycle_inputs import build_cycle_inputs
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import CONFIG_ACTIONS, shipped_tiers
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.kernels.batched import solve_batched
+from kubebatch_tpu.kernels.narrow import (NARROW_AUTO_CELLS, narrow_enabled,
+                                          score_dtype, scores_bf16_exact)
+from kubebatch_tpu.kernels.tensorize import (LARGE_BUCKET, LARGE_GRAIN,
+                                             pad_to_bucket, sticky_bucket)
+from kubebatch_tpu.sim.cluster import (BASELINE_SPECS, ClusterSpec,
+                                       build_cluster)
+
+
+class _B:
+    def bind(self, pod, hostname):
+        pod.node_name = hostname
+
+    def evict(self, pod):
+        pod.deletion_timestamp = 1.0
+
+
+def _open(spec_or_cfg):
+    cache = SchedulerCache(binder=_B(), evictor=_B(), async_writeback=False)
+    sim = build_cluster(spec_or_cfg if isinstance(spec_or_cfg, ClusterSpec)
+                        else BASELINE_SPECS[spec_or_cfg])
+    sim.populate(cache)
+    return OpenSession(cache, shipped_tiers())
+
+
+# ---------------------------------------------------------------------
+# padding re-bucket (cfg6/cfg7 cold-compile boundedness)
+# ---------------------------------------------------------------------
+
+def test_pad_to_bucket_regrains_above_large_bucket():
+    # every historical bucket is untouched
+    assert pad_to_bucket(50) == 64
+    assert pad_to_bucket(5000) == 8192
+    assert pad_to_bucket(16384) == 16384
+    # past LARGE_BUCKET: next multiple of the grain, not pow2
+    assert pad_to_bucket(16385) == 16384 + LARGE_GRAIN
+    assert pad_to_bucket(50000) == 53248          # cfg6 (pow2 would be 65536)
+    assert pad_to_bucket(100000) == 102400        # cfg7 (pow2: 131072)
+    assert pad_to_bucket(104000) % LARGE_GRAIN == 0
+
+
+def test_sticky_bucket_grain_hysteresis():
+    store = {}
+    big = LARGE_BUCKET + 2 * LARGE_GRAIN
+    assert sticky_bucket("k", big, store=store) == big
+    # one grain below holds the larger bucket (no shape flip)
+    for _ in range(3):
+        assert sticky_bucket("k", big - LARGE_GRAIN, store=store) == big
+    # two grains below snaps down immediately
+    assert sticky_bucket("k", big - 2 * LARGE_GRAIN - 1,
+                         store=store) == big - 2 * LARGE_GRAIN
+    # the pow2/grain boundary itself: held 20480, dip to 16384 (one
+    # grain below but pow2-sized) must HOLD, not flip the shape
+    store = {}
+    edge = LARGE_BUCKET + LARGE_GRAIN
+    assert sticky_bucket("e", edge, store=store) == edge
+    assert sticky_bucket("e", LARGE_BUCKET, store=store) == edge
+
+
+# ---------------------------------------------------------------------
+# narrow policy
+# ---------------------------------------------------------------------
+
+def test_narrow_policy_auto_and_env(monkeypatch):
+    monkeypatch.delenv("KUBEBATCH_NARROW", raising=False)
+    assert not narrow_enabled(8192, 16384)        # cfg5: f32 stays
+    assert narrow_enabled(53248, 53248)           # cfg6: narrows
+    assert int(53248) * 53248 >= NARROW_AUTO_CELLS
+    # the node-axis rule: big-N stores narrow even with a small other
+    # axis (the victims [S, N] matrices at cfg6/cfg7 node counts)
+    assert narrow_enabled(53248, 8)
+    assert not narrow_enabled(8192, 8)
+    monkeypatch.setenv("KUBEBATCH_NARROW", "1")
+    assert narrow_enabled(8, 8)
+    monkeypatch.setenv("KUBEBATCH_NARROW", "0")
+    assert not narrow_enabled(10 ** 6, 10 ** 6)
+    assert str(score_dtype(True)) != str(score_dtype(False))
+
+
+def test_narrow_score_exactness_gate(monkeypatch):
+    """AUTO narrowing refuses score scales bf16 cannot round-trip
+    exactly (NodeAffinity is a raw preferred-weight sum and CAN exceed
+    256) — the decision-identity contract over memory."""
+    monkeypatch.delenv("KUBEBATCH_NARROW", raising=False)
+    small = np.array([[0.0, 10.0, 200.0]], np.float32)
+    big = np.array([[0.0, 10.0, 600.0]], np.float32)   # 601 vs 602 collide
+    frac = np.array([[0.25, 10.0]], np.float32)        # non-integer
+    assert scores_bf16_exact(small)
+    assert not scores_bf16_exact(big)
+    assert not scores_bf16_exact(frac)
+    # dynamic terms consume headroom: 250 static + 2x10 dyn > 256
+    assert not scores_bf16_exact(np.array([[250.0]], np.float32),
+                                 dyn_weights=(1.0, 1.0))
+    assert narrow_enabled(53248, 53248, static_scores=small)
+    assert not narrow_enabled(53248, 53248, static_scores=big)
+    # the env override is an explicit operator choice and skips the gate
+    monkeypatch.setenv("KUBEBATCH_NARROW", "1")
+    assert narrow_enabled(8, 8, static_scores=big)
+
+
+def test_cfg6_cfg7_wiring():
+    from kubebatch_tpu.kernels.hier import hier_pool_size
+
+    for cfg, nodes in ((6, 50000), (7, 100000)):
+        assert BASELINE_SPECS[cfg].n_nodes == nodes
+        assert CONFIG_ACTIONS[cfg] == ("allocate",)
+        n_pad = pad_to_bucket(nodes)
+        assert n_pad % hier_pool_size(n_pad) == 0
+    assert BASELINE_SPECS[7].n_groups * BASELINE_SPECS[7].pods_per_group \
+        > 100000
+
+
+# ---------------------------------------------------------------------
+# dtype parity: the narrowed path is DECISION-identical to f32
+# (the satellite's pin — scores are integer-valued, exact in bf16;
+# every epsilon-compared resource quantity stays f32 either way)
+# ---------------------------------------------------------------------
+
+#: cfg5-shaped contention at test scale: heterogeneous requests via
+#: jitter, multi-queue, 2x oversubscribed — the shape class where a
+#: score tie-break slip would show
+_CFG5_SHAPED = ClusterSpec(
+    n_nodes=48, n_groups=96, pods_per_group=4, n_queues=4,
+    queue_weights=(1, 2, 3, 4), pod_cpu_millis=1000,
+    pod_mem_bytes=2 * 1024 ** 3, jitter=0.2, seed=11)
+
+#: cfg2p-shaped: the predicate-rich mix (selectors, taints, both
+#: affinity kinds, preferred scores, ports) so the affinity/ip score
+#: seams run under narrow too
+_CFG2P_SHAPED = ClusterSpec(
+    n_nodes=16, n_groups=32, pods_per_group=4, n_zones=4,
+    selector_frac=0.15, taint_frac=0.1, toleration_frac=0.15,
+    anti_affinity_frac=0.1, zone_affinity_frac=0.06,
+    pref_affinity_frac=0.1, hostport_frac=0.06, seed=5)
+
+
+def _solve_with_narrow(spec, narrow_env, monkeypatch):
+    monkeypatch.setenv("KUBEBATCH_NARROW", narrow_env)
+    ssn = _open(spec)
+    try:
+        inputs = build_cycle_inputs(ssn, allow_affinity=True)
+        assert inputs is not None and not isinstance(inputs, str)
+        return solve_batched(inputs.device, inputs, compact_bucket=0)
+    finally:
+        CloseSession(ssn)
+        monkeypatch.delenv("KUBEBATCH_NARROW", raising=False)
+
+
+@pytest.mark.parametrize("spec", [_CFG5_SHAPED, _CFG2P_SHAPED],
+                         ids=["cfg5-shaped", "cfg2p-shaped"])
+def test_batched_narrow_decision_parity(spec, monkeypatch):
+    st_w, nd_w, sq_w, _ = _solve_with_narrow(spec, "0", monkeypatch)
+    st_n, nd_n, sq_n, _ = _solve_with_narrow(spec, "1", monkeypatch)
+    # the bit-identical pin on the final decision arrays
+    np.testing.assert_array_equal(st_w, st_n)
+    np.testing.assert_array_equal(nd_w, nd_n)
+    np.testing.assert_array_equal(sq_w, sq_n)
+    assert np.isin(st_w, [1, 2, 3]).sum() > 0   # a real cycle, not a no-op
+
+
+def test_fused_narrow_decision_parity(monkeypatch):
+    spec = ClusterSpec(n_nodes=8, n_groups=10, pods_per_group=3, seed=3,
+                       jitter=0.15)
+    results = {}
+    for env in ("0", "1"):
+        monkeypatch.setenv("KUBEBATCH_NARROW", env)
+        ssn = _open(spec)
+        AllocateAction(mode="fused").execute(ssn)
+        results[env] = {t.key: (t.status, t.node_name)
+                        for job in ssn.jobs.values()
+                        for t in job.tasks.values()}
+        CloseSession(ssn)
+    monkeypatch.delenv("KUBEBATCH_NARROW", raising=False)
+    assert results["0"] == results["1"]
+    assert any(n for _, n in results["0"].values())
+
+
+# ---------------------------------------------------------------------
+# compile-surface swap: past the hier threshold the registered surface
+# trades the flat [T, N] entry for the two-level one (so warm-up never
+# compiles a graph auto mode would refuse to dispatch) — the same
+# registry-diff discipline ROADMAP item 4 asks for before any config add
+# ---------------------------------------------------------------------
+
+def test_surface_swaps_flat_for_hier_past_threshold(monkeypatch):
+    from kubebatch_tpu import compilesvc
+    from kubebatch_tpu.actions import allocate as alloc_mod
+
+    before = compilesvc.enumerate_signatures(2, steady=False)
+    monkeypatch.setattr(alloc_mod, "AUTO_HIER_MIN_NODES", 32)
+    after = compilesvc.enumerate_signatures(2, steady=False)
+    gone, added = compilesvc.diff_signatures(before, after)
+    assert {s.entry for s in gone} == {"_batched_packed"}
+    assert {s.entry for s in added} == {"_hier_packed"}
+    assert all(s.engine == "hier" for s in added)
+
+
+@pytest.mark.slow
+def test_cfg6_cold_surface_matches_fixture():
+    """The committed expected-signature delta for cfg6 (the satellite's
+    drift alarm): the live cold enumeration must match
+    tests/data/compile_surface_cfg6_cold.txt key for key — a config
+    or bucket-policy change that moves the registry surface fails here
+    loudly instead of as a silent mid-run recompile."""
+    from kubebatch_tpu import compilesvc
+
+    path = os.path.join(os.path.dirname(__file__), "data",
+                        "compile_surface_cfg6_cold.txt")
+    with open(path) as f:
+        expected = [ln.strip() for ln in f if ln.strip()
+                    and not ln.startswith("#")]
+    sigs = compilesvc.enumerate_signatures(6, steady=False)
+    assert [s.key for s in sigs] == expected

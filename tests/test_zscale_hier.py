@@ -1,0 +1,259 @@
+"""The two-level (hier) solve vs the flat round solver, and on the mesh.
+
+Coverage per the ISSUE 10 satellite: bucket selection + within-bucket
+waterfall decisions equal to the flat solve on a downsampled config, on
+both the 1-D ``("nodes",)`` and the 2-D ``("hosts", "nodes")`` meshes;
+plus the fail/gang semantics and the action-layer engine selection.
+
+(Sorts last on purpose — see test_zscale.py.)
+"""
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.actions.cycle_inputs import build_cycle_inputs
+from kubebatch_tpu.api import TaskStatus
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.kernels.batched import solve_batched
+from kubebatch_tpu.kernels.batched_sharded import node_mesh
+from kubebatch_tpu.kernels.hier import (hier_pool_size, solve_hier,
+                                        solve_hier_sharded)
+from kubebatch_tpu.objects import PodPhase
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+_PLACED = (1, 2, 3)   # ALLOC / ALLOC_OB / PIPELINE
+
+
+class _B:
+    def bind(self, pod, hostname):
+        pod.node_name = hostname
+
+
+def _build(cache, n_nodes=24, n_groups=12, pods_per_group=4, n_queues=2,
+           seed=0, uniform_cpu=0):
+    rng = np.random.default_rng(seed)
+    for q in range(n_queues):
+        cache.add_queue(build_queue(f"q{q}", weight=q + 1))
+    for i in range(n_nodes):
+        cpu = uniform_cpu or int(rng.integers(2, 8)) * 1000
+        cache.add_node(build_node(f"n{i:03d}", rl(cpu, 8 * GiB, pods=20)))
+    for g in range(n_groups):
+        name = f"g{g:03d}"
+        cache.add_pod_group(build_group(
+            "ns", name, max(1, pods_per_group - 1), queue=f"q{g % n_queues}",
+            creation_timestamp=float(g)))
+        for p in range(pods_per_group):
+            cache.add_pod(build_pod(
+                "ns", f"{name}-{p}", "", PodPhase.PENDING,
+                rl(int(rng.integers(1, 4)) * 500, 2 * GiB), group=name,
+                priority=int(rng.integers(1, 5)),
+                creation_timestamp=float(g * 100 + p)))
+
+
+def _open(**kw):
+    cache = SchedulerCache(binder=_B(), async_writeback=False)
+    _build(cache, **kw)
+    return OpenSession(cache, shipped_tiers())
+
+
+def _flat(**kw):
+    ssn = _open(**kw)
+    inputs = build_cycle_inputs(ssn)
+    out = solve_batched(inputs.device, inputs, compact_bucket=0)
+    return ssn, out
+
+
+def _hier(pool_size=8, mesh=None, **kw):
+    ssn = _open(**kw)
+    inputs = build_cycle_inputs(ssn)
+    if mesh is None:
+        out = solve_hier(inputs.device, inputs, pool_size=pool_size)
+    else:
+        out = solve_hier_sharded(mesh, inputs.device, inputs,
+                                 pool_size=pool_size)
+    return ssn, out
+
+
+def test_hier_pool_size_divides():
+    # incl. mesh-rounded non-grain buckets (6/12-device shard rounding)
+    for n in (32, 64, 8192, 53248, 102400, 53250, 8196):
+        assert n % hier_pool_size(n) == 0
+
+
+def test_hier_equals_flat_downsampled_regime():
+    """The downsampled equality pin (the cfg6/cfg7 done-bar shape:
+    uniform nodes, demand inside the winning bucket — the sim specs for
+    cfg6/cfg7 are uniform-node, jitter-free for exactly this check):
+    the two-level decomposition must not move a single placement —
+    decisions (states, nodes) bit-identical to the flat solve."""
+    kw = dict(n_nodes=24, n_groups=6, pods_per_group=2, seed=4,
+              uniform_cpu=8000)
+    ssn_a, (st_a, nd_a, sq_a, _) = _flat(**kw)
+    ssn_b, (st_b, nd_b, sq_b, _) = _hier(pool_size=8, **kw)
+    np.testing.assert_array_equal(st_a, st_b)
+    np.testing.assert_array_equal(nd_a, nd_b)
+    placed = np.isin(st_a, _PLACED)
+    assert placed.sum() == 12
+    CloseSession(ssn_a)
+    CloseSession(ssn_b)
+
+
+@pytest.mark.parametrize("seed,uniform_cpu", [(0, 4000), (0, 0), (7, 0)],
+                         ids=["uniform", "hetero-s0", "hetero-s7"])
+def test_hier_matches_flat_decisions_contended(seed, uniform_cpu):
+    """Contended multi-pool regime (demand spills across buckets over
+    several waves): the DECISION arrays (which task placed / failed /
+    deferred) stay identical to the flat solve; the task->node map is
+    wave-granular by design — the same ordering contract batched.py
+    documents vs the sequential oracle, one level up (kernels/hier.py
+    faithfulness note) — so nodes are checked for feasibility via the
+    identical placed set, not bit equality."""
+    kw = dict(n_nodes=24, n_groups=12, pods_per_group=4, seed=seed,
+              uniform_cpu=uniform_cpu)
+    ssn_a, (st_a, nd_a, _, _) = _flat(**kw)
+    ssn_b, (st_b, nd_b, _, _) = _hier(pool_size=8, **kw)
+    np.testing.assert_array_equal(st_a, st_b)
+    placed = np.isin(st_a, _PLACED)
+    assert placed.sum() > 0
+    assert (nd_b[placed] >= 0).all()
+    CloseSession(ssn_a)
+    CloseSession(ssn_b)
+
+
+def test_hier_fail_semantics_match_flat():
+    """A task no node can ever hold must FAIL (and gang-kill its job)
+    in the same way on both engines — the elig_elsewhere hook defers
+    block-local ineligibility, never cluster-wide ineligibility."""
+    def build(cache):
+        cache.add_queue(build_queue("q0"))
+        for i in range(16):
+            cache.add_node(build_node(f"n{i:03d}", rl(4000, 8 * GiB,
+                                                      pods=20)))
+        cache.add_pod_group(build_group("ns", "ok", 2, queue="q0",
+                                        creation_timestamp=0.0))
+        for p in range(2):
+            cache.add_pod(build_pod("ns", f"ok-{p}", "", PodPhase.PENDING,
+                                    rl(1000, GiB), group="ok",
+                                    creation_timestamp=float(p)))
+        # min_member=1 with one impossible + one possible task: the
+        # impossible one FAILs and kills later-ranked siblings
+        cache.add_pod_group(build_group("ns", "doomed", 1, queue="q0",
+                                        creation_timestamp=1.0))
+        cache.add_pod(build_pod("ns", "doomed-0", "", PodPhase.PENDING,
+                                rl(64000, GiB), group="doomed",
+                                creation_timestamp=100.0))
+        cache.add_pod(build_pod("ns", "doomed-1", "", PodPhase.PENDING,
+                                rl(1000, GiB), group="doomed",
+                                creation_timestamp=101.0))
+
+    results = {}
+    for mode in ("batched", "hier"):
+        cache = SchedulerCache(binder=_B(), async_writeback=False)
+        build(cache)
+        ssn = OpenSession(cache, shipped_tiers())
+        AllocateAction(mode=mode).execute(ssn)
+        results[mode] = {t.key: t.status for job in ssn.jobs.values()
+                         for t in job.tasks.values()}
+        CloseSession(ssn)
+    assert results["hier"] == results["batched"]
+
+
+def test_hier_all_ineligible_cycle_fails_like_flat():
+    """A cycle whose EVERY pending task is oversized: the wave loop
+    finds no candidate pool and runs zero waves — the terminal FAIL
+    sweep must still fail the tasks and kill the jobs exactly like the
+    flat engine's first round."""
+    def build(cache):
+        cache.add_queue(build_queue("q0"))
+        for i in range(16):
+            cache.add_node(build_node(f"n{i:03d}", rl(4000, 8 * GiB,
+                                                      pods=20)))
+        for g in range(3):
+            name = f"huge{g}"
+            cache.add_pod_group(build_group("ns", name, 1, queue="q0",
+                                            creation_timestamp=float(g)))
+            cache.add_pod(build_pod(
+                "ns", f"{name}-0", "", PodPhase.PENDING,
+                rl(64000, GiB), group=name, creation_timestamp=float(g)))
+
+    results = {}
+    for mode in ("batched", "hier"):
+        cache = SchedulerCache(binder=_B(), async_writeback=False)
+        build(cache)
+        ssn = OpenSession(cache, shipped_tiers())
+        AllocateAction(mode=mode).execute(ssn)
+        results[mode] = {t.key: t.status for job in ssn.jobs.values()
+                         for t in job.tasks.values()}
+        CloseSession(ssn)
+    assert results["hier"] == results["batched"]
+    assert len(results["hier"]) == 3
+
+
+def test_hier_mesh_1d_and_2d_match_single_chip():
+    """The satellite's mesh pin: the wave loop under GSPMD — node axis
+    split over ``("nodes",)`` and hierarchically over
+    ``("hosts", "nodes")`` — is bit-identical to single-chip hier."""
+    kw = dict(n_nodes=24, n_groups=12, pods_per_group=4, seed=3)
+    ssn_a, (st_a, nd_a, sq_a, _) = _hier(pool_size=8, **kw)
+    ssn_b, (st_b, nd_b, sq_b, _) = _hier(pool_size=8, mesh=node_mesh(),
+                                         **kw)
+    mesh2 = node_mesh(n_hosts=2)
+    ssn_c, (st_c, nd_c, sq_c, _) = _hier(pool_size=8, mesh=mesh2, **kw)
+    for st, nd, sq in ((st_b, nd_b, sq_b), (st_c, nd_c, sq_c)):
+        np.testing.assert_array_equal(st_a, st)
+        np.testing.assert_array_equal(nd_a, nd)
+        np.testing.assert_array_equal(sq_a, sq)
+    for s in (ssn_a, ssn_b, ssn_c):
+        CloseSession(s)
+
+
+def test_auto_mode_selects_hier_past_threshold(monkeypatch):
+    from kubebatch_tpu.actions import allocate as alloc_mod
+
+    ssn = _open(n_nodes=24, n_groups=12, pods_per_group=4)
+    try:
+        # 48 pending < AUTO_BATCHED_MIN -> fused regardless of nodes
+        assert AllocateAction._auto_mode(ssn) == "fused"
+        monkeypatch.setattr(alloc_mod, "AUTO_BATCHED_MIN", 8)
+        monkeypatch.setattr(alloc_mod, "AUTO_HIER_MIN_NODES", 16)
+        assert AllocateAction._auto_mode(ssn) == "hier"
+    finally:
+        CloseSession(ssn)
+
+
+def test_ladder_demoted_hier_skips_flat_batched(monkeypatch):
+    """A demoted hier cycle must land on the fused tier, not the flat
+    batched engine whose [T, N] graph is the thing the two-level split
+    exists to avoid at cluster scale."""
+    from kubebatch_tpu import faults
+    from kubebatch_tpu.actions import allocate as alloc_mod
+
+    monkeypatch.setattr(alloc_mod, "AUTO_HIER_MIN_NODES", 16)
+    monkeypatch.setattr(faults.LADDER, "level", 1)   # cap = "batched"
+    ssn = _open(n_nodes=24, n_groups=6, pods_per_group=2, seed=4,
+                uniform_cpu=8000)
+    try:
+        AllocateAction(mode="hier").execute(ssn)
+        assert alloc_mod.last_cycle_engine == "fused"
+    finally:
+        CloseSession(ssn)
+
+
+def test_hier_engine_end_to_end_and_recorded():
+    from kubebatch_tpu.actions import allocate as alloc_mod
+
+    results = {}
+    for mode in ("batched", "hier"):
+        ssn = _open(n_nodes=24, n_groups=12, pods_per_group=4, seed=3)
+        AllocateAction(mode=mode).execute(ssn)
+        results[mode] = {t.key: t.status for job in ssn.jobs.values()
+                         for t in job.tasks.values()}
+        assert alloc_mod.last_cycle_engine == mode
+        CloseSession(ssn)
+    assert results["hier"] == results["batched"]
+    assert sum(1 for s in results["hier"].values()
+               if s in (TaskStatus.ALLOCATED, TaskStatus.BINDING)) > 0

@@ -26,6 +26,22 @@ $B 1200 python tools/precompile.py --config 3p
 $B 1200 python tools/precompile.py --config 4
 
 $B 1800 python bench.py --config 5                      # cold + steady extra
+# the scale axis (ISSUE 10): cfg6 = 50k nodes / 50k pods through the
+# two-level solve — cold line (carries the downsampled oracle check +
+# memory_peak_mb) and a steady churn line; cfg7 (100k nodes) only when
+# the operator opts in (KB_SWEEP_CFG7=1) — it needs ~4x cfg6's window.
+# Steady churn is 1024 ON PURPOSE: 256 pending sits under the batched
+# threshold and would measure the fused engine, not the two-level one
+$B 2400 python tools/precompile.py --config 6
+$B 3600 python bench.py --config 6
+$B 3600 python bench.py --config 6 --steady 1024 --cycles 9
+# buffer-assignment memory A/Bs (tools/narrow_ab.py): on the TPU
+# backend the bf16 line is the real narrowed-dtype number (the cpu
+# fallback emulates bf16 — BENCH_NOTES round 13); the flat-vs-hier
+# line is the [T,N]-never-materializes claim, dtype-free
+$B 2400 python tools/narrow_ab.py --config 5
+$B 3600 python tools/narrow_ab.py --config 6 --flat-vs-hier
+[ -n "$KB_SWEEP_CFG7" ] && $B 6000 python bench.py --config 7
 $B 1800 python bench.py --config 5p                     # predicate-rich stress
 $B 1200 python bench.py --config 3p                     # MXU-claim mid-scale
 $B 1200 python bench.py --config 2p

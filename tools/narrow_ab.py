@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Same-box memory A/Bs at a config's cold shape (ISSUE 10), measured
+by XLA's own buffer assignment (``compiled.memory_analysis()``) — the
+compiler that allocates the [T, N] intermediates is the instrument, so
+the numbers are free of the host-RSS noise (compile arena, Python
+heap) that drowns a wall-clock A/B.
+
+Two modes:
+
+- default: the narrow-DTYPE A/B — the same entry lowered twice,
+  narrow=False (f32 scores) vs narrow=True (bf16 scores, bool masks).
+  CAVEAT, stamped on the line as ``bf16_emulated_backend``: XLA:CPU
+  EMULATES bf16 arithmetic by inserting f32 upcasts, so on a
+  cpu-fallback box the narrowed arena measures LARGER (both copies
+  live) — the honest bf16 number needs the TPU backend, where the
+  sweep runs this tool (device_sweep.sh).
+- ``--flat-vs-hier`` (cfg6/cfg7): the TWO-LEVEL memory claim, dtype-
+  emulation-free — the flat ``_batched_packed`` [T, N] graph vs the
+  ``_hier_packed`` [T, pool] wave graph at the SAME inputs, both
+  narrow=False, arenas from buffer assignment. This is the "no shard
+  ever materializes a full [T, N] block" number.
+
+Output contract: the LAST stdout line is one JSON object; process-level
+runs append it to BENCH_DEVICE.jsonl like every bench line.
+
+    python tools/narrow_ab.py --config 5
+    python tools/narrow_ab.py --config 6 --flat-vs-hier
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", default="5",
+                    choices=["2", "3", "4", "5", "6", "7"])
+    ap.add_argument("--flat-vs-hier", action="store_true",
+                    help="compare the flat [T, N] graph vs the two-level"
+                         " wave graph at the same inputs (cfg6/cfg7),"
+                         " both f32 — the dtype-emulation-free memory"
+                         " claim")
+    args = ap.parse_args(argv)
+    config = int(args.config)
+
+    import bench
+    if argv is None:
+        bench.RECORD_ARGV = sys.argv[1:]
+
+    import jax
+
+    from kubebatch_tpu.compilesvc.profile import build_materials
+    from kubebatch_tpu.kernels.batched import (_batched_packed,
+                                               prepare_batched)
+    from kubebatch_tpu.kernels.hier import _hier_packed, prepare_hier
+
+    materials = build_materials(config, steady=False)
+    inputs = materials.cold_inputs
+    assert inputs is not None and not isinstance(inputs, str)
+
+    def arena(entry, kargs, statics):
+        ma = entry.lower(*kargs, **statics).compile().memory_analysis()
+        return {
+            "temp_mb": round(ma.temp_size_in_bytes / 2.0 ** 20, 1),
+            "argument_mb": round(ma.argument_size_in_bytes / 2.0 ** 20, 1),
+            "output_mb": round(ma.output_size_in_bytes / 2.0 ** 20, 1),
+        }
+
+    t_pad = int(inputs.task_valid.shape[0])
+    n_pad = int(inputs.device.n_padded)
+    backend_cpu = jax.local_devices()[0].platform == "cpu"
+
+    if args.flat_vs_hier:
+        # the two-level claim: nothing materializes at [T, N] — both
+        # graphs f32 so bf16 CPU emulation can't confound the arenas
+        hargs, hstat = prepare_hier(inputs.device, inputs)
+        fargs, fstat = prepare_batched(inputs.device, inputs)
+        hier_a = arena(_hier_packed, hargs, dict(hstat, narrow=False))
+        flat_a = arena(_batched_packed, fargs, dict(fstat, narrow=False))
+        out = {
+            "metric": f"hier_ab_temp_mb_cfg{config}",
+            "value": hier_a["temp_mb"],
+            "unit": "MB",
+            # >1.0 = the wave graph's transient arena is smaller than
+            # the flat [T, N] graph's at identical inputs
+            "vs_baseline": round(flat_a["temp_mb"]
+                                 / max(hier_a["temp_mb"], 0.1), 4),
+            "flat": flat_a,
+            "hier": hier_a,
+            "pool_size": hstat["pool_size"],
+            "t_pad": t_pad, "n_pad": n_pad,
+            "source": "xla_buffer_assignment",
+        }
+    else:
+        if config >= 6:
+            entry, (kargs, statics) = _hier_packed, prepare_hier(
+                inputs.device, inputs)
+        else:
+            entry, (kargs, statics) = _batched_packed, prepare_batched(
+                inputs.device, inputs)
+        sizes = {}
+        for narrow in (False, True):
+            sizes["narrow" if narrow else "f32"] = arena(
+                entry, kargs, dict(statics, narrow=narrow))
+        f32_t = sizes["f32"]["temp_mb"]
+        nar_t = sizes["narrow"]["temp_mb"]
+        out = {
+            "metric": f"narrow_ab_temp_mb_cfg{config}",
+            "value": nar_t,
+            "unit": "MB",
+            # >1.0 = the narrowed graph's transient arena is smaller;
+            # on a bf16-emulating backend (CPU) expect < 1.0 — see the
+            # module docstring and the flag below
+            "vs_baseline": round(f32_t / nar_t, 4) if nar_t else 0.0,
+            "f32": sizes["f32"],
+            "narrow": sizes["narrow"],
+            "bf16_emulated_backend": backend_cpu,
+            "t_pad": t_pad, "n_pad": n_pad,
+            "entry": ("_hier_packed" if config >= 6
+                      else "_batched_packed"),
+            "source": "xla_buffer_assignment",
+        }
+    bench.emit(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,7 +29,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--config", default="5",
-                    choices=["1", "2", "3", "4", "5", "2p", "3p", "5p"])
+                    choices=["1", "2", "3", "4", "5", "6", "7",
+                             "2p", "3p", "5p"])
     ap.add_argument("--list", action="store_true",
                     help="print the registered signature keys (no "
                          "compilation)")
