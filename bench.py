@@ -296,6 +296,7 @@ def run_config(config: int, cycles: int, mode: str):
     from kubebatch_tpu.actions import allocate as _alloc_mod
     from kubebatch_tpu.metrics import (blocking_readbacks, compile_ms_total,
                                        host_phase_seconds,
+                                       readback_accounting,
                                        solver_kernel_seconds)
 
     latencies = []
@@ -319,6 +320,7 @@ def run_config(config: int, cycles: int, mode: str):
     # cluster graph mid-cycle otherwise), explicit collection between
     # cycles, off the latency path
     gc.disable()
+    acct0 = readback_accounting()
     try:
         for cycle in range(cycles):
             sim = baseline_cluster(config)
@@ -375,6 +377,10 @@ def run_config(config: int, cycles: int, mode: str):
                     # compile is a counted recompile on the line
                     from kubebatch_tpu import compilesvc
                     compilesvc.mark_warm()
+                if cycles > 1:
+                    # measured-window accounting excludes the cold cycle
+                    # (it pays compile, not representative transfers)
+                    acct0 = readback_accounting()
             if cycle > 0 or cycles == 1:   # first cycle pays jit compile
                 latencies.append(dt)
                 bound_total += len(binds)
@@ -391,6 +397,7 @@ def run_config(config: int, cycles: int, mode: str):
                     phase_s.setdefault(k, []).append(hp[k] - hp0.get(k, 0.0))
     finally:
         gc.enable()
+    acct = readback_accounting(since=acct0)
     action_ms = {name: round(1e3 * s / max(1, measured_cycles), 3)
                  for name, s in action_seconds.items()}
     # the cold-cycle host split (VERDICT r5 directive 1): per-phase MEDIAN
@@ -399,7 +406,7 @@ def run_config(config: int, cycles: int, mode: str):
     phase_ms = {k: round(1e3 * float(np.median(v)), 3)
                 for k, v in sorted(phase_s.items())}
     return (latencies, bound_total, bind_seconds, evicted_total, action_ms,
-            engines, readbacks, kernel_s, phase_ms, cold_split)
+            engines, readbacks, kernel_s, phase_ms, cold_split, acct)
 
 
 def run_steady(config, cycles: int, mode: str, churn_pods: int,
@@ -500,6 +507,7 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
         from kubebatch_tpu.actions import allocate as _alloc_mod
         from kubebatch_tpu.metrics import (blocking_readbacks,
                                            host_phase_seconds,
+                                           readback_accounting,
                                            recompiles_total)
 
         # the warm-up / churn cycles above traced every steady shape:
@@ -508,6 +516,11 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
         # a compile wall mid-steady-cycle must never pass silently)
         compilesvc.mark_warm()
         recompiles0 = recompiles_total()
+        # readbacks-per-decision window (metrics.readback_accounting):
+        # the telemetry frames count every bound task as a decision, so
+        # the measured window's transfer cost is reported per unit of
+        # scheduling work, not just per cycle
+        acct0 = readback_accounting()
         latencies = []
         bound = 0
         action_seconds = {name: 0.0 for name in CONFIG_ACTIONS[config]}
@@ -556,6 +569,7 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
             for k in hp:
                 phase_s.setdefault(k, []).append(hp[k] - hp0.get(k, 0.0))
         recompiles = recompiles_total() - recompiles0
+        acct = readback_accounting(since=acct0)
     finally:
         gc.enable()
     action_ms = {name: round(1e3 * secs / max(1, len(latencies)), 3)
@@ -571,7 +585,7 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
     # peak RSS in MiB (ru_maxrss is KiB on Linux) — the soak evidence
     rss_mb = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024.0
     return (latencies, bound, action_ms, readbacks, rss_mb, engines,
-            recompiles, span_counts, trace_roots, phase_ms)
+            recompiles, span_counts, trace_roots, phase_ms, acct)
 
 
 def run_arrival(config, cycles: int, churn_pods: int,
@@ -594,6 +608,7 @@ def run_arrival(config, cycles: int, churn_pods: int,
     from kubebatch_tpu.cache import SchedulerCache
     from kubebatch_tpu.metrics import (ARRIVAL_STATS,
                                        arrivals_observed_total,
+                                       readback_accounting,
                                        recompiles_total,
                                        subcycles_total)
     from kubebatch_tpu.objects import (GROUP_NAME_ANNOTATION, Container,
@@ -728,10 +743,12 @@ def run_arrival(config, cycles: int, churn_pods: int,
         from kubebatch_tpu import compilesvc
         compilesvc.mark_warm()
         recompiles0 = recompiles_total()
+        acct0 = readback_accounting()
         sub0 = subcycles_total()
         obs0 = arrivals_observed_total()
         for cycle in range(3, 3 + cycles):
             drive_cycle(cycle, measure=True)
+        acct = readback_accounting(since=acct0)
         recompiles = recompiles_total() - recompiles0
         subcycles = subcycles_total() - sub0
         # windowed read off the monotonic counter: ARRIVAL_STATS is a
@@ -772,6 +789,8 @@ def run_arrival(config, cycles: int, churn_pods: int,
             float(np.percentile(cycle_lat, 50)) * 1e3, 3),
         "recompiles_total": recompiles,
         "recompiles_by_reason": recompile_split,
+        "readback_accounting": acct,
+        "readbacks_per_decision": acct["readbacks_per_decision"],
     }
 
 
@@ -927,6 +946,7 @@ def main(argv=None):
         from kubebatch_tpu.metrics import (compile_ms_total,
                                            mega_dispatches_total,
                                            mega_lanes_total,
+                                           readback_accounting,
                                            recompiles_total)
         from kubebatch_tpu.sim.tenants import (run_multi_tenant,
                                                run_saturation)
@@ -942,10 +962,12 @@ def main(argv=None):
         rpc_addr = f"127.0.0.1:{_port}"
         compilesvc.warmup("t")
         r0 = recompiles_total()
+        acct0 = readback_accounting()
         parity = run_multi_tenant(n_tenants=args.tenants,
                                   address=rpc_addr)
         sat = run_saturation(n_tenants=args.tenants, address=rpc_addr,
                              duration_s=args.tenant_seconds)
+        acct = readback_accounting(since=acct0)
         out = {
             "metric": "tenant_saturation_solves_per_sec",
             "value": sat.capacity_solves_per_sec,
@@ -970,6 +992,8 @@ def main(argv=None):
             "shed_modes_seen": sat.shed_modes_seen,
             "recompiles_total": recompiles_total() - r0,
             "compile_ms_total": round(compile_ms_total(), 1),
+            "readback_accounting": acct,
+            "readbacks_per_decision": acct["readbacks_per_decision"],
             "backend": backend,
         }
         if parity.mismatched or parity.rpc_errors:
@@ -1032,7 +1056,8 @@ def main(argv=None):
     if args.steady > 0:
         # >=9 measured cycles so the reported p95 means something
         (latencies, bound, action_ms, readbacks, rss_mb, engines,
-         recompiles, span_counts, trace_roots, phase_ms) = run_steady(
+         recompiles, span_counts, trace_roots, phase_ms,
+         acct) = run_steady(
             args.config, max(args.cycles, 9), args.mode, args.steady,
             skew=args.steady_skew)
         p50_ms = float(np.percentile(latencies, 50) * 1e3)
@@ -1054,6 +1079,11 @@ def main(argv=None):
             "mode": args.mode,
             "readbacks_per_cycle": round(float(np.mean(readbacks)), 1)
             if readbacks else 0.0,
+            # readbacks per unit of scheduling work over the measured
+            # window (metrics.readback_accounting; decisions come from
+            # the device telemetry frames' bound counts)
+            "readback_accounting": acct,
+            "readbacks_per_decision": acct["readbacks_per_decision"],
             "engines": sorted(set(engines)),
             # the steady host split off the update_host_phase keys
             # (ISSUE 9): host_share_ms keeps its historical definition
@@ -1123,7 +1153,7 @@ def main(argv=None):
         return 0
 
     (latencies, bound, seconds, evicted, action_ms, engines,
-     readbacks, kernel_s, phase_ms, cold_split) = run_config(
+     readbacks, kernel_s, phase_ms, cold_split, acct) = run_config(
         args.config, args.cycles, args.mode)
     p50_ms = float(np.percentile(latencies, 50) * 1e3)
     p95_ms = float(np.percentile(latencies, 95) * 1e3)
@@ -1147,6 +1177,8 @@ def main(argv=None):
         "readbacks_per_cycle": round(float(np.mean(readbacks)), 1)
         if readbacks else 0.0,
         "readbacks_max": max(readbacks) if readbacks else 0,
+        "readback_accounting": acct,
+        "readbacks_per_decision": acct["readbacks_per_decision"],
         # solver dispatch wall (incl. the blocking-read RTTs): the cold
         # split is kernel ~= this - readbacks x link RTT
         "solver_dispatch_ms_per_cycle": round(
@@ -1218,9 +1250,11 @@ def main(argv=None):
         try:
             churn = 256
             (s_lat, s_bound, s_act, s_rb, _, s_eng, s_rc, s_spans,
-             _s_roots, s_phase) = run_steady(args.config, 9, args.mode,
-                                             churn)
+             _s_roots, s_phase, s_acct) = run_steady(args.config, 9,
+                                                     args.mode, churn)
             out["steady_recompiles"] = s_rc
+            out["steady_readbacks_per_decision"] = \
+                s_acct["readbacks_per_decision"]
             out["steady_host_phase_ms"] = s_phase
             out["steady_p50_ms"] = round(
                 float(np.percentile(s_lat, 50) * 1e3), 3)

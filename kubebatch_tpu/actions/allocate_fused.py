@@ -42,11 +42,12 @@ _BOOL = ("task_valid", "job_valid", "sig_pred")
 @partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",
                                    "queue_keys", "gang_enabled",
                                    "prop_overused", "dyn_enabled",
-                                   "max_iters", "narrow"))
+                                   "max_iters", "narrow", "narrow_gate"))
 def _fused_packed(buf_f, buf_i, buf_b, idle, releasing, backfilled,
                   allocatable_cm, nz_req0, max_task_num, n_tasks, node_ok,
                   lay_f, lay_i, lay_b, job_keys, queue_keys, gang_enabled,
-                  prop_overused, dyn_enabled, max_iters, narrow=False):
+                  prop_overused, dyn_enabled, max_iters, narrow=False,
+                  narrow_gate=False):
     f = unpack(buf_f, lay_f)
     i = unpack(buf_i, lay_i)
     b = unpack(buf_b, lay_b)
@@ -64,7 +65,7 @@ def _fused_packed(buf_f, buf_i, buf_b, idle, releasing, backfilled,
         f["j_alloc0"], f["cluster_total"], f["dyn_weights"],
         job_keys=job_keys, queue_keys=queue_keys, gang_enabled=gang_enabled,
         prop_overused=prop_overused, dyn_enabled=dyn_enabled,
-        max_iters=max_iters, narrow=narrow)
+        max_iters=max_iters, narrow=narrow, narrow_gate=narrow_gate)
 
 
 # accounted trace boundary (compilesvc): the small-cycle fused entry
@@ -87,19 +88,24 @@ def prepare_fused(inputs):
             device.idle, device.releasing, device.backfilled,
             device.allocatable_cm, device.nz_req,
             device.max_task_num, device.n_tasks, device.node_ok)
+    # shape-derived (the rpc wire's device lacks n_padded); AUTO narrow
+    # requires bf16-exact score scale (kernels/narrow.py)
+    narrow = narrow_enabled(
+        int(device.node_ok.shape[0]), t_pad,
+        static_scores=inputs.sig_scores,
+        dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
+                     else None))
     statics = dict(
         lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
         job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
         gang_enabled=inputs.gang_enabled,
         prop_overused=inputs.prop_overused,
         dyn_enabled=inputs.dyn_enabled, max_iters=max_iters,
-        # shape-derived (the rpc wire's device lacks n_padded); AUTO
-        # narrow requires bf16-exact score scale (kernels/narrow.py)
-        narrow=narrow_enabled(
-            int(device.node_ok.shape[0]), t_pad,
-            static_scores=inputs.sig_scores,
-            dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
-                         else None)))
+        narrow=narrow,
+        # telemetry: the exactness-gate hit — the shape thresholds alone
+        # wanted the narrow diet but the score/weight scale refused it
+        narrow_gate=(not narrow and narrow_enabled(
+            int(device.node_ok.shape[0]), t_pad)))
     return args, statics
 
 
@@ -120,13 +126,16 @@ def execute_fused(ssn: Session) -> bool:
     # the kernel span replaces the perf_counter pair AND the explicit
     # solver_trace annotation (cat="kernel" enters both derived views);
     # its extent matches the old accounting: dispatch through carry commit
-    with _span("fused_allocate", cat="kernel"):
+    with _span("fused_allocate", cat="kernel") as sp:
         (host_block, idle_f, rel_f, ntasks_f, nz_f) = _fused_packed(
             *args, **statics)
         count_blocking_readback()
         with _span("readback", cat="readback"):
             host_block = np.asarray(host_block)  # the cycle's ONE blocking read
-        task_state, task_node, task_seq, _ = unpack_host_block(host_block)
+        task_state, task_node, task_seq, _, telem = \
+            unpack_host_block(host_block)
+        from ..obs import telemetry as _obs_telemetry
+        _obs_telemetry.record(telem, span=sp)
         device.idle, device.releasing, device.n_tasks = \
             idle_f, rel_f, ntasks_f
         device.nz_req = nz_f

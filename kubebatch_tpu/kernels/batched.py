@@ -77,6 +77,7 @@ from .narrow import narrow_enabled, score_dtype
 from .pack import pack_inputs
 from .pack import unpack as _unpack
 from .solver import dynamic_node_score
+from .telemetry import ENGINE_BATCHED, decision_frame
 from .tensorize import VEC_EPS
 
 _IMAX = jnp.iinfo(jnp.int32).max
@@ -993,7 +994,11 @@ def batched_allocate(state: RoundState, a: CycleArrays,
     demand window intentionally defers whole jobs past round 0, so
     contended cycles routinely exceed the bucket and run full-width —
     the compaction is an optimization for the uncontended steady regime,
-    not the contended one."""
+    not the contended one.
+
+    Returns (final RoundState, rounds, epilogue retries, stranded gang
+    count) — the trailing two are int32 telemetry scalars the packed
+    entries fold into the device telemetry frame."""
     t_pad = a.task_valid.shape[0]
 
     def rounds_loop(st, arrays, start_round):
@@ -1023,7 +1028,8 @@ def batched_allocate(state: RoundState, a: CycleArrays,
         whole gangs, up to 3 passes. The final non-reviving rollback
         retires any alive-partial gang so the cycle emits none (killed
         gangs keep their pre-kill placements + FitError, exactly like
-        the oracle's drop-on-first-unassignable)."""
+        the oracle's drop-on-first-unassignable). Returns the retry-pass
+        count and the finally-stranded gang count as telemetry."""
 
         def epi_cond(carry):
             s, _, k = carry
@@ -1035,16 +1041,17 @@ def batched_allocate(state: RoundState, a: CycleArrays,
             s, rounds, _ = rounds_loop(s, a, rounds)
             return s, rounds, k + 1
 
-        st, rounds, _ = jax.lax.while_loop(epi_cond, epi_body,
-                                           (st, rounds, jnp.int32(0)))
-        st, _ = _rollback_stranded(st, a, revive=False)
-        return st, rounds
+        st, rounds, retries = jax.lax.while_loop(epi_cond, epi_body,
+                                                 (st, rounds,
+                                                  jnp.int32(0)))
+        st, stranded = _rollback_stranded(st, a, revive=False)
+        return st, rounds, retries, stranded.sum().astype(jnp.int32)
 
     if not gang_enabled:
         # without a gang quorum every placement dispatches — partial jobs
         # are legitimate (non-gang reference semantics), nothing strands
         def epilogue(st, rounds):  # noqa: F811 — identity on purpose
-            return st, rounds
+            return st, rounds, jnp.int32(0), jnp.int32(0)
     if compact_bucket <= 0 or compact_bucket >= t_pad:
         final, rounds, _ = loop(state, a, 0)
         return epilogue(final, rounds)
@@ -1136,12 +1143,14 @@ _PORT_BOOL = ("task_ports", "port_base")
                                    "queue_keys", "prop_overused",
                                    "dyn_enabled", "pipe_enabled",
                                    "max_rounds", "compact_bucket",
-                                   "gang_enabled", "narrow"))
+                                   "gang_enabled", "narrow",
+                                   "narrow_gate"))
 def _batched_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
                     backfilled, allocatable_cm, max_task_num, node_ok,
                     lay_f, lay_i, lay_b, job_keys, queue_keys,
                     prop_overused, dyn_enabled, pipe_enabled, max_rounds,
-                    compact_bucket, gang_enabled=True, narrow=False):
+                    compact_bucket, gang_enabled=True, narrow=False,
+                    narrow_gate=False):
     f = _unpack(buf_f, lay_f)
     i = _unpack(buf_i, lay_i)
     b = _unpack(buf_b, lay_b)
@@ -1159,11 +1168,15 @@ def _batched_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
         aff_grp_total=f.get("aff_grp_total0"),
         port_claim=(jnp.zeros_like(b["port_base"])
                     if "port_base" in b else None))
-    return _pack_result(*_run_batched(state, f, i, b, backfilled,
-                                      allocatable_cm, max_task_num, node_ok,
-                                      job_keys, queue_keys, prop_overused,
-                                      dyn_enabled, pipe_enabled, max_rounds,
-                                      compact_bucket, gang_enabled, narrow))
+    final, rounds, retries, stranded = _run_batched(
+        state, f, i, b, backfilled, allocatable_cm, max_task_num, node_ok,
+        job_keys, queue_keys, prop_overused, dyn_enabled, pipe_enabled,
+        max_rounds, compact_bucket, gang_enabled, narrow)
+    frame = decision_frame(
+        ENGINE_BATCHED, final.task_state, final.task_seq, b["task_valid"],
+        waves=rounds, stride=t_pad, narrow=narrow, narrow_gate=narrow_gate,
+        retries=retries, stranded=stranded)
+    return _pack_result(final, rounds, frame)
 
 
 # accounted trace boundary (compilesvc): the production whole-cycle entry
@@ -1171,13 +1184,14 @@ _batched_packed = _instrument("batched", "_batched_packed",
                               _batched_packed)
 
 
-def _pack_result(final: RoundState, rounds):
-    """Decisions + round count as ONE int32 buffer: every blocking
-    device->host read pays full tunnel latency (~70 ms on axon), so the
-    host reads back a single [3*T+1] array instead of four."""
+def _pack_result(final: RoundState, rounds, frame):
+    """Decisions + round count + telemetry frame as ONE int32 buffer:
+    every blocking device->host read pays full tunnel latency (~70 ms on
+    axon), so the host reads back a single [3*T+1+TELEM_WIDTH] array
+    instead of five."""
     return final, jnp.concatenate(
         [final.task_state, final.task_node, final.task_seq,
-         rounds.astype(jnp.int32)[None]])
+         rounds.astype(jnp.int32)[None], frame])
 
 
 def _run_batched(state, f, i, b, backfilled, allocatable_cm, max_task_num,
@@ -1262,6 +1276,16 @@ def prepare_batched(device, inputs, max_rounds: int = 0,
             device.idle, device.releasing, device.n_tasks, device.nz_req,
             device.backfilled, device.allocatable_cm, device.max_task_num,
             device.node_ok)
+    # shape-derived node bucket (``device`` may be the rpc wire's
+    # duck-typed DeviceSession, no n_padded property); AUTO narrow
+    # also requires the score scale to round-trip bf16 exactly
+    narrow = narrow_enabled(
+        int(device.node_ok.shape[0]), t_pad,
+        static_scores=inputs.sig_scores,
+        dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
+                     else None),
+        ip_weight=(aff.ip_weight
+                   if aff is not None and aff.ip_enabled else 0.0))
     statics = dict(
         lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
         job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
@@ -1271,16 +1295,11 @@ def prepare_batched(device, inputs, max_rounds: int = 0,
         max_rounds=min(max_rounds, 4096),
         compact_bucket=compact,
         gang_enabled=inputs.gang_enabled,
-        # shape-derived node bucket (``device`` may be the rpc wire's
-        # duck-typed DeviceSession, no n_padded property); AUTO narrow
-        # also requires the score scale to round-trip bf16 exactly
-        narrow=narrow_enabled(
-            int(device.node_ok.shape[0]), t_pad,
-            static_scores=inputs.sig_scores,
-            dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
-                         else None),
-            ip_weight=(aff.ip_weight
-                       if aff is not None and aff.ip_enabled else 0.0)))
+        narrow=narrow,
+        # telemetry: the exactness-gate hit — the shape thresholds alone
+        # wanted the narrow diet but the score/weight scale refused it
+        narrow_gate=(not narrow and narrow_enabled(
+            int(device.node_ok.shape[0]), t_pad)))
     return args, statics
 
 
@@ -1295,7 +1314,7 @@ def solve_batched(device, inputs, max_rounds: int = 0,
     t_pad = inputs.task_valid.shape[0]
     args, statics = prepare_batched(device, inputs, max_rounds,
                                     compact_bucket)
-    with _span("batched_allocate", cat="kernel"):
+    with _span("batched_allocate", cat="kernel") as sp:
         final, packed = _batched_packed(*args, **statics)
         # ONE blocking transfer for everything the host needs; it stays
         # inside the kernel span (which carries the jax TraceAnnotation)
@@ -1308,6 +1327,8 @@ def solve_batched(device, inputs, max_rounds: int = 0,
         task_node = out[t_pad:2 * t_pad]
         task_seq = out[2 * t_pad:3 * t_pad]
         rounds = out[3 * t_pad]
+        from ..obs import telemetry as _obs_telemetry
+        _obs_telemetry.record(out[3 * t_pad + 1:], span=sp)
 
         device.idle = final.idle
         device.releasing = final.releasing

@@ -44,6 +44,7 @@ from ..compilesvc import register_provider as _register_provider
 from .batched import RoundState, CycleArrays, _IMAX, batched_allocate
 from .fused import SKIP
 from .narrow import narrow_enabled
+from .telemetry import ENGINE_SHARDED, decision_frame
 
 AXIS = "nodes"
 HOST_AXIS = "hosts"
@@ -125,19 +126,24 @@ def _specs_for(mesh: Mesh, affinity: bool = False, ports: bool = False,
 
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys", "prop_overused",
                                    "dyn_enabled", "pipe_enabled",
-                                   "max_rounds", "narrow"))
+                                   "max_rounds", "narrow", "narrow_gate"))
 def _sharded_entry(state: RoundState, arrays: CycleArrays, job_keys,
                    queue_keys, prop_overused, dyn_enabled, pipe_enabled,
-                   max_rounds, narrow=False):
-    final, rounds = batched_allocate(
+                   max_rounds, narrow=False, narrow_gate=False):
+    final, rounds, retries, stranded = batched_allocate(
         state, arrays, job_keys=job_keys, queue_keys=queue_keys,
         prop_overused=prop_overused, dyn_enabled=dyn_enabled,
         pipe_enabled=pipe_enabled, max_rounds=max_rounds,
         compact_bucket=0,   # compaction gathers are counterproductive SPMD
         narrow=narrow)
+    frame = decision_frame(
+        ENGINE_SHARDED, final.task_state, final.task_seq,
+        arrays.task_valid, waves=rounds,
+        stride=arrays.task_valid.shape[0], narrow=narrow,
+        narrow_gate=narrow_gate, retries=retries, stranded=stranded)
     return final, jnp.concatenate(
         [final.task_state, final.task_node, final.task_seq,
-         rounds.astype(jnp.int32)[None]])
+         rounds.astype(jnp.int32)[None], frame])
 
 
 # accounted trace boundary (compilesvc): the GSPMD mesh entry
@@ -198,7 +204,7 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
     t_pad = inputs.task_valid.shape[0]
     placed_state, placed_arrays, statics = prepare_sharded(
         mesh, device, inputs, max_rounds)
-    with _span("batched_allocate_sharded", cat="kernel"):
+    with _span("batched_allocate_sharded", cat="kernel") as sp:
         final, packed = _sharded_entry(placed_state, placed_arrays,
                                        **statics)
         count_blocking_readback()
@@ -208,6 +214,8 @@ def solve_batched_sharded(mesh: Mesh, device, inputs,
         task_node = out[t_pad:2 * t_pad]
         task_seq = out[2 * t_pad:3 * t_pad]
         rounds = out[3 * t_pad]
+        from ..obs import telemetry as _obs_telemetry
+        _obs_telemetry.record(out[3 * t_pad + 1:], span=sp)
 
         # commit the carry back to the session's device state (trimmed to
         # the single-chip bucket) so later actions see the updated
@@ -303,21 +311,24 @@ def prepare_sharded(mesh: Mesh, device, inputs, max_rounds: int = 0):
     array_specs, state_specs = _specs_for(
         mesh, affinity=aff is not None, ports=has_ports,
         ip=aff is not None and aff.ip_enabled)
+    # PER-SHARD narrow policy: each device materializes
+    # [T, N/shards]; AUTO additionally requires bf16-exact scores
+    narrow = narrow_enabled(
+        max(1, n_sh // n_dev), t_pad,
+        static_scores=inputs.sig_scores,
+        dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
+                     else None),
+        ip_weight=(aff.ip_weight
+                   if aff is not None and aff.ip_enabled else 0.0))
     statics = dict(
         job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
         prop_overused=inputs.prop_overused,
         dyn_enabled=inputs.dyn_enabled,
         pipe_enabled=inputs.pipe_enabled,
         max_rounds=min(max_rounds, 4096),
-        # PER-SHARD narrow policy: each device materializes
-        # [T, N/shards]; AUTO additionally requires bf16-exact scores
-        narrow=narrow_enabled(
-            max(1, n_sh // n_dev), t_pad,
-            static_scores=inputs.sig_scores,
-            dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
-                         else None),
-            ip_weight=(aff.ip_weight
-                       if aff is not None and aff.ip_enabled else 0.0)))
+        narrow=narrow,
+        narrow_gate=(not narrow
+                     and narrow_enabled(max(1, n_sh // n_dev), t_pad)))
     return put(state, state_specs), put(arrays, array_specs), statics
 
 

@@ -43,6 +43,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from .telemetry import ENGINE_FUSED, TELEM_WIDTH, decision_frame
 from .tensorize import VEC_EPS
 
 SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
@@ -78,10 +79,13 @@ def _lex_argmin(keys, valid):
 
 def unpack_host_block(host_block):
     """Decode fused_allocate's packed host block into
-    (task_state, task_node, task_seq, iters). Counterpart of the encoding
-    at the bottom of fused_allocate — keep the two in sync."""
-    task_state, task_node, task_seq = host_block[:, :-1]
-    return task_state, task_node, task_seq, host_block[0, -1]
+    (task_state, task_node, task_seq, iters, telemetry[TELEM_WIDTH]).
+    Counterpart of the encoding at the bottom of fused_allocate — keep
+    the two in sync."""
+    core = host_block[:, :-TELEM_WIDTH]
+    task_state, task_node, task_seq = core[:, :-1]
+    return (task_state, task_node, task_seq, core[0, -1],
+            host_block[0, -TELEM_WIDTH:])
 
 
 class FusedState(NamedTuple):
@@ -104,7 +108,7 @@ class FusedState(NamedTuple):
 
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys", "gang_enabled",
                                    "prop_overused", "dyn_enabled",
-                                   "max_iters", "narrow"))
+                                   "max_iters", "narrow", "narrow_gate"))
 def fused_allocate(
         # nodes
         idle, releasing, backfilled, allocatable_cm, nz_req0, max_task_num,
@@ -132,7 +136,8 @@ def fused_allocate(
         prop_overused: bool = True,
         dyn_enabled: bool = False,
         max_iters: int = 0,
-        narrow: bool = False):
+        narrow: bool = False,
+        narrow_gate: bool = False):
     from .narrow import score_dtype
     from .solver import dynamic_node_score
     if dyn_weights is None:
@@ -294,12 +299,19 @@ def fused_allocate(
         current_job=jnp.int32(-1), seq=jnp.int32(0), it=jnp.int32(0))
     final = jax.lax.while_loop(cond, body, init)
     # everything the host must read back travels in ONE int32 block —
-    # row 0 task_state, row 1 task_node, row 2 task_seq, and the iteration
-    # count in the extra trailing column — so applying the cycle's
-    # decisions costs a single device->host transfer (the axon tunnel
-    # charges a full round trip per blocking read)
+    # row 0 task_state, row 1 task_node, row 2 task_seq, then the
+    # iteration count and the telemetry frame in trailing columns — so
+    # applying the cycle's decisions costs a single device->host
+    # transfer (the axon tunnel charges a full round trip per blocking
+    # read). Fused places one task per iteration (no wave structure);
+    # stride=max_iters maps every placement into wave slot 0.
+    frame = decision_frame(
+        ENGINE_FUSED, final.task_state, final.task_seq, task_valid,
+        waves=final.it, stride=max(int(max_iters), 1), narrow=narrow,
+        narrow_gate=narrow_gate)
     host_block = jnp.concatenate(
         [jnp.stack([final.task_state, final.task_node, final.task_seq]),
-         jnp.broadcast_to(final.it, (3, 1))], axis=1)
+         jnp.broadcast_to(final.it, (3, 1)),
+         jnp.broadcast_to(frame, (3, TELEM_WIDTH))], axis=1)
     return (host_block, final.idle, final.releasing, final.n_tasks,
             final.nz_req)

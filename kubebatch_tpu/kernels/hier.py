@@ -71,6 +71,7 @@ from .fused import (ALLOC, ALLOC_OB, K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
                     K_PROP_SHARE, PIPELINE, SKIP)
 from .narrow import narrow_enabled
 from .pack import pack_inputs
+from .telemetry import ENGINE_HIER, ENGINE_HIER_SHARDED, decision_frame
 from .pack import unpack as _unpack
 from .solver import dynamic_node_score
 from .tensorize import VEC_EPS
@@ -217,8 +218,11 @@ def hier_allocate(state: RoundState, a: CycleArrays,
                   gang_enabled: bool = True,
                   narrow: bool = True):
     """The whole two-level allocate cycle — waves of (coarse pool pass →
-    within-bucket round loop) in ONE device dispatch. Same return shape
-    as batched_allocate: (final RoundState, rounds)."""
+    within-bucket round loop) in ONE device dispatch. Returns
+    (final RoundState, rounds, epilogue retries, stranded gang count,
+    first-wave pool occupancy, first-wave winning-bucket fill) — the
+    trailing four are int32 telemetry scalars the packed entries fold
+    into the device telemetry frame."""
     t_pad = a.task_valid.shape[0]
     n_pad = a.node_ok.shape[0]
     pool = pool_size if pool_size > 0 else hier_pool_size(n_pad)
@@ -253,11 +257,11 @@ def hier_allocate(state: RoundState, a: CycleArrays,
 
     def waves_loop(state, rounds0):
         def cond(carry):
-            _, _, wave, _, has_work = carry
+            _, _, wave, _, has_work, _, _ = carry
             return has_work & (wave < max_waves)
 
         def body(carry):
-            st, rounds, wave, blocked, _ = carry
+            st, rounds, wave, blocked, _, occ0, fill0 = carry
             task_pool_elig, pool_best = _coarse_pass(st, a, pool,
                                                      pipe_enabled,
                                                      dyn_enabled)
@@ -269,6 +273,13 @@ def hier_allocate(state: RoundState, a: CycleArrays,
             key = jnp.where((cand_cnt > 0) & ~blocked, pool_best, -jnp.inf)
             has_work = jnp.any(key > -jnp.inf)
             winner = jnp.argmax(key)
+            # telemetry: the FIRST wave's coarse-pass shape — pools with
+            # any eligible pending work, and the winner's candidate fill
+            first = wave == 0
+            occ_n = jnp.where(first,
+                              (cand_cnt > 0).sum().astype(jnp.int32), occ0)
+            fill_n = jnp.where(first, cand_cnt[winner].astype(jnp.int32),
+                               fill0)
 
             def run_block(args):
                 st, rounds, blocked = args
@@ -293,11 +304,14 @@ def hier_allocate(state: RoundState, a: CycleArrays,
             st_out, rounds_out, blocked_out = jax.lax.cond(
                 has_work, run_block, lambda args: args,
                 (st, rounds, blocked))
-            return st_out, rounds_out, wave + 1, blocked_out, has_work
+            return (st_out, rounds_out, wave + 1, blocked_out, has_work,
+                    occ_n, fill_n)
 
         init = (state, rounds0, jnp.int32(0),
-                jnp.zeros(n_pools, bool), jnp.asarray(True))
-        st, rounds, _, _, _ = jax.lax.while_loop(cond, body, init)
+                jnp.zeros(n_pools, bool), jnp.asarray(True),
+                jnp.int32(0), jnp.int32(0))
+        st, rounds, _, _, _, occ, fill = jax.lax.while_loop(cond, body,
+                                                            init)
 
         # terminal FAIL sweep: with no pool left holding eligible
         # pending work, tasks eligible NOWHERE must still fail (and
@@ -315,10 +329,12 @@ def hier_allocate(state: RoundState, a: CycleArrays,
         bfinal, rounds, _ = block_rounds(
             _block_state(st, off0, pool), _block_arrays(a, off0, pool),
             rounds, elig_any)
-        return _merge_block(st, bfinal, off0, pool), rounds
+        return _merge_block(st, bfinal, off0, pool), rounds, occ, fill
 
-    final, rounds = waves_loop(state, jnp.int32(0))
+    final, rounds, pool_occ, bucket_fill = waves_loop(state, jnp.int32(0))
 
+    retries = jnp.int32(0)
+    stranded = jnp.int32(0)
     if gang_enabled:
         # stranded-gang epilogue at full task width, the flat engine's
         # exact structure (batched.batched_allocate): rollback + revive
@@ -331,25 +347,30 @@ def hier_allocate(state: RoundState, a: CycleArrays,
         def epi_body(carry):
             s, rounds, k = carry
             s, _ = _rollback_stranded(s, a, revive=True)
-            s, rounds = waves_loop(s, rounds)
+            # epilogue waves keep their own coarse-pass stats out of the
+            # frame — pool_occ/bucket_fill describe the cycle's opening
+            s, rounds, _, _ = waves_loop(s, rounds)
             return s, rounds, k + 1
 
-        final, rounds, _ = jax.lax.while_loop(
+        final, rounds, retries = jax.lax.while_loop(
             epi_cond, epi_body, (final, rounds, jnp.int32(0)))
-        final, _ = _rollback_stranded(final, a, revive=False)
-    return final, rounds
+        final, stranded_mask = _rollback_stranded(final, a, revive=False)
+        stranded = stranded_mask.sum().astype(jnp.int32)
+    return final, rounds, retries, stranded, pool_occ, bucket_fill
 
 
 @partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",
                                    "queue_keys", "prop_overused",
                                    "dyn_enabled", "pipe_enabled",
                                    "max_rounds", "pool_size", "max_waves",
-                                   "gang_enabled", "narrow"))
+                                   "gang_enabled", "narrow",
+                                   "narrow_gate"))
 def _hier_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
                  backfilled, allocatable_cm, max_task_num, node_ok,
                  lay_f, lay_i, lay_b, job_keys, queue_keys,
                  prop_overused, dyn_enabled, pipe_enabled, max_rounds,
-                 pool_size, max_waves=0, gang_enabled=True, narrow=True):
+                 pool_size, max_waves=0, gang_enabled=True, narrow=True,
+                 narrow_gate=False):
     f = _unpack(buf_f, lay_f)
     i = _unpack(buf_i, lay_i)
     b = _unpack(buf_b, lay_b)
@@ -375,12 +396,19 @@ def _hier_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks, nz_req,
         job_create_rank=i["job_create_rank"], job_valid=b["job_valid"],
         q_deserved=f["q_deserved"], q_create_rank=i["q_create_rank"],
         cluster_total=f["cluster_total"], dyn_weights=f["dyn_weights"])
-    return _pack_result(*hier_allocate(
-        state, arrays, job_keys=job_keys, queue_keys=queue_keys,
-        prop_overused=prop_overused, dyn_enabled=dyn_enabled,
-        pipe_enabled=pipe_enabled, max_rounds=max_rounds,
-        pool_size=pool_size, max_waves=max_waves,
-        gang_enabled=gang_enabled, narrow=narrow))
+    final, rounds, retries, stranded, pool_occ, bucket_fill = \
+        hier_allocate(
+            state, arrays, job_keys=job_keys, queue_keys=queue_keys,
+            prop_overused=prop_overused, dyn_enabled=dyn_enabled,
+            pipe_enabled=pipe_enabled, max_rounds=max_rounds,
+            pool_size=pool_size, max_waves=max_waves,
+            gang_enabled=gang_enabled, narrow=narrow)
+    frame = decision_frame(
+        ENGINE_HIER, final.task_state, final.task_seq, b["task_valid"],
+        waves=rounds, stride=t_pad, narrow=narrow, narrow_gate=narrow_gate,
+        retries=retries, stranded=stranded, pool_occ=pool_occ,
+        bucket_fill=bucket_fill)
+    return _pack_result(final, rounds, frame)
 
 
 # accounted trace boundary (compilesvc): the two-level whole-cycle entry
@@ -411,6 +439,13 @@ def prepare_hier(device, inputs, max_rounds: int = 0,
             device.idle, device.releasing, device.n_tasks, device.nz_req,
             device.backfilled, device.allocatable_cm, device.max_task_num,
             device.node_ok)
+    # narrow by the FULL [T, N] problem (the scale that forced the
+    # two-level split), not the block — cfg6/cfg7 blocks ride bf16
+    # when the score scale round-trips exactly
+    narrow = narrow_enabled(
+        n_pad, t_pad, static_scores=inputs.sig_scores,
+        dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
+                     else None))
     statics = dict(
         lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
         job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
@@ -420,13 +455,10 @@ def prepare_hier(device, inputs, max_rounds: int = 0,
         max_rounds=min(max_rounds, 4096),
         pool_size=pool,
         gang_enabled=inputs.gang_enabled,
-        # narrow by the FULL [T, N] problem (the scale that forced the
-        # two-level split), not the block — cfg6/cfg7 blocks ride bf16
-        # when the score scale round-trips exactly
-        narrow=narrow_enabled(
-            n_pad, t_pad, static_scores=inputs.sig_scores,
-            dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
-                         else None)))
+        narrow=narrow,
+        # telemetry: the exactness-gate hit — the shape thresholds alone
+        # wanted the narrow diet but the score/weight scale refused it
+        narrow_gate=(not narrow and narrow_enabled(n_pad, t_pad)))
     return args, statics
 
 
@@ -437,7 +469,7 @@ def solve_hier(device, inputs, max_rounds: int = 0, pool_size: int = 0):
     readback, device carry committed on return."""
     t_pad = inputs.task_valid.shape[0]
     args, statics = prepare_hier(device, inputs, max_rounds, pool_size)
-    with _span("hier_allocate", cat="kernel"):
+    with _span("hier_allocate", cat="kernel") as sp:
         final, packed = _hier_packed(*args, **statics)
         count_blocking_readback()
         with _span("readback", cat="readback"):
@@ -446,6 +478,8 @@ def solve_hier(device, inputs, max_rounds: int = 0, pool_size: int = 0):
         task_node = out[t_pad:2 * t_pad]
         task_seq = out[2 * t_pad:3 * t_pad]
         rounds = out[3 * t_pad]
+        from ..obs import telemetry as _obs_telemetry
+        _obs_telemetry.record(out[3 * t_pad + 1:], span=sp)
 
         device.idle = final.idle
         device.releasing = final.releasing
@@ -465,19 +499,27 @@ def solve_hier(device, inputs, max_rounds: int = 0, pool_size: int = 0):
 @partial(jax.jit, static_argnames=("job_keys", "queue_keys",
                                    "prop_overused", "dyn_enabled",
                                    "pipe_enabled", "max_rounds",
-                                   "pool_size", "gang_enabled", "narrow"))
+                                   "pool_size", "gang_enabled", "narrow",
+                                   "narrow_gate"))
 def _hier_sharded_entry(state: RoundState, arrays: CycleArrays, job_keys,
                         queue_keys, prop_overused, dyn_enabled,
                         pipe_enabled, max_rounds, pool_size,
-                        gang_enabled=True, narrow=True):
-    final, rounds = hier_allocate(
-        state, arrays, job_keys=job_keys, queue_keys=queue_keys,
-        prop_overused=prop_overused, dyn_enabled=dyn_enabled,
-        pipe_enabled=pipe_enabled, max_rounds=max_rounds,
-        pool_size=pool_size, gang_enabled=gang_enabled, narrow=narrow)
+                        gang_enabled=True, narrow=True, narrow_gate=False):
+    final, rounds, retries, stranded, pool_occ, bucket_fill = \
+        hier_allocate(
+            state, arrays, job_keys=job_keys, queue_keys=queue_keys,
+            prop_overused=prop_overused, dyn_enabled=dyn_enabled,
+            pipe_enabled=pipe_enabled, max_rounds=max_rounds,
+            pool_size=pool_size, gang_enabled=gang_enabled, narrow=narrow)
+    frame = decision_frame(
+        ENGINE_HIER_SHARDED, final.task_state, final.task_seq,
+        arrays.task_valid, waves=rounds,
+        stride=arrays.task_valid.shape[0], narrow=narrow,
+        narrow_gate=narrow_gate, retries=retries, stranded=stranded,
+        pool_occ=pool_occ, bucket_fill=bucket_fill)
     return final, jnp.concatenate(
         [final.task_state, final.task_node, final.task_seq,
-         rounds.astype(jnp.int32)[None]])
+         rounds.astype(jnp.int32)[None], frame])
 
 
 _hier_sharded_entry = _instrument("hier", "_hier_sharded_entry",
@@ -498,6 +540,10 @@ def solve_hier_sharded(mesh, device, inputs, max_rounds: int = 0,
         mesh, device, inputs, max_rounds)
     n_sh = placed_arrays.node_ok.shape[0]
     pool = pool_size if pool_size > 0 else hier_pool_size(n_sh)
+    narrow = narrow_enabled(
+        n_sh, t_pad, static_scores=inputs.sig_scores,
+        dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
+                     else None))
     statics = dict(
         job_keys=base["job_keys"], queue_keys=base["queue_keys"],
         prop_overused=base["prop_overused"],
@@ -505,11 +551,9 @@ def solve_hier_sharded(mesh, device, inputs, max_rounds: int = 0,
         pipe_enabled=base["pipe_enabled"],
         max_rounds=base["max_rounds"], pool_size=pool,
         gang_enabled=getattr(inputs, "gang_enabled", True),
-        narrow=narrow_enabled(
-            n_sh, t_pad, static_scores=inputs.sig_scores,
-            dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
-                         else None)))
-    with _span("hier_allocate_sharded", cat="kernel"):
+        narrow=narrow,
+        narrow_gate=(not narrow and narrow_enabled(n_sh, t_pad)))
+    with _span("hier_allocate_sharded", cat="kernel") as sp:
         final, packed = _hier_sharded_entry(placed_state, placed_arrays,
                                             **statics)
         count_blocking_readback()
@@ -519,6 +563,8 @@ def solve_hier_sharded(mesh, device, inputs, max_rounds: int = 0,
         task_node = out[t_pad:2 * t_pad]
         task_seq = out[2 * t_pad:3 * t_pad]
         rounds = out[3 * t_pad]
+        from ..obs import telemetry as _obs_telemetry
+        _obs_telemetry.record(out[3 * t_pad + 1:], span=sp)
         count_blocking_readback(4)
         with _span("readback_carry", cat="readback", n=4):
             device.idle = jnp.asarray(np.asarray(final.idle)[:n_pad])

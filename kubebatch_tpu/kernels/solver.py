@@ -40,6 +40,7 @@ from ..compilesvc import instrument as _instrument
 from ..compilesvc import register_provider as _register_provider
 from ..metrics import count_blocking_readback
 from ..obs import span as _span
+from .telemetry import ENGINE_VISIT, TELEM_WIDTH, decision_frame
 from .tensorize import VEC_EPS, NodeState, TaskBatch, pad_to_bucket
 
 SKIP, ALLOC, ALLOC_OB, PIPELINE, FAIL = 0, 1, 2, 3, 4
@@ -167,11 +168,16 @@ def _allocate_scan(idle, releasing, backfilled, allocatable_cm, nz_req,
                     pred_mask)
     final, (decisions, node_idx) = jax.lax.scan(step, init, tasks)
     became_ready = final.allocated >= min_available
-    # ONE packed int32 host result [2T+1]: decisions, node indices, and
-    # the readiness flag ship as a single blocking transfer (each
-    # device->host read pays the full tunnel RTT)
+    # ONE packed int32 host result [2T+1+TELEM_WIDTH]: decisions, node
+    # indices, the readiness flag, and the telemetry frame ship as a
+    # single blocking transfer (each device->host read pays the full
+    # tunnel RTT). A visit is one wave — every placement lands in wave
+    # slot 0.
+    frame = decision_frame(ENGINE_VISIT, decisions,
+                           jnp.zeros_like(decisions), task_valid,
+                           waves=1, stride=1)
     packed = jnp.concatenate([decisions, node_idx,
-                              became_ready.astype(jnp.int32)[None]])
+                              became_ready.astype(jnp.int32)[None], frame])
     return (packed, final.idle, final.releasing, final.n_tasks,
             final.nz_req)
 
@@ -381,7 +387,7 @@ class DeviceSession:
         dyn_weights = np.asarray(
             [dyn.least_requested, dyn.balanced_resource] if dyn_enabled
             else [0.0, 0.0], np.float32)
-        with _span("allocate_scan", cat="kernel"):
+        with _span("allocate_scan", cat="kernel") as sp:
             (packed, idle, releasing, n_tasks, nz_req) = _allocate_scan(
                 self.idle, self.releasing, self.backfilled,
                 self.allocatable_cm, self.nz_req, self.max_task_num,
@@ -398,6 +404,8 @@ class DeviceSession:
             decisions = host[:t_pad]
             node_idx = host[t_pad:2 * t_pad]
             became_ready = bool(host[2 * t_pad])
+            from ..obs import telemetry as _obs_telemetry
+            _obs_telemetry.record(host[2 * t_pad + 1:], span=sp)
             self.idle, self.releasing, self.n_tasks = \
                 idle, releasing, n_tasks
             self.nz_req = nz_req

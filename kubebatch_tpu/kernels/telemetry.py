@@ -1,0 +1,137 @@
+"""Device-resident solve telemetry — one fixed-width int32 frame per
+dispatch, riding the engine's EXISTING packed host result.
+
+Every device engine already ships its decisions to the host as one
+packed int32 block (the single blocking readback per cycle — each
+read pays the full axon-tunnel RTT). This module defines a small
+fixed-shape frame the engines append to that block, so wave counts,
+eligibility census, pool occupancy, narrow-gate hits and the gang
+epilogue's retry/stranded counters become visible on the host WITHOUT
+a second transfer. The frame width is a compile-time constant and the
+fields are int32 scalars computed from state the kernels already
+carry, so appending it changes neither the dispatch count nor the
+signature registration path (compilesvc providers derive keys through
+the live prepare_* code, which now simply returns a slightly longer
+output block).
+
+The host-side decode lives in obs/telemetry.py; keep FIELDS and the
+index constants below in sync with it (they import from here).
+
+Decision codes are duplicated from kernels/solver.py — importing them
+would create a cycle (solver -> obs -> telemetry decode -> solver).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TELEM_WIDTH", "WAVE_SLOTS", "FIELDS", "ENGINE_NAMES",
+           "ENGINE_VISIT", "ENGINE_BATCHED", "ENGINE_FUSED", "ENGINE_HIER",
+           "ENGINE_SHARDED", "ENGINE_HIER_SHARDED", "ENGINE_VICTIM_WAVE",
+           "ENGINE_VICTIM_VISIT", "decision_frame", "host_frame"]
+
+#: frame width in int32 words — static per config, part of every
+#: engine's packed-output shape
+TELEM_WIDTH = 16
+
+#: per-wave bound-task histogram slots (wave index clips into the last)
+WAVE_SLOTS = 4
+
+# field indices ---------------------------------------------------------
+F_ENGINE = 0        # engine id (ENGINE_* below)
+F_WAVES = 1         # waves / rounds / iterations the solve ran
+F_BOUND = 2         # tasks bound (ALLOC | ALLOC_OB | PIPELINE)
+F_FAILED = 3        # tasks the solve marked FAIL
+F_PENDING = 4       # valid tasks left SKIP (not visited / job dropped)
+F_CENSUS = 5        # eligibility census: valid tasks presented
+F_WAVE_BOUND0 = 6   # .. F_WAVE_BOUND0+WAVE_SLOTS-1: bound per wave slot
+F_POOL_OCC = 10     # hier: pools with >=1 eligible candidate, wave 0
+F_BUCKET_FILL = 11  # hier: candidate count in the winning pool, wave 0
+F_NARROW = 12       # narrow dtype engaged for this dispatch (0/1)
+F_NARROW_GATE = 13  # shape wanted narrow but the exactness gate refused
+F_RETRIES = 14      # gang epilogue compaction retries taken
+F_STRANDED = 15     # gangs still stranded after the final rollback
+
+#: decode order — index i of the frame is FIELDS[i]
+FIELDS = ("engine", "waves", "bound", "failed", "pending", "census",
+          "wave_bound0", "wave_bound1", "wave_bound2", "wave_bound3",
+          "pool_occ", "bucket_fill", "narrow", "narrow_gate",
+          "retries", "stranded")
+
+# engine ids ------------------------------------------------------------
+ENGINE_VISIT = 1
+ENGINE_BATCHED = 2
+ENGINE_FUSED = 3
+ENGINE_HIER = 4
+ENGINE_SHARDED = 5
+ENGINE_HIER_SHARDED = 6
+ENGINE_VICTIM_WAVE = 7
+ENGINE_VICTIM_VISIT = 8
+
+ENGINE_NAMES = {
+    ENGINE_VISIT: "visit",
+    ENGINE_BATCHED: "batched",
+    ENGINE_FUSED: "fused",
+    ENGINE_HIER: "hier",
+    ENGINE_SHARDED: "sharded",
+    ENGINE_HIER_SHARDED: "hier_sharded",
+    ENGINE_VICTIM_WAVE: "victim_wave",
+    ENGINE_VICTIM_VISIT: "victim_visit",
+}
+
+# decision codes (solver.py/fused.py agree on these)
+_SKIP, _ALLOC, _ALLOC_OB, _PIPELINE, _FAIL = 0, 1, 2, 3, 4
+
+
+def decision_frame(engine: int, task_state, task_seq, task_valid, waves,
+                   stride: int, *, narrow: bool = False,
+                   narrow_gate: bool = False, retries=0, stranded=0,
+                   pool_occ=0, bucket_fill=0):
+    """Build the [TELEM_WIDTH] int32 frame inside a jitted solve.
+
+    ``task_state``/``task_seq``/``task_valid`` are the engine's decision
+    arrays; ``stride`` is the engine's task_seq round stride (static —
+    seq // stride recovers the wave a placement landed in; engines
+    without wave structure pass a stride that maps every placement to
+    slot 0). Untouched tasks hold int32 max in task_seq — the clip
+    below keeps their (zero-weight) scatter index in range.
+    """
+    i32 = jnp.int32
+
+    def scal(x):
+        return jnp.asarray(x, i32).reshape(())
+
+    valid = jnp.asarray(task_valid, bool)
+    state = jnp.asarray(task_state, i32)
+    placed = valid & ((state == _ALLOC) | (state == _ALLOC_OB)
+                      | (state == _PIPELINE))
+    bound = placed.sum().astype(i32)
+    failed = (valid & (state == _FAIL)).sum().astype(i32)
+    pending = (valid & (state == _SKIP)).sum().astype(i32)
+    census = valid.sum().astype(i32)
+    slot = jnp.clip(jnp.asarray(task_seq, i32) // i32(max(int(stride), 1)),
+                    0, WAVE_SLOTS - 1)
+    wave_bound = jnp.zeros(WAVE_SLOTS, i32).at[slot].add(
+        placed.astype(i32))
+    return jnp.concatenate([
+        jnp.stack([scal(engine), scal(waves), bound, failed, pending,
+                   census]),
+        wave_bound,
+        jnp.stack([scal(pool_occ), scal(bucket_fill),
+                   scal(1 if narrow else 0), scal(1 if narrow_gate else 0),
+                   scal(retries), scal(stranded)]),
+    ])
+
+
+def host_frame(engine: int, **fields) -> np.ndarray:
+    """Numpy frame for engines whose telemetry is assembled host-side
+    from the already-read-back packed block (the victim kernels: their
+    result block is a bool bitmap, so the frame is derived from the
+    same single readback instead of widening the transfer 4x).
+    Unknown field names are a programming error."""
+    out = np.zeros(TELEM_WIDTH, np.int32)
+    out[F_ENGINE] = engine
+    index = {name: i for i, name in enumerate(FIELDS)}
+    for name, val in fields.items():
+        out[index[name]] = int(val)
+    return out

@@ -83,6 +83,7 @@ from ..metrics import count_blocking_readback
 from ..obs import span as _span
 from ..api.resource import RESOURCE_DIM
 from .solver import dynamic_node_score
+from .telemetry import ENGINE_VICTIM_VISIT, ENGINE_VICTIM_WAVE, host_frame
 from .tensorize import (VEC_EPS, _intern_paths, accumulate_nz, load_kb_pack,
                         nz_request_vec, pad_to_bucket)
 from ..api.resource import VEC_SCALE
@@ -1606,7 +1607,7 @@ class VictimSolver:
                 score_nodes=self.score_nodes, room_check=self.room_check)
 
         self.dispatches += 1
-        with _span("victim_wave", cat="kernel"):
+        with _span("victim_wave", cat="kernel") as sp:
             packed = None
             if self.remote is not None:
                 # sidecar analysis (KUBEBATCH_SOLVER=rpc): statics were
@@ -1629,6 +1630,14 @@ class VictimSolver:
             pick = packed[:, :n_pad]
             guard = packed[:, n_pad:2 * n_pad]
             victims = packed[:, 2 * n_pad:]
+            # host-derived telemetry frame: the wave result is a bool
+            # bitmap, so the frame comes from the SAME readback instead
+            # of widening the transfer to int32 (kernels/telemetry.py)
+            from ..obs import telemetry as _obs_telemetry
+            _obs_telemetry.record(host_frame(
+                ENGINE_VICTIM_WAVE, waves=1, pending=p,
+                census=int(pick[:p].any(axis=1).sum()),
+                bound=int(victims[:p].any(axis=1).sum())), span=sp)
         log_pos = len(st.events)
         for i, t in enumerate(chunk):
             self._wave_cache[(filter_kind, t.uid)] = {
@@ -1662,7 +1671,7 @@ class VictimSolver:
                 filter_kind=filter_kind, dyn_enabled=dyn_enabled,
                 score_nodes=self.score_nodes, room_check=self.room_check)
 
-        with _span("victim_visit", cat="kernel"):
+        with _span("victim_visit", cat="kernel") as sp:
             packed = None
             if self.remote is not None:
                 packed = self.remote.visit(
@@ -1678,6 +1687,11 @@ class VictimSolver:
                 count_blocking_readback()
                 with _span("readback", cat="readback"):
                     packed = np.asarray(out)   # [4+V] — ONE blocking read
+            from ..obs import telemetry as _obs_telemetry
+            _obs_telemetry.record(host_frame(
+                ENGINE_VICTIM_VISIT, waves=1, pending=1,
+                bound=int(bool(packed[0])),
+                census=int(packed[2])), span=sp)
         found, node, vcount, guard = (bool(packed[0]), int(packed[1]),
                                       int(packed[2]), bool(packed[3]))
         rows = np.nonzero(packed[4:])[0].tolist() if found else []

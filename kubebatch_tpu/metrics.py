@@ -700,6 +700,119 @@ def blocking_readbacks() -> int:
 
 
 # ---------------------------------------------------------------------------
+# readbacks-per-decision accounting + device telemetry (ISSUE 12)
+# ---------------------------------------------------------------------------
+# The raw readback count says what a cycle PAID; dividing by the tasks
+# the device actually bound says what it paid PER UNIT OF WORK — the
+# scaling figure ROADMAP item 2 (pipelined cycles) is measured against.
+# Decisions are fed from the decoded device telemetry frame
+# (obs/telemetry.py), so every engine — in-process, sharded, rpc-served,
+# mega-coalesced — counts through one seam.
+
+_decisions = 0
+
+
+def count_decisions(n: int) -> None:
+    """Record n scheduling decisions (tasks bound by a device solve)."""
+    global _decisions
+    if n:
+        _decisions += int(n)
+
+
+def decisions_total() -> int:
+    """Process-lifetime bound-task count; consumers diff across a window."""
+    return _decisions
+
+
+def readback_accounting(since: "dict | None" = None) -> dict:
+    """{readbacks, decisions, readbacks_per_decision} — process-lifetime,
+    or the window since a previous readback_accounting() snapshot when
+    ``since`` is passed. The ratio is None for an idle window (nothing
+    bound). Replaces diffing the raw _blocking_readbacks global."""
+    rb = _blocking_readbacks
+    dec = _decisions
+    if since is not None:
+        rb -= int(since.get("readbacks", 0))
+        dec -= int(since.get("decisions", 0))
+    return {"readbacks": rb, "decisions": dec,
+            "readbacks_per_decision": (round(rb / dec, 6) if dec
+                                       else None)}
+
+
+class _BoundedHist:
+    """Tiny host-side histogram: fixed bucket uppers plus an overflow
+    slot, rendered OpenMetrics-style by obs/http.py. Single-writer (the
+    scheduler thread) with racy-read snapshots — the same contract as
+    the other mirror counters."""
+
+    __slots__ = ("uppers", "counts", "sum", "count")
+
+    def __init__(self, uppers):
+        self.uppers = tuple(uppers)
+        self.counts = [0] * (len(self.uppers) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v) -> None:
+        v = float(v)
+        for i, ub in enumerate(self.uppers):
+            if v <= ub:
+                break
+        else:
+            i = len(self.uppers)
+        self.counts[i] += 1
+        self.sum += v
+        self.count += 1
+
+    def snapshot(self) -> dict:
+        cum, buckets = 0, {}
+        for ub, c in zip(self.uppers, self.counts):
+            cum += c
+            buckets[repr(float(ub))] = cum
+        return {"buckets": buckets, "sum": round(self.sum, 6),
+                "count": self.count}
+
+
+_telemetry_last: dict = {}          # engine -> last decoded frame
+_telemetry_tenant_last: dict = {}   # tenant -> last decoded frame
+_telemetry_hists = {
+    "telemetry_waves": _BoundedHist(_buckets(1, 2, 12)),
+    "telemetry_bound": _BoundedHist(_buckets(1, 4, 10)),
+    "cycle_latency_ms": _BoundedHist(_buckets(1, 2, 14)),
+}
+
+
+def observe_telemetry(engine: str, frame: dict, tenant=None) -> None:
+    """Fold one decoded device telemetry frame into the per-engine
+    gauges and bounded histograms (obs/telemetry.record is the only
+    caller). Also advances the decisions accumulator — the frame's
+    bound count IS the dispatch's decision count."""
+    count_decisions(frame.get("bound", 0))
+    _telemetry_last[engine] = frame
+    if tenant:
+        _telemetry_tenant_last[tenant] = frame
+    _telemetry_hists["telemetry_waves"].observe(frame.get("waves", 0))
+    _telemetry_hists["telemetry_bound"].observe(frame.get("bound", 0))
+
+
+def observe_cycle_latency_ms(ms: float) -> None:
+    """Cycle wall time into the bounded histogram (obs cycle hook)."""
+    _telemetry_hists["cycle_latency_ms"].observe(ms)
+
+
+def telemetry_snapshot() -> dict:
+    """Last decoded frame per engine (and per tenant when attributed)
+    plus the bounded histograms — counters_snapshot's 'telemetry'
+    section."""
+    out = {"last": dict(_telemetry_last),
+           "histograms": {k: h.snapshot()
+                          for k, h in _telemetry_hists.items()}}
+    if _telemetry_tenant_last:
+        out["tenant_last"] = dict(_telemetry_tenant_last)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # rpc dispatch-latency exposure (ISSUE 7 satellite 1)
 # ---------------------------------------------------------------------------
 # rpc/client.py keeps a bounded ring of (client rtt, server solve_ms)
@@ -761,6 +874,7 @@ def counters_snapshot(include_rpc: bool = True) -> dict:
                                in host_phase_seconds().items()},
         "slow_path_items": slow_path_items(),
         "blocking_readbacks": blocking_readbacks(),
+        "decisions_total": decisions_total(),
         "shed_level": shed_level(),
         "load_shed_total": load_shed_total(),
         "mega_dispatches_total": mega_dispatches_total(),
@@ -770,7 +884,9 @@ def counters_snapshot(include_rpc: bool = True) -> dict:
         "audit_cycles_total": audit_cycles_total(),
         "audit_failures_total": audit_failures_total(),
         "fold_demotions_total": fold_demotions_total(),
+        "telemetry": telemetry_snapshot(),
     }
+    snap["readback_accounting"] = readback_accounting()
     arrival = arrival_latency_percentiles()
     if arrival:
         # sub-cycle arrival -> decision percentiles on /debug/vars and

@@ -13,11 +13,17 @@ The observability subsystem (ISSUE 7 / docs/OBSERVABILITY.md):
   ladder demotions and chaos invariant violations;
 - :mod:`.explain` — the opt-in unschedulability explainer (one extra
   readback, never on the steady path);
+- :mod:`.telemetry` — host decode of the device telemetry frame every
+  engine appends to its packed result (rides the one readback);
 - :mod:`.http`    — /metrics, /healthz, /debug/vars, /debug/explain.
 
 Import discipline: this package imports only metrics (and jax, which
 every kernel module already pays for); actions/kernels/rpc import obs,
-never the reverse at module scope — no cycles.
+never the reverse at module scope — no cycles. The one exception is
+.telemetry's frame-layout import from kernels.telemetry, a leaf module
+with no obs dependency; it is imported at the BOTTOM of this file so
+the kernels package (whose own modules import obs.span) always finds
+this package initialized.
 """
 from .spans import (CYCLE_HOOKS, Span, add_event, arm_profile, begin_cycle,
                     begin_server_root, current_cycle, cycle, enabled,
@@ -29,4 +35,7 @@ __all__ = ["CYCLE_HOOKS", "Span", "add_event", "arm_profile",
            "begin_cycle", "begin_server_root", "current_cycle", "cycle",
            "enabled", "end_cycle", "end_server_root", "graft",
            "last_cycle", "now", "set_enabled", "span",
-           "span_overhead_estimate", "spans_total", "tracer_stats"]
+           "span_overhead_estimate", "spans_total", "telemetry",
+           "tracer_stats"]
+
+from . import telemetry  # noqa: E402  (see import discipline above)

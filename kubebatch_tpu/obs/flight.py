@@ -20,7 +20,8 @@ via the CLI ``--flight-record[=DIR]``, ``KUBEBATCH_FLIGHT_RECORD``, or
 ``arm()`` in tests. Each dump is one JSON file:
 
     <dir>/flightrec-<seq>-<reason>.json
-    { "reason": ..., "ts": ..., "cycles": [ {spans, counters, ladder}... ] }
+    { "reason": ..., "ts": ...,
+      "cycles": [ {spans, counters, ladder, telemetry}... ] }
 
 so the artifact answers "what did the last K cycles look like, and what
 were the counters at each of them" without any other file.
@@ -84,11 +85,15 @@ class FlightRecorder:
         of a tree with tens of nodes plus dict copies of the counters;
         the rpc percentile pass is skipped per cycle (the dump header
         computes it once at dump time)."""
+        from . import telemetry
         rec = {
             "ts": time.time(),
             "spans": root.to_dict(),
             "counters": metrics.counters_snapshot(include_rpc=False),
             "ladder": _ladder_state(),
+            # last decoded device telemetry frame per engine — the
+            # kernel's own account of the cycle, alongside the host view
+            "telemetry": telemetry.last_frames(),
         }
         with self._lock:
             self._ring.append(rec)

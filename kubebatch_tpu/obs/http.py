@@ -7,8 +7,9 @@ must not disappear just because the prometheus client library is absent
 One small stdlib ThreadingHTTPServer serves:
 
 - ``/metrics``       — the Prometheus registry when prometheus_client is
-  importable, else a minimal text rendering of the mirror counters (the
-  scrape contract degrades, it never 404s);
+  importable, else an OpenMetrics exposition of the mirror counters
+  (typed ``# HELP``/``# TYPE`` lines, histogram buckets, ``# EOF``) so
+  scrapers ingest the fallback correctly too — the surface never 404s;
 - ``/healthz``       — liveness JSON: status "ok" at the full engine,
   "degraded" under any ladder demotion, "failing" when the ladder is
   pinned at its floor; plus ladder level, cycle failure count,
@@ -34,24 +35,68 @@ from .. import metrics
 __all__ = ["DebugHTTPServer", "start"]
 
 
-def _render_vars_text(snapshot: dict) -> str:
-    """Prometheus-ish text fallback for /metrics without the client lib:
-    flat ``kube_batch_<key>{...} value`` lines from the mirror counters."""
-    lines = []
+#: leaf keys that are monotone accumulators despite lacking the
+#: ``_total`` suffix (the suffix rule covers everything else)
+_COUNTER_LEAVES = {"blocking_readbacks", "readbacks", "decisions",
+                   "dispatches", "count"}
 
-    def walk(prefix: str, value):
+#: OpenMetrics media type (the ``# EOF`` terminator below is part of it)
+OPENMETRICS_CTYPE = ("application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8")
+
+
+def _render_openmetrics(snapshot: dict) -> str:
+    """OpenMetrics exposition of the mirror counters — the /metrics
+    fallback without prometheus_client. Typing derives from the
+    snapshot's structure: ``*_total`` names (and the readback/decision
+    accumulators) are counters, dicts shaped like
+    metrics._BoundedHist.snapshot() render as full histograms
+    (``_bucket{le=...}``/``_sum``/``_count``), every other numeric leaf
+    is a gauge. Nested dict keys flatten into the metric name, so the
+    exposition covers exactly what /debug/vars covers."""
+    out = []
+
+    def emit(name: str, mtype: str, help_: str, lines) -> None:
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} {mtype}")
+        out.extend(lines)
+
+    def is_hist(v) -> bool:
+        return (isinstance(v, dict) and isinstance(v.get("buckets"), dict)
+                and "sum" in v and "count" in v)
+
+    def clean(k: str) -> str:
+        return (str(k).replace("-", "_").replace(".", "_")
+                .replace("/", "_").replace(" ", "_"))
+
+    def walk(prefix: str, value, leaf_key: str = "") -> None:
+        name = f"kube_batch_{prefix}"
+        if is_hist(value):
+            lines = []
+            for ub, cum in value["buckets"].items():
+                lines.append(f'{name}_bucket{{le="{float(ub)}"}} {cum}')
+            lines.append(f'{name}_bucket{{le="+Inf"}} {value["count"]}')
+            lines.append(f"{name}_sum {value['sum']}")
+            lines.append(f"{name}_count {value['count']}")
+            emit(name, "histogram", f"{leaf_key} (bounded histogram)",
+                 lines)
+            return
         if isinstance(value, dict):
             for k, v in sorted(value.items()):
-                walk(f"{prefix}_{k}".replace("-", "_")
-                     .replace(".", "_").replace("/", "_"), v)
-        elif isinstance(value, bool):
-            lines.append(f"kube_batch_{prefix} {int(value)}")
-        elif isinstance(value, (int, float)) and value is not None:
-            lines.append(f"kube_batch_{prefix} {value}")
+                key = clean(k)
+                walk(f"{prefix}_{key}" if prefix else key, v, str(k))
+            return
+        if isinstance(value, bool):
+            value = int(value)
+        if isinstance(value, (int, float)):
+            mtype = ("counter" if (name.endswith("_total")
+                                   or leaf_key in _COUNTER_LEAVES)
+                     else "gauge")
+            emit(name, mtype, leaf_key or prefix, [f"{name} {value}"])
 
     walk("", snapshot)
-    return "\n".join(line.replace("kube_batch__", "kube_batch_")
-                     for line in lines) + "\n"
+    out.append("# EOF")
+    return "\n".join(out) + "\n"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -113,9 +158,9 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send(200, generate_latest(REGISTRY),
                                "text/plain; version=0.0.4")
                 except Exception:
-                    self._send(200, _render_vars_text(
+                    self._send(200, _render_openmetrics(
                         metrics.counters_snapshot()).encode(),
-                        "text/plain")
+                        OPENMETRICS_CTYPE)
             else:
                 self._send_json({"error": "not found", "endpoints": [
                     "/metrics", "/healthz", "/debug/vars",

@@ -1,0 +1,93 @@
+"""Host decode of the device telemetry frame (ISSUE 12 tentpole).
+
+The device engines append a fixed [TELEM_WIDTH] int32 frame
+(kernels/telemetry.py) to the packed block they already ship back in
+the cycle's ONE blocking readback. This module is the host side:
+decode the words, remember the last frame per engine, attach the
+decoded dict to the dispatch span (so it shows in Chrome-trace args
+and flight-recorder dumps, and — for sidecar solves — crosses the rpc
+hop inside the existing kb-trace-bin trailing metadata), and fold it
+into metrics.py's gauges/histograms and the readbacks-per-decision
+accounting.
+
+decode/record ALWAYS run — 16 host ints per dispatch, no device work —
+so readback and decision accounting are identical whether span
+retention is on or off (obs.set_enabled only gates tree attachment;
+with retention off the thread stack is empty and the span attach is a
+no-op).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .. import metrics
+from ..kernels.telemetry import ENGINE_NAMES, FIELDS, TELEM_WIDTH
+from . import spans as _spans
+
+__all__ = ["TELEM_WIDTH", "FIELDS", "decode", "record", "last_frame",
+           "last_frames"]
+
+_lock = threading.Lock()
+_last: dict = {}
+
+
+def decode(words) -> dict:
+    """[TELEM_WIDTH] int32 words -> {field: int}, with the engine id
+    resolved to its name. Tolerates longer inputs (callers may pass an
+    unsliced tail)."""
+    w = np.asarray(words).reshape(-1)[:TELEM_WIDTH]
+    frame = {name: int(w[i]) for i, name in enumerate(FIELDS)}
+    frame["engine"] = ENGINE_NAMES.get(frame["engine"],
+                                       str(frame["engine"]))
+    return frame
+
+
+def record(words, span=None, tenant: Optional[str] = None) -> dict:
+    """Decode one frame and publish it everywhere it is consumed:
+
+    - the last-frame store (flight recorder ring entries, dryrun,
+      tests);
+    - the dispatch span's args (Chrome trace + rpc trailing metadata):
+      ``span`` explicit, else the innermost open span on this thread;
+    - metrics.observe_telemetry (per-engine gauges, bounded histograms,
+      and the decisions accumulator readbacks-per-decision divides by).
+
+    Called at the readback decode site with a slice of the ALREADY
+    transferred host array — it must never touch device memory (the
+    one-blocking-readback pin counts transfers, not decodes)."""
+    frame = decode(words)
+    eng = frame["engine"]
+    with _lock:
+        _last[eng] = frame
+    if span is None:
+        st = getattr(_spans._TLS, "stack", None)
+        span = st[-1] if st else None
+    if span is not None:
+        span.args = dict(span.args or {}, telemetry=frame)
+    metrics.observe_telemetry(eng, frame, tenant=tenant)
+    return frame
+
+
+def last_frame(engine: str) -> Optional[dict]:
+    """Most recent decoded frame for ``engine``, or None."""
+    with _lock:
+        return _last.get(engine)
+
+
+def last_frames() -> dict:
+    """Copy of the last decoded frame per engine (each flight-recorder
+    ring entry embeds this — a demotion dump shows what the device saw
+    on the failing cycle)."""
+    with _lock:
+        return dict(_last)
+
+
+def _cycle_hook(root) -> None:
+    # cycle wall time into the bounded histogram rendered at /metrics
+    metrics.observe_cycle_latency_ms(root.dur * 1e3)
+
+
+_spans.CYCLE_HOOKS.append(_cycle_hook)

@@ -294,9 +294,16 @@ def fused_lane_args(req: solver_pb2.SnapshotRequest,
 
 
 def fused_response(req, w: WireSolve, host_block: np.ndarray,
-                   solve_ms: float) -> solver_pb2.DecisionsResponse:
+                   solve_ms: float, tenant: Optional[str] = None
+                   ) -> solver_pb2.DecisionsResponse:
     """Decode one fused/mega host block into the wire response."""
-    task_state, task_node, task_seq, iters = unpack_host_block(host_block)
+    task_state, task_node, task_seq, iters, telem = \
+        unpack_host_block(host_block)
+    # device telemetry: attaches to the innermost open span — under an
+    # rpc handler that is the per-request server root, so the frame
+    # ships to the client inside the EXISTING kb-trace-bin trailing
+    # metadata; a tenant id lands it in metrics' per-tenant store too
+    obs.telemetry.record(telem, tenant=tenant)
     return _decisions(req, w, task_state, task_node, task_seq,
                       int(iters), solve_ms)
 
@@ -318,7 +325,8 @@ def _decisions(req, w: WireSolve, task_state, task_node, task_seq,
 
 
 def solve_snapshot(req: solver_pb2.SnapshotRequest,
-                   w: Optional[WireSolve] = None
+                   w: Optional[WireSolve] = None,
+                   tenant: Optional[str] = None
                    ) -> solver_pb2.DecisionsResponse:
     if w is None:
         w = decode_snapshot(req)
@@ -335,7 +343,7 @@ def solve_snapshot(req: solver_pb2.SnapshotRequest,
     solve_ms = sp.dur * 1e3        # same extent the perf_counter pair had
     with obs.span("readback", cat="readback"):
         host_block = np.asarray(host_block)   # one device->host transfer
-    return fused_response(req, w, host_block, solve_ms)
+    return fused_response(req, w, host_block, solve_ms, tenant=tenant)
 
 
 def _affinity_from_wire(req, n_pad: int, t_pad: int):

@@ -204,7 +204,8 @@ class TenantSolveService:
                 metrics.count_mega_dispatch(len(group))
                 for (it, w, _), hb in zip(group, blocks):
                     it.resp = rpc_server.fused_response(it.req, w, hb,
-                                                        solve_ms)
+                                                        solve_ms,
+                                                        tenant=it.tenant)
                     self._stash(it)
                     metrics.count_tenant(it.tenant, "solves")
                     metrics.count_tenant(it.tenant, "mega_solves")
@@ -218,7 +219,8 @@ class TenantSolveService:
                         it.finish(error=e)
         for it, w in singles:
             try:
-                it.resp = rpc_server.solve_snapshot(it.req, w)
+                it.resp = rpc_server.solve_snapshot(it.req, w,
+                                                    tenant=it.tenant)
                 self._stash(it)
                 metrics.count_tenant(it.tenant, "solves")
             except Exception as e:  # noqa: BLE001

@@ -9,8 +9,8 @@ import bench
 
 def test_run_steady_small_config():
     (latencies, bound, action_ms, readbacks, rss_mb, engines,
-     recompiles, span_counts, trace_roots, phase_ms) = bench.run_steady(
-        2, 2, "auto", 16)
+     recompiles, span_counts, trace_roots, phase_ms,
+     acct) = bench.run_steady(2, 2, "auto", 16)
     assert engines and all(e for e in engines)
     assert len(latencies) == 2
     assert bound == 32          # 16 churn pods per measured cycle
@@ -30,6 +30,12 @@ def test_run_steady_small_config():
     # both have fired on an incremental steady cycle
     assert "fold" in phase_ms, phase_ms
     assert "apply" in phase_ms, phase_ms
+    # the readbacks-per-decision window (ISSUE 12 satellite 2): the
+    # steady line's accounting must cover the measured cycles only
+    assert acct["readbacks"] == sum(readbacks)
+    assert acct["decisions"] >= bound
+    assert acct["readbacks_per_decision"] == round(
+        acct["readbacks"] / acct["decisions"], 6)
 
 
 def test_bench_main_one_json_line(capsys):
@@ -62,7 +68,9 @@ def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
                     [0.01, 0.01], {"tensorize": 1.0, "replay": 2.0,
                                    "close": 0.5},
                     {"cold_wall_ms": 500.0, "cold_compile_ms": 400.0,
-                     "cold_host_ms": 80.0}))
+                     "cold_host_ms": 80.0},
+                    {"readbacks": 2, "decisions": 200,
+                     "readbacks_per_decision": 0.01}))
     steady_ran = {}
 
     def fake_steady(*a):
@@ -70,7 +78,9 @@ def test_bench_cfg5_fallback_prints_primary_before_steady(capsys,
         steady_ran["primary_first"] = capsys.readouterr().out.strip()
         return ([0.05] * 5, 1280, {"allocate": 40.0}, [1, 1, 1, 1, 1],
                 100.0, ["batched"], 0, [20] * 5, [],
-                {"fold": 0.5, "apply": 1.0})
+                {"fold": 0.5, "apply": 1.0},
+                {"readbacks": 5, "decisions": 1280,
+                 "readbacks_per_decision": 0.003906})
 
     monkeypatch.setattr(bench, "run_steady", fake_steady)
     rc = bench.main(["--config", "5", "--cycles", "2"])

@@ -21,7 +21,7 @@ from kubebatch_tpu.actions.allocate_batched import execute_batched
 from kubebatch_tpu.cache import SchedulerCache
 from kubebatch_tpu.conf import shipped_tiers
 from kubebatch_tpu.framework import CloseSession, OpenSession
-from kubebatch_tpu.metrics import blocking_readbacks
+from kubebatch_tpu.metrics import readback_accounting
 from kubebatch_tpu.sim import ClusterSpec, build_cluster
 
 GiB = 1024 ** 3
@@ -42,11 +42,11 @@ def _cycle(spec, runner):
     cache = SchedulerCache(binder=_B(), evictor=_B(), async_writeback=False)
     sim.populate(cache)
     ssn = OpenSession(cache, shipped_tiers())
-    rb0 = blocking_readbacks()
+    acct0 = readback_accounting()
     runner(ssn)
-    used = blocking_readbacks() - rb0
+    acct = readback_accounting(since=acct0)
     CloseSession(ssn)
-    return used, binds
+    return acct["readbacks"], binds, acct
 
 
 SPEC = ClusterSpec(n_nodes=32, n_groups=24, pods_per_group=4,
@@ -58,9 +58,14 @@ def test_batched_allocate_is_one_blocking_read():
     def run(ssn):
         assert execute_batched(ssn) == "batched"
 
-    used, binds = _cycle(SPEC, run)
+    used, binds, acct = _cycle(SPEC, run)
     assert binds, "scenario must actually schedule"
     assert used == 1, f"batched allocate must read back ONCE, saw {used}"
+    # the accounting window also attributes decisions to the window, so
+    # the per-decision ratio the bench lines emit is well-defined here
+    assert acct["decisions"] >= len(binds)
+    assert acct["readbacks_per_decision"] == round(
+        1 / acct["decisions"], 6)
 
 
 def test_batched_allocate_with_affinity_is_one_blocking_read():
@@ -71,7 +76,7 @@ def test_batched_allocate_with_affinity_is_one_blocking_read():
     def run(ssn):
         assert execute_batched(ssn) == "batched"
 
-    used, binds = _cycle(spec, run)
+    used, binds, _ = _cycle(spec, run)
     assert binds
     assert used == 1, f"affinity cycles must not add readbacks, saw {used}"
 
@@ -81,7 +86,7 @@ def test_fused_allocate_is_one_blocking_read():
         from kubebatch_tpu.actions.allocate_fused import execute_fused
         assert execute_fused(ssn)
 
-    used, binds = _cycle(SPEC, run)
+    used, binds, _ = _cycle(SPEC, run)
     assert binds
     assert used == 1, f"fused allocate must read back ONCE, saw {used}"
 
@@ -109,7 +114,7 @@ def test_full_cycle_with_victims_bounded_readbacks():
         BackfillAction().execute(ssn)
         PreemptAction().execute(ssn)
 
-    used, _ = _cycle(spec, run)
+    used, _, _ = _cycle(spec, run)
     assert used <= 15, f"full-cycle readbacks out of budget: {used}"
 
 
@@ -139,7 +144,7 @@ def test_host_phase_budget_counters():
     def run(ssn):
         assert execute_batched(ssn) == "batched"
 
-    used, binds = _cycle(SPEC, run)
+    used, binds, _ = _cycle(SPEC, run)
     assert binds, "scenario must actually schedule"
 
     sp = slow_path_items()

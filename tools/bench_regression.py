@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Diff two BENCH_DEVICE.jsonl lines and gate on the invariants.
+
+The committed bench lines carry two kinds of numbers: invariants the
+code enforces (recompiles after warmup, blocking readbacks per
+decision, injected-fault/cycle-failure counts) and wall-times that only
+mean something on the same box (BENCH_NOTES: the tunnel RTT and host
+CPU dominate, so cross-box wall deltas are noise). This gate treats
+them accordingly:
+
+- HARD-FAIL pins — candidate may not exceed baseline:
+    recompiles_total, steady_recompiles, readbacks_per_decision,
+    readbacks_per_cycle, readbacks_max, faults_injected,
+    cycle_failures, invariant_violations
+- ADVISORY — reported with % delta, warn past --wall-tolerance, never
+  fail: value, p50/p95/max wall-times, host_share_ms, compile totals.
+
+Lines are selected by their "metric" field (the last occurrence wins,
+matching how bench.py appends). Fields absent from the BASELINE line
+are skipped (older lines predate them); a hard-pin field the baseline
+has but the CANDIDATE dropped is itself a failure — the invariant
+stopped being measured.
+
+Usage:
+    python tools/bench_regression.py BASELINE.jsonl CANDIDATE.jsonl \
+        [--metric sched_cycle_p50_ms_cfg2_steady] [--wall-tolerance 25]
+
+Exit 0 = all pins green; exit 1 = a pin regressed (details on stderr).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: candidate > baseline on any of these is a regression, full stop
+HARD_PINS = (
+    "recompiles_total",
+    "steady_recompiles",
+    "readbacks_per_decision",
+    "steady_readbacks_per_decision",
+    "readbacks_per_cycle",
+    "steady_readbacks_per_cycle",
+    "readbacks_max",
+    "faults_injected",
+    "cycle_failures",
+    "invariant_violations",
+)
+
+#: reported, warned past tolerance, never fatal (same-box numbers only)
+ADVISORY = (
+    "value",
+    "p95_ms",
+    "max_ms",
+    "host_share_ms",
+    "cold_wall_ms",
+    "compile_ms_total",
+    "trace_overhead_ms",
+    "rss_peak_mb",
+    "memory_peak_mb",
+)
+
+#: float comparison slack for the ratio pins (readbacks_per_decision is
+#: rounded to 6 places at the source)
+EPS = 1e-6
+
+
+def load_lines(path: str) -> Dict[str, dict]:
+    """metric-name -> last line with that metric (bench.py appends, so
+    the last occurrence is the current one)."""
+    out: Dict[str, dict] = {}
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                out[rec["metric"]] = rec
+    return out
+
+
+def _num(rec: dict, key: str) -> Optional[float]:
+    v = rec.get(key)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def diff_metric(metric: str, base: dict, cand: dict,
+                wall_tolerance_pct: float
+                ) -> Tuple[List[str], List[str]]:
+    """Returns (failures, report_lines) for one metric pair."""
+    failures: List[str] = []
+    report: List[str] = []
+    for key in HARD_PINS:
+        b = _num(base, key)
+        if b is None:
+            continue            # older baseline predates the field
+        c = _num(cand, key)
+        if c is None:
+            failures.append(
+                f"{metric}: {key} present in baseline ({b:g}) but "
+                f"missing from candidate — the pin stopped being "
+                f"measured")
+            continue
+        if c > b + EPS:
+            failures.append(
+                f"{metric}: {key} regressed {b:g} -> {c:g}")
+        else:
+            report.append(f"  PIN  {key}: {b:g} -> {c:g}  ok")
+    for key in ADVISORY:
+        b, c = _num(base, key), _num(cand, key)
+        if b is None or c is None:
+            continue
+        delta = (c - b) / b * 100.0 if b else 0.0
+        flag = ("  ** exceeds ±{:.0f}% (advisory: wall-times are "
+                "same-box only)".format(wall_tolerance_pct)
+                if abs(delta) > wall_tolerance_pct else "")
+        report.append(f"  adv  {key}: {b:g} -> {c:g}  "
+                      f"({delta:+.1f}%){flag}")
+    return failures, report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="gate a fresh BENCH_DEVICE.jsonl line against a "
+                    "committed baseline")
+    ap.add_argument("baseline", help="committed jsonl (the pin source)")
+    ap.add_argument("candidate", help="fresh jsonl to gate")
+    ap.add_argument("--metric", action="append", default=None,
+                    help="metric name(s) to compare (default: every "
+                         "metric present in BOTH files)")
+    ap.add_argument("--wall-tolerance", type=float, default=25.0,
+                    help="advisory warn threshold for wall-time deltas, "
+                         "percent (default 25)")
+    args = ap.parse_args(argv)
+
+    base_lines = load_lines(args.baseline)
+    cand_lines = load_lines(args.candidate)
+    if not base_lines:
+        print(f"no bench lines in baseline {args.baseline}",
+              file=sys.stderr)
+        return 1
+    if not cand_lines:
+        print(f"no bench lines in candidate {args.candidate}",
+              file=sys.stderr)
+        return 1
+
+    if args.metric:
+        metrics = args.metric
+        missing = [m for m in metrics
+                   if m not in base_lines or m not in cand_lines]
+        if missing:
+            print(f"metric(s) not in both files: {missing}",
+                  file=sys.stderr)
+            return 1
+    else:
+        metrics = sorted(set(base_lines) & set(cand_lines))
+        if not metrics:
+            print("no common metrics between the two files",
+                  file=sys.stderr)
+            return 1
+
+    all_failures: List[str] = []
+    for metric in metrics:
+        failures, report = diff_metric(
+            metric, base_lines[metric], cand_lines[metric],
+            args.wall_tolerance)
+        print(metric)
+        for line in report:
+            print(line)
+        all_failures.extend(failures)
+
+    if all_failures:
+        print("\nREGRESSION GATE FAILED:", file=sys.stderr)
+        for f in all_failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print(f"\nregression gate green ({len(metrics)} metric(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
