@@ -134,18 +134,45 @@ def ensure_responsive_backend(timeout: float = 120.0) -> str:
     return backend
 
 
+def _sidecar_health(address: str) -> dict:
+    """Probe the sidecar's obs /healthz surface (obs/http.py). The obs
+    address comes from KUBEBATCH_OBS_ADDR when set, else the default
+    obs port next to the gRPC host. Returns the health JSON, or {} when
+    no obs surface answers (a sidecar run without --obs — not an error,
+    just unverifiable)."""
+    import json as _json
+    import urllib.request
+
+    obs_addr = os.environ.get("KUBEBATCH_OBS_ADDR", "")
+    if not obs_addr:
+        host = address.rsplit(":", 1)[0]
+        obs_addr = f"{host}:8080"
+    url = f"http://{obs_addr}/healthz"
+    try:
+        with urllib.request.urlopen(url, timeout=2.0) as resp:
+            return _json.loads(resp.read().decode())
+    except Exception:
+        return {}
+
+
 def ensure_rpc_sidecar():
     """--mode rpc support: PROBE BEFORE SPAWN. KUBEBATCH_SOLVER_ADDR
     (when set) and the default serve() address are probed for a live
     sidecar and reused — a bench run next to a running daemon must not
     fork a second solver process (it would double device contention and
-    could clash on the lease/metrics ports). Only when nothing answers
-    does an in-process server start on a free port — a real gRPC hop
-    over localhost TCP, the co-located deployment shape, so the recorded
-    per-dispatch cost is serialization + wire + queueing, not a stub.
-    Returns (address, server_or_None); the caller stops the server
-    after the run."""
+    could clash on the lease/metrics ports). A candidate that answers
+    the port is then HEALTH-CHECKED through /healthz: a sidecar
+    reporting "failing" (ladder at its floor) or running a different
+    kubebatch version would silently poison the recorded numbers, so
+    it is refused and an in-process server spawns instead. Only when
+    nothing answers does an in-process server start on a free port — a
+    real gRPC hop over localhost TCP, the co-located deployment shape,
+    so the recorded per-dispatch cost is serialization + wire +
+    queueing, not a stub. Returns (address, server_or_None); the
+    caller stops the server after the run."""
     import grpc
+
+    from kubebatch_tpu import __version__
 
     addr = os.environ.get("KUBEBATCH_SOLVER_ADDR", "")
     # the default serve() port is probed too: an operator's already-
@@ -156,15 +183,35 @@ def ensure_rpc_sidecar():
             ch = grpc.insecure_channel(cand)
             grpc.channel_ready_future(ch).result(timeout=2.0)
             ch.close()
-            os.environ["KUBEBATCH_SOLVER_ADDR"] = cand
-            if cand != addr:
-                print(f"reusing running rpc sidecar at {cand}",
-                      file=sys.stderr)
-            return cand, None
         except Exception:
             if cand == addr:
                 print(f"rpc sidecar {cand} unreachable; "
                       "starting in-process", file=sys.stderr)
+            continue
+        health = _sidecar_health(cand)
+        if health:
+            if health.get("status") == "failing":
+                print(f"rpc sidecar {cand} reports failing "
+                      f"(degradation level "
+                      f"{health.get('degradation_level')}); refusing to "
+                      f"bench against it — starting in-process",
+                      file=sys.stderr)
+                break
+            peer_ver = health.get("version", "")
+            if peer_ver and peer_ver != __version__:
+                print(f"rpc sidecar {cand} runs kubebatch {peer_ver}, "
+                      f"this bench is {__version__}; refusing the "
+                      f"mismatch — starting in-process", file=sys.stderr)
+                break
+        else:
+            print(f"rpc sidecar {cand} has no obs surface to verify "
+                  f"health/version; reusing it unverified",
+                  file=sys.stderr)
+        os.environ["KUBEBATCH_SOLVER_ADDR"] = cand
+        if cand != addr:
+            print(f"reusing running rpc sidecar at {cand}",
+                  file=sys.stderr)
+        return cand, None
     from kubebatch_tpu.rpc.server import make_server
 
     server, port = make_server("127.0.0.1:0")
@@ -859,6 +906,23 @@ def main(argv=None):
     ap.add_argument("--tenant-seconds", type=float, default=3.0,
                     help="per-phase duration for --tenants (capacity "
                          "and overload phases each run this long)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="fleet failover mode (ISSUE 14): N in-process "
+                         "sidecars behind the health-weighted tenant "
+                         "router, driven at saturation; one sidecar is "
+                         "killed abruptly mid-run. Pins: affected "
+                         "tenants fail over under a bounded p99 blip, "
+                         "unaffected tenants zero shed/zero errors, "
+                         "decisions bit-identical to dedicated oracles "
+                         "(pre- AND post-kill), standby mega lanes "
+                         "bit-identical, recompiles 0. Metric "
+                         "fleet_failover_p99_blip_ms; exit 1 on any "
+                         "pin.")
+    ap.add_argument("--fleet-tenants", type=int, default=4, metavar="N",
+                    help="tenant count for --fleet (default 4)")
+    ap.add_argument("--fleet-blip-bound-ms", type=float, default=250.0,
+                    help="hard bound for the failover p99 blip on the "
+                         "--fleet line (stated on the line, enforced)")
     ap.add_argument("--trace-export", default="", metavar="PATH",
                     help="with --steady: write the measured cycles' span "
                          "trees as Chrome trace-event JSON (Perfetto-"
@@ -1012,6 +1076,73 @@ def main(argv=None):
                   f"{recompiles_by_reason()}", file=sys.stderr)
             return 1
         return 0
+
+    if args.fleet:
+        # the fleet failover line (ISSUE 14): N sidecars at saturation,
+        # kill one mid-run, pin the failover cost and the zero-impact
+        # guarantees. In-process servers for the same reason as
+        # --tenants: every evidence counter reads THIS process.
+        from kubebatch_tpu import compilesvc
+        from kubebatch_tpu.metrics import recompiles_total
+        from kubebatch_tpu.sim.tenants import run_fleet
+
+        compilesvc.warmup("t")
+        r0 = recompiles_total()
+        rep = run_fleet(n_tenants=args.fleet_tenants,
+                        sidecars=args.fleet,
+                        duration_s=args.tenant_seconds)
+        out = {
+            "metric": "fleet_failover_p99_blip_ms",
+            "value": rep.failover_p99_blip_ms,
+            "unit": "ms",
+            # headroom against the stated bound (1.0 = at the bound)
+            "vs_baseline": round(rep.failover_p99_blip_ms
+                                 / args.fleet_blip_bound_ms, 4),
+            "sidecars": rep.sidecars,
+            "tenants": rep.tenants,
+            "killed_addr": rep.killed_addr,
+            "affected_tenants": rep.affected_tenants,
+            "failover_p99_blip_bound_ms": args.fleet_blip_bound_ms,
+            "pre_kill_p99_ms": rep.pre_kill_p99_ms,
+            "post_kill_p99_ms": rep.post_kill_p99_ms,
+            "cross_tenant_added_p99_ms": rep.cross_tenant_added_p99_ms,
+            "cross_tenant_shed": rep.cross_tenant_shed,
+            "cross_tenant_errors": rep.cross_tenant_errors,
+            "failovers": rep.failovers,
+            "failover_lost": rep.failover_lost,
+            "solves_total": rep.solves_total,
+            "parity_bit_identical": rep.parity_bit_identical,
+            "standby_mega_bit_identical": rep.standby_mega_bit_identical,
+            "recompiles_total": recompiles_total() - r0,
+            "backend": backend,
+        }
+        if rep.parity_mismatched or rep.rpc_errors:
+            out["parity_mismatched"] = rep.parity_mismatched
+            out["parity_errors"] = rep.rpc_errors[:5]
+        emit(out)
+        failed = []
+        if not rep.parity_bit_identical:
+            failed.append(f"parity diverged: {rep.parity_mismatched} "
+                          f"{rep.rpc_errors[:3]}")
+        if not rep.standby_mega_bit_identical:
+            failed.append("standby mega lanes diverged from dedicated "
+                          "dispatches")
+        if out["recompiles_total"]:
+            failed.append(f"{out['recompiles_total']} recompiles after "
+                          f"warm-up")
+        if rep.cross_tenant_shed or rep.cross_tenant_errors:
+            failed.append(f"unaffected tenants impacted: "
+                          f"shed={rep.cross_tenant_shed} "
+                          f"errors={rep.cross_tenant_errors}")
+        if rep.failover_lost:
+            failed.append(f"{rep.failover_lost} failover(s) refused "
+                          f"(standby lagged)")
+        if rep.failover_p99_blip_ms > args.fleet_blip_bound_ms:
+            failed.append(f"failover blip {rep.failover_p99_blip_ms}ms "
+                          f"over the {args.fleet_blip_bound_ms}ms bound")
+        for msg in failed:
+            print(f"fleet bench: {msg}", file=sys.stderr)
+        return 1 if failed else 0
 
     if args.mode == "arrival":
         # schedule-on-arrival mode (ISSUE 9): arrival -> decision

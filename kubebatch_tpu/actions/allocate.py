@@ -203,9 +203,15 @@ class AllocateAction(Action):
                                   get_solver_client)
         from ..rpc.victims_wire import (breaker_open, breaker_target,
                                         clear_breaker, trip_breaker)
+        from ..tenantsvc import router as _router
 
-        addr = os.environ.get("KUBEBATCH_SOLVER_ADDR", "127.0.0.1:50061")
         tenant = current_tenant()
+        rt = _router.active()
+        if rt is not None:
+            # a fleet is armed: placement, partition retry, health
+            # feedback, and breaker strikes all live in the client pool
+            return self._execute_rpc_fleet(ssn, rt, tenant)
+        addr = os.environ.get("KUBEBATCH_SOLVER_ADDR", "127.0.0.1:50061")
         target = breaker_target(addr, tenant)
         if breaker_open(target):
             # the sidecar failed recently (process-wide breaker shared
@@ -250,6 +256,44 @@ class AllocateAction(Action):
         # reset the strike escalation for this sidecar
         clear_breaker(target)
         client.apply_decisions(ssn, resp, tasks_by_uid)
+        return True
+
+    def _execute_rpc_fleet(self, ssn: Session, rt, tenant: str) -> bool:
+        """One remote solve through the fleet: the router resolves
+        placement (health-drained + failover overrides) and the client
+        pool owns the wire bookkeeping — rpc.partition retry onto a
+        re-resolved target, rtt feedback into the health score, and the
+        per-(address, tenant) breaker strikes. Same fallback contract
+        as the single-sidecar path: False only BEFORE any mutation."""
+        import logging
+
+        from ..rpc.client import (AdmissionRejected, SolverClient,
+                                  build_snapshot, get_solver_pool)
+        from ..rpc.victims_wire import breaker_open, breaker_target
+
+        addr = rt.route(tenant)
+        if breaker_open(breaker_target(addr, tenant)):
+            return False
+        try:
+            req, tasks_by_uid = build_snapshot(ssn)
+        except ValueError:
+            # snapshot exceeds the sidecar vocabulary — known, quiet
+            return False
+        try:
+            resp = get_solver_pool(tenant).solve(req)
+        except AdmissionRejected as e:
+            logging.getLogger("kubebatch").info(
+                "fleet shed tenant %s (%s); running in-process this "
+                "cycle", tenant, e)
+            return False
+        except Exception as e:
+            # the pool already struck the breaker and drained the
+            # router's health for every target it tried
+            logging.getLogger("kubebatch").warning(
+                "fleet solve failed for tenant %s (%s); running "
+                "in-process", tenant, e)
+            return False
+        SolverClient.apply_decisions(ssn, resp, tasks_by_uid)
         return True
 
     def _execute_queued(self, ssn: Session, mode: Optional[str] = None) -> None:

@@ -62,6 +62,10 @@ SEAMS: Dict[str, str] = {
                      "an injected fault rejects the request; the client "
                      "falls back in-process without tripping the "
                      "breaker)",
+    "rpc.partition": "client->sidecar route severed (rpc/client.py pool "
+                     "dispatch — fires like a dead channel: the breaker "
+                     "strikes the (address, tenant) target and the fleet "
+                     "router drains the address's health)",
     "cache.bind": "binder write-back (cache/cache.py _bind_one)",
     "cache.evict": "evictor write-back (cache/cache.py evict)",
     "cache.resync": "resync ground-truth replay (cache/cache.py "
@@ -75,9 +79,17 @@ SEAMS: Dict[str, str] = {
                          "loop)",
     "source.gone": "HTTP 410 Gone on the watch (cache/k8s_source.py)",
     "lease.renew": "leader lease renew CAS (runtime/leaderelection.py)",
+    "fleet.kill": "fleet sidecar death (sim/chaos.py fleet supervisor / "
+                  "bench.py --fleet): one in-process sidecar is stopped "
+                  "abruptly mid-run — kill -9 semantics, no grace; its "
+                  "tenants must fail over to their warm standby",
+    "fleet.slowpeer": "fleet slow peer (rpc/client.py pool dispatch): the "
+                      "target answers, late — an injected pre-wire delay; "
+                      "health-weighted routing must drain the slow "
+                      "sidecar BEFORE its breaker ever trips",
 }
 
-FAMILIES = ("device", "rpc", "cache", "source", "lease")
+FAMILIES = ("device", "rpc", "cache", "source", "lease", "fleet")
 
 
 class FaultInjected(RuntimeError):
@@ -232,13 +244,26 @@ class BackoffPolicy:
     queues (5ms * 2^retries, capped — the workqueue.RateLimiting
     equivalent); ``cooldown`` is the quarantine before the first
     recovery probe (the old private rpc-breaker constant), escalated by
-    ``probe_backoff`` per repeated trip up to ``max_cooldown``."""
+    ``probe_backoff`` per repeated trip up to ``max_cooldown``.
+
+    ``jitter`` > 0 decorrelates the escalation: a FLEET of breakers
+    quarantining the same sick sidecar would otherwise re-probe it in
+    lockstep (every cooldown is the same fixed step), and the
+    simultaneous probe volley is its own thundering herd against a
+    recovering process. The jittered schedule is SEEDED per
+    (``jitter_seed``, breaker target), so a chaos run with a fixed seed
+    replays the exact same probe times — reproducibility is the whole
+    reason the schedule is derived, not drawn from global randomness.
+    ``jitter == 0`` (the default) reproduces ``quarantine_for``
+    bit-for-bit, so every existing consumer is unchanged."""
 
     base_delay: float = 0.005
     max_delay: float = 10.0
     cooldown: float = 60.0
     probe_backoff: float = 2.0
     max_cooldown: float = 480.0
+    jitter: float = 0.0
+    jitter_seed: int = 0
 
     def retry_delay(self, retries: int) -> float:
         return min(self.base_delay * (2 ** retries), self.max_delay)
@@ -247,6 +272,28 @@ class BackoffPolicy:
         return min(self.cooldown * (self.probe_backoff
                                     ** max(0, strikes - 1)),
                    self.max_cooldown)
+
+    def jittered_quarantine_for(self, strikes: int,
+                                token: str = "") -> float:
+        """Decorrelated-jitter cooldown (the AWS "decorrelated jitter"
+        shape): strike 1 is the exact base ``cooldown``; every further
+        strike draws uniformly between the base and ``probe_backoff *
+        (1 + jitter)`` times the PREVIOUS draw, capped at
+        ``max_cooldown``. The walk is replayed from strike 1 on each
+        call with an RNG seeded by (jitter_seed, token) — stateless,
+        thread-safe, and two breakers for different targets land on
+        different schedules while the same (seed, target, strike)
+        always yields the same cooldown."""
+        if self.jitter <= 0.0:
+            return self.quarantine_for(strikes)
+        rng = random.Random(f"{self.jitter_seed}:{token}")
+        d = self.cooldown
+        for _ in range(max(0, strikes - 1)):
+            hi = max(self.cooldown,
+                     d * self.probe_backoff * (1.0 + self.jitter))
+            d = min(self.max_cooldown,
+                    rng.uniform(self.cooldown, hi))
+        return d
 
 
 DEFAULT_BACKOFF = BackoffPolicy()
@@ -296,8 +343,10 @@ class Quarantine:
         with self._lock:
             strikes = self._strikes.get(target, 0) + 1
             self._strikes[target] = strikes
-            self._until[target] = (time.monotonic()
-                                   + self._pol().quarantine_for(strikes))
+            self._until[target] = (
+                time.monotonic()
+                + self._pol().jittered_quarantine_for(strikes,
+                                                      token=target))
 
     def blocked(self, target: str) -> bool:
         with self._lock:
@@ -312,8 +361,9 @@ class Quarantine:
                 # against a wedged target) stay blocked. A successful
                 # probe calls clear(); a failed one trips and escalates.
                 strikes = self._strikes.get(target, 1)
-                self._until[target] = (now
-                                       + self._pol().quarantine_for(strikes))
+                self._until[target] = (
+                    now + self._pol().jittered_quarantine_for(
+                        strikes, token=target))
                 return False
             return True
 
@@ -326,6 +376,13 @@ class Quarantine:
     def strikes(self, target: str) -> int:
         with self._lock:
             return self._strikes.get(target, 0)
+
+    def strike_snapshot(self) -> Dict[str, int]:
+        """A locked copy of {target: strikes} — the fleet router reads
+        this to aggregate per-address health across the per-(address,
+        tenant) breaker targets without consuming probe windows."""
+        with self._lock:
+            return dict(self._strikes)
 
     def reset(self) -> None:
         with self._lock:

@@ -480,6 +480,54 @@ def load_shed_total() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# fleet accounting (ISSUE 14: health-weighted routing + warm-standby
+# failover). Per-target routing decisions and per-tenant failovers, hit
+# from every routed dispatch and from the failover path; deliberately
+# NOT mirrored into prometheus per event (a labels() lookup per routed
+# dispatch is measurable at saturation) — /debug/vars and the flight
+# recorder serve them from counters_snapshot like the fold counts.
+# ---------------------------------------------------------------------------
+
+_route_counters: dict = {}
+_failover_counters: dict = {}
+
+
+def count_route(target: str, result: str = "routed", n: int = 1) -> None:
+    """Record n routing decisions for ``target`` ("routed", "drained" —
+    skipped by the health walk, "dead" — skipped as marked-dead)."""
+    with _robust_lock:
+        per = _route_counters.setdefault(target, {})
+        per[result] = per.get(result, 0) + n
+
+
+def route_counters() -> dict:
+    """Per-target routing decision counts, {target: {result: n}}."""
+    with _robust_lock:
+        return {t: dict(per) for t, per in _route_counters.items()}
+
+
+def count_failover(tenant: str, src: str, dst: str) -> None:
+    """Record one tenant failover (re-route src -> dst after the version
+    handshake)."""
+    with _robust_lock:
+        per = _failover_counters.setdefault(tenant, {})
+        key = f"{src}->{dst}"
+        per[key] = per.get(key, 0) + 1
+
+
+def failover_counters() -> dict:
+    """Per-tenant failover counts, {tenant: {"src->dst": n}}."""
+    with _robust_lock:
+        return {t: dict(per) for t, per in _failover_counters.items()}
+
+
+def failovers_total() -> int:
+    with _robust_lock:
+        return sum(n for per in _failover_counters.values()
+                   for n in per.values())
+
+
+# ---------------------------------------------------------------------------
 # event-fold / sub-cycle accounting (ISSUE 9: event-driven incremental
 # cycles). Same discipline as the robustness counters: process-lifetime
 # values consumers diff across a window. events_folded is hit from
@@ -897,6 +945,13 @@ def counters_snapshot(include_rpc: bool = True) -> dict:
         # the per-tenant section: /debug/vars and flight dumps from a
         # SHARED sidecar stay attributable per tenant
         snap["tenants"] = tenants
+    routes = route_counters()
+    if routes:
+        # the fleet section (ISSUE 14): per-target routing decisions and
+        # per-tenant failovers, so a failover flight dump names the move
+        snap["fleet_routes"] = routes
+        snap["failovers_total"] = failovers_total()
+        snap["failovers"] = failover_counters()
     if include_rpc:
         rpc = rpc_dispatch_percentiles()
         if rpc:

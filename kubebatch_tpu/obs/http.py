@@ -127,9 +127,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # "degraded", and a ladder pinned at its floor (every
                 # engine tier exhausted) is the failing state
                 at_floor = level >= len(LADDER_LEVELS) - 1
+                from .. import __version__
                 self._send_json({
                     "status": ("failing" if at_floor
                                else "degraded" if level else "ok"),
+                    "version": __version__,
                     "degradation_level": level,
                     "cycle_failures_total":
                         snap.get("cycle_failures_total", 0),

@@ -422,3 +422,193 @@ def build_snapshot(ssn: Session):
     THIS function so they share the live encode code with every real
     tenant request."""
     return SolverClient._build_snapshot(ssn)
+
+
+# -- fleet: router-aware target resolution + the client pool ------------
+
+def resolve_solver_target(tenant: Optional[str] = None) -> str:
+    """The dial target for one tenant: the fleet router's answer when
+    one is installed (tenantsvc.router.install), else the single-
+    sidecar env/default — so every existing single-address caller is
+    unchanged until a fleet is actually armed."""
+    from ..tenantsvc import router as _router
+
+    rt = _router.active()
+    if rt is not None:
+        return rt.route(tenant or current_tenant())
+    return os.environ.get("KUBEBATCH_SOLVER_ADDR", "127.0.0.1:50061")
+
+
+#: injected delay for the fleet.slowpeer seam (seconds) — long enough
+#: to read as "slow" against DEFAULT_SLOW_MS, short enough to keep soak
+#: runs fast
+SLOWPEER_DELAY_S = 0.05
+
+
+class SolverClientPool:
+    """Multi-address Solve frontend for a sidecar fleet.
+
+    Each call resolves its target through the router (health-drained
+    placement + failover overrides), reuses one SolverClient per
+    address, and feeds the router back: rtt on success, failure on a
+    wire error. Two fault seams live here — they are the fleet plane's
+    front door:
+
+    - ``rpc.partition``: the route to the resolved target is severed.
+      Fires like a dead channel: the (address, tenant) breaker target
+      strikes, the router's health drains, the optional failover_cb
+      fires, and the call retries ONCE on a re-resolved target (the
+      ring walk now avoids the sick address).
+    - ``fleet.slowpeer``: the target answers, late — an injected
+      pre-wire delay whose rtt is reported to the router, so health-
+      weighted routing drains the slow sidecar before its breaker
+      ever trips.
+    """
+
+    def __init__(self, addresses: List[str], tenant: str = "default",
+                 lane: str = "normal", accept_stale: bool = False,
+                 router=None, failover_cb=None):
+        self.addresses = list(addresses)
+        self.tenant = tenant or "default"
+        self.lane = lane
+        self.accept_stale = accept_stale
+        self._router = router
+        #: called (tenant, dead_address) after a partition/wire failure
+        #: — bench/sim hook this to run the replication handshake
+        self.failover_cb = failover_cb
+        self._clients: Dict[str, SolverClient] = {}
+        self._lock = threading.Lock()
+
+    def router(self):
+        if self._router is not None:
+            return self._router
+        from ..tenantsvc import router as _router
+
+        return _router.active()
+
+    def target(self) -> str:
+        rt = self.router()
+        if rt is not None:
+            return rt.route(self.tenant)
+        return (self.addresses[0] if self.addresses
+                else resolve_solver_target(self.tenant))
+
+    def client_for(self, address: str) -> SolverClient:
+        with self._lock:
+            client = self._clients.get(address)
+            if client is None:
+                client = self._clients[address] = SolverClient(
+                    address, tenant=self.tenant, lane=self.lane,
+                    accept_stale=self.accept_stale)
+        return client
+
+    def close(self) -> None:
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            c.close()
+
+    def _partition(self, address: str, rt) -> None:
+        """A severed route's bookkeeping — identical to what a dead
+        channel earns in actions/allocate._execute_rpc."""
+        from ..faults import SIDECAR_QUARANTINE
+        from .victims_wire import breaker_target
+
+        SIDECAR_QUARANTINE.trip(breaker_target(address, self.tenant))
+        if rt is not None:
+            rt.report_failure(address)
+        cb = self.failover_cb or _FAILOVER_CB
+        if cb is not None:
+            try:
+                cb(self.tenant, address)
+            except Exception:   # the cb is advisory, never call-fatal
+                pass
+
+    def solve(self, req, timeout: float = 60.0):
+        import time as _time
+
+        from ..faults import check as _fault_check, should_fail
+
+        rt = self.router()
+        last_exc: Optional[BaseException] = None
+        tried: List[str] = []
+        for attempt in range(2):
+            addr = self.target()
+            if tried and addr == tried[-1]:
+                break              # nowhere else to go — re-raise below
+            tried.append(addr)
+            delay = 0.0
+            if should_fail("fleet.slowpeer"):
+                delay = SLOWPEER_DELAY_S
+                _time.sleep(delay)
+            try:
+                _fault_check("rpc.partition")
+            except Exception as e:
+                last_exc = e
+                self._partition(addr, rt)
+                continue
+            t0 = _time.monotonic()
+            try:
+                resp = self.client_for(addr).solve(req, timeout=timeout)
+            except AdmissionRejected:
+                raise              # overload, not death — never re-route
+            except grpc.RpcError as e:
+                last_exc = e
+                self._partition(addr, rt)
+                continue
+            if rt is not None:
+                rt.observe(addr, _time.monotonic() - t0 + delay)
+            return resp
+        raise last_exc if last_exc is not None else RuntimeError(
+            "solver pool exhausted its targets")
+
+
+#: process-wide pools per (router addresses, tenant) — the fleet analog
+#: of _CLIENTS; one pool (and its channels) per tenant per fleet shape
+_POOLS: Dict[tuple, SolverClientPool] = {}
+_POOLS_LOCK = threading.Lock()
+
+#: default failover callback for ambient pools — fleet harnesses
+#: (bench --fleet, sim fleet chaos) install the replication plane's
+#: handshake-then-reroute here so a partitioned target fails its
+#: tenants over mid-call
+_FAILOVER_CB = None
+
+
+def set_failover_callback(cb) -> None:
+    global _FAILOVER_CB
+    _FAILOVER_CB = cb
+
+
+def get_solver_pool(tenant: Optional[str] = None) -> SolverClientPool:
+    """The ambient fleet pool for one tenant (requires an installed
+    tenantsvc router). Cached per (fleet addresses, tenant) so a
+    re-armed fleet with different membership gets fresh pools."""
+    from ..tenantsvc import router as _router
+
+    rt = _router.active()
+    if rt is None:
+        raise RuntimeError("get_solver_pool needs an installed "
+                           "tenantsvc router (tenantsvc.router.install)")
+    tenant = tenant or current_tenant()
+    # keyed by router IDENTITY (the pool keeps rt alive, so the id is
+    # stable): a re-armed fleet gets fresh pools even at the same addrs
+    key = (id(rt), tenant)
+    with _POOLS_LOCK:
+        pool = _POOLS.get(key)
+        if pool is None:
+            pool = _POOLS[key] = SolverClientPool(
+                list(rt.addresses), tenant=tenant, router=rt)
+    return pool
+
+
+def reset_solver_pools() -> None:
+    """Close and drop every cached fleet pool (fleet harness teardown)."""
+    with _POOLS_LOCK:
+        pools = list(_POOLS.values())
+        _POOLS.clear()
+    for p in pools:
+        try:
+            p.close()
+        except Exception:
+            pass

@@ -556,3 +556,301 @@ def run_chaos(cycles: int = 200, seed: int = 0,
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+# ---------------------------------------------------------------------
+# fleet chaos (ISSUE 14): N sidecars, seeded partitions / slow peers /
+# one abrupt kill, per-tenant invariants throughout
+# ---------------------------------------------------------------------
+
+#: per-crossing rates for the fleet soak — the rpc + fleet families.
+#: Deliberately NO cache/source/lease/device seams: the fleet soak's
+#: per-tenant stacks are synchronous sims (no streaming source, no
+#: write-back pool), so those families' retry machinery isn't in the
+#: loop; the five-family soak (run_chaos) owns them.
+DEFAULT_FLEET_RATES: Dict[str, float] = {
+    "rpc.solve": 0.15,
+    "rpc.partition": 0.2,
+    "fleet.slowpeer": 0.25,
+}
+
+#: exactly one abrupt sidecar death per soak (deterministic count, like
+#: cache.fold in the five-family soak): the kill is the event under
+#: test — its failovers must land clean — and killing more than
+#: sidecars-1 would leave no fleet to assert anything about
+DEFAULT_FLEET_COUNTS: Dict[str, int] = {
+    "fleet.kill": 1,
+}
+
+
+class _FleetSeams(_RecordingSeams):
+    """_RecordingSeams plus the tenant sim's kubelet contract: freshly
+    bound pods queue in ``fresh`` until the next tick flips them to
+    Running (sim/tenants._Binder's shape, with double-bind detection)."""
+
+    def __init__(self):
+        super().__init__()
+        self.fresh: List = []
+
+    def bind(self, pod, hostname):
+        super().bind(pod, hostname)
+        with self._lock:
+            self.fresh.append(pod)
+
+
+@dataclass
+class FleetChaosReport:
+    cycles: int = 0
+    seed: int = 0
+    sidecars: int = 0
+    tenants: int = 0
+    failures: int = 0
+    faults_injected: Dict[str, int] = field(default_factory=dict)
+    families_injected: List[str] = field(default_factory=list)
+    violations: List[str] = field(default_factory=list)
+    killed: List[str] = field(default_factory=list)
+    failovers: int = 0
+    final_ladder_level: int = -1
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def run_fleet_chaos(cycles: int = 200, seed: int = 0,
+                    sidecars: int = 3, tenants: int = 3,
+                    rates: Optional[Dict[str, float]] = None,
+                    counts: Optional[Dict[str, int]] = None,
+                    fault_start: int = 3,
+                    fault_stop: Optional[int] = None
+                    ) -> FleetChaosReport:
+    """The fleet soak: per-tenant seeded clusters scheduling through a
+    router-placed sidecar fleet under seeded partitions, injected slow
+    peers, and one abrupt sidecar death — with the standing invariants
+    asserted per tenant every cycle: no task lost or double-bound
+    (audit_cache + the recording binder), fairness shares conserved,
+    and the degradation ladder back at level 0 once faults stop. The
+    kill's tenants must fail over through the replication handshake
+    (``report.failovers`` counts them; zero after a kill is a
+    violation). Runs on the jittered backoff policy so fleet breakers
+    never re-probe in lockstep — the satellite (b) schedule, exercised
+    live."""
+    from ..actions.allocate import AllocateAction
+    from ..conf import shipped_tiers
+    from ..framework import CloseSession, OpenSession
+    from ..metrics import failovers_total
+    from ..objects import PodPhase
+    from ..rpc import client as rpc_client
+    from ..rpc.server import make_server
+    from ..tenantsvc import (ReplicationLagError, ReplicationPlane,
+                             TenantRouter)
+    from ..tenantsvc import router as router_mod
+    from ..tenantsvc.service import TenantSolveService
+    from ..tenantsvc.sessions import TenantRegistry
+    from .cluster import BASELINE_SPECS
+    from .tenants import TENANT_CONFIG, _TENANT_CHURN
+
+    report = FleetChaosReport(cycles=cycles, seed=seed,
+                              sidecars=sidecars, tenants=tenants)
+    rates = dict(rates if rates is not None else DEFAULT_FLEET_RATES)
+    counts = dict(counts if counts is not None else DEFAULT_FLEET_COUNTS)
+    if fault_stop is None:
+        fault_stop = max(fault_start + 1, cycles - max(12, cycles // 5))
+
+    saved_policy = faults.backoff_policy()
+    saved_env = {k: os.environ.get(k) for k in
+                 ("KUBEBATCH_SOLVER", "KUBEBATCH_SOLVER_ADDR",
+                  "KUBEBATCH_NO_BACKEND_PROBE")}
+    faults.reset()
+    # fast cooldowns sized to cycles — WITH decorrelated jitter, so the
+    # soak runs the schedule a fleet actually deploys
+    faults.set_backoff_policy(faults.BackoffPolicy(
+        base_delay=0.002, max_delay=0.05, cooldown=0.25,
+        probe_backoff=1.5, max_cooldown=1.0,
+        jitter=0.5, jitter_seed=seed))
+    os.environ["KUBEBATCH_NO_BACKEND_PROBE"] = "1"
+
+    servers: Dict[str, object] = {}
+    plane = None
+    try:
+        svcs: Dict[str, TenantSolveService] = {}
+        for _ in range(sidecars):
+            svc = TenantSolveService(TenantRegistry())
+            server, port = make_server("127.0.0.1:0", tenant_service=svc)
+            server.start()
+            addr = f"127.0.0.1:{port}"
+            servers[addr] = server
+            svcs[addr] = svc
+        addrs = list(servers)
+        router = TenantRouter(addrs)
+        router_mod.install(router)
+        plane = ReplicationPlane(router)
+        for addr, svc in svcs.items():
+            plane.attach(addr, svc.registry)
+        plane.start()
+
+        names = [f"tenant-{i}" for i in range(tenants)]
+
+        def failover_cb(tenant: str, dead_addr: str) -> None:
+            if next(iter(router._walk(tenant))) != dead_addr:
+                return
+            if router.snapshot()["overrides"].get(tenant):
+                return
+            try:
+                plane.failover(tenant, reason=f"partition:{dead_addr}")
+            except ReplicationLagError as e:
+                report.violations.append(
+                    f"failover refused for {tenant}: {e}")
+
+        rpc_client.set_failover_callback(failover_cb)
+
+        # per-tenant stacks: seeded cluster + recording binder + cache
+        from ..cache import SchedulerCache
+        from dataclasses import replace as _dc_replace
+
+        stacks = []
+        for i in range(tenants):
+            spec = _dc_replace(BASELINE_SPECS[TENANT_CONFIG], seed=i)
+            sim = build_cluster(spec)
+            seams = _FleetSeams()
+            cache = SchedulerCache(binder=seams, evictor=seams,
+                                   async_writeback=False)
+            sim.populate(cache)
+            stacks.append((sim, cache, seams))
+
+        tiers = shipped_tiers()
+        act = AllocateAction(mode="rpc")
+        fo0 = failovers_total()
+        plan = faults.FaultPlan(rates=rates, counts=counts, seed=seed)
+
+        def kubelet(cache, seams) -> None:
+            for pod in seams.fresh:
+                if pod.phase == PodPhase.PENDING:
+                    pod.phase = PodPhase.RUNNING
+                    cache.update_pod(pod, pod)
+            seams.fresh.clear()
+
+        def check_invariants(where: str, cache, seams) -> None:
+            before = len(report.violations)
+            with cache._lock:
+                problems = audit_cache(cache)
+            for p in problems:
+                report.violations.append(f"{where}: {p}")
+            with cache._lock:
+                job_cpu = sum(j.allocated.milli_cpu
+                              for j in cache.jobs.values())
+                job_mem = sum(j.allocated.memory
+                              for j in cache.jobs.values())
+                node_cpu = sum(n.used.milli_cpu
+                               for n in cache.nodes.values())
+                node_mem = sum(n.used.memory
+                               for n in cache.nodes.values())
+            if abs(job_cpu - node_cpu) > 1e-3 \
+                    or abs(job_mem - node_mem) > 64.0:
+                report.violations.append(
+                    f"{where}: fairness shares diverged — jobs "
+                    f"({job_cpu:.3f}m, {job_mem:.0f}B) != nodes "
+                    f"({node_cpu:.3f}m, {node_mem:.0f}B)")
+            report.violations.extend(
+                f"{where}: {v}" for v in seams.take_violations())
+            if len(report.violations) > before:
+                from ..obs import flight as _flight
+                _flight.dump(f"fleet_chaos-{where.split(':')[0]}")
+
+        def maybe_kill() -> None:
+            alive = [a for a in addrs if a not in report.killed]
+            if len(alive) <= 1 or not faults.should_fail("fleet.kill"):
+                return
+            primary = {t: next(iter(router._walk(t))) for t in names}
+            by_primary: Dict[str, int] = {}
+            for t, a in primary.items():
+                if a in alive:
+                    by_primary[a] = by_primary.get(a, 0) + 1
+            victim = (max(by_primary, key=lambda a: by_primary[a])
+                      if by_primary else alive[0])
+            servers[victim].stop(grace=None)      # abrupt, no grace
+            router.mark_dead(victim)
+            report.killed.append(victim)
+            for t in names:
+                if primary.get(t) != victim:
+                    continue
+                if router.snapshot()["overrides"].get(t):
+                    continue
+                try:
+                    plane.failover(t, reason="fleet.kill")
+                except ReplicationLagError as e:
+                    report.violations.append(
+                        f"failover refused for {t} after kill: {e}")
+
+        from ..rpc.client import set_tenant
+
+        for cycle in range(cycles):
+            if cycle == fault_start:
+                faults.arm(plan)
+            if cycle == fault_stop:
+                faults.disarm()
+            in_window = fault_start <= cycle < fault_stop
+            if in_window:
+                maybe_kill()
+            for i, tenant in enumerate(names):
+                sim, cache, seams = stacks[i]
+                set_tenant(tenant)
+                try:
+                    kubelet(cache, seams)
+                    if cycle:
+                        sim.churn_tick(cache, _TENANT_CHURN)
+                    ssn = OpenSession(cache, tiers)
+                    try:
+                        act.execute(ssn)
+                    finally:
+                        CloseSession(ssn)
+                except BaseException as e:  # the loop must never die
+                    report.failures += 1
+                    report.violations.append(
+                        f"cycle {cycle} tenant {tenant}: raised {e!r}")
+                finally:
+                    set_tenant(None)
+                kubelet(cache, seams)
+                check_invariants(
+                    f"cycle {cycle}{' (faulted)' if in_window else ''} "
+                    f"{tenant}", cache, seams)
+            if not in_window and cycle > fault_stop:
+                time.sleep(0.02)   # real time for cooldown expiry
+
+        faults.disarm()
+        report.faults_injected = dict(plan.injected)
+        report.families_injected = sorted(
+            {s.split(".", 1)[0] for s in plan.injected})
+        report.failovers = failovers_total() - fo0
+        report.final_ladder_level = faults.LADDER.level
+        if report.final_ladder_level != 0:
+            report.violations.append(
+                f"ladder failed to recover: level "
+                f"{report.final_ladder_level}")
+        if report.killed and report.failovers == 0:
+            report.violations.append(
+                f"sidecar {report.killed} died but no tenant failed "
+                f"over — the kill's tenants were stranded")
+        return report
+    finally:
+        faults.disarm()
+        faults.set_backoff_policy(saved_policy)
+        faults.LADDER.reset()
+        faults.SIDECAR_QUARANTINE.reset()
+        from ..rpc import client as _rc
+        from ..tenantsvc import router as _rt_mod
+        _rc.set_failover_callback(None)
+        _rc.reset_solver_pools()
+        _rt_mod.install(None)
+        if plane is not None:
+            plane.stop()
+        for server in servers.values():
+            try:
+                server.stop(grace=None)
+            except Exception:
+                pass
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

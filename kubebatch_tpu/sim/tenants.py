@@ -32,7 +32,7 @@ import numpy as np
 from .cluster import BASELINE_SPECS, build_cluster
 
 __all__ = ["run_multi_tenant", "run_saturation", "drive_tenant_cycles",
-           "TENANT_CONFIG"]
+           "run_fleet", "TENANT_CONFIG"]
 
 #: the per-tenant cluster spec key (sim/cluster.py BASELINE_SPECS)
 TENANT_CONFIG = "t"
@@ -333,3 +333,290 @@ def run_saturation(n_tenants: int = 4, address: str = "",
         overload_stale_served=stale[0],
         overload_errors=errored[0],
         shed_modes_seen=shed_delta)
+
+
+# ---------------------------------------------------------------------
+# fleet: N sidecars, kill one mid-saturation (ISSUE 14)
+# ---------------------------------------------------------------------
+
+@dataclass
+class FleetReport:
+    """The ``bench.py --fleet N`` evidence. Hard invariants (the bench
+    exits 1 on any): parity + standby-mega bit-identity, zero
+    cross-tenant shed/errors, zero lost failovers, blip under bound."""
+
+    sidecars: int
+    tenants: int
+    killed_addr: str = ""
+    affected_tenants: List[str] = field(default_factory=list)
+    pre_kill_p99_ms: float = 0.0
+    post_kill_p99_ms: float = 0.0
+    #: affected tenants' post-kill-window p99 minus their pre-kill p99 —
+    #: the failover cost, which the bench pins under a stated bound
+    failover_p99_blip_ms: float = 0.0
+    cross_tenant_added_p99_ms: float = 0.0
+    cross_tenant_shed: int = 0
+    cross_tenant_errors: int = 0
+    failovers: int = 0
+    failover_lost: int = 0
+    solves_total: int = 0
+    parity_bit_identical: bool = False
+    parity_mismatched: List[str] = field(default_factory=list)
+    standby_mega_bit_identical: bool = False
+    rpc_errors: List[str] = field(default_factory=list)
+
+
+def _decision_key(resp) -> tuple:
+    """The bit-identity comparand of one DecisionsResponse — decisions
+    only (solve_ms is wall time, never compared)."""
+    return tuple(sorted((d.task_uid, d.node_name, d.kind, d.order)
+                        for d in resp.decisions))
+
+
+def run_fleet(n_tenants: int = 4, sidecars: int = 3,
+              duration_s: float = 3.0, kill_after_frac: float = 0.4,
+              post_window_s: float = 1.0,
+              config=TENANT_CONFIG) -> FleetReport:
+    """N tenants across a fleet of in-process sidecars; one sidecar is
+    killed abruptly (stop with no grace — kill -9 semantics) mid-
+    saturation. Three phases:
+
+    1. **parity**: every tenant's seeded cluster driven through the
+       fleet (mode="rpc", router placement) must end bit-identical to
+       a dedicated in-process oracle run;
+    2. **saturation + kill**: closed-loop per-tenant solve load; at
+       ``kill_after_frac * duration_s`` the victim (the address
+       serving the most tenants) dies, the router marks it dead, and
+       its tenants fail over through the replication handshake —
+       per-request latencies bucket into pre/post-kill windows for the
+       blip measurement;
+    3. **post-kill parity + standby mega**: an affected tenant re-runs
+       its cluster through its standby (bit-identity survives the
+       move), and the standby's coalesced mega-solve lanes are checked
+       bit-identical to dedicated single dispatches.
+    """
+    from .. import faults, metrics
+    from ..rpc import client as rpc_client
+    from ..rpc.client import SolverClientPool
+    from ..rpc.server import make_server
+    from ..tenantsvc import ReplicationLagError, ReplicationPlane, TenantRouter
+    from ..tenantsvc import router as router_mod
+    from ..tenantsvc.service import TenantSolveService
+    from ..tenantsvc.sessions import TenantRegistry
+
+    report = FleetReport(sidecars=sidecars, tenants=n_tenants)
+    tenants = [f"tenant-{i}" for i in range(n_tenants)]
+
+    servers: Dict[str, object] = {}
+    svcs: Dict[str, TenantSolveService] = {}
+    plane = None
+    prev_addr = os.environ.get("KUBEBATCH_SOLVER_ADDR")
+    try:
+        for _ in range(sidecars):
+            svc = TenantSolveService(TenantRegistry())
+            server, port = make_server("127.0.0.1:0", tenant_service=svc)
+            server.start()
+            addr = f"127.0.0.1:{port}"
+            servers[addr] = server
+            svcs[addr] = svc
+        addrs = list(servers)
+        router = TenantRouter(addrs)
+        router_mod.install(router)
+        plane = ReplicationPlane(router)
+        for addr, svc in svcs.items():
+            plane.attach(addr, svc.registry)
+        plane.start()
+
+        lost_lock = threading.Lock()
+
+        def failover_cb(tenant: str, dead_addr: str) -> None:
+            # only the tenant's ring primary failing matters; a retry
+            # against an already-drained address must not re-fail-over
+            walk_primary = next(iter(router._walk(tenant)))
+            if walk_primary != dead_addr:
+                return
+            if router.snapshot()["overrides"].get(tenant):
+                return
+            try:
+                plane.failover(tenant, reason=f"partition:{dead_addr}")
+            except ReplicationLagError:
+                with lost_lock:
+                    report.failover_lost += 1
+
+        rpc_client.set_failover_callback(failover_cb)
+
+        # ---- phase 1: fleet parity vs dedicated oracles -------------
+        from ..rpc.client import set_tenant
+
+        dedicated = {}
+        for i, tenant in enumerate(tenants):
+            sim, cache, binder = _tenant_cluster(i, config)
+            dedicated[tenant] = drive_tenant_cycles(
+                sim, cache, binder, 3, mode="auto")
+
+        fleet_state: Dict[str, Dict] = {}
+
+        def parity_worker(i: int, tenant: str):
+            set_tenant(tenant)
+            try:
+                sim, cache, binder = _tenant_cluster(i, config)
+                fleet_state[tenant] = drive_tenant_cycles(
+                    sim, cache, binder, 3, mode="rpc")
+            except Exception as e:  # noqa: BLE001 — reported below
+                report.rpc_errors.append(
+                    f"{tenant}: {type(e).__name__}: {e}")
+            finally:
+                set_tenant(None)
+
+        threads = [threading.Thread(target=parity_worker,
+                                    args=(i, t), name=f"kb-fleet-{i}")
+                   for i, t in enumerate(tenants)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        report.parity_mismatched = [
+            t for t in tenants if fleet_state.get(t) != dedicated[t]]
+
+        # ---- phase 2: saturation + kill -----------------------------
+        reqs = _tenant_requests(n_tenants, config)
+        pools = [SolverClientPool(addrs, tenant=t, lane="batch",
+                                  accept_stale=True, router=router)
+                 for t in tenants]
+        for pool, req in zip(pools, reqs):     # warm off the clock
+            pool.solve(req)
+
+        primary = {t: next(iter(router._walk(t))) for t in tenants}
+        by_primary: Dict[str, int] = {}
+        for t, a in primary.items():
+            by_primary[a] = by_primary.get(a, 0) + 1
+        victim = max(by_primary, key=lambda a: by_primary[a])
+        report.killed_addr = victim
+        report.affected_tenants = sorted(
+            t for t, a in primary.items() if a == victim)
+
+        shed0 = sum(metrics.load_shed_total().values())
+        fo0 = metrics.failovers_total()
+        samples: Dict[str, List[tuple]] = {t: [] for t in tenants}
+        errors: Dict[str, int] = {t: 0 for t in tenants}
+        lock = threading.Lock()
+        t_start = time.perf_counter()
+        kill_at = t_start + duration_s * kill_after_frac
+        stop_at = t_start + duration_s
+
+        def sat_worker(i: int):
+            pool, req, tenant = pools[i], reqs[i], tenants[i]
+            mine, errs = [], 0
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                try:
+                    pool.solve(req)
+                    mine.append((time.perf_counter() - t_start,
+                                 time.perf_counter() - t0))
+                except Exception:  # noqa: BLE001 — counted, pinned 0
+                    errs += 1      # for unaffected tenants by the bench
+            with lock:
+                samples[tenant].extend(mine)
+                errors[tenant] += errs
+
+        def killer():
+            now = time.perf_counter()
+            if kill_at > now:
+                time.sleep(kill_at - now)
+            servers[victim].stop(grace=None)     # kill -9 semantics
+            router.mark_dead(victim)
+            for t in report.affected_tenants:
+                if router.snapshot()["overrides"].get(t):
+                    continue                     # cb already moved it
+                try:
+                    plane.failover(t, reason="fleet.kill")
+                except ReplicationLagError:
+                    with lost_lock:
+                        report.failover_lost += 1
+
+        threads = [threading.Thread(target=sat_worker, args=(i,))
+                   for i in range(n_tenants)]
+        kthread = threading.Thread(target=killer, name="kb-fleet-killer")
+        for t in threads:
+            t.start()
+        kthread.start()
+        for t in threads:
+            t.join(timeout=600)
+        kthread.join(timeout=600)
+
+        kill_rel = duration_s * kill_after_frac
+
+        def p99(vals: List[float]) -> float:
+            return (round(float(np.percentile(vals, 99)) * 1e3, 3)
+                    if vals else 0.0)
+
+        aff = set(report.affected_tenants)
+        pre_aff = [rtt for t in aff for ts, rtt in samples[t]
+                   if ts < kill_rel]
+        post_aff = [rtt for t in aff for ts, rtt in samples[t]
+                    if kill_rel <= ts < kill_rel + post_window_s]
+        pre_un = [rtt for t in tenants if t not in aff
+                  for ts, rtt in samples[t] if ts < kill_rel]
+        post_un = [rtt for t in tenants if t not in aff
+                   for ts, rtt in samples[t]
+                   if kill_rel <= ts < kill_rel + post_window_s]
+        report.pre_kill_p99_ms = p99(pre_aff)
+        report.post_kill_p99_ms = p99(post_aff)
+        report.failover_p99_blip_ms = round(
+            max(0.0, report.post_kill_p99_ms - report.pre_kill_p99_ms), 3)
+        report.cross_tenant_added_p99_ms = round(
+            max(0.0, p99(post_un) - p99(pre_un)), 3)
+        report.cross_tenant_errors = sum(
+            errors[t] for t in tenants if t not in aff)
+        report.cross_tenant_shed = max(
+            0, sum(metrics.load_shed_total().values()) - shed0)
+        report.failovers = metrics.failovers_total() - fo0
+        report.solves_total = sum(len(v) for v in samples.values())
+
+        # ---- phase 3: post-kill parity + standby mega ---------------
+        if report.affected_tenants:
+            t0_name = report.affected_tenants[0]
+            idx = tenants.index(t0_name)
+            set_tenant(t0_name)
+            try:
+                sim, cache, binder = _tenant_cluster(idx, config)
+                post_state = drive_tenant_cycles(
+                    sim, cache, binder, 3, mode="rpc")
+            finally:
+                set_tenant(None)
+            if post_state != dedicated[t0_name]:
+                report.parity_mismatched.append(f"{t0_name} (post-kill)")
+
+        # standby mega: the survivor coalesces same-shape lanes into
+        # one mega dispatch; decisions must match dedicated singles
+        standby_addr = next(a for a in addrs if a != victim)
+        standby_svc = svcs[standby_addr]
+        mega_reqs = [(t, "batch", reqs[i])
+                     for i, t in enumerate(tenants) if i < 3]
+        mega0 = metrics.mega_dispatches_total()
+        coalesced = standby_svc.solve_many(mega_reqs)
+        single_svc = TenantSolveService(TenantRegistry())
+        singles = [single_svc.solve_many([one])[0] for one in mega_reqs]
+        report.standby_mega_bit_identical = (
+            metrics.mega_dispatches_total() > mega0
+            and all(_decision_key(a) == _decision_key(b)
+                    for a, b in zip(coalesced, singles)))
+
+        report.parity_bit_identical = (not report.parity_mismatched
+                                       and not report.rpc_errors)
+        for pool in pools:
+            pool.close()
+        return report
+    finally:
+        rpc_client.set_failover_callback(None)
+        rpc_client.reset_solver_pools()
+        router_mod.install(None)
+        if plane is not None:
+            plane.stop()
+        for server in servers.values():
+            server.stop(grace=None)
+        faults.SIDECAR_QUARANTINE.reset()
+        if prev_addr is None:
+            os.environ.pop("KUBEBATCH_SOLVER_ADDR", None)
+        else:
+            os.environ["KUBEBATCH_SOLVER_ADDR"] = prev_addr

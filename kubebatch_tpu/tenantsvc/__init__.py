@@ -43,8 +43,12 @@ Design notes: docs/TENANCY.md.
 """
 from __future__ import annotations
 
+from .replicate import (ReplicationLagError,  # noqa: F401
+                        ReplicationPlane)
+from .router import TenantRouter  # noqa: F401
 from .sessions import (MirrorStore, StaleMirrorError,  # noqa: F401
                        TenantRegistry, TenantSession, TENANT_QUARANTINE)
 
 __all__ = ["MirrorStore", "StaleMirrorError", "TenantRegistry",
-           "TenantSession", "TENANT_QUARANTINE"]
+           "TenantSession", "TENANT_QUARANTINE", "TenantRouter",
+           "ReplicationPlane", "ReplicationLagError"]

@@ -1,0 +1,162 @@
+"""Warm-standby session replication for the sidecar fleet (ISSUE 14).
+
+The cold-standby model (LeaderElector/FileLease, inherited from
+kube-batch) survives scheduler death by re-syncing the world from
+scratch — a resync storm exactly when the fleet is least able to
+absorb one. This plane makes failover cheap instead: the MirrorStore's
+per-kind strictly-monotonic versions are ALREADY the state a standby
+needs, so every clean mirror upload on a tenant's primary streams to
+that tenant's designated standby (router.standby_for — the next
+distinct ring address) as it commits. Failover is then a routing
+override plus a version handshake; the standby's serve-stale mirror is
+as fresh as the primary's last committed decision.
+
+The can-never-apply-older guarantee costs nothing extra: the standby
+copy goes through the same ``MirrorStore.upload`` strict-advance check
+as any upload, so a replayed, reordered, or split-brain older version
+is REJECTED at the standby exactly as it would be at the primary.
+Replication errors never propagate into the primary's solve path
+(sessions._notify_upload swallows them) — a broken standby degrades
+failover freshness, not live traffic.
+
+WFQ weights ride along: ``session.weight`` is copied to the standby
+session on every streamed upload, so a tenant's weighted-fair share
+survives the move (ISSUE 14 tentpole requirement).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from . import sessions as _sessions
+from .router import TenantRouter
+from .sessions import StaleMirrorError, TenantRegistry, TenantSession
+
+__all__ = ["ReplicationPlane", "ReplicationLagError"]
+
+
+class ReplicationLagError(RuntimeError):
+    """A failover handshake found the standby BEHIND the primary's
+    last-seen versions — failing over would serve older state than the
+    tenant has been shown, so the failover is refused."""
+
+
+class ReplicationPlane:
+    """Streams mirror uploads from each tenant's primary to its warm
+    standby, across a set of in-process registries.
+
+    ``attach(address, registry)`` declares which registry backs which
+    fleet address (and stamps ``registry.origin`` so sessions know
+    where they live). ``start()`` registers the sessions upload hook;
+    ``stop()`` removes it. One plane instance per fleet.
+    """
+
+    def __init__(self, router: TenantRouter):
+        self.router = router
+        self._registries: Dict[str, TenantRegistry] = {}
+        self._lock = threading.Lock()
+        #: highest version streamed per (tenant, kind) — what the
+        #: failover handshake checks the standby against
+        self._last_seen: Dict[Tuple[str, str], int] = {}
+        #: re-entrancy guard: applying a copy to the standby fires the
+        #: same upload hook; without this the stream would echo forever
+        self._replicating = threading.local()
+        self._started = False
+
+    # -- wiring ----------------------------------------------------------
+    def attach(self, address: str, registry: TenantRegistry) -> None:
+        registry.origin = address
+        with self._lock:
+            self._registries[address] = registry
+
+    def detach(self, address: str) -> None:
+        with self._lock:
+            self._registries.pop(address, None)
+
+    def start(self) -> "ReplicationPlane":
+        if not self._started:
+            _sessions.on_mirror_upload(self._on_upload)
+            self._started = True
+        return self
+
+    def stop(self) -> None:
+        if self._started:
+            _sessions.remove_mirror_upload_hook(self._on_upload)
+            self._started = False
+
+    # -- the stream ------------------------------------------------------
+    def _on_upload(self, session: TenantSession, kind: str,
+                   version: int, payload) -> None:
+        if getattr(self._replicating, "active", False):
+            return                     # this IS the standby copy landing
+        tenant = session.tenant
+        # only the tenant's primary streams; an upload landing on any
+        # other registry (a stray client, the standby serving after
+        # failover) must not fan back out
+        primary = self.router.route(tenant)
+        if session.origin != primary:
+            return
+        standby = self.router.standby_for(tenant)
+        with self._lock:
+            reg = self._registries.get(standby) if standby else None
+        if reg is None:
+            return
+        key = (tenant, kind)
+        with self._lock:
+            if version > self._last_seen.get(key, -1):
+                self._last_seen[key] = version
+        peer = reg.get(tenant)
+        self._replicating.active = True
+        try:
+            peer.mirrors.upload(kind, version, payload)
+        except StaleMirrorError:
+            # the strict-advance check IS the never-apply-older
+            # guarantee doing its job (a reordered or replayed stream
+            # frame) — drop it, the standby already has newer
+            pass
+        finally:
+            self._replicating.active = False
+        # WFQ weight survives the move
+        peer.weight = session.weight
+
+    # -- failover --------------------------------------------------------
+    def handshake(self, tenant: str, standby: str) -> Dict[str, int]:
+        """Compare the standby's mirror versions against the stream's
+        high-water marks. Returns {kind: standby_version} when the
+        standby is caught up; raises ReplicationLagError listing every
+        lagging kind otherwise."""
+        with self._lock:
+            reg = self._registries.get(standby)
+            marks = {k: v for (t, k), v in self._last_seen.items()
+                     if t == tenant}
+        if reg is None:
+            raise ReplicationLagError(
+                f"no registry attached for standby {standby!r}")
+        ssn = reg.get(tenant)
+        lag = {}
+        have = {}
+        for kind, mark in marks.items():
+            v = ssn.mirrors.version(kind)
+            have[kind] = v
+            if v < mark:
+                lag[kind] = (v, mark)
+        if lag:
+            raise ReplicationLagError(
+                f"standby {standby!r} lags for tenant {tenant!r}: "
+                + ", ".join(f"{k} at v{v} < v{m}"
+                            for k, (v, m) in sorted(lag.items())))
+        return have
+
+    def failover(self, tenant: str, reason: str = "") -> str:
+        """Handshake-then-reroute. Verifies the standby holds every
+        kind at or past the stream's high-water mark (so the move can
+        never serve older state), then arms the router override. The
+        router emits the failover counter, tenant-tagged span, and
+        flight-recorder dump."""
+        standby = self.router.standby_for(tenant)
+        if standby is None:
+            raise ReplicationLagError(
+                f"tenant {tenant!r} has no standby on the ring")
+        self.handshake(tenant, standby)
+        dst = self.router.fail_over(tenant, reason=reason)
+        return dst or standby

@@ -1,0 +1,266 @@
+"""Tenant -> sidecar placement for a fleet of solve processes (ISSUE 14).
+
+One sidecar serves every tenant up to ~500 solves/s on one cpu box
+(docs/TENANCY.md); past that the production shape is a POOL of
+sidecars. Placement has three requirements that rule out a plain
+round-robin:
+
+- **stability**: a tenant's mirrors (sessions.MirrorStore) live on the
+  sidecar serving it — placement must be sticky per tenant and move as
+  few tenants as possible when the pool changes, which is the textbook
+  consistent-hash ring (sha1 points, vnodes for spread);
+- **health awareness**: the breaker (faults.SIDECAR_QUARANTINE, keyed
+  per (address, tenant) since PR 6) only reacts AFTER a target has
+  failed hard enough to trip. A sick-but-alive sidecar — answering,
+  late — never trips it. The router generalizes the strike state into
+  a per-address health score in [0, 1] (latency/failure ewma, decayed
+  by aggregated breaker strikes) and uses it to DRAIN the ring walk:
+  a degraded target keeps only a health-proportional fraction of its
+  tenants, deterministically (the acceptance draw hashes the
+  (tenant, address) pair, so the same tenants shed first on every
+  router instance — no thundering re-placement);
+- **bounded failover**: when a sidecar dies outright (fleet.kill), its
+  tenants re-route to their warm standby — the NEXT distinct address
+  on the ring, which the replication plane (replicate.py) has been
+  streaming mirrors to all along. Failover is a routing override plus
+  a version handshake, never a resync storm.
+
+The router is pure bookkeeping: it never opens channels. rpc/client.py
+consults it to pick a dial target; actions/allocate.py feeds it
+rtt/failure observations from the live path.
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import metrics
+from ..faults import SIDECAR_QUARANTINE
+
+__all__ = ["TenantRouter", "install", "active"]
+
+#: virtual nodes per address — enough for an even spread at 2-8
+#: sidecars without making ring rebuilds noticeable
+VNODES = 48
+
+#: health multiplier per aggregated breaker strike against an address —
+#: one strike halves the acceptance fraction, three make the target
+#: nearly invisible to the ring walk well before max quarantine
+STRIKE_DECAY = 0.5
+
+#: ewma smoothing for the latency/outcome score (higher = snappier
+#: drain, lower = steadier under jitter)
+EWMA_ALPHA = 0.3
+
+#: an observed rtt at/above this counts as fully slow (score 0.0 for
+#: that sample); rtts at/below slow_ms/4 count as fully healthy
+DEFAULT_SLOW_MS = 50.0
+
+
+def _hash64(s: str) -> int:
+    return int.from_bytes(hashlib.sha1(s.encode()).digest()[:8], "big")
+
+
+class TenantRouter:
+    """Consistent-hash tenant placement, re-weighted by live health.
+
+    ``place(tenant)`` is the pure ring answer (health-drained walk);
+    ``route(tenant)`` additionally honors failover overrides and is
+    what the client pool dials. All methods are thread-safe; the ring
+    is immutable after construction, only scores and overrides move.
+    """
+
+    def __init__(self, addresses: List[str], vnodes: int = VNODES,
+                 slow_ms: float = DEFAULT_SLOW_MS):
+        if not addresses:
+            raise ValueError("TenantRouter needs at least one address")
+        self.addresses = tuple(dict.fromkeys(addresses))  # dedup, ordered
+        self.slow_ms = slow_ms
+        ring: List[Tuple[int, str]] = []
+        for addr in self.addresses:
+            for v in range(vnodes):
+                ring.append((_hash64(f"{addr}#{v}"), addr))
+        ring.sort()
+        self._ring_keys = [k for k, _ in ring]
+        self._ring_addrs = [a for _, a in ring]
+        self._lock = threading.Lock()
+        #: ewma outcome score per address in [0, 1]; starts healthy
+        self._score: Dict[str, float] = {a: 1.0 for a in self.addresses}
+        self._dead: Dict[str, bool] = {a: False for a in self.addresses}
+        #: tenant -> forced address (set by fail_over, cleared when the
+        #: primary is trusted again)
+        self._override: Dict[str, str] = {}
+
+    # -- health ----------------------------------------------------------
+    def _strikes_for(self, address: str) -> int:
+        """Aggregate breaker strikes against an address across its
+        per-(address, tenant) targets — ``addr`` itself plus every
+        ``addr#tenant`` key (rpc/victims_wire.breaker_target)."""
+        prefix = address + "#"
+        total = 0
+        for target, strikes in SIDECAR_QUARANTINE.strike_snapshot().items():
+            if target == address or target.startswith(prefix):
+                total += strikes
+        return total
+
+    def health(self, address: str) -> float:
+        """Live health in [0, 1]: the rtt/outcome ewma decayed by the
+        breaker's aggregated strike count. 1.0 = route everything,
+        0.0 = route nothing (dead or fully struck-out)."""
+        if self._dead.get(address, True):
+            return 0.0
+        with self._lock:
+            score = self._score.get(address, 0.0)
+        return score * (STRIKE_DECAY ** self._strikes_for(address))
+
+    def _blend(self, address: str, sample: float) -> None:
+        with self._lock:
+            old = self._score.get(address, 1.0)
+            self._score[address] = ((1.0 - EWMA_ALPHA) * old
+                                    + EWMA_ALPHA * sample)
+
+    def observe(self, address: str, rtt_s: float) -> None:
+        """Feed one successful round-trip. Fast rtts pull the score to
+        1.0, rtts past ``slow_ms`` pull it toward 0 — the drain that
+        fires for a slow-but-alive peer (fleet.slowpeer) that the
+        breaker never sees."""
+        ms = rtt_s * 1000.0
+        lo, hi = self.slow_ms / 4.0, self.slow_ms
+        if ms <= lo:
+            sample = 1.0
+        elif ms >= hi:
+            sample = 0.0
+        else:
+            sample = 1.0 - (ms - lo) / (hi - lo)
+        self._blend(address, sample)
+
+    def report_ok(self, address: str) -> None:
+        self._blend(address, 1.0)
+
+    def report_failure(self, address: str) -> None:
+        self._blend(address, 0.0)
+
+    def mark_dead(self, address: str) -> None:
+        """Hard out: the supervisor saw the process die (fleet.kill).
+        The address is skipped entirely until mark_alive."""
+        self._dead[address] = True
+
+    def mark_alive(self, address: str) -> None:
+        self._dead[address] = False
+        with self._lock:
+            self._score[address] = 1.0
+
+    # -- placement -------------------------------------------------------
+    def _walk(self, tenant: str):
+        """Ring addresses in walk order from the tenant's hash point,
+        distinct, full circle."""
+        if not self._ring_keys:
+            return
+        i = bisect.bisect(self._ring_keys, _hash64(tenant))
+        seen = set()
+        n = len(self._ring_addrs)
+        for step in range(n):
+            addr = self._ring_addrs[(i + step) % n]
+            if addr not in seen:
+                seen.add(addr)
+                yield addr
+
+    def place(self, tenant: str) -> str:
+        """The ring walk with health-proportional draining: at each
+        candidate, a deterministic per-(tenant, address) draw accepts
+        the tenant with probability = health. A target at health 0.6
+        keeps ~60% of its tenants — and always the SAME 60%, so every
+        router instance drains identically and placement stays sticky
+        while the target recovers. Dead targets are skipped outright.
+        If everything is drained, falls back to the healthiest address
+        (routing somewhere beats routing nowhere)."""
+        best, best_h = None, -1.0
+        for addr in self._walk(tenant):
+            h = self.health(addr)
+            if h > best_h:
+                best, best_h = addr, h
+            if h <= 0.0:
+                metrics.count_route(addr, "dead" if self._dead.get(addr)
+                                    else "drained")
+                continue
+            draw = (_hash64(f"{tenant}@{addr}") % 10_000) / 10_000.0
+            if draw < h:
+                metrics.count_route(addr, "routed")
+                return addr
+            metrics.count_route(addr, "drained")
+        if best is None:  # pragma: no cover — empty ring is ctor-barred
+            raise RuntimeError("no addresses on the ring")
+        metrics.count_route(best, "routed")
+        return best
+
+    def standby_for(self, tenant: str) -> Optional[str]:
+        """The tenant's warm standby: the next DISTINCT address on the
+        ring after its primary's walk position — the peer replicate.py
+        streams this tenant's mirrors to. None on a one-address ring."""
+        walk = list(self._walk(tenant))
+        return walk[1] if len(walk) > 1 else None
+
+    def route(self, tenant: str) -> str:
+        """What the client dials: the failover override when one is
+        armed, else the health-drained ring placement."""
+        with self._lock:
+            forced = self._override.get(tenant)
+        if forced is not None and not self._dead.get(forced, False):
+            return forced
+        return self.place(tenant)
+
+    # -- failover --------------------------------------------------------
+    def fail_over(self, tenant: str, reason: str = "") -> Optional[str]:
+        """Re-route a tenant to its standby NOW. Returns the new
+        address (None when there is no standby to go to). Counted per
+        tenant, span-tagged, and the flight recorder dumps — a failover
+        is exactly the kind of incident the ring buffer exists for."""
+        walk = list(self._walk(tenant))
+        src = walk[0]
+        dst = next((a for a in walk[1:]
+                    if not self._dead.get(a, False)), None)
+        if dst is None or dst == src:
+            return None
+        with self._lock:
+            self._override[tenant] = dst
+        metrics.count_failover(tenant, src, dst)
+        from ..obs import flight, spans
+        with spans.span("tenant_failover", cat="host", tenant=tenant,
+                        src=src, dst=dst, reason=reason):
+            flight.maybe_dump_on_failure(f"failover:{tenant}:{reason}")
+        return dst
+
+    def clear_failover(self, tenant: str) -> None:
+        with self._lock:
+            self._override.pop(tenant, None)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            overrides = dict(self._override)
+            scores = dict(self._score)
+        return {
+            "addresses": list(self.addresses),
+            "health": {a: round(self.health(a), 4)
+                       for a in self.addresses},
+            "scores": {a: round(s, 4) for a, s in scores.items()},
+            "dead": [a for a in self.addresses if self._dead.get(a)],
+            "overrides": overrides,
+        }
+
+
+#: the process's active router (bench --fleet / sim fleet chaos install
+#: it); rpc/client.py and actions/allocate.py consult it when present
+_ACTIVE: Optional[TenantRouter] = None
+
+
+def install(router: Optional[TenantRouter]) -> Optional[TenantRouter]:
+    global _ACTIVE
+    _ACTIVE = router
+    return router
+
+
+def active() -> Optional[TenantRouter]:
+    return _ACTIVE

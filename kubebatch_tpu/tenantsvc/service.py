@@ -165,10 +165,13 @@ class TenantSolveService:
     # -- dispatch --------------------------------------------------------
     def _stash(self, item: Item) -> None:
         """Cache the tenant's latest decisions as a versioned mirror —
-        the serve-stale shed mode's source. Monotonic per tenant."""
+        the serve-stale shed mode's source. Monotonic per tenant.
+        Routed through upload_mirror (not mirrors.upload) so the
+        warm-standby replication hook sees every decisions bump — the
+        standby's serve-stale source stays as fresh as the primary's."""
         session = self.registry.get(item.tenant)
         version = session.mirrors.version("decisions") + 1
-        session.mirrors.upload("decisions", version, item.resp)
+        session.upload_mirror("decisions", version, item.resp)
 
     def _drain(self) -> None:
         from ..rpc import server as rpc_server

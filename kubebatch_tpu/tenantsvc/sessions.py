@@ -26,14 +26,46 @@ id uploaded by tenant A does not exist in tenant B's namespace at all.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..faults import Quarantine
 
 __all__ = ["MirrorStore", "StaleMirrorError", "TenantSession",
-           "TenantRegistry", "TENANT_QUARANTINE"]
+           "TenantRegistry", "TENANT_QUARANTINE", "on_mirror_upload",
+           "remove_mirror_upload_hook"]
+
+log = logging.getLogger("kubebatch.tenantsvc")
+
+#: observers notified (session, kind, version, payload) after a CLEAN
+#: mirror upload commits — the warm-standby replication plane
+#: (replicate.py) registers here. Hooks run outside the store lock and
+#: must never raise into the upload path (a broken standby stream must
+#: not fail the primary's solve).
+_UPLOAD_HOOKS: List[Callable] = []
+
+
+def on_mirror_upload(cb: Callable) -> None:
+    if cb not in _UPLOAD_HOOKS:
+        _UPLOAD_HOOKS.append(cb)
+
+
+def remove_mirror_upload_hook(cb: Callable) -> None:
+    try:
+        _UPLOAD_HOOKS.remove(cb)
+    except ValueError:
+        pass
+
+
+def _notify_upload(session: "TenantSession", kind: str, version: int,
+                   payload) -> None:
+    for cb in list(_UPLOAD_HOOKS):
+        try:
+            cb(session, kind, version, payload)
+        except Exception:          # pragma: no cover — observer bug
+            log.exception("mirror upload hook failed")
 
 #: quarantine for misbehaving tenants (repeated stale/rollback uploads);
 #: same policy object semantics as the sidecar breaker — backoff-gated
@@ -104,8 +136,12 @@ class TenantSession:
     tenant's first request; victim state and mirrors live here so there
     is no shared namespace to bleed across."""
 
-    def __init__(self, tenant: str):
+    def __init__(self, tenant: str, origin: str = ""):
         self.tenant = tenant
+        #: the sidecar address this session lives on ("" for a
+        #: standalone registry) — the replication plane uses it to tell
+        #: a primary's upload from the standby copy it just applied
+        self.origin = origin
         self.created = time.monotonic()
         self.mirrors = MirrorStore()
         #: per-tenant victim registry (rpc/victims_wire.VictimRegistry);
@@ -141,6 +177,7 @@ class TenantSession:
         with self._lock:
             self._stale_streak = 0
         TENANT_QUARANTINE.clear(self.tenant)
+        _notify_upload(self, kind, version, payload)
 
     def quarantined(self) -> bool:
         return TENANT_QUARANTINE.blocked(self.tenant)
@@ -156,8 +193,12 @@ class TenantRegistry:
 
     MAX_TENANTS = 64
 
-    def __init__(self, max_tenants: Optional[int] = None):
+    def __init__(self, max_tenants: Optional[int] = None,
+                 origin: str = ""):
         self.max_tenants = max_tenants or self.MAX_TENANTS
+        #: sidecar address this registry serves (replicate.attach sets
+        #: it); every session created here inherits it
+        self.origin = origin
         self._sessions: Dict[str, TenantSession] = {}
         self._lock = threading.Lock()
 
@@ -176,7 +217,8 @@ class TenantRegistry:
                     raise RegistryFullError(
                         f"tenant registry full ({self.max_tenants}); "
                         f"refusing new tenant {tenant!r}")
-                ssn = self._sessions[tenant] = TenantSession(tenant)
+                ssn = self._sessions[tenant] = TenantSession(
+                    tenant, origin=self.origin)
             return ssn
 
     def tenants(self) -> Tuple[str, ...]:

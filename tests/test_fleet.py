@@ -1,0 +1,402 @@
+"""Fleet-scale tenantsvc (ISSUE 14): health-weighted consistent-hash
+routing, warm-standby session replication and the refuse-if-lagging
+failover handshake, decorrelated-jitter quarantine schedules, the
+fleet fault seams, and the fleet chaos soak."""
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from kubebatch_tpu import faults, metrics
+from kubebatch_tpu.tenantsvc import (ReplicationLagError, ReplicationPlane,
+                                     TENANT_QUARANTINE, TenantRegistry,
+                                     TenantRouter)
+from kubebatch_tpu.tenantsvc import router as router_mod
+from kubebatch_tpu.tenantsvc.router import STRIKE_DECAY
+
+#: fixed fake fleet addresses for the pure-logic tests (no sockets)
+ADDRS = ["10.0.0.1:50061", "10.0.0.2:50061", "10.0.0.3:50061"]
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    pol = faults.backoff_policy()
+    yield
+    from kubebatch_tpu.rpc import client as rpc_client
+    faults.set_backoff_policy(pol)
+    faults.reset()
+    TENANT_QUARANTINE.reset()
+    router_mod.install(None)
+    rpc_client.set_failover_callback(None)
+    rpc_client.reset_solver_pools()
+
+
+# ---------------------------------------------------------------------
+# router: consistent hashing, health drain, failover
+# ---------------------------------------------------------------------
+
+def test_router_placement_is_deterministic_and_spread():
+    tenants = [f"t{i}" for i in range(60)]
+    r1 = TenantRouter(ADDRS)
+    first = {t: r1.place(t) for t in tenants}
+    # same router and a fresh router agree — placement is pure ring
+    # geometry, no RNG at route time
+    assert {t: r1.place(t) for t in tenants} == first
+    assert {t: TenantRouter(ADDRS).place(t) for t in tenants} == first
+    # every address attracts a non-trivial share of 60 tenants
+    by_addr = {a: sum(1 for p in first.values() if p == a) for a in ADDRS}
+    assert all(v > 0 for v in by_addr.values()), by_addr
+
+
+def test_router_adding_an_address_only_moves_its_own_tenants():
+    tenants = [f"m{i}" for i in range(60)]
+    small = TenantRouter(ADDRS[:2])
+    big = TenantRouter(ADDRS)
+    moved = [t for t in tenants if small.place(t) != big.place(t)]
+    # the consistent-hash property: every moved tenant moved TO the
+    # new address, never between the surviving two
+    assert moved, "the new address attracted nobody"
+    assert all(big.place(t) == ADDRS[2] for t in moved)
+
+
+def test_health_drain_sheds_tenants_before_any_breaker_trips():
+    """fleet.slowpeer's claim: a browning-out sidecar (slow rtts) loses
+    tenants while its breaker is still closed."""
+    router = TenantRouter(ADDRS)
+    tenants = [f"d{i}" for i in range(60)]
+    sick = router.place("d0")
+    before = sum(1 for t in tenants if router.place(t) == sick)
+    for _ in range(30):
+        router.observe(sick, 1.0)      # 1 s rtt >> slow_ms
+    assert router.health(sick) < 0.05
+    after = sum(1 for t in tenants if router.place(t) == sick)
+    assert after < before
+    # no quarantine was involved: the drain is ewma-only
+    assert not faults.SIDECAR_QUARANTINE.strike_snapshot()
+
+
+def test_breaker_strikes_decay_the_address_health():
+    from kubebatch_tpu.rpc.victims_wire import breaker_target
+
+    router = TenantRouter(ADDRS)
+    addr = ADDRS[0]
+    h0 = router.health(addr)
+    faults.SIDECAR_QUARANTINE.trip(breaker_target(addr, "s-a"))
+    h1 = router.health(addr)
+    assert h1 == pytest.approx(h0 * STRIKE_DECAY)
+    # a strike for a DIFFERENT tenant on the same address aggregates
+    faults.SIDECAR_QUARANTINE.trip(breaker_target(addr, "s-b"))
+    assert router.health(addr) == pytest.approx(h0 * STRIKE_DECAY ** 2)
+    # the other addresses are untouched
+    assert router.health(ADDRS[1]) == pytest.approx(1.0)
+
+
+def test_mark_dead_failover_and_counters():
+    router = TenantRouter(ADDRS)
+    tenant = "fo-t"
+    primary = router.route(tenant)
+    standby = router.standby_for(tenant)
+    assert standby is not None and standby != primary
+    n0 = metrics.failovers_total()
+    router.mark_dead(primary)
+    assert router.place(tenant) != primary
+    dst = router.fail_over(tenant, reason="test-kill")
+    assert dst == standby
+    assert router.route(tenant) == dst          # override holds
+    assert metrics.failovers_total() == n0 + 1
+    assert metrics.failover_counters().get(tenant, {}).get(
+        f"{primary}->{dst}") == 1
+    router.mark_alive(primary)
+    router.clear_failover(tenant)
+    assert router.route(tenant) == primary
+
+
+# ---------------------------------------------------------------------
+# replication: stream, never-apply-older, refuse-if-lagging
+# ---------------------------------------------------------------------
+
+def _fleet_plane(n=2):
+    router = TenantRouter(ADDRS[:n])
+    plane = ReplicationPlane(router)
+    regs = {}
+    for a in ADDRS[:n]:
+        regs[a] = TenantRegistry()
+        plane.attach(a, regs[a])
+    plane.start()
+    return router, plane, regs
+
+
+def test_replication_streams_uploads_and_wfq_weight():
+    router, plane, regs = _fleet_plane()
+    try:
+        tenant = "rep-t"
+        primary = router.route(tenant)
+        standby = router.standby_for(tenant)
+        ssn = regs[primary].get(tenant)
+        ssn.weight = 3.5
+        ssn.upload_mirror("decisions", 1, "d1")
+        ssn.upload_mirror("decisions", 2, "d2")
+        peer = regs[standby].get(tenant)
+        assert peer.mirrors.latest("decisions") == (2, "d2")
+        # the WFQ share survives the move (tentpole requirement)
+        assert peer.weight == 3.5
+        assert plane.handshake(tenant, standby) == {"decisions": 2}
+    finally:
+        plane.stop()
+
+
+def test_replication_never_applies_an_older_frame():
+    router, plane, regs = _fleet_plane()
+    try:
+        tenant = "old-t"
+        primary = router.route(tenant)
+        standby = router.standby_for(tenant)
+        ssn = regs[primary].get(tenant)
+        ssn.upload_mirror("decisions", 2, "new")
+        # a late/reordered stream frame arrives after the newer one:
+        # the standby's strict-advance store rejects it silently
+        plane._on_upload(ssn, "decisions", 1, "stale-replay")
+        assert regs[standby].get(tenant).mirrors.latest("decisions") \
+            == (2, "new")
+    finally:
+        plane.stop()
+
+
+def test_failover_refused_while_standby_lags_then_succeeds(monkeypatch):
+    router, plane, regs = _fleet_plane()
+    try:
+        tenant = "lag-t"
+        primary = router.route(tenant)
+        standby = router.standby_for(tenant)
+        ssn = regs[primary].get(tenant)
+        ssn.upload_mirror("decisions", 1, "d1")
+        peer = regs[standby].get(tenant)
+        # break the standby: the stream's apply fails (swallowed by the
+        # sessions hook — live traffic never sees it), so the
+        # high-water mark advances past what the standby holds
+        real_upload = peer.mirrors.upload
+        monkeypatch.setattr(peer.mirrors, "upload",
+                            lambda *a, **k: (_ for _ in ()).throw(
+                                RuntimeError("standby down")))
+        ssn.upload_mirror("decisions", 2, "d2")
+        with pytest.raises(ReplicationLagError):
+            plane.failover(tenant, reason="test")
+        # the refused failover must NOT have re-routed
+        assert router.route(tenant) == primary
+        # repair the standby; the next committed upload catches it up
+        monkeypatch.setattr(peer.mirrors, "upload", real_upload)
+        ssn.upload_mirror("decisions", 3, "d3")
+        dst = plane.failover(tenant, reason="test")
+        assert dst == standby
+        assert router.route(tenant) == standby
+    finally:
+        plane.stop()
+
+
+def test_only_the_primary_streams():
+    router, plane, regs = _fleet_plane()
+    try:
+        tenant = "dir-t"
+        primary = router.route(tenant)
+        standby = router.standby_for(tenant)
+        # an upload landing on the STANDBY's registry (a stray client)
+        # must not fan back out to the primary
+        regs[standby].get(tenant).upload_mirror("decisions", 1, "stray")
+        assert regs[primary].get(tenant).mirrors.latest("decisions") \
+            is None
+    finally:
+        plane.stop()
+
+
+# ---------------------------------------------------------------------
+# decorrelated jitter (satellite: seeded, reproducible, pinned)
+# ---------------------------------------------------------------------
+
+def test_jitter_zero_is_bit_compatible_with_the_legacy_schedule():
+    pol = faults.BackoffPolicy(cooldown=60.0, probe_backoff=2.0,
+                               max_cooldown=480.0)
+    for strikes in range(1, 7):
+        assert pol.jittered_quarantine_for(strikes, token="x") \
+            == pol.quarantine_for(strikes)
+
+
+def test_jitter_schedule_is_seeded_reproducible_and_pinned():
+    pol = faults.BackoffPolicy(cooldown=60.0, probe_backoff=2.0,
+                               max_cooldown=480.0, jitter=0.5,
+                               jitter_seed=7)
+    tok_a = "10.0.0.1:50061#tenant-3"
+    tok_b = "10.0.0.2:50061#tenant-3"
+    sched_a = [round(pol.jittered_quarantine_for(s, token=tok_a), 6)
+               for s in range(1, 6)]
+    sched_b = [round(pol.jittered_quarantine_for(s, token=tok_b), 6)
+               for s in range(1, 6)]
+    # regression pin: the exact decorrelated walk for (seed=7, token)
+    assert sched_a == [60.0, 85.984682, 162.664483, 361.733947,
+                       258.096949]
+    assert sched_b == [60.0, 104.302011, 274.12335, 480.0, 463.711018]
+    # strike 1 is always the exact base cooldown; every draw is bounded
+    for sched in (sched_a, sched_b):
+        assert sched[0] == pol.cooldown
+        assert all(pol.cooldown <= d <= pol.max_cooldown for d in sched)
+    # two breakers on different targets spread out (no lockstep herd)
+    assert sched_a != sched_b
+    # a fresh policy object with the same seed replays identically
+    pol2 = faults.BackoffPolicy(cooldown=60.0, probe_backoff=2.0,
+                                max_cooldown=480.0, jitter=0.5,
+                                jitter_seed=7)
+    assert [round(pol2.jittered_quarantine_for(s, token=tok_a), 6)
+            for s in range(1, 6)] == sched_a
+
+
+# ---------------------------------------------------------------------
+# the interleaved two-address isolation test (satellite): one address's
+# quarantine never strikes the other for the same tenant
+# ---------------------------------------------------------------------
+
+def test_partition_on_one_address_never_strikes_the_other():
+    from kubebatch_tpu.rpc.client import SolverClientPool
+    from kubebatch_tpu.rpc.server import make_server
+    from kubebatch_tpu.rpc.victims_wire import breaker_target
+    from kubebatch_tpu.sim.tenants import _tenant_requests
+    from kubebatch_tpu.tenantsvc.service import TenantSolveService
+
+    tenant = "iso-t"
+    servers = {}
+    try:
+        for _ in range(2):
+            svc = TenantSolveService(TenantRegistry())
+            server, port = make_server("127.0.0.1:0", tenant_service=svc)
+            server.start()
+            servers[f"127.0.0.1:{port}"] = server
+        addrs = list(servers)
+        router = TenantRouter(addrs)
+        router_mod.install(router)
+        pool = SolverClientPool(addrs, tenant=tenant, lane="batch",
+                                accept_stale=True, router=router)
+        req = _tenant_requests(1)[0]
+
+        # interleaved: healthy solve, partitioned solve, healthy solve
+        assert pool.solve(req).decisions is not None
+        faults.arm(faults.FaultPlan(counts={"rpc.partition": 1}))
+        try:
+            pool.solve(req)   # retries on the re-resolved target; the
+                              # draw may re-pick the struck address, in
+                              # which case the single fault re-raises
+        except faults.FaultInjected:
+            pass
+        finally:
+            faults.disarm()
+        assert pool.solve(req).decisions is not None
+
+        # exactly ONE (address, tenant) target was struck; the same
+        # tenant's leg on the other address is clean and unblocked
+        strikes = faults.SIDECAR_QUARANTINE.strike_snapshot()
+        struck_targets = [breaker_target(a, tenant) for a in addrs
+                          if breaker_target(a, tenant) in strikes]
+        assert len(struck_targets) == 1, strikes
+        struck = next(a for a in addrs
+                      if breaker_target(a, tenant) == struck_targets[0])
+        clean = next(a for a in addrs if a != struck)
+        assert strikes[breaker_target(struck, tenant)] == 1
+        assert faults.SIDECAR_QUARANTINE.blocked(
+            breaker_target(struck, tenant))
+        assert breaker_target(clean, tenant) not in strikes
+        assert not faults.SIDECAR_QUARANTINE.blocked(
+            breaker_target(clean, tenant))
+        # the strike halved the struck address's health ewma-for-ewma:
+        # its STRIKE_DECAY factor applies to it alone
+        assert router._strikes_for(struck) == 1
+        assert router._strikes_for(clean) == 0
+        pool.close()
+    finally:
+        router_mod.install(None)
+        for server in servers.values():
+            server.stop(grace=None)
+
+
+# ---------------------------------------------------------------------
+# bench sidecar probe (satellite: refuse unhealthy / version mismatch)
+# ---------------------------------------------------------------------
+
+def _probe_with_health(monkeypatch, health):
+    import bench
+    from kubebatch_tpu.rpc.server import make_server
+
+    server, port = make_server("127.0.0.1:0")
+    server.start()
+    addr = f"127.0.0.1:{port}"
+    monkeypatch.setenv("KUBEBATCH_SOLVER_ADDR", addr)
+    monkeypatch.setattr(bench, "_sidecar_health", lambda a: dict(health))
+    try:
+        used, spawned = bench.ensure_rpc_sidecar()
+        if spawned is not None:
+            spawned.stop(grace=None)
+        return addr, used, spawned
+    finally:
+        server.stop(grace=None)
+        monkeypatch.delenv("KUBEBATCH_SOLVER_ADDR", raising=False)
+
+
+def test_ensure_rpc_sidecar_reuses_a_healthy_matching_sidecar(
+        monkeypatch):
+    from kubebatch_tpu import __version__
+
+    addr, used, spawned = _probe_with_health(
+        monkeypatch, {"status": "ok", "version": __version__})
+    assert used == addr and spawned is None
+
+
+def test_ensure_rpc_sidecar_refuses_failing_and_mismatched(monkeypatch):
+    addr, used, spawned = _probe_with_health(
+        monkeypatch, {"status": "failing", "degradation_level": 3})
+    assert used != addr and spawned is not None
+    addr, used, spawned = _probe_with_health(
+        monkeypatch, {"status": "ok", "version": "0.0.0-other"})
+    assert used != addr and spawned is not None
+
+
+# ---------------------------------------------------------------------
+# seam coverage gate (satellite)
+# ---------------------------------------------------------------------
+
+def test_seam_coverage_tool_passes_and_its_self_test_can_fail():
+    tool = str(Path(__file__).resolve().parent.parent / "tools"
+               / "seam_coverage.py")
+    for args in ([], ["--self-test"]):
+        proc = subprocess.run([sys.executable, tool] + args,
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, (args, proc.stdout, proc.stderr)
+
+
+# ---------------------------------------------------------------------
+# the fleet chaos soak (tier-1 smoke + the slow acceptance soak)
+# ---------------------------------------------------------------------
+
+def test_fleet_chaos_smoke_kill_and_recover():
+    from kubebatch_tpu.sim.chaos import run_fleet_chaos
+
+    rep = run_fleet_chaos(cycles=6, seed=0, sidecars=2, tenants=2,
+                          fault_start=1)
+    assert rep.ok, rep.violations[:10]
+    assert len(rep.killed) == 1
+    assert rep.failovers >= 1
+    assert "fleet" in rep.families_injected
+    assert rep.final_ladder_level == 0
+
+
+@pytest.mark.slow
+def test_fleet_chaos_soak_200_cycles():
+    """ISSUE 14 acceptance: >=200 cycles across N sidecars with the
+    fleet seams armed — no lost/double-bound task, fairness conserved,
+    a mid-soak sidecar kill whose tenants failed over, ladder back to
+    0, zero violations."""
+    from kubebatch_tpu.sim.chaos import run_fleet_chaos
+
+    rep = run_fleet_chaos(cycles=200, seed=7, sidecars=3, tenants=3)
+    assert rep.ok, rep.violations[:10]
+    assert rep.cycles >= 200
+    assert len(rep.killed) >= 1
+    assert rep.failovers >= 1
+    assert "fleet" in rep.families_injected
+    assert rep.final_ladder_level == 0

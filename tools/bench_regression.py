@@ -11,7 +11,10 @@ them accordingly:
 - HARD-FAIL pins — candidate may not exceed baseline:
     recompiles_total, steady_recompiles, readbacks_per_decision,
     readbacks_per_cycle, readbacks_max, faults_injected,
-    cycle_failures, invariant_violations
+    cycle_failures, invariant_violations, and the fleet zero-impact
+    trio (cross_tenant_shed, cross_tenant_errors, failover_lost).
+    "fleet"-prefixed metrics must additionally carry the failover
+    blip and its stated bound, and the blip may not exceed the bound.
 - ADVISORY — reported with % delta, warn past --wall-tolerance, never
   fail: value, p50/p95/max wall-times, host_share_ms, compile totals.
 
@@ -46,7 +49,18 @@ HARD_PINS = (
     "faults_injected",
     "cycle_failures",
     "invariant_violations",
+    # fleet failover pins (ISSUE 14): the committed line carries these
+    # at 0, so any candidate regression is a cross-tenant impact or a
+    # refused failover — both hard failures
+    "cross_tenant_shed",
+    "cross_tenant_errors",
+    "failover_lost",
 )
+
+#: fields a "fleet"-prefixed metric line must carry (the blip itself is
+#: the line's value; the bound it was gated against rides with it, so
+#: the pin stays meaningful if the default bound ever moves)
+FLEET_REQUIRED = ("value", "failover_p99_blip_bound_ms")
 
 #: reported, warned past tolerance, never fatal (same-box numbers only)
 ADVISORY = (
@@ -97,6 +111,19 @@ def diff_metric(metric: str, base: dict, cand: dict,
     """Returns (failures, report_lines) for one metric pair."""
     failures: List[str] = []
     report: List[str] = []
+    if metric.startswith("fleet"):
+        for key in FLEET_REQUIRED:
+            if _num(cand, key) is None:
+                failures.append(
+                    f"{metric}: fleet line must carry numeric "
+                    f"'{key}' (failover blip + its stated bound) — "
+                    f"missing from candidate")
+        blip = _num(cand, "value")
+        bound = _num(cand, "failover_p99_blip_bound_ms")
+        if blip is not None and bound is not None and blip > bound:
+            failures.append(
+                f"{metric}: failover p99 blip {blip:g}ms exceeds the "
+                f"stated bound {bound:g}ms")
     for key in HARD_PINS:
         b = _num(base, key)
         if b is None:

@@ -65,6 +65,10 @@ $B 1200 python bench.py --config 3 --mode rpc
 # parity gate (bit-identical to dedicated runs), solves/sec at
 # capacity, p99 under 2x offered overload, recompiles pinned to 0
 $B  900 python bench.py --tenants 4
+# fleet failover (ISSUE 14): 3 in-process sidecars at saturation, one
+# killed mid-run — failover p99 blip bounded, unaffected tenants pinned
+# to zero shed/errors, decisions bit-identical to dedicated oracles
+$B  900 python bench.py --fleet 3
 # schedule-on-arrival (ISSUE 9): latency-lane arrival -> decision
 # p50/p99 through the sub-cycle under 256-pod churn (~70%-fill
 # cluster); every offered arrival must get a sub-cycle decision and

@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""Static seam-coverage check (ISSUE 14 satellite).
+
+Every fault seam registered in kubebatch_tpu/faults.py::SEAMS must be
+ARMED somewhere — crossed by a chaos arm (sim/chaos.py rate/count
+tables) or exercised by a test — or it has decayed into dead code: a
+seam nobody injects is a robustness claim nobody verifies. This check
+is static and import-free (ast on faults.py, literal scan of the arm
+surfaces), so it runs in the dryrun without loading jax or grpc.
+
+Wired into __graft_entry__ (the dryrun fails on an orphaned seam).
+``--self-test`` proves the check can actually fail: it injects a
+deliberately unarmed dummy seam and exits 0 only when the check
+correctly reports it orphaned.
+
+Exit codes: 0 = every seam armed (or self-test passed), 1 = orphaned
+seam(s) found (or self-test failed to catch the dummy).
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+FAULTS = REPO / "kubebatch_tpu" / "faults.py"
+
+#: where a seam counts as armed: the chaos soak arm tables and drivers,
+#: and the test suite
+ARM_SURFACES = [REPO / "kubebatch_tpu" / "sim" / "chaos.py"]
+TEST_GLOB = "tests/test_*.py"
+
+
+def registered_seams() -> list:
+    """The SEAMS dict's keys, read via ast — no kubebatch import."""
+    tree = ast.parse(FAULTS.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+        else:
+            continue
+        if "SEAMS" not in targets or not isinstance(node.value, ast.Dict):
+            continue
+        return [k.value for k in node.value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)]
+    raise SystemExit(f"could not find the SEAMS dict in {FAULTS}")
+
+
+def arm_corpus() -> dict:
+    """{path: text} of every surface where arming counts."""
+    paths = list(ARM_SURFACES) + sorted(REPO.glob(TEST_GLOB))
+    return {p: p.read_text() for p in paths if p.exists()}
+
+
+def find_orphans(seams: list, corpus: dict) -> dict:
+    """{seam: []} for seams armed nowhere, {seam: [paths]} coverage
+    otherwise — a seam counts as armed when its full dotted name
+    appears as a literal in any arm surface."""
+    coverage = {}
+    for seam in seams:
+        coverage[seam] = [str(p.relative_to(REPO))
+                          for p, text in corpus.items() if seam in text]
+    return coverage
+
+
+def main(argv) -> int:
+    self_test = "--self-test" in argv
+    seams = registered_seams()
+    if self_test:
+        seams = seams + ["selftest.orphan"]
+    coverage = find_orphans(seams, arm_corpus())
+    orphans = sorted(s for s, where in coverage.items() if not where)
+
+    if self_test:
+        if orphans == ["selftest.orphan"]:
+            print("seam_coverage self-test OK: the deliberately "
+                  "unarmed dummy seam was correctly reported orphaned")
+            return 0
+        print(f"seam_coverage self-test FAILED: expected exactly "
+              f"['selftest.orphan'] orphaned, got {orphans}",
+              file=sys.stderr)
+        return 1
+
+    if orphans:
+        print("orphaned fault seams (registered in faults.py but armed "
+              "by no chaos arm and no test):", file=sys.stderr)
+        for seam in orphans:
+            print(f"  {seam}", file=sys.stderr)
+        print("arm each seam in sim/chaos.py (rate/count tables) or a "
+              "tests/test_*.py, or delete it from SEAMS.",
+              file=sys.stderr)
+        return 1
+    print(f"seam coverage OK: {len(seams)} seams, every one armed "
+          f"(sim/chaos.py or tests/)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
