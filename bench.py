@@ -635,6 +635,170 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
             recompiles, span_counts, trace_roots, phase_ms, acct)
 
 
+def run_churn_ladder(config, cycles: int, mode: str,
+                     levels=(256, 1024, 4096)):
+    """Churn ladder (ISSUE 15): ONE persistent cache measured at each
+    churn level ascending. The active-set engine picks its task grain
+    from the pending count, so each level exercises one registered
+    bucket (256 / 1024 / 4096).
+
+    Warm-up traces every ladder shape before ``compilesvc.mark_warm()``:
+    two unmeasured churn cycles per level, with the activeset cadence
+    RESET at each level so the first engaged cycle is an audit cycle —
+    that traces BOTH the steady packed entry and the combined audit
+    entry at that grain. The cadence is reset again at the top of each
+    measured window, so every emitted line carries at least one
+    in-window audit cycle (p50 over >=9 cycles stays robust to it).
+
+    Returns one dict per level: wall latencies, readbacks, engines,
+    recompiles, and the ``activeset`` evidence block (engaged cycles,
+    audits, divergences, demotions, median active tasks / candidate
+    nodes off the device telemetry frame)."""
+    import gc
+
+    from kubebatch_tpu import actions, plugins  # noqa: F401
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.conf import shipped_tiers
+    from kubebatch_tpu.framework import CloseSession, OpenSession
+    from kubebatch_tpu.objects import PodPhase
+    from kubebatch_tpu.sim import baseline_cluster
+
+    tiers = shipped_tiers()
+    sim = baseline_cluster(config)
+    binds = {}
+    fresh_binds = []
+
+    class _B:
+        def bind(self, pod, hostname):
+            binds[pod.uid] = hostname
+            pod.node_name = hostname
+            fresh_binds.append(pod)
+
+        def bind_many(self, pairs):
+            for pod, hostname in pairs:
+                binds[pod.uid] = hostname
+                pod.node_name = hostname
+                fresh_binds.append(pod)
+
+        def evict(self, pod):
+            pod.deletion_timestamp = 1.0
+
+    seam = _B()
+    cache = SchedulerCache(binder=seam, evictor=seam, async_writeback=False)
+    sim.populate(cache)
+    acts = build_actions(config, mode)
+
+    def kubelet_tick():
+        for pod in fresh_binds:
+            if pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                cache.update_pod(pod, pod)
+        fresh_binds.clear()
+
+    def one_cycle():
+        ssn = OpenSession(cache, tiers)
+        for _, act in acts:
+            act.execute(ssn)
+        CloseSession(ssn)
+
+    from kubebatch_tpu.kernels import activeset as _activeset
+
+    gc.disable()
+    try:
+        # schedule the whole cluster (active set declines at full width
+        # and the warm-up rides the full hier engine)
+        for _ in range(2):
+            one_cycle()
+            kubelet_tick()
+        # trace every ladder shape, ascending; the cadence reset makes
+        # the first engaged cycle per level an audit cycle, so the
+        # combined audit entry is traced at every grain the measured
+        # window can dispatch
+        for level in levels:
+            _activeset.reset()
+            for _ in range(2):
+                kubelet_tick()
+                sim.churn_tick(cache, level)
+                one_cycle()
+        from kubebatch_tpu import compilesvc
+        from kubebatch_tpu.actions import allocate as _alloc_mod
+        from kubebatch_tpu.metrics import (activeset_audits_total,
+                                           activeset_cycles_total,
+                                           activeset_demotions_total,
+                                           activeset_divergences_total,
+                                           blocking_readbacks,
+                                           recompiles_total)
+        from kubebatch_tpu.obs import telemetry as _obs_telemetry
+        compilesvc.mark_warm()
+        out = []
+        for level in levels:
+            _activeset.reset()
+            rc0 = recompiles_total()
+            ac0 = activeset_cycles_total()
+            au0 = activeset_audits_total()
+            dv0 = activeset_divergences_total()
+            dm0 = activeset_demotions_total()
+            latencies = []
+            readbacks = []
+            engines = []
+            act_tasks = []
+            act_nodes = []
+            action_seconds = {name: 0.0 for name in CONFIG_ACTIONS[config]}
+            bound = 0
+            for cycle in range(cycles):
+                before = len(binds)
+                kubelet_tick()
+                sim.churn_tick(cache, level)
+                gc.collect()
+                rb0 = blocking_readbacks()
+                t0 = time.perf_counter()
+                ssn = OpenSession(cache, tiers)
+                for name, act in acts:
+                    a0 = time.perf_counter()
+                    act.execute(ssn)
+                    action_seconds[name] += time.perf_counter() - a0
+                CloseSession(ssn)
+                dt = time.perf_counter() - t0
+                if os.environ.get("KB_BENCH_DEBUG"):
+                    print(f"ladder churn={level} cycle={cycle}: "
+                          f"{dt:.3f}s bound={len(binds) - before} "
+                          f"engine={_alloc_mod.last_cycle_engine}",
+                          file=sys.stderr)
+                latencies.append(dt)
+                bound += len(binds) - before
+                readbacks.append(blocking_readbacks() - rb0)
+                engines.append(_alloc_mod.last_cycle_engine)
+                if engines[-1] == "activeset":
+                    frame = _obs_telemetry.last_frame("activeset")
+                    if frame is not None:
+                        act_tasks.append(frame.get("act_tasks", 0))
+                        act_nodes.append(frame.get("act_nodes", 0))
+            action_ms = {name: round(1e3 * s / max(1, len(latencies)), 3)
+                         for name, s in action_seconds.items()}
+            out.append({
+                "churn_pods": level,
+                "latencies": latencies,
+                "bound": bound,
+                "readbacks": readbacks,
+                "engines": engines,
+                "action_ms": action_ms,
+                "recompiles": recompiles_total() - rc0,
+                "activeset": {
+                    "cycles": activeset_cycles_total() - ac0,
+                    "audits": activeset_audits_total() - au0,
+                    "divergences": activeset_divergences_total() - dv0,
+                    "demotions": activeset_demotions_total() - dm0,
+                    "active_tasks": int(np.median(act_tasks))
+                    if act_tasks else 0,
+                    "candidate_nodes": int(np.median(act_nodes))
+                    if act_nodes else 0,
+                },
+            })
+    finally:
+        gc.enable()
+    return out
+
+
 def run_arrival(config, cycles: int, churn_pods: int,
                 arrivals_per_cycle: int = 4) -> dict:
     """Schedule-on-arrival measurement (ISSUE 9): a steady churn regime
@@ -874,6 +1038,15 @@ def main(argv=None):
                          "fully, then churn CHURN_PODS pods per measured "
                          "cycle (whole gangs finish + arrive). Reports "
                          "metric sched_cycle_p50_ms_cfgN_steady.")
+    ap.add_argument("--churn-ladder", action="store_true",
+                    help="churn ladder (ISSUE 15): ONE persistent cache "
+                         "measured at 256/1024/4096 churn pods ascending "
+                         "— one JSON line per level, each with an "
+                         "'activeset' evidence block (engaged cycles, "
+                         "audits, divergences, demotions, active "
+                         "tasks/candidate nodes); exits 1 on any "
+                         "recompile, audit divergence, demotion, or "
+                         ">1 readback per cycle")
     ap.add_argument("--steady-skew", action="store_true",
                     help="with --steady: pin each tick's fresh gangs to "
                          "ONE queue, alternating between the extreme-"
@@ -1165,6 +1338,61 @@ def main(argv=None):
                   f"registered buckets)", file=sys.stderr)
             return 1
         return 0
+
+    if args.churn_ladder:
+        # the active-set ladder (ISSUE 15): per-level lines with hard
+        # exit-1 pins — any recompile, audit divergence, demotion, or
+        # second readback on a measured cycle fails the run AFTER the
+        # evidence lines land (the jsonl still records what happened)
+        rows = run_churn_ladder(args.config, max(args.cycles, 9),
+                                args.mode)
+        from kubebatch_tpu.metrics import compile_ms_total
+        failed = []
+        for row in rows:
+            lat = row["latencies"]
+            lvl = row["churn_pods"]
+            seconds = sum(lat)
+            p50 = float(np.percentile(lat, 50) * 1e3)
+            rb = round(float(np.mean(row["readbacks"])), 1) \
+                if row["readbacks"] else 0.0
+            line = {
+                "metric": (f"sched_cycle_p50_ms_cfg{args.config}"
+                           f"_churn{lvl}"),
+                "value": round(p50, 3),
+                "unit": "ms",
+                "p95_ms": round(float(np.percentile(lat, 95) * 1e3), 3),
+                "max_ms": round(float(np.max(lat) * 1e3), 3),
+                "churn_pods": lvl,
+                "measured_cycles": len(lat),
+                "pods_bound_per_sec": round(row["bound"] / seconds, 1)
+                if seconds else 0.0,
+                "action_ms": row["action_ms"],
+                "engines": sorted(set(row["engines"])),
+                "readbacks_per_cycle": rb,
+                "recompiles_total": row["recompiles"],
+                "activeset": row["activeset"],
+                "mode": args.mode,
+                "backend": backend,
+                "compile_ms_total": round(compile_ms_total(), 1),
+            }
+            emit(line)
+            a = row["activeset"]
+            if row["recompiles"]:
+                failed.append(f"churn {lvl}: {row['recompiles']} "
+                              f"recompiles after warm-up")
+            if a["divergences"]:
+                failed.append(f"churn {lvl}: {a['divergences']} audit "
+                              f"divergences (active set must be "
+                              f"bit-identical to full width)")
+            if a["demotions"]:
+                failed.append(f"churn {lvl}: {a['demotions']} activeset "
+                              f"demotions")
+            if rb > 1.0:
+                failed.append(f"churn {lvl}: {rb} readbacks/cycle "
+                              f"(budget is ONE)")
+        for msg in failed:
+            print(f"churn ladder: {msg}", file=sys.stderr)
+        return 1 if failed else 0
 
     rpc_addr, rpc_server = "", None
     if args.mode == "rpc":

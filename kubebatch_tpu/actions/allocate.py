@@ -104,17 +104,25 @@ class AllocateAction(Action):
     @staticmethod
     def _auto_mode(ssn: Session) -> str:
         """Size-based engine selection (the shipped default and the
-        rpc-unavailable fallback share it)."""
+        rpc-unavailable fallback share it). Keyed on the PERSISTENT
+        problem shape (the node axis) before per-cycle work: a
+        cluster-scale config keeps the same engine family across churn
+        levels, so same-config steady bench lines are comparable
+        (ISSUE 15 fixed the flap where cfg6 churn 256 measured the
+        fused engine while churn 1024 measured hier)."""
+        if len(ssn.nodes) >= AUTO_HIER_MIN_NODES:
+            # cluster-scale node axis: no flat engine (single-chip OR
+            # per-shard) materializes [T, N] inside the HBM budget —
+            # the two-level bucketed solve is the only fit, at EVERY
+            # churn level (steady cycles ride its active-set twin,
+            # kernels/activeset.py, which engages inside
+            # execute_batched)
+            return "hier"
         pending = sum(
             len(j.task_status_index.get(TaskStatus.PENDING, {}))
             for j in ssn.jobs.values())
         if pending < AUTO_BATCHED_MIN:
             return "fused"
-        if len(ssn.nodes) >= AUTO_HIER_MIN_NODES:
-            # cluster-scale node axis: no flat engine (single-chip OR
-            # per-shard) materializes [T, N] inside the HBM budget —
-            # the two-level bucketed solve is the only fit
-            return "hier"
         if len(ssn.nodes) >= AUTO_SHARDED_MIN_NODES:
             import jax
             if len(jax.devices()) > 1:
@@ -135,7 +143,7 @@ class AllocateAction(Action):
         # (cap_engine counts the demotion in engine_demotions_total)
         wanted = mode
         mode = _LADDER.cap_engine(mode)
-        if wanted == "hier" and mode == "batched" \
+        if wanted in ("hier", "activeset") and mode == "batched" \
                 and len(ssn.nodes) >= AUTO_HIER_MIN_NODES:
             # a demoted hier cycle must NOT land on the flat batched
             # engine: its [T, N] graph at this node count is exactly the
@@ -160,17 +168,28 @@ class AllocateAction(Action):
             from ..metrics import count_engine_demotion
             count_engine_demotion("rpc", "in-process")
             mode = self._auto_mode(ssn)
-        if mode in ("batched", "sharded", "hier"):
+        if mode in ("batched", "sharded", "hier", "activeset"):
             from .allocate_batched import batched_supported, execute_batched
             # execute_batched returns the engine that actually ran
-            # ("hier" / "sharded" / "batched"; the remaining degradations
-            # — sharded->batched on a 1-device host, hier->batched/
-            # sharded on an affinity cycle — are counted) or False —
-            # without consuming state — when the snapshot carries
-            # unsupported features
+            # ("activeset" / "hier" / "sharded" / "batched"; the
+            # remaining degradations — sharded->batched on a 1-device
+            # host, hier->batched/sharded on an affinity cycle — are
+            # counted) or False — without consuming state — when the
+            # snapshot carries unsupported features. The active-set
+            # steady engine engages on auto-selected hier cycles (its
+            # own gates decide per cycle) and is forced by
+            # KUBEBATCH_SOLVER=activeset for the dryrun/test harnesses.
+            # activeset= is passed only when the engine may engage, so
+            # plain batched/sharded calls keep the pre-activeset call
+            # shape (test spies wrap execute_batched with the old
+            # signature)
+            act_kw = {"activeset": True} \
+                if (mode == "activeset"
+                    or (self.mode == "auto" and mode == "hier")) else {}
             ran = batched_supported(ssn) \
-                and execute_batched(ssn, sharded=(mode == "sharded"),
-                                    hier=(mode == "hier"))
+                and execute_batched(
+                    ssn, sharded=(mode == "sharded"),
+                    hier=(mode in ("hier", "activeset")), **act_kw)
             if ran:
                 last_cycle_engine = ran
                 return

@@ -23,18 +23,25 @@ batched_supported = cycle_supported
 
 
 def execute_batched(ssn: Session, sharded: bool = False,
-                    hier: bool = False):
+                    hier: bool = False, activeset: bool = False):
     """Run the whole allocate action as a handful of round dispatches.
-    Returns the engine that actually ran ("hier" / "batched" /
-    "sharded" — truthy), or False — without consuming any state — when
-    the snapshot has features the kernels can't express (the caller
-    falls back). Affinity/port cycles run first-class on the batched
-    and sharded engines: the sharded twin partitions the affinity
-    matmuls over the mesh with a replicated carry
+    Returns the engine that actually ran ("activeset" / "hier" /
+    "batched" / "sharded" — truthy), or False — without consuming any
+    state — when the snapshot has features the kernels can't express
+    (the caller falls back). Affinity/port cycles run first-class on
+    the batched and sharded engines: the sharded twin partitions the
+    affinity matmuls over the mesh with a replicated carry
     (kernels/batched_sharded.py). The two-level engine cannot express
     the cluster-global affinity carries, so an affinity cycle demotes
     hier -> batched/sharded — counted
-    (metrics.engine_demotions_total), never silent."""
+    (metrics.engine_demotions_total), never silent.
+
+    ``activeset=True`` lets the steady active-set engine
+    (kernels/activeset.py) claim the cycle first: it solves the packed
+    churn-grain sub-problem (or the combined full-width audit on its
+    cadence) and declines — falling through to the full solve below —
+    when the cycle is cold-sized, carries inexact pairs, or the engine
+    demoted itself."""
     inputs = build_cycle_inputs(ssn, allow_affinity=True)
     if inputs is EMPTY_CYCLE:
         return "hier" if hier else ("sharded" if sharded else "batched")
@@ -45,6 +52,14 @@ def execute_batched(ssn: Session, sharded: bool = False,
     _fault_check("device.dispatch")
     if hier:
         if getattr(inputs, "affinity", None) is None:
+            if activeset:
+                from ..kernels import activeset as _activeset
+                res = _activeset.solve_cycle(inputs.device, inputs)
+                if res is not None:
+                    task_state, task_node, task_seq, _ = res
+                    replay_decisions(ssn, inputs, task_state, task_node,
+                                     task_seq)
+                    return "activeset"
             from ..kernels.hier import solve_hier
             task_state, task_node, task_seq, _ = solve_hier(
                 inputs.device, inputs)

@@ -152,7 +152,7 @@ def execute_fused(ssn: Session) -> bool:
 @_register_provider("actions.allocate_fused")
 def compile_signatures(materials):
     from ..compilesvc.registry import Signature, signature_key
-    from .allocate import AUTO_BATCHED_MIN
+    from .allocate import AUTO_BATCHED_MIN, AUTO_HIER_MIN_NODES
 
     out = []
     for regime, inputs in (("cold", materials.cold_inputs),
@@ -161,6 +161,10 @@ def compile_signatures(materials):
             continue
         if len(inputs.tasks) >= AUTO_BATCHED_MIN:
             continue    # this regime dispatches the batched engine
+        if len(inputs.device.state.names) >= AUTO_HIER_MIN_NODES:
+            continue    # auto keys on the node axis first (ISSUE 15):
+            # hier/activeset own cluster-scale configs at every churn
+            # level, so a fused graph here would never be dispatched
         if getattr(inputs, "affinity", None) is not None:
             continue    # fused never consumes the affinity vocabulary
         args, statics = prepare_fused(inputs)

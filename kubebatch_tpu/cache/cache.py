@@ -205,6 +205,11 @@ class SchedulerCache:
         #: sticky_bucket): interleaved schedulers in one process must not
         #: fight over a shared shape hold
         self.pad_sticky: Dict[str, list] = {}
+        #: the device-row active set consumed for the CURRENT cycle
+        #: (EventFold.take_active_rows via device_session) — read by the
+        #: active-set solve's telemetry/dispatch policy; never drained a
+        #: second time
+        self.last_active_rows: set = set()
         #: maintained sum of node allocatable over the cluster (drf and
         #: proportion consume it each open, drf.go:59-60); recomputed
         #: lazily after any node-shape change instead of walked per open
@@ -1143,20 +1148,28 @@ class SchedulerCache:
         arrays with dirty/touched node rows re-packed from the session's
         host truth, or a fresh build when the node set changed (or nothing
         is adoptable). The refresh set includes nodes the CURRENT session
-        already touched (e.g. reclaim evictions run before allocate)."""
+        already touched (e.g. reclaim evictions run before allocate).
+
+        The refresh rows come from ``EventFold.take_active_rows`` — the
+        ONE consuming read of the cycle's device-row active set, shared
+        with the active-set solve's dispatch policy via
+        ``last_active_rows`` (kernels/activeset.py reads the count; a
+        second drain of ``dev_refresh`` could double-count a row or drop
+        a mark that lands mid-cycle)."""
         from ..kernels.solver import DeviceSession
 
         with self._lock:
             ds = self._dev_state
             self._dev_state = None   # consumed; re-adopted at close
+            active = self.fold.take_active_rows()
+            self.last_active_rows = active
             if not self.fold.enabled or ds is None:
                 # the fresh build reflects the session snapshot — marks up
-                # to THAT point are satisfied; later marks (dev_dirty)
-                # must survive to the next snapshot
-                self.fold.dev_refresh.clear()
+                # to THAT point are satisfied (the consuming read above
+                # already drained them); later marks (dev_dirty) must
+                # survive to the next snapshot
                 return DeviceSession(ssn.nodes)
-            refresh, self.fold.dev_refresh = self.fold.dev_refresh, set()
-        refresh |= ssn.touched_nodes
+        refresh = active | ssn.touched_nodes
         if not ds.update_rows(ssn.nodes, refresh):
             return DeviceSession(ssn.nodes)
         return ds

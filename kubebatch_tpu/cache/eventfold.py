@@ -141,6 +141,21 @@ class EventFold:
             self.vic_refresh.clear()
             self.vicjob_refresh.clear()
 
+    def take_active_rows(self) -> set:
+        """CONSUME the device-row active set for the session being
+        built: the rows whose device-array state changed since the last
+        consumer (folded events migrated at snapshot time, plus rows a
+        dead session handed back). Exactly one consumer per cycle — the
+        DeviceSession refresh and the active-set solve share the one
+        returned set instead of each draining ``dev_refresh``, so a row
+        can neither be double-counted nor dropped. Marks that land
+        MID-CYCLE (after ``migrate_marks``) stay in ``dev_dirty`` — they
+        refer to truth the open session cannot see and migrate at the
+        NEXT snapshot (the regression in tests/test_activeset.py pins
+        this). Call under the cache lock."""
+        rows, self.dev_refresh = self.dev_refresh, set()
+        return rows
+
     def take_base(self):
         """Consume the adopted base for this snapshot (the objects are
         handed to the new session, which will mutate them; if the

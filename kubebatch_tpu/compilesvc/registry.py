@@ -103,6 +103,7 @@ PROVIDER_MODULES: Tuple[str, ...] = (
     "kubebatch_tpu.kernels.batched",
     "kubebatch_tpu.kernels.batched_sharded",
     "kubebatch_tpu.kernels.hier",
+    "kubebatch_tpu.kernels.activeset",
     "kubebatch_tpu.kernels.sharded",
     "kubebatch_tpu.kernels.victims",
     "kubebatch_tpu.actions.allocate_fused",

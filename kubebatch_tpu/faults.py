@@ -50,9 +50,9 @@ from .metrics import (count_engine_demotion, count_fault_injected,
 
 log = logging.getLogger("kubebatch.faults")
 
-#: the seam catalog: every named injection point, grouped into five
-#: families (device / rpc / cache / source / lease). Rates in a plan may
-#: address an exact seam, a family wildcard ("cache.*"), or "*".
+#: the seam catalog: every named injection point, grouped into families
+#: (device / rpc / cache / source / lease / fleet / solve). Rates in a
+#: plan may address an exact seam, a family wildcard ("cache.*"), or "*".
 SEAMS: Dict[str, str] = {
     "device.dispatch": "device solver dispatch (allocate visit, fused, "
                        "batched and sharded kernels)",
@@ -74,6 +74,12 @@ SEAMS: Dict[str, str] = {
                   "DEMOTES the cache to snapshot-primary full clones "
                   "for the rest of the process instead of raising; the "
                   "degradation rung, not a crash)",
+    "solve.activeset": "active-set solve dispatch (kernels/activeset.py "
+                       "— a fired seam DEMOTES the solve to the "
+                       "full-width engine for the rest of the process "
+                       "instead of raising, exactly like cache.fold: "
+                       "the rung trades the O(churn) steady cycle for "
+                       "the always-sound full solve)",
     "source.deliver": "sim event-stream delivery (sim/source.py pump)",
     "source.disconnect": "watch stream drop (cache/k8s_source.py watch "
                          "loop)",
@@ -89,7 +95,8 @@ SEAMS: Dict[str, str] = {
                       "sidecar BEFORE its breaker ever trips",
 }
 
-FAMILIES = ("device", "rpc", "cache", "source", "lease", "fleet")
+FAMILIES = ("device", "rpc", "cache", "source", "lease", "fleet",
+            "solve")
 
 
 class FaultInjected(RuntimeError):
@@ -431,8 +438,8 @@ def _notify_demotion(level: int) -> None:
 #: at or below the cap and passes through unchanged. rpc counts as a
 #: full-tier engine (its own breaker handles sidecar failure; the
 #: ladder demotes it with everything else once CYCLES start failing).
-_ENGINE_RANK = {"rpc": 0, "sharded": 0, "hier": 0, "batched": 1,
-                "native": 1, "fused": 2, "jax": 2, "host": 3}
+_ENGINE_RANK = {"rpc": 0, "sharded": 0, "hier": 0, "activeset": 0,
+                "batched": 1, "native": 1, "fused": 2, "jax": 2, "host": 3}
 
 
 class DegradationLadder:

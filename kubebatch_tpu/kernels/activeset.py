@@ -1,0 +1,822 @@
+"""Active-set allocate — the steady cycle solved as a packed sub-problem
+at churn grain, with the full-width solve demoted to a periodic audit.
+
+ROADMAP item 1 (ISSUE 15). The two-level hier engine (kernels/hier.py)
+made cfg6/cfg7 *representable* — peak memory [T, pool] instead of
+[T, N] — but its coarse pass still folds per-(task, pool) eligibility
+at [T, pool] for EVERY pool on EVERY wave: an O(T x N x R) sweep per
+wave that dominates the 904 ms cfg6 steady allocate (BENCH_DEVICE.jsonl
+round 13) even though the steady task axis is already churn-sized.
+This module is the round-12 snapshot -> audit-view demotion applied one
+layer down, to the solve itself:
+
+1. **Active set**: the steady cycle's pending tasks (the session built
+   on the folded base — EventFold's dirty rows arrive through the
+   consuming ``take_active_rows()`` API plus whatever the previous
+   cycle left pending) are packed into the smallest registered task
+   grain (``ACT_GRAINS``: 256 / 1024 / 4096 — fixed compilesvc shape
+   buckets, so churn jitter never recompiles).
+2. **Pair-level coarse pass**: tasks in one (sig, nonzero-request) pair
+   are interchangeable to ``resource_eligibility`` when every member
+   shares ``init_resreq`` bit-for-bit (a cheap host gate checks this
+   per cycle; pairs must also be exact, not octave-bucketed). The
+   per-wave pool oracle then folds eligibility over PAIRS instead of
+   tasks — O(P x N x R) with P two orders of magnitude under T — and
+   gathers back through ``task_pair``. Same ``resource_eligibility``,
+   same any-fold, same majority-pair pool score: per-task results are
+   bit-identical, so pool choice, wave order, quarantine evolution and
+   therefore **decisions** are bit-identical to the hier engine's
+   (task_seq differs only by the static round stride, compared as
+   (seq // stride, seq % stride)).
+3. **Scatter-back**: each wave's winning block folds into the
+   persistent node carry by ``dynamic_update_slice`` exactly as hier's
+   ``_merge_block`` — the device state the next cycle reads is updated
+   in place; nothing is re-derived at full width. Still ONE dispatch
+   and ONE blocking readback per cycle, with the telemetry frame
+   extended to the active-set words (act_tasks / act_nodes /
+   act_scatter / act_demoted).
+4. **Audit rung**: every ``--solve-audit-every`` N-th engaged cycle
+   dispatches the COMBINED entry — full-width hier solve and active-set
+   solve from the same initial state inside one jit — compares
+   decisions in-kernel, commits the full-width result, and returns the
+   divergence count in the frame's ``act_demoted`` word (so audit
+   cycles also cost exactly one readback). Any divergence — or a fired
+   ``solve.activeset`` fault seam — calls :func:`demote`: the engine
+   disables itself for the rest of the process and cycles fall back to
+   the always-sound full-width solve, the same demote-not-raise rung
+   as cache.fold (counted in ``activeset_demotions_total``,
+   flight-dumped when armed, chaos-armed in sim/chaos.py).
+
+Affinity / host-port cycles are not expressible here (same contract as
+hier); the action layer gates them to the flat engines first.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compilesvc import instrument as _instrument
+from ..compilesvc import register_provider as _register_provider
+from ..faults import armed as _faults_armed
+from ..faults import should_fail as _should_fail
+from ..metrics import (count_activeset_audit, count_activeset_cycle,
+                       count_activeset_demotion, count_blocking_readback)
+from ..obs import span as _span
+from .batched import (CycleArrays, RoundState, _IMAX, _PACK_BOOL, _PACK_F32,
+                      _PACK_I32, _pack_result, _rollback_stranded, _round,
+                      _stranded_jobs, resource_eligibility)
+from .fused import (ALLOC, ALLOC_OB, K_DRF_SHARE, K_GANG_READY, K_PRIORITY,
+                    K_PROP_SHARE, PIPELINE, SKIP)
+from .hier import (_block_arrays, _block_state, _merge_block, hier_allocate,
+                   hier_pool_size, prepare_hier)
+from .narrow import narrow_enabled
+from .pack import pack_inputs
+from .pack import unpack as _unpack
+from .solver import dynamic_node_score
+from .telemetry import ENGINE_ACTIVESET, F_ACT_DEMOTED, decision_frame
+from .tensorize import VEC_EPS
+
+log = logging.getLogger("kubebatch.activeset")
+
+_BIG_NEG = jnp.float32(-3.0e38)
+
+#: the registered task grains — the packed active set pads to the
+#: smallest one that fits, so every steady dispatch lands on a shape
+#: compilesvc already compiled regardless of per-cycle churn jitter
+ACT_GRAINS = (256, 1024, 4096)
+
+#: task-axis CycleInputs attributes re-sliced/padded to the grain
+#: (everything else — job/queue/sig/pair/node axes — packs unchanged)
+_TASK_AXIS = ("resreq", "init_resreq", "task_nz", "task_job", "task_rank",
+              "task_sig", "task_valid")
+
+#: the active-set float pack adds the per-pair init_resreq
+#: representatives the pair-level coarse pass screens with
+_ACT_PACK_F32 = _PACK_F32 + ("pair_init_resreq",)
+
+AUDIT_EVERY_ENV = "KUBEBATCH_SOLVE_AUDIT_EVERY"
+DEFAULT_AUDIT_EVERY = 16
+
+
+def activeset_grain(n_real: int) -> int:
+    """Smallest registered grain holding ``n_real`` active tasks; 0 when
+    the active set outgrows the largest grain (the engine declines and
+    the cycle runs full-width — cold starts land here by design)."""
+    for g in ACT_GRAINS:
+        if n_real <= g:
+            return g
+    return 0
+
+
+# ---------------------------------------------------------------------
+# engine state: audit cadence + the demotion rung (process-lifetime,
+# like cache/eventfold.py's enabled flag — restart to re-enable)
+# ---------------------------------------------------------------------
+
+_audit_every: Optional[int] = None
+_cycle_idx = 0
+_demoted = False
+
+
+def audit_every() -> int:
+    global _audit_every
+    if _audit_every is None:
+        raw = os.environ.get(AUDIT_EVERY_ENV, "").strip()
+        _audit_every = int(raw) if raw else DEFAULT_AUDIT_EVERY
+    return _audit_every
+
+
+def set_audit_every(n: int) -> None:
+    """Audit cadence: every n-th engaged cycle runs the combined
+    full-width comparison entry (0 disables audits — soak tests that
+    audit out-of-band use this). ``--solve-audit-every`` lands here."""
+    global _audit_every
+    _audit_every = max(0, int(n))
+
+
+def demoted() -> bool:
+    return _demoted
+
+
+def demote(reason: str) -> None:
+    """The ladder rung back to the full-width solve: disable the
+    active-set engine for the rest of the process. An audit divergence
+    or a fired ``solve.activeset`` seam lands here — never an exception
+    into the scheduling loop; a slower-but-sound cycle beats a wrong
+    placement. Idempotent."""
+    global _demoted
+    if _demoted:
+        return
+    _demoted = True
+    count_activeset_demotion(reason)
+    log.error("active-set solve DEMOTED to full-width (reason=%s): "
+              "steady cycles fall back to the hier engine; restart to "
+              "re-enable", reason)
+    try:
+        from ..obs import flight as _flight
+        _flight.dump(f"activeset_demotion-{reason}")
+    except Exception:             # pragma: no cover — observer bug
+        log.exception("activeset demotion flight dump failed")
+
+
+def reset() -> None:
+    """Test/bench hook: forget the demotion and restart the cadence."""
+    global _cycle_idx, _demoted
+    _cycle_idx = 0
+    _demoted = False
+
+
+# ---------------------------------------------------------------------
+# the pair-level coarse pass
+# ---------------------------------------------------------------------
+
+def _pair_coarse(state: RoundState, a: CycleArrays, pair_init, pool: int,
+                 pipe_enabled: bool, dyn_enabled: bool):
+    """hier's pool oracle folded over PAIRS instead of tasks.
+
+    ``resource_eligibility`` reads exactly two task-axis inputs —
+    ``init_resreq`` and ``task_sig`` — so substituting the per-pair
+    representatives (host-verified bit-identical to every member's row,
+    see ``_pair_init_rows``) and gathering through ``task_pair`` yields
+    the same [T, B] any-eligibility hier's ``_coarse_pass`` computes, at
+    [P, pool] peak work instead of [T, pool]. The majority-pair pool
+    score is hier's own, verbatim.
+
+    Returns (task_pool_elig [T, B] bool, pool_best [B] f32)."""
+    eps = jnp.asarray(VEC_EPS)
+    n_pad = a.node_ok.shape[0]
+    p_pad = a.pair_sig.shape[0]
+    n_pools = n_pad // pool
+
+    base = a.node_ok & (state.n_tasks < a.max_task_num)      # [N]
+
+    def one_pool(p, acc_elig):
+        off = p * pool
+        bs = _block_state(state, off, pool)
+        ba = _block_arrays(a, off, pool)
+        pa = ba._replace(init_resreq=pair_init, task_sig=ba.pair_sig)
+        elig = resource_eligibility(bs.idle, bs.releasing, bs.n_tasks,
+                                    pa, pipe_enabled, eps)   # [P, pool]
+        col = jnp.any(elig, axis=1)                          # [P]
+        return jax.lax.dynamic_update_slice(acc_elig, col[:, None], (0, p))
+
+    pair_pool_elig = jax.lax.fori_loop(
+        0, n_pools, one_pool, jnp.zeros((p_pad, n_pools), bool))
+    task_pool_elig = pair_pool_elig[jnp.maximum(a.task_pair, 0)]
+
+    # demand-majority cohort — identical to hier._coarse_pass (the
+    # per-task segment_sum is [T], not [T, N]; no need to pair-fold it)
+    engaged = (a.task_valid & (state.task_state == SKIP)
+               & state.job_alive[jnp.maximum(a.task_job, 0)]
+               & a.job_valid[jnp.maximum(a.task_job, 0)])
+    pair_demand = jax.ops.segment_sum(
+        engaged.astype(jnp.int32), a.task_pair,
+        num_segments=p_pad)
+    maj = jnp.argmax(pair_demand)
+    sc_maj = a.sig_scores[a.pair_sig[maj]].astype(jnp.float32)
+    if dyn_enabled:
+        sc_maj = sc_maj + dynamic_node_score(state.nz_req, a.pair_nz[maj],
+                                             a.allocatable_cm,
+                                             a.dyn_weights)
+    pred_maj = a.sig_pred[a.pair_sig[maj]]
+    pool_best = jnp.where(pred_maj & base, sc_maj, _BIG_NEG
+                          ).reshape(n_pools, pool).max(axis=1)
+    return task_pool_elig, pool_best
+
+
+# ---------------------------------------------------------------------
+# the wave loop — hier_allocate's exact structure with the pair-level
+# oracle, plus a scatter counter for the telemetry frame
+# ---------------------------------------------------------------------
+
+def activeset_allocate(state: RoundState, a: CycleArrays, pair_init,
+                       job_keys: Tuple[str, ...] = (K_PRIORITY,
+                                                    K_GANG_READY,
+                                                    K_DRF_SHARE),
+                       queue_keys: Tuple[str, ...] = (K_PROP_SHARE,),
+                       prop_overused: bool = True,
+                       dyn_enabled: bool = False,
+                       pipe_enabled: bool = True,
+                       max_rounds: int = 64,
+                       pool_size: int = 0,
+                       max_waves: int = 0,
+                       gang_enabled: bool = True,
+                       narrow: bool = True):
+    """The whole active-set cycle in ONE device dispatch: waves of
+    (pair coarse pass -> within-bucket round loop) at grain task width.
+    Returns hier_allocate's tuple plus ``blocks`` — the count of block
+    solves folded back into the node carry (x pool_size = node rows
+    scattered, the frame's act_scatter word)."""
+    t_pad = a.task_valid.shape[0]
+    n_pad = a.node_ok.shape[0]
+    pool = pool_size if pool_size > 0 else hier_pool_size(n_pad)
+    assert n_pad % pool == 0, (n_pad, pool)
+    n_pools = n_pad // pool
+    if max_waves <= 0:
+        max_waves = (t_pad + 8) * (n_pools + 1)
+
+    def block_rounds(st, barrays, rounds0, elig_elsewhere):
+        def cond(carry):
+            _, round_idx, progress = carry
+            return progress & (round_idx < max_rounds)
+
+        def body(carry):
+            s, round_idx, _ = carry
+            ns, progress = _round(s, barrays, round_idx, job_keys,
+                                  queue_keys, prop_overused, dyn_enabled,
+                                  pipe_enabled, seq_stride=t_pad,
+                                  narrow=narrow,
+                                  elig_elsewhere=elig_elsewhere,
+                                  pair_init=pair_init)
+            return ns, round_idx + 1, progress
+
+        init = (st, rounds0, jnp.asarray(True))
+        return jax.lax.while_loop(cond, body, init)
+
+    def waves_loop(state, rounds0, blocks0):
+        def cond(carry):
+            _, _, wave, _, has_work, _, _, _ = carry
+            return has_work & (wave < max_waves)
+
+        def body(carry):
+            st, rounds, wave, blocked, _, occ0, fill0, blocks = carry
+            task_pool_elig, pool_best = _pair_coarse(st, a, pair_init,
+                                                     pool, pipe_enabled,
+                                                     dyn_enabled)
+            pending = (a.task_valid & (st.task_state == SKIP)
+                       & st.job_alive[jnp.maximum(a.task_job, 0)]
+                       & a.job_valid[jnp.maximum(a.task_job, 0)])
+            cand_cnt = (task_pool_elig
+                        & pending[:, None]).sum(axis=0)      # [B]
+            key = jnp.where((cand_cnt > 0) & ~blocked, pool_best, -jnp.inf)
+            has_work = jnp.any(key > -jnp.inf)
+            winner = jnp.argmax(key)
+            first = wave == 0
+            occ_n = jnp.where(first,
+                              (cand_cnt > 0).sum().astype(jnp.int32), occ0)
+            fill_n = jnp.where(first, cand_cnt[winner].astype(jnp.int32),
+                               fill0)
+
+            def run_block(args):
+                st, rounds, blocked, blocks = args
+                off = (winner * pool).astype(jnp.int32)
+                elig_elsewhere = jnp.any(
+                    task_pool_elig
+                    & (jnp.arange(n_pools) != winner)[None, :], axis=1)
+                bstate = _block_state(st, off, pool)
+                barrays = _block_arrays(a, off, pool)
+                bfinal, rounds_n, _ = block_rounds(bstate, barrays, rounds,
+                                                   elig_elsewhere)
+                merged = _merge_block(st, bfinal, off, pool)
+                progressed = jnp.any(merged.task_state != st.task_state)
+                blocked_n = jnp.where(
+                    progressed, jnp.zeros_like(blocked),
+                    blocked.at[winner].set(True))
+                return merged, rounds_n, blocked_n, blocks + 1
+
+            st_out, rounds_out, blocked_out, blocks_out = jax.lax.cond(
+                has_work, run_block, lambda args: args,
+                (st, rounds, blocked, blocks))
+            return (st_out, rounds_out, wave + 1, blocked_out, has_work,
+                    occ_n, fill_n, blocks_out)
+
+        init = (state, rounds0, jnp.int32(0),
+                jnp.zeros(n_pools, bool), jnp.asarray(True),
+                jnp.int32(0), jnp.int32(0), blocks0)
+        st, rounds, _, _, _, occ, fill, blocks = jax.lax.while_loop(
+            cond, body, init)
+
+        # terminal FAIL sweep — one block round on pool 0 with
+        # elig_elsewhere = any-pool eligibility, exactly as hier's
+        task_pool_elig, _ = _pair_coarse(st, a, pair_init, pool,
+                                         pipe_enabled, dyn_enabled)
+        elig_any = jnp.any(task_pool_elig, axis=1)
+        off0 = jnp.int32(0)
+        bfinal, rounds, _ = block_rounds(
+            _block_state(st, off0, pool), _block_arrays(a, off0, pool),
+            rounds, elig_any)
+        return (_merge_block(st, bfinal, off0, pool), rounds, occ, fill,
+                blocks + 1)
+
+    final, rounds, pool_occ, bucket_fill, blocks = waves_loop(
+        state, jnp.int32(0), jnp.int32(0))
+
+    retries = jnp.int32(0)
+    stranded = jnp.int32(0)
+    if gang_enabled:
+        def epi_cond(carry):
+            s, _, k, _ = carry
+            return (k < 3) & jnp.any(_stranded_jobs(s, a))
+
+        def epi_body(carry):
+            s, rounds, k, blocks = carry
+            s, _ = _rollback_stranded(s, a, revive=True)
+            s, rounds, _, _, blocks = waves_loop(s, rounds, blocks)
+            return s, rounds, k + 1, blocks
+
+        final, rounds, retries, blocks = jax.lax.while_loop(
+            epi_cond, epi_body, (final, rounds, jnp.int32(0), blocks))
+        final, stranded_mask = _rollback_stranded(final, a, revive=False)
+        stranded = stranded_mask.sum().astype(jnp.int32)
+    return final, rounds, retries, stranded, pool_occ, bucket_fill, blocks
+
+
+# ---------------------------------------------------------------------
+# packed jit entries
+# ---------------------------------------------------------------------
+
+def _state_arrays(f, i, b):
+    """RoundState initial fields + CycleArrays from unpacked dicts —
+    the construction _hier_packed inlines, shared here by the steady
+    and the combined audit entry."""
+    t_pad = i["task_job"].shape[0]
+
+    def mk_state(idle, releasing, n_tasks, nz_req):
+        return RoundState(
+            idle=idle, releasing=releasing, n_tasks=n_tasks, nz_req=nz_req,
+            q_allocated=f["q_alloc0"], j_allocated=f["j_alloc0"],
+            alloc_cnt=i["init_allocated"], job_alive=b["job_valid"],
+            task_state=jnp.full(t_pad, SKIP, jnp.int32),
+            task_node=jnp.full(t_pad, -1, jnp.int32),
+            task_seq=jnp.full(t_pad, _IMAX, jnp.int32))
+
+    def mk_arrays(backfilled, allocatable_cm, max_task_num, node_ok):
+        return CycleArrays(
+            backfilled=backfilled, allocatable_cm=allocatable_cm,
+            max_task_num=max_task_num, node_ok=node_ok,
+            resreq=f["resreq"], init_resreq=f["init_resreq"],
+            task_nz=f["task_nz"], task_job=i["task_job"],
+            task_rank=i["task_rank"], task_sig=i["task_sig"],
+            task_pair=i["task_pair"], task_valid=b["task_valid"],
+            sig_scores=f["sig_scores"], sig_pred=b["sig_pred"],
+            pair_sig=i["pair_sig"], pair_nz=f["pair_nz"],
+            order_min_available=i["order_min_available"],
+            job_queue=i["job_queue"], job_priority=f["job_priority"],
+            job_create_rank=i["job_create_rank"], job_valid=b["job_valid"],
+            q_deserved=f["q_deserved"], q_create_rank=i["q_create_rank"],
+            cluster_total=f["cluster_total"], dyn_weights=f["dyn_weights"])
+
+    return mk_state, mk_arrays
+
+
+@partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",
+                                   "queue_keys", "prop_overused",
+                                   "dyn_enabled", "pipe_enabled",
+                                   "max_rounds", "pool_size", "max_waves",
+                                   "gang_enabled", "narrow",
+                                   "narrow_gate"))
+def _activeset_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks,
+                      nz_req, backfilled, allocatable_cm, max_task_num,
+                      node_ok, lay_f, lay_i, lay_b, job_keys, queue_keys,
+                      prop_overused, dyn_enabled, pipe_enabled, max_rounds,
+                      pool_size, max_waves=0, gang_enabled=True,
+                      narrow=True, narrow_gate=False):
+    f = _unpack(buf_f, lay_f)
+    i = _unpack(buf_i, lay_i)
+    b = _unpack(buf_b, lay_b)
+    mk_state, mk_arrays = _state_arrays(f, i, b)
+    state = mk_state(idle, releasing, n_tasks, nz_req)
+    arrays = mk_arrays(backfilled, allocatable_cm, max_task_num, node_ok)
+    grain = i["task_job"].shape[0]
+    n_pad = node_ok.shape[0]
+    pool = pool_size if pool_size > 0 else hier_pool_size(n_pad)
+    final, rounds, retries, stranded, pool_occ, bucket_fill, blocks = \
+        activeset_allocate(
+            state, arrays, f["pair_init_resreq"], job_keys=job_keys,
+            queue_keys=queue_keys, prop_overused=prop_overused,
+            dyn_enabled=dyn_enabled, pipe_enabled=pipe_enabled,
+            max_rounds=max_rounds, pool_size=pool, max_waves=max_waves,
+            gang_enabled=gang_enabled, narrow=narrow)
+    frame = decision_frame(
+        ENGINE_ACTIVESET, final.task_state, final.task_seq,
+        b["task_valid"], waves=rounds, stride=grain, narrow=narrow,
+        narrow_gate=narrow_gate, retries=retries, stranded=stranded,
+        pool_occ=pool_occ, bucket_fill=bucket_fill,
+        act_tasks=b["task_valid"].sum().astype(jnp.int32),
+        act_nodes=pool_occ * jnp.int32(pool),
+        act_scatter=blocks * jnp.int32(pool), act_demoted=0)
+    return _pack_result(final, rounds, frame)
+
+
+_activeset_packed = _instrument("activeset", "_activeset_packed",
+                                _activeset_packed)
+
+
+def _divergence(afinal: RoundState, grain: int, ffinal: RoundState,
+                t_full: int, valid):
+    """In-kernel decision comparison over the rows both solves carry
+    (``min(grain, t_full)`` — every REAL task lives below both widths;
+    rows beyond are padding, constant SKIP/-1/IMAX on both sides).
+    task_seq encodes round * stride + rank with each solve's own static
+    stride, so equality is on the (round, rank) decomposition."""
+    m = min(grain, t_full)
+    va = valid[:m]
+    sa, na, qa = (afinal.task_state[:m], afinal.task_node[:m],
+                  afinal.task_seq[:m])
+    sf, nf, qf = (ffinal.task_state[:m], ffinal.task_node[:m],
+                  ffinal.task_seq[:m])
+    div = sa != sf
+    placed = (sf == ALLOC) | (sf == ALLOC_OB) | (sf == PIPELINE)
+    both = placed & (sa == sf)
+    div |= both & (na != nf)
+    div |= both & ((qa // grain) != (qf // t_full))
+    div |= both & ((qa % grain) != (qf % t_full))
+    return (va & div).sum().astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("alay_f", "alay_i", "alay_b", "flay_f",
+                                   "flay_i", "flay_b", "job_keys",
+                                   "queue_keys", "prop_overused",
+                                   "dyn_enabled", "pipe_enabled",
+                                   "amax_rounds", "fmax_rounds",
+                                   "pool_size", "max_waves",
+                                   "gang_enabled", "narrow",
+                                   "narrow_gate"))
+def _activeset_audit_packed(abuf_f, abuf_i, abuf_b, fbuf_f, fbuf_i, fbuf_b,
+                            idle, releasing, n_tasks, nz_req, backfilled,
+                            allocatable_cm, max_task_num, node_ok,
+                            alay_f, alay_i, alay_b, flay_f, flay_i, flay_b,
+                            job_keys, queue_keys, prop_overused,
+                            dyn_enabled, pipe_enabled, amax_rounds,
+                            fmax_rounds, pool_size, max_waves=0,
+                            gang_enabled=True, narrow=True,
+                            narrow_gate=False):
+    """The audit cycle's ONE dispatch: full-width hier solve and
+    active-set solve from the same initial device state, decisions
+    compared in-kernel, the FULL-WIDTH result committed (the audit is
+    also the repair pass), divergence returned in the frame's
+    act_demoted word — so even audit cycles pay a single readback."""
+    af = _unpack(abuf_f, alay_f)
+    ai = _unpack(abuf_i, alay_i)
+    ab = _unpack(abuf_b, alay_b)
+    ff = _unpack(fbuf_f, flay_f)
+    fi = _unpack(fbuf_i, flay_i)
+    fb = _unpack(fbuf_b, flay_b)
+    amk_state, amk_arrays = _state_arrays(af, ai, ab)
+    fmk_state, fmk_arrays = _state_arrays(ff, fi, fb)
+    grain = ai["task_job"].shape[0]
+    t_full = fi["task_job"].shape[0]
+    n_pad = node_ok.shape[0]
+    pool = pool_size if pool_size > 0 else hier_pool_size(n_pad)
+
+    afinal, _, _, _, aocc, _, ablocks = activeset_allocate(
+        amk_state(idle, releasing, n_tasks, nz_req),
+        amk_arrays(backfilled, allocatable_cm, max_task_num, node_ok),
+        af["pair_init_resreq"], job_keys=job_keys, queue_keys=queue_keys,
+        prop_overused=prop_overused, dyn_enabled=dyn_enabled,
+        pipe_enabled=pipe_enabled, max_rounds=amax_rounds, pool_size=pool,
+        max_waves=max_waves, gang_enabled=gang_enabled, narrow=narrow)
+    ffinal, frounds, fretries, fstranded, focc, ffill = hier_allocate(
+        fmk_state(idle, releasing, n_tasks, nz_req),
+        fmk_arrays(backfilled, allocatable_cm, max_task_num, node_ok),
+        job_keys=job_keys, queue_keys=queue_keys,
+        prop_overused=prop_overused, dyn_enabled=dyn_enabled,
+        pipe_enabled=pipe_enabled, max_rounds=fmax_rounds, pool_size=pool,
+        max_waves=max_waves, gang_enabled=gang_enabled, narrow=narrow)
+
+    div = _divergence(afinal, grain, ffinal, t_full, ab["task_valid"])
+    frame = decision_frame(
+        ENGINE_ACTIVESET, ffinal.task_state, ffinal.task_seq,
+        fb["task_valid"], waves=frounds, stride=t_full, narrow=narrow,
+        narrow_gate=narrow_gate, retries=fretries, stranded=fstranded,
+        pool_occ=focc, bucket_fill=ffill,
+        act_tasks=ab["task_valid"].sum().astype(jnp.int32),
+        act_nodes=aocc * jnp.int32(pool),
+        act_scatter=ablocks * jnp.int32(pool), act_demoted=div)
+    return _pack_result(ffinal, frounds, frame)
+
+
+_activeset_audit_packed = _instrument("activeset",
+                                      "_activeset_audit_packed",
+                                      _activeset_audit_packed)
+
+
+# ---------------------------------------------------------------------
+# host-side prepare — the (args, statics) the entries dispatch, shared
+# by the live path and the compilesvc signature provider
+# ---------------------------------------------------------------------
+
+def _regrain(arr, grain: int):
+    arr = np.asarray(arr)
+    t = arr.shape[0]
+    if t == grain:
+        return arr
+    if t > grain:
+        # real tasks occupy rows [:n_real] (pair_terms and TaskBatch
+        # both pin this); the slice only drops padding
+        return arr[:grain]
+    pad = [(0, grain - t)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
+def _pair_init_rows(inputs, task_pair, pair_sig) -> Optional[np.ndarray]:
+    """Per-pair init_resreq representatives [P_pad, R] — or None when
+    some pair's members differ bit-for-bit, the case where the
+    pair-level screen would not equal the per-task one and the engine
+    must decline the cycle. Padding pairs keep zero rows (no task
+    gathers through them)."""
+    n_real = inputs.n_tasks_real
+    init = np.asarray(inputs.init_resreq)[:n_real]
+    p_pad = int(np.asarray(pair_sig).shape[0])
+    out = np.zeros((p_pad, init.shape[1] if init.ndim == 2 else 0),
+                   init.dtype if init.size else np.float32)
+    if n_real == 0:
+        return out
+    tp = np.asarray(task_pair)[:n_real]
+    uniq, first = np.unique(tp, return_index=True)
+    rep = init[first]
+    if not np.array_equal(rep[np.searchsorted(uniq, tp)], init):
+        return None
+    out[uniq] = rep
+    return out
+
+
+def prepare_activeset(device, inputs, grain: int = 0, max_rounds: int = 0,
+                      pool_size: int = 0):
+    """The (args, statics, grain) the steady packed entry dispatches —
+    or None when the engine declines: affinity cycle, active set over
+    the largest grain, octave-bucketed (inexact) pairs, or a pair whose
+    members' init_resreq rows differ. ``grain`` forces a specific
+    registered bucket (the provider registers all three)."""
+    if getattr(inputs, "affinity", None) is not None:
+        return None
+    n_real = inputs.n_tasks_real
+    g = grain if grain > 0 else activeset_grain(n_real)
+    if g <= 0 or n_real > g:
+        return None
+    task_pair, pair_sig, pair_nz, exact = inputs.pair_terms()
+    if not exact:
+        return None
+    pair_init = _pair_init_rows(inputs, task_pair, pair_sig)
+    if pair_init is None:
+        return None
+
+    override = {n: _regrain(getattr(inputs, n), g) for n in _TASK_AXIS}
+    override["task_pair"] = _regrain(task_pair, g)
+    override["pair_sig"] = pair_sig
+    override["pair_nz"] = pair_nz
+    override["pair_init_resreq"] = pair_init
+    buf_f, lay_f, buf_i, lay_i, buf_b, lay_b = pack_inputs(
+        lambda n: override[n] if n in override else getattr(inputs, n),
+        _ACT_PACK_F32, _PACK_I32, _PACK_BOOL)
+
+    t_full = inputs.task_valid.shape[0]
+    n_pad = int(device.node_ok.shape[0])
+    pool = pool_size if pool_size > 0 else hier_pool_size(n_pad)
+    if max_rounds <= 0:
+        max_rounds = g + 8
+    # narrow by the FULL [T, N] problem so the dtype diet — and hence
+    # the audit's bit-identity contract — matches the full-width twin
+    narrow = narrow_enabled(
+        n_pad, t_full, static_scores=inputs.sig_scores,
+        dyn_weights=(inputs.dyn_weights if inputs.dyn_enabled
+                     else None))
+    args = (buf_f, buf_i, buf_b,
+            device.idle, device.releasing, device.n_tasks, device.nz_req,
+            device.backfilled, device.allocatable_cm, device.max_task_num,
+            device.node_ok)
+    statics = dict(
+        lay_f=lay_f, lay_i=lay_i, lay_b=lay_b,
+        job_keys=inputs.job_keys, queue_keys=inputs.queue_keys,
+        prop_overused=inputs.prop_overused,
+        pipe_enabled=inputs.pipe_enabled,
+        dyn_enabled=inputs.dyn_enabled,
+        max_rounds=min(max_rounds, 4096),
+        pool_size=pool,
+        gang_enabled=inputs.gang_enabled,
+        narrow=narrow,
+        narrow_gate=(not narrow and narrow_enabled(n_pad, t_full)))
+    return args, statics, g
+
+
+def prepare_activeset_audit(device, inputs, grain: int = 0,
+                            max_rounds: int = 0, pool_size: int = 0):
+    """(args, statics, grain) for the combined audit entry: the
+    active-set plan joined with prepare_hier's full-width plan (device
+    arrays passed once, shared by both halves). None whenever the
+    steady plan is None."""
+    plan = prepare_activeset(device, inputs, grain=grain,
+                             max_rounds=max_rounds, pool_size=pool_size)
+    if plan is None:
+        return None
+    aargs, astatics, g = plan
+    fargs, fstatics = prepare_hier(device, inputs,
+                                   pool_size=astatics["pool_size"])
+    args = aargs[:3] + fargs[:3] + fargs[3:]
+    statics = dict(
+        alay_f=astatics["lay_f"], alay_i=astatics["lay_i"],
+        alay_b=astatics["lay_b"],
+        flay_f=fstatics["lay_f"], flay_i=fstatics["lay_i"],
+        flay_b=fstatics["lay_b"],
+        job_keys=fstatics["job_keys"], queue_keys=fstatics["queue_keys"],
+        prop_overused=fstatics["prop_overused"],
+        pipe_enabled=fstatics["pipe_enabled"],
+        dyn_enabled=fstatics["dyn_enabled"],
+        amax_rounds=astatics["max_rounds"],
+        fmax_rounds=fstatics["max_rounds"],
+        pool_size=fstatics["pool_size"],
+        gang_enabled=fstatics["gang_enabled"],
+        narrow=fstatics["narrow"],
+        narrow_gate=fstatics["narrow_gate"])
+    return args, statics, g
+
+
+# ---------------------------------------------------------------------
+# solve drivers — one dispatch, one blocking readback, carry committed
+# ---------------------------------------------------------------------
+
+def _read_result(packed, t: int, sp):
+    count_blocking_readback()
+    with _span("readback", cat="readback"):
+        out = np.asarray(packed)
+    task_state = out[:t]
+    task_node = out[t:2 * t]
+    task_seq = out[2 * t:3 * t]
+    rounds = out[3 * t]
+    frame = out[3 * t + 1:]
+    from ..obs import telemetry as _obs_telemetry
+    _obs_telemetry.record(frame, span=sp)
+    return task_state, task_node, task_seq, int(rounds), frame
+
+
+def _commit(device, final: RoundState) -> None:
+    device.idle = final.idle
+    device.releasing = final.releasing
+    device.n_tasks = final.n_tasks
+    device.nz_req = final.nz_req
+
+
+def solve_activeset(device, inputs, plan=None):
+    """The steady active-set cycle — CycleInputs in, (task_state,
+    task_node, task_seq, rounds) numpy out at grain width (every real
+    task row lives below the grain). None when the engine declines."""
+    if plan is None:
+        plan = prepare_activeset(device, inputs)
+    if plan is None:
+        return None
+    args, statics, g = plan
+    with _span("activeset_allocate", cat="kernel") as sp:
+        final, packed = _activeset_packed(*args, **statics)
+        task_state, task_node, task_seq, rounds, _ = _read_result(
+            packed, g, sp)
+        _commit(device, final)
+    return task_state, task_node, task_seq, rounds
+
+
+def solve_activeset_audit(device, inputs, plan=None):
+    """The combined audit cycle: decisions are the FULL-WIDTH solve's
+    (the audit doubles as the repair pass), divergence read from the
+    frame's act_demoted word. Returns (task_state, task_node, task_seq,
+    rounds, divergence) or None when the engine declines."""
+    if plan is None:
+        plan = prepare_activeset_audit(device, inputs)
+    if plan is None:
+        return None
+    args, statics, _ = plan
+    t_full = inputs.task_valid.shape[0]
+    with _span("activeset_audit", cat="kernel") as sp:
+        final, packed = _activeset_audit_packed(*args, **statics)
+        task_state, task_node, task_seq, rounds, frame = _read_result(
+            packed, t_full, sp)
+        _commit(device, final)
+    return task_state, task_node, task_seq, rounds, int(
+        frame[F_ACT_DEMOTED])
+
+
+def solve_cycle(device, inputs):
+    """The action layer's one entry point: None when the engine declines
+    (demoted, oversize active set, inexact pairs, affinity) — the caller
+    falls back to the full-width solve — else the cycle's decisions,
+    with the audit cadence, the fault seam, and the demotion rung
+    handled here."""
+    global _cycle_idx
+    if _demoted:
+        return None
+    plan = prepare_activeset(device, inputs)
+    if plan is None:
+        return None
+    if _faults_armed() and _should_fail("solve.activeset"):
+        # demote-not-raise, the cache.fold discipline: the cycle that
+        # crossed the fired seam still runs — on the sound full-width
+        # engine — and every later cycle does too
+        demote("fault")
+        return None
+    idx = _cycle_idx
+    _cycle_idx += 1
+    n = audit_every()
+    audit = n > 0 and idx % n == 0
+    count_activeset_cycle(audit)
+    if not audit:
+        return solve_activeset(device, inputs, plan=plan)
+    res = solve_activeset_audit(device, inputs)
+    if res is None:                       # pragma: no cover — plan raced
+        return None
+    task_state, task_node, task_seq, rounds, div = res
+    count_activeset_audit(div == 0)
+    if div:
+        demote("audit")
+    return task_state, task_node, task_seq, rounds
+
+
+# ---------------------------------------------------------------------
+# compilesvc signature provider — the churn-grain buckets (256 / 1024 /
+# 4096) register for hier-scale node axes so steady churn jitter always
+# lands on a compiled shape, plus the combined audit entry at the
+# materials' natural grain
+# ---------------------------------------------------------------------
+
+@_register_provider("kernels.activeset")
+def compile_signatures(materials):
+    from ..actions.allocate import AUTO_HIER_MIN_NODES
+    from ..compilesvc.registry import Signature, signature_key
+
+    out = []
+    inputs = materials.steady_inputs
+    if inputs is None or isinstance(inputs, str):
+        return out
+    if len(inputs.device.state.names) < AUTO_HIER_MIN_NODES:
+        return out      # flat engines own this node axis
+    if getattr(inputs, "affinity", None) is not None:
+        return out      # affinity gates to the flat engines
+    pipes = ((False, True)
+             if ("reclaim" in materials.actions
+                 or "preempt" in materials.actions)
+             else (bool(inputs.pipe_enabled),))
+    for g in ACT_GRAINS:
+        plan = prepare_activeset(inputs.device, inputs, grain=g)
+        if plan is None:
+            continue
+        args, base, _ = plan
+        for pipe in pipes:
+            statics = dict(base, pipe_enabled=pipe)
+            out.append(Signature(
+                engine="activeset", entry="_activeset_packed",
+                key=signature_key("_activeset_packed", args, statics),
+                lower=lambda a=args, s=statics: _activeset_packed.lower(
+                    *a, **s),
+                run=lambda a=args, s=statics: _activeset_packed(*a, **s),
+                note=(f"steady grain={g} N={inputs.device.n_padded} "
+                      f"pool={statics['pool_size']} pipe={pipe}")))
+    audit = prepare_activeset_audit(inputs.device, inputs)
+    if audit is not None:
+        args, base, g = audit
+        for pipe in pipes:
+            statics = dict(base, pipe_enabled=pipe)
+            out.append(Signature(
+                engine="activeset", entry="_activeset_audit_packed",
+                key=signature_key("_activeset_audit_packed", args,
+                                  statics),
+                lower=lambda a=args, s=statics:
+                    _activeset_audit_packed.lower(*a, **s),
+                run=lambda a=args, s=statics: _activeset_audit_packed(
+                    *a, **s),
+                note=(f"audit grain={g} "
+                      f"T={inputs.task_valid.shape[0]} "
+                      f"N={inputs.device.n_padded} pipe={pipe}")))
+    return out

@@ -437,7 +437,7 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
            job_keys: Tuple[str, ...], queue_keys: Tuple[str, ...],
            prop_overused: bool, dyn_enabled: bool,
            pipe_enabled: bool = True, seq_stride: int = 0,
-           narrow: bool = False, elig_elsewhere=None):
+           narrow: bool = False, elig_elsewhere=None, pair_init=None):
     """One allocation round.  Returns (new_state, progress).
 
     ``pipe_enabled`` is a static specialization: when the host saw no
@@ -454,7 +454,18 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     when the round runs on one node-pool BLOCK (kernels/hier.py), a task
     with no eligible node in the block but an eligible node in some
     OTHER pool must WAIT for a later wave, not fail its job; the flat
-    solve passes None and keeps the exact allocate.go drop semantics."""
+    solve passes None and keeps the exact allocate.go drop semantics.
+
+    ``pair_init`` ([P,R] f32, or None): the active-set engine's
+    exact-pair fold. When set, the caller guarantees every valid task's
+    ``init_resreq`` row is bit-identical to its pair representative
+    (host-verified, see activeset._pair_init_rows) and that no affinity
+    vocabulary is present — so ``eligible``, the score rows, and the
+    fallback argmax are row-identical within a pair, and the round
+    computes them once per PAIR ([P,N]) and gathers per task, never
+    materializing a [T,N] object. Decision-identical by construction
+    (identical rows -> identical argmax); the audit rung verifies it
+    empirically every cadence."""
     eps = jnp.asarray(VEC_EPS)
     t_pad = a.task_valid.shape[0]
     n_pad = a.node_ok.shape[0]
@@ -574,18 +585,30 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
         jnp.arange(t_pad, dtype=jnp.int32))
 
     # ---- 2. exact eligibility ------------------------------------------
-    # (the shared resource_eligibility definition; accessible/base/pred_t
+    # (the shared resource_eligibility definition; accessible/base
     # recomputed locally for the waterfall/retry — XLA CSEs the overlap)
     accessible = state.idle + a.backfilled
     base = a.node_ok & (state.n_tasks < a.max_task_num)
-    pred_t = a.sig_pred[a.task_sig]
-    eligible = resource_eligibility(state.idle, state.releasing,
-                                    state.n_tasks, a, pipe_enabled, eps)
     aff = a.node_dom is not None   # static: pytree structure
-    if aff:
-        aff_ok, could_wait = _aff_eligibility(state, a)
-        eligible = eligible & aff_ok
-    any_elig = jnp.any(eligible, axis=1)
+    pair_level = pair_init is not None  # static: active-set fast path
+    if pair_level:
+        assert not aff, "pair-level rounds exclude affinity configs"
+        # fold the task axis to pairs: eligibility reads exactly two
+        # task-axis inputs (init_resreq, task_sig), both pair-constant
+        pa = a._replace(init_resreq=pair_init, task_sig=a.pair_sig)
+        tp = jnp.maximum(a.task_pair, 0)
+        elig_p = resource_eligibility(state.idle, state.releasing,
+                                      state.n_tasks, pa, pipe_enabled,
+                                      eps)                 # [P,N]
+        any_elig = jnp.any(elig_p, axis=1)[tp]
+    else:
+        eligible = resource_eligibility(state.idle, state.releasing,
+                                        state.n_tasks, a, pipe_enabled,
+                                        eps)               # [T,N]
+        if aff:
+            aff_ok, could_wait = _aff_eligibility(state, a)
+            eligible = eligible & aff_ok
+        any_elig = jnp.any(eligible, axis=1)
 
     fail_now = participating & ~any_elig
     if aff:
@@ -671,20 +694,26 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
     slot_ok = slot < n_pad
     slot_c = jnp.minimum(slot, n_pad - 1)
     p_water = ord_sh[slot_c].astype(jnp.int32)
-    water_elig = jnp.take_along_axis(eligible, p_water[:, None],
-                                     axis=1)[:, 0] & slot_ok
-
-    sc_rows = sc[a.task_pair]                             # [T,N]
-    if aff and a.ip_weight is not None:
-        # interpod-affinity score term (nodeorder.go:305-313) against
-        # round-start counts; scored tasks leave the shared waterfall —
-        # their rows are task-specific, not cohort-wide. The term is
-        # integer-valued (floor(10*x) * weight), so the f32-accumulate /
-        # narrow-store round trip is exact.
-        ip_term, ip_scored = _ip_score(state, a)
-        sc_rows = (sc_rows.astype(jnp.float32) + ip_term).astype(sdt)
-        water_elig = water_elig & ~ip_scored
-    fb = jnp.argmax(jnp.where(eligible, sc_rows, -jnp.inf), axis=1)
+    if pair_level:
+        # two [T]-gathers from the [P,N] pair objects replace the [T,N]
+        # take_along_axis / score-row gather / row argmax — the three
+        # per-round fusions that dominated the packed solve's dispatch
+        water_elig = elig_p[tp, p_water] & slot_ok
+        fb = jnp.argmax(jnp.where(elig_p, sc, -jnp.inf), axis=1)[tp]
+    else:
+        water_elig = jnp.take_along_axis(eligible, p_water[:, None],
+                                         axis=1)[:, 0] & slot_ok
+        sc_rows = sc[a.task_pair]                         # [T,N]
+        if aff and a.ip_weight is not None:
+            # interpod-affinity score term (nodeorder.go:305-313) against
+            # round-start counts; scored tasks leave the shared waterfall
+            # — their rows are task-specific, not cohort-wide. The term
+            # is integer-valued (floor(10*x) * weight), so the
+            # f32-accumulate / narrow-store round trip is exact.
+            ip_term, ip_scored = _ip_score(state, a)
+            sc_rows = (sc_rows.astype(jnp.float32) + ip_term).astype(sdt)
+            water_elig = water_elig & ~ip_scored
+        fb = jnp.argmax(jnp.where(eligible, sc_rows, -jnp.inf), axis=1)
     proposal1 = jnp.where(water_elig, p_water, fb).astype(jnp.int32)
 
     # ---- 4. acceptance (two phases) ------------------------------------
@@ -786,13 +815,20 @@ def _round(state: RoundState, a: CycleArrays, round_idx,
             # could race a phase-1 winner in ways only the next round's
             # refreshed counts can adjudicate
             retry = retry & ~_aff_involved(state, a)
-        eligible_r = resource_eligibility(idle_c, rel_c, ntasks_c, a,
-                                          pipe_enabled, eps)
-        if aff:
-            eligible_r = eligible_r & aff_ok
-        fb_r = jnp.argmax(jnp.where(eligible_r, sc_rows, -jnp.inf),
-                          axis=1).astype(jnp.int32)
-        retry = retry & jnp.any(eligible_r, axis=1)
+        if pair_level:
+            elig_pr = resource_eligibility(idle_c, rel_c, ntasks_c, pa,
+                                           pipe_enabled, eps)  # [P,N]
+            fb_r = jnp.argmax(jnp.where(elig_pr, sc, -jnp.inf),
+                              axis=1)[tp].astype(jnp.int32)
+            retry = retry & jnp.any(elig_pr, axis=1)[tp]
+        else:
+            eligible_r = resource_eligibility(idle_c, rel_c, ntasks_c, a,
+                                              pipe_enabled, eps)
+            if aff:
+                eligible_r = eligible_r & aff_ok
+            fb_r = jnp.argmax(jnp.where(eligible_r, sc_rows, -jnp.inf),
+                              axis=1).astype(jnp.int32)
+            retry = retry & jnp.any(eligible_r, axis=1)
         accept_r, ob_r, prop_alloc_r = accept_phase(fb_r, retry, idle_c,
                                                     rel_c, ntasks_c)
         idle_c, rel_c, ntasks_c, nz_c = commit_node(

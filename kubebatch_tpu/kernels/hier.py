@@ -585,7 +585,7 @@ def solve_hier_sharded(mesh, device, inputs, max_rounds: int = 0,
 
 @_register_provider("kernels.hier")
 def compile_signatures(materials):
-    from ..actions.allocate import AUTO_BATCHED_MIN, AUTO_HIER_MIN_NODES
+    from ..actions.allocate import AUTO_HIER_MIN_NODES
     from ..compilesvc.registry import Signature, signature_key
 
     out = []
@@ -593,10 +593,12 @@ def compile_signatures(materials):
                            ("steady", materials.steady_inputs)):
         if inputs is None or isinstance(inputs, str):
             continue
-        if len(inputs.tasks) < AUTO_BATCHED_MIN:
-            continue    # this regime dispatches the fused engine
         if len(inputs.device.state.names) < AUTO_HIER_MIN_NODES:
             continue    # flat engines own this node axis
+        # no task-count floor: auto mode keys on the persistent node
+        # axis (ISSUE 15), so hier owns EVERY churn level here — the
+        # steady sub-batched-threshold shapes are the audit fallback
+        # surface behind the active-set engine
         if getattr(inputs, "affinity", None) is not None:
             continue    # affinity gates to the flat engines
         args, base = prepare_hier(inputs.device, inputs)

@@ -28,11 +28,12 @@ import numpy as np
 __all__ = ["TELEM_WIDTH", "WAVE_SLOTS", "FIELDS", "ENGINE_NAMES",
            "ENGINE_VISIT", "ENGINE_BATCHED", "ENGINE_FUSED", "ENGINE_HIER",
            "ENGINE_SHARDED", "ENGINE_HIER_SHARDED", "ENGINE_VICTIM_WAVE",
-           "ENGINE_VICTIM_VISIT", "decision_frame", "host_frame"]
+           "ENGINE_VICTIM_VISIT", "ENGINE_ACTIVESET", "decision_frame",
+           "host_frame"]
 
 #: frame width in int32 words — static per config, part of every
 #: engine's packed-output shape
-TELEM_WIDTH = 16
+TELEM_WIDTH = 20
 
 #: per-wave bound-task histogram slots (wave index clips into the last)
 WAVE_SLOTS = 4
@@ -51,12 +52,18 @@ F_NARROW = 12       # narrow dtype engaged for this dispatch (0/1)
 F_NARROW_GATE = 13  # shape wanted narrow but the exactness gate refused
 F_RETRIES = 14      # gang epilogue compaction retries taken
 F_STRANDED = 15     # gangs still stranded after the final rollback
+F_ACT_TASKS = 16    # activeset: active (pending) tasks in the packed set
+F_ACT_NODES = 17    # activeset: candidate nodes (eligible pools x pool)
+F_ACT_SCATTER = 18  # activeset: node rows scattered back (waves x pool)
+F_ACT_DEMOTED = 19  # activeset: audit divergences (nonzero = demote) /
+                    # demotion bit on host-assembled frames
 
 #: decode order — index i of the frame is FIELDS[i]
 FIELDS = ("engine", "waves", "bound", "failed", "pending", "census",
           "wave_bound0", "wave_bound1", "wave_bound2", "wave_bound3",
           "pool_occ", "bucket_fill", "narrow", "narrow_gate",
-          "retries", "stranded")
+          "retries", "stranded", "act_tasks", "act_nodes", "act_scatter",
+          "act_demoted")
 
 # engine ids ------------------------------------------------------------
 ENGINE_VISIT = 1
@@ -67,6 +74,7 @@ ENGINE_SHARDED = 5
 ENGINE_HIER_SHARDED = 6
 ENGINE_VICTIM_WAVE = 7
 ENGINE_VICTIM_VISIT = 8
+ENGINE_ACTIVESET = 9
 
 ENGINE_NAMES = {
     ENGINE_VISIT: "visit",
@@ -77,6 +85,7 @@ ENGINE_NAMES = {
     ENGINE_HIER_SHARDED: "hier_sharded",
     ENGINE_VICTIM_WAVE: "victim_wave",
     ENGINE_VICTIM_VISIT: "victim_visit",
+    ENGINE_ACTIVESET: "activeset",
 }
 
 # decision codes (solver.py/fused.py agree on these)
@@ -86,7 +95,8 @@ _SKIP, _ALLOC, _ALLOC_OB, _PIPELINE, _FAIL = 0, 1, 2, 3, 4
 def decision_frame(engine: int, task_state, task_seq, task_valid, waves,
                    stride: int, *, narrow: bool = False,
                    narrow_gate: bool = False, retries=0, stranded=0,
-                   pool_occ=0, bucket_fill=0):
+                   pool_occ=0, bucket_fill=0, act_tasks=0, act_nodes=0,
+                   act_scatter=0, act_demoted=0):
     """Build the [TELEM_WIDTH] int32 frame inside a jitted solve.
 
     ``task_state``/``task_seq``/``task_valid`` are the engine's decision
@@ -119,7 +129,8 @@ def decision_frame(engine: int, task_state, task_seq, task_valid, waves,
         wave_bound,
         jnp.stack([scal(pool_occ), scal(bucket_fill),
                    scal(1 if narrow else 0), scal(1 if narrow_gate else 0),
-                   scal(retries), scal(stranded)]),
+                   scal(retries), scal(stranded), scal(act_tasks),
+                   scal(act_nodes), scal(act_scatter), scal(act_demoted)]),
     ])
 
 

@@ -144,6 +144,22 @@ if _PROM:
         "Event-fold layer demotions back to snapshot-primary full "
         "clones (audit mismatch or injected cache.fold fault)",
         ["reason"], namespace=NAMESPACE)
+    activeset_cycle_counter = Counter(
+        "activeset_cycles_total",
+        "Steady cycles solved by the active-set engine "
+        "(kernels/activeset.py: packed churn-grain sub-problem), by kind "
+        "(steady / audit)",
+        ["kind"], namespace=NAMESPACE)
+    activeset_audit_counter = Counter(
+        "activeset_audits_total",
+        "Full-width audit solves compared against the active-set "
+        "decisions on the --solve-audit-every cadence, by result",
+        ["result"], namespace=NAMESPACE)
+    activeset_demotion_counter = Counter(
+        "activeset_demotions_total",
+        "Active-set solve demotions back to the full-width engine "
+        "(audit divergence or injected solve.activeset fault)",
+        ["reason"], namespace=NAMESPACE)
     arrival_latency = Histogram(
         "subcycle_arrival_latency_milliseconds",
         "Latency-lane pod arrival -> decision latency through the "
@@ -617,6 +633,71 @@ def fold_demotions_total() -> dict:
         return dict(_fold_demotions)
 
 
+_activeset_cycles = 0
+_activeset_audits = 0
+_activeset_divergences = 0
+_activeset_demotions: dict = {}
+
+
+def count_activeset_cycle(audit: bool) -> None:
+    """Record one cycle the active-set engine solved; ``audit=True``
+    marks the periodic cycles where the full-width solve ran alongside
+    it (still one dispatch / one readback — the combined audit entry)."""
+    global _activeset_cycles
+    with _robust_lock:
+        _activeset_cycles += 1
+    if _PROM:
+        activeset_cycle_counter.labels("audit" if audit else "steady").inc()
+
+
+def activeset_cycles_total() -> int:
+    with _robust_lock:
+        return _activeset_cycles
+
+
+def count_activeset_audit(ok: bool) -> None:
+    """Record one full-width audit comparison; ``ok=False`` means the
+    active-set decisions diverged — the engine demotes on that path."""
+    global _activeset_audits, _activeset_divergences
+    with _robust_lock:
+        _activeset_audits += 1
+        if not ok:
+            _activeset_divergences += 1
+    if _PROM:
+        activeset_audit_counter.labels("ok" if ok else "diff").inc()
+
+
+def activeset_audits_total() -> int:
+    with _robust_lock:
+        return _activeset_audits
+
+
+def activeset_divergences_total() -> int:
+    with _robust_lock:
+        return _activeset_divergences
+
+
+def count_activeset_demotion(reason: str) -> None:
+    """Record one active-set demotion back to the full-width engine
+    ("audit" = divergence caught by the audit rung, "fault" = injected
+    solve.activeset seam)."""
+    with _robust_lock:
+        _activeset_demotions[reason] = _activeset_demotions.get(reason,
+                                                                0) + 1
+    if _PROM:
+        activeset_demotion_counter.labels(reason).inc()
+
+
+def activeset_demotions_total() -> int:
+    with _robust_lock:
+        return sum(_activeset_demotions.values())
+
+
+def activeset_demotions_by_reason() -> dict:
+    with _robust_lock:
+        return dict(_activeset_demotions)
+
+
 _arrivals_observed = 0
 
 
@@ -932,6 +1013,10 @@ def counters_snapshot(include_rpc: bool = True) -> dict:
         "audit_cycles_total": audit_cycles_total(),
         "audit_failures_total": audit_failures_total(),
         "fold_demotions_total": fold_demotions_total(),
+        "activeset_cycles_total": activeset_cycles_total(),
+        "activeset_audits_total": activeset_audits_total(),
+        "activeset_divergences_total": activeset_divergences_total(),
+        "activeset_demotions_total": activeset_demotions_total(),
         "telemetry": telemetry_snapshot(),
     }
     snap["readback_accounting"] = readback_accounting()

@@ -115,6 +115,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "divergence demotes the event-fold layer to "
                         "snapshot-primary). Default: KUBEBATCH_AUDIT_"
                         "EVERY, else off")
+    p.add_argument("--solve-audit-every", type=int, default=None,
+                   metavar="N",
+                   help="active-set solve audit cadence: every Nth "
+                        "engaged steady cycle also runs the full-width "
+                        "solve in the same dispatch and compares "
+                        "decisions bit-for-bit (a divergence demotes "
+                        "the active-set engine to full-width). Default: "
+                        "KUBEBATCH_SOLVE_AUDIT_EVERY, else 16; 0 "
+                        "disables the audit")
     p.add_argument("--subcycle", action="store_true", default=None,
                    help="schedule-on-arrival: latency-lane pod arrivals "
                         "(annotation scheduling.k8s.io/kube-batch/"
@@ -250,6 +259,7 @@ def main(argv=None) -> int:
                       cycle_deadline=args.cycle_deadline,
                       explain_unschedulable=args.explain_unschedulable,
                       audit_every=args.audit_every,
+                      solve_audit_every=args.solve_audit_every,
                       subcycle=args.subcycle)
 
     stop = threading.Event()

@@ -61,6 +61,7 @@ class Scheduler:
                  cycle_deadline: Optional[float] = None,
                  explain_unschedulable: bool = False,
                  audit_every: Optional[int] = None,
+                 solve_audit_every: Optional[int] = None,
                  subcycle: Optional[bool] = None):
         self.cache = cache
         self.schedule_period = schedule_period
@@ -82,6 +83,16 @@ class Scheduler:
             env = os.environ.get("KUBEBATCH_AUDIT_EVERY", "")
             audit_every = int(env) if env else 0
         self.audit_every = int(audit_every or 0)
+        #: active-set solve audit cadence (ISSUE 15): same machinery one
+        #: layer down — every Nth ENGAGED steady cycle the solve runs
+        #: the combined full-width comparison entry; a decision
+        #: divergence demotes the active-set engine to full-width for
+        #: the rest of the process (kernels/activeset.py owns the
+        #: counter and the rung; the scheduler only sets the cadence,
+        #: which the env default already covers when the flag is None)
+        if solve_audit_every is not None:
+            from ..kernels import activeset as _activeset
+            _activeset.set_audit_every(solve_audit_every)
         #: schedule-on-arrival sub-cycle (ISSUE 9): latency-lane pod
         #: arrivals get a narrow allocate against the live device arrays
         #: instead of waiting for the period (runtime/subcycle.py)

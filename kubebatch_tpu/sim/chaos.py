@@ -77,6 +77,13 @@ DEFAULT_RATES: Dict[str, float] = {
 #: so a rate would demote on the first faulted event every run anyway
 DEFAULT_COUNTS: Dict[str, int] = {
     "cache.fold": 1,
+    # same fail-first-once discipline for the active-set demotion rung
+    # (ISSUE 15): the solve.activeset seam fires once, the engine
+    # demotes to the full-width solve, and the soak's invariant bar
+    # (zero double-binds, zero lost decisions) must still hold — the
+    # seam only engages on configs where the engine does, so arming it
+    # everywhere is free on small soaks
+    "solve.activeset": 1,
 }
 
 #: the smoke-test subset: no device/rpc seams, so the ladder never

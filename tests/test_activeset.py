@@ -1,0 +1,373 @@
+"""The active-set device solve vs the full-width two-level engine.
+
+Coverage per the ISSUE 15 satellites: steady-solve decisions
+bit-identical to ``solve_hier`` (states, nodes, and task_seq compared
+as (seq // stride, seq % stride) — the stride is each solve's own
+static task width); the combined audit entry reporting zero divergence
+while committing the full-width carry; a 50-cycle churn soak audited
+EVERY cycle across all five event kinds (add / delete / bind / evict /
+resync); the demotion rung through the ``solve.activeset`` fault seam;
+the telemetry frame's new act_* words against host oracles; the
+engine-per-(config, churn) pin that fixes the cfg6 flap; and the
+consuming ``EventFold.take_active_rows()`` contract with a mid-cycle
+fold.
+
+(Reuses the 24-node harness from test_zscale_hier; sorts with the
+zscale modules on purpose.)
+"""
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, faults, metrics, plugins  # noqa: F401
+from kubebatch_tpu.actions.allocate import AllocateAction
+from kubebatch_tpu.actions.cycle_inputs import build_cycle_inputs
+from kubebatch_tpu.api import TaskStatus
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.cache.eventfold import EventFold
+from kubebatch_tpu.conf import shipped_tiers
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.kernels import activeset
+from kubebatch_tpu.kernels.hier import solve_hier
+from kubebatch_tpu.objects import PodPhase
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+from .test_zscale_hier import _build, _open
+
+_PLACED = (1, 2, 3)   # ALLOC / ALLOC_OB / PIPELINE
+
+
+@pytest.fixture(autouse=True)
+def _clean_engine_state():
+    """Every test starts and ends with the engine un-demoted, the audit
+    cadence at its default, and injection disarmed."""
+    faults.disarm()
+    activeset.reset()
+    activeset._audit_every = None
+    yield
+    faults.disarm()
+    activeset.reset()
+    activeset._audit_every = None
+
+
+def test_grain_selection():
+    assert activeset.activeset_grain(1) == 256
+    assert activeset.activeset_grain(256) == 256
+    assert activeset.activeset_grain(257) == 1024
+    assert activeset.activeset_grain(1024) == 1024
+    assert activeset.activeset_grain(1025) == 4096
+    assert activeset.activeset_grain(4096) == 4096
+    assert activeset.activeset_grain(4097) == 0   # engine declines
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed,uniform_cpu", [(0, 4000), (3, 0), (7, 0)],
+                         ids=["uniform", "hetero-s3", "hetero-s7"])
+def test_steady_solve_bitidentical_to_hier(seed, uniform_cpu):
+    """The tentpole's core contract: the packed churn-grain sub-problem
+    (pair-level coarse pass + scatter-back) must not move a single
+    decision vs the full-width two-level solve at the same pool
+    decomposition — states AND nodes bit-equal, task_seq congruent
+    under each solve's own static stride."""
+    kw = dict(n_nodes=24, n_groups=12, pods_per_group=4, seed=seed,
+              uniform_cpu=uniform_cpu)
+    ssn_a = _open(**kw)
+    ia = build_cycle_inputs(ssn_a)
+    st_h, nd_h, sq_h, _ = solve_hier(ia.device, ia, pool_size=8)
+    t = ia.task_valid.shape[0]
+
+    ssn_b = _open(**kw)
+    ib = build_cycle_inputs(ssn_b)
+    plan = activeset.prepare_activeset(ib.device, ib, pool_size=8)
+    assert plan is not None, "engine declined a supported cycle"
+    g = plan[2]
+    assert g >= t
+    st_a, nd_a, sq_a, _ = activeset.solve_activeset(ib.device, ib,
+                                                    plan=plan)
+    np.testing.assert_array_equal(st_h, st_a[:t])
+    np.testing.assert_array_equal(nd_h, nd_a[:t])
+    assert (st_a[t:] == 0).all(), "padding rows must stay SKIP"
+    placed = np.isin(st_h, _PLACED)
+    assert placed.sum() > 0
+    np.testing.assert_array_equal(sq_h[placed] // t, sq_a[:t][placed] // g)
+    np.testing.assert_array_equal(sq_h[placed] % t, sq_a[:t][placed] % g)
+    # the packed sub-problem updates the SAME persistent node carry the
+    # full-width solve would have
+    np.testing.assert_allclose(np.asarray(ia.device.idle),
+                               np.asarray(ib.device.idle))
+    CloseSession(ssn_a)
+    CloseSession(ssn_b)
+
+
+def test_audit_entry_zero_divergence_commits_full_width():
+    """The combined audit dispatch: both solves from the same initial
+    state in ONE jit, divergence counted in-kernel (zero here), and the
+    FULL-WIDTH result committed — output arrays and the node carry both
+    match a plain solve_hier run."""
+    kw = dict(n_nodes=24, n_groups=12, pods_per_group=4, seed=3)
+    ssn_a = _open(**kw)
+    ia = build_cycle_inputs(ssn_a)
+    st_h, nd_h, sq_h, _ = solve_hier(ia.device, ia, pool_size=8)
+
+    ssn_b = _open(**kw)
+    ib = build_cycle_inputs(ssn_b)
+    plan = activeset.prepare_activeset_audit(ib.device, ib, pool_size=8)
+    assert plan is not None
+    st, nd, sq, _, div = activeset.solve_activeset_audit(ib.device, ib,
+                                                         plan=plan)
+    assert div == 0
+    np.testing.assert_array_equal(st_h, st)
+    np.testing.assert_array_equal(nd_h, nd)
+    np.testing.assert_array_equal(sq_h, sq)
+    np.testing.assert_allclose(np.asarray(ia.device.idle),
+                               np.asarray(ib.device.idle))
+    CloseSession(ssn_a)
+    CloseSession(ssn_b)
+
+
+class _SoakSeams:
+    def __init__(self):
+        self.fresh = []
+
+    def bind(self, pod, hostname):
+        pod.node_name = hostname
+        self.fresh.append(pod)
+
+    def bind_many(self, pairs):
+        for pod, hostname in pairs:
+            self.bind(pod, hostname)
+
+    def evict(self, pod):
+        pod.deletion_timestamp = 1.0
+
+
+@pytest.mark.slow
+def test_churn_soak_audited_every_cycle_all_event_kinds():
+    """50 live cycles on ONE persistent cache with the audit cadence
+    armed EVERY cycle and the engine forced: each cycle folds a
+    different event kind (add / delete / bind / evict / resync) into
+    the cache, then the combined entry checks the active-set decisions
+    bit-identical to the full-width solve in-kernel. Zero divergences
+    and zero demotions over the whole soak."""
+    seams = _SoakSeams()
+    cache = SchedulerCache(binder=seams, evictor=seams,
+                           async_writeback=False)
+    _build(cache, n_nodes=24, n_groups=12, pods_per_group=2, seed=5,
+           uniform_cpu=8000)
+    tiers = shipped_tiers()
+    act = AllocateAction(mode="activeset")
+    activeset.set_audit_every(1)
+
+    from kubebatch_tpu.actions import allocate as alloc_mod
+
+    def kubelet_tick():
+        for pod in seams.fresh:
+            if pod.phase == PodPhase.PENDING and pod.node_name:
+                pod.phase = PodPhase.RUNNING
+                cache.update_pod(pod, pod)
+        seams.fresh.clear()
+
+    def running_task():
+        for job in cache.jobs.values():
+            for ti in job.tasks.values():
+                if ti.status == TaskStatus.RUNNING and ti.node_name:
+                    return ti
+        return None
+
+    def bound_gang():
+        for job in cache.jobs.values():
+            tasks = list(job.tasks.values())
+            if tasks and all(t.node_name for t in tasks) \
+                    and all(t.status == TaskStatus.RUNNING
+                            for t in tasks):
+                return job
+        return None
+
+    next_gid = [100]
+
+    def add_gang():
+        g = next_gid[0]
+        next_gid[0] += 1
+        name = f"soak{g:03d}"
+        cache.add_pod_group(build_group("ns", name, 1, queue="q0",
+                                        creation_timestamp=float(g)))
+        for p in range(2):
+            cache.add_pod(build_pod(
+                "ns", f"{name}-{p}", "", PodPhase.PENDING,
+                rl(500, GiB), group=name,
+                creation_timestamp=float(g * 100 + p)))
+
+    kinds = ("add", "delete", "bind", "evict", "resync")
+    dv0 = metrics.activeset_divergences_total()
+    dm0 = metrics.activeset_demotions_total()
+    c0 = metrics.activeset_cycles_total()
+    a0 = metrics.activeset_audits_total()
+    engaged = 0
+    for cycle in range(50):
+        kind = kinds[cycle % len(kinds)]
+        add_gang()           # keeps pending work on every cycle
+        if kind == "delete":
+            job = bound_gang()
+            if job is not None:
+                for ti in list(job.tasks.values()):
+                    cache.delete_pod(ti.pod)
+                if job.pod_group is not None:
+                    cache.delete_pod_group(job.pod_group)
+        elif kind == "bind":
+            kubelet_tick()   # bound pods start Running (update events)
+        elif kind == "evict":
+            ti = running_task()
+            if ti is not None:
+                cache.evict(ti, "soak churn")
+        elif kind == "resync":
+            ti = running_task()
+            if ti is not None:
+                cache.resync_task(ti)
+                cache.process_resync_tasks()
+        ssn = OpenSession(cache, tiers)
+        act.execute(ssn)
+        CloseSession(ssn)
+        if alloc_mod.last_cycle_engine == "activeset":
+            engaged += 1
+        assert metrics.activeset_divergences_total() - dv0 == 0, (
+            f"cycle {cycle} ({kind}): active set diverged from the "
+            f"full-width solve")
+        assert metrics.activeset_demotions_total() - dm0 == 0, (
+            f"cycle {cycle} ({kind}): engine demoted")
+        assert isinstance(cache.last_active_rows, set)
+        kubelet_tick()
+    assert not activeset.demoted()
+    assert engaged >= 45, f"engine engaged only {engaged}/50 cycles"
+    # cadence 1: every engaged cycle was an audit cycle
+    assert metrics.activeset_cycles_total() - c0 == engaged
+    assert metrics.activeset_audits_total() - a0 == engaged
+
+
+@pytest.mark.slow
+def test_fault_seam_demotes_for_rest_of_process():
+    """The demotion rung: an armed ``solve.activeset`` seam fires on
+    the next engaged cycle — that cycle still schedules (on the sound
+    full-width engine) and every later cycle declines up front, until
+    an operator reset. Counted under reason "fault"."""
+    from kubebatch_tpu.actions import allocate as alloc_mod
+
+    faults.arm(faults.FaultPlan(counts={"solve.activeset": 1}))
+    dm0 = metrics.activeset_demotions_total()
+    kw = dict(n_nodes=24, n_groups=12, pods_per_group=4, seed=3)
+    try:
+        ssn = _open(**kw)
+        AllocateAction(mode="activeset").execute(ssn)
+        # the faulted cycle fell back WITHOUT losing the cycle
+        assert alloc_mod.last_cycle_engine == "hier"
+        assert activeset.demoted()
+        assert metrics.activeset_demotions_total() - dm0 == 1
+        assert metrics.activeset_demotions_by_reason().get("fault", 0) >= 1
+        placed = sum(1 for job in ssn.jobs.values()
+                     for t in job.tasks.values()
+                     if t.status in (TaskStatus.ALLOCATED,
+                                     TaskStatus.BINDING))
+        assert placed > 0, "demoted cycle must still schedule"
+        CloseSession(ssn)
+        # seam exhausted + disarmed: still demoted (rest-of-process)
+        faults.disarm()
+        ssn = _open(**kw)
+        AllocateAction(mode="activeset").execute(ssn)
+        assert alloc_mod.last_cycle_engine == "hier"
+        assert metrics.activeset_demotions_total() - dm0 == 1
+        CloseSession(ssn)
+        # the operator rung: reset() re-engages the engine
+        activeset.reset()
+        ssn = _open(**kw)
+        AllocateAction(mode="activeset").execute(ssn)
+        assert alloc_mod.last_cycle_engine == "activeset"
+        CloseSession(ssn)
+    finally:
+        faults.disarm()
+        activeset.reset()
+
+
+def test_telemetry_act_words_match_host_oracle():
+    """The frame's four new words against host-computable oracles:
+    act_tasks = the real (unpadded) active-task count, act_nodes /
+    act_scatter = whole-pool counts from the wave loop, act_demoted =
+    0 on a steady solve and the divergence count on an audit solve."""
+    from kubebatch_tpu.kernels.telemetry import ENGINE_NAMES
+    from kubebatch_tpu.obs import telemetry as obs_telemetry
+
+    kw = dict(n_nodes=24, n_groups=12, pods_per_group=4, seed=3)
+    ssn = _open(**kw)
+    inputs = build_cycle_inputs(ssn)
+    n_real = int(np.asarray(inputs.task_valid).sum())
+    n_pad = int(inputs.device.node_ok.shape[0])
+    plan = activeset.prepare_activeset(inputs.device, inputs, pool_size=8)
+    assert plan is not None
+    activeset.solve_activeset(inputs.device, inputs, plan=plan)
+    frame = obs_telemetry.last_frame("activeset")
+    assert frame is not None
+    assert frame["engine"] == ENGINE_NAMES[
+        __import__("kubebatch_tpu.kernels.telemetry",
+                   fromlist=["ENGINE_ACTIVESET"]).ENGINE_ACTIVESET]
+    assert frame["act_tasks"] == n_real
+    pool = plan[1]["pool_size"]
+    assert frame["act_nodes"] % pool == 0
+    assert 0 < frame["act_nodes"] <= n_pad
+    assert frame["act_scatter"] % pool == 0
+    assert frame["act_scatter"] > 0
+    assert frame["act_demoted"] == 0
+    CloseSession(ssn)
+
+    ssn = _open(**kw)
+    inputs = build_cycle_inputs(ssn)
+    plan = activeset.prepare_activeset_audit(inputs.device, inputs,
+                                             pool_size=8)
+    *_, div = activeset.solve_activeset_audit(inputs.device, inputs,
+                                              plan=plan)
+    frame = obs_telemetry.last_frame("activeset")
+    assert frame["act_demoted"] == div == 0
+    CloseSession(ssn)
+
+
+def test_auto_engine_pinned_per_config_not_per_churn(monkeypatch):
+    """The cfg6 flap fix: auto mode keys on the PERSISTENT problem
+    shape (the node axis) before the per-cycle pending count, so one
+    config rides one engine family at every churn level (256-pod churn
+    used to measure fused while 1024-pod churn measured hier)."""
+    from kubebatch_tpu.actions import allocate as alloc_mod
+
+    monkeypatch.setattr(alloc_mod, "AUTO_HIER_MIN_NODES", 16)
+    # tiny churn (4 pending, far under AUTO_BATCHED_MIN): still hier
+    ssn = _open(n_nodes=24, n_groups=2, pods_per_group=2)
+    assert AllocateAction._auto_mode(ssn) == "hier"
+    CloseSession(ssn)
+    # heavier churn on the same node axis: the same engine family
+    ssn = _open(n_nodes=24, n_groups=12, pods_per_group=4)
+    assert AllocateAction._auto_mode(ssn) == "hier"
+    CloseSession(ssn)
+    # below the node threshold the pending-based split still applies
+    monkeypatch.setattr(alloc_mod, "AUTO_HIER_MIN_NODES", 16384)
+    ssn = _open(n_nodes=24, n_groups=2, pods_per_group=2)
+    assert AllocateAction._auto_mode(ssn) == "fused"
+    CloseSession(ssn)
+
+
+def test_take_active_rows_consumes_once_and_defers_midcycle_marks():
+    """The consuming-read contract (cache/eventfold.py): exactly one
+    drain of dev_refresh per snapshot, and a mark that lands MID-CYCLE
+    (after migrate_marks) stays in dev_dirty until the NEXT snapshot —
+    the open session cannot see the truth it refers to."""
+    fold = EventFold(cache=None, enabled=True)
+    fold.mark_node("n1")
+    fold.mark_node("n2")
+    fold.migrate_marks(False)
+    assert fold.take_active_rows() == {"n1", "n2"}
+    assert fold.take_active_rows() == set(), \
+        "second drain must see nothing (consuming read)"
+    fold.mark_node("n3")                     # mid-cycle fold
+    assert fold.take_active_rows() == set(), \
+        "a mid-cycle mark must NOT surface before the next snapshot"
+    fold.migrate_marks(False)
+    assert fold.take_active_rows() == {"n3"}
+    # disabled fold: marks are dropped, drains stay empty
+    off = EventFold(cache=None, enabled=False)
+    off.mark_node("n9")
+    off.migrate_marks(False)
+    assert off.take_active_rows() == set()

@@ -55,12 +55,33 @@ HARD_PINS = (
     "cross_tenant_shed",
     "cross_tenant_errors",
     "failover_lost",
+    # active-set pins (ISSUE 15, dotted paths reach the nested block):
+    # a divergence means the packed sub-problem stopped being
+    # bit-identical to the full-width solve; a demotion means the rung
+    # fired outside an armed chaos plan
+    "activeset.divergences",
+    "activeset.demotions",
 )
 
 #: fields a "fleet"-prefixed metric line must carry (the blip itself is
 #: the line's value; the bound it was gated against rides with it, so
 #: the pin stays meaningful if the default bound ever moves)
 FLEET_REQUIRED = ("value", "failover_p99_blip_bound_ms")
+
+#: fields a churn-ladder metric line (.._churnN) must carry — the
+#: active-set evidence block plus the per-cycle invariants it gates
+ACTIVESET_REQUIRED = ("value", "readbacks_per_cycle", "recompiles_total",
+                      "activeset.cycles", "activeset.audits",
+                      "activeset.divergences", "activeset.demotions")
+
+#: absolute bounds on a churn-ladder CANDIDATE line, independent of the
+#: baseline's numbers (the invariants are structural, not relative):
+#: the active set must audit clean, never demote, never recompile after
+#: warm-up, and keep the ONE-readback-per-cycle budget
+ACTIVESET_BOUNDS = (("activeset.divergences", 0.0),
+                    ("activeset.demotions", 0.0),
+                    ("recompiles_total", 0.0),
+                    ("readbacks_per_cycle", 1.0))
 
 #: reported, warned past tolerance, never fatal (same-box numbers only)
 ADVISORY = (
@@ -99,7 +120,13 @@ def load_lines(path: str) -> Dict[str, dict]:
 
 
 def _num(rec: dict, key: str) -> Optional[float]:
-    v = rec.get(key)
+    """Numeric field lookup; 'a.b' descends into a nested dict (the
+    churn-ladder lines carry their activeset evidence as a block)."""
+    v: object = rec
+    for part in key.split("."):
+        if not isinstance(v, dict):
+            return None
+        v = v.get(part)
     if isinstance(v, bool) or not isinstance(v, (int, float)):
         return None
     return float(v)
@@ -124,6 +151,19 @@ def diff_metric(metric: str, base: dict, cand: dict,
             failures.append(
                 f"{metric}: failover p99 blip {blip:g}ms exceeds the "
                 f"stated bound {bound:g}ms")
+    if "_churn" in metric:
+        for key in ACTIVESET_REQUIRED:
+            if _num(cand, key) is None:
+                failures.append(
+                    f"{metric}: churn-ladder line must carry numeric "
+                    f"'{key}' (the active-set evidence block) — "
+                    f"missing from candidate")
+        for key, bound in ACTIVESET_BOUNDS:
+            c = _num(cand, key)
+            if c is not None and c > bound + EPS:
+                failures.append(
+                    f"{metric}: {key} = {c:g} exceeds the structural "
+                    f"bound {bound:g}")
     for key in HARD_PINS:
         b = _num(base, key)
         if b is None:

@@ -30,11 +30,16 @@ $B 1800 python bench.py --config 5                      # cold + steady extra
 # two-level solve — cold line (carries the downsampled oracle check +
 # memory_peak_mb) and a steady churn line; cfg7 (100k nodes) only when
 # the operator opts in (KB_SWEEP_CFG7=1) — it needs ~4x cfg6's window.
-# Steady churn is 1024 ON PURPOSE: 256 pending sits under the batched
-# threshold and would measure the fused engine, not the two-level one
+# NOTE since ISSUE 15 auto mode keys on the node axis first: every
+# churn level at cfg6 scale rides hier/activeset, so the 256-pod rungs
+# below measure the active-set engine, never a flat one
 $B 2400 python tools/precompile.py --config 6
 $B 3600 python bench.py --config 6
 $B 3600 python bench.py --config 6 --steady 1024 --cycles 9
+# active-set churn ladder (ISSUE 15): 256/1024/4096 churn pods over ONE
+# persistent cache, one line per rung with the activeset evidence block;
+# exit 1 on any recompile, audit divergence, demotion, or 2nd readback
+$B 3600 python bench.py --config 6 --churn-ladder --cycles 9
 # buffer-assignment memory A/Bs (tools/narrow_ab.py): on the TPU
 # backend the bf16 line is the real narrowed-dtype number (the cpu
 # fallback emulates bf16 — BENCH_NOTES round 13); the flat-vs-hier
