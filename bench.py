@@ -1005,6 +1005,198 @@ def run_arrival(config, cycles: int, churn_pods: int,
     }
 
 
+def run_sustained(config, cycles: int, mode: str,
+                  churn_pods: int) -> dict:
+    """Sustained-rate A/B (ISSUE 16): the SAME steady churn regime
+    driven through a real Scheduler twice in one process — sequential
+    loop first, then the pipelined executor (runtime/pipeline.py) —
+    and reported as cycles/s + pods-bound/s at saturation instead of
+    per-cycle p50. The sequential loop's wall per cycle is
+    host_work + flight (the blocking readback pins the solve to the
+    critical path); the pipelined loop's is max(host_work, flight), so
+    the sustained rate is where the overlap shows up.
+
+    Alongside the rate: arrival -> decision p50/p99 through the cache
+    arrival hooks (a churned pod's wait from add_pod to its bind
+    write-back — under overlap a decision lands one cycle late, so
+    this is the honesty figure next to the cps win), and the
+    readback_accounting split that REPLACES the 1-readback-per-cycle
+    pin: the pipelined arm must show ZERO blocking readbacks per
+    decision (the critical-path figure) while total_readbacks_per_
+    decision still proves one transfer per solve happened — deferred,
+    off the critical path."""
+    import gc
+
+    from kubebatch_tpu import actions, compilesvc, plugins  # noqa: F401
+    from kubebatch_tpu.actions import allocate as _alloc_mod
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.metrics import (pipeline_conflicts_by_outcome,
+                                       pipeline_conflicts_total,
+                                       pipeline_cycles_total,
+                                       pipeline_demotions_total,
+                                       readback_accounting,
+                                       recompiles_total)
+    from kubebatch_tpu.objects import PodPhase
+    from kubebatch_tpu.runtime import pipeline as pipeline_mod
+    from kubebatch_tpu.runtime.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                                 Scheduler)
+    from kubebatch_tpu.sim import baseline_cluster
+
+    actions_line = ", ".join(CONFIG_ACTIONS[config])
+    conf = DEFAULT_SCHEDULER_CONF.replace(
+        'actions: "allocate, backfill"', f'actions: "{actions_line}"')
+    # both arms run the engine family the executor pipelines (the
+    # persistent-carry activeset/hier path); auto would hand small
+    # configs to the flat engines and measure nothing. Same env for
+    # both arms — the A/B stays apples-to-apples.
+    solver = mode if mode in ("hier", "activeset") else "activeset"
+    saved_solver = os.environ.get("KUBEBATCH_SOLVER")
+
+    def run_arm(pipelined: bool) -> dict:
+        sim = baseline_cluster(config)
+        binds = {}
+        fresh_binds = []
+        bind_ts = {}
+
+        class _B:
+            def bind(self, pod, hostname):
+                binds[pod.uid] = hostname
+                bind_ts[pod.uid] = time.perf_counter()
+                pod.node_name = hostname
+                fresh_binds.append(pod)
+
+            def bind_many(self, pairs):
+                now = time.perf_counter()
+                for pod, hostname in pairs:
+                    binds[pod.uid] = hostname
+                    bind_ts[pod.uid] = now
+                    pod.node_name = hostname
+                    fresh_binds.append(pod)
+
+            def evict(self, pod):
+                pod.deletion_timestamp = 1.0
+
+        seam = _B()
+        cache = SchedulerCache(binder=seam, evictor=seam,
+                               async_writeback=False)
+        sim.populate(cache)
+        arrive_ts = {}
+        measuring = [False]
+
+        def _on_arrival(pod):
+            # arrival -> decision clock starts at cache ingestion, the
+            # same instant a real informer would hand the pod over
+            if measuring[0]:
+                arrive_ts[pod.uid] = time.perf_counter()
+
+        cache.arrival_hooks.append(_on_arrival)
+        pipeline_mod.reset()
+        sched = Scheduler(cache, scheduler_conf=conf,
+                          schedule_period=3600.0, pipeline=pipelined)
+
+        def kubelet_tick():
+            for pod in fresh_binds:
+                if pod.phase == PodPhase.PENDING:
+                    pod.phase = PodPhase.RUNNING
+                    cache.update_pod(pod, pod)
+            fresh_binds.clear()
+
+        gc.disable()
+        try:
+            for _ in range(2):          # settle the initial backlog
+                sched.run_cycle()
+                kubelet_tick()
+            for _ in range(3):          # trace every steady churn shape
+                kubelet_tick()
+                sim.churn_tick(cache, churn_pods)
+                sched.run_cycle()
+                kubelet_tick()
+            compilesvc.mark_warm()
+            rc0 = recompiles_total()
+            acct0 = readback_accounting()
+            pc0 = pipeline_cycles_total()
+            cf0 = pipeline_conflicts_total()
+            dm0 = pipeline_demotions_total()
+            engines = set()
+            bound0 = len(binds)
+            measuring[0] = True
+            gc.collect()
+            t0 = time.perf_counter()
+            for _ in range(cycles):
+                kubelet_tick()
+                sim.churn_tick(cache, churn_pods)
+                sched.run_cycle()
+                engines.add(_alloc_mod.last_cycle_engine)
+                kubelet_tick()
+            if pipelined and sched._pipeline is not None:
+                # the last dispatched solve must land inside the timed
+                # window — cps is rate of COMPLETED scheduling work
+                sched._pipeline.drain()
+                kubelet_tick()
+            wall = time.perf_counter() - t0
+            measuring[0] = False
+            acct = readback_accounting(since=acct0)
+            recompiles = recompiles_total() - rc0
+        finally:
+            gc.enable()
+        lat = [bind_ts[u] - arrive_ts[u]
+               for u, t in arrive_ts.items()
+               if u in bind_ts and bind_ts[u] >= t]
+        lat_ms = np.asarray(lat) * 1e3 if lat else np.asarray([0.0])
+        return {
+            "cps": cycles / wall if wall else 0.0,
+            "pods_bound_per_sec": (len(binds) - bound0) / wall
+            if wall else 0.0,
+            "wall_s": round(wall, 3),
+            "arrival_decision_p50_ms": round(
+                float(np.percentile(lat_ms, 50)), 3),
+            "arrival_decision_p99_ms": round(
+                float(np.percentile(lat_ms, 99)), 3),
+            "arrivals_decided": len(lat),
+            "engines": sorted(engines),
+            "recompiles": recompiles,
+            "readback_accounting": acct,
+            "pipeline": {
+                "cycles": pipeline_cycles_total() - pc0,
+                "conflicts": pipeline_conflicts_total() - cf0,
+                "conflicts_by_outcome": pipeline_conflicts_by_outcome(),
+                "demotions": pipeline_demotions_total() - dm0,
+                "demoted": pipeline_mod.demoted(),
+            },
+        }
+
+    os.environ["KUBEBATCH_SOLVER"] = solver
+    try:
+        seq = run_arm(False)
+        pipe = run_arm(True)
+    finally:
+        if saved_solver is None:
+            os.environ.pop("KUBEBATCH_SOLVER", None)
+        else:
+            os.environ["KUBEBATCH_SOLVER"] = saved_solver
+    speedup = (pipe["cps"] / seq["cps"]) if seq["cps"] else 0.0
+    p_acct = pipe["readback_accounting"]
+    return {
+        "metric": f"sched_sustained_cps_cfg{config}_churn{churn_pods}",
+        "value": round(pipe["cps"], 3),
+        "unit": "cycles/s",
+        "vs_baseline": round(speedup, 4),
+        "speedup_vs_sequential": round(speedup, 4),
+        "sequential_cps": round(seq["cps"], 3),
+        "churn_pods": churn_pods,
+        "measured_cycles": cycles,
+        "sequential": seq,
+        "pipeline": pipe,
+        # the headline pins (enforced in main): overlap must not cost
+        # correctness machinery — zero recompiles, zero demotions, and
+        # the blocking-readback term GONE from the pipelined arm
+        "recompiles_total": seq["recompiles"] + pipe["recompiles"],
+        "pipeline_demotions": pipe["pipeline"]["demotions"],
+        "readbacks_per_decision": p_acct["readbacks_per_decision"],
+        "deferred_readbacks": p_acct["deferred_readbacks"],
+    }
+
+
 def main(argv=None):
     # evidence recording only for process-level runs (argv is None →
     # parsing the real command line, i.e. the driver or an operator);
@@ -1101,9 +1293,19 @@ def main(argv=None):
                          "trees as Chrome trace-event JSON (Perfetto-"
                          "loadable) to PATH and record the path on the "
                          "JSON line (trace_file)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="with --chaos: run the soak scheduler on the "
+                         "pipelined executor (runtime/pipeline.py) with "
+                         "the pipeline.conflict seam armed — the "
+                         "consume-time invalidation rung under the full "
+                         "invariant bar")
+    ap.add_argument("--sustained-churn", type=int, default=256,
+                    metavar="CHURN_PODS",
+                    help="churn pods per cycle for --mode sustained "
+                         "(default 256)")
     ap.add_argument("--mode", default="auto",
                     choices=["auto", "batched", "sharded", "hier", "fused",
-                             "jax", "host", "rpc", "arrival"],
+                             "jax", "host", "rpc", "arrival", "sustained"],
                     help="allocate engine: auto = size-based selection "
                          "(the shipped default); batched = round-based "
                          "throughput engine (policy-exact, order-"
@@ -1117,7 +1319,11 @@ def main(argv=None):
         # = 3 measured (cycle 0 pays jit and is excluded) banks the
         # scale evidence without eating a sweep window
         args.cycles = (200 if args.chaos
-                       else 4 if args.config in (6, 7) else 6)
+                       else 4 if args.config in (6, 7)
+                       # sustained: long enough that in-window arrivals
+                       # drain through the saturated backlog and get a
+                       # decision inside the measured window
+                       else 40 if args.mode == "sustained" else 6)
 
     from kubebatch_tpu import enable_persistent_compile_cache
     enable_persistent_compile_cache()
@@ -1135,7 +1341,8 @@ def main(argv=None):
         from kubebatch_tpu.sim.chaos import run_chaos
 
         rep = run_chaos(cycles=args.cycles, seed=args.chaos_seed,
-                        rpc_sidecar=True)
+                        rpc_sidecar=not args.pipeline,
+                        pipeline=args.pipeline)
         out = {
             "metric": "chaos_cycle_p50_ms",
             "value": rep.degraded_p50_ms,
@@ -1162,6 +1369,11 @@ def main(argv=None):
             "invariant_violations": len(rep.violations),
             "backend": backend,
         }
+        if args.pipeline:
+            out["metric"] = "chaos_cycle_p50_ms_pipeline"
+            out["pipeline_cycles"] = rep.pipeline_cycles
+            out["pipeline_conflicts"] = rep.pipeline_conflicts
+            out["pipeline_demoted"] = rep.pipeline_demoted
         from kubebatch_tpu.metrics import compile_ms_total, recompiles_total
         out["compile_ms_total"] = round(compile_ms_total(), 1)
         out["recompiles_total"] = recompiles_total()
@@ -1315,6 +1527,42 @@ def main(argv=None):
                           f"over the {args.fleet_blip_bound_ms}ms bound")
         for msg in failed:
             print(f"fleet bench: {msg}", file=sys.stderr)
+        return 1 if failed else 0
+
+    if args.mode == "sustained":
+        # sustained-rate A/B (ISSUE 16): sequential vs pipelined
+        # cycles/s on the same box in one process; hard exit-1 pins —
+        # any measured-window recompile, any pipeline demotion, or a
+        # blocking readback on a conflict-free pipelined window fails
+        # the run AFTER the evidence line lands
+        out = run_sustained(args.config, max(args.cycles, 9), "auto",
+                            churn_pods=args.sustained_churn)
+        out["backend"] = backend
+        from kubebatch_tpu.metrics import compile_ms_total
+        out["compile_ms_total"] = round(compile_ms_total(), 1)
+        emit(out)
+        failed = []
+        if out["recompiles_total"]:
+            failed.append(f"{out['recompiles_total']} recompiles after "
+                          f"warm-up")
+        if out["pipeline_demotions"]:
+            failed.append(f"{out['pipeline_demotions']} pipeline "
+                          f"demotion(s) mid-window")
+        p = out["pipeline"]
+        if not p["pipeline"]["cycles"]:
+            failed.append("pipelined arm never committed an overlapped "
+                          "cycle")
+        if not p["readback_accounting"]["deferred_readbacks"]:
+            failed.append("pipelined arm recorded no deferred readbacks "
+                          "— the overlap path did not run")
+        if not p["pipeline"]["conflicts"] \
+                and p["readback_accounting"]["readbacks"]:
+            failed.append(
+                f"{p['readback_accounting']['readbacks']} BLOCKING "
+                f"readbacks on a conflict-free pipelined window (the "
+                f"critical-path term must be gone)")
+        for msg in failed:
+            print(f"sustained bench: {msg}", file=sys.stderr)
         return 1 if failed else 0
 
     if args.mode == "arrival":

@@ -23,7 +23,8 @@ batched_supported = cycle_supported
 
 
 def execute_batched(ssn: Session, sharded: bool = False,
-                    hier: bool = False, activeset: bool = False):
+                    hier: bool = False, activeset: bool = False,
+                    inputs=None):
     """Run the whole allocate action as a handful of round dispatches.
     Returns the engine that actually ran ("activeset" / "hier" /
     "batched" / "sharded" — truthy), or False — without consuming any
@@ -41,8 +42,16 @@ def execute_batched(ssn: Session, sharded: bool = False,
     churn-grain sub-problem (or the combined full-width audit on its
     cadence) and declines — falling through to the full solve below —
     when the cycle is cold-sized, carries inexact pairs, or the engine
-    demoted itself."""
-    inputs = build_cycle_inputs(ssn, allow_affinity=True)
+    demoted itself.
+
+    ``inputs`` lets a caller that already tensorized this session hand
+    the result in (the pipelined executor builds inputs to decide
+    whether to dispatch async and falls back here on decline) —
+    build_cycle_inputs consumes one-shot cache state
+    (EventFold.take_active_rows), so building twice per session would
+    hand the second build an empty active set."""
+    if inputs is None:
+        inputs = build_cycle_inputs(ssn, allow_affinity=True)
     if inputs is EMPTY_CYCLE:
         return "hier" if hier else ("sharded" if sharded else "batched")
     if inputs is None:

@@ -579,6 +579,48 @@ def replay_decisions(ssn: Session, inputs: CycleInputs,
             _replay_ordered(ssn, inputs, task_state, task_node, task_seq)
 
 
+def rebase_inputs(ssn: Session, inputs: CycleInputs,
+                  task_state: np.ndarray) -> bool:
+    """Re-point ``inputs``' host-side object indexes (jobs, tasks) at
+    THIS session's clones before a deferred replay.
+
+    The pipelined executor replays cycle N's decisions into session N+1
+    — but ``build_cycle_inputs`` captured session N's job/task clones,
+    and OpenSession re-clones from cache truth, so session N+1 holds
+    DIFFERENT object instances for the same uids. Replaying through the
+    stale references would mutate orphaned objects while the live
+    session still enumerates the placed tasks as pending. Identity is
+    by uid (``ssn.jobs[job.uid]``, ``job.tasks[task.uid]`` — the same
+    lookup the ordered replay's Session mutators use).
+
+    Returns False — caller must invalidate instead of replaying — when
+    a PLACED task (or its job) no longer resolves as pending in this
+    session: the cache moved underneath the flight in a way the
+    conflict fingerprint did not catch (e.g. a delete whose job mark
+    was echo-suppressed). Non-placed rows are only ever read (FAIL fit
+    deltas), so a vanished one keeps its stale object."""
+    from ..api.types import TaskStatus
+    from ..kernels.fused import ALLOC, ALLOC_OB, PIPELINE
+
+    state = np.asarray(task_state)[:len(inputs.tasks)]
+    placed = ((state == ALLOC) | (state == ALLOC_OB)
+              | (state == PIPELINE)).tolist()
+    jobs = [ssn.jobs.get(j.uid, j) for j in inputs.jobs]
+    tasks = list(inputs.tasks)
+    pending = TaskStatus.PENDING
+    for i, t in enumerate(tasks):
+        job = ssn.jobs.get(t.job)
+        cur = None if job is None else job.tasks.get(t.uid)
+        if cur is None or (placed[i] and cur.status != pending):
+            if placed[i]:
+                return False
+            continue
+        tasks[i] = cur
+    inputs.jobs = jobs
+    inputs.tasks = tasks
+    return True
+
+
 def _bulk_replay_supported(ssn: Session) -> bool:
     from ..cache.interface import NullVolumeBinder
 

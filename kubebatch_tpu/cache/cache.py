@@ -326,8 +326,11 @@ class SchedulerCache:
 
     def _mark_node_shape(self, name: str) -> None:
         """A node's static profile (labels/taints/unschedulable/allocatable)
-        or the node set changed — static-term encodings are stale too."""
-        self._mark_node(name)
+        or the node set changed — static-term encodings are stale too.
+        ``cap=True`` keeps the mark visible to the pipelined conflict
+        check even through its own-bind echo subtraction (a capacity
+        change is never our echo)."""
+        self.fold.mark_node(name, cap=True)
         self.terms_cache = None
         self._shape_epoch += 1
         self._alloc_total = None

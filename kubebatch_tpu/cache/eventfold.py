@@ -89,6 +89,20 @@ class EventFold:
         #: PDB, or missing queue) — rebuilt by the full snapshot paths,
         #: patched at dirty jobs by the incremental path
         self.excluded_uids: set = set()
+        #: in-flight tagging (ISSUE 16): while a pipelined solve is in
+        #: flight, every mark is ALSO tagged into these sets so the
+        #: consume-time conflict check can ask "did any event since
+        #: dispatch touch an entity the in-flight decisions bind
+        #: against?". Tagging is unconditional on ``enabled`` — the
+        #: conflict check needs the marks even after a fold demotion.
+        self._flight_open = False
+        self.flight_jobs: set = set()
+        self.flight_nodes: set = set()
+        #: node marks from node.* capacity events specifically — a
+        #: capacity change invalidates decisions onto that node even
+        #: when the general node-mark echo (our own bind write-backs)
+        #: is being subtracted out
+        self.flight_caps: set = set()
 
     # ------------------------------------------------------------------
     # the fold entry point (called by every cache handler, under the
@@ -111,15 +125,44 @@ class EventFold:
             self.demote("fault")
 
     def mark_job(self, uid: str) -> None:
+        if self._flight_open:
+            self.flight_jobs.add(uid)
         if self.enabled:
             self.dirty_jobs.add(uid)
             self.vicjob_dirty.add(uid)
 
-    def mark_node(self, name: str) -> None:
+    def mark_node(self, name: str, cap: bool = False) -> None:
+        if self._flight_open:
+            self.flight_nodes.add(name)
+            if cap:
+                self.flight_caps.add(name)
         if self.enabled:
             self.dirty_nodes.add(name)
             self.dev_dirty.add(name)
             self.vic_dirty.add(name)
+
+    # ------------------------------------------------------------------
+    # in-flight window (ISSUE 16; runtime/pipeline.py)
+    # ------------------------------------------------------------------
+    def begin_flight(self) -> None:
+        """Open the in-flight mark window: called right after a
+        pipelined solve dispatches, under the cache lock's caller (the
+        scheduler thread). Any mark folded until ``end_flight`` is
+        evidence the dispatched inputs may be stale."""
+        self.flight_jobs = set()
+        self.flight_nodes = set()
+        self.flight_caps = set()
+        self._flight_open = True
+
+    def end_flight(self) -> Tuple[set, set, set]:
+        """Close the window and hand back (jobs, nodes, capacity-nodes)
+        marked while the solve was in flight."""
+        self._flight_open = False
+        marks = (self.flight_jobs, self.flight_nodes, self.flight_caps)
+        self.flight_jobs = set()
+        self.flight_nodes = set()
+        self.flight_caps = set()
+        return marks
 
     # ------------------------------------------------------------------
     # snapshot-side protocol

@@ -51,7 +51,8 @@ from .metrics import (count_engine_demotion, count_fault_injected,
 log = logging.getLogger("kubebatch.faults")
 
 #: the seam catalog: every named injection point, grouped into families
-#: (device / rpc / cache / source / lease / fleet / solve). Rates in a
+#: (device / rpc / cache / source / lease / fleet / solve / pipeline).
+#: Rates in a
 #: plan may address an exact seam, a family wildcard ("cache.*"), or "*".
 SEAMS: Dict[str, str] = {
     "device.dispatch": "device solver dispatch (allocate visit, fused, "
@@ -80,6 +81,13 @@ SEAMS: Dict[str, str] = {
                        "instead of raising, exactly like cache.fold: "
                        "the rung trades the O(churn) steady cycle for "
                        "the always-sound full solve)",
+    "pipeline.conflict": "pipelined consume conflict check "
+                         "(runtime/pipeline.py — a fired seam forces the "
+                         "in-flight solve result stale at consume time: "
+                         "the decisions are discarded, the device carry "
+                         "restored from its shadow, and the cycle "
+                         "re-solves sequentially against the fresh "
+                         "active set; the invalidate rung, not a crash)",
     "source.deliver": "sim event-stream delivery (sim/source.py pump)",
     "source.disconnect": "watch stream drop (cache/k8s_source.py watch "
                          "loop)",
@@ -96,7 +104,7 @@ SEAMS: Dict[str, str] = {
 }
 
 FAMILIES = ("device", "rpc", "cache", "source", "lease", "fleet",
-            "solve")
+            "solve", "pipeline")
 
 
 class FaultInjected(RuntimeError):

@@ -66,7 +66,8 @@ from ..compilesvc import register_provider as _register_provider
 from ..faults import armed as _faults_armed
 from ..faults import should_fail as _should_fail
 from ..metrics import (count_activeset_audit, count_activeset_cycle,
-                       count_activeset_demotion, count_blocking_readback)
+                       count_activeset_demotion, count_blocking_readback,
+                       count_deferred_readback)
 from ..obs import span as _span
 from .batched import (CycleArrays, RoundState, _IMAX, _PACK_BOOL, _PACK_F32,
                       _PACK_I32, _pack_result, _rollback_stranded, _round,
@@ -405,18 +406,23 @@ def _state_arrays(f, i, b):
     return mk_state, mk_arrays
 
 
-@partial(jax.jit, static_argnames=("lay_f", "lay_i", "lay_b", "job_keys",
-                                   "queue_keys", "prop_overused",
-                                   "dyn_enabled", "pipe_enabled",
-                                   "max_rounds", "pool_size", "max_waves",
-                                   "gang_enabled", "narrow",
-                                   "narrow_gate"))
-def _activeset_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks,
-                      nz_req, backfilled, allocatable_cm, max_task_num,
-                      node_ok, lay_f, lay_i, lay_b, job_keys, queue_keys,
-                      prop_overused, dyn_enabled, pipe_enabled, max_rounds,
-                      pool_size, max_waves=0, gang_enabled=True,
-                      narrow=True, narrow_gate=False):
+_ACT_STATICS = ("lay_f", "lay_i", "lay_b", "job_keys", "queue_keys",
+                "prop_overused", "dyn_enabled", "pipe_enabled",
+                "max_rounds", "pool_size", "max_waves", "gang_enabled",
+                "narrow", "narrow_gate")
+
+#: positional indices of the persistent device carry in the steady
+#: entry's signature (idle / releasing / n_tasks / nz_req) — the
+#: donate_argnums the pipelined twin hands back to XLA
+_ACT_CARRY_ARGNUMS = (3, 4, 5, 6)
+
+
+def _activeset_fn(buf_f, buf_i, buf_b, idle, releasing, n_tasks,
+                  nz_req, backfilled, allocatable_cm, max_task_num,
+                  node_ok, lay_f, lay_i, lay_b, job_keys, queue_keys,
+                  prop_overused, dyn_enabled, pipe_enabled, max_rounds,
+                  pool_size, max_waves=0, gang_enabled=True,
+                  narrow=True, narrow_gate=False):
     f = _unpack(buf_f, lay_f)
     i = _unpack(buf_i, lay_i)
     b = _unpack(buf_b, lay_b)
@@ -444,8 +450,32 @@ def _activeset_packed(buf_f, buf_i, buf_b, idle, releasing, n_tasks,
     return _pack_result(final, rounds, frame)
 
 
-_activeset_packed = _instrument("activeset", "_activeset_packed",
-                                _activeset_packed)
+_activeset_packed = _instrument(
+    "activeset", "_activeset_packed",
+    jax.jit(_activeset_fn, static_argnames=_ACT_STATICS))
+
+#: the pipelined twin (ISSUE 16): same traced function, but the carry
+#: slots are DONATED — XLA writes the next cycle's carry into the old
+#: buffers instead of allocating. Only dispatched off-CPU (XLA-CPU
+#: ignores donation with a warning per call); the executor keeps a
+#: copy-shadow of the carry for conflict rollback, which doubles as the
+#: second slot of the double-buffer pair.
+_activeset_packed_donated = _instrument(
+    "activeset", "_activeset_packed_donated",
+    jax.jit(_activeset_fn, static_argnames=_ACT_STATICS,
+            donate_argnums=_ACT_CARRY_ARGNUMS))
+
+_donation: Optional[bool] = None
+
+
+def _donation_enabled() -> bool:
+    """Buffer donation on the carry slots — off on the CPU backend,
+    where XLA ignores donate_argnums (it would warn every dispatch and
+    donate nothing)."""
+    global _donation
+    if _donation is None:
+        _donation = jax.default_backend() != "cpu"
+    return _donation
 
 
 def _divergence(afinal: RoundState, grain: int, ffinal: RoundState,
@@ -765,6 +795,123 @@ def solve_cycle(device, inputs):
 
 
 # ---------------------------------------------------------------------
+# async dispatch (ISSUE 16; runtime/pipeline.py is the only consumer):
+# the dispatch returns immediately with the result still on device —
+# the readback happens at consume time, a cycle later, off the
+# critical path
+# ---------------------------------------------------------------------
+
+def carry_shadow(device):
+    """Snapshot the persistent carry BEFORE an async dispatch, for the
+    conflict-invalidation rollback. With donation on, the dispatched
+    buffers are dead the moment the call returns, so the shadow must be
+    real device copies — the second slot of the double-buffer pair;
+    without donation the old arrays stay alive and plain references
+    suffice (zero cost)."""
+    carry = (device.idle, device.releasing, device.n_tasks, device.nz_req)
+    if _donation_enabled():
+        return tuple(jnp.array(c, copy=True) for c in carry)
+    return carry
+
+
+class PendingSolve:
+    """A dispatched-but-unread active-set solve. The carry was already
+    committed forward at dispatch (the NEXT cycle's pack chains on the
+    device-side futures without any host sync); ``consume()`` pays the
+    one deferred readback and returns the decision arrays;
+    ``restore_carry()`` rolls the device back to the pre-dispatch
+    shadow when the consume-time conflict check invalidates the
+    result."""
+
+    __slots__ = ("packed", "t", "audit", "shadow", "device")
+
+    def __init__(self, packed, t: int, audit: bool, shadow, device):
+        self.packed = packed
+        self.t = t
+        self.audit = audit
+        self.shadow = shadow
+        self.device = device
+
+    def consume(self, sp=None):
+        """Block on the in-flight result (usually already landed — the
+        host ran a whole cycle meanwhile and ``copy_to_host_async``
+        started the transfer at dispatch) and decode it. Returns
+        (task_state, task_node, task_seq, rounds) at ``self.t`` width.
+        Audit pendings compare in-kernel like the sync path: the
+        committed result is the full-width solve's (always sound), so
+        the decisions replay regardless; a divergence demotes."""
+        count_deferred_readback()
+        out = np.asarray(self.packed)
+        t = self.t
+        task_state = out[:t]
+        task_node = out[t:2 * t]
+        task_seq = out[2 * t:3 * t]
+        rounds = out[3 * t]
+        frame = out[3 * t + 1:]
+        from ..obs import telemetry as _obs_telemetry
+        _obs_telemetry.record(frame, span=sp)
+        if self.audit:
+            div = int(frame[F_ACT_DEMOTED])
+            count_activeset_audit(div == 0)
+            if div:
+                demote("audit")
+        return task_state, task_node, task_seq, int(rounds)
+
+    def restore_carry(self) -> None:
+        d = self.device
+        d.idle, d.releasing, d.n_tasks, d.nz_req = self.shadow
+
+
+def solve_cycle_async(device, inputs) -> Optional[PendingSolve]:
+    """solve_cycle's future-shaped twin: same decline gates, same fault
+    seam, same audit cadence — but the dispatch returns a
+    :class:`PendingSolve` instead of blocking on the readback. None
+    when the engine declines (the caller runs the cycle
+    sequentially)."""
+    global _cycle_idx
+    if _demoted:
+        return None
+    plan = prepare_activeset(device, inputs)
+    if plan is None:
+        return None
+    if _faults_armed() and _should_fail("solve.activeset"):
+        demote("fault")
+        return None
+    idx = _cycle_idx
+    _cycle_idx += 1
+    n = audit_every()
+    audit = n > 0 and idx % n == 0
+    count_activeset_cycle(audit)
+    shadow = carry_shadow(device)
+    if audit:
+        aplan = prepare_activeset_audit(device, inputs)
+        if aplan is None:                 # pragma: no cover — plan raced
+            return None
+        args, statics, _ = aplan
+        t = inputs.task_valid.shape[0]
+        with _span("activeset_audit_dispatch", cat="kernel"):
+            final, packed = _activeset_audit_packed(*args, **statics)
+    else:
+        args, statics, g = plan
+        t = g
+        with _span("activeset_dispatch", cat="kernel"):
+            if _donation_enabled():
+                final, packed = _activeset_packed_donated(*args, **statics)
+            else:
+                final, packed = _activeset_packed(*args, **statics)
+    # the carry chains forward as device-side futures — cycle N+1's
+    # pack reads these without waiting for the solve to finish
+    _commit(device, final)
+    try:
+        # start the device->host transfer now; consume()'s np.asarray a
+        # cycle later then finds the bytes already on the host
+        packed.copy_to_host_async()
+    except Exception:                     # pragma: no cover — backend quirk
+        pass
+    return PendingSolve(packed, t, audit, shadow, device)
+
+
+# ---------------------------------------------------------------------
 # compilesvc signature provider — the churn-grain buckets (256 / 1024 /
 # 4096) register for hier-scale node axes so steady churn jitter always
 # lands on a compiled shape, plus the combined audit entry at the
@@ -803,6 +950,25 @@ def compile_signatures(materials):
                 run=lambda a=args, s=statics: _activeset_packed(*a, **s),
                 note=(f"steady grain={g} N={inputs.device.n_padded} "
                       f"pool={statics['pool_size']} pipe={pipe}")))
+            if _donation_enabled():
+                # the pipelined twin compiles separately (donation is
+                # part of the executable); the warm-up run hands it
+                # COPIES of the carry so warming never invalidates the
+                # shared materials arrays
+                out.append(Signature(
+                    engine="activeset", entry="_activeset_packed_donated",
+                    key=signature_key("_activeset_packed_donated", args,
+                                      statics),
+                    lower=lambda a=args, s=statics:
+                        _activeset_packed_donated.lower(*a, **s),
+                    run=lambda a=args, s=statics:
+                        _activeset_packed_donated(
+                            *a[:3],
+                            *(jnp.array(x, copy=True) for x in a[3:7]),
+                            *a[7:], **s),
+                    note=(f"steady-donated grain={g} "
+                          f"N={inputs.device.n_padded} "
+                          f"pool={statics['pool_size']} pipe={pipe}")))
     audit = prepare_activeset_audit(inputs.device, inputs)
     if audit is not None:
         args, base, g = audit

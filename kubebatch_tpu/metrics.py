@@ -698,6 +698,61 @@ def activeset_demotions_by_reason() -> dict:
         return dict(_activeset_demotions)
 
 
+# -- pipelined cycle executor (ISSUE 16; runtime/pipeline.py) ----------
+
+_pipeline_cycles = 0
+_pipeline_conflicts: dict = {}
+_pipeline_demotions: dict = {}
+
+
+def count_pipeline_cycle() -> None:
+    """Record one overlapped cycle: a cycle that consumed an in-flight
+    solve result dispatched by the PREVIOUS cycle."""
+    global _pipeline_cycles
+    with _robust_lock:
+        _pipeline_cycles += 1
+
+
+def pipeline_cycles_total() -> int:
+    with _robust_lock:
+        return _pipeline_cycles
+
+
+def count_pipeline_conflict(outcome: str) -> None:
+    """Record one consume-time conflict-check resolution that did NOT
+    commit the in-flight decisions: "conflict" = a folded event touched
+    the decisions' job/node footprint (the optimistic result is stale),
+    "fault" = the armed pipeline.conflict seam forced staleness. Clean
+    commits are the complement (pipeline_cycles - conflicts)."""
+    with _robust_lock:
+        _pipeline_conflicts[outcome] = \
+            _pipeline_conflicts.get(outcome, 0) + 1
+
+
+def pipeline_conflicts_total() -> int:
+    with _robust_lock:
+        return sum(_pipeline_conflicts.values())
+
+
+def pipeline_conflicts_by_outcome() -> dict:
+    with _robust_lock:
+        return dict(_pipeline_conflicts)
+
+
+def count_pipeline_demotion(reason: str) -> None:
+    """Record one pipeline demotion back to the sequential loop
+    ("storm" = consecutive consume-time conflicts crossed the storm
+    limit — the overlap is losing more cycles than it saves)."""
+    with _robust_lock:
+        _pipeline_demotions[reason] = \
+            _pipeline_demotions.get(reason, 0) + 1
+
+
+def pipeline_demotions_total() -> int:
+    with _robust_lock:
+        return sum(_pipeline_demotions.values())
+
+
 _arrivals_observed = 0
 
 
@@ -828,6 +883,25 @@ def blocking_readbacks() -> int:
     return _blocking_readbacks
 
 
+_deferred_readbacks = 0
+
+
+def count_deferred_readback(n: int = 1) -> None:
+    """Record n DEFERRED device->host transfers: the pipelined consume
+    path's readback of a result dispatched a cycle earlier. It still
+    pays the link RTT, but off the critical path — cycle N+1's pack and
+    dispatch already ran while it was in flight. Counted separately so
+    the sustained-rate accounting can tell "readback happened later"
+    from "readback never happened"."""
+    global _deferred_readbacks
+    _deferred_readbacks += n
+
+
+def deferred_readbacks() -> int:
+    """Process-lifetime count; consumers diff across a window."""
+    return _deferred_readbacks
+
+
 # ---------------------------------------------------------------------------
 # readbacks-per-decision accounting + device telemetry (ISSUE 12)
 # ---------------------------------------------------------------------------
@@ -854,18 +928,29 @@ def decisions_total() -> int:
 
 
 def readback_accounting(since: "dict | None" = None) -> dict:
-    """{readbacks, decisions, readbacks_per_decision} — process-lifetime,
-    or the window since a previous readback_accounting() snapshot when
-    ``since`` is passed. The ratio is None for an idle window (nothing
-    bound). Replaces diffing the raw _blocking_readbacks global."""
+    """{readbacks, deferred_readbacks, decisions,
+    readbacks_per_decision, total_readbacks_per_decision} —
+    process-lifetime, or the window since a previous
+    readback_accounting() snapshot when ``since`` is passed. The ratios
+    are None for an idle window (nothing bound).
+    ``readbacks_per_decision`` counts BLOCKING transfers only (the
+    critical-path figure — 0 on a pipelined line);
+    ``total_readbacks_per_decision`` adds the deferred window so a
+    pipelined line still proves one transfer per solve happened, just
+    later. Replaces diffing the raw _blocking_readbacks global."""
     rb = _blocking_readbacks
+    dfr = _deferred_readbacks
     dec = _decisions
     if since is not None:
         rb -= int(since.get("readbacks", 0))
+        dfr -= int(since.get("deferred_readbacks", 0))
         dec -= int(since.get("decisions", 0))
-    return {"readbacks": rb, "decisions": dec,
+    return {"readbacks": rb, "deferred_readbacks": dfr,
+            "decisions": dec,
             "readbacks_per_decision": (round(rb / dec, 6) if dec
-                                       else None)}
+                                       else None),
+            "total_readbacks_per_decision":
+                (round((rb + dfr) / dec, 6) if dec else None)}
 
 
 class _BoundedHist:
@@ -1017,6 +1102,11 @@ def counters_snapshot(include_rpc: bool = True) -> dict:
         "activeset_audits_total": activeset_audits_total(),
         "activeset_divergences_total": activeset_divergences_total(),
         "activeset_demotions_total": activeset_demotions_total(),
+        "deferred_readbacks": deferred_readbacks(),
+        "pipeline_cycles_total": pipeline_cycles_total(),
+        "pipeline_conflicts_total": pipeline_conflicts_total(),
+        "pipeline_conflicts_by_outcome": pipeline_conflicts_by_outcome(),
+        "pipeline_demotions_total": pipeline_demotions_total(),
         "telemetry": telemetry_snapshot(),
     }
     snap["readback_accounting"] = readback_accounting()

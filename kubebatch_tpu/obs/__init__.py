@@ -26,16 +26,16 @@ the kernels package (whose own modules import obs.span) always finds
 this package initialized.
 """
 from .spans import (CYCLE_HOOKS, Span, add_event, arm_profile, begin_cycle,
-                    begin_server_root, current_cycle, cycle, enabled,
-                    end_cycle, end_server_root, graft, last_cycle, now,
-                    set_enabled, span, span_overhead_estimate, spans_total,
-                    tracer_stats)
+                    begin_server_root, current_cycle, current_epoch, cycle,
+                    enabled, end_cycle, end_server_root, graft, last_cycle,
+                    now, set_enabled, span, span_overhead_estimate,
+                    spans_total, tracer_stats)
 
 __all__ = ["CYCLE_HOOKS", "Span", "add_event", "arm_profile",
-           "begin_cycle", "begin_server_root", "current_cycle", "cycle",
-           "enabled", "end_cycle", "end_server_root", "graft",
-           "last_cycle", "now", "set_enabled", "span",
-           "span_overhead_estimate", "spans_total", "telemetry",
+           "begin_cycle", "begin_server_root", "current_cycle",
+           "current_epoch", "cycle", "enabled", "end_cycle",
+           "end_server_root", "graft", "last_cycle", "now", "set_enabled",
+           "span", "span_overhead_estimate", "spans_total", "telemetry",
            "tracer_stats"]
 
 from . import telemetry  # noqa: E402  (see import discipline above)

@@ -33,6 +33,7 @@ stitch into the client's cycle tree without touching the wire schema.
 """
 from __future__ import annotations
 
+import itertools as _it
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -40,10 +41,10 @@ from typing import Callable, Dict, List, Optional
 from .. import metrics
 
 __all__ = ["Span", "span", "begin_cycle", "end_cycle", "current_cycle",
-           "last_cycle", "set_enabled", "enabled", "cycle",
-           "begin_server_root", "end_server_root", "graft", "add_event",
-           "arm_profile", "span_overhead_estimate", "CYCLE_HOOKS",
-           "tracer_stats", "spans_total"]
+           "current_epoch", "last_cycle", "set_enabled", "enabled",
+           "cycle", "begin_server_root", "end_server_root", "graft",
+           "add_event", "arm_profile", "span_overhead_estimate",
+           "CYCLE_HOOKS", "tracer_stats", "spans_total"]
 
 _perf = time.perf_counter
 
@@ -125,6 +126,14 @@ _last_cycle: Optional[Span] = None
 #: process-lifetime span count (consumers diff across a window, like
 #: every other counter in metrics.py)
 _spans_total = 0
+
+#: monotonically increasing cycle-epoch sequence, stamped on every cycle
+#: root's args (ISSUE 16): with the pipelined executor a span can close
+#: inside a DIFFERENT cycle's root than the one that launched its work
+#: (the consume of cycle N's solve runs under cycle N+1), so the epoch
+#: tag — not tree position — is what attributes overlapped work to its
+#: launching cycle. Never reset; GIL-atomic via itertools.count.
+_epoch_seq = _it.count(1)
 
 
 def _stack() -> list:
@@ -288,10 +297,15 @@ def begin_cycle(cycle_id: Optional[int] = None, name: str = "cycle",
     ``name`` labels the root ("cycle" for the period loop; the
     schedule-on-arrival path opens "subcycle" roots, which therefore
     appear as their own span roots in Chrome traces and the flight
-    ring — same tree machinery, no second tracer)."""
+    ring — same tree machinery, no second tracer). Every root carries a
+    process-unique ``epoch`` arg: spans that outlive their cycle (the
+    pipelined consume closes inside the NEXT cycle's root) are tagged
+    with the launching root's epoch, so trace consumers attribute them
+    by epoch rather than by tree position."""
     if cycle_id is not None:
         args["cycle"] = cycle_id
-    root = Span(name, "cycle", args or None)
+    args["epoch"] = next(_epoch_seq)
+    root = Span(name, "cycle", args)
     if _ENABLED:
         st = _stack()
         if st:                             # nested cycle: plain child span
@@ -304,22 +318,44 @@ def begin_cycle(cycle_id: Optional[int] = None, name: str = "cycle",
 
 def end_cycle(root: Span, **args) -> Span:
     """Close a cycle root: stamps dur, fires the cycle hooks (flight
-    recorder ring + trace exporter), clears the thread stack."""
+    recorder ring + trace exporter), clears this root's stack frame.
+
+    Overlapping roots (ISSUE 16): a cycle root that is still OPEN when
+    an earlier root ends is not a straggler — it is detached from the
+    ending root's tree and kept live on the stack, so it finishes as an
+    independent root with its own hook firing and a complete tree of
+    its own (two overlapping roots export as two valid Chrome-trace
+    trees). Only non-cycle spans left open above the ending root (a
+    raising action) are swept."""
     root.dur = _perf() - root.t0
     if args:
         root.args = dict(root.args or {}, **args)
     st = _stack()
+    nested = False
     if root in st:             # not pushed at all when retention was off
-        while st and st[-1] is not root:   # a raising action left spans open
-            st.pop()
-        if st:
-            st.pop()
+        i = st.index(root)
+        # an older cycle root still open BELOW this one means this root
+        # is properly nested (subcycle style): its parent fires the hooks
+        nested = any(s.cat == "cycle" for s in st[:i])
+        above = st[i:]
+        del st[i:]
+        for j in range(1, len(above)):
+            if above[j].cat == "cycle":
+                # a younger overlapping root (and everything it opened):
+                # detach it from the ending root's tree and re-push
+                parent = above[j - 1]
+                if above[j] in parent.children:
+                    parent.children.remove(above[j])
+                st.extend(above[j:])
+                break
+    else:
+        nested = any(s.cat == "cycle" for s in st)
     global _spans_total, _last_cycle
     _spans_total += 1          # descendants already counted at their exit
     _profile_cycle_end()
-    # outermost CYCLE on this thread (plain host spans around it — the
-    # loop tick — don't make it "nested"): fire the cycle hooks
-    if not any(s.cat == "cycle" for s in st):
+    # outermost CYCLE root (plain host spans around it — the loop
+    # tick — don't make it "nested"): fire the cycle hooks
+    if not nested:
         _last_cycle = root
         if _ENABLED:
             for hook in CYCLE_HOOKS:
@@ -354,14 +390,24 @@ def cycle(cycle_id: Optional[int] = None, **args) -> _CycleCtx:
 
 
 def current_cycle() -> Optional[Span]:
-    """This thread's outermost open CYCLE span, or None."""
+    """This thread's innermost open CYCLE span, or None — with
+    overlapping roots the innermost is the cycle currently being BUILT
+    (the older one is only waiting for its in-flight work)."""
     st = getattr(_TLS, "stack", None)
     if not st:
         return None
-    for s in st:
+    for s in reversed(st):
         if s.cat == "cycle":
             return s
     return None
+
+
+def current_epoch() -> Optional[int]:
+    """The ``epoch`` tag of this thread's current cycle root, or None.
+    Work launched now and consumed inside a LATER cycle stamps this on
+    its consume span, attributing it to the launching cycle."""
+    sp = current_cycle()
+    return (sp.args or {}).get("epoch") if sp is not None else None
 
 
 def last_cycle() -> Optional[Span]:

@@ -1,0 +1,367 @@
+"""Pipelined scheduling cycles — the blocking readback off the critical
+path (ISSUE 16; ROADMAP item 1's latency lever).
+
+The sequential loop is strictly ordered per cycle: fold/tensorize ->
+one device dispatch -> one BLOCKING readback -> host apply/bind.
+Through the axon tunnel the readback pays the full link RTT (~75 ms,
+BENCH_NOTES), so cycle latency has a hard floor no kernel speedup can
+cross. This executor restructures the loop so the readback of cycle
+N's solve overlaps cycle N+1's host work:
+
+cycle N:   consume N-1's in-flight result (conflict-check, replay) ->
+           run actions; allocate TENSORIZES and DISPATCHES the solve
+           for N's pending set, then returns without reading it back ->
+           close the session (adoption hands N's clones to N+1's base)
+cycle N+1: the solve result lands while N+1's open/fold/pack runs;
+           consume pays a DEFERRED readback (usually already on the
+           host — ``copy_to_host_async`` started the transfer at
+           dispatch) and replays N's decisions into N+1's session.
+
+Why replaying a cycle late is sound — the rebase argument
+(docs/INCREMENTAL.md "Pipelined cycles"): session N closed WITHOUT
+applying the in-flight decisions, so cache truth never saw them and
+session N+1's snapshot still carries every placed task as pending.
+The tensorized inputs, though, hold session N's clones — OpenSession
+re-clones, so N+1 holds different instances for the same uids.
+Consume therefore REBASES the inputs' job/task references onto
+session N+1's objects by uid (cycle_inputs.rebase_inputs) before
+replaying; the replay then performs precisely the mutations session N
+would have performed, one cycle later, and the bind write-back lands
+in cache truth exactly once. A placed uid that no longer resolves as
+pending is staleness the conflict fingerprint missed — the rebase
+fails and the cycle invalidates like any other conflict.
+
+Optimism and its guard rails: while a solve is in flight, cache events
+keep folding. The fold layer tags every mark into a flight window
+(EventFold.begin_flight/end_flight); at consume time the executor
+checks whether any flight-marked job/node intersects the in-flight
+decisions' footprint. Our OWN committed binds echo back through the
+kubelet (a Running flip re-marks the job and node we just bound), so
+the check subtracts the footprint of the last two commits — EXCEPT for
+node-shape/capacity marks (``flight_caps``), which are never our echo
+and always conflict. A conflict (or the armed ``pipeline.conflict``
+seam) invalidates: the decisions are discarded untouched (nothing was
+replayed, so there is nothing to roll back), the device carry restores
+from its pre-dispatch shadow, and the CURRENT cycle runs the
+sequential path — the conflicted tasks are still pending in this very
+session, so "re-solve against the fresh active set" is just the
+ordinary solve. Repeated conflicts (the storm) demote the executor to
+the sequential loop for the rest of the process — the same sticky
+demote-not-raise rung as cache.fold and solve.activeset.
+
+The executor only engages the active-set/hier engine family (the
+engines with a persistent device carry and a packed result frame);
+every other mode, a ladder-degraded process, affinity cycles, and
+declined solves run the ordinary ``AllocateAction.execute`` path
+unchanged.
+"""
+from __future__ import annotations
+
+import logging
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from .. import obs as _obs
+from ..faults import armed as _faults_armed
+from ..faults import should_fail as _should_fail
+from ..framework import CloseSession, OpenSession
+from ..metrics import (count_pipeline_conflict, count_pipeline_cycle,
+                       count_pipeline_demotion)
+
+log = logging.getLogger("kubebatch.pipeline")
+
+#: consecutive consume-time conflicts that demote the executor — at
+#: this rate the overlap re-solves more cycles than it saves
+CONFLICT_STORM_LIMIT = 3
+
+#: how many past commits' footprints the echo window remembers; the
+#: kubelet Running flip for a bind normally echoes within one cycle,
+#: two covers a slow tick without letting real staleness hide long
+ECHO_WINDOW = 2
+
+_demoted = False
+
+
+def demoted() -> bool:
+    return _demoted
+
+
+def demote(reason: str) -> None:
+    """The sticky rung back to the sequential loop: conflict storms (or
+    anything else that makes the overlap a net loss) land here — never
+    an exception into the scheduling loop. Idempotent; restart (or
+    reset(), tests) to re-enable."""
+    global _demoted
+    if _demoted:
+        return
+    _demoted = True
+    count_pipeline_demotion(reason)
+    log.error("pipelined executor DEMOTED to the sequential loop "
+              "(reason=%s): cycles run fold -> dispatch -> blocking "
+              "readback -> apply again; restart to re-enable", reason)
+    try:
+        from ..obs import flight as _flight
+        _flight.dump(f"pipeline_demotion-{reason}")
+    except Exception:             # pragma: no cover — observer bug
+        log.exception("pipeline demotion flight dump failed")
+
+
+def reset() -> None:
+    """Test/bench hook: forget the demotion."""
+    global _demoted
+    _demoted = False
+
+
+class PendingCycle:
+    """One in-flight solve: the kernel-side future plus everything the
+    NEXT cycle needs to consume it — the tensorized inputs it will
+    replay through and the launching cycle's epoch tag for the obs
+    tree."""
+
+    __slots__ = ("solve", "inputs", "epoch")
+
+    def __init__(self, solve, inputs, epoch):
+        self.solve = solve
+        self.inputs = inputs
+        self.epoch = epoch
+
+
+class PipelinedExecutor:
+    """Drives one scheduler's cycles in pipelined form. Owned by
+    Scheduler (constructed when ``pipeline=True``); run_once here
+    replaces Scheduler.run_once's session block while the executor is
+    active. All state is per-scheduler except the process-wide demotion
+    flag above."""
+
+    def __init__(self, scheduler):
+        self.sched = scheduler
+        self._pending: Optional[PendingCycle] = None
+        #: footprints (jobs, nodes) of the last ECHO_WINDOW commits —
+        #: subtracted from the flight marks so our own bind echo never
+        #: reads as a conflict
+        self._echo: deque = deque(maxlen=ECHO_WINDOW)
+        self._streak = 0
+
+    # ------------------------------------------------------------------
+    def active(self) -> bool:
+        return not _demoted
+
+    def reset(self) -> None:
+        """Drop in-flight state AND the module demotion (tests/bench)."""
+        self._pending = None
+        self._echo.clear()
+        self._streak = 0
+        reset()
+
+    # ------------------------------------------------------------------
+    def run_once(self, snapshot=None) -> None:
+        """One pipelined cycle: Scheduler.run_once's session block with
+        (a) the previous cycle's in-flight result consumed FIRST —
+        before any action sees the session — and (b) the allocate
+        action routed through the async-dispatch path."""
+        sched = self.sched
+        jobs = nodes = None
+        session_span = None
+        try:
+            with _obs.span("session", cat="e2e") as session_span:
+                ssn = OpenSession(sched.cache, sched.tiers,
+                                  sched.enable_preemption,
+                                  snapshot=snapshot)
+                jobs, nodes = len(ssn.jobs), len(ssn.nodes)
+                try:
+                    sequential = self._consume(ssn)
+                    for action in sched.actions:
+                        action.initialize()
+                        with _obs.span(action.name, cat="action") as asp:
+                            if action.name == "allocate":
+                                self._allocate(ssn, action, sequential)
+                            else:
+                                action.execute(ssn)
+                        log.debug("action %s took %.2fms", action.name,
+                                  1e3 * asp.dur)
+                        action.uninitialize()
+                    if sched.explain_unschedulable:
+                        from ..obs import explain as _explain
+                        try:
+                            with _obs.span("explain", cat="host"):
+                                _explain.explain_session(ssn)
+                        except Exception:
+                            log.exception("unschedulability explainer "
+                                          "failed; cycle unaffected")
+                finally:
+                    CloseSession(ssn)
+        finally:
+            if jobs is not None:
+                log.info("scheduling cycle: %d jobs / %d nodes in %.2fms "
+                         "(pipelined)", jobs, nodes,
+                         1e3 * session_span.dur)
+
+    def drain(self, max_cycles: int = 3) -> None:
+        """Flush the in-flight solve by running whole cycles until the
+        pipeline is empty (an empty pending set dispatches nothing, so
+        one cycle normally suffices). Benches and tests call this so
+        every dispatched decision has been applied before they compare
+        state; the chaos quiesce loop gets the same effect from its
+        settle cycles."""
+        n = 0
+        while self._pending is not None and n < max_cycles:
+            self.sched.run_cycle()
+            n += 1
+
+    # ------------------------------------------------------------------
+    # consume side
+    # ------------------------------------------------------------------
+    def _consume(self, ssn) -> bool:
+        """Consume the previous cycle's in-flight result into ``ssn``.
+        Returns True when the result was invalidated — the caller then
+        runs THIS cycle sequentially (its session still carries the
+        conflicted tasks as pending, so the ordinary solve IS the
+        re-solve against the fresh active set)."""
+        from ..actions.cycle_inputs import rebase_inputs, replay_decisions
+
+        pend, self._pending = self._pending, None
+        if pend is None:
+            return False
+        fold = getattr(self.sched.cache, "fold", None)
+        flight = (fold.end_flight() if fold is not None
+                  else (set(), set(), set()))
+        with _obs.span("consume", cat="host", epoch=pend.epoch) as sp:
+            task_state, task_node, task_seq, _ = pend.solve.consume(sp)
+        fp_jobs, fp_nodes = self._footprint(pend.inputs, task_state,
+                                            task_node)
+        outcome = None
+        if _faults_armed() and _should_fail("pipeline.conflict"):
+            outcome = "fault"
+        elif self._is_conflict(fp_jobs, fp_nodes, flight):
+            outcome = "conflict"
+        elif not rebase_inputs(ssn, pend.inputs, task_state):
+            # a placed task no longer resolves as pending in this
+            # session — staleness the fingerprint missed (echo-masked)
+            outcome = "conflict"
+        if outcome is None:
+            count_pipeline_cycle()
+            replay_decisions(ssn, pend.inputs, task_state, task_node,
+                             task_seq)
+            self._echo.append((fp_jobs, fp_nodes))
+            self._streak = 0
+            return False
+        # stale: discard untouched (nothing was replayed, so cache
+        # truth and the session never saw these decisions), roll the
+        # device carry back to its pre-dispatch shadow, and let this
+        # cycle solve sequentially
+        count_pipeline_conflict(outcome)
+        pend.solve.restore_carry()
+        self._echo.clear()
+        self._streak += 1
+        log.warning("pipelined result invalidated at consume "
+                    "(%s; streak %d/%d): %d jobs / %d nodes in the "
+                    "flight window touched the decision footprint — "
+                    "re-solving this cycle sequentially", outcome,
+                    self._streak, CONFLICT_STORM_LIMIT,
+                    len(flight[0]), len(flight[1]))
+        if self._streak >= CONFLICT_STORM_LIMIT:
+            demote("storm")
+        return True
+
+    @staticmethod
+    def _footprint(inputs, task_state, task_node):
+        """(job uids, node names) the in-flight decisions bind against."""
+        from ..kernels.fused import ALLOC, ALLOC_OB, PIPELINE
+
+        n = len(inputs.tasks)
+        state = np.asarray(task_state)[:n]
+        placed = np.nonzero((state == ALLOC) | (state == ALLOC_OB)
+                            | (state == PIPELINE))[0]
+        names = inputs.device.state.names
+        node_cols = np.asarray(task_node)[:n][placed]
+        fp_jobs = {inputs.tasks[int(i)].job for i in placed.tolist()}
+        fp_nodes = {names[int(c)] for c in node_cols.tolist()
+                    if 0 <= int(c) < len(names)}
+        return fp_jobs, fp_nodes
+
+    def _is_conflict(self, fp_jobs, fp_nodes, flight) -> bool:
+        """Did any event folded while the solve was in flight touch an
+        entity the decisions bind against? Capacity marks always
+        conflict; plain job/node marks are screened against the echo of
+        our own recent commits (the kubelet Running flip for a bind we
+        made re-marks exactly the footprint we recorded)."""
+        flight_jobs, flight_nodes, flight_caps = flight
+        if flight_caps & fp_nodes:
+            return True
+        echo_jobs: set = set()
+        echo_nodes: set = set()
+        for ej, en in self._echo:
+            echo_jobs |= ej
+            echo_nodes |= en
+        return bool(((flight_jobs - echo_jobs) & fp_jobs)
+                    or ((flight_nodes - echo_nodes) & fp_nodes))
+
+    # ------------------------------------------------------------------
+    # dispatch side
+    # ------------------------------------------------------------------
+    def _allocate(self, ssn, action, sequential: bool) -> None:
+        """The allocate action with the async-dispatch path: when the
+        active-set engine may claim this cycle, tensorize + dispatch
+        and return with the result in flight; otherwise (other engine
+        families, ladder-degraded process, declined solve, or a
+        conflict this cycle) run the ordinary sequential execute."""
+        from ..actions import allocate as _alloc
+        from ..actions.allocate_batched import batched_supported
+        from ..actions.cycle_inputs import EMPTY_CYCLE, build_cycle_inputs
+        from ..faults import check as _fault_check
+        from ..kernels import activeset as _activeset
+
+        mode = action.mode
+        eff = action._auto_mode(ssn) if mode == "auto" else mode
+        pipelinable = (not sequential
+                       and eff in ("hier", "activeset")
+                       and (eff == "activeset" or mode == "auto")
+                       and self.sched.ladder.level == 0
+                       and not _activeset.demoted()
+                       and batched_supported(ssn))
+        if not pipelinable:
+            action.execute(ssn)
+            return
+        inputs = build_cycle_inputs(ssn, allow_affinity=True)
+        if inputs is EMPTY_CYCLE:
+            _alloc.last_cycle_engine = "hier"
+            return
+        if inputs is None or getattr(inputs, "affinity", None) is not None:
+            self._sequential(ssn, action, eff, inputs)
+            return
+        # same seam the sequential path crosses before its dispatch
+        _fault_check("device.dispatch")
+        pend = _activeset.solve_cycle_async(inputs.device, inputs)
+        if pend is None:
+            # the engine declined (cold-sized set, inexact pairs,
+            # demoted): run this cycle on the full-width path, reusing
+            # the inputs already built
+            self._sequential(ssn, action, eff, inputs)
+            return
+        _alloc.last_cycle_engine = "activeset"
+        fold = getattr(self.sched.cache, "fold", None)
+        if fold is not None:
+            fold.begin_flight()
+        self._pending = PendingCycle(pend, inputs, _obs.current_epoch())
+
+    @staticmethod
+    def _sequential(ssn, action, eff: str, inputs) -> None:
+        """The sequential fallback AFTER inputs were built: the one-shot
+        active-row state is already consumed, so re-entering
+        action.execute (which rebuilds inputs) would hand the solve an
+        empty active set — route through execute_batched with the
+        prebuilt inputs instead, mirroring AllocateAction.execute's
+        fallback chain past it."""
+        from ..actions import allocate as _alloc
+        from ..actions.allocate_batched import execute_batched
+        from ..metrics import count_engine_demotion
+
+        ran = execute_batched(ssn, hier=True, activeset=True,
+                              inputs=inputs)
+        if ran:
+            _alloc.last_cycle_engine = ran
+            return
+        count_engine_demotion(eff, "visit")
+        action._execute_queued(ssn, "batched")

@@ -62,7 +62,8 @@ class Scheduler:
                  explain_unschedulable: bool = False,
                  audit_every: Optional[int] = None,
                  solve_audit_every: Optional[int] = None,
-                 subcycle: Optional[bool] = None):
+                 subcycle: Optional[bool] = None,
+                 pipeline: Optional[bool] = None):
         self.cache = cache
         self.schedule_period = schedule_period
         self.enable_preemption = enable_preemption
@@ -124,6 +125,19 @@ class Scheduler:
         #: monotonically increasing cycle id stamped on each cycle root
         #: span (and propagated over the rpc hop as trace context)
         self._cycle_seq = -1
+        #: pipelined cycles (ISSUE 16; runtime/pipeline.py): overlap the
+        #: device solve's readback with the next cycle's host work. The
+        #: executor replaces run_once's session block while it is
+        #: active; a conflict-storm demotion flips cycles back to the
+        #: sequential block below, permanently for the process.
+        if pipeline is None:
+            from ..util import env_on
+            pipeline = env_on("KUBEBATCH_PIPELINE", default="0")
+        self.pipeline_enabled = bool(pipeline)
+        self._pipeline = None
+        if self.pipeline_enabled:
+            from .pipeline import PipelinedExecutor
+            self._pipeline = PipelinedExecutor(self)
 
     @staticmethod
     def _load_conf(conf_str: str):
@@ -306,6 +320,12 @@ class Scheduler:
                 log.error("fold audit FAILED (%d diffs; fold demoted to "
                           "snapshot-primary): %s", len(diffs), diffs[:4])
                 _flight.maybe_dump_on_failure("fold-audit")
+        if self._pipeline is not None and self._pipeline.active():
+            # pipelined cycle (ISSUE 16): same session protocol, but the
+            # previous cycle's in-flight solve is consumed first and the
+            # allocate action dispatches without reading back
+            self._pipeline.run_once(snapshot)
+            return
         try:
             with _obs.span("session", cat="e2e") as session_span:
                 ssn = OpenSession(self.cache, self.tiers,

@@ -84,6 +84,13 @@ DEFAULT_COUNTS: Dict[str, int] = {
     # seam only engages on configs where the engine does, so arming it
     # everywhere is free on small soaks
     "solve.activeset": 1,
+    # pipelined-consume invalidation (ISSUE 16): one forced conflict at
+    # the consume check — the in-flight result is discarded, the cycle
+    # re-solves sequentially, and nothing double-binds or goes missing.
+    # The seam only engages when the soak runs with ``pipeline=True``
+    # (otherwise the consume path never crosses it), so arming it in
+    # the default plan is free
+    "pipeline.conflict": 1,
 }
 
 #: the smoke-test subset: no device/rpc seams, so the ladder never
@@ -170,6 +177,12 @@ class ChaosReport:
     degraded_p50_ms: float = 0.0
     healthy_p50_ms: float = 0.0
     pods_bound: int = 0
+    #: pipelined soak (``pipeline=True``): overlapped commits, consume
+    #: invalidations, and whether the storm rung demoted mid-soak
+    #: (legitimate under heavy churn — recorded, not a violation)
+    pipeline_cycles: int = 0
+    pipeline_conflicts: int = 0
+    pipeline_demoted: bool = False
     lease_lost: bool = False
     lease_renew_attempts: int = 0
     #: unschedulability-explainer lines for pods still pending after the
@@ -206,7 +219,8 @@ def run_chaos(cycles: int = 200, seed: int = 0,
               rpc_sidecar: bool = False,
               fault_start: int = 3,
               fault_stop: Optional[int] = None,
-              churn_gangs: int = 1) -> ChaosReport:
+              churn_gangs: int = 1,
+              pipeline: bool = False) -> ChaosReport:
     """Run the soak and return the report (callers assert ``report.ok``).
 
     ``fault_stop`` defaults to leaving ~the last fifth of the cycles
@@ -214,9 +228,15 @@ def run_chaos(cycles: int = 200, seed: int = 0,
     and the bit-identical recovery check runs against a fully healthy
     scheduler. ``rpc_sidecar`` starts an in-process gRPC solver sidecar
     and routes allocate through it (KUBEBATCH_SOLVER=rpc) so the rpc
-    seams are crossed by real wire calls.
+    seams are crossed by real wire calls. ``pipeline=True`` runs the
+    soak scheduler on the pipelined executor (runtime/pipeline.py) —
+    the armed ``pipeline.conflict`` seam plus the soak's own churn then
+    exercise the consume-time invalidation rung under the full
+    invariant bar.
     """
     from ..actions import allocate as _alloc_mod
+    from ..metrics import (pipeline_conflicts_total, pipeline_cycles_total)
+    from ..runtime import pipeline as _pipeline_mod
 
     report = ChaosReport(cycles=cycles, seed=seed)
     # the deterministic counts (cache.fold: demote-the-fold rung) ride
@@ -256,6 +276,13 @@ def run_chaos(cycles: int = 200, seed: int = 0,
             server.start()
             os.environ["KUBEBATCH_SOLVER"] = "rpc"
             os.environ["KUBEBATCH_SOLVER_ADDR"] = f"127.0.0.1:{port}"
+        elif pipeline:
+            # the executor only pipelines the activeset/hier family, and
+            # the 12-node soak cluster auto-selects the flat engines —
+            # force the solver so the overlap path actually engages
+            # (both fingerprints run under the same env, so the
+            # bit-identical oracle stays apples-to-apples)
+            os.environ["KUBEBATCH_SOLVER"] = "activeset"
 
         # ---- the fault-free oracle, recorded BEFORE any chaos ------
         baseline_decisions, baseline_engine = _fingerprint(seed)
@@ -287,8 +314,13 @@ def run_chaos(cycles: int = 200, seed: int = 0,
         # folded state and a fresh full clone) runs INSIDE the soak —
         # the ISSUE 9 acceptance gate; failures surface as violations
         # below via metrics.audit_failures_total
+        if pipeline:
+            _pipeline_mod.reset()     # soak starts un-demoted
+        pc0 = pipeline_cycles_total()
+        cf0 = pipeline_conflicts_total()
         sched = Scheduler(cache, schedule_period=0.01,
-                          cycle_deadline=30.0, audit_every=5)
+                          cycle_deadline=30.0, audit_every=5,
+                          pipeline=pipeline)
 
         # ---- the leader lease, renewed throughout the soak ---------
         lease_dir = tempfile.mkdtemp(prefix="kb-chaos-lease-")
@@ -469,6 +501,13 @@ def run_chaos(cycles: int = 200, seed: int = 0,
         report.final_engine = _alloc_mod.last_cycle_engine
         report.final_ladder_level = faults.LADDER.level
         report.pods_bound = len(seams.snapshot_bound())
+        report.pipeline_cycles = pipeline_cycles_total() - pc0
+        report.pipeline_conflicts = pipeline_conflicts_total() - cf0
+        report.pipeline_demoted = _pipeline_mod.demoted()
+        if pipeline and not report.pipeline_cycles:
+            report.violations.append(
+                "pipelined soak never committed an overlapped cycle — "
+                "the executor never engaged (engine gates too strict?)")
 
         # ---- final invariants --------------------------------------
         check_invariants("final")
@@ -549,6 +588,8 @@ def run_chaos(cycles: int = 200, seed: int = 0,
         faults.set_backoff_policy(saved_policy)
         faults.LADDER.reset()
         faults.SIDECAR_QUARANTINE.reset()
+        if pipeline:
+            _pipeline_mod.reset()    # demotion is process-sticky
         lease_stop.set()
         if lease_thread is not None:
             lease_thread.join(timeout=5.0)

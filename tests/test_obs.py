@@ -511,3 +511,34 @@ def test_span_exception_safety():
     finally:
         obs.end_cycle(root)
     assert [c.name for c in root.children] == ["fine"]
+
+
+def test_overlapping_cycle_roots_detach_and_both_fire():
+    """Pipelined cycles overlap: cycle k+1 opens before cycle k's
+    deferred consume closes k's root. The tracer must split the two
+    into INDEPENDENT roots — the younger root is detached from the
+    elder's tree when the elder ends, later spans land under the
+    younger, and BOTH fire CYCLE_HOOKS with distinct epoch tags."""
+    fired = []
+    hook = lambda root: fired.append(root)  # noqa: E731
+    obs.CYCLE_HOOKS.append(hook)
+    try:
+        a = obs.begin_cycle(101)
+        b = obs.begin_cycle(102)       # opens while A is still live
+        assert obs.current_cycle() is b
+        obs.end_cycle(a)               # A ends first (deferred consume)
+        # B was detached from A's children and re-pushed as its own root
+        assert b not in a.children
+        assert obs.current_cycle() is b
+        assert obs.current_epoch() == b.args["epoch"]
+        with obs.span("late-apply"):
+            pass
+        obs.end_cycle(b)
+    finally:
+        obs.CYCLE_HOOKS.remove(hook)
+    assert fired == [a, b], "both overlapped roots must fire hooks"
+    assert a.args["epoch"] != b.args["epoch"]
+    # the post-overlap span belongs to the younger root's tree
+    assert [c.name for c in b.children] == ["late-apply"]
+    assert obs.current_cycle() is None
+    assert obs.last_cycle() is b

@@ -1,0 +1,231 @@
+"""Pipelined scheduling cycles (ISSUE 16; runtime/pipeline.py).
+
+Coverage per the ISSUE satellites: pipelined-vs-sequential decision
+identity over a 50-cycle quiet churn stream (same binds, no conflicts,
+the executor engaged); conflict-invalidation correctness (a conflicting
+cache event lands mid-flight — the stale result is discarded, the cycle
+re-solves sequentially, nothing double-binds and the deleted pod never
+binds); and the demotion rung (repeated ``pipeline.conflict`` seam
+fires demote the executor to the sequential loop for the rest of the
+process, while a single fire recovers).
+
+Reuses the 24-node persistent-cache harness from test_activeset /
+test_zscale_hier; the allocate engine is forced to ``activeset`` (the
+engine family the executor pipelines).
+"""
+import numpy as np
+import pytest
+
+from kubebatch_tpu import actions, faults, metrics, plugins  # noqa: F401
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.kernels import activeset
+from kubebatch_tpu.objects import PodPhase
+from kubebatch_tpu.runtime import pipeline as pipeline_mod
+from kubebatch_tpu.runtime.scheduler import Scheduler
+
+from .fixtures import GiB, build_group, build_pod, rl
+from .test_zscale_hier import _build
+
+
+@pytest.fixture(autouse=True)
+def _clean_engine_state(monkeypatch):
+    """Every test starts and ends un-demoted (pipeline AND activeset
+    rungs), injection disarmed, and the allocate engine forced to the
+    active-set family the executor pipelines."""
+    monkeypatch.setenv("KUBEBATCH_SOLVER", "activeset")
+    faults.disarm()
+    activeset.reset()
+    # the combined audit entry is test_activeset's pin; compiling it
+    # here would add ~70 s of jit for nothing this file asserts on
+    activeset.set_audit_every(0)
+    pipeline_mod.reset()
+    yield
+    faults.disarm()
+    activeset.reset()
+    activeset._audit_every = None
+    pipeline_mod.reset()
+
+
+class _Seams:
+    """Binder/evictor seam recording every bind (and catching a pod
+    bound twice — the no-double-bind invariant rides this)."""
+
+    def __init__(self):
+        self.binds = {}          # pod name -> node name
+        self.bind_events = []    # (pod name, node) in commit order
+        self.fresh = []
+
+    def bind(self, pod, hostname):
+        self.bind_events.append((pod.name, hostname))
+        self.binds[pod.name] = hostname
+        pod.node_name = hostname
+        self.fresh.append(pod)
+
+    def bind_many(self, pairs):
+        for pod, hostname in pairs:
+            self.bind(pod, hostname)
+
+    def evict(self, pod):
+        pod.deletion_timestamp = 1.0
+
+
+class _Harness:
+    """ONE persistent cache driven through a real Scheduler, with the
+    quiet churn stream the soak tests use: one fresh 2-pod gang per
+    cycle, bound pods flipped Running after each cycle."""
+
+    def __init__(self, pipeline: bool, seed: int = 5):
+        self.seams = _Seams()
+        self.cache = SchedulerCache(binder=self.seams, evictor=self.seams,
+                                    async_writeback=False)
+        _build(self.cache, n_nodes=24, n_groups=12, pods_per_group=2,
+               seed=seed, uniform_cpu=8000)
+        self.sched = Scheduler(self.cache, schedule_period=3600.0,
+                               pipeline=pipeline)
+        self.next_gid = 100
+        self.live_gangs = []
+
+    def kubelet_tick(self):
+        for pod in self.seams.fresh:
+            if pod.phase == PodPhase.PENDING and pod.node_name:
+                pod.phase = PodPhase.RUNNING
+                self.cache.update_pod(pod, pod)
+        self.seams.fresh.clear()
+
+    def add_gang(self, n_pods: int = 2):
+        g = self.next_gid
+        self.next_gid += 1
+        name = f"soak{g:03d}"
+        self.cache.add_pod_group(build_group(
+            "ns", name, 1, queue="q0", creation_timestamp=float(g)))
+        pods = []
+        for p in range(n_pods):
+            pod = build_pod("ns", f"{name}-{p}", "", PodPhase.PENDING,
+                            rl(500, GiB), group=name,
+                            creation_timestamp=float(g * 100 + p))
+            self.cache.add_pod(pod)
+            pods.append(pod)
+        self.live_gangs.append((name, pods))
+        return name, pods
+
+    def run_quiet(self, cycles: int):
+        for _ in range(cycles):
+            self.add_gang()
+            assert self.sched.run_cycle(), "quiet cycle failed"
+            self.kubelet_tick()
+
+    def drain(self):
+        if self.sched._pipeline is not None:
+            self.sched._pipeline.drain()
+            self.kubelet_tick()
+
+
+def test_quiet_stream_decisions_identical_to_sequential():
+    """The optimistic-commit soundness pin: over a 30-cycle quiet churn
+    stream the pipelined loop must produce EXACTLY the sequential
+    loop's binds — same pod -> node map — with zero conflicts, zero
+    demotions, and the executor actually engaged (pipeline_cycles
+    counts the overlapped commits)."""
+    seq = _Harness(pipeline=False, seed=5)
+    seq.run_quiet(30)
+
+    pc0 = metrics.pipeline_cycles_total()
+    cf0 = metrics.pipeline_conflicts_total()
+    dm0 = metrics.pipeline_demotions_total()
+    pipe = _Harness(pipeline=True, seed=5)
+    pipe.run_quiet(30)
+    pipe.drain()
+
+    assert metrics.pipeline_conflicts_total() - cf0 == 0, (
+        "quiet stream must not conflict (echo suppression broken?)")
+    assert metrics.pipeline_demotions_total() - dm0 == 0
+    assert not pipeline_mod.demoted()
+    engaged = metrics.pipeline_cycles_total() - pc0
+    assert engaged >= 24, (
+        f"executor committed only {engaged}/30 overlapped cycles")
+    assert pipe.seams.binds == seq.seams.binds, (
+        "pipelined binds diverged from the sequential oracle")
+    assert len(pipe.seams.bind_events) == len(pipe.seams.binds), (
+        "a pod was bound more than once")
+
+
+def test_conflict_mid_flight_invalidates_without_double_bind():
+    """A conflicting event lands while a solve is in flight: delete a
+    pending pod the in-flight decisions (very likely) placed. The
+    consume-time check must invalidate — counted under outcome
+    "conflict" — the deleted pod must never bind, no pod binds twice,
+    and the loop keeps scheduling (the re-solve is the ordinary
+    sequential cycle)."""
+    h = _Harness(pipeline=True, seed=7)
+    # steady-state warmup: get the executor dispatching
+    h.run_quiet(6)
+    assert h.sched._pipeline._pending is not None, (
+        "executor never dispatched — harness no longer reaches the "
+        "pipelined path")
+    cf0 = metrics.pipeline_conflicts_total()
+    # a fresh gang arrives and THIS cycle's solve places it (quiet
+    # cluster with headroom); delete one of its pods while the solve is
+    # in flight — the job mark is not our echo, so consume conflicts
+    name, pods = h.add_gang()
+    h.sched.run_cycle()          # dispatches with the gang pending
+    assert h.sched._pipeline._pending is not None
+    victim = pods[0]
+    h.cache.delete_pod(victim)
+    h.run_quiet(3)
+    h.drain()
+    by = metrics.pipeline_conflicts_by_outcome()
+    assert metrics.pipeline_conflicts_total() - cf0 >= 1, (
+        "mid-flight delete of an in-flight placement did not conflict")
+    assert by.get("conflict", 0) >= 1
+    assert victim.name not in h.seams.binds, (
+        "a deleted pod's stale in-flight decision was committed")
+    assert len(h.seams.bind_events) == len(h.seams.binds), (
+        "a pod was bound more than once")
+    # the invalidation is a rung, not a stop: the stream keeps binding
+    assert not pipeline_mod.demoted()
+    assert f"{name}-1" in h.seams.binds, (
+        "the surviving sibling never got scheduled after the re-solve")
+
+
+def test_seam_single_fire_recovers():
+    """One armed ``pipeline.conflict`` fire forces exactly one
+    invalidation (outcome "fault"); the next commit resets the streak
+    and the executor stays promoted."""
+    h = _Harness(pipeline=True, seed=5)
+    h.run_quiet(4)
+    cf0 = metrics.pipeline_conflicts_total()
+    pc0 = metrics.pipeline_cycles_total()
+    faults.arm(faults.FaultPlan(counts={"pipeline.conflict": 1}))
+    h.run_quiet(6)
+    faults.disarm()
+    h.drain()
+    assert metrics.pipeline_conflicts_total() - cf0 == 1
+    assert metrics.pipeline_conflicts_by_outcome().get("fault", 0) >= 1
+    assert not pipeline_mod.demoted()
+    assert metrics.pipeline_cycles_total() - pc0 >= 2, (
+        "executor never re-engaged after the forced invalidation")
+
+
+def test_conflict_storm_demotes_to_sequential():
+    """CONFLICT_STORM_LIMIT consecutive invalidations demote the
+    executor for the rest of the process: pipeline_demotions_total
+    counts reason "storm", Scheduler.run_once falls back to the
+    sequential block, and scheduling continues (binds keep landing)."""
+    h = _Harness(pipeline=True, seed=5)
+    h.run_quiet(4)
+    dm0 = metrics.pipeline_demotions_total()
+    faults.arm(faults.FaultPlan(
+        counts={"pipeline.conflict": pipeline_mod.CONFLICT_STORM_LIMIT}))
+    # each fault costs one dispatched cycle + one sequential cycle, so
+    # give the storm room to accumulate its consecutive invalidations
+    h.run_quiet(4 * pipeline_mod.CONFLICT_STORM_LIMIT)
+    faults.disarm()
+    assert pipeline_mod.demoted(), "storm did not demote the executor"
+    assert metrics.pipeline_demotions_total() - dm0 == 1
+    assert not h.sched._pipeline.active()
+    binds_at_demotion = len(h.seams.binds)
+    # demoted loop still schedules, on the sequential block
+    h.run_quiet(3)
+    assert len(h.seams.binds) > binds_at_demotion, (
+        "demoted scheduler stopped binding")
+    assert len(h.seams.bind_events) == len(h.seams.binds)

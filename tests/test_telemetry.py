@@ -432,4 +432,5 @@ def test_counters_snapshot_carries_telemetry_section():
         assert set(h) == {"buckets", "sum", "count"}
     assert "readback_accounting" in snap
     assert set(snap["readback_accounting"]) == {
-        "readbacks", "decisions", "readbacks_per_decision"}
+        "readbacks", "deferred_readbacks", "decisions",
+        "readbacks_per_decision", "total_readbacks_per_decision"}

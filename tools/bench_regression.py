@@ -83,6 +83,22 @@ ACTIVESET_BOUNDS = (("activeset.divergences", 0.0),
                     ("recompiles_total", 0.0),
                     ("readbacks_per_cycle", 1.0))
 
+#: fields a sustained-rate line (sched_sustained_..) must carry — the
+#: pipelined arm's evidence block replaces the 1-readback/cycle pin
+#: with the critical-path split: zero BLOCKING readbacks per decision
+#: while the deferred window proves the transfers still happened
+SUSTAINED_REQUIRED = ("value", "speedup_vs_sequential",
+                      "recompiles_total", "pipeline_demotions",
+                      "readbacks_per_decision", "deferred_readbacks",
+                      "pipeline.pipeline.cycles")
+
+#: absolute bounds on a sustained CANDIDATE line: no recompile after
+#: warm-up, the demotion rung never fires outside an armed plan, and
+#: the blocking-readback term stays off the pipelined critical path
+SUSTAINED_BOUNDS = (("recompiles_total", 0.0),
+                    ("pipeline_demotions", 0.0),
+                    ("readbacks_per_decision", 0.0))
+
 #: reported, warned past tolerance, never fatal (same-box numbers only)
 ADVISORY = (
     "value",
@@ -151,7 +167,20 @@ def diff_metric(metric: str, base: dict, cand: dict,
             failures.append(
                 f"{metric}: failover p99 blip {blip:g}ms exceeds the "
                 f"stated bound {bound:g}ms")
-    if "_churn" in metric:
+    if metric.startswith("sched_sustained"):
+        for key in SUSTAINED_REQUIRED:
+            if _num(cand, key) is None:
+                failures.append(
+                    f"{metric}: sustained line must carry numeric "
+                    f"'{key}' (the pipelined-arm evidence) — missing "
+                    f"from candidate")
+        for key, bound in SUSTAINED_BOUNDS:
+            c = _num(cand, key)
+            if c is not None and c > bound + EPS:
+                failures.append(
+                    f"{metric}: {key} = {c:g} exceeds the structural "
+                    f"bound {bound:g}")
+    elif "_churn" in metric:
         for key in ACTIVESET_REQUIRED:
             if _num(cand, key) is None:
                 failures.append(
