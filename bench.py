@@ -817,11 +817,11 @@ def run_arrival(config, cycles: int, churn_pods: int,
 
     from kubebatch_tpu import actions, plugins  # noqa: F401
     from kubebatch_tpu.cache import SchedulerCache
-    from kubebatch_tpu.metrics import (ARRIVAL_STATS,
-                                       arrivals_observed_total,
+    from kubebatch_tpu.metrics import (arrivals_observed_total,
                                        readback_accounting,
                                        recompiles_total,
                                        subcycles_total)
+    from kubebatch_tpu.obs import ledger as ledger_mod
     from kubebatch_tpu.objects import (GROUP_NAME_ANNOTATION, Container,
                                        Pod, PodGroup, PodPhase,
                                        resource_list)
@@ -957,40 +957,36 @@ def run_arrival(config, cycles: int, churn_pods: int,
         acct0 = readback_accounting()
         sub0 = subcycles_total()
         obs0 = arrivals_observed_total()
+        # the measurement window over the decision ledger: percentiles
+        # come from its streaming histogram (obs/ledger.py — no raw
+        # latency list anywhere; a >4096-arrival run no longer truncates
+        # the way the old ARRIVAL_STATS ring slice did)
+        win = ledger_mod.window()
         for cycle in range(3, 3 + cycles):
             drive_cycle(cycle, measure=True)
         acct = readback_accounting(since=acct0)
         recompiles = recompiles_total() - recompiles0
         subcycles = subcycles_total() - sub0
-        # windowed read off the monotonic counter: ARRIVAL_STATS is a
-        # bounded ring, so a len()-based slice under-reports once it
-        # wraps (>4096 arrivals in one run)
+        # decided = the exact monotonic counter delta; the ledger window
+        # carries the shape
         n_new = arrivals_observed_total() - obs0
-        stats = list(ARRIVAL_STATS)
-        measured = stats[-n_new:] if n_new else []
-        if n_new > len(stats):
-            print(f"arrival bench: ring kept only {len(stats)} of "
-                  f"{n_new} measured arrival latencies; percentiles "
-                  f"cover the tail", file=sys.stderr)
     finally:
         gc.enable()
     from kubebatch_tpu.metrics import recompiles_by_reason
     recompile_split = {f"{engine}/{reason}": n for (engine, reason), n
                        in recompiles_by_reason().items()}
 
-    arr_ms = np.asarray(measured) * 1e3 if measured else np.asarray([0.0])
+    arr_p50 = win.subcycle_percentile(50) or 0.0
+    arr_p99 = win.subcycle_percentile(99) or 0.0
+    arr_max = win.subcycle_max_ms() or 0.0
     return {
         "metric": f"arrival_decision_p50_ms_cfg{config}",
-        "value": round(float(np.percentile(arr_ms, 50)), 3),
+        "value": round(arr_p50, 3),
         "unit": "ms",
         # vs the 1 s schedule period the lane would otherwise wait for
-        "vs_baseline": round(1000.0
-                             / max(float(np.percentile(arr_ms, 99)),
-                                   1e-9), 4),
-        "arrival_p99_ms": round(float(np.percentile(arr_ms, 99)), 3),
-        "arrival_max_ms": round(float(np.max(arr_ms)), 3),
-        # decided = the monotonic counter delta (n_new), NOT the ring
-        # slice length — the ring caps at 4096, the exit gate must not
+        "vs_baseline": round(1000.0 / max(arr_p99, 1e-9), 4),
+        "arrival_p99_ms": round(arr_p99, 3),
+        "arrival_max_ms": round(arr_max, 3),
         "arrivals_offered": offered[0],
         "arrivals_decided": n_new,
         "subcycles": subcycles,
@@ -1053,23 +1049,21 @@ def run_sustained(config, cycles: int, mode: str,
     saved_solver = os.environ.get("KUBEBATCH_SOLVER")
 
     def run_arm(pipelined: bool) -> dict:
+        from kubebatch_tpu.obs import ledger as ledger_mod
+
         sim = baseline_cluster(config)
         binds = {}
         fresh_binds = []
-        bind_ts = {}
 
         class _B:
             def bind(self, pod, hostname):
                 binds[pod.uid] = hostname
-                bind_ts[pod.uid] = time.perf_counter()
                 pod.node_name = hostname
                 fresh_binds.append(pod)
 
             def bind_many(self, pairs):
-                now = time.perf_counter()
                 for pod, hostname in pairs:
                     binds[pod.uid] = hostname
-                    bind_ts[pod.uid] = now
                     pod.node_name = hostname
                     fresh_binds.append(pod)
 
@@ -1080,16 +1074,6 @@ def run_sustained(config, cycles: int, mode: str,
         cache = SchedulerCache(binder=seam, evictor=seam,
                                async_writeback=False)
         sim.populate(cache)
-        arrive_ts = {}
-        measuring = [False]
-
-        def _on_arrival(pod):
-            # arrival -> decision clock starts at cache ingestion, the
-            # same instant a real informer would hand the pod over
-            if measuring[0]:
-                arrive_ts[pod.uid] = time.perf_counter()
-
-        cache.arrival_hooks.append(_on_arrival)
         pipeline_mod.reset()
         sched = Scheduler(cache, scheduler_conf=conf,
                           schedule_period=3600.0, pipeline=pipelined)
@@ -1119,7 +1103,13 @@ def run_sustained(config, cycles: int, mode: str,
             dm0 = pipeline_demotions_total()
             engines = set()
             bound0 = len(binds)
-            measuring[0] = True
+            # arrival -> decision latency through the decision ledger:
+            # the cache stamps every pending arrival at ingestion and
+            # closes the record at the bind state flip — the window
+            # diffs its streaming histograms over exactly the measured
+            # cycles (the hand-rolled arrive_ts/bind_ts dicts this
+            # replaced gated on a measuring flag the same way)
+            win = ledger_mod.window()
             gc.collect()
             t0 = time.perf_counter()
             for _ in range(cycles):
@@ -1134,25 +1124,21 @@ def run_sustained(config, cycles: int, mode: str,
                 sched._pipeline.drain()
                 kubelet_tick()
             wall = time.perf_counter() - t0
-            measuring[0] = False
             acct = readback_accounting(since=acct0)
             recompiles = recompiles_total() - rc0
         finally:
             gc.enable()
-        lat = [bind_ts[u] - arrive_ts[u]
-               for u, t in arrive_ts.items()
-               if u in bind_ts and bind_ts[u] >= t]
-        lat_ms = np.asarray(lat) * 1e3 if lat else np.asarray([0.0])
         return {
             "cps": cycles / wall if wall else 0.0,
             "pods_bound_per_sec": (len(binds) - bound0) / wall
             if wall else 0.0,
             "wall_s": round(wall, 3),
             "arrival_decision_p50_ms": round(
-                float(np.percentile(lat_ms, 50)), 3),
+                win.percentile(50) or 0.0, 3),
             "arrival_decision_p99_ms": round(
-                float(np.percentile(lat_ms, 99)), 3),
-            "arrivals_decided": len(lat),
+                win.percentile(99) or 0.0, 3),
+            "arrivals_decided": win.closed(),
+            "ledger_deferred_closed": win.deferred_closed(),
             "engines": sorted(engines),
             "recompiles": recompiles,
             "readback_accounting": acct,
@@ -1194,7 +1180,182 @@ def run_sustained(config, cycles: int, mode: str,
         "pipeline_demotions": pipe["pipeline"]["demotions"],
         "readbacks_per_decision": p_acct["readbacks_per_decision"],
         "deferred_readbacks": p_acct["deferred_readbacks"],
+        # the ledger evidence (ISSUE 17): decided counts and the
+        # pipelined arm's arrival -> bind p99 now come from the decision
+        # ledger's streaming histograms (bench_regression requires them)
+        "ledger": {
+            "decided": pipe["arrivals_decided"],
+            "deferred_closed": pipe["ledger_deferred_closed"],
+            "arrival_decision_p50_ms": pipe["arrival_decision_p50_ms"],
+            "arrival_decision_p99_ms": pipe["arrival_decision_p99_ms"],
+        },
     }
+
+
+def run_soak(config, cycles: int, churn_pods: int,
+             timeline_dir: str = "") -> dict:
+    """Long-horizon soak (ISSUE 17): one steady churn regime driven for
+    ``cycles`` scheduler cycles (default 10k from the CLI) with the SLO
+    burn-rate plane armed on the shipped objectives and the timeline
+    spilling per-cycle digests to ``timeline_dir`` — a multi-hour run
+    produces a replayable JSONL record at O(1) resident memory, and the
+    evidence line carries the SLO report, the drift counter and the
+    ledger percentiles. The caller (main) hard-exits on any breach,
+    drift firing, or measured-window recompile."""
+    import gc
+
+    from kubebatch_tpu import actions, compilesvc, plugins  # noqa: F401
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.metrics import (readback_accounting,
+                                       recompiles_total,
+                                       slo_breaches_by_objective,
+                                       slo_breaches_total,
+                                       timeline_drift_by_kind,
+                                       timeline_drift_total)
+    from kubebatch_tpu.objects import PodPhase
+    from kubebatch_tpu.obs import ledger as ledger_mod
+    from kubebatch_tpu.obs import slo as slo_mod
+    from kubebatch_tpu.obs import timeline as timeline_mod
+    from kubebatch_tpu.runtime.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                                 Scheduler)
+    from kubebatch_tpu.sim import baseline_cluster
+
+    actions_line = ", ".join(CONFIG_ACTIONS[config])
+    conf = DEFAULT_SCHEDULER_CONF.replace(
+        'actions: "allocate, backfill"', f'actions: "{actions_line}"')
+    sim = baseline_cluster(config)
+    binds = {}
+    fresh_binds = []
+
+    class _B:
+        def bind(self, pod, hostname):
+            binds[pod.uid] = hostname
+            pod.node_name = hostname
+            fresh_binds.append(pod)
+
+        def bind_many(self, pairs):
+            for pod, hostname in pairs:
+                self.bind(pod, hostname)
+
+        def evict(self, pod):
+            pod.deletion_timestamp = 1.0
+
+    seam = _B()
+    cache = SchedulerCache(binder=seam, evictor=seam,
+                           async_writeback=False)
+    sim.populate(cache)
+    sched = Scheduler(cache, scheduler_conf=conf, schedule_period=3600.0)
+
+    def kubelet_tick():
+        for pod in fresh_binds:
+            if pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                cache.update_pod(pod, pod)
+        fresh_binds.clear()
+
+    cycle_hist = ledger_mod.StreamHist()   # O(1) cycle-wall record
+    gc.disable()
+    try:
+        for _ in range(2):              # settle the initial backlog
+            sched.run_cycle()
+            kubelet_tick()
+        for _ in range(3):              # trace every steady churn shape
+            kubelet_tick()
+            sim.churn_tick(cache, churn_pods)
+            sched.run_cycle()
+            kubelet_tick()
+        compilesvc.mark_warm()
+        rc0 = recompiles_total()
+        acct0 = readback_accounting()
+        slo0 = slo_breaches_total()
+        drift0 = timeline_drift_total()
+        # the observability planes under test: cycle-hooked SLO
+        # evaluation + the spilling timeline (window state fresh from
+        # here — pre-arm history never counts into a burn window). The
+        # baseline cluster is 2x oversubscribed, so churned gangs queue
+        # behind the backlog for seconds BY DESIGN — the arrival
+        # objective gets a saturation-calibrated floor (the headroom
+        # regimes keep the production 5 s bound); relative latency rot
+        # is the timeline drift rung's job
+        import dataclasses as _dc
+        timeline_mod.arm(timeline_dir or None)
+        slo_mod.arm(tuple(
+            _dc.replace(o, threshold_ms=max(o.threshold_ms, 60000.0))
+            if o.name == "arrival_decision_p99" else o
+            for o in slo_mod.DEFAULT_OBJECTIVES))
+        win = ledger_mod.window()
+        gc.collect()
+        t0 = time.perf_counter()
+        for _ in range(cycles):
+            kubelet_tick()
+            sim.churn_tick(cache, churn_pods)
+            c0 = time.perf_counter()
+            sched.run_cycle()
+            cycle_hist.observe(time.perf_counter() - c0)
+            kubelet_tick()
+        wall = time.perf_counter() - t0
+        acct = readback_accounting(since=acct0)
+        recompiles = recompiles_total() - rc0
+    finally:
+        gc.enable()
+        timeline_mod.flush()
+        tstats = timeline_mod.stats()
+        slo_snap = slo_mod.snapshot()
+        slo_mod.disarm()
+        timeline_mod.disarm()
+
+    _, _, cyc_buckets = cycle_hist.snapshot()
+    breaches = slo_breaches_total() - slo0
+    drift = timeline_drift_total() - drift0
+    out = {
+        "metric": f"sched_soak_cfg{config}_cycles{cycles}",
+        "value": round(cycles / wall, 3) if wall else 0.0,
+        "unit": "cycles/s",
+        # vs the 1 cycle/s north-star budget
+        "vs_baseline": round(cycles / wall, 4) if wall else 0.0,
+        "measured_cycles": cycles,
+        "churn_pods": churn_pods,
+        "wall_s": round(wall, 3),
+        "cycle_p50_ms": round(
+            (ledger_mod._pct_from_counts(cyc_buckets, 50) or 0.0) * 1e3,
+            3),
+        "cycle_p99_ms": round(
+            (ledger_mod._pct_from_counts(cyc_buckets, 99) or 0.0) * 1e3,
+            3),
+        "slo_report": {
+            "breaches_total": breaches,
+            "by_objective": slo_breaches_by_objective(),
+            "objectives": [
+                {"name": o["name"],
+                 "breached": o["breached"],
+                 "fast_burn": o["windows"]["fast"]["burn"],
+                 "slow_burn": o["windows"]["slow"]["burn"]}
+                for o in slo_snap.get("objectives", [])],
+        },
+        "timeline_drift_total": drift,
+        "timeline_drift_by_kind": timeline_drift_by_kind(),
+        "timeline": {
+            "path": (timeline_mod.TIMELINE.path or ""),
+            "ticks": tstats["ticks"],
+            "spilled": tstats["spilled"],
+            "ring": tstats["ring"],
+            "rss_mb_fast": tstats["rss_mb_fast"],
+            "rss_mb_slow": tstats["rss_mb_slow"],
+            "cycle_ms_fast": tstats["cycle_ms_fast"],
+            "cycle_ms_slow": tstats["cycle_ms_slow"],
+        },
+        "recompiles_total": recompiles,
+        "ledger": {
+            "decided": win.closed(),
+            "arrival_decision_p50_ms": round(win.percentile(50) or 0.0,
+                                             3),
+            "arrival_decision_p99_ms": round(win.percentile(99) or 0.0,
+                                             3),
+        },
+        "readback_accounting": acct,
+        "readbacks_per_decision": acct["readbacks_per_decision"],
+    }
+    return out
 
 
 def main(argv=None):
@@ -1303,9 +1464,15 @@ def main(argv=None):
                     metavar="CHURN_PODS",
                     help="churn pods per cycle for --mode sustained "
                          "(default 256)")
+    ap.add_argument("--timeline-dir", default="", metavar="DIR",
+                    help="with --mode soak: spill the per-cycle timeline "
+                         "digests (obs/timeline.py) to DIR/timeline.jsonl "
+                         "— the replayable long-horizon record; empty = "
+                         "ring-only (memory stays bounded either way)")
     ap.add_argument("--mode", default="auto",
                     choices=["auto", "batched", "sharded", "hier", "fused",
-                             "jax", "host", "rpc", "arrival", "sustained"],
+                             "jax", "host", "rpc", "arrival", "sustained",
+                             "soak"],
                     help="allocate engine: auto = size-based selection "
                          "(the shipped default); batched = round-based "
                          "throughput engine (policy-exact, order-"
@@ -1323,7 +1490,11 @@ def main(argv=None):
                        # sustained: long enough that in-window arrivals
                        # drain through the saturated backlog and get a
                        # decision inside the measured window
-                       else 40 if args.mode == "sustained" else 6)
+                       else 40 if args.mode == "sustained"
+                       # soak: the long-horizon default (ISSUE 17) —
+                       # deep enough that the timeline ring wraps and
+                       # the drift EWMAs leave their warm-up
+                       else 10000 if args.mode == "soak" else 6)
 
     from kubebatch_tpu import enable_persistent_compile_cache
     enable_persistent_compile_cache()
@@ -1563,6 +1734,37 @@ def main(argv=None):
                 f"critical-path term must be gone)")
         for msg in failed:
             print(f"sustained bench: {msg}", file=sys.stderr)
+        return 1 if failed else 0
+
+    if args.mode == "soak":
+        # the long-horizon soak line (ISSUE 17): SLO plane + timeline
+        # armed over a multi-thousand-cycle steady regime; the evidence
+        # lands FIRST, then any breach / drift / recompile fails the run
+        out = run_soak(args.config, max(args.cycles, 128),
+                       churn_pods=args.sustained_churn,
+                       timeline_dir=args.timeline_dir)
+        out["backend"] = backend
+        from kubebatch_tpu.metrics import compile_ms_total
+        out["compile_ms_total"] = round(compile_ms_total(), 1)
+        emit(out)
+        failed = []
+        if out["slo_report"]["breaches_total"]:
+            failed.append(
+                f"{out['slo_report']['breaches_total']} SLO breach "
+                f"window count(s): "
+                f"{out['slo_report']['by_objective']}")
+        if out["timeline_drift_total"]:
+            failed.append(
+                f"timeline drift fired {out['timeline_drift_total']} "
+                f"time(s): {out['timeline_drift_by_kind']}")
+        if out["recompiles_total"]:
+            failed.append(f"{out['recompiles_total']} recompiles after "
+                          f"warm-up")
+        if not out["ledger"]["decided"]:
+            failed.append("soak window closed no ledger records — the "
+                          "churn regime bound nothing?")
+        for msg in failed:
+            print(f"soak bench: {msg}", file=sys.stderr)
         return 1 if failed else 0
 
     if args.mode == "arrival":

@@ -38,6 +38,7 @@ from ..faults import check as _fault_check
 from ..objects import (Node, Pod, PodDisruptionBudget, PodGroup,
                        PodGroupPhase, PodPhase, PriorityClass, Queue,
                        UNSCHEDULABLE_CONDITION)
+from ..obs import ledger as _ledger
 from ..obs import span as _span
 from ..util import env_on
 from .eventfold import EventFold
@@ -432,8 +433,13 @@ class SchedulerCache:
     def _fire_arrival_hooks(self, pod: Pod) -> None:
         """Notify arrival observers (the schedule-on-arrival sub-cycle)
         about a freshly-added PENDING pod — OUTSIDE the cache lock: the
-        hook opens a session, which re-enters the cache."""
-        if not self.arrival_hooks or pod.phase != PodPhase.PENDING:
+        hook opens a session, which re-enters the cache. The ledger
+        arrival stamp fires here too, hook list or not: every PENDING
+        pod's decision clock starts at ingestion."""
+        if pod.phase != PodPhase.PENDING:
+            return
+        _ledger.stamp_arrival(pod)
+        if not self.arrival_hooks:
             return
         for hook in list(self.arrival_hooks):
             try:
@@ -462,6 +468,9 @@ class SchedulerCache:
         with self._lock:
             self._delete_pod_locked(pod)
             self.fold.record("pod.delete")
+        # a pod deleted while pending will never bind: drop its open
+        # ledger record instead of leaving it to the MAX_OPEN evictor
+        _ledger.discard(pod.uid)
 
     def _delete_pod_locked(self, pod: Pod) -> None:
         """ref: event_handlers.go:151-171 — prefer the cache's own task (it
@@ -643,6 +652,7 @@ class SchedulerCache:
     def bind(self, ti: TaskInfo, hostname: str) -> None:
         """Local state flips to Binding under the lock; the API call runs
         async with resync-on-failure (ref: cache.go:392-432)."""
+        _ledger.stage_mark("apply")
         with self._lock:
             job, task = self._find_job_and_task(ti)
             node = self.nodes.get(hostname)
@@ -657,6 +667,9 @@ class SchedulerCache:
             self.fold.record("bind")
             pod = task.pod
 
+        # the decision is durably applied at the state flip above — the
+        # ledger closes HERE, not at the async API write-back
+        _ledger.close(pod)
         self._submit(lambda: self._bind_one(task, pod, hostname))
 
     def _bind_one(self, task: TaskInfo, pod, hostname: str) -> None:
@@ -692,6 +705,9 @@ class SchedulerCache:
 
         submits = []
         binding = TaskStatus.BINDING
+        # ledger: "apply" is stamped at ENTRY (per-pod closes happen
+        # inside the span below, before its exit could stamp anything)
+        _ledger.stage_mark("apply")
         # the "apply" phase: grouped column updates under ONE lock hold —
         # the decision-apply share of the steady host split
         # (bench host_share split; ISSUE 9 tentpole part 3)
@@ -846,6 +862,11 @@ class SchedulerCache:
             submits.extend((t, t.pod, h) for t, h in zip(twins, hostnames))
             self.fold.record("bind", n=len(submits))
 
+        # per-pod ledger closes at the state flip (outside the lock —
+        # the records are already durably applied above)
+        if _ledger.enabled():
+            for t in twins:
+                _ledger.close(t.pod)
         self._submit_binds(submits)
 
     def _submit_binds(self, submits: List[tuple]) -> None:

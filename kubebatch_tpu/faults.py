@@ -101,10 +101,16 @@ SEAMS: Dict[str, str] = {
                       "target answers, late — an injected pre-wire delay; "
                       "health-weighted routing must drain the slow "
                       "sidecar BEFORE its breaker ever trips",
+    "obs.slo": "SLO plane evaluation tick (obs/slo.py — a fired seam "
+               "forces a synthetic breach through the REAL fire path: "
+               "slo_breaches_total increments and the flight recorder "
+               "dumps, without any objective burning; demote-not-raise "
+               "like cache.fold — the breach machinery must never "
+               "corrupt a scheduling cycle)",
 }
 
 FAMILIES = ("device", "rpc", "cache", "source", "lease", "fleet",
-            "solve", "pipeline")
+            "solve", "pipeline", "obs")
 
 
 class FaultInjected(RuntimeError):

@@ -562,8 +562,11 @@ _audit_cycles = 0
 _audit_failures = 0
 _fold_demotions: dict = {}
 
-#: bounded ring of arrival -> decision latencies (seconds) observed by
-#: the schedule-on-arrival sub-cycle; consumers read percentiles
+#: DEPRECATED (ISSUE 17): the raw-list arrival reservoir. The sub-cycle
+#: arrival latencies now stream into the decision ledger's log-bucketed
+#: histogram (obs/ledger.py) — O(1) memory, windowed percentile reads —
+#: and nothing appends here anymore. The name survives one deprecation
+#: round for import compatibility; it stays empty.
 ARRIVAL_STATS: "_deque" = _deque(maxlen=4096)
 
 
@@ -757,35 +760,96 @@ _arrivals_observed = 0
 
 
 def observe_arrival_latency(seconds: float) -> None:
-    """Record one latency-lane arrival -> decision duration (sub-cycle)."""
+    """Record one latency-lane arrival -> decision duration (sub-cycle).
+    The exact COUNT lives here; the latency shape streams into the
+    decision ledger's histogram (obs/ledger.py — the ISSUE 17 replacement
+    for the deprecated ARRIVAL_STATS raw list)."""
     global _arrivals_observed
     with _robust_lock:
         _arrivals_observed += 1
-    ARRIVAL_STATS.append(seconds)
+    try:                                   # lazy: obs imports metrics
+        from .obs import ledger as _ledger
+        _ledger.observe_subcycle_arrival(seconds)
+    except Exception:                      # pragma: no cover — import race
+        pass
     if _PROM:
         arrival_latency.observe(seconds * 1e3)
 
 
 def arrivals_observed_total() -> int:
-    """Monotonic count of recorded arrival latencies (ARRIVAL_STATS is
-    a bounded ring, so ``len()`` stops growing once it wraps — windowed
-    consumers diff THIS counter instead)."""
+    """Monotonic count of recorded arrival latencies (the ledger
+    histogram is process-lifetime too — windowed consumers diff THIS
+    counter or take a ledger window)."""
     with _robust_lock:
         return _arrivals_observed
 
 
 def arrival_latency_percentiles() -> dict:
-    """p50/p99 (ms) of the recent sub-cycle arrival -> decision
-    latencies; empty dict when no sub-cycle ran."""
-    stats = list(ARRIVAL_STATS)
-    if not stats:
+    """p50/p99 (ms) of the sub-cycle arrival -> decision latencies via
+    the decision ledger (bucket-resolution percentiles, ~9% relative);
+    empty dict when no sub-cycle ran. Keys are byte-compatible with the
+    pre-ledger reservoir read; "arrivals" stays the exact count."""
+    with _robust_lock:
+        n = _arrivals_observed
+    if not n:
         return {}
-    import numpy as _np
+    try:                                   # lazy: obs imports metrics
+        from .obs import ledger as _ledger
+        pct = _ledger.subcycle_percentiles()
+    except Exception:                      # pragma: no cover — import race
+        pct = None
+    if not pct:
+        return {}
+    return {"arrivals": n,
+            "arrival_ms_p50": pct["p50_ms"],
+            "arrival_ms_p99": pct["p99_ms"]}
 
-    ms = _np.asarray(stats) * 1e3
-    return {"arrivals": len(stats),
-            "arrival_ms_p50": round(float(_np.percentile(ms, 50)), 3),
-            "arrival_ms_p99": round(float(_np.percentile(ms, 99)), 3)}
+
+# ---------------------------------------------------------------------------
+# SLO breaches + timeline drift (ISSUE 17): the counters the soak gate
+# and tools/bench_regression.py hard-pin; obs/slo.py and obs/timeline.py
+# increment them, the snapshot serves them as OpenMetrics counters
+# ---------------------------------------------------------------------------
+
+_slo_breaches: dict = {}
+_timeline_drift: dict = {}
+
+
+def count_slo_breach(objective: str, window: str) -> None:
+    """Record one SLO burn-rate breach for ``objective`` in ``window``
+    ("fast"/"slow" — a full breach fires both; obs/slo.py single-fires
+    per episode)."""
+    with _robust_lock:
+        key = f"{objective}/{window}"
+        _slo_breaches[key] = _slo_breaches.get(key, 0) + 1
+
+
+def slo_breaches_total() -> int:
+    with _robust_lock:
+        return sum(_slo_breaches.values())
+
+
+def slo_breaches_by_objective() -> dict:
+    """Per-(objective, window) breach counts, keys "objective/window"."""
+    with _robust_lock:
+        return dict(_slo_breaches)
+
+
+def count_timeline_drift(kind: str) -> None:
+    """Record one timeline EWMA drift firing (``kind`` = "cycle_ms" /
+    "rss_mb" — the long-soak silent-degradation rung)."""
+    with _robust_lock:
+        _timeline_drift[kind] = _timeline_drift.get(kind, 0) + 1
+
+
+def timeline_drift_total() -> int:
+    with _robust_lock:
+        return sum(_timeline_drift.values())
+
+
+def timeline_drift_by_kind() -> dict:
+    with _robust_lock:
+        return dict(_timeline_drift)
 
 
 _solver_kernel_seconds = 0.0
@@ -1107,6 +1171,10 @@ def counters_snapshot(include_rpc: bool = True) -> dict:
         "pipeline_conflicts_total": pipeline_conflicts_total(),
         "pipeline_conflicts_by_outcome": pipeline_conflicts_by_outcome(),
         "pipeline_demotions_total": pipeline_demotions_total(),
+        "slo_breaches_total": slo_breaches_total(),
+        "slo_breaches_by_objective": slo_breaches_by_objective(),
+        "timeline_drift_total": timeline_drift_total(),
+        "timeline_drift_by_kind": timeline_drift_by_kind(),
         "telemetry": telemetry_snapshot(),
     }
     snap["readback_accounting"] = readback_accounting()
@@ -1134,6 +1202,19 @@ def counters_snapshot(include_rpc: bool = True) -> dict:
     try:                                   # lazy: obs imports metrics
         from .obs import spans as _spans
         snap["tracer"] = _spans.tracer_stats()
+    except Exception:                      # pragma: no cover — import race
+        pass
+    try:                                   # lazy: the ISSUE 17 planes
+        from .obs import ledger as _ledger, slo as _slo, \
+            timeline as _timeline
+        lstats = _ledger.stats()
+        if lstats.get("closed_total"):
+            snap["ledger"] = lstats
+        slo_section = _slo.metrics_section()
+        if slo_section:
+            snap["slo"] = slo_section
+        if _timeline.armed():
+            snap["timeline"] = _timeline.stats()
     except Exception:                      # pragma: no cover — import race
         pass
     return snap

@@ -15,7 +15,16 @@ The observability subsystem (ISSUE 7 / docs/OBSERVABILITY.md):
   readback, never on the steady path);
 - :mod:`.telemetry` — host decode of the device telemetry frame every
   engine appends to its packed result (rides the one readback);
-- :mod:`.http`    — /metrics, /healthz, /debug/vars, /debug/explain.
+- :mod:`.ledger`  — the per-pod decision-latency ledger (arrival ->
+  fold -> pack -> solve -> apply -> bind) closing into log-bucketed
+  streaming histograms keyed (lane, tenant, engine);
+- :mod:`.slo`     — declarative latency objectives over the ledger,
+  evaluated as multi-window burn rates; breaches fire the flight
+  recorder + slo_breaches_total and serve on /debug/slo;
+- :mod:`.timeline` — bounded ring of per-cycle digests with JSONL
+  spill and an EWMA drift rung (the ≥10k-cycle soak substrate);
+- :mod:`.http`    — /metrics, /healthz, /debug/vars, /debug/explain,
+  /debug/slo.
 
 Import discipline: this package imports only metrics (and jax, which
 every kernel module already pays for); actions/kernels/rpc import obs,
@@ -39,3 +48,15 @@ __all__ = ["CYCLE_HOOKS", "Span", "add_event", "arm_profile",
            "tracer_stats"]
 
 from . import telemetry  # noqa: E402  (see import discipline above)
+from . import ledger  # noqa: E402  (same discipline: metrics-only deps)
+from . import slo  # noqa: E402
+from . import timeline  # noqa: E402
+from .spans import SPAN_HOOKS  # noqa: E402
+
+# the ledger's stage stamps ride span exits; registered HERE (not at
+# ledger import) so a direct `import kubebatch_tpu.obs.ledger` in a
+# tool can read histograms without arming the hot-path hook twice
+if ledger.on_span_exit not in SPAN_HOOKS:
+    SPAN_HOOKS.append(ledger.on_span_exit)
+
+__all__ += ["SPAN_HOOKS", "ledger", "slo", "timeline"]

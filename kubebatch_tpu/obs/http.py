@@ -153,6 +153,14 @@ class _Handler(BaseHTTPRequestHandler):
                     })
                 else:
                     self._send_json(snap)
+            elif path == "/debug/slo":
+                from . import ledger as _ledger
+                from . import slo as _slo
+                # the SLO plane's live burn rates plus the ledger
+                # counters the objectives evaluate over (ISSUE 17)
+                payload = _slo.snapshot()
+                payload["ledger"] = _ledger.stats()
+                self._send_json(payload)
             elif path == "/metrics":
                 try:
                     from prometheus_client import (REGISTRY,
@@ -166,7 +174,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send_json({"error": "not found", "endpoints": [
                     "/metrics", "/healthz", "/debug/vars",
-                    "/debug/explain"]}, code=404)
+                    "/debug/explain", "/debug/slo"]}, code=404)
         except BrokenPipeError:            # pragma: no cover — client gone
             pass
         except Exception as e:             # a debug surface never crashes

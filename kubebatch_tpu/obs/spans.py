@@ -44,7 +44,7 @@ __all__ = ["Span", "span", "begin_cycle", "end_cycle", "current_cycle",
            "current_epoch", "last_cycle", "set_enabled", "enabled",
            "cycle", "begin_server_root", "end_server_root", "graft",
            "add_event", "arm_profile", "span_overhead_estimate",
-           "CYCLE_HOOKS", "tracer_stats", "spans_total"]
+           "CYCLE_HOOKS", "SPAN_HOOKS", "tracer_stats", "spans_total"]
 
 _perf = time.perf_counter
 
@@ -118,6 +118,13 @@ _ENABLED = True
 #: hooks called with the finished root span at every cycle end (flight
 #: recorder + trace exporter register here; hooks must never raise)
 CYCLE_HOOKS: List[Callable[[Span], None]] = []
+
+#: hooks called with EVERY finished span on a clean exit (the decision
+#: ledger stamps stage transitions here — obs/ledger.py registers at
+#: import). The empty-list check is the only hot-path cost; a
+#: registered hook shares the per-span overhead budget test_obs pins,
+#: so hooks must be a few dict ops at most and must never raise.
+SPAN_HOOKS: List[Callable[[Span], None]] = []
 
 #: the most recent finished cycle root on ANY thread (diagnostics; the
 #: scheduler is single-threaded so last-writer-wins is exact there)
@@ -269,6 +276,14 @@ class _SpanCtx:
             view = _DERIVED.get(sp.cat)
             if view is not None:
                 view(sp)
+            if SPAN_HOOKS and exc_type is None:
+                # clean exits only: an aborted dispatch must not stamp a
+                # ledger stage it never completed
+                try:
+                    for hook in SPAN_HOOKS:
+                        hook(sp)
+                except Exception:          # pragma: no cover — hook bug
+                    pass
         if not _ENABLED or (self._pushed and not _stack()):
             sp.children = []               # retention off / rootless: drop
 

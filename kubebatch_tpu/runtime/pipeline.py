@@ -242,8 +242,15 @@ class PipelinedExecutor:
             outcome = "conflict"
         if outcome is None:
             count_pipeline_cycle()
-            replay_decisions(ssn, pend.inputs, task_state, task_node,
-                             task_seq)
+            # ledger: binds replayed here consume epoch k's solve inside
+            # cycle k+1 — attribute fold/pack/solve to the LAUNCHING
+            # epoch and flag the records deferred (the invalidated path
+            # below replays nothing, so it closes nothing: the
+            # sequential re-solve closes those records normally)
+            from ..obs import ledger as _ledger
+            with _ledger.attribute(pend.epoch, deferred=True):
+                replay_decisions(ssn, pend.inputs, task_state, task_node,
+                                 task_seq)
             self._echo.append((fp_jobs, fp_nodes))
             self._streak = 0
             return False

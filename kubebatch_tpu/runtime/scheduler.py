@@ -63,7 +63,8 @@ class Scheduler:
                  audit_every: Optional[int] = None,
                  solve_audit_every: Optional[int] = None,
                  subcycle: Optional[bool] = None,
-                 pipeline: Optional[bool] = None):
+                 pipeline: Optional[bool] = None,
+                 slo: Optional[bool] = None):
         self.cache = cache
         self.schedule_period = schedule_period
         self.enable_preemption = enable_preemption
@@ -138,6 +139,22 @@ class Scheduler:
         if self.pipeline_enabled:
             from .pipeline import PipelinedExecutor
             self._pipeline = PipelinedExecutor(self)
+        #: SLO burn-rate plane (ISSUE 17; obs/slo.py): armed explicitly
+        #: per scheduler — the cycle hook evaluates the shipped
+        #: objectives over the decision ledger; disarmed it costs
+        #: nothing. KUBEBATCH_TIMELINE_DIR also arms the long-horizon
+        #: timeline spill (obs/timeline.py) for soak runs.
+        if slo is None:
+            from ..util import env_on
+            slo = env_on("KUBEBATCH_SLO", default="0")
+        self.slo_enabled = bool(slo)
+        if self.slo_enabled:
+            from ..obs import slo as _slo
+            _slo.arm()
+        tdir = os.environ.get("KUBEBATCH_TIMELINE_DIR", "")
+        if tdir:
+            from ..obs import timeline as _timeline
+            _timeline.arm(tdir)
 
     @staticmethod
     def _load_conf(conf_str: str):

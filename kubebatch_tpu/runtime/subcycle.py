@@ -26,8 +26,9 @@ narrow allocate can run the moment the pod lands:
 
 Each sub-cycle runs under its own obs cycle root (name "subcycle"), so
 it shows up as a separate root in Chrome traces and the flight ring;
-arrival -> decision latency feeds ``metrics.ARRIVAL_STATS``
-(``subcycle_arrival`` percentiles on /debug/vars).
+arrival -> decision latency streams into the decision ledger
+(obs/ledger.py; ``subcycle_arrival`` percentiles on /debug/vars — the
+raw-list ``metrics.ARRIVAL_STATS`` reservoir is deprecated).
 """
 from __future__ import annotations
 
@@ -44,13 +45,15 @@ from ..objects import Pod
 log = logging.getLogger("kubebatch.subcycle")
 
 #: pod annotation carrying the service lane — same vocabulary as the
-#: tenantsvc rpc lanes (kb-lane metadata: latency > normal > batch)
-LANE_ANNOTATION = "scheduling.k8s.io/kube-batch/lane"
-LATENCY_LANE = "latency"
+#: tenantsvc rpc lanes (kb-lane metadata: latency > normal > batch).
+#: Single-sourced in obs/ledger.py (the ledger keys histograms by lane);
+#: re-exported here for the existing import sites
+from ..obs.ledger import (DEFAULT_LANE, LANE_ANNOTATION,  # noqa: E402
+                          LATENCY_LANE)
 
 
 def pod_lane(pod: Pod) -> str:
-    return pod.annotations.get(LANE_ANNOTATION, "normal")
+    return pod.annotations.get(LANE_ANNOTATION, DEFAULT_LANE)
 
 
 def is_latency_pod(pod: Pod) -> bool:

@@ -27,6 +27,7 @@ import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
+from dataclasses import replace as _dc_replace
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -91,6 +92,12 @@ DEFAULT_COUNTS: Dict[str, int] = {
     # (otherwise the consume path never crosses it), so arming it in
     # the default plan is free
     "pipeline.conflict": 1,
+    # SLO-plane breach path (ISSUE 17): the obs.slo seam fires once in
+    # the evaluation tick, forcing a synthetic breach through the real
+    # fire path (slo_breaches_total + flight dump) — the soak proves the
+    # breach machinery itself cannot corrupt a cycle, and the report
+    # pins every breach in the run to exactly the injected ones
+    "obs.slo": 1,
 }
 
 #: the smoke-test subset: no device/rpc seams, so the ladder never
@@ -185,6 +192,14 @@ class ChaosReport:
     pipeline_demoted: bool = False
     lease_lost: bool = False
     lease_renew_attempts: int = 0
+    #: decision-ledger audit (ISSUE 17): closed records for the soak's
+    #: bound pods, deferred (pipelined-consume) closes among them, and
+    #: the SLO-breach accounting — every breach in the run must be one
+    #: the armed obs.slo seam injected (2 window counts per fire)
+    ledger_closed: int = 0
+    ledger_deferred_closed: int = 0
+    slo_breaches: int = 0
+    slo_injected: int = 0
     #: unschedulability-explainer lines for pods still pending after the
     #: quiesce window (obs/explain.py) — the sim-summary form of
     #: kube-batch's per-pod Unschedulable events
@@ -235,7 +250,10 @@ def run_chaos(cycles: int = 200, seed: int = 0,
     invariant bar.
     """
     from ..actions import allocate as _alloc_mod
+    from .. import metrics
     from ..metrics import (pipeline_conflicts_total, pipeline_cycles_total)
+    from ..obs import ledger as _ledger
+    from ..obs import slo as _slo
     from ..runtime import pipeline as _pipeline_mod
 
     report = ChaosReport(cycles=cycles, seed=seed)
@@ -292,6 +310,12 @@ def run_chaos(cycles: int = 200, seed: int = 0,
             return report
 
         # ---- the live stack: source -> cache -> scheduler ----------
+        # ledger audit mode AFTER the baseline fingerprint (its binds
+        # must not pollute the soak's closed-record set): every pod the
+        # live stack binds must close a ledger record — checked against
+        # seams.snapshot_bound() in the final invariants
+        _ledger.reset()
+        _ledger.retain()
         sim = build_cluster(chaos_spec(seed))
         seams = _RecordingSeams()
         cache = SchedulerCache(binder=seams, evictor=seams,
@@ -318,9 +342,20 @@ def run_chaos(cycles: int = 200, seed: int = 0,
             _pipeline_mod.reset()     # soak starts un-demoted
         pc0 = pipeline_cycles_total()
         cf0 = pipeline_conflicts_total()
+        slo0 = metrics.slo_breaches_total()
         sched = Scheduler(cache, schedule_period=0.01,
                           cycle_deadline=30.0, audit_every=5,
                           pipeline=pipeline)
+        # SLO plane with chaos-calibrated ledger thresholds: injected
+        # fault windows legitimately hold pods pending for seconds
+        # (retry backoff, recovery sleeps), which the production
+        # arrival bounds would count as organic breaches — here the
+        # gate is "no breach beyond the armed obs.slo seam's", so the
+        # arrival objectives must only ever fire through the seam
+        _slo.arm(tuple(
+            _dc_replace(o, threshold_ms=max(o.threshold_ms, 120000.0))
+            if o.kind == "ledger" else o
+            for o in _slo.DEFAULT_OBJECTIVES))
 
         # ---- the leader lease, renewed throughout the soak ---------
         lease_dir = tempfile.mkdtemp(prefix="kb-chaos-lease-")
@@ -564,6 +599,53 @@ def run_chaos(cycles: int = 200, seed: int = 0,
                 "leadership lost during the soak (injected renew faults "
                 "must heal inside the deadline, never accumulate to loss)")
 
+        # ---- decision-ledger audit (ISSUE 17) ----------------------
+        # BEFORE the recovery fingerprint: its fresh stack would pour
+        # unrelated closes into the retained ring. Every pod this soak
+        # bound must hold ONE closed record with monotone stage stamps;
+        # deferred closes must appear iff the pipelined path committed.
+        records = {r["uid"]: r for r in _ledger.retained()}
+        report.ledger_closed = len(records)
+        report.ledger_deferred_closed = sum(
+            1 for r in records.values() if r["deferred"])
+        for uid in seams.snapshot_bound():
+            rec = records.get(uid)
+            if rec is None:
+                pod = pods_by_uid.get(uid)
+                name = (f"{pod.namespace}/{pod.name}" if pod is not None
+                        else uid)
+                report.violations.append(
+                    f"bound pod has no closed ledger record: {name}")
+                continue
+            ts = rec["arrival"]
+            for stage, v in rec["stages"]:
+                if v < ts:
+                    report.violations.append(
+                        f"ledger stamps not monotone for {uid}: "
+                        f"{stage} at {v} after {ts}")
+                ts = v
+            if rec["bind"] < ts:
+                report.violations.append(
+                    f"ledger bind precedes last stage for {uid}")
+        if (report.pipeline_cycles
+                and not report.ledger_deferred_closed):
+            report.violations.append(
+                "pipelined cycles committed but no ledger record was "
+                "closed as deferred — the attribution context never "
+                "reached replay_decisions")
+        # SLO accounting: each obs.slo seam fire forces one synthetic
+        # breach = 2 window counts; anything beyond that is a real
+        # (unexplained) breach of the conservative default objectives
+        report.slo_breaches = metrics.slo_breaches_total() - slo0
+        report.slo_injected = report.faults_injected.get("obs.slo", 0)
+        unexplained = report.slo_breaches - 2 * report.slo_injected
+        if unexplained:
+            report.violations.append(
+                f"unexplained SLO breaches during the soak: "
+                f"{unexplained} window counts beyond the "
+                f"{report.slo_injected} injected fire(s) "
+                f"({metrics.slo_breaches_by_objective()})")
+
         # ---- recovery fingerprint: bit-identical decisions ---------
         recovered_decisions, recovered_engine = _fingerprint(seed)
         report.recovered_bit_identical = (
@@ -588,6 +670,8 @@ def run_chaos(cycles: int = 200, seed: int = 0,
         faults.set_backoff_policy(saved_policy)
         faults.LADDER.reset()
         faults.SIDECAR_QUARANTINE.reset()
+        _slo.disarm()
+        _ledger.stop_retention()
         if pipeline:
             _pipeline_mod.reset()    # demotion is process-sticky
         lease_stop.set()

@@ -20,7 +20,10 @@ carries the queue mechanics and the error taxonomy.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
+
+from ..obs import ledger as _ledger
 
 __all__ = ["LANES", "LANE_INDEX", "AdmissionError", "QueueFullError",
            "ShedRejectError", "QuarantinedTenantError",
@@ -69,7 +72,7 @@ class Item:
     ``error`` and sets it."""
 
     __slots__ = ("tenant", "lane", "req", "done", "resp", "error",
-                 "stale", "cancelled")
+                 "stale", "cancelled", "t0")
 
     def __init__(self, tenant: str, lane: str, req):
         self.tenant = tenant
@@ -82,6 +85,9 @@ class Item:
         #: set by a waiter that gave up (timeout) — a later leader must
         #: not burn a dispatch on, or count/stash, a result nobody reads
         self.cancelled = False
+        #: enqueue stamp: the WFQ pull attributes the admission wait to
+        #: (tenant, lane) in the decision ledger
+        self.t0 = time.monotonic()
 
     def finish(self, resp=None, error: Optional[BaseException] = None,
                stale: bool = False) -> None:
@@ -145,6 +151,11 @@ class AdmissionQueue:
                     out.append(self._queues[best][li].pop(0))
                     self._served[best] = self._served.get(best, 0.0) + 1.0
                     self._total -= 1
+        if out:
+            now = time.monotonic()
+            for item in out:
+                _ledger.observe_admission(item.tenant, item.lane,
+                                          max(0.0, now - item.t0))
         return out
 
     def depth_total(self) -> int:

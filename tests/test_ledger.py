@@ -1,0 +1,563 @@
+"""Decision-latency ledger, SLO burn-rate plane, long-horizon timeline
+(ISSUE 17; obs/ledger.py, obs/slo.py, obs/timeline.py).
+
+What the pins mean:
+
+- the streaming histogram replaces bench.py's hand-rolled percentile
+  math: the equality pin holds StreamHist answers within the documented
+  bucket resolution of the numpy order statistics over the same samples;
+- every decision path CLOSES a ledger record at the cache bind funnel —
+  full cycle, sub-cycle, and the pipelined deferred consume (flagged
+  deferred, attributed to the launching epoch) — with monotone stamps;
+- the SLO plane's burn-rate windows are tested on a synthetic clock:
+  breach fires once per episode through the real counter + flight path,
+  fast-window recovery re-arms, and the ``obs.slo`` seam fires exactly
+  as many times as the armed plan says;
+- the timeline's ring stays bounded while the JSONL spill carries every
+  digest, and the EWMA drift rung fires ONCE per episode after the
+  warm-up + patience gates;
+- observation is free on the decision path: the ledger on/off A/B rides
+  the dryrun (readback accounting identical), and the mini-soak here
+  pins zero breaches / zero drift on a healthy run.
+"""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubebatch_tpu import faults, metrics, obs  # noqa: F401
+from kubebatch_tpu.obs import ledger
+from kubebatch_tpu.obs import slo as slo_mod
+from kubebatch_tpu.obs import timeline as timeline_mod
+from kubebatch_tpu.obs.http import DebugHTTPServer
+from kubebatch_tpu.runtime import subcycle
+
+from .fixtures import GiB, build_group, build_pod, rl
+from kubebatch_tpu.objects import PodPhase
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger_state():
+    """Every test starts with an empty ledger, the SLO plane and the
+    timeline disarmed, and injection off."""
+    ledger.reset()
+    ledger.set_enabled(True)
+    slo_mod.disarm()
+    timeline_mod.disarm()
+    faults.disarm()
+    yield
+    ledger.reset()
+    ledger.set_enabled(True)
+    slo_mod.disarm()
+    timeline_mod.disarm()
+    faults.disarm()
+
+
+# ---------------------------------------------------------------------
+# streaming histogram: the legacy-percentile equality pin
+# ---------------------------------------------------------------------
+
+def test_streamhist_matches_numpy_percentiles():
+    """The ledger's log-bucketed percentiles replace np.percentile over
+    retained sample lists (the deleted bench.py math). FINE=8 buckets
+    are ~9% wide, so the bucket-midpoint answer must sit within 12% of
+    the true order statistic on a realistic latency distribution."""
+    rng = np.random.default_rng(17)
+    samples = rng.lognormal(mean=-4.0, sigma=1.2, size=500)
+    h = ledger.StreamHist()
+    for v in samples:
+        h.observe(float(v))
+    assert h.count == 500
+    assert h.sum == pytest.approx(float(samples.sum()), rel=1e-9)
+    for p in (50.0, 90.0, 99.0):
+        legacy = float(np.percentile(samples, p))
+        got = ledger._pct_from_counts(h.buckets, p)
+        assert got == pytest.approx(legacy, rel=0.12), (
+            f"p{p}: hist {got} vs legacy {legacy}")
+    # the max answer is the bucket UPPER edge: never below the true max
+    top = ledger._max_from_counts(h.buckets)
+    assert float(samples.max()) <= top <= float(samples.max()) * 1.10
+
+
+def test_count_over_threshold_bucket_resolution():
+    h = ledger.StreamHist()
+    for v in (0.001, 0.002, 0.010, 0.500, 2.0):
+        h.observe(v)
+    assert ledger.count_over_threshold(h.buckets, 0.1) == 2
+    assert ledger.count_over_threshold(h.buckets, 10.0) == 0
+    assert ledger.count_over_threshold(h.buckets, 0.0) == 5
+
+
+def test_lane_annotation_single_source():
+    """runtime/subcycle re-exports the ledger's lane vocabulary — one
+    annotation key across scheduling, admission and observation."""
+    assert subcycle.LANE_ANNOTATION is ledger.LANE_ANNOTATION
+    assert subcycle.LATENCY_LANE is ledger.LATENCY_LANE
+
+
+# ---------------------------------------------------------------------
+# stamp/close mechanics (no scheduler needed)
+# ---------------------------------------------------------------------
+
+def _pod(name="p0", ns="ns", lane=None):
+    pod = build_pod(ns, name, "", PodPhase.PENDING, rl(500, GiB))
+    if lane:
+        pod.annotations[ledger.LANE_ANNOTATION] = lane
+    return pod
+
+
+def test_close_without_arrival_is_unmatched_not_invented():
+    pod = _pod()
+    ledger.close(pod)
+    st = ledger.stats()
+    assert st["closed_total"] == 0
+    assert st["unmatched_total"] == 1
+
+
+def test_arrival_first_stamp_wins_and_discard_drops():
+    pod = _pod()
+    ledger.stamp_arrival(pod)
+    t0 = ledger._open[pod.uid]
+    ledger.stamp_arrival(pod)                  # re-entry: no clock reset
+    assert ledger._open[pod.uid] == t0
+    ledger.discard(pod.uid)
+    assert pod.uid not in ledger._open
+    ledger.close(pod)                          # discarded -> unmatched
+    assert ledger.stats()["unmatched_total"] == 1
+
+
+def test_max_open_eviction_bounds_the_map(monkeypatch):
+    monkeypatch.setattr(ledger, "MAX_OPEN", 4)
+    pods = [_pod(f"p{i}") for i in range(6)]
+    for pod in pods:
+        ledger.stamp_arrival(pod)
+    st = ledger.stats()
+    assert st["open"] == 4
+    assert st["evicted_total"] == 2
+    # the evicted records were the OLDEST two
+    assert pods[0].uid not in ledger._open
+    assert pods[5].uid in ledger._open
+
+
+def test_close_keys_lane_tenant_and_retains_monotone_record():
+    ledger.retain()
+    pod = _pod(lane=ledger.LATENCY_LANE)
+    ledger.stamp_arrival(pod)
+    ledger.stage_mark("apply", epoch=1)
+    with ledger.attribute(epoch=1, deferred=False):
+        ledger.close(pod, engine="testeng")
+    recs = ledger.retained()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["uid"] == pod.uid
+    assert rec["lane"] == ledger.LATENCY_LANE
+    assert rec["tenant"] == "ns"
+    assert rec["engine"] == "testeng"
+    assert not rec["deferred"]
+    ts = rec["arrival"]
+    for _, v in rec["stages"]:
+        assert v >= ts
+        ts = v
+    assert rec["bind"] >= ts
+    assert ledger.percentile(50, lane=ledger.LATENCY_LANE) is not None
+    assert ledger.percentile(50, lane="nope") is None
+
+
+def test_deferred_attribution_flags_and_counts():
+    ledger.retain()
+    pod = _pod()
+    ledger.stamp_arrival(pod)
+    with ledger.attribute(epoch=7, deferred=True):
+        ledger.close(pod)
+    st = ledger.stats()
+    assert st["closed_total"] == 1
+    assert st["deferred_closed_total"] == 1
+    assert ledger.retained()[0]["deferred"] is True
+
+
+def test_disabled_ledger_is_inert():
+    ledger.set_enabled(False)
+    pod = _pod()
+    ledger.stamp_arrival(pod)
+    ledger.close(pod)
+    ledger.set_enabled(True)
+    st = ledger.stats()
+    assert st["closed_total"] == 0
+    assert st["unmatched_total"] == 0
+    assert st["open"] == 0
+
+
+def test_window_isolation():
+    """A LedgerWindow diffs against its baseline: closes before the
+    window never leak into its counts or percentiles."""
+    a = _pod("a")
+    ledger.stamp_arrival(a)
+    ledger.close(a)
+    win = ledger.window()
+    assert win.closed() == 0
+    assert win.percentile(50) is None
+    b = _pod("b")
+    ledger.stamp_arrival(b)
+    ledger.close(b)
+    assert win.closed() == 1
+    assert win.count() == 1
+    assert win.percentile(50) is not None
+
+
+def test_subcycle_feed_rides_metrics_surface():
+    """metrics.observe_arrival_latency routes into the ledger's
+    sub-cycle histogram; the percentile surface keeps its byte-
+    compatible keys (arrivals stays an EXACT count — a process-lifetime
+    monotonic counter, so assert the delta, not the absolute)."""
+    base = metrics.arrivals_observed_total()
+    metrics.observe_arrival_latency(0.004)
+    metrics.observe_arrival_latency(0.009)
+    pct = metrics.arrival_latency_percentiles()
+    assert set(pct) == {"arrivals", "arrival_ms_p50", "arrival_ms_p99"}
+    assert pct["arrivals"] == base + 2
+    assert pct["arrival_ms_p50"] == pytest.approx(4.0, rel=0.12)
+    assert pct["arrival_ms_p99"] == pytest.approx(9.0, rel=0.12)
+    sub = ledger.subcycle_percentiles()
+    assert sub and sub["count"] == 2
+
+
+def test_counters_snapshot_carries_obs_sections():
+    pod = _pod()
+    ledger.stamp_arrival(pod)
+    ledger.close(pod)
+    slo_mod.arm()
+    try:
+        snap = metrics.counters_snapshot()
+        assert snap["ledger"]["closed_total"] >= 1
+        assert snap["slo"]["armed"] == 1
+        assert "slo_breaches_total" in snap
+        assert "timeline_drift_total" in snap
+        assert "timeline" not in snap          # disarmed -> quiet
+    finally:
+        slo_mod.disarm()
+
+
+# ---------------------------------------------------------------------
+# SLO plane on a synthetic clock
+# ---------------------------------------------------------------------
+
+def _cycle_objective(**kw):
+    base = dict(name="cyc", kind="cycle", threshold_ms=100.0, target=0.5,
+                fast_s=60.0, slow_s=600.0, min_count=8)
+    base.update(kw)
+    return slo_mod.Objective(**base)
+
+
+def test_slo_burn_breach_single_fire_and_recovery():
+    clock = [0.0]
+    plane = slo_mod.SLOPlane((_cycle_objective(),),
+                             now=lambda: clock[0])
+
+    def tick(dur_s, t):
+        clock[0] = t
+        plane.tick(dur_s, t=t)
+
+    b0 = metrics.slo_breaches_total()
+    for i in range(12):                        # healthy: 10ms cycles
+        tick(0.010, float(i))
+    assert metrics.slo_breaches_total() == b0
+    for i in range(12, 40):                    # rot: 1s cycles
+        tick(1.0, float(i))
+    assert metrics.slo_breaches_total() == b0 + 2   # one fire = fast+slow
+    snap = plane.snapshot()
+    (obj,) = snap["objectives"]
+    assert obj["breached"] and obj["breaches_total"] == 1
+    assert obj["windows"]["fast"]["burning"]
+    # recovery: a quiet fast window re-arms the episode latch...
+    for i in range(200):
+        tick(0.010, 1000.0 + i)
+    assert not plane.snapshot()["objectives"][0]["breached"]
+    # ...so a second rot episode fires a second time
+    for i in range(40):
+        tick(1.0, 2000.0 + i)
+    assert metrics.slo_breaches_total() == b0 + 4
+
+
+def test_slo_min_count_gate_never_fires_thin_windows():
+    plane = slo_mod.SLOPlane((_cycle_objective(min_count=8),))
+    b0 = metrics.slo_breaches_total()
+    for i in range(6):                         # 5 observed: under gate
+        plane.tick(1.0, t=float(i))
+    assert metrics.slo_breaches_total() == b0
+
+
+def test_slo_ledger_objective_filters_by_lane():
+    obj = slo_mod.Objective(name="lat", kind="ledger",
+                            lane=ledger.LATENCY_LANE, threshold_ms=50.0,
+                            target=0.5, min_count=4)
+    plane = slo_mod.SLOPlane((obj,))
+    with ledger._lock:
+        slow = ledger._hist_for((ledger.LATENCY_LANE, "t", "e"))
+        other = ledger._hist_for((ledger.DEFAULT_LANE, "t", "e"))
+    plane.tick(None, t=0.0)
+    for _ in range(16):
+        slow.observe(1.0)                      # latency lane: all bad
+        other.observe(0.001)                   # normal lane: all good
+    b0 = metrics.slo_breaches_total()
+    plane.tick(None, t=1.0)
+    assert metrics.slo_breaches_total() == b0 + 2
+    # the normal lane alone never burns the lane-filtered objective
+    plane2 = slo_mod.SLOPlane((obj,))
+    with ledger._lock:
+        ledger._hists.clear()
+        good = ledger._hist_for((ledger.DEFAULT_LANE, "t", "e"))
+    plane2.tick(None, t=0.0)
+    for _ in range(16):
+        good.observe(1.0)
+    b1 = metrics.slo_breaches_total()
+    plane2.tick(None, t=1.0)
+    assert metrics.slo_breaches_total() == b1
+
+
+def test_slo_seam_fires_through_real_path_exactly_once():
+    plane = slo_mod.SLOPlane((_cycle_objective(),))
+    faults.arm(faults.FaultPlan(counts={"obs.slo": 1}))
+    b0 = metrics.slo_breaches_total()
+    for i in range(4):
+        plane.tick(0.010, t=float(i))
+    faults.disarm()
+    assert plane.snapshot()["injected_total"] == 1
+    assert metrics.slo_breaches_total() == b0 + 2
+    assert metrics.slo_breaches_by_objective().get("injected/fast") == 1
+
+
+def test_slo_arm_disarm_hooks_cycle_ends():
+    assert not slo_mod.armed()
+    plane = slo_mod.arm()
+    try:
+        assert slo_mod.armed() and plane is slo_mod.PLANE
+        assert slo_mod._on_cycle in obs.CYCLE_HOOKS
+        assert slo_mod.metrics_section() is not None
+    finally:
+        slo_mod.disarm()
+    assert slo_mod._on_cycle not in obs.CYCLE_HOOKS
+    assert slo_mod.metrics_section() is None
+
+
+def test_debug_slo_endpoint_serves_plane_and_ledger():
+    pod = _pod()
+    ledger.stamp_arrival(pod)
+    ledger.close(pod)
+    slo_mod.arm()
+    srv = DebugHTTPServer("127.0.0.1", 0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/slo",
+                timeout=10) as resp:
+            payload = json.loads(resp.read().decode())
+        assert payload["armed"] is True
+        assert {o["name"] for o in payload["objectives"]} == {
+            o.name for o in slo_mod.DEFAULT_OBJECTIVES}
+        assert payload["ledger"]["closed_total"] >= 1
+        # the 404 surface advertises the new endpoint
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=10)
+        assert ei.value.code == 404
+        assert "/debug/slo" in json.loads(
+            ei.value.read().decode())["endpoints"]
+    finally:
+        srv.stop()
+        slo_mod.disarm()
+
+
+# ---------------------------------------------------------------------
+# timeline ring, spill, drift rung (synthetic roots + clock)
+# ---------------------------------------------------------------------
+
+class _Root:
+    """The slice of a cycle root span the timeline digests."""
+
+    def __init__(self, dur_s, epoch=1, name="cycle"):
+        self.dur = dur_s
+        self.args = {"epoch": epoch}
+        self.name = name
+
+    def count(self):
+        return 3
+
+
+def test_timeline_ring_bounded_and_spill_complete(tmp_path):
+    clk = iter(float(i) for i in range(10**6))
+    tl = timeline_mod.Timeline(now=lambda: next(clk))
+    tl.arm(str(tmp_path), capacity=64, spill_every=32)
+    for i in range(300):
+        tl.tick(_Root(0.010, epoch=i))
+    tl.flush()
+    st = tl.stats()
+    assert st["ticks"] == 300
+    assert st["ring"] == 64                    # resident stays bounded
+    assert st["spilled"] == 300
+    lines = [json.loads(ln) for ln in
+             open(tl.path).read().splitlines()]
+    assert len(lines) == 300
+    assert [d["epoch"] for d in lines] == list(range(300))
+    for d in lines[:3]:
+        assert {"ts", "cycle_ms", "spans", "rss_mb",
+                "deltas"} <= set(d)
+    # ring-only mode (no directory) still bounds and never spills
+    tl2 = timeline_mod.Timeline(now=lambda: next(clk))
+    tl2.arm(None, capacity=16, spill_every=4)
+    for i in range(40):
+        tl2.tick(_Root(0.010))
+    tl2.flush()
+    assert tl2.stats()["ring"] == 16
+    assert tl2.stats()["spilled"] == 0
+
+
+def test_timeline_drift_rung_fires_once_per_episode(tmp_path):
+    clk = iter(float(i) for i in range(10**6))
+    tl = timeline_mod.Timeline(now=lambda: next(clk))
+    tl.arm(str(tmp_path), capacity=32, spill_every=10**6)
+    d0 = metrics.timeline_drift_by_kind().get("cycle_ms", 0)
+    # converge the EWMAs on a healthy 10ms cadence (MIN_TICKS gate)
+    for _ in range(timeline_mod.MIN_TICKS):
+        tl.tick(_Root(0.010))
+    assert metrics.timeline_drift_by_kind().get("cycle_ms", 0) == d0
+    # sustained 10x rot: fast track runs past slow*(1+DUR_TOL) and stays
+    # there — the rung fires ONCE, not once per over-tolerance tick
+    for _ in range(120):
+        tl.tick(_Root(0.100))
+    assert metrics.timeline_drift_by_kind().get("cycle_ms", 0) == d0 + 1
+    assert tl.stats()["drift_total"] >= 1
+
+
+def test_timeline_arm_disarm_hooks_cycle_ends(tmp_path):
+    assert not timeline_mod.armed()
+    timeline_mod.arm(str(tmp_path), capacity=8, spill_every=4)
+    try:
+        assert timeline_mod.armed()
+        assert timeline_mod._on_cycle in obs.CYCLE_HOOKS
+    finally:
+        timeline_mod.disarm()
+    assert not timeline_mod.armed()
+    assert timeline_mod._on_cycle not in obs.CYCLE_HOOKS
+
+
+# ---------------------------------------------------------------------
+# real-scheduler integration: closes at every bind path + mini-soak
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def _engine_env(monkeypatch):
+    """The pipelined tests force the active-set family the executor
+    pipelines (test_pipeline's fixture, replicated — autouse fixtures
+    don't cross modules)."""
+    from kubebatch_tpu.kernels import activeset
+    from kubebatch_tpu.runtime import pipeline as pipeline_mod
+    monkeypatch.setenv("KUBEBATCH_SOLVER", "activeset")
+    activeset.reset()
+    activeset.set_audit_every(0)
+    pipeline_mod.reset()
+    yield
+    activeset.reset()
+    activeset._audit_every = None
+    pipeline_mod.reset()
+
+
+def _assert_monotone(rec):
+    ts = rec["arrival"]
+    for stage, v in rec["stages"]:
+        assert v >= ts, f"{rec['uid']}: stage {stage} regressed"
+        ts = v
+    assert rec["bind"] >= ts
+
+
+def test_sequential_cycles_close_every_bound_pod():
+    from .test_pipeline import _Harness
+    ledger.retain()
+    h = _Harness(pipeline=False)
+    h.run_quiet(6)
+    records = {r["uid"]: r for r in ledger.retained()}
+    bound = [p for _, pods in h.live_gangs for p in pods
+             if p.node_name]
+    assert bound, "quiet stream bound nothing"
+    for pod in bound:
+        rec = records.get(pod.uid)
+        assert rec is not None, f"bound pod {pod.uid} never closed"
+        _assert_monotone(rec)
+        assert not rec["deferred"]
+    assert ledger.stats()["deferred_closed_total"] == 0
+
+
+@pytest.mark.slow  # ~35s: compiles the pipelined executor's own shapes
+def test_pipelined_consume_closes_deferred(_engine_env):
+    from .test_pipeline import _Harness
+    ledger.retain()
+    h = _Harness(pipeline=True)
+    h.run_quiet(8)
+    h.drain()
+    st = ledger.stats()
+    assert st["deferred_closed_total"] > 0, (
+        "overlapped consumes never attributed a deferred close")
+    deferred = [r for r in ledger.retained() if r["deferred"]]
+    for rec in deferred:
+        _assert_monotone(rec)
+    # deferred closes still key the launching epoch, not the consumer's
+    assert all(r["epoch"] is not None for r in deferred)
+
+
+def test_mini_soak_flat_ring_zero_breaches(tmp_path):
+    """The tier-1 slice of the soak acceptance: ~80 quiet cycles with
+    the timeline spilling and the SLO plane armed on soak-calibrated
+    objectives — ring stays at capacity bound, every digest lands in
+    the spill, zero breaches, zero drift. (The ≥2k-cycle run is the
+    ``slow``-marked test below; the 10k default rides bench --mode
+    soak.)"""
+    import dataclasses
+    from .test_pipeline import _Harness
+    b0 = metrics.slo_breaches_total()
+    dr0 = metrics.timeline_drift_total()
+    slo_mod.arm(tuple(
+        dataclasses.replace(o, threshold_ms=max(o.threshold_ms, 60000.0))
+        if o.kind == "ledger" else o
+        for o in slo_mod.DEFAULT_OBJECTIVES))
+    timeline_mod.arm(str(tmp_path), capacity=32, spill_every=16)
+    try:
+        h = _Harness(pipeline=False)
+        h.run_quiet(80)
+    finally:
+        slo_mod.disarm()
+        timeline_mod.disarm()              # disarm flushes the spill
+    st = timeline_mod.stats()
+    assert st["ticks"] >= 80
+    assert st["ring"] <= 32
+    lines = open(timeline_mod.TIMELINE.path).read().splitlines()
+    assert len(lines) == st["ticks"]
+    assert metrics.slo_breaches_total() == b0, (
+        f"unexplained breaches: {metrics.slo_breaches_by_objective()}")
+    assert metrics.timeline_drift_total() == dr0
+    assert ledger.stats()["closed_total"] > 0
+
+
+@pytest.mark.slow
+def test_soak_2k_cycles_flat_memory_and_quiet_plane(tmp_path):
+    """The full acceptance rung: a ≥2k-cycle churn soak through
+    bench.run_soak — flat timeline memory (ring at bound, RSS EWMAs
+    within drift tolerance), zero breaches, zero drift, zero measured-
+    window recompiles, and every-cycle ledger coverage."""
+    import bench
+    rec = bench.run_soak("2", cycles=2000, churn_pods=64,
+                         timeline_dir=str(tmp_path))
+    assert rec["measured_cycles"] == 2000
+    assert rec["slo_report"]["breaches_total"] == 0
+    assert rec["timeline_drift_total"] == 0
+    assert rec["recompiles_total"] == 0
+    assert rec["ledger"]["decided"] > 0
+    assert rec["timeline"]["ticks"] >= 2000
+    assert rec["timeline"]["ring"] <= 2048
+    lines = open(str(tmp_path) + "/timeline.jsonl").read().splitlines()
+    assert len(lines) >= 2000
+    # flat memory: the fast RSS track ended within the drift tolerance
+    # of the slow baseline (the rung itself already pinned zero fires)
+    assert rec["timeline"]["rss_mb_fast"] <= (
+        rec["timeline"]["rss_mb_slow"] * (1.0 + timeline_mod.RSS_TOL))

@@ -61,6 +61,13 @@ HARD_PINS = (
     # fired outside an armed chaos plan
     "activeset.divergences",
     "activeset.demotions",
+    # observability pins (ISSUE 17, soak lines): a breach count above
+    # the committed baseline means the burn-rate plane fired on a
+    # regression the p50/p99 advisories would only warn about; a drift
+    # count means the EWMA rung saw the long-horizon rot itself
+    "slo_breaches_total",
+    "slo_report.breaches_total",
+    "timeline_drift_total",
 )
 
 #: fields a "fleet"-prefixed metric line must carry (the blip itself is
@@ -90,7 +97,9 @@ ACTIVESET_BOUNDS = (("activeset.divergences", 0.0),
 SUSTAINED_REQUIRED = ("value", "speedup_vs_sequential",
                       "recompiles_total", "pipeline_demotions",
                       "readbacks_per_decision", "deferred_readbacks",
-                      "pipeline.pipeline.cycles")
+                      "pipeline.pipeline.cycles",
+                      "ledger.decided",
+                      "ledger.arrival_decision_p99_ms")
 
 #: absolute bounds on a sustained CANDIDATE line: no recompile after
 #: warm-up, the demotion rung never fires outside an armed plan, and
@@ -98,6 +107,23 @@ SUSTAINED_REQUIRED = ("value", "speedup_vs_sequential",
 SUSTAINED_BOUNDS = (("recompiles_total", 0.0),
                     ("pipeline_demotions", 0.0),
                     ("readbacks_per_decision", 0.0))
+
+#: fields a long-horizon soak line (sched_soak_..) must carry — the SLO
+#: burn-rate verdict and the timeline drift rung are the whole point of
+#: the mode; a soak line without them proves nothing (ISSUE 17)
+SOAK_REQUIRED = ("value", "measured_cycles",
+                 "slo_report.breaches_total", "timeline_drift_total",
+                 "recompiles_total", "timeline.ticks",
+                 "ledger.decided", "ledger.arrival_decision_p99_ms",
+                 "readbacks_per_decision")
+
+#: absolute bounds on a soak CANDIDATE line: the burn-rate plane stays
+#: quiet, the EWMA drift rung never fires, no recompile after warm-up,
+#: and the ledger keeps the blocking-readback term off the decision path
+SOAK_BOUNDS = (("slo_report.breaches_total", 0.0),
+               ("timeline_drift_total", 0.0),
+               ("recompiles_total", 0.0),
+               ("readbacks_per_decision", 0.0))
 
 #: reported, warned past tolerance, never fatal (same-box numbers only)
 ADVISORY = (
@@ -175,6 +201,19 @@ def diff_metric(metric: str, base: dict, cand: dict,
                     f"'{key}' (the pipelined-arm evidence) — missing "
                     f"from candidate")
         for key, bound in SUSTAINED_BOUNDS:
+            c = _num(cand, key)
+            if c is not None and c > bound + EPS:
+                failures.append(
+                    f"{metric}: {key} = {c:g} exceeds the structural "
+                    f"bound {bound:g}")
+    elif metric.startswith("sched_soak"):
+        for key in SOAK_REQUIRED:
+            if _num(cand, key) is None:
+                failures.append(
+                    f"{metric}: soak line must carry numeric '{key}' "
+                    f"(the SLO/timeline evidence block) — missing "
+                    f"from candidate")
+        for key, bound in SOAK_BOUNDS:
             c = _num(cand, key)
             if c is not None and c > bound + EPS:
                 failures.append(

@@ -82,6 +82,12 @@ $B  900 python bench.py --config 2 --mode arrival --cycles 9
 $B 1800 python bench.py --config 5 --mode arrival --cycles 9
 # 60+-cycle steady soak (p50/p95/max + RSS in the JSON line)
 $B 2400 python bench.py --config 5 --steady 256 --cycles 60
+# long-horizon soak (ISSUE 17): SLO burn-rate plane + timeline spill
+# over a 2k-cycle steady regime — breaches, timeline drift and
+# recompiles all hard-exit 1 after the evidence line lands (the full
+# 10k-cycle default runs in dedicated soak windows, not the sweep)
+$B 3600 python bench.py --config 2 --mode soak --cycles 2000 \
+    --sustained-churn 64 --timeline-dir /tmp/kb-sweep-timeline
 # chaos soak: degraded-mode p50 alongside healthy p50, invariant
 # violations fail the run (docs/ROBUSTNESS.md)
 $B 1200 python bench.py --chaos --cycles 240
