@@ -457,7 +457,7 @@ def run_config(config: int, cycles: int, mode: str):
 
 
 def run_steady(config, cycles: int, mode: str, churn_pods: int,
-               skew: bool = False):
+               skew: bool = False, trace: str = ""):
     """Steady-state regime: ONE persistent cache, fully scheduled in a
     warmup cycle, then a churn trickle per measured cycle (whole gangs
     finish, equal fresh gangs arrive). This is where the incremental
@@ -517,9 +517,30 @@ def run_steady(config, cycles: int, mode: str, churn_pods: int,
 
     tick_no = [0]
 
+    replayer = None
+    if trace:
+        # --trace replaces the synthetic churn templates: arrivals come
+        # from the workloads/ plane (diurnal + heavy-tail + elastic),
+        # applied synchronously to the cache. Calibrated so steady
+        # concurrent trace pods ~= 4x the churn level at ~8-cycle gang
+        # lifetimes, i.e. per-cycle event volume near the synthetic
+        # regime's.
+        from kubebatch_tpu.workloads import TraceReplayer
+        _, records, dt = _build_trace(
+            trace, target_pods=4 * max(1, churn_pods),
+            cycles=cycles + 8, lifetime_cycles=8,
+            cpu_milli=sim.spec.pod_cpu_millis,
+            mem_bytes=sim.spec.pod_mem_bytes,
+            n_queues=max(1, len(sim.queues)))
+        replayer = TraceReplayer(records, _DirectEmitter(cache),
+                                 [q.name for q in sim.queues], dt=dt)
+
     def churn():
         """Per-cycle arrivals; under --steady-skew they alternate between
         the two extreme-weight queues so cross-queue imbalance persists."""
+        if replayer is not None:
+            replayer.tick()
+            return
         arrival = None
         if skew:
             nq = max(1, len(sim.queues))
@@ -1002,7 +1023,7 @@ def run_arrival(config, cycles: int, churn_pods: int,
 
 
 def run_sustained(config, cycles: int, mode: str,
-                  churn_pods: int) -> dict:
+                  churn_pods: int, trace: str = "") -> dict:
     """Sustained-rate A/B (ISSUE 16): the SAME steady churn regime
     driven through a real Scheduler twice in one process — sequential
     loop first, then the pipelined executor (runtime/pipeline.py) —
@@ -1078,6 +1099,28 @@ def run_sustained(config, cycles: int, mode: str,
         sched = Scheduler(cache, scheduler_conf=conf,
                           schedule_period=3600.0, pipeline=pipelined)
 
+        replayer = None
+        if trace:
+            # --trace: both arms replay the SAME trace stream (fresh
+            # replayer per arm, identical records) so the A/B stays
+            # apples-to-apples under the workload plane's shapes
+            from kubebatch_tpu.workloads import TraceReplayer
+            _, records, dt = _build_trace(
+                trace, target_pods=4 * max(1, churn_pods),
+                cycles=cycles + 8, lifetime_cycles=8,
+                cpu_milli=sim.spec.pod_cpu_millis,
+                mem_bytes=sim.spec.pod_mem_bytes,
+                n_queues=max(1, len(sim.queues)))
+            replayer = TraceReplayer(records, _DirectEmitter(cache),
+                                     [q.name for q in sim.queues],
+                                     dt=dt)
+
+        def churn():
+            if replayer is not None:
+                replayer.tick()
+            else:
+                sim.churn_tick(cache, churn_pods)
+
         def kubelet_tick():
             for pod in fresh_binds:
                 if pod.phase == PodPhase.PENDING:
@@ -1092,7 +1135,7 @@ def run_sustained(config, cycles: int, mode: str,
                 kubelet_tick()
             for _ in range(3):          # trace every steady churn shape
                 kubelet_tick()
-                sim.churn_tick(cache, churn_pods)
+                churn()
                 sched.run_cycle()
                 kubelet_tick()
             compilesvc.mark_warm()
@@ -1114,7 +1157,7 @@ def run_sustained(config, cycles: int, mode: str,
             t0 = time.perf_counter()
             for _ in range(cycles):
                 kubelet_tick()
-                sim.churn_tick(cache, churn_pods)
+                churn()
                 sched.run_cycle()
                 engines.add(_alloc_mod.last_cycle_engine)
                 kubelet_tick()
@@ -1192,8 +1235,511 @@ def run_sustained(config, cycles: int, mode: str,
     }
 
 
+# ---------------------------------------------------------------------
+# trace-replay workloads (ISSUE 19): --trace <preset|path> swaps the
+# synthetic churn templates for the workloads/ plane — diurnal+heavy-
+# tail arrival streams, elastic gangs, and a lendable backfill stream
+# ---------------------------------------------------------------------
+
+class _DirectEmitter:
+    """StreamingEventSource facade that applies replayer events straight
+    to the cache — synchronous trace churn for the steady/sustained
+    arms (the soak runs the REAL source + watch pump; run_trace_soak)."""
+
+    def __init__(self, cache):
+        self._cache = cache
+
+    def emit_group(self, pg):
+        self._cache.add_pod_group(pg)
+
+    def emit_group_update(self, old, new):
+        self._cache.update_pod_group(old, new)
+
+    def emit_group_delete(self, pg):
+        self._cache.delete_pod_group(pg)
+
+    def emit_pod(self, pod):
+        self._cache.add_pod(pod)
+
+    def emit_pod_update(self, old, new):
+        self._cache.update_pod(old, new)
+
+    def emit_pod_delete(self, pod):
+        self._cache.delete_pod(pod)
+
+
+def _build_trace(trace_arg: str, *, target_pods: int, cycles: int,
+                 lifetime_cycles: int, cpu_milli, mem_bytes,
+                 n_queues: int, seed: int = 0):
+    """Resolve ``--trace`` into ``(label, records, dt)``.
+
+    A preset name generates a seeded stream CALIBRATED to the caller's
+    cluster: pod shapes match the cluster spec, ``dt`` (sim-seconds per
+    scheduler cycle) is sized so a mean gang lives ~``lifetime_cycles``
+    cycles, and the arrival rate is scaled (Little's law: concurrent
+    tasks ~= rate x mean_tasks x mean_duration) so the steady-state
+    concurrent trace pods land near ``target_pods``. A filesystem path
+    replays a JSONL trace VERBATIM — shapes as recorded, ``dt`` sized
+    so the file's span fits the run."""
+    import dataclasses as _dc
+
+    from kubebatch_tpu.workloads import (PRESETS, generate_trace,
+                                         load_trace)
+    if trace_arg in PRESETS:
+        tspec = _dc.replace(PRESETS[trace_arg],
+                            cpu_milli=float(cpu_milli),
+                            mem_bytes=float(mem_bytes),
+                            n_queues=max(1, n_queues))
+        dt = tspec.mean_duration / max(1, lifetime_cycles)
+        steady = (tspec.rate.base * tspec.mean_tasks
+                  * tspec.mean_duration)
+        tspec = tspec.scale_rate(target_pods / max(1e-9, steady))
+        # +25% horizon: the warm-up/settle cycles ride the same stream
+        records = generate_trace(tspec, seed, cycles * dt * 1.25)
+        return trace_arg, records, dt
+    if os.path.exists(trace_arg):
+        records = load_trace(trace_arg)
+        span = max((r.t for r in records), default=0.0) + 1.0
+        return (os.path.basename(trace_arg), records,
+                span / max(1, cycles))
+    raise SystemExit(f"--trace {trace_arg!r}: not a preset "
+                     f"({sorted(PRESETS)}) and no such file")
+
+
+def _warm_trace_shape_grid(cache, source, sched, records, high_t, high_j,
+                           queue_names, kubelet_tick, reap_evictions,
+                           binds):
+    """Trace the (t_pad, j_pad) bucket grid around the replay's observed
+    backlog envelope BEFORE compilesvc.mark_warm, so the measured window
+    recompiles nothing (the soak pin).
+
+    A trace's pending backlog ramps/peaks with the diurnal wave (and
+    snowballs in reclaim-limited congestion), so warm cycles at the
+    stream's head only trace the smallest buckets — every bucket combo
+    first crossed mid-window was a counted "unregistered" recompile.
+    ``high_t``/``high_j`` are the max pending tasks/gangs the shape
+    dry-run (a full pre-warm replay of the same stream) saw; the grid
+    covers every pow2 rung up to those marks plus margin by injecting a
+    synthetic pending backlog of exactly each rung's size, running one
+    cycle, and deleting the synthetics. Sticky pad holds
+    (cache.pad_sticky) are cleared per rung so each rung pads exactly;
+    the j = 2t rungs — only reachable live via a post-warm-frozen
+    one-below job hold — are manufactured by pre-seeding that hold.
+    Rungs the live backlog already passed are skipped; already-traced
+    rungs are jit cache hits (and persistent-cache retrievals across
+    processes), so repeat runs pay near nothing."""
+    from kubebatch_tpu.api import TaskStatus
+    from kubebatch_tpu.kernels.tensorize import pad_to_bucket
+    from kubebatch_tpu.objects import (Container, GROUP_NAME_ANNOTATION,
+                                       Pod, PodGroup, resource_list)
+
+    if not records:
+        return
+    # margin over the dry-run's high-water: the measured pass is not
+    # bit-identical (armed fault seams, elastic-grow timing, cycle-phase
+    # jitter), so cover one growth step past everything observed
+    t_top = pad_to_bucket(max(8, int(high_t * 1.5) + 8), 8)
+    j_top = pad_to_bucket(max(4, int(high_j * 1.5) + 4), 4)
+    cpu, mem = records[0].cpu_milli, records[0].mem_bytes
+    t_buckets = []
+    b = 8
+    while b <= t_top:
+        t_buckets.append(b)
+        b *= 2
+    j_buckets = []
+    b = 4
+    while b <= min(j_top, 2 * t_top):
+        j_buckets.append(b)
+        b *= 2
+    serial = [0]
+
+    def pending_now():
+        with cache._lock:
+            pt = sum(len(j.task_status_index.get(TaskStatus.PENDING, {}))
+                     for j in cache.jobs.values())
+            pj = sum(1 for j in cache.jobs.values()
+                     if j.task_status_index.get(TaskStatus.PENDING))
+        return pt, pj
+
+    for tb in t_buckets:
+        for jb in j_buckets:
+            if jb > 2 * tb:
+                continue
+            pend_t, pend_j = pending_now()
+            if jb == 2 * tb:
+                # j_pad = 2 x t_pad exists live only as a frozen
+                # one-below hold; seed the hold and fill to one-below
+                n_tasks, n_jobs = tb, tb
+                cache.pad_sticky["cycle_jobs"] = [jb, 0]
+            else:
+                n_tasks, n_jobs = tb, jb
+                cache.pad_sticky.pop("cycle_jobs", None)
+            cache.pad_sticky.pop("cycle_tasks", None)
+            add_t, add_j = n_tasks - pend_t, n_jobs - pend_j
+            if add_t <= 0 or add_j <= 0 or add_t < add_j:
+                continue        # live backlog already past this rung
+            groups, pods = [], []
+            base, extra = divmod(add_t, add_j)
+            for g in range(add_j):
+                serial[0] += 1
+                pg = PodGroup(
+                    name=f"warmgrid-{serial[0]:04d}", namespace="sim",
+                    min_member=1,
+                    queue=(queue_names[serial[0] % len(queue_names)]
+                           if queue_names else ""),
+                    creation_timestamp=1.5e9 + serial[0])
+                source.emit_group(pg)
+                groups.append(pg)
+                for k in range(base + (1 if g < extra else 0)):
+                    pod = Pod(
+                        name=f"{pg.name}-{k:03d}", namespace="sim",
+                        annotations={GROUP_NAME_ANNOTATION: pg.name},
+                        containers=[Container(requests=resource_list(
+                            cpu=cpu, memory=mem))],
+                        creation_timestamp=1.5e9 + serial[0] + k / 1e3)
+                    source.emit_pod(pod)
+                    pods.append(pod)
+            source.sync(timeout=30.0)
+            sched.run_cycle()
+            for pod in pods:
+                binds.pop(pod.uid, None)
+                source.emit_pod_delete(pod)
+            for pg in groups:
+                source.emit_group_delete(pg)
+            kubelet_tick()      # replayer-owned binds only; clears fresh
+            source.sync(timeout=30.0)
+            reap_evictions()
+    cache.pad_sticky.pop("cycle_tasks", None)
+    cache.pad_sticky.pop("cycle_jobs", None)
+
+
+def run_trace_soak(config, cycles: int, trace: str,
+                   timeline_dir: str = "") -> dict:
+    """Trace-replay soak (ISSUE 19 / ROADMAP item 3): the long-horizon
+    soak harness of run_soak driven by the workloads/ plane instead of
+    the synthetic churn templates — a live StreamingEventSource pump, a
+    diurnal+heavy-tail gang stream with elastic resizes and a lendable
+    backfill cohort, chaos count-seams armed mid-window (cache.fold +
+    workload.elastic), and the backfill-over-reserved machinery on
+    (KUBEBATCH_RESERVED_BACKFILL): the cluster runs ~50% static fill +
+    ~35% steady trace load, so diurnal peaks and cron bursts create the
+    contention that makes elastic gangs AlmostReady, lends their
+    reserved capacity to backfill pods, and reclaims it atomically.
+
+    The evidence line carries the run_soak SLO/timeline/ledger block
+    PLUS the trace census (arrivals/completions/elastic_events), the
+    peak lent capacity (backfilled_peak_milli), the backfill-over-
+    reserved ledger, the in-soak audit-divergence count, and the
+    injected-seam census. The caller (main) hard-fails on any breach,
+    drift, recompile, audit divergence, nonzero guard counter
+    (double-bind / lost-reservation), or a soak that never exercised
+    the over-reserve/reclaim path."""
+    import gc
+
+    from kubebatch_tpu import actions, compilesvc, faults, plugins  # noqa: F401
+    from kubebatch_tpu.cache import SchedulerCache
+    from kubebatch_tpu.metrics import (audit_failures_total,
+                                       backfill_double_binds_total,
+                                       backfill_over_placements_total,
+                                       backfill_reclaims_total,
+                                       backfill_tenants_evicted_total,
+                                       lost_reservations_total,
+                                       readback_accounting,
+                                       recompiles_total,
+                                       slo_breaches_by_objective,
+                                       slo_breaches_total,
+                                       timeline_drift_by_kind,
+                                       timeline_drift_total)
+    from kubebatch_tpu.obs import ledger as ledger_mod
+    from kubebatch_tpu.obs import slo as slo_mod
+    from kubebatch_tpu.obs import timeline as timeline_mod
+    from kubebatch_tpu.runtime.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                                 Scheduler)
+    from kubebatch_tpu.sim.cluster import BASELINE_SPECS, build_cluster
+    from kubebatch_tpu.sim.source import StreamingEventSource
+    from kubebatch_tpu.workloads import TraceReplayer
+    import dataclasses as _dc
+
+    spec = BASELINE_SPECS[config]
+    cap_pods = int(min(
+        spec.n_nodes * spec.node_cpu_millis
+        // max(1, spec.pod_cpu_millis),
+        spec.n_nodes * spec.node_mem_bytes
+        // max(1, spec.pod_mem_bytes)))
+    spec = _dc.replace(spec, n_groups=0, running_fill=0.5)
+    label, records, dt = _build_trace(
+        trace, target_pods=int(0.35 * cap_pods), cycles=cycles,
+        lifetime_cycles=max(8, min(500, cycles // 20)),
+        cpu_milli=spec.pod_cpu_millis, mem_bytes=spec.pod_mem_bytes,
+        n_queues=max(1, spec.n_queues))
+
+    # the workload plane exists to exercise backfill-over-reserved: a
+    # trace line always arms the backfill action, even on configs whose
+    # synthetic scenario is allocate-only
+    acts = tuple(CONFIG_ACTIONS[config])
+    if "backfill" not in acts:
+        acts = acts + ("backfill",)
+    conf = DEFAULT_SCHEDULER_CONF.replace(
+        'actions: "allocate, backfill"',
+        f'actions: "{", ".join(acts)}"')
+
+    sim = build_cluster(spec)
+    binds = {}
+    fresh_binds = []
+    evicted_uids = []
+
+    class _B:
+        def bind(self, pod, hostname):
+            binds[pod.uid] = hostname
+            pod.node_name = hostname
+            fresh_binds.append(pod)
+
+        def bind_many(self, pairs):
+            for pod, hostname in pairs:
+                self.bind(pod, hostname)
+
+        def evict(self, pod):
+            # a reclaimed backfill tenant: the write-back records the
+            # eviction; the "cluster" answers with a pod delete after
+            # the cycle (reap_evictions)
+            evicted_uids.append(pod.uid)
+
+    seam = _B()
+    saved_bf = os.environ.get("KUBEBATCH_RESERVED_BACKFILL")
+    os.environ["KUBEBATCH_RESERVED_BACKFILL"] = "1"
+    cache = SchedulerCache(binder=seam, evictor=seam,
+                           async_writeback=False)
+    source = StreamingEventSource()
+    with source._lock:
+        for q in sim.queues:
+            source.queues[q.name] = q
+        for n in sim.nodes:
+            source.nodes[n.name] = n
+        for g in sim.groups:
+            source.groups[f"{g.namespace}/{g.name}"] = g
+        for p in sim.pods:
+            source.pods[f"{p.namespace}/{p.name}"] = p
+    source.start(cache)
+    replayer = TraceReplayer(records, source,
+                             [q.name for q in sim.queues], dt=dt)
+    # audit_every: the fold-vs-full-clone snapshot diff runs INSIDE the
+    # soak — trace churn exercising the fold/audit rungs is the point
+    sched = Scheduler(cache, scheduler_conf=conf,
+                      schedule_period=3600.0, audit_every=50)
+
+    def kubelet_tick():
+        replayer.kubelet(fresh_binds)
+        fresh_binds.clear()
+
+    def reap_evictions():
+        while evicted_uids:
+            uid = evicted_uids.pop()
+            binds.pop(uid, None)
+            replayer.kill_pod(uid)
+
+    # chaos count-seams mid-window: cache.fold proves the fold demotion
+    # rung lands under trace churn; workload.elastic forces one
+    # mid-flight grow through the replayer. Seams that would trip the
+    # soak's own pins by design (obs.slo fires a synthetic breach;
+    # device seams force engine recompiles) stay off THIS plan — the
+    # full randomized schedule is the chaos line's job.
+    plan = faults.FaultPlan(rates={}, counts={"cache.fold": 1,
+                                              "workload.elastic": 1},
+                            seed=0)
+    fault_start = max(10, cycles // 10)
+    fault_stop = max(fault_start + 1, cycles // 2)
+
+    cycle_hist = ledger_mod.StreamHist()
+    backfilled_peak = 0.0
+    gc.disable()
+    try:
+        for _ in range(2):              # settle: adopt the fill
+            source.sync(timeout=30.0)
+            sched.run_cycle()
+            kubelet_tick()
+        # shape dry-run: replay the IDENTICAL stream once pre-warm, so
+        # every (t_pad, j_pad) signature the measured window dispatches
+        # is traced for free — including the congestion regimes no host
+        # model predicts (reclaim-limited backlog snowballs). The high-
+        # water pending counts observed here size the grid pass below.
+        from kubebatch_tpu.api import TaskStatus as _TS
+        high_t = high_j = 0
+        for _ in range(cycles):
+            kubelet_tick()
+            replayer.tick()
+            source.sync(timeout=30.0)
+            with cache._lock:
+                pt = sum(len(j.task_status_index.get(_TS.PENDING, {}))
+                         for j in cache.jobs.values())
+                pj = sum(1 for j in cache.jobs.values()
+                         if j.task_status_index.get(_TS.PENDING))
+            high_t, high_j = max(high_t, pt), max(high_j, pj)
+            sched.run_cycle()
+            kubelet_tick()
+            reap_evictions()
+            if replayer.exhausted:
+                break
+        # teardown: the dry-run's survivors leave the stage, and a fresh
+        # replayer over the same records drives the measured window from
+        # the same near-empty cluster the dry-run started from
+        for pod in list(replayer.pods_by_uid.values()):
+            binds.pop(pod.uid, None)
+            source.emit_pod_delete(pod)
+        for gang in list(replayer.live.values()):
+            source.emit_group_delete(gang.pg)
+        fresh_binds.clear()
+        del evicted_uids[:]
+        source.sync(timeout=30.0)
+        replayer = TraceReplayer(records, source,
+                                 [q.name for q in sim.queues], dt=dt)
+        for _ in range(2):              # settle the emptied cluster
+            source.sync(timeout=30.0)
+            sched.run_cycle()
+            kubelet_tick()
+        # the dry-run traces the shapes its own trajectory crossed; the
+        # grid covers the whole bucket lattice up to that high-water
+        # plus margin, so measured-pass divergence (armed fault seams,
+        # elastic timing) cannot reach an untraced rung (the soak pins
+        # recompiles_total at 0 across the whole measured window)
+        _warm_trace_shape_grid(
+            cache, source, sched, records, high_t=high_t, high_j=high_j,
+            queue_names=[q.name for q in sim.queues],
+            kubelet_tick=kubelet_tick, reap_evictions=reap_evictions,
+            binds=binds)
+        compilesvc.mark_warm()
+        rc0 = recompiles_total()
+        acct0 = readback_accounting()
+        slo0 = slo_breaches_total()
+        drift0 = timeline_drift_total()
+        audit0 = audit_failures_total()
+        bf0 = {"over": backfill_over_placements_total(),
+               "reclaims": backfill_reclaims_total(),
+               "evicted": backfill_tenants_evicted_total(),
+               "double": backfill_double_binds_total(),
+               "lost": lost_reservations_total()}
+        stats0 = dict(replayer.stats)
+        import dataclasses as _dcr
+        timeline_mod.arm(timeline_dir or None)
+        # same saturation-calibrated arrival floor as run_soak: peak
+        # contention queues gangs for seconds by design
+        slo_mod.arm(tuple(
+            _dcr.replace(o, threshold_ms=max(o.threshold_ms, 60000.0))
+            if o.name == "arrival_decision_p99" else o
+            for o in slo_mod.DEFAULT_OBJECTIVES))
+        win = ledger_mod.window()
+        gc.collect()
+        t0 = time.perf_counter()
+        for cycle in range(cycles):
+            if cycle == fault_start:
+                faults.arm(plan)
+            if cycle == fault_stop:
+                faults.disarm()
+            kubelet_tick()
+            replayer.tick()
+            replayer.inject_elastic()
+            source.sync(timeout=30.0)
+            c0 = time.perf_counter()
+            sched.run_cycle()
+            cycle_hist.observe(time.perf_counter() - c0)
+            kubelet_tick()
+            reap_evictions()
+            with cache._lock:
+                lent = sum(n.backfilled.milli_cpu
+                           for n in cache.nodes.values())
+            backfilled_peak = max(backfilled_peak, lent)
+        wall = time.perf_counter() - t0
+        acct = readback_accounting(since=acct0)
+        recompiles = recompiles_total() - rc0
+    finally:
+        faults.disarm()
+        gc.enable()
+        timeline_mod.flush()
+        tstats = timeline_mod.stats()
+        slo_snap = slo_mod.snapshot()
+        slo_mod.disarm()
+        timeline_mod.disarm()
+        source.stop()
+        if saved_bf is None:
+            os.environ.pop("KUBEBATCH_RESERVED_BACKFILL", None)
+        else:
+            os.environ["KUBEBATCH_RESERVED_BACKFILL"] = saved_bf
+
+    _, _, cyc_buckets = cycle_hist.snapshot()
+    breaches = slo_breaches_total() - slo0
+    drift = timeline_drift_total() - drift0
+    stats = {k: replayer.stats[k] - stats0[k] for k in replayer.stats}
+    out = {
+        "metric": (f"sched_soak_cfg{config}_cycles{cycles}"
+                   f"_trace_{label}"),
+        "value": round(cycles / wall, 3) if wall else 0.0,
+        "unit": "cycles/s",
+        "vs_baseline": round(cycles / wall, 4) if wall else 0.0,
+        "measured_cycles": cycles,
+        "wall_s": round(wall, 3),
+        "trace_preset": label,
+        "trace_dt_s": round(dt, 3),
+        "trace_records": len(records),
+        "trace": stats,
+        "elastic_events": stats["elastic_events"],
+        "backfilled_peak_milli": round(backfilled_peak, 1),
+        "backfill": {
+            "over_placements":
+                backfill_over_placements_total() - bf0["over"],
+            "reclaims": backfill_reclaims_total() - bf0["reclaims"],
+            "tenants_evicted":
+                backfill_tenants_evicted_total() - bf0["evicted"],
+            "double_binds":
+                backfill_double_binds_total() - bf0["double"],
+            "lost_reservations":
+                lost_reservations_total() - bf0["lost"],
+        },
+        "audit_divergences": audit_failures_total() - audit0,
+        "faults_injected": sum(plan.injected.values()),
+        "faults_by_seam": dict(plan.injected),
+        "cycle_p50_ms": round(
+            (ledger_mod._pct_from_counts(cyc_buckets, 50) or 0.0) * 1e3,
+            3),
+        "cycle_p99_ms": round(
+            (ledger_mod._pct_from_counts(cyc_buckets, 99) or 0.0) * 1e3,
+            3),
+        "slo_report": {
+            "breaches_total": breaches,
+            "by_objective": slo_breaches_by_objective(),
+            "objectives": [
+                {"name": o["name"],
+                 "breached": o["breached"],
+                 "fast_burn": o["windows"]["fast"]["burn"],
+                 "slow_burn": o["windows"]["slow"]["burn"]}
+                for o in slo_snap.get("objectives", [])],
+        },
+        "timeline_drift_total": drift,
+        "timeline_drift_by_kind": timeline_drift_by_kind(),
+        "timeline": {
+            "path": (timeline_mod.TIMELINE.path or ""),
+            "ticks": tstats["ticks"],
+            "spilled": tstats["spilled"],
+            "ring": tstats["ring"],
+            "rss_mb_fast": tstats["rss_mb_fast"],
+            "rss_mb_slow": tstats["rss_mb_slow"],
+            "cycle_ms_fast": tstats["cycle_ms_fast"],
+            "cycle_ms_slow": tstats["cycle_ms_slow"],
+        },
+        "recompiles_total": recompiles,
+        "ledger": {
+            "decided": win.closed(),
+            "arrival_decision_p50_ms": round(win.percentile(50) or 0.0,
+                                             3),
+            "arrival_decision_p99_ms": round(win.percentile(99) or 0.0,
+                                             3),
+        },
+        "readback_accounting": acct,
+        "readbacks_per_decision": acct["readbacks_per_decision"],
+    }
+    return out
+
+
 def run_soak(config, cycles: int, churn_pods: int,
-             timeline_dir: str = "") -> dict:
+             timeline_dir: str = "", trace: str = "") -> dict:
     """Long-horizon soak (ISSUE 17): one steady churn regime driven for
     ``cycles`` scheduler cycles (default 10k from the CLI) with the SLO
     burn-rate plane armed on the shipped objectives and the timeline
@@ -1201,7 +1747,13 @@ def run_soak(config, cycles: int, churn_pods: int,
     produces a replayable JSONL record at O(1) resident memory, and the
     evidence line carries the SLO report, the drift counter and the
     ledger percentiles. The caller (main) hard-exits on any breach,
-    drift firing, or measured-window recompile."""
+    drift firing, or measured-window recompile.
+
+    With ``trace`` set (``--trace <preset|path>``) the whole regime is
+    delegated to the workloads/ plane — see run_trace_soak."""
+    if trace:
+        return run_trace_soak(config, cycles, trace,
+                              timeline_dir=timeline_dir)
     import gc
 
     from kubebatch_tpu import actions, compilesvc, plugins  # noqa: F401
@@ -1464,6 +2016,18 @@ def main(argv=None):
                     metavar="CHURN_PODS",
                     help="churn pods per cycle for --mode sustained "
                          "(default 256)")
+    ap.add_argument("--trace", default="", metavar="PRESET|PATH",
+                    help="drive the run from the workloads/ trace-replay "
+                         "plane instead of the synthetic churn templates "
+                         "(ISSUE 19): a preset name (borg-diurnal, "
+                         "ml-train-heavy) generates a seeded stream "
+                         "calibrated to the cluster; a path replays a "
+                         "JSONL trace verbatim. Wired through --steady, "
+                         "--mode sustained and --mode soak; the soak "
+                         "variant arms the backfill-over-reserved "
+                         "machinery plus the cache.fold/workload.elastic "
+                         "chaos seams and hard-fails on any audit "
+                         "divergence or backfill guard counter")
     ap.add_argument("--timeline-dir", default="", metavar="DIR",
                     help="with --mode soak: spill the per-cycle timeline "
                          "digests (obs/timeline.py) to DIR/timeline.jsonl "
@@ -1707,7 +2271,11 @@ def main(argv=None):
         # blocking readback on a conflict-free pipelined window fails
         # the run AFTER the evidence line lands
         out = run_sustained(args.config, max(args.cycles, 9), "auto",
-                            churn_pods=args.sustained_churn)
+                            churn_pods=args.sustained_churn,
+                            trace=args.trace)
+        if args.trace:
+            out["metric"] += "_trace"
+            out["trace_preset"] = args.trace
         out["backend"] = backend
         from kubebatch_tpu.metrics import compile_ms_total
         out["compile_ms_total"] = round(compile_ms_total(), 1)
@@ -1742,7 +2310,8 @@ def main(argv=None):
         # lands FIRST, then any breach / drift / recompile fails the run
         out = run_soak(args.config, max(args.cycles, 128),
                        churn_pods=args.sustained_churn,
-                       timeline_dir=args.timeline_dir)
+                       timeline_dir=args.timeline_dir,
+                       trace=args.trace)
         out["backend"] = backend
         from kubebatch_tpu.metrics import compile_ms_total
         out["compile_ms_total"] = round(compile_ms_total(), 1)
@@ -1763,6 +2332,29 @@ def main(argv=None):
         if not out["ledger"]["decided"]:
             failed.append("soak window closed no ledger records — the "
                           "churn regime bound nothing?")
+        if args.trace:
+            # the trace soak's extra pins (ISSUE 19): audit-clean all
+            # the way, guard counters at zero, and the backfill-over-
+            # reserved path actually exercised end-to-end
+            bf = out["backfill"]
+            if out["audit_divergences"]:
+                failed.append(f"{out['audit_divergences']} in-soak "
+                              f"audit divergence(s) (fold vs full-clone "
+                              f"snapshot_diff)")
+            if bf["double_binds"] or bf["lost_reservations"]:
+                failed.append(
+                    f"backfill guard counters nonzero: double_binds="
+                    f"{bf['double_binds']} lost_reservations="
+                    f"{bf['lost_reservations']}")
+            if not bf["over_placements"] or not bf["reclaims"]:
+                failed.append(
+                    f"trace soak never exercised backfill-over-reserved "
+                    f"(over_placements={bf['over_placements']}, "
+                    f"reclaims={bf['reclaims']}) — the contention "
+                    f"calibration regressed")
+            if not out["elastic_events"]:
+                failed.append("trace soak saw no elastic grow/shrink "
+                              "events")
         for msg in failed:
             print(f"soak bench: {msg}", file=sys.stderr)
         return 1 if failed else 0
@@ -1868,10 +2460,12 @@ def main(argv=None):
          recompiles, span_counts, trace_roots, phase_ms,
          acct) = run_steady(
             args.config, max(args.cycles, 9), args.mode, args.steady,
-            skew=args.steady_skew)
+            skew=args.steady_skew, trace=args.trace)
         p50_ms = float(np.percentile(latencies, 50) * 1e3)
         seconds = sum(latencies)
         suffix = "_steady_skew" if args.steady_skew else "_steady"
+        if args.trace:
+            suffix += "_trace"
         out = {
             "metric": f"sched_cycle_p50_ms_cfg{args.config}{suffix}",
             "value": round(p50_ms, 3),
@@ -1910,6 +2504,8 @@ def main(argv=None):
                 "audit": phase_ms.get("audit", 0.0)},
             "backend": backend,
         }
+        if args.trace:
+            out["trace_preset"] = args.trace
         # injection disarmed -> these pin to zero; a nonzero value on a
         # steady line means a seam fired outside an armed plan
         from kubebatch_tpu.metrics import (compile_ms_total,

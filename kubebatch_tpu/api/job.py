@@ -126,6 +126,9 @@ class JobInfo:
         self.priority: int = 0
         self.node_selector: Dict[str, str] = {}
         self.min_available: int = 0
+        #: elastic desired membership (>= min_available when set; 0 means
+        #: fixed-size — desired == min_available)
+        self.max_available: int = 0
         #: node -> fit-delta Resource for unschedulable diagnostics
         self.nodes_fit_delta: Dict[str, Resource] = {}
         self.tasks: Dict[str, TaskInfo] = {}
@@ -157,6 +160,7 @@ class JobInfo:
         self.name = pg.name
         self.namespace = pg.namespace
         self.min_available = pg.min_member
+        self.max_available = getattr(pg, "max_member", 0) or 0
         self.queue = pg.queue
         self.creation_timestamp = pg.creation_timestamp
         self.pod_group = pg
@@ -339,6 +343,11 @@ class JobInfo:
                 n += len(bucket)
         return n
 
+    @property
+    def desired_members(self) -> int:
+        """Elastic desired size: max_member when set, else min_member."""
+        return max(self.min_available, self.max_available)
+
     # --- readiness (fork semantics, ref: job_info.go:374-388) -------------
     def get_readiness(self) -> JobReadiness:
         allocated_cnt = self.count(*allocated_statuses())
@@ -390,6 +399,7 @@ class JobInfo:
         info.queue = self.queue
         info.priority = self.priority
         info.min_available = self.min_available
+        info.max_available = self.max_available
         info.node_selector = dict(self.node_selector)
         info.creation_timestamp = self.creation_timestamp
         info.pod_group = self.pod_group

@@ -37,7 +37,7 @@ from ..faults import BackoffPolicy, backoff_policy
 from ..faults import check as _fault_check
 from ..objects import (Node, Pod, PodDisruptionBudget, PodGroup,
                        PodGroupPhase, PodPhase, PriorityClass, Queue,
-                       UNSCHEDULABLE_CONDITION)
+                       UNSCHEDULABLE_CONDITION, is_backfill_pod)
 from ..obs import ledger as _ledger
 from ..obs import span as _span
 from ..util import env_on
@@ -659,6 +659,11 @@ class SchedulerCache:
             if node is None:
                 raise KeyError(f"failed to bind Task {task.uid} to host "
                                f"{hostname}, host does not exist")
+            # the backfill mark travels on the pod annotation (stamped by
+            # actions/backfill.py on the SHARED pod); refresh before node
+            # accounting so lent capacity lands in NodeInfo.backfilled
+            if not task.is_backfill and is_backfill_pod(task.pod):
+                task.is_backfill = True
             job.update_task_status(task, TaskStatus.BINDING)
             task.node_name = hostname
             node.add_task(task)
@@ -832,6 +837,11 @@ class SchedulerCache:
                         break
                 self._mark_job(job.uid)
 
+            # annotation-borne backfill marks, refreshed before the node
+            # accounting and the clone (see bind())
+            for t in twins:
+                if not t.is_backfill and is_backfill_pod(t.pod):
+                    t.is_backfill = True
             batch_set_attr(twins, "status", binding)
             batch_set_attr(twins, "node_name", hostnames)
             clones = batch_clone_tasks(twins, binding, hostnames)

@@ -207,6 +207,7 @@ def snapshot_diff(a, b) -> List[str]:
         ja, jb = a.jobs[uid], b.jobs[uid]
         if (ja.queue != jb.queue or ja.priority != jb.priority
                 or ja.min_available != jb.min_available
+                or ja.max_available != jb.max_available
                 or ja.creation_timestamp != jb.creation_timestamp
                 or ja.pod_group is not jb.pod_group
                 or ja.pdb is not jb.pdb

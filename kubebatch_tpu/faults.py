@@ -107,10 +107,18 @@ SEAMS: Dict[str, str] = {
                "dumps, without any objective burning; demote-not-raise "
                "like cache.fold — the breach machinery must never "
                "corrupt a scheduling cycle)",
+    "workload.elastic": "elastic gang resize delivered mid-flight "
+                        "(workloads/elastic.py / sim/chaos.py — a fired "
+                        "seam forces a grow/shrink event onto a live "
+                        "gang BETWEEN solve launch and consume, so the "
+                        "pipelined executor's flight-window fingerprint "
+                        "must invalidate the in-flight result rather "
+                        "than double-bind against the resized gang; the "
+                        "adversarial-timing rung, not a crash)",
 }
 
 FAMILIES = ("device", "rpc", "cache", "source", "lease", "fleet",
-            "solve", "pipeline", "obs")
+            "solve", "pipeline", "obs", "workload")
 
 
 class FaultInjected(RuntimeError):

@@ -22,7 +22,9 @@ from typing import Callable, Dict, List, Optional
 from ..api import (ClusterInfo, JobInfo, JobReadiness, NodeInfo, QueueInfo,
                    TaskInfo, TaskStatus, ValidateResult)
 from ..conf import Tier
-from ..metrics import update_pod_schedule_status, update_task_schedule_duration
+from ..metrics import (count_backfill_over_placement,
+                       update_pod_schedule_status,
+                       update_task_schedule_duration)
 from ..objects import (PodGroupCondition, PodGroupPhase, PodGroupStatus,
                        UNSCHEDULABLE_CONDITION)
 from .event import Event, EventHandler
@@ -402,6 +404,11 @@ class Session:
         self.touched_nodes.add(hostname)
         new_status = (TaskStatus.ALLOCATED_OVER_BACKFILL
                       if using_backfill_task_res else TaskStatus.ALLOCATED)
+        if using_backfill_task_res:
+            # session-only reservation over lent capacity; counted here
+            # so every entry path (allocate visit, device kernels,
+            # backfill over-reserve) lands in the same ledger
+            count_backfill_over_placement()
         job.update_task_status(task, new_status)
         task.node_name = hostname
         node = self.nodes.get(hostname)

@@ -521,8 +521,11 @@ class _NodeSegment:
                     rr = t.resreq
                     res[i] = (rr.milli_cpu, rr.memory, rr.milli_gpu)
         self.run_res = (res * VEC_SCALE).astype(np.float32)
+        # backfill tenants are lent capacity: never criticality-shielded
+        # from eviction (backfill-over-reserved reclaim depends on it)
         self.run_crit = np.fromiter(
-            (_pod_critical(t.pod) for t in run), bool, count=k)
+            (_pod_critical(t.pod) and not t.is_backfill for t in run),
+            bool, count=k)
         self.nz = accumulate_nz(tasks, [0] * len(tasks), 1)[0]
         self.n_tasks = len(tasks)
 
@@ -560,8 +563,11 @@ def _build_segments(pairs) -> Dict[str, _NodeSegment]:
                            count=n_flat)
     run_pos = np.flatnonzero(run_mask)
     run_tasks_flat = [flat[x] for x in run_pos]
+    # same backfill exemption as _NodeSegment.__init__: lent capacity
+    # is always evictable
     crit_flat = np.fromiter(
-        (_pod_critical(t.pod) for t in run_tasks_flat), bool,
+        (_pod_critical(t.pod) and not t.is_backfill
+         for t in run_tasks_flat), bool,
         count=len(run_tasks_flat))
     res_run = res32[run_pos]
     run_counts = np.bincount(np.asarray(rows, np.int64)[run_pos],

@@ -756,6 +756,75 @@ def pipeline_demotions_total() -> int:
         return sum(_pipeline_demotions.values())
 
 
+# -- backfill-over-reserved (ISSUE 19; actions/backfill.py) ------------
+# Lend/reclaim accounting for the completed fork feature: placements are
+# AllocatedOverBackfill tasks laid over lent (backfilled) capacity;
+# reclaims promote a gang to Ready by atomically evicting its backfill
+# tenants. The last two are GUARD counters — normally zero, hard-pinned
+# at zero by tools/bench_regression.py on trace soak lines: a double
+# bind means a promoted task dispatched against capacity its tenant
+# still holds; a lost reservation means an over-backfill placement the
+# action could neither promote nor cleanly release at session close.
+
+_backfill_over_placements = 0
+_backfill_reclaims = 0
+_backfill_tenants_evicted = 0
+_backfill_double_binds = 0
+_lost_reservations = 0
+
+
+def count_backfill_over_placement(n: int = 1) -> None:
+    global _backfill_over_placements
+    with _robust_lock:
+        _backfill_over_placements += n
+
+
+def backfill_over_placements_total() -> int:
+    with _robust_lock:
+        return _backfill_over_placements
+
+
+def count_backfill_reclaim(tenants_evicted: int) -> None:
+    """Record one gang promoted Ready by reclaiming its lent capacity
+    (``tenants_evicted`` backfill tasks evicted in the statement)."""
+    global _backfill_reclaims, _backfill_tenants_evicted
+    with _robust_lock:
+        _backfill_reclaims += 1
+        _backfill_tenants_evicted += tenants_evicted
+
+
+def backfill_reclaims_total() -> int:
+    with _robust_lock:
+        return _backfill_reclaims
+
+
+def backfill_tenants_evicted_total() -> int:
+    with _robust_lock:
+        return _backfill_tenants_evicted
+
+
+def count_backfill_double_bind() -> None:
+    global _backfill_double_binds
+    with _robust_lock:
+        _backfill_double_binds += 1
+
+
+def backfill_double_binds_total() -> int:
+    with _robust_lock:
+        return _backfill_double_binds
+
+
+def count_lost_reservation(n: int = 1) -> None:
+    global _lost_reservations
+    with _robust_lock:
+        _lost_reservations += n
+
+
+def lost_reservations_total() -> int:
+    with _robust_lock:
+        return _lost_reservations
+
+
 _arrivals_observed = 0
 
 

@@ -301,6 +301,10 @@ class PodGroup:
     namespace: str = "default"
     uid: str = field(default_factory=lambda: new_uid("pg"))
     min_member: int = 0
+    #: desired membership for elastic gangs (0 = fixed-size: desired ==
+    #: min_member). A gang with allocated >= min_member but < max_member
+    #: is AlmostReady — schedulable at its minimum, backfilled later.
+    max_member: int = 0
     queue: str = ""
     priority_class_name: str = ""
     creation_timestamp: float = 0.0

@@ -98,6 +98,13 @@ DEFAULT_COUNTS: Dict[str, int] = {
     # breach machinery itself cannot corrupt a cycle, and the report
     # pins every breach in the run to exactly the injected ones
     "obs.slo": 1,
+    # elastic-workload seam (ISSUE 19): fires once between cycles,
+    # forcing a grow on a live gang — desired rises above the bound
+    # membership via a group update + a fresh pod, mid-flight when the
+    # soak pipelines, and the bar stays: audit-clean cache every cycle,
+    # no double-binds, and the grown pod MUST bind by quiesce (it joins
+    # pods_by_uid, so a lost grow shows up as pending-remains)
+    "workload.elastic": 1,
 }
 
 #: the smoke-test subset: no device/rpc seams, so the ladder never
@@ -436,6 +443,57 @@ def run_chaos(cycles: int = 200, seed: int = 0,
                     source.emit_pod(pod)   # also records it in the world
                     pods_by_uid[pod.uid] = pod
 
+        # ---- elastic-workload injection (workload.elastic seam) ----
+        from ..workloads import ElasticDriver
+        elastic = ElasticDriver(source)
+        espec = chaos_spec(seed)
+
+        def elastic_tick() -> None:
+            """When the workload.elastic seam fires, grow one live gang
+            by a pod: desired rises via a group update and the fresh pod
+            rides the event stream like any arrival. It joins
+            pods_by_uid, so the quiesce gate requires it to BIND — a
+            grow the scheduler drops is a soak violation, not noise."""
+            by_group: Dict[str, List[Pod]] = {}
+            for pod in pods_by_uid.values():
+                by_group.setdefault(
+                    pod.annotations.get(GROUP_NAME_ANNOTATION, ""),
+                    []).append(pod)
+            for key in sorted(source.groups):
+                pg = source.groups.get(key)
+                if pg is None or not pg.name.startswith("job-"):
+                    continue
+                pods = by_group.get(pg.name, [])
+                if not pods or any(p.phase != PodPhase.RUNNING
+                                   for p in pods):
+                    continue
+
+                def make_pod(idx: int, _pg=pg) -> Pod:
+                    return Pod(
+                        name=f"{_pg.name}-{idx:03d}", namespace="sim",
+                        annotations={GROUP_NAME_ANNOTATION: _pg.name},
+                        containers=[Container(requests=resource_list(
+                            cpu=espec.pod_cpu_millis,
+                            memory=espec.pod_mem_bytes))],
+                        creation_timestamp=2e9 + elastic.grows)
+
+                # monotonic member index: churn may have deleted a
+                # mid-list member, so len(pods) can equal a LIVE pod's
+                # suffix — name from the high-water suffix instead
+                suffixes = []
+                for p in pods:
+                    tail = p.name.rsplit("-", 1)[-1]
+                    if tail.isdigit():
+                        suffixes.append(int(tail))
+                grown = elastic.maybe_inject(
+                    pg, pods, make_pod,
+                    next_index=max(suffixes, default=len(pods) - 1) + 1)
+                if grown is not None:
+                    _, added = grown
+                    for pod in added:
+                        pods_by_uid[pod.uid] = pod
+                return   # one candidate gang per tick: the seam decides
+
         def check_invariants(where: str) -> None:
             before = len(report.violations)
             with cache._lock:
@@ -482,6 +540,7 @@ def run_chaos(cycles: int = 200, seed: int = 0,
             in_window = fault_start <= cycle < fault_stop
             kubelet_tick()
             churn()
+            elastic_tick()
             source.sync(timeout=15.0)
             t0 = time.perf_counter()
             try:

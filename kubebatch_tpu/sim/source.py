@@ -482,6 +482,11 @@ class StreamingEventSource:
             self.groups[f"{pg.namespace}/{pg.name}"] = pg
         self._emit("group", "add", pg)
 
+    def emit_group_update(self, old: PodGroup, new: PodGroup) -> None:
+        with self._lock:
+            self.groups[f"{new.namespace}/{new.name}"] = new
+        self._emit("group", "update", new, old)
+
     def emit_group_delete(self, pg: PodGroup) -> None:
         with self._lock:
             self.groups.pop(f"{pg.namespace}/{pg.name}", None)

@@ -1,0 +1,32 @@
+"""workloads — the trace-replay workload plane (ROADMAP item 3).
+
+Three pillars, layered on the EXISTING ingestion path (the
+``sim/source.py`` streaming pump — no new way into the cache):
+
+- ``shapes``: composable seeded distributions — diurnal sinusoid
+  arrival rates, heavy-tail Pareto/lognormal sizes and durations,
+  burst episodes.
+- ``trace``: the trace record schema, the seeded generator with its
+  named presets (``borg-diurnal``, ``ml-train-heavy``), the JSONL
+  loader/dumper, and ``TraceReplayer`` — the driver that turns a
+  record stream into pod/podgroup adds, delayed completions, and
+  elastic resize events through a ``StreamingEventSource``.
+- ``elastic``: grow/shrink mechanics for gangs with
+  ``min_member != max_member`` (the ``workload.elastic`` fault seam's
+  host), riding ``emit_group_update`` + pod add/delete.
+
+See docs/WORKLOADS.md for the schema, the preset catalog, and the
+backfill-over-reserved state machine the replayed gangs exercise.
+"""
+from .elastic import ElasticDriver
+from .shapes import (BurstOverlay, DiurnalRate, LognormalSampler,
+                     ParetoSampler)
+from .trace import (PRESETS, TraceRecord, TraceReplayer, TraceSpec,
+                    generate_trace, load_trace, resolve_trace, save_trace)
+
+__all__ = [
+    "BurstOverlay", "DiurnalRate", "ElasticDriver", "LognormalSampler",
+    "PRESETS", "ParetoSampler", "TraceRecord", "TraceReplayer",
+    "TraceSpec", "generate_trace", "load_trace", "resolve_trace",
+    "save_trace",
+]

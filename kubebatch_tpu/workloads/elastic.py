@@ -1,0 +1,103 @@
+"""Elastic gang mechanics: grow/shrink a live PodGroup mid-run.
+
+A gang is elastic when ``min_member < max_member``: it schedules at its
+quorum (AlmostReady) and backfills toward the desired size. This module
+owns the event-side mechanics — a resize is an ordinary
+``emit_group_update`` (min/max change) plus pod adds or deletes through
+the SAME streaming source everything else rides, so the fold layer and
+the pipelined executor's flight-window fingerprint see it like any
+other churn.
+
+``ElasticDriver.maybe_inject`` is the ``workload.elastic`` fault seam's
+host: the chaos soak (sim/chaos.py) crosses it every cycle, and a fired
+seam forces a grow onto a live gang at adversarial timing — between
+solve launch and consume under the pipelined executor, where a stale
+in-flight result against the resized gang would double-bind unless the
+fingerprint invalidates it.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Tuple
+
+from .. import faults
+from ..objects import Pod, PodGroup, PodPhase
+
+
+class ElasticDriver:
+    """Applies grow/shrink events to live gangs through a
+    ``StreamingEventSource`` (sim/source.py)."""
+
+    def __init__(self, source):
+        self.source = source
+        #: counters for evidence lines (bench soak / chaos report)
+        self.grows = 0
+        self.shrinks = 0
+        self.injected = 0
+
+    def grow(self, pg: PodGroup, n: int,
+             make_pod: Callable[[int], Pod],
+             next_index: int) -> Tuple[PodGroup, List[Pod]]:
+        """Raise the gang's desired size by ``n``: group update first
+        (the membership contract changes before the pods exist, exactly
+        like a real controller scaling up), then ``n`` new member pods
+        built by ``make_pod(index)`` starting at ``next_index``.
+
+        ``next_index`` MUST be monotonic over the gang's lifetime (a
+        high-water member counter), never ``len(pods)``: after a mid-
+        list eviction (a reclaimed backfill tenant), the list length
+        equals a LIVE member's index, and reusing it would collide two
+        pods on one ns/name key in the scheduler cache."""
+        new_desired = next_index + n
+        new_pg = dataclasses.replace(
+            pg, max_member=max(new_desired, pg.min_member))
+        self.source.emit_group_update(pg, new_pg)
+        added = []
+        for i in range(n):
+            pod = make_pod(next_index + i)
+            self.source.emit_pod(pod)
+            added.append(pod)
+        self.grows += 1
+        return new_pg, added
+
+    def shrink(self, pg: PodGroup, pods: List[Pod],
+               n: int) -> Tuple[PodGroup, List[Pod]]:
+        """Lower the gang's desired size by ``n``: delete the ``n``
+        least-committed members (pending before running, newest first),
+        then shrink the membership contract — never below one member.
+        ``min_member`` follows the new desired size down when it would
+        otherwise exceed it."""
+        n = min(n, max(0, len(pods) - 1))
+        if n <= 0:
+            return pg, []
+        pending = [p for p in reversed(pods) if p.phase == PodPhase.PENDING]
+        running = [p for p in reversed(pods) if p.phase != PodPhase.PENDING]
+        victims = (pending + running)[:n]
+        for pod in victims:
+            self.source.emit_pod_delete(pod)
+        new_desired = len(pods) - n
+        new_pg = dataclasses.replace(
+            pg, max_member=new_desired,
+            min_member=min(pg.min_member, new_desired))
+        self.source.emit_group_update(pg, new_pg)
+        self.shrinks += 1
+        return new_pg, victims
+
+    def maybe_inject(self, pg: PodGroup, pods: List[Pod],
+                     make_pod: Callable[[int], Pod],
+                     next_index: Optional[int] = None
+                     ) -> Optional[Tuple[PodGroup, List[Pod]]]:
+        """The ``workload.elastic`` seam crossing: when the armed fault
+        plan fires, force a one-member grow onto the live gang ``pg``
+        RIGHT NOW — the caller sits between solve launch and consume,
+        so the resize lands mid-flight. Returns (new_pg, added) when
+        the seam fired, None otherwise. ``next_index`` defaults to
+        ``len(pods)`` — callers whose gangs can lose mid-list members
+        (evicted tenants) must pass their monotonic counter (see grow)."""
+        if not faults.should_fail("workload.elastic"):
+            return None
+        if next_index is None:
+            next_index = len(pods)
+        new_pg, added = self.grow(pg, 1, make_pod, next_index=next_index)
+        self.injected += 1
+        return new_pg, added

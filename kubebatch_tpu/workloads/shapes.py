@@ -1,0 +1,125 @@
+"""Composable workload shape primitives.
+
+Everything here is a pure function of a caller-owned
+``random.Random`` and the trace clock, so two generators built from
+the same seed produce bit-identical streams (pinned in
+tests/test_workloads.py). The shapes follow the Borg workload-trace
+characterizations the lineage papers lean on: arrival rates swing
+diurnally (a sinusoid over a day-shaped period), job sizes and
+durations are heavy-tailed (Pareto / lognormal — a few giants dominate
+the mass), and submission is bursty (short episodes of multiplied
+rate, e.g. cron storms and retry stampedes).
+"""
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class DiurnalRate:
+    """Arrival rate lambda(t) = base * (1 + amplitude*sin(2*pi*t/period)).
+
+    ``amplitude`` in [0, 1): the peak/trough ratio is
+    (1+a)/(1-a) — 0.6 gives the ~4x day/night swing of the Borg traces.
+    ``phase`` shifts the peak (fraction of a period).
+    """
+    base: float
+    amplitude: float = 0.0
+    period: float = 86400.0
+    phase: float = 0.0
+
+    def rate(self, t: float) -> float:
+        if self.amplitude <= 0.0:
+            return self.base
+        x = 2.0 * math.pi * (t / self.period + self.phase)
+        return self.base * (1.0 + self.amplitude * math.sin(x))
+
+    @property
+    def max_rate(self) -> float:
+        return self.base * (1.0 + max(0.0, self.amplitude))
+
+
+@dataclass(frozen=True)
+class BurstOverlay:
+    """Burst episodes over a base rate: while inside an episode the
+    rate is multiplied by ``factor``. Episodes recur every ``every``
+    seconds (from the episode-grid origin) and last ``duration``
+    seconds — deterministic placement, so the same seed replays the
+    same storms."""
+    every: float = 0.0
+    duration: float = 0.0
+    factor: float = 1.0
+
+    def multiplier(self, t: float) -> float:
+        if self.every <= 0.0 or self.duration <= 0.0 or self.factor == 1.0:
+            return 1.0
+        return self.factor if (t % self.every) < self.duration else 1.0
+
+    @property
+    def max_multiplier(self) -> float:
+        if self.every <= 0.0 or self.duration <= 0.0:
+            return 1.0
+        return max(1.0, self.factor)
+
+
+@dataclass(frozen=True)
+class ParetoSampler:
+    """Heavy-tail sampler: P(X > x) = (xmin/x)^alpha for x >= xmin.
+
+    ``alpha`` is the tail index (smaller = heavier; Borg task-count
+    tails sit around 1.5-2.5). Samples clamp to [lo, hi] when bounds
+    are given — gang sizes must stay schedulable on the sim cluster.
+    """
+    alpha: float
+    xmin: float = 1.0
+    lo: float = 0.0
+    hi: float = 0.0
+
+    def sample(self, rng: random.Random) -> float:
+        u = 1.0 - rng.random()        # (0, 1]
+        x = self.xmin / (u ** (1.0 / self.alpha))
+        if self.lo:
+            x = max(self.lo, x)
+        if self.hi:
+            x = min(self.hi, x)
+        return x
+
+
+@dataclass(frozen=True)
+class LognormalSampler:
+    """Lognormal sampler (mu/sigma in log space), clamped like
+    ParetoSampler. The duration workhorse: most jobs are short, the
+    tail runs for hours."""
+    mu: float
+    sigma: float
+    lo: float = 0.0
+    hi: float = 0.0
+
+    def sample(self, rng: random.Random) -> float:
+        x = rng.lognormvariate(self.mu, self.sigma)
+        if self.lo:
+            x = max(self.lo, x)
+        if self.hi:
+            x = min(self.hi, x)
+        return x
+
+
+def poisson_arrivals(rng: random.Random, rate: DiurnalRate,
+                     burst: BurstOverlay, horizon: float):
+    """Arrival times of a non-homogeneous Poisson process over
+    [0, horizon) via thinning: candidate points at the envelope rate,
+    accepted with probability lambda(t)/envelope. One rng, consumed in
+    a fixed order — bit-identical per seed."""
+    envelope = rate.max_rate * burst.max_multiplier
+    if envelope <= 0.0:
+        return
+    t = 0.0
+    while True:
+        t += rng.expovariate(envelope)
+        if t >= horizon:
+            return
+        lam = rate.rate(t) * burst.multiplier(t)
+        if rng.random() * envelope < lam:
+            yield t

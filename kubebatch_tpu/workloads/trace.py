@@ -1,0 +1,429 @@
+"""Trace records, the seeded generator, JSONL I/O, and the replayer.
+
+One record = one gang submission. The generator composes the shape
+primitives (workloads/shapes.py) into a deterministic stream — two
+generators built from the same (spec, seed, horizon) produce
+bit-identical records. A JSONL trace file holds the same schema, one
+record per line, so captured or hand-written traces replay through the
+identical path.
+
+``TraceReplayer`` is the only driver: it advances a sim clock and
+turns due records into pod/podgroup ADDS, due resizes into elastic
+grow/shrink events, and due completions into pod/podgroup DELETES —
+all through an existing ``sim/source.py`` ``StreamingEventSource``, so
+the fold layer, sub-cycles, and the pipelined executor ingest the
+firehose exactly the way they ingest everything else.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..objects import (BACKFILL_ANNOTATION, Container,
+                       GROUP_NAME_ANNOTATION, Pod, PodGroup, PodPhase,
+                       resource_list)
+from .elastic import ElasticDriver
+from .shapes import (BurstOverlay, DiurnalRate, LognormalSampler,
+                     ParetoSampler, poisson_arrivals)
+
+GiB = 1024 ** 3
+
+
+@dataclass
+class TraceRecord:
+    """One gang submission. ``tasks`` is the DESIRED member count (the
+    gang's pods at arrival); ``min_member`` <= tasks is the quorum — a
+    gap makes the gang elastic (AlmostReady-capable). ``resizes`` are
+    mid-run desired-size changes, each ``{"dt": seconds-after-arrival,
+    "to": new-desired}``. ``duration`` runs from the first moment the
+    gang is Running at QUORUM (``min_member`` members) to its
+    completion (delete) — elastic extras accelerate a real job but do
+    not gate its completion. Gating on full desired size would make
+    any gang whose extras starve immortal: it holds its quorum's
+    capacity forever, which starves more gangs, and the cluster wedges
+    on a feedback loop no admission-calibrated trace intends."""
+    t: float
+    name: str
+    tasks: int
+    min_member: int
+    duration: float
+    cpu_milli: float
+    mem_bytes: float
+    queue: int = 0
+    backfill: bool = False
+    resizes: List[Dict[str, float]] = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, line: str) -> "TraceRecord":
+        d = json.loads(line)
+        return cls(**d)
+
+
+@dataclass(frozen=True)
+class TraceSpec:
+    """A named generator configuration (preset or custom)."""
+    name: str
+    rate: DiurnalRate
+    sizes: ParetoSampler
+    durations: LognormalSampler
+    burst: BurstOverlay = BurstOverlay()
+    cpu_milli: float = 1000.0
+    mem_bytes: float = 2 * GiB
+    n_queues: int = 2
+    #: fraction of gangs with min_member < desired (elastic)
+    elastic_fraction: float = 0.0
+    #: of the elastic gangs, fraction that fires one mid-run resize
+    resize_fraction: float = 0.0
+    #: min_member = max(1, ceil(min_frac * desired)) for elastic gangs
+    min_frac: float = 0.5
+    #: fraction of submissions that are single-pod backfill (lendable)
+    backfill_fraction: float = 0.0
+    #: analytic approximations for load calibration (Little's law):
+    #: steady concurrent tasks ~= rate.base * mean_tasks * mean_duration
+    mean_tasks: float = 2.0
+    mean_duration: float = 300.0
+
+    def scale_rate(self, factor: float) -> "TraceSpec":
+        """Same shapes at ``factor``x the arrival rate — how a caller
+        fits a preset to its cluster's headroom."""
+        return dataclasses.replace(
+            self, rate=dataclasses.replace(self.rate,
+                                           base=self.rate.base * factor))
+
+
+#: the preset catalog (docs/WORKLOADS.md). Rates are in gangs per
+#: sim-second and deliberately LOW — callers calibrate with
+#: ``scale_rate`` (bench.py sizes offered load to cluster headroom).
+PRESETS: Dict[str, TraceSpec] = {
+    # Borg-shaped: strong diurnal swing ((1+.6)/(1-.6) = 4x peak/trough
+    # over a compressed 6h "day"), heavy-tail gang sizes (alpha 1.8),
+    # lognormal durations with a long tail, cron-storm bursts, a lendable
+    # best-effort stream, and a modest elastic cohort.
+    "borg-diurnal": TraceSpec(
+        name="borg-diurnal",
+        rate=DiurnalRate(base=0.05, amplitude=0.6, period=21600.0),
+        burst=BurstOverlay(every=3600.0, duration=120.0, factor=3.0),
+        sizes=ParetoSampler(alpha=1.8, xmin=1.0, lo=1.0, hi=8.0),
+        durations=LognormalSampler(mu=5.5, sigma=1.2, lo=60.0,
+                                   hi=7200.0),
+        elastic_fraction=0.25, resize_fraction=0.6, min_frac=0.5,
+        backfill_fraction=0.2,
+        mean_tasks=2.4, mean_duration=500.0),
+    # ML-training-shaped: larger gangs (alpha 1.5, up to 12), much
+    # longer durations, a flatter diurnal (training submits around the
+    # clock), a bigger elastic cohort (grow-to-desired is the norm),
+    # and a thinner backfill stream.
+    "ml-train-heavy": TraceSpec(
+        name="ml-train-heavy",
+        rate=DiurnalRate(base=0.02, amplitude=0.3, period=21600.0),
+        sizes=ParetoSampler(alpha=1.5, xmin=2.0, lo=2.0, hi=12.0),
+        durations=LognormalSampler(mu=6.5, sigma=1.0, lo=300.0,
+                                   hi=14400.0),
+        elastic_fraction=0.4, resize_fraction=0.7, min_frac=0.5,
+        backfill_fraction=0.1,
+        mean_tasks=3.9, mean_duration=1200.0),
+}
+
+
+def generate_trace(spec: TraceSpec, seed: int, horizon: float,
+                   max_jobs: int = 0) -> List[TraceRecord]:
+    """The seeded generator: records over [0, horizon) sim-seconds,
+    bit-identical per (spec, seed, horizon, max_jobs)."""
+    rng = random.Random(seed)
+    records: List[TraceRecord] = []
+    for t in poisson_arrivals(rng, spec.rate, spec.burst, horizon):
+        i = len(records)
+        if rng.random() < spec.backfill_fraction:
+            rec = TraceRecord(
+                t=t, name=f"bf-{i:06d}", tasks=1, min_member=1,
+                duration=spec.durations.sample(rng),
+                cpu_milli=spec.cpu_milli, mem_bytes=spec.mem_bytes,
+                queue=rng.randrange(max(1, spec.n_queues)),
+                backfill=True)
+        else:
+            desired = int(round(spec.sizes.sample(rng)))
+            desired = max(1, desired)
+            elastic = rng.random() < spec.elastic_fraction and desired > 1
+            min_member = (max(1, math.ceil(spec.min_frac * desired))
+                          if elastic else desired)
+            duration = spec.durations.sample(rng)
+            resizes: List[Dict[str, float]] = []
+            if elastic and rng.random() < spec.resize_fraction:
+                dt = duration * rng.uniform(0.2, 0.6)
+                if rng.random() < 0.5:
+                    to = desired + max(1, desired // 2)
+                else:
+                    to = max(min_member, desired - 1)
+                if to != desired:
+                    resizes.append({"dt": dt, "to": float(to)})
+            rec = TraceRecord(
+                t=t, name=f"tr-{i:06d}", tasks=desired,
+                min_member=min_member, duration=duration,
+                cpu_milli=spec.cpu_milli, mem_bytes=spec.mem_bytes,
+                queue=rng.randrange(max(1, spec.n_queues)),
+                resizes=resizes)
+        records.append(rec)
+        if max_jobs and len(records) >= max_jobs:
+            break
+    return records
+
+
+# ---------------------------------------------------------------------
+# JSONL I/O — the same schema on disk
+# ---------------------------------------------------------------------
+
+def save_trace(records: List[TraceRecord], path: str) -> None:
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(rec.to_json() + "\n")
+
+
+def load_trace(path: str) -> List[TraceRecord]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(TraceRecord.from_json(line))
+    return records
+
+
+def resolve_trace(arg: str, seed: int,
+                  horizon: float) -> Tuple[str, List[TraceRecord]]:
+    """``--trace <preset|path>`` resolution: a preset name generates a
+    seeded stream over ``horizon``; anything else must be a JSONL trace
+    file. Returns (label, records)."""
+    if arg in PRESETS:
+        return arg, generate_trace(PRESETS[arg], seed, horizon)
+    if os.path.exists(arg):
+        return os.path.basename(arg), load_trace(arg)
+    raise ValueError(
+        f"--trace {arg!r}: not a preset ({sorted(PRESETS)}) and no such "
+        f"file")
+
+
+# ---------------------------------------------------------------------
+# the replayer
+# ---------------------------------------------------------------------
+
+@dataclass
+class _LiveGang:
+    record: TraceRecord
+    pg: PodGroup
+    pods: List[Pod]
+    ready_at: Optional[float] = None
+    resizes: List[Tuple[float, int]] = field(default_factory=list)
+    #: high-water member index — grows name pods from here, NEVER from
+    #: len(pods): a reclaimed tenant leaves a hole mid-list, and naming
+    #: by length would collide a new pod with a live member's ns/name
+    next_idx: int = 0
+
+
+class TraceReplayer:
+    """Drives a record stream into a ``StreamingEventSource``.
+
+    One ``tick()`` advances the sim clock by ``dt`` seconds and emits,
+    in order: due arrivals (group add + pod adds), due elastic resizes
+    (group update + pod add/delete through ``ElasticDriver``), and due
+    completions (pod deletes + group delete). The caller owns the
+    scheduler loop and calls ``source.sync()``/``run_cycle`` between
+    ticks; ``kubelet(fresh)`` flips this replayer's freshly bound pods
+    to Running through the same event stream.
+    """
+
+    def __init__(self, records: List[TraceRecord],
+                 source, queues: List[str], namespace: str = "sim",
+                 dt: float = 1.0, base_timestamp: float = 3e9,
+                 on_pod_delete: Optional[Callable[[str], None]] = None):
+        self.records = sorted(records, key=lambda r: (r.t, r.name))
+        self.source = source
+        self.queues = list(queues)
+        self.namespace = namespace
+        self.dt = dt
+        self.base_timestamp = base_timestamp
+        self.on_pod_delete = on_pod_delete
+        self.clock = 0.0
+        self._next = 0
+        self.pods_by_uid: Dict[str, Pod] = {}
+        self.live: Dict[str, _LiveGang] = {}
+        self.elastic = ElasticDriver(source)
+        self.stats = {"arrivals": 0, "completions": 0, "grows": 0,
+                      "shrinks": 0, "elastic_events": 0,
+                      "pods_added": 0, "pods_deleted": 0}
+
+    # -- pod/gang construction ----------------------------------------
+    def _make_pod(self, gang: _LiveGang, idx: int) -> Pod:
+        rec = gang.record
+        annotations = {GROUP_NAME_ANNOTATION: gang.pg.name}
+        if rec.backfill:
+            annotations[BACKFILL_ANNOTATION] = "true"
+        return Pod(
+            name=f"{gang.pg.name}-{idx:03d}", namespace=self.namespace,
+            annotations=annotations,
+            containers=[Container(requests=resource_list(
+                cpu=rec.cpu_milli, memory=rec.mem_bytes))],
+            creation_timestamp=self.base_timestamp + rec.t + idx / 1e3)
+
+    def _arrive(self, rec: TraceRecord) -> None:
+        queue = (self.queues[rec.queue % len(self.queues)]
+                 if self.queues else "")
+        pg = PodGroup(
+            name=rec.name, namespace=self.namespace,
+            min_member=rec.min_member, max_member=rec.tasks,
+            queue=queue,
+            creation_timestamp=self.base_timestamp + rec.t)
+        gang = _LiveGang(record=rec, pg=pg, pods=[], next_idx=rec.tasks)
+        gang.resizes = [(rec.t + r["dt"], int(r["to"]))
+                        for r in rec.resizes]
+        self.source.emit_group(pg)
+        for i in range(rec.tasks):
+            pod = self._make_pod(gang, i)
+            self.source.emit_pod(pod)
+            gang.pods.append(pod)
+            self.pods_by_uid[pod.uid] = pod
+        self.live[rec.name] = gang
+        self.stats["arrivals"] += 1
+        self.stats["pods_added"] += rec.tasks
+
+    def _resize(self, gang: _LiveGang, to: int) -> None:
+        have = len(gang.pods)
+        if to > have:
+            new_pg, added = self.elastic.grow(
+                gang.pg, to - have,
+                lambda idx: self._make_pod(gang, idx),
+                next_index=gang.next_idx)
+            gang.next_idx += len(added)
+            gang.pods.extend(added)
+            for pod in added:
+                self.pods_by_uid[pod.uid] = pod
+            self.stats["grows"] += 1
+            self.stats["pods_added"] += len(added)
+            # quorum is unchanged by a grow (min_member stays), so a
+            # running gang keeps its completion clock — the new members
+            # are extras the allocator binds as capacity allows
+        elif to < have:
+            new_pg, removed = self.elastic.shrink(gang.pg, gang.pods,
+                                                  have - to)
+            for pod in removed:
+                gang.pods.remove(pod)
+                self.pods_by_uid.pop(pod.uid, None)
+                if self.on_pod_delete is not None:
+                    self.on_pod_delete(pod.uid)
+            self.stats["shrinks"] += 1
+            self.stats["pods_deleted"] += len(removed)
+        else:
+            return
+        gang.pg = new_pg
+        self.stats["elastic_events"] += 1
+
+    def _complete(self, gang: _LiveGang) -> None:
+        for pod in gang.pods:
+            self.source.emit_pod_delete(pod)
+            self.pods_by_uid.pop(pod.uid, None)
+            if self.on_pod_delete is not None:
+                self.on_pod_delete(pod.uid)
+        self.source.emit_group_delete(gang.pg)
+        del self.live[gang.record.name]
+        self.stats["completions"] += 1
+        self.stats["pods_deleted"] += len(gang.pods)
+
+    # -- the clock ------------------------------------------------------
+    def tick(self) -> Dict[str, int]:
+        """Advance ``dt`` sim-seconds; emit due arrivals, resizes and
+        completions. Returns this tick's event counts."""
+        before = dict(self.stats)
+        self.clock += self.dt
+        while (self._next < len(self.records)
+               and self.records[self._next].t <= self.clock):
+            self._arrive(self.records[self._next])
+            self._next += 1
+        for gang in list(self.live.values()):
+            due = [(t, to) for t, to in gang.resizes if t <= self.clock]
+            if due:
+                gang.resizes = [(t, to) for t, to in gang.resizes
+                                if t > self.clock]
+                for _, to in due:
+                    self._resize(gang, to)
+        for gang in list(self.live.values()):
+            if gang.ready_at is None:
+                # the completion clock starts at QUORUM, not at full
+                # desired size (see TraceRecord.duration)
+                running = sum(1 for p in gang.pods
+                              if p.phase == PodPhase.RUNNING)
+                if gang.pods and running >= max(1, gang.pg.min_member):
+                    gang.ready_at = self.clock
+            elif self.clock >= gang.ready_at + gang.record.duration:
+                self._complete(gang)
+        return {k: self.stats[k] - before[k] for k in self.stats}
+
+    def inject_elastic(self) -> bool:
+        """The ``workload.elastic`` fault seam's hook: when an armed
+        plan fires it, grow ONE fully-running live gang by a pod —
+        desired rises mid-run via a group update, exactly the
+        chaos-soak discipline (the caller's quiesce gate requires the
+        grown pod to bind). Call once per cycle; a no-fire is free."""
+        for name in sorted(self.live):
+            gang = self.live[name]
+            if gang.record.backfill or gang.ready_at is None:
+                continue
+            grown = self.elastic.maybe_inject(
+                gang.pg, gang.pods,
+                lambda idx: self._make_pod(gang, idx),
+                next_index=gang.next_idx)
+            if grown is None:
+                return False       # one candidate per tick; seam decides
+            gang.pg, added = grown
+            gang.next_idx += len(added)
+            gang.pods.extend(added)
+            for pod in added:
+                self.pods_by_uid[pod.uid] = pod
+            self.stats["grows"] += 1
+            self.stats["elastic_events"] += 1
+            self.stats["pods_added"] += len(added)
+            return True
+        return False
+
+    def kill_pod(self, uid: str) -> None:
+        """The evictor seam's hook: the cluster deletes an evicted pod
+        (a reclaimed backfill tenant). The pod leaves its gang through
+        the event stream; a gang emptied by the kill is completed
+        early (its group deleted) rather than left a zombie."""
+        pod = self.pods_by_uid.pop(uid, None)
+        if pod is None:
+            return
+        self.source.emit_pod_delete(pod)
+        if self.on_pod_delete is not None:
+            self.on_pod_delete(uid)
+        self.stats["pods_deleted"] += 1
+        gname = pod.annotations.get(GROUP_NAME_ANNOTATION, "")
+        gang = self.live.get(gname)
+        if gang is None:
+            return
+        gang.pods = [p for p in gang.pods if p.uid != uid]
+        if not gang.pods:
+            self.source.emit_group_delete(gang.pg)
+            del self.live[gname]
+            self.stats["completions"] += 1
+
+    def kubelet(self, fresh_pods: List[Pod]) -> None:
+        """Flip THIS replayer's freshly bound pods to Running via the
+        event stream (pods it does not own are left to the caller)."""
+        for pod in fresh_pods:
+            if (pod.uid in self.pods_by_uid
+                    and pod.phase == PodPhase.PENDING
+                    and pod.node_name):
+                pod.phase = PodPhase.RUNNING
+                self.source.emit_pod_update(pod, pod)
+
+    @property
+    def exhausted(self) -> bool:
+        """All records delivered and every delivered gang completed."""
+        return self._next >= len(self.records) and not self.live

@@ -47,9 +47,11 @@ def build_pod(ns: str, name: str, node_name: str, phase: PodPhase,
 
 
 def build_group(ns: str, name: str, min_member: int, queue: str = "",
-                creation_timestamp: float = 0.0) -> PodGroup:
+                creation_timestamp: float = 0.0,
+                max_member: int = 0) -> PodGroup:
     return PodGroup(name=name, namespace=ns, min_member=min_member,
-                    queue=queue, creation_timestamp=creation_timestamp)
+                    max_member=max_member, queue=queue,
+                    creation_timestamp=creation_timestamp)
 
 
 def build_queue(name: str, weight: int = 1) -> Queue:

@@ -499,6 +499,42 @@ def test_subcycle_gang_barrier_not_counted_as_decided():
     assert not diff
 
 
+def test_min_member_update_dirties_job_rows():
+    """ISSUE 19 regression: an elastic resize lands as a podgroup UPDATE
+    changing min_member while desired stays put. The fold layer must
+    dirty the job's rows for it — a stale min_available row keeps the
+    gang barrier at the old quorum, and the audited snapshot would show
+    the divergence."""
+    src, kubelet, cache = _mk_cluster(n_nodes=2)
+    old = build_group("ns", "g0", 3, queue="q1", max_member=3)
+    src.emit_group(old)
+    for p in range(2):
+        src.emit_pod(build_pod("ns", f"g0-{p}", "", PodPhase.PENDING,
+                               rl(500, GiB), group="g0",
+                               creation_timestamp=float(p)))
+    assert src.sync(5.0)
+    # cycle 1: quorum 3 with 2 pods — nothing may bind
+    snap, diff = cache.audited_snapshot()
+    assert not diff
+    ssn = OpenSession(cache, shipped_tiers(), snapshot=snap)
+    AllocateAction().execute(ssn)
+    CloseSession(ssn)
+    assert not kubelet.binds
+    # the resize: min_member 3 -> 2, desired unchanged
+    new = build_group("ns", "g0", 2, queue="q1", max_member=3)
+    src.emit_group_update(old, new)
+    assert src.sync(5.0)
+    snap, diff = cache.audited_snapshot()
+    assert not diff, diff[:8]
+    assert snap.jobs["ns/g0"].min_available == 2
+    # cycle 2: the folded snapshot's new quorum lets the gang place
+    ssn = OpenSession(cache, shipped_tiers(), snapshot=snap)
+    AllocateAction().execute(ssn)
+    CloseSession(ssn)
+    assert len(kubelet.binds) == 2
+    assert not snapshot_diff(cache.snapshot(), cache.snapshot_full())
+
+
 def test_gc_deleted_job_vanishes_from_incremental_snapshot():
     """The deleted-jobs GC pops from cache truth OUTSIDE the handler
     surface (process_cleanup_jobs); the incremental snapshot's

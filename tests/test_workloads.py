@@ -1,0 +1,285 @@
+"""workloads/ — the trace-replay workload plane (ISSUE 19).
+
+Covers the three pillars end to end: the seeded generator (bit-identical
+streams, JSONL round-trip, shape sanity), elastic gang mechanics (the
+three-state gang readiness ladder, grow-after-eviction naming), and the
+backfill-over-reserved state machine driven by a real TraceReplayer
+through a live Scheduler — grow, atomic tenant eviction, and the
+fold-vs-full-clone oracle staying bit-identical throughout.
+"""
+import random
+
+import pytest
+
+from kubebatch_tpu import actions, metrics, plugins  # noqa: F401
+from kubebatch_tpu.api import TaskStatus
+from kubebatch_tpu.cache import SchedulerCache
+from kubebatch_tpu.conf import PluginOption, Tier
+from kubebatch_tpu.framework import CloseSession, OpenSession
+from kubebatch_tpu.objects import PodPhase
+from kubebatch_tpu.sim import StreamingEventSource
+from kubebatch_tpu.workloads import (PRESETS, TraceRecord, TraceReplayer,
+                                     generate_trace, load_trace,
+                                     save_trace)
+from kubebatch_tpu.workloads.shapes import (BurstOverlay, DiurnalRate,
+                                            LognormalSampler,
+                                            ParetoSampler)
+
+from .fixtures import GiB, build_group, build_node, build_pod, build_queue, rl
+
+
+# ---------------------------------------------------------------------
+# pillar 1 — the generator and its shapes
+# ---------------------------------------------------------------------
+
+def test_generator_bit_identical_per_seed():
+    spec = PRESETS["borg-diurnal"]
+    a = generate_trace(spec, seed=7, horizon=20000.0)
+    b = generate_trace(spec, seed=7, horizon=20000.0)
+    assert [r.to_json() for r in a] == [r.to_json() for r in b]
+    assert a, "20000s of borg-diurnal must produce records"
+    c = generate_trace(spec, seed=8, horizon=20000.0)
+    assert [r.to_json() for r in a] != [r.to_json() for r in c]
+
+
+def test_jsonl_round_trip(tmp_path):
+    records = generate_trace(PRESETS["ml-train-heavy"], seed=3,
+                             horizon=40000.0)
+    path = str(tmp_path / "trace.jsonl")
+    save_trace(records, path)
+    loaded = load_trace(path)
+    assert [r.to_json() for r in loaded] == [r.to_json() for r in records]
+
+
+def test_diurnal_rate_ratio():
+    # amplitude 0.6 -> peak/trough = (1+.6)/(1-.6) = 4x
+    rate = DiurnalRate(base=1.0, amplitude=0.6, period=86400.0)
+    peak = rate.rate(86400.0 / 4)
+    trough = rate.rate(3 * 86400.0 / 4)
+    assert peak / trough == pytest.approx(4.0)
+    assert rate.max_rate == pytest.approx(1.6)
+
+
+def test_burst_overlay_windows():
+    burst = BurstOverlay(every=3600.0, duration=120.0, factor=3.0)
+    assert burst.multiplier(10.0) == 3.0       # inside the episode
+    assert burst.multiplier(500.0) == 1.0      # outside
+    assert burst.multiplier(3600.0 + 10.0) == 3.0
+    assert burst.max_multiplier == 3.0
+
+
+def test_samplers_clamp_and_tail_shape():
+    rng = random.Random(5)
+    sizes = ParetoSampler(alpha=1.8, xmin=1.0, lo=1.0, hi=8.0)
+    xs = [sizes.sample(rng) for _ in range(4000)]
+    assert all(1.0 <= x <= 8.0 for x in xs)
+    # heavy tail decreases: far more mass near xmin than near the cap
+    assert sum(x < 2.0 for x in xs) > 4 * sum(6.0 < x < 8.0 for x in xs)
+    durs = LognormalSampler(mu=5.5, sigma=1.2, lo=60.0, hi=7200.0)
+    ds = [durs.sample(rng) for _ in range(2000)]
+    assert all(60.0 <= d <= 7200.0 for d in ds)
+    assert min(ds) == 60.0 or max(ds) == 7200.0 or len(set(ds)) > 100
+
+
+def test_preset_census_has_all_cohorts():
+    """Both presets must emit every cohort the soak leans on: plain
+    gangs, elastic gangs (min < desired), mid-run resizes, and the
+    lendable backfill singles."""
+    for name, spec in PRESETS.items():
+        recs = generate_trace(spec, seed=1, horizon=60000.0)
+        assert any(r.backfill for r in recs), name
+        assert any(not r.backfill and r.min_member == r.tasks
+                   for r in recs), name
+        elastic = [r for r in recs if r.min_member < r.tasks]
+        assert elastic, name
+        assert any(r.resizes for r in elastic), name
+        for r in recs:
+            assert 1 <= r.min_member <= r.tasks
+            assert r.duration > 0 and r.cpu_milli > 0
+
+
+# ---------------------------------------------------------------------
+# pillar 2 — gang readiness three-state ladder + elastic naming
+# ---------------------------------------------------------------------
+
+def _tiers():
+    return [Tier(plugins=[PluginOption(name="priority"),
+                          PluginOption(name="gang")]),
+            Tier(plugins=[PluginOption(name="drf"),
+                          PluginOption(name="proportion")])]
+
+
+def test_gang_three_state_readiness():
+    """NotReady -> AlmostReady (quorum reachable only over lent
+    capacity) -> Ready (promoted), the gang plugin's ladder the
+    backfill-over-reserved machinery walks."""
+    cache = SchedulerCache(async_writeback=False)
+    cache.add_queue(build_queue("q1"))
+    cache.add_node(build_node("n1", rl(4000, 8 * GiB, pods=110)))
+    cache.add_pod_group(build_group("ns", "g", 2, queue="q1",
+                                    max_member=3))
+    for i in range(3):
+        cache.add_pod(build_pod("ns", f"g-{i}", "", PodPhase.PENDING,
+                                rl(1000, GiB), group="g",
+                                creation_timestamp=float(i)))
+    ssn = OpenSession(cache, _tiers())
+    job = ssn.jobs["ns/g"]
+    tasks = sorted(job.tasks.values(), key=lambda t: t.name)
+    assert not ssn.job_ready(job) and not ssn.job_almost_ready(job)
+    ssn.allocate(tasks[0], "n1")
+    assert not ssn.job_ready(job) and not ssn.job_almost_ready(job)
+    # second quorum member only fits over lent capacity: AlmostReady
+    ssn.allocate(tasks[1], "n1", True)
+    assert job.count(TaskStatus.ALLOCATED_OVER_BACKFILL) == 1
+    assert ssn.job_almost_ready(job) and not ssn.job_ready(job)
+    # promotion (what reclaim_over_backfill does after the evictions)
+    job.update_task_status(job.own_task(tasks[1]), TaskStatus.ALLOCATED)
+    assert ssn.job_ready(job) and not ssn.job_almost_ready(job)
+    CloseSession(ssn)
+
+
+def _mini_source(n_nodes=1, cpu=4000, mem=16 * GiB):
+    class Kubelet:
+        def __init__(self):
+            self.binds = {}
+            self.fresh = []
+            self.evicted = []
+
+        def bind(self, pod, hostname):
+            self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+            pod.node_name = hostname
+            self.fresh.append(pod)
+
+        def bind_many(self, pairs):
+            for pod, hostname in pairs:
+                self.bind(pod, hostname)
+
+        def evict(self, pod):
+            self.evicted.append(pod.uid)
+
+    kubelet = Kubelet()
+    cache = SchedulerCache(binder=kubelet, evictor=kubelet,
+                           async_writeback=False)
+    src = StreamingEventSource()
+    src.emit_queue(build_queue("q1"))
+    for n in range(n_nodes):
+        src.emit_node(build_node(f"n{n:02d}", rl(cpu, mem, pods=110)))
+    src.start(cache)
+    assert src.sync(5.0)
+    return src, kubelet, cache
+
+
+def test_grow_after_mid_list_eviction_skips_live_names():
+    """Regression: growing a gang after a mid-list member eviction must
+    name the new pod from the gang's high-water index, never from
+    len(pods) — the length equals a LIVE member's suffix after the
+    eviction, and reusing it collides two pods on one ns/name key in
+    the scheduler cache (a double bind at dispatch)."""
+    src, kubelet, cache = _mini_source()
+    rec = TraceRecord(t=0.5, name="g", tasks=3, min_member=2,
+                      duration=1e6, cpu_milli=100.0, mem_bytes=GiB)
+    rep = TraceReplayer([rec], src, ["q1"], dt=1.0)
+    rep.tick()
+    gang = rep.live["g"]
+    assert [p.name for p in gang.pods] == ["g-000", "g-001", "g-002"]
+    assert gang.next_idx == 3
+    rep.kill_pod(gang.pods[1].uid)       # mid-list hole: len(pods) == 2
+    rep._resize(gang, 3)                 # grow back to desired 3
+    names = [p.name for p in gang.pods]
+    assert len(names) == len(set(names)), names
+    assert "g-003" in names and "g-001" not in names, names
+    src.stop()
+
+
+# ---------------------------------------------------------------------
+# pillar 3 — replayer-driven backfill-over-reserved, end to end
+# ---------------------------------------------------------------------
+
+def test_replay_grow_atomic_reclaim_matches_oracle(monkeypatch):
+    """The whole pipeline on a hand-written trace: backfill singles fill
+    the node, a gang arrives that only fits over the lent capacity,
+    reclaim evicts the tenants ATOMICALLY with the gang's promotion and
+    dispatch, and a later elastic grow binds onto the freed capacity —
+    with the fold-vs-full-clone audit green at every cycle."""
+    from kubebatch_tpu.debug import audit_cache, snapshot_diff
+    from kubebatch_tpu.runtime.scheduler import Scheduler
+
+    monkeypatch.setenv("KUBEBATCH_RESERVED_BACKFILL", "1")
+    src, kubelet, cache = _mini_source()
+    records = [TraceRecord(t=0.2 + i / 1e3, name=f"bf-{i}", tasks=1,
+                           min_member=1, duration=1e6, cpu_milli=1000.0,
+                           mem_bytes=GiB, backfill=True)
+               for i in range(4)]
+    records.append(TraceRecord(
+        t=3.0, name="gang", tasks=2, min_member=2, duration=1e6,
+        cpu_milli=1000.0, mem_bytes=GiB,
+        resizes=[{"dt": 5.0, "to": 3.0}]))
+    rep = TraceReplayer(records, src, ["q1"], dt=1.0)
+    sched = Scheduler(cache, schedule_period=3600.0, audit_every=1)
+
+    reclaims0 = metrics.backfill_reclaims_total()
+    evicted0 = metrics.backfill_tenants_evicted_total()
+    double0 = metrics.backfill_double_binds_total()
+    lost0 = metrics.lost_reservations_total()
+    audit0 = metrics.audit_failures_total()
+
+    for cycle in range(12):
+        rep.kubelet(kubelet.fresh)
+        kubelet.fresh.clear()
+        rep.tick()
+        assert src.sync(5.0)
+        assert sched.run_cycle()
+        rep.kubelet(kubelet.fresh)
+        kubelet.fresh.clear()
+        while kubelet.evicted:
+            rep.kill_pod(kubelet.evicted.pop())
+        assert src.sync(5.0)
+        assert not audit_cache(cache)
+
+    # the tenants left atomically with the gang's promotion...
+    assert metrics.backfill_reclaims_total() - reclaims0 >= 1
+    assert metrics.backfill_tenants_evicted_total() - evicted0 >= 1
+    assert rep.stats["completions"] >= 1, "evicted singles must vanish"
+    # ...the gang bound its quorum AND its elastic grow
+    for name in ("sim/gang-000", "sim/gang-001", "sim/gang-002"):
+        assert name in kubelet.binds, (name, sorted(kubelet.binds))
+    assert rep.stats["grows"] >= 1 and rep.stats["elastic_events"] >= 1
+    # ...and the state machine stayed clean: no double bind, no leaked
+    # session-only reservation, fold snapshot == full-clone oracle
+    assert metrics.backfill_double_binds_total() - double0 == 0
+    assert metrics.lost_reservations_total() - lost0 == 0
+    assert metrics.audit_failures_total() - audit0 == 0
+    assert not snapshot_diff(cache.snapshot(), cache.snapshot_full())
+    with cache._lock:
+        leftover = [t for j in cache.jobs.values()
+                    for t in j.tasks.values()
+                    if t.status == TaskStatus.ALLOCATED_OVER_BACKFILL]
+    assert not leftover, "an over-backfill placement escaped the session"
+    src.stop()
+
+
+def test_replayer_quorum_clock_and_completion():
+    """An elastic gang running at quorum completes on schedule even when
+    its extras never bind — the immortal-gang wedge regression: gating
+    completion on full desired size leaks the quorum's capacity forever
+    once extras starve."""
+    src, kubelet, cache = _mini_source(cpu=2000)
+    # node fits exactly the quorum (2 x 1000m); the third pod starves
+    rec = TraceRecord(t=0.5, name="g", tasks=3, min_member=2,
+                      duration=3.0, cpu_milli=1000.0, mem_bytes=GiB)
+    rep = TraceReplayer([rec], src, ["q1"], dt=1.0)
+    from kubebatch_tpu.runtime.scheduler import Scheduler
+    sched = Scheduler(cache, schedule_period=3600.0)
+    for cycle in range(10):
+        rep.kubelet(kubelet.fresh)
+        kubelet.fresh.clear()
+        rep.tick()
+        assert src.sync(5.0)
+        sched.run_cycle()
+        rep.kubelet(kubelet.fresh)
+        kubelet.fresh.clear()
+        if rep.exhausted:
+            break
+    assert rep.exhausted, "quorum-running gang must complete"
+    assert rep.stats["completions"] == 1
+    src.stop()

@@ -15,6 +15,10 @@ them accordingly:
     trio (cross_tenant_shed, cross_tenant_errors, failover_lost).
     "fleet"-prefixed metrics must additionally carry the failover
     blip and its stated bound, and the blip may not exceed the bound.
+    Trace-replay soak lines ("sched_soak..._trace_<label>") must carry
+    the workload-plane census (elastic/backfill/audit block), keep the
+    reclaim guard counters and audit divergences at zero, and show the
+    over-reserve/reclaim path actually ran.
 - ADVISORY — reported with % delta, warn past --wall-tolerance, never
   fail: value, p50/p95/max wall-times, host_share_ms, compile totals.
 
@@ -125,6 +129,23 @@ SOAK_BOUNDS = (("slo_report.breaches_total", 0.0),
                ("recompiles_total", 0.0),
                ("readbacks_per_decision", 0.0))
 
+#: fields a trace-replay soak line (.._trace_<label>) must carry ON TOP
+#: of the soak block — the workload-plane census (ISSUE 19): the soak
+#: proves nothing about backfill-over-reserved unless the line shows
+#: the over-reserve/reclaim path actually ran and audited clean
+TRACE_REQUIRED = ("elastic_events", "backfilled_peak_milli",
+                  "backfill.over_placements", "backfill.reclaims",
+                  "backfill.tenants_evicted", "audit_divergences",
+                  "trace.arrivals", "trace.completions")
+
+#: absolute bounds on a trace CANDIDATE line: the atomic-reclaim guard
+#: counters stay zero (a double bind or a lost session-only reservation
+#: is a state-machine hole, not a perf delta), and the in-soak
+#: fold-vs-full-clone audit stays bit-identical under trace churn
+TRACE_BOUNDS = (("backfill.double_binds", 0.0),
+                ("backfill.lost_reservations", 0.0),
+                ("audit_divergences", 0.0))
+
 #: reported, warned past tolerance, never fatal (same-box numbers only)
 ADVISORY = (
     "value",
@@ -214,11 +235,41 @@ def diff_metric(metric: str, base: dict, cand: dict,
                     f"(the SLO/timeline evidence block) — missing "
                     f"from candidate")
         for key, bound in SOAK_BOUNDS:
+            if key == "readbacks_per_decision" and "_trace" in metric:
+                # the trace soak runs the SYNCHRONOUS loop by design:
+                # the replayer interleaves kubelet flips and reclaim
+                # evictions with every cycle, so deferred readbacks
+                # don't apply — the zero-blocking-readback pin is the
+                # pipelined (non-trace) soak's evidence
+                continue
             c = _num(cand, key)
             if c is not None and c > bound + EPS:
                 failures.append(
                     f"{metric}: {key} = {c:g} exceeds the structural "
                     f"bound {bound:g}")
+        if "_trace" in metric:
+            for key in TRACE_REQUIRED:
+                if _num(cand, key) is None:
+                    failures.append(
+                        f"{metric}: trace-soak line must carry numeric "
+                        f"'{key}' (the workload-plane census) — "
+                        f"missing from candidate")
+            for key, bound in TRACE_BOUNDS:
+                c = _num(cand, key)
+                if c is not None and c > bound + EPS:
+                    failures.append(
+                        f"{metric}: {key} = {c:g} exceeds the "
+                        f"structural bound {bound:g}")
+            over = _num(cand, "backfill.over_placements")
+            recl = _num(cand, "backfill.reclaims")
+            if over is not None and over < 1.0:
+                failures.append(
+                    f"{metric}: backfill.over_placements = 0 — the "
+                    f"soak never exercised over-reserve")
+            if recl is not None and recl < 1.0:
+                failures.append(
+                    f"{metric}: backfill.reclaims = 0 — the soak "
+                    f"never exercised atomic reclaim")
     elif "_churn" in metric:
         for key in ACTIVESET_REQUIRED:
             if _num(cand, key) is None:

@@ -88,6 +88,12 @@ $B 2400 python bench.py --config 5 --steady 256 --cycles 60
 # 10k-cycle default runs in dedicated soak windows, not the sweep)
 $B 3600 python bench.py --config 2 --mode soak --cycles 2000 \
     --sustained-churn 64 --timeline-dir /tmp/kb-sweep-timeline
+# trace-shaped soak (ISSUE 19, docs/WORKLOADS.md): Borg-style diurnal
+# + heavy-tail stream with elastic gangs and backfill-over-reserved;
+# hard-exits on breaches/drift/recompiles/audit divergences AND on a
+# window that never exercised over-reserve, reclaim, or elastic events
+$B 3600 python bench.py --config 2 --mode soak --cycles 2000 \
+    --trace borg-diurnal
 # chaos soak: degraded-mode p50 alongside healthy p50, invariant
 # violations fail the run (docs/ROBUSTNESS.md)
 $B 1200 python bench.py --chaos --cycles 240
